@@ -1,0 +1,20 @@
+"""bigdl_tpu: a TPU-native low-bit LLM inference & finetuning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the reference
+ipex-llm stack (see SURVEY.md): one-line low-bit loading of HF models,
+quantized checkpoint save/load, fused decode kernels, speculative decoding,
+QLoRA finetuning, tensor-parallel multi-chip inference, and serving.
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.ops.quant import (  # noqa: F401
+    QTensor,
+    QTYPES,
+    FLOAT_QTYPES,
+    get_qtype,
+    quantize,
+    dequantize,
+    quantize_linear,
+    dequantize_linear,
+)

@@ -1,0 +1,10 @@
+from bigdl_tpu.ops.quant import (  # noqa: F401
+    QTensor,
+    QTYPES,
+    FLOAT_QTYPES,
+    get_qtype,
+    quantize,
+    dequantize,
+    quantize_linear,
+    dequantize_linear,
+)

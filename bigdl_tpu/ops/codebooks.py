@@ -1,0 +1,60 @@
+"""Codebook tables for lookup-based quantization formats.
+
+The reference (ipex-llm) supports NF4/NF3/FP4 via ggml codebook kernels
+(see /root/reference SURVEY: ggml/quantize.py:28-47 qtype registry and the
+native `ggml_quantize_tensor` per-format paths). Here the codebooks are plain
+JAX constants; quantization is an argmin over the codebook and dequantization
+is a gather — both of which XLA vectorizes onto the VPU.
+
+Values:
+- NF4: the 16 "NormalFloat" levels from the QLoRA paper (quantiles of a
+  standard normal, normalized to [-1, 1]).
+- NF3: 8-level variant used by the reference's nf3 qtype.
+- FP4: e2m1 mini-float values (sign x {0, .5, 1, 1.5, 2, 3, 4, 6} / 6 scaled),
+  matching bitsandbytes' fp4 table.
+"""
+
+import numpy as np
+
+# QLoRA NF4 levels (exact values from the QLoRA paper / bitsandbytes).
+NF4_CODE = np.array(
+    [
+        -1.0,
+        -0.6961928009986877,
+        -0.5250730514526367,
+        -0.39491748809814453,
+        -0.28444138169288635,
+        -0.18477343022823334,
+        -0.09105003625154495,
+        0.0,
+        0.07958029955625534,
+        0.16093020141124725,
+        0.24611230194568634,
+        0.33791524171829224,
+        0.44070982933044434,
+        0.5626170039176941,
+        0.7229568362236023,
+        1.0,
+    ],
+    dtype=np.float32,
+)
+
+# 8-level NormalFloat (nf3): signed quantiles of N(0,1) normalized to [-1, 1].
+NF3_CODE = np.array(
+    [-1.0, -0.5350227355957031, -0.2469314038753510, 0.0,
+     0.1833375245332718, 0.3819939494132996, 0.6229856610298157, 1.0],
+    dtype=np.float32,
+)
+
+# FP4 (e2m1): bitsandbytes table, normalized so max |v| == 1.
+FP4_CODE = np.array(
+    [0.0, 0.0052, 0.6667, 1.0, 0.3333, 0.5, 0.1667, 0.25,
+     -0.0, -0.0052, -0.6667, -1.0, -0.3333, -0.5, -0.1667, -0.25],
+    dtype=np.float32,
+)
+
+CODEBOOKS = {
+    "nf4": NF4_CODE,
+    "nf3": NF3_CODE,
+    "fp4": FP4_CODE,
+}

@@ -1,0 +1,99 @@
+"""Quantized matmul: the hot op of the whole framework.
+
+TPU-native equivalent of the reference's dequant-matmul kernels
+(`linear_q4_0.forward_new` SYCL op, reference transformers/low_bit_linear.py:
+608-631, and the CPU `ggml_compute_forward_mul_mat_q_fp32` path at
+low_bit_linear.py:418-453).
+
+Two execution paths:
+- **XLA fallback** (`_q_matmul_xla`): dequantize to x.dtype then `jnp.dot`.
+  Works on any backend (CPU tests, interpret mode). XLA fuses the dequant
+  into the matmul's operand read on TPU reasonably well for prefill shapes.
+- **Pallas kernel** (`bigdl_tpu.ops.pallas.dequant_matmul`): streams the
+  *packed* int4/int8 blocks HBM->VMEM and unpacks in-kernel, so decode
+  (GEMV-like, memory-bound) reads ~K*N/2 bytes instead of 2*K*N. Selected
+  automatically on TPU for supported qtypes.
+
+The public entry is `q_matmul(x, w)` where `w` is a QTensor of logical shape
+[K, N] (contraction-major; see ops/quant.py) and x is [..., K].
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops.quant import QTensor, dequantize
+
+# Kernel backend selection:
+#   "auto"   — Pallas on TPU when supported, else XLA fallback
+#   "xla"    — always dequant + dot
+#   "pallas" — force Pallas (errors if unsupported)
+_BACKEND_ENV = "BIGDL_TPU_MATMUL_BACKEND"
+
+# qtypes the Pallas dequant-matmul kernel supports today.
+_PALLAS_QTYPES = frozenset({"sym_int4", "asym_int4", "nf4", "fp4", "nf3", "sym_int8"})
+
+
+def _backend() -> str:
+    return os.environ.get(_BACKEND_ENV, "auto")
+
+
+def _on_tpu(x: jax.Array) -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _q_matmul_xla(x: jax.Array, w: QTensor) -> jax.Array:
+    dense = dequantize(w, dtype=jnp.bfloat16)
+    y = jnp.dot(
+        x.astype(jnp.bfloat16), dense, preferred_element_type=jnp.float32
+    )
+    return y.astype(x.dtype)
+
+
+def q_matmul(x: jax.Array, w: QTensor, *, backend: Optional[str] = None) -> jax.Array:
+    """Compute x @ W for a quantized W of logical shape [K, N].
+
+    x: [..., K] float array. Returns [..., N] in x.dtype.
+    """
+    be = backend or _backend()
+    if be == "xla":
+        return _q_matmul_xla(x, w)
+    if be in ("auto", "pallas"):
+        use_pallas = w.qtype in _PALLAS_QTYPES and _on_tpu(x)
+        if be == "pallas" or use_pallas:
+            try:
+                from bigdl_tpu.ops.pallas.dequant_matmul import q_matmul_pallas
+
+                return q_matmul_pallas(x, w)
+            except NotImplementedError:
+                if be == "pallas":
+                    raise
+        return _q_matmul_xla(x, w)
+    raise ValueError(f"unknown matmul backend {be!r}")
+
+
+def q_linear(
+    x: jax.Array,
+    w: QTensor,
+    bias: Optional[jax.Array] = None,
+    *,
+    backend: Optional[str] = None,
+) -> jax.Array:
+    """LowBitLinear.forward equivalent: y = x @ W + b.
+
+    (reference transformers/low_bit_linear.py:546-668; the tensor-parallel
+    all-reduce the reference issues here — dist.inference_all_reduce at
+    low_bit_linear.py:635-637 — is unnecessary in this design: sharded
+    QTensors under pjit make XLA insert the collective.)
+    """
+    y = q_matmul(x, w, backend=backend)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y

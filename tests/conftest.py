@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip sharding (TP/DP/SP) is tested on a virtual CPU mesh, the
+multi-chip-simulatable test layer the reference lacks (SURVEY.md §4):
+`--xla_force_host_platform_device_count=8` gives 8 XLA CPU devices so
+pjit/shard_map collectives execute for real, single-host.
+"""
+
+import os
+
+# The ambient environment pins JAX_PLATFORMS to the real TPU tunnel ("axon");
+# unit tests must run on the virtual CPU mesh, unconditionally.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Belt and braces: if jax was already imported by a pytest plugin before this
+# conftest ran, the env var is too late — force the platform via config too.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,68 @@
+"""Tests for the quantized matmul (XLA fallback path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.ops.matmul import q_linear, q_matmul
+from bigdl_tpu.ops.quant import dequantize, quantize
+
+
+def _rand(shape, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+@pytest.mark.parametrize("qtype", ["sym_int4", "nf4", "sym_int8", "fp8_e4m3"])
+@pytest.mark.parametrize("m", [1, 8, 64])
+def test_q_matmul_matches_dequant_dot(qtype, m):
+    k, n = 256, 128
+    x = _rand((m, k), seed=1) * 0.1
+    w = _rand((k, n), seed=2) * 0.05
+    qt = quantize(w, qtype)
+    got = q_matmul(x, qt, backend="xla")
+    want = x.astype(jnp.bfloat16) @ dequantize(qt, jnp.bfloat16)
+    assert got.shape == (m, n)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_q_matmul_quality_vs_float():
+    # end-to-end quality: int4 matmul ≈ float matmul within quant noise
+    k, n, m = 512, 256, 4
+    x = _rand((m, k), seed=3) / np.sqrt(k)
+    w = _rand((k, n), seed=4)
+    qt = quantize(w, "sym_int4")
+    got = np.asarray(q_matmul(x, qt), np.float32)
+    want = np.asarray(x @ w, np.float32)
+    rel = np.abs(got - want).mean() / np.abs(want).mean()
+    assert rel < 0.12, rel
+
+
+def test_q_linear_bias_and_batch_dims():
+    k, n = 128, 64
+    x = _rand((2, 3, k))
+    w = _rand((k, n))
+    b = _rand((n,), seed=9)
+    qt = quantize(w, "sym_int4")
+    y = q_linear(x, qt, bias=b)
+    assert y.shape == (2, 3, n)
+    want = x @ dequantize(qt, jnp.float32) + b
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(want), rtol=3e-2, atol=6e-2
+    )
+
+
+def test_q_matmul_under_jit():
+    k, n = 128, 128
+    x = _rand((4, k))
+    qt = quantize(_rand((k, n), seed=7), "sym_int4")
+
+    @jax.jit
+    def f(x, qt):
+        return q_matmul(x, qt)
+
+    y = f(x, qt)
+    assert y.shape == (4, n)

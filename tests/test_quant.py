@@ -1,0 +1,143 @@
+"""Golden tests for the quantization core (SURVEY.md §7 stage 1).
+
+Modeled on the reference's numerical-equivalence test style
+(test/inference_gpu/test_transformers_api_attention.py pattern): quantize →
+dequantize must reconstruct within a qtype-dependent error bound, and the
+formats must satisfy their defining algebraic properties (max-element
+exactness for sym, min/max mapping for asym, codebook membership for nf4...).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.ops.quant import (
+    QTYPES,
+    QTensor,
+    dequantize,
+    dequantize_linear,
+    get_qtype,
+    quantize,
+    quantize_linear,
+)
+from bigdl_tpu.ops.codebooks import CODEBOOKS
+
+ALL_QTYPES = [
+    "sym_int4", "asym_int4", "sym_int5", "asym_int5", "sym_int8",
+    "nf4", "nf3", "fp4", "fp8_e4m3", "fp8_e5m2",
+]
+
+# max tolerated MAD (mean absolute deviation) relative to weight std=1,
+# per format. 4-bit ~ 0.04-0.1, 8-bit ~ 0.003.
+MAD_BOUND = {
+    "sym_int4": 0.08, "asym_int4": 0.08, "sym_int5": 0.04, "asym_int5": 0.04,
+    "sym_int8": 0.005, "nf4": 0.08, "nf3": 0.18, "fp4": 0.12,
+    "fp8_e4m3": 0.04, "fp8_e5m2": 0.08,
+}
+
+
+def _rand(k, n, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), (k, n), jnp.float32)
+
+
+@pytest.mark.parametrize("qtype", ALL_QTYPES)
+def test_roundtrip_mad(qtype):
+    x = _rand(256, 128)
+    qt = quantize(x, qtype)
+    y = dequantize(qt, dtype=jnp.float32)
+    assert y.shape == x.shape
+    mad = float(jnp.mean(jnp.abs(y - x)))
+    assert mad < MAD_BOUND[qtype], f"{qtype}: MAD {mad}"
+
+
+@pytest.mark.parametrize("qtype", ALL_QTYPES)
+def test_pytree_roundtrip(qtype):
+    x = _rand(64, 128)
+    qt = quantize(x, qtype)
+    leaves, treedef = jax.tree_util.tree_flatten(qt)
+    qt2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(qt2, QTensor)
+    assert qt2.qtype == qtype and qt2.shape == (64, 128)
+    np.testing.assert_array_equal(
+        np.asarray(dequantize(qt, jnp.float32)),
+        np.asarray(dequantize(qt2, jnp.float32)),
+    )
+
+
+def test_sym_int4_max_element_exact():
+    # ggml-style signed scale: the max-|x| element reconstructs exactly.
+    x = _rand(32, 128, seed=3)
+    qt = quantize(x, "sym_int4")
+    y = dequantize(qt, jnp.float32)
+    idx = jnp.argmax(jnp.abs(x), axis=0)
+    got = jnp.take_along_axis(y, idx[None, :], axis=0)[0]
+    want = jnp.take_along_axis(x, idx[None, :], axis=0)[0]
+    # scale stored bf16 (8 mantissa bits) → rounding bound 2^-8
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=5e-3)
+
+
+def test_asym_int4_endpoints():
+    x = _rand(32, 128, seed=4)
+    qt = quantize(x, "asym_int4")
+    y = np.asarray(dequantize(qt, jnp.float32))
+    xn = np.asarray(x)
+    # block = whole column here (32 = one block): min and max map to codes
+    # 0 and 15 and reconstruct to ~min and ~max (bf16 scale rounding).
+    np.testing.assert_allclose(y.min(0), xn.min(0), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(y.max(0), xn.max(0), rtol=2e-2, atol=2e-2)
+
+
+def test_nf4_values_on_codebook():
+    x = _rand(128, 128, seed=5)
+    qt = quantize(x, "nf4")
+    y = np.asarray(dequantize(qt, jnp.float32))
+    scale = np.asarray(qt.scale, np.float32).repeat(64, axis=0)
+    normalized = y / np.where(scale == 0, 1.0, scale)
+    code = CODEBOOKS["nf4"]
+    dist = np.abs(normalized[..., None] - code[None, None, :]).min(-1)
+    assert dist.max() < 1e-3
+
+
+def test_padding_of_nonmultiple_k():
+    x = _rand(40, 128)  # 40 not a multiple of block 32
+    qt = quantize(x, "sym_int4")
+    y = dequantize(qt, jnp.float32)
+    assert y.shape == (40, 128)
+    mad = float(jnp.mean(jnp.abs(y - x)))
+    assert mad < MAD_BOUND["sym_int4"]
+
+
+def test_quantize_linear_orientation():
+    w = _rand(128, 256)  # HF layout [out=128, in=256]
+    qt = quantize_linear(w, "sym_int4")
+    assert qt.shape == (256, 128)  # [K=in, N=out]
+    back = dequantize_linear(qt, jnp.float32)
+    assert back.shape == (128, 256)
+    assert float(jnp.mean(jnp.abs(back - w))) < MAD_BOUND["sym_int4"]
+
+
+def test_compression_ratio():
+    x = _rand(4096, 1024)
+    qt = quantize(x, "sym_int4")
+    dense_bytes = x.size * 4
+    # int4 + f16 scale per 32: 4.5 bits/value ≈ 7.1x vs f32
+    assert qt.nbytes < dense_bytes / 6.5
+
+
+def test_zero_block_stability():
+    x = jnp.zeros((64, 128))
+    for qtype in ALL_QTYPES:
+        y = dequantize(quantize(x, qtype), jnp.float32)
+        assert not np.isnan(np.asarray(y)).any(), qtype
+        np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-6)
+
+
+def test_jit_quantize_under_jit():
+    @jax.jit
+    def roundtrip(x):
+        return dequantize(quantize(x, "sym_int4"), jnp.float32)
+
+    x = _rand(64, 128)
+    y = roundtrip(x)
+    assert float(jnp.mean(jnp.abs(y - x))) < MAD_BOUND["sym_int4"]
