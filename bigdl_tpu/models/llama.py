@@ -1,0 +1,309 @@
+"""Llama-family model: functional, static-shape, scan-over-layers.
+
+TPU-native re-design of the reference's optimized llama path
+(reference transformers/models/llama.py: llama_model_forward_4_36 at :103,
+llama_attention_forward_4_36 at :875, llama_mlp_forward at :150,
+llama_rms_norm_forward at :134). Where the reference monkey-patches HF
+nn.Modules and dispatches per-shape to SYCL kernels, this is a from-scratch
+functional model over a parameter pytree:
+
+- All linear weights are contraction-major leaves ([K, N] dense or QTensor),
+  so every projection is one `linear()` call that hits the fused Pallas
+  dequant-matmul on TPU.
+- Per-layer parameters are STACKED along a leading L axis and the layer loop
+  is `lax.scan` — one layer gets traced/compiled once, not 32 times.
+- The KV cache is pre-allocated static-shape (ops/kvcache.py) and carried
+  through the scan; decode never re-allocates or re-compiles.
+- The same `forward()` serves prefill (Sq = prompt length) and decode
+  (Sq = 1): query positions make causal + cache-tail masking uniform.
+
+Covers the llama architecture family as the reference does (llama/llama2/
+codellama/vicuna and, via configs, mistral-style GQA models).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.ops.attention import sdp_attention
+from bigdl_tpu.ops.kvcache import KVCache, init_cache, read_layer, update_layer
+from bigdl_tpu.ops.matmul import linear
+from bigdl_tpu.ops.norms import rms_norm
+from bigdl_tpu.ops.rope import apply_rope, rope_cos_sin, rope_freqs
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    head_dim: Optional[int] = None
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    rope_scaling_factor: float = 1.0
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    sliding_window: Optional[int] = None
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_hf(cls, hf: Dict[str, Any]) -> "LlamaConfig":
+        """Build from an HF config dict (config.json of llama/mistral...)."""
+        rs = hf.get("rope_scaling") or {}
+        factor = 1.0
+        if rs and rs.get("rope_type", rs.get("type", "linear")) == "linear":
+            factor = float(rs.get("factor", 1.0))
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            num_key_value_heads=hf.get(
+                "num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rope_scaling_factor=factor,
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            attention_bias=hf.get("attention_bias", False),
+            mlp_bias=hf.get("mlp_bias", False),
+            sliding_window=hf.get("sliding_window"),
+        )
+
+
+# Parameter pytree layout (all linear leaves contraction-major [K, N]):
+# {
+#   "embed_tokens": [V, D],
+#   "layers": {
+#     "input_layernorm":          [L, D],
+#     "post_attention_layernorm": [L, D],
+#     "q_proj" | "k_proj" | "v_proj" | "o_proj":       stacked QTensor/dense,
+#     "gate_proj" | "up_proj" | "down_proj":           stacked QTensor/dense,
+#     (+ "<name>_bias": [L, N] when attention_bias/mlp_bias)
+#   },
+#   "norm": [D],
+#   "lm_head": QTensor/dense [D, V] (absent when tied),
+# }
+
+
+def _layer_step(cfg: LlamaConfig, carry, xs):
+    x, ck, cv, pos, cos, sin = carry
+    lp, lidx = xs
+    b, sq, d = x.shape
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+
+    # --- attention block ---
+    hidden = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
+    q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias"))
+    k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias"))
+    v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias"))
+    q = q.reshape(b, sq, h, hd)
+    k = k.reshape(b, sq, hkv, hd)
+    v = v.reshape(b, sq, hkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    ck, cv = update_layer(ck, cv, lidx, k, v, pos)
+    kf, vf = read_layer(ck, cv, lidx)
+    attn = sdp_attention(q, kf, vf, pos, sliding_window=cfg.sliding_window)
+    attn = attn.reshape(b, sq, h * hd)
+    x = x + linear(attn, lp["o_proj"], lp.get("o_proj_bias"))
+
+    # --- mlp block (fused gate/up + SiLU, the reference's mlp_forward_xpu) ---
+    hidden = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+    gate = linear(hidden, lp["gate_proj"], lp.get("gate_proj_bias"))
+    up = linear(hidden, lp["up_proj"], lp.get("up_proj_bias"))
+    mlp = linear(jax.nn.silu(gate) * up, lp["down_proj"],
+                 lp.get("down_proj_bias"))
+    x = x + mlp
+
+    return (x, ck, cv, pos, cos, sin), None
+
+
+def forward(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    tokens: jax.Array,       # [B, Sq] int32
+    cache: KVCache,
+    compute_dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, KVCache]:
+    """Run the model; returns (logits [B, Sq, V], updated cache).
+
+    `cache.pos` is the write offset: 0 for prefill, prompt_len + n for the
+    n-th decode step. One function, both phases (static Sq distinguishes
+    the compiled executables).
+    """
+    b, sq = tokens.shape
+    pos = cache.pos
+
+    x = params["embed_tokens"][tokens].astype(compute_dtype)
+
+    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
+                          scaling_factor=cfg.rope_scaling_factor)
+    positions = pos + jnp.arange(sq, dtype=jnp.int32)
+    cos, sin = rope_cos_sin(positions[None, :], inv_freq)  # [1, Sq, hd/2]
+
+    lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
+    (x, ck, cv, _, _, _), _ = lax.scan(
+        lambda c, xs: _layer_step(cfg, c, xs),
+        (x, cache.k, cache.v, pos, cos, sin),
+        (params["layers"], lidx),
+    )
+
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        logits = jnp.dot(x, params["embed_tokens"].T.astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+    else:
+        logits = linear(x, lm_head)
+    logits = logits.astype(jnp.float32)
+
+    return logits, KVCache(ck, cv, pos + sq)
+
+
+def forward_last_token(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    cache: KVCache,
+    compute_dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, KVCache]:
+    """Prefill variant that only computes lm_head on the final position —
+    the reference's `optimize_lm_head` trick (low_bit_linear.py:251-258),
+    which matters when V=32k+ and Sq is long."""
+    b, sq = tokens.shape
+    pos = cache.pos
+    x = params["embed_tokens"][tokens].astype(compute_dtype)
+    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
+                          scaling_factor=cfg.rope_scaling_factor)
+    positions = pos + jnp.arange(sq, dtype=jnp.int32)
+    cos, sin = rope_cos_sin(positions[None, :], inv_freq)
+    lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
+    (x, ck, cv, _, _, _), _ = lax.scan(
+        lambda c, xs: _layer_step(cfg, c, xs),
+        (x, cache.k, cache.v, pos, cos, sin),
+        (params["layers"], lidx),
+    )
+    x = rms_norm(x[:, -1:, :], params["norm"], cfg.rms_norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        logits = jnp.dot(x, params["embed_tokens"].T.astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+    else:
+        logits = linear(x, lm_head)
+    return logits.astype(jnp.float32), KVCache(ck, cv, pos + sq)
+
+
+def new_cache(cfg: LlamaConfig, batch: int, max_seq: int,
+              quantized: bool = False) -> KVCache:
+    return init_cache(cfg.num_hidden_layers, batch, max_seq,
+                      cfg.num_key_value_heads, cfg.hd,
+                      quantized=quantized)
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint -> parameter pytree (the conversion engine for this family;
+# reference analog: ggml_convert_low_bit walking nn.Modules, convert.py:643)
+# ---------------------------------------------------------------------------
+
+_LAYER_LINEARS = {
+    "self_attn.q_proj": "q_proj",
+    "self_attn.k_proj": "k_proj",
+    "self_attn.v_proj": "v_proj",
+    "self_attn.o_proj": "o_proj",
+    "mlp.gate_proj": "gate_proj",
+    "mlp.up_proj": "up_proj",
+    "mlp.down_proj": "down_proj",
+}
+
+
+def convert_hf_params(
+    tensors,                      # iterable of (name, np.ndarray)
+    cfg: LlamaConfig,
+    qtype: Optional[str] = "sym_int4",
+    compute_dtype=jnp.bfloat16,
+    modules_to_not_convert: Tuple[str, ...] = (),
+) -> Dict[str, Any]:
+    """Build the parameter pytree from HF-named tensors, quantizing linears.
+
+    qtype=None (or a FLOAT_QTYPE) keeps dense weights in compute_dtype —
+    the reference's optimize_model(low_bit=False) / BF16Linear path.
+    Weights are converted tensor-by-tensor (host holds one at a time) and
+    per-layer results are stacked along a leading L axis for lax.scan.
+    """
+    from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize_linear
+
+    L = cfg.num_hidden_layers
+    do_quant = qtype is not None and qtype not in FLOAT_QTYPES
+
+    def cvt_linear(name: str, w) -> Any:
+        w = jnp.asarray(np.asarray(w))
+        if do_quant and not any(m in name for m in modules_to_not_convert):
+            return quantize_linear(w, qtype)
+        return w.T.astype(compute_dtype)  # contraction-major dense
+
+    layer_acc: Dict[str, list] = {}
+    params: Dict[str, Any] = {}
+
+    def put_layer(key: str, idx: int, val):
+        slot = layer_acc.setdefault(key, [None] * L)
+        slot[idx] = val
+
+    for name, w in tensors:
+        if name in ("model.embed_tokens.weight", "transformer.wte.weight"):
+            params["embed_tokens"] = jnp.asarray(np.asarray(w)).astype(
+                compute_dtype)
+        elif name == "model.norm.weight":
+            params["norm"] = jnp.asarray(np.asarray(w)).astype(compute_dtype)
+        elif name == "lm_head.weight":
+            params["lm_head"] = cvt_linear(name, w)
+        elif name.startswith("model.layers."):
+            parts = name.split(".")
+            idx = int(parts[2])
+            sub = ".".join(parts[3:-1])   # e.g. self_attn.q_proj
+            leaf = parts[-1]              # weight | bias
+            if sub in _LAYER_LINEARS:
+                key = _LAYER_LINEARS[sub]
+                if leaf == "weight":
+                    put_layer(key, idx, cvt_linear(name, w))
+                else:
+                    put_layer(f"{key}_bias", idx,
+                              jnp.asarray(np.asarray(w)).astype(compute_dtype))
+            elif sub in ("input_layernorm", "post_attention_layernorm"):
+                put_layer(sub, idx,
+                          jnp.asarray(np.asarray(w)).astype(compute_dtype))
+            # rotary_emb.inv_freq etc. are derived, skip
+        # else: ignore non-model tensors
+
+    missing = [k for k, v in layer_acc.items() if any(x is None for x in v)]
+    if missing:
+        raise ValueError(f"checkpoint missing layer tensors for: {missing}")
+
+    layers = {}
+    for key, per_layer in layer_acc.items():
+        layers[key] = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+    params["layers"] = layers
+
+    if cfg.tie_word_embeddings:
+        params.pop("lm_head", None)
+    elif "lm_head" not in params:
+        raise ValueError("checkpoint has no lm_head.weight and config does "
+                         "not tie word embeddings")
+    return params

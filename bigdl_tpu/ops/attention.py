@@ -1,0 +1,69 @@
+"""Scaled-dot-product attention over the static KV cache.
+
+TPU-native equivalent of the reference's attention dispatch surface: the
+prefill flash/native_sdp paths and the decode `sdp_fp8`/ESIMD `sdp_forward`
+kernels (reference transformers/models/llama.py:1320-1349, models/utils.py:
+315-355 gates, and the SYCL ops inventoried in SURVEY.md §2.3-C/D).
+
+One function serves prefill and decode: queries carry explicit positions, so
+causal masking and cache-tail masking collapse into a single comparison —
+no separate mask tensors, no dynamic shapes, garbage in the unwritten cache
+tail is masked because key_pos > query_pos there. GQA is computed by
+reshaping queries to [.., kv_heads, group, ..] (no KV head replication, which
+would multiply HBM traffic by the group size).
+
+FP8 KV: pass e5m2 k/v straight in — the upcast happens inside and XLA fuses
+it into the QK/AV matmul operand reads (the reference needs dedicated
+`query_key_fp8_matmul` kernels for this; XLA gets it from fusion).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sdp_attention(
+    q: jax.Array,          # [B, Sq, H, D] (post-RoPE)
+    k: jax.Array,          # [B, Skv, Hkv, D] (cache slice; any storage dtype)
+    v: jax.Array,          # [B, Skv, Hkv, D]
+    q_pos: jax.Array,      # scalar int32: absolute position of q[..., 0, ...]
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Causal SDP against a (possibly partially-filled) KV cache.
+
+    Query i attends keys j where j <= q_pos + i (and within the sliding
+    window if set). Returns [B, Sq, H, D] in q.dtype. Softmax in f32.
+    """
+    b, sq, h, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    g = h // hkv
+    if scale is None:
+        scale = d ** -0.5
+
+    qf = q.reshape(b, sq, hkv, g, d).astype(jnp.bfloat16)
+    kf = k.astype(jnp.bfloat16)
+    vf = v.astype(jnp.bfloat16)
+
+    # [B, Hkv, G, Sq, Skv]
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf,
+                        preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if logits_soft_cap is not None:
+        scores = jnp.tanh(scores / logits_soft_cap) * logits_soft_cap
+
+    q_ids = q_pos + jnp.arange(sq, dtype=jnp.int32)          # [Sq]
+    k_ids = jnp.arange(skv, dtype=jnp.int32)                 # [Skv]
+    mask = k_ids[None, :] <= q_ids[:, None]                  # [Sq, Skv]
+    if sliding_window is not None:
+        mask &= k_ids[None, :] > q_ids[:, None] - sliding_window
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(jnp.bfloat16), vf,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, sq, h, d).astype(q.dtype)

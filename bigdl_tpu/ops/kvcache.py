@@ -1,0 +1,107 @@
+"""Static-shape KV cache with optional FP8 storage.
+
+TPU-native re-design of the reference's KV caching
+(`DynamicNormalCache`/`DynamicFp8Cache`, reference transformers/kv.py:28-123,
+and init/append/extend helpers in transformers/models/utils.py:38-153).
+
+The reference grows its cache in 256-token blocks (realloc + copy) because
+PyTorch tolerates dynamic shapes. Under XLA everything must be static: the
+cache is **pre-allocated at max_seq_len** and appends are
+`lax.dynamic_update_slice` writes at the current position — no realloc ever,
+the jit-compiled decode step has one shape for its whole lifetime. Validity
+is tracked by a scalar `pos`; attention masks keys at positions >= the
+query's position + 1 (so garbage in the unwritten tail is never read).
+
+FP8 ("quantize_kv_cache"): stores K/V as float8_e5m2 exactly like the
+reference's scale-free e5m2 cache (models/utils.py:99-153), halving KV HBM
+traffic; values are upcast at attention time and XLA fuses the cast into the
+matmul operand read.
+
+Layout: [num_layers, batch, max_seq, kv_heads, head_dim] — the whole stack is
+one array per K/V so a `lax.scan` over layers can carry it and update layer
+slices in place (donated buffers alias, so there is no copy in the hot loop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class KVCache:
+    k: jax.Array    # [L, B, S_max, H_kv, D]
+    v: jax.Array    # [L, B, S_max, H_kv, D]
+    pos: jax.Array  # scalar int32: number of valid positions
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.pos), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+
+def init_cache(
+    num_layers: int,
+    batch: int,
+    max_seq: int,
+    kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+    quantized: bool = False,
+) -> KVCache:
+    """Allocate an empty cache. quantized=True stores float8_e5m2."""
+    dt = jnp.float8_e5m2 if quantized else dtype
+    shape = (num_layers, batch, max_seq, kv_heads, head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dt),
+        v=jnp.zeros(shape, dt),
+        pos=jnp.zeros((), jnp.int32),
+    )
+
+
+def update_layer(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    layer: jax.Array | int,
+    k_new: jax.Array,   # [B, S_new, H_kv, D]
+    v_new: jax.Array,
+    pos: jax.Array,     # scalar int32: write offset
+) -> Tuple[jax.Array, jax.Array]:
+    """Write k_new/v_new into layer `layer` at sequence offset `pos`.
+
+    Returns the updated full-stack arrays. Under jit with donated inputs this
+    lowers to an in-place dynamic-update-slice.
+    """
+    k_new = k_new.astype(cache_k.dtype)[None]
+    v_new = v_new.astype(cache_v.dtype)[None]
+    idx = (layer, 0, pos, 0, 0)
+    return (
+        jax.lax.dynamic_update_slice(cache_k, k_new, idx),
+        jax.lax.dynamic_update_slice(cache_v, v_new, idx),
+    )
+
+
+def read_layer(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    layer: jax.Array | int,
+    compute_dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, jax.Array]:
+    """Full-length K/V for one layer, upcast from storage dtype."""
+    k = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
+    v = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
+    return k.astype(compute_dtype), v.astype(compute_dtype)

@@ -79,6 +79,28 @@ def q_matmul(x: jax.Array, w: QTensor, *, backend: Optional[str] = None) -> jax.
     raise ValueError(f"unknown matmul backend {be!r}")
 
 
+def linear(
+    x: jax.Array,
+    w,
+    bias: Optional[jax.Array] = None,
+    *,
+    backend: Optional[str] = None,
+) -> jax.Array:
+    """Linear over either a QTensor or a dense [K, N] array.
+
+    Model code calls this uniformly; float-qtype models (fp16/bf16 paths of
+    the reference's BF16Linear/FP16Linear, low_bit_linear.py:671-827) carry
+    dense leaves, quantized models carry QTensors.
+    """
+    if isinstance(w, QTensor):
+        return q_linear(x, w, bias, backend=backend)
+    y = jnp.dot(x, w.astype(x.dtype), preferred_element_type=jnp.float32)
+    y = y.astype(x.dtype)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
 def q_linear(
     x: jax.Array,
     w: QTensor,

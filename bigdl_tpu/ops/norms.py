@@ -1,0 +1,41 @@
+"""Normalization ops.
+
+TPU-native equivalents of the reference's fused norm kernels:
+`linear_q4_0.rms_norm` (reference transformers/models/llama.py:134-141) and
+`fused_layer_norm` (models/utils.py). On TPU these are bandwidth-trivial
+elementwise+reduce patterns that XLA fuses into neighboring ops, so the
+default implementation is plain jnp; a Pallas variant exists for fusing into
+surrounding kernels when profiling shows a win.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in f32 accumulation, output in x.dtype (llama-family)."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dt)
+
+
+def layer_norm(
+    x: jax.Array,
+    weight: jax.Array,
+    bias: jax.Array | None = None,
+    eps: float = 1e-5,
+) -> jax.Array:
+    """Standard LayerNorm in f32 accumulation (gpt/bert families)."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dt)

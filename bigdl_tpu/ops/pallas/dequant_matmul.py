@@ -63,18 +63,18 @@ def _dequant_tile(codes_blk, scale, zero, kind: str, codebook, bk: int, bn: int)
         z = zero.astype(jnp.float32)[:, None, :]
         vals = codes_f * s + z
     elif kind == "codebook":
-        # 16-entry LUT via 4 select levels (binary decomposition) — avoids
-        # gather, which Mosaic lowers poorly. codes in [0, 15]:
-        # val = sum over code table with bit-select tree.
+        # LUT via binary select tree (avoids gather, which Mosaic lowers
+        # poorly). Codes are stored in 4-bit nibbles; tables smaller than 16
+        # (nf3 has 8 entries) are zero-padded — those codes never occur.
         c = codes_blk
-        tbl = codebook
+        tbl = list(codebook) + [0.0] * (16 - len(codebook))
         def sel(bit, lo_v, hi_v):
             return jnp.where(bit, hi_v, lo_v)
         b0 = (c & 1).astype(jnp.bool_)
         b1 = ((c >> 1) & 1).astype(jnp.bool_)
         b2 = ((c >> 2) & 1).astype(jnp.bool_)
         b3 = ((c >> 3) & 1).astype(jnp.bool_)
-        # level 0: pairs
+        # level 0: pairs, pattern matches bit ordering lsb->msb
         l0 = [sel(b0, tbl[i], tbl[i + 1]) for i in range(0, 16, 2)]
         l1 = [sel(b1, l0[i], l0[i + 1]) for i in range(0, 8, 2)]
         l2 = [sel(b2, l1[i], l1[i + 1]) for i in range(0, 4, 2)]
@@ -84,16 +84,14 @@ def _dequant_tile(codes_blk, scale, zero, kind: str, codebook, bk: int, bn: int)
     return vals.reshape(bk, bn).astype(jnp.bfloat16)
 
 
-def _kernel_4bit(x_ref, data_ref, scale_ref, out_ref, acc_ref, *,
-                 block, kind, codebook, bk, bn, nk):
+def _accumulate(x_ref, w, out_ref, acc_ref, nk):
+    """Shared K-loop accumulate/writeback (grid axis 2 = K, innermost)."""
     k = pl.program_id(2)
 
     @pl.when(k == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    codes = _unpack_tile(data_ref[:], block, bk, bn)
-    w = _dequant_tile(codes, scale_ref[:], None, kind, codebook, bk, bn)
     acc_ref[:] += jax.lax.dot_general(
         x_ref[:], w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -104,45 +102,24 @@ def _kernel_4bit(x_ref, data_ref, scale_ref, out_ref, acc_ref, *,
         out_ref[:] = acc_ref[:].astype(out_ref.dtype)
 
 
-def _kernel_4bit_asym(x_ref, data_ref, scale_ref, zero_ref, out_ref, acc_ref,
-                      *, block, bk, bn, nk):
-    k = pl.program_id(2)
-
-    @pl.when(k == 0)
-    def _():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
+def _kernel_4bit(x_ref, data_ref, scale_ref, *rest, block, kind, codebook,
+                 bk, bn, nk):
+    if kind == "asym":
+        zero_ref, out_ref, acc_ref = rest
+        zero = zero_ref[:]
+    else:
+        (out_ref, acc_ref), zero = rest, None
     codes = _unpack_tile(data_ref[:], block, bk, bn)
-    w = _dequant_tile(codes, scale_ref[:], zero_ref[:], "asym", None, bk, bn)
-    acc_ref[:] += jax.lax.dot_general(
-        x_ref[:], w, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-
-    @pl.when(k == nk - 1)
-    def _():
-        out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+    w = _dequant_tile(codes, scale_ref[:], zero, kind, codebook, bk, bn)
+    _accumulate(x_ref, w, out_ref, acc_ref, nk)
 
 
 def _kernel_int8(x_ref, data_ref, scale_ref, out_ref, acc_ref, *,
                  block, bk, bn, nk):
-    k = pl.program_id(2)
-
-    @pl.when(k == 0)
-    def _():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
     s = scale_ref[:].astype(jnp.float32)[:, None, :]
     vals = data_ref[:].astype(jnp.float32).reshape(bk // block, block, bn) * s
     w = vals.reshape(bk, bn).astype(jnp.bfloat16)
-    acc_ref[:] += jax.lax.dot_general(
-        x_ref[:], w, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-
-    @pl.when(k == nk - 1)
-    def _():
-        out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+    _accumulate(x_ref, w, out_ref, acc_ref, nk)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -164,8 +141,8 @@ def q_matmul_pallas(x: jax.Array, w: QTensor, *, interpret: bool = False) -> jax
     if kp != klog:
         x2 = jnp.pad(x2, ((0, 0), (0, kp - klog)))
 
-    # tile selection; pad M up to a bf16-tileable multiple when needed
-    bm = _pick_tile(m, [256, 128, 64, 32, 16, 8])
+    # tile selection; pad M up to a bf16-tileable multiple (min sublane 16)
+    bm = _pick_tile(m, [256, 128, 64, 32, 16])
     if bm:
         mp = m
     else:
@@ -200,7 +177,8 @@ def q_matmul_pallas(x: jax.Array, w: QTensor, *, interpret: bool = False) -> jax
             codebook = [float(v) for v in CODEBOOKS[qt.codebook]]
         if qt.kind == "asym":
             kernel = functools.partial(
-                _kernel_4bit_asym, block=b, bk=bk, bn=bn, nk=nk)
+                _kernel_4bit, block=b, kind="asym", codebook=None,
+                bk=bk, bn=bn, nk=nk)
             y = pl.pallas_call(
                 kernel,
                 grid=grid,

@@ -163,6 +163,8 @@ class QTensor:
         tot += self.scale.size * self.scale.dtype.itemsize
         if self.zero is not None:
             tot += self.zero.size * self.zero.dtype.itemsize
+        if self.aux is not None:
+            tot += self.aux.size * self.aux.dtype.itemsize
         return tot
 
     def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:

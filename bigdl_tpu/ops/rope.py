@@ -1,0 +1,85 @@
+"""Rotary position embeddings.
+
+TPU-native equivalent of the reference's rotary kernels
+(`linear_q4_0.apply_rotary_embedding_half_q_and_k`, reference
+transformers/models/utils.py:203-217, and the training-mode
+`FastRopeEmbedding` at transformers/layers/rope_embedding.py:40-67).
+Pure-JAX: XLA fuses the mul/add chain into surrounding ops; a custom VJP is
+unnecessary since the ops are natively differentiable.
+
+Supports the "half-rotation" (llama/mistral/qwen) and "interleaved"
+(gptj/gptneox-rotary, chatglm) conventions, plus linear/NTK ("dynamic")
+scaling as used by the reference's long-context model variants.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_freqs(
+    head_dim: int,
+    base: float = 10000.0,
+    rotary_dim: Optional[int] = None,
+    scaling_factor: float = 1.0,
+) -> jax.Array:
+    """Inverse frequencies [rotary_dim // 2] (f32)."""
+    rd = rotary_dim or head_dim
+    exponent = jnp.arange(0, rd, 2, dtype=jnp.float32) / rd
+    inv_freq = 1.0 / (base ** exponent)
+    return inv_freq / scaling_factor
+
+
+def rope_cos_sin(
+    positions: jax.Array,  # [...] int positions
+    inv_freq: jax.Array,   # [rd // 2]
+) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables [..., rd // 2] for given positions (f32)."""
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    h = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., h:], x[..., :h]], axis=-1)
+
+
+def apply_rope(
+    x: jax.Array,           # [..., seq, heads, head_dim] or [..., seq, head_dim]
+    cos: jax.Array,         # [..., seq, rd // 2]
+    sin: jax.Array,
+    interleaved: bool = False,
+) -> jax.Array:
+    """Apply rotary embedding over the last dim's first 2*(rd//2) channels.
+
+    cos/sin are broadcast over the heads axis; pass tables built from the
+    *same* positions used to index the KV cache.
+    """
+    dt = x.dtype
+    rd2 = cos.shape[-1]
+    rd = rd2 * 2
+    xf = x.astype(jnp.float32)
+    x_rot, x_pass = xf[..., :rd], xf[..., rd:]
+
+    if x.ndim == cos.ndim + 1:
+        # insert heads axis: [..., seq, 1, rd2]
+        cos = cos[..., None, :]
+        sin = sin[..., None, :]
+
+    if interleaved:
+        x1 = x_rot[..., 0::2]
+        x2 = x_rot[..., 1::2]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.stack([o1, o2], axis=-1).reshape(x_rot.shape)
+    else:
+        cs = jnp.concatenate([cos, cos], axis=-1)
+        sn = jnp.concatenate([sin, sin], axis=-1)
+        out = x_rot * cs + _rotate_half(x_rot) * sn
+
+    if x_pass.shape[-1]:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out.astype(dt)

@@ -1,0 +1,79 @@
+"""HF checkpoint reading: config + state dict, without instantiating torch.
+
+The reference piggybacks on HF `from_pretrained` to materialize nn.Modules
+and then walks them (transformers/model.py:435, convert.py:191-387). We load
+tensors directly instead: safetensors files are memory-mapped and converted
+per-tensor, so peak host memory is one tensor, not one model — the TPU-side
+equivalent of the reference's `low_cpu_mem_usage`/lazy-load path
+(utils/lazy_load_torch.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def load_hf_config(model_path: str) -> Dict[str, Any]:
+    with open(os.path.join(model_path, "config.json")) as f:
+        return json.load(f)
+
+
+def _safetensors_files(model_path: str):
+    idx = os.path.join(model_path, "model.safetensors.index.json")
+    if os.path.exists(idx):
+        with open(idx) as f:
+            index = json.load(f)
+        files = sorted(set(index["weight_map"].values()))
+        return [os.path.join(model_path, f) for f in files]
+    single = os.path.join(model_path, "model.safetensors")
+    if os.path.exists(single):
+        return [single]
+    return []
+
+
+def _torch_files(model_path: str):
+    idx = os.path.join(model_path, "pytorch_model.bin.index.json")
+    if os.path.exists(idx):
+        with open(idx) as f:
+            index = json.load(f)
+        files = sorted(set(index["weight_map"].values()))
+        return [os.path.join(model_path, f) for f in files]
+    single = os.path.join(model_path, "pytorch_model.bin")
+    if os.path.exists(single):
+        return [single]
+    return []
+
+
+def iter_hf_tensors(model_path: str) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield (name, np.ndarray) for every tensor in the checkpoint."""
+    st_files = _safetensors_files(model_path)
+    if st_files:
+        from safetensors import safe_open
+
+        for path in st_files:
+            with safe_open(path, framework="np") as f:
+                for name in f.keys():
+                    yield name, f.get_tensor(name)
+        return
+
+    pt_files = _torch_files(model_path)
+    if pt_files:
+        import torch
+
+        for path in pt_files:
+            sd = torch.load(path, map_location="cpu", weights_only=True)
+            for name, t in sd.items():
+                yield name, t.float().numpy()
+        return
+
+    raise FileNotFoundError(
+        f"no model.safetensors[.index.json] or pytorch_model.bin in {model_path}"
+    )
+
+
+def load_hf_state_dict(model_path: str) -> Dict[str, np.ndarray]:
+    return dict(iter_hf_tensors(model_path))

@@ -19,7 +19,8 @@ def _rand(shape, seed=0):
     return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
 
 
-@pytest.mark.parametrize("qtype", ["sym_int4", "asym_int4", "nf4", "fp4", "sym_int8"])
+@pytest.mark.parametrize(
+    "qtype", ["sym_int4", "asym_int4", "nf4", "nf3", "fp4", "sym_int8"])
 @pytest.mark.parametrize("m", [1, 16, 64])
 def test_pallas_matches_xla(qtype, m):
     k, n = 256, 128
