@@ -11,9 +11,8 @@ runs on device; only the emitted token returns to host each step.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -94,14 +93,16 @@ def generate_on_device(
     pad (0) tokens (masked continuation keeps shapes static).
     """
     b, s = input_ids.shape
+    if s + max_new_tokens > cache.max_seq:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"cache max_seq {cache.max_seq}")
 
     logits, cache = forward_fn(params, cfg, input_ids, cache)
     last = logits[:, -1, :]
     key = jax.random.PRNGKey(seed)
 
     def pick(lg, k):
-        if temperature <= 0.0:
-            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
         return sample_token(lg, k, temperature=temperature, top_k=top_k,
                             top_p=top_p)
 
@@ -137,12 +138,11 @@ class Generator:
 
     def __init__(self, params: Dict[str, Any], cfg,
                  forward_fn=None, prefill_fn=None, max_seq: int = 2048,
-                 kv_quantized: bool = False, batch_size: int = 1):
+                 kv_quantized: bool = False):
         self.params = params
         self.cfg = cfg
         self.max_seq = max_seq
         self.kv_quantized = kv_quantized
-        self.batch_size = batch_size
         fwd = forward_fn or llama_mod.forward
         pre = prefill_fn or llama_mod.forward_last_token
 
@@ -175,8 +175,10 @@ class Generator:
         if ids.ndim == 1:
             ids = ids[None]
         b, s = ids.shape
-        if s > self.max_seq:
-            raise ValueError(f"prompt length {s} > max_seq {self.max_seq}")
+        if s + gen.max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({gen.max_new_tokens}) "
+                f"exceeds max_seq {self.max_seq}")
 
         bucket = self._bucket(s)
         # right-pad into the bucket: positions stay correct for RoPE, the
@@ -205,12 +207,11 @@ class Generator:
         else:
             logits = logits[:, -1:, :]
 
-        if gen.do_sample:
-            key, sk = jax.random.split(key)
-            tok = self._sample(logits[:, -1, :], sk, temperature=gen.temperature,
-                               top_k=gen.top_k, top_p=gen.top_p)
-        else:
-            tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        temp = gen.temperature if gen.do_sample else 0.0
+
+        key, sk = jax.random.split(key)
+        tok = self._sample(logits[:, -1, :], sk, temperature=temp,
+                           top_k=gen.top_k, top_p=gen.top_p)
         tok_host = np.asarray(tok)
         if stats is not None:
             stats.first_token_s = time.perf_counter() - t0
@@ -226,14 +227,13 @@ class Generator:
             t1 = time.perf_counter()
             logits, cache = self._decode(
                 self.params, self.cfg, tok[:, None], cache)
-            if gen.do_sample:
-                key, sk = jax.random.split(key)
-                tok = self._sample(logits[:, -1, :], sk,
-                                   temperature=gen.temperature,
-                                   top_k=gen.top_k, top_p=gen.top_p)
-            else:
-                tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            key, sk = jax.random.split(key)
+            tok = self._sample(logits[:, -1, :], sk, temperature=temp,
+                               top_k=gen.top_k, top_p=gen.top_p)
             tok_host = np.asarray(tok)
+            # post-EOS rows emit pad (0): parity with generate_on_device
+            tok_host = np.where(finished, 0, tok_host)
+            tok = jnp.asarray(tok_host)
             if stats is not None:
                 stats.rest_token_s.append(time.perf_counter() - t1)
             out.append(tok_host)

@@ -65,8 +65,14 @@ class LlamaConfig:
         """Build from an HF config dict (config.json of llama/mistral...)."""
         rs = hf.get("rope_scaling") or {}
         factor = 1.0
-        if rs and rs.get("rope_type", rs.get("type", "linear")) == "linear":
-            factor = float(rs.get("factor", 1.0))
+        if rs:
+            rtype = rs.get("rope_type", rs.get("type", "linear"))
+            if rtype == "linear":
+                factor = float(rs.get("factor", 1.0))
+            elif rtype != "default":
+                raise NotImplementedError(
+                    f"rope_scaling type {rtype!r} not supported yet "
+                    "(supported: linear)")
         return cls(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -142,12 +148,15 @@ def forward(
     tokens: jax.Array,       # [B, Sq] int32
     cache: KVCache,
     compute_dtype=jnp.bfloat16,
+    last_only: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """Run the model; returns (logits [B, Sq, V], updated cache).
 
     `cache.pos` is the write offset: 0 for prefill, prompt_len + n for the
     n-th decode step. One function, both phases (static Sq distinguishes
-    the compiled executables).
+    the compiled executables). last_only=True computes lm_head for the
+    final position only — the reference's `optimize_lm_head` trick
+    (low_bit_linear.py:251-258), which matters when V=32k+ and Sq is long.
     """
     b, sq = tokens.shape
     pos = cache.pos
@@ -166,6 +175,8 @@ def forward(
         (params["layers"], lidx),
     )
 
+    if last_only:
+        x = x[:, -1:, :]
     x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
     lm_head = params.get("lm_head")
     if lm_head is None:
@@ -185,30 +196,62 @@ def forward_last_token(
     cache: KVCache,
     compute_dtype=jnp.bfloat16,
 ) -> Tuple[jax.Array, KVCache]:
-    """Prefill variant that only computes lm_head on the final position —
-    the reference's `optimize_lm_head` trick (low_bit_linear.py:251-258),
-    which matters when V=32k+ and Sq is long."""
-    b, sq = tokens.shape
-    pos = cache.pos
+    """Prefill variant of `forward` with lm_head on the final position only."""
+    return forward(params, cfg, tokens, cache, compute_dtype=compute_dtype,
+                   last_only=True)
+
+
+def forward_train(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    tokens: jax.Array,       # [B, S] int32
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Cacheless causal forward for training: returns logits [B, S, V].
+
+    The finetuning path (QLoRA stack, reference transformers/qlora.py) runs
+    through this; no KV cache is materialized, attention is causal over the
+    in-flight sequence, and `jax.checkpoint` on the layer body trades FLOPs
+    for HBM during backward (the scan carries only layer inputs).
+    """
+    b, s = tokens.shape
     x = params["embed_tokens"][tokens].astype(compute_dtype)
     inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
                           scaling_factor=cfg.rope_scaling_factor)
-    positions = pos + jnp.arange(sq, dtype=jnp.int32)
+    positions = jnp.arange(s, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions[None, :], inv_freq)
-    lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
-    (x, ck, cv, _, _, _), _ = lax.scan(
-        lambda c, xs: _layer_step(cfg, c, xs),
-        (x, cache.k, cache.v, pos, cos, sin),
-        (params["layers"], lidx),
-    )
-    x = rms_norm(x[:, -1:, :], params["norm"], cfg.rms_norm_eps)
+
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+
+    @jax.checkpoint
+    def layer(x, lp):
+        hidden = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
+        q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias"))
+        k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias"))
+        v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias"))
+        q = apply_rope(q.reshape(b, s, h, hd), cos, sin)
+        k = apply_rope(k.reshape(b, s, hkv, hd), cos, sin)
+        v = v.reshape(b, s, hkv, hd)
+        attn = sdp_attention(q, k, v, jnp.zeros((), jnp.int32),
+                             sliding_window=cfg.sliding_window)
+        x = x + linear(attn.reshape(b, s, h * hd), lp["o_proj"],
+                       lp.get("o_proj_bias"))
+        hidden = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+        gate = linear(hidden, lp["gate_proj"], lp.get("gate_proj_bias"))
+        up = linear(hidden, lp["up_proj"], lp.get("up_proj_bias"))
+        x = x + linear(jax.nn.silu(gate) * up, lp["down_proj"],
+                       lp.get("down_proj_bias"))
+        return x
+
+    x, _ = lax.scan(lambda c, lp: (layer(c, lp), None), x, params["layers"])
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
     lm_head = params.get("lm_head")
     if lm_head is None:
         logits = jnp.dot(x, params["embed_tokens"].T.astype(x.dtype),
                          preferred_element_type=jnp.float32)
     else:
         logits = linear(x, lm_head)
-    return logits.astype(jnp.float32), KVCache(ck, cv, pos + sq)
+    return logits.astype(jnp.float32)
 
 
 def new_cache(cfg: LlamaConfig, batch: int, max_seq: int,

@@ -1,0 +1,22 @@
+"""Parallelism: device meshes, sharding rules, collectives.
+
+TPU-native replacement for the reference's distributed stack (SURVEY.md
+§2.2): DeepSpeed-AutoTP tensor-parallel sharding + oneCCL all-reduce
+(reference transformers/convert.py:102-119, low_bit_linear.py:635-637),
+MPI/ccl training launch (transformers/training_patch.py), and the absent
+sequence-parallel path. Here parallelism is declarative: build a
+`jax.sharding.Mesh`, annotate parameter/activation shardings, and XLA
+inserts the ICI/DCN collectives.
+"""
+
+from bigdl_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    make_mesh,
+    init_distributed,
+)
+from bigdl_tpu.parallel.sharding import (  # noqa: F401
+    llama_param_specs,
+    shard_params,
+    shard_batch,
+    replicate,
+)

@@ -1,0 +1,108 @@
+"""Device mesh construction, single- and multi-host.
+
+Replaces the reference's process-group bootstrap: `deepspeed.init_inference
+(mp_size=N)` + oneCCL backend selection and MPI `PMI_SIZE` env sniffing
+(reference transformers/training_patch.py:100-198, example/GPU/
+Deepspeed-AutoTP/deepspeed_autotp.py:76-101). On TPU the equivalents are
+`jax.distributed.initialize()` for multi-host and a named `Mesh` whose axes
+map onto ICI (within-slice) and DCN (across-slice) links.
+
+Axis convention used across the framework:
+  dp — data parallel (batch), outermost; rides DCN across slices
+  fsdp — parameter/optimizer sharding (ZeRO-equivalent), within slice
+  tp — tensor parallel (the AutoTP equivalent), innermost for fastest ICI
+  sp — sequence/context parallel (ring attention), shares ICI with tp
+  ep — expert parallel (MoE)
+Any axis of size 1 may be omitted when building specs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. Axes with size 1 still exist (GSPMD ignores
+    unit axes at zero cost), so one spec set serves every topology."""
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("dp", "fsdp", "tp", "sp", "ep")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.dp, self.fsdp, self.tp, self.sp, self.ep)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape))
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bootstrap (the `mpirun`/PMI analog, training_patch.py).
+
+    On TPU pods the args are discovered from the environment; explicit args
+    support manual (GPU/CPU) clusters. Safe to call when single-host.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    env_has_tpu = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+        "MEGASCALE_COORDINATOR_ADDRESS")
+    if coordinator_address or env_has_tpu:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+
+def make_mesh(
+    cfg: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    tp: Optional[int] = None,
+    dp: Optional[int] = None,
+    sp: int = 1,
+    ep: int = 1,
+    fsdp: int = 1,
+) -> Mesh:
+    """Build a named Mesh over the available devices.
+
+    With no arguments: all devices on the `tp` axis (the common inference
+    setup — the AutoTP equivalent). `mesh_utils.create_device_mesh` orders
+    devices so the innermost axes land on the fastest ICI links.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if cfg is None:
+        if tp is None and dp is None:
+            tp = n
+        tp = tp or 1
+        dp = dp or max(1, n // (tp * sp * ep * fsdp))
+        cfg = MeshConfig(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
+    if cfg.size != n:
+        raise ValueError(
+            f"mesh shape {cfg.shape} needs {cfg.size} devices, have {n}")
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(cfg.shape, devices=devs)
+    except Exception:
+        arr = np.asarray(devs).reshape(cfg.shape)
+    return Mesh(arr, cfg.axis_names)

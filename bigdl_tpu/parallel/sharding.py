@@ -1,0 +1,134 @@
+"""Sharding rules for parameter pytrees (QTensor-aware tensor parallelism).
+
+The AutoTP equivalent, redesigned: where the reference shards nn.Linear
+modules with DeepSpeed and then quantizes the shards — capturing an
+`mp_group` and calling `dist.inference_all_reduce` by hand after every
+row-parallel matmul (reference transformers/convert.py:102-119,
+low_bit_linear.py:635-637) — here the *quantized* arrays themselves carry
+shardings. A QTensor's packed data, scales, zeros and high-bit planes are
+all laid out [.., K-ish, N], so one rule covers every field:
+
+  column-parallel (q/k/v/gate/up, lm_head): shard the last axis (N)
+  row-parallel  (o_proj/down_proj):         shard the second-to-last (K)
+
+Scales shard *with* their blocks automatically (K//block rows follow K).
+XLA/GSPMD then inserts the all-reduce after row-parallel matmuls — there is
+no hand-written collective anywhere in the model code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# param-name → parallel style for the llama family pytree
+# (bigdl_tpu/models/llama.py layout).
+LLAMA_RULES: Dict[str, str] = {
+    "embed_tokens": "row",      # shard vocab; gather+psum handled by GSPMD
+    "q_proj": "col",
+    "k_proj": "col",
+    "v_proj": "col",
+    "o_proj": "row",
+    "gate_proj": "col",
+    "up_proj": "col",
+    "down_proj": "row",
+    "q_proj_bias": "col",
+    "k_proj_bias": "col",
+    "v_proj_bias": "col",
+    "gate_proj_bias": "col",
+    "up_proj_bias": "col",
+    "lm_head": "col",
+    # replicated: norms, o/down biases (added post-reduce)
+}
+
+
+def _path_param_name(path) -> str:
+    """Last dict key on the path = the logical parameter name."""
+    name = ""
+    for entry in path:
+        if isinstance(entry, jax.tree_util.DictKey):
+            name = str(entry.key)
+    return name
+
+
+def _leaf_spec(style: str, leaf: jax.Array, axis: str, axis_size: int) -> P:
+    """Spec for one array leaf under a col/row rule.
+
+    Leaves are [.., K-ish, N] (weights, scales, zeros, bit-planes, stacked
+    or not) or [.., N] (biases). Falls back to replication when the sharded
+    dim does not divide by the mesh axis (the reference hard-fails here;
+    uneven heads are common enough to deserve a graceful path).
+    """
+    nd = leaf.ndim
+    if style == "col":
+        dim = nd - 1
+    elif style == "row":
+        dim = nd - 2
+        if dim < 0:
+            return P()
+    else:
+        return P()
+    if leaf.shape[dim] % axis_size != 0:
+        return P()
+    spec = [None] * nd
+    spec[dim] = axis
+    return P(*spec)
+
+
+def llama_param_specs(
+    params: Any,
+    mesh: Mesh,
+    rules: Optional[Dict[str, str]] = None,
+    axis: str = "tp",
+) -> Any:
+    """PartitionSpec pytree matching `params` (llama-family layout).
+
+    Works for dense and quantized pytrees alike: QTensor children (packed
+    data / scale / zero / aux) inherit the owning parameter's rule.
+    """
+    rules = rules if rules is not None else LLAMA_RULES
+    axis_size = mesh.shape.get(axis, 1)
+
+    def spec_for(path, leaf):
+        style = rules.get(_path_param_name(path), "rep")
+        return _leaf_spec(style, leaf, axis, axis_size)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_params(
+    params: Any,
+    mesh: Mesh,
+    specs: Optional[Any] = None,
+    rules: Optional[Dict[str, str]] = None,
+    axis: str = "tp",
+) -> Any:
+    """device_put every leaf with its NamedSharding (commits the layout;
+    jit then propagates it — no in_shardings needed at call sites)."""
+    if specs is None:
+        specs = llama_param_specs(params, mesh, rules=rules, axis=axis)
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_s, _ = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    out = [
+        jax.device_put(p, NamedSharding(mesh, s))
+        for p, s in zip(flat_p, flat_s)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Fully replicate a pytree over the mesh."""
+    sh = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def shard_batch(batch: Any, mesh: Mesh, axis: str = "dp") -> Any:
+    """Shard array leading axes over the data-parallel mesh axis."""
+    def put(x):
+        if getattr(x, "ndim", 0) == 0 or x.shape[0] % mesh.shape.get(axis, 1):
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        return jax.device_put(x, NamedSharding(mesh, P(axis)))
+    return jax.tree.map(put, batch)

@@ -1,0 +1,70 @@
+"""Training core: loss, train-step factory, sharded optimizer state.
+
+The reference finetunes through HF Trainer + DeepSpeed ZeRO-2 over
+MPI/oneCCL (SURVEY.md §3.5, transformers/training_patch.py). Here a train
+step is a pure function jitted over a mesh: params carry their shardings
+(bigdl_tpu.parallel), the batch is dp-sharded, and XLA emits the gradient
+all-reduce over ICI — the `mpirun + ccl` stack collapses into GSPMD.
+
+Works over dense (full finetune) and mixed dense/QTensor+LoRA pytrees
+(QLoRA: frozen quantized base + trainable adapters, bigdl_tpu/qlora.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def next_token_loss(logits: jax.Array, tokens: jax.Array,
+                    mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean next-token cross-entropy. logits [B,S,V] f32, tokens [B,S].
+
+    mask [B,S] marks *target* validity (loss over positions 1..S-1 uses
+    mask[:, 1:]); pad targets contribute zero.
+    """
+    targets = tokens[:, 1:]
+    lg = logits[:, :-1, :]
+    ll = jax.nn.log_softmax(lg, axis=-1)
+    nll = -jnp.take_along_axis(ll, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(nll)
+
+
+def make_train_step(
+    forward_train: Callable,   # (params, cfg, tokens) -> logits
+    cfg: Any,
+    optimizer: optax.GradientTransformation,
+    trainable_filter: Optional[Callable[[Any], Any]] = None,
+) -> Callable:
+    """Build a jittable `step(params, opt_state, batch) -> (params,
+    opt_state, loss)`.
+
+    `trainable_filter(params) -> pytree of bool` freezes leaves (QLoRA:
+    only adapters train). Gradients for frozen leaves are zeroed before the
+    optimizer, so optimizer state for them stays inert.
+    """
+
+    def loss_fn(params, batch):
+        logits = forward_train(params, cfg, batch["input_ids"])
+        return next_token_loss(logits, batch["input_ids"],
+                               batch.get("attention_mask"))
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        if trainable_filter is not None:
+            tmask = trainable_filter(params)
+            grads = jax.tree.map(
+                lambda g, t: g if t else jnp.zeros_like(g), grads, tmask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -1,0 +1,99 @@
+"""Synthetic model builders (benchmarks, compile checks, unit tests).
+
+Weights are generated *on device* with JAX PRNG and quantized tensor by
+tensor, so building a 7B-parameter INT4 model for latency benchmarking
+never materializes the float model on host (the benchmark analog of the
+reference's low_cpu_mem_usage loading; metric defined by BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.models.llama import LlamaConfig
+from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize
+
+
+TINY_LLAMA = LlamaConfig(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=2,
+    num_attention_heads=8,
+    num_key_value_heads=4,
+    max_position_embeddings=256,
+)
+
+LLAMA2_7B = LlamaConfig()  # defaults are llama2-7b
+
+MISTRAL_7B = LlamaConfig(
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_hidden_layers=32,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    rope_theta=10000.0,
+    max_position_embeddings=8192,
+)
+
+
+def random_llama_params(
+    cfg: LlamaConfig,
+    qtype: Optional[str] = "sym_int4",
+    seed: int = 0,
+    compute_dtype=jnp.bfloat16,
+) -> Dict[str, Any]:
+    """Random llama-family parameter pytree, quantized linears, on device."""
+    key = jax.random.PRNGKey(seed)
+    do_quant = qtype is not None and qtype not in FLOAT_QTYPES
+    d, ff, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+
+    def nxt():
+        nonlocal key
+        key, sub = jax.random.split(key)
+        return sub
+
+    def randw(k, kdim, ndim):
+        # contraction-major [K, N] directly; ~N(0, 0.02)
+        return jax.random.normal(k, (kdim, ndim), jnp.float32) * 0.02
+
+    def make_linear(kdim, ndim):
+        w = randw(nxt(), kdim, ndim)
+        if do_quant:
+            return quantize(w, qtype)
+        return w.astype(compute_dtype)
+
+    def stack(makers):
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *makers)
+
+    layers: Dict[str, Any] = {}
+    per = {
+        "q_proj": (d, h * hd),
+        "k_proj": (d, hkv * hd),
+        "v_proj": (d, hkv * hd),
+        "o_proj": (h * hd, d),
+        "gate_proj": (d, ff),
+        "up_proj": (d, ff),
+        "down_proj": (ff, d),
+    }
+    for name, (kdim, ndim) in per.items():
+        layers[name] = stack(
+            [make_linear(kdim, ndim) for _ in range(cfg.num_hidden_layers)])
+    ones = jnp.ones((cfg.num_hidden_layers, d), compute_dtype)
+    layers["input_layernorm"] = ones
+    layers["post_attention_layernorm"] = ones
+
+    params: Dict[str, Any] = {
+        "embed_tokens": (jax.random.normal(nxt(), (v, d), jnp.float32)
+                         * 0.02).astype(compute_dtype),
+        "layers": layers,
+        "norm": jnp.ones((d,), compute_dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = make_linear(d, v)
+    return params

@@ -1,0 +1,136 @@
+"""Tensor-parallel correctness on the virtual 8-device CPU mesh.
+
+The multi-chip-simulatable test layer the reference lacks (SURVEY.md §4):
+sharded QTensor params + jit must produce the same logits as single-device
+execution, with GSPMD inserting the collectives.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.models import llama as llama_mod
+from bigdl_tpu.parallel import make_mesh, llama_param_specs, shard_params
+from jax.sharding import PartitionSpec as P
+
+
+def tiny_cfg():
+    return llama_mod.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        max_position_embeddings=128,
+    )
+
+
+def tiny_params(cfg, qtype="sym_int4", seed=0):
+    rng = np.random.default_rng(seed)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.05).astype(np.float32)
+
+    d, ff, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    tensors = [("model.embed_tokens.weight", t(v, d)),
+               ("model.norm.weight", np.ones(d, np.float32)),
+               ("lm_head.weight", t(v, d))]
+    for i in range(cfg.num_hidden_layers):
+        pre = f"model.layers.{i}."
+        tensors += [
+            (pre + "self_attn.q_proj.weight", t(h * hd, d)),
+            (pre + "self_attn.k_proj.weight", t(hkv * hd, d)),
+            (pre + "self_attn.v_proj.weight", t(hkv * hd, d)),
+            (pre + "self_attn.o_proj.weight", t(d, h * hd)),
+            (pre + "mlp.gate_proj.weight", t(ff, d)),
+            (pre + "mlp.up_proj.weight", t(ff, d)),
+            (pre + "mlp.down_proj.weight", t(d, ff)),
+            (pre + "input_layernorm.weight", np.ones(d, np.float32)),
+            (pre + "post_attention_layernorm.weight", np.ones(d, np.float32)),
+        ]
+    return llama_mod.convert_hf_params(tensors, cfg, qtype=qtype)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    params = tiny_params(cfg)
+    tokens = jnp.asarray(np.arange(16, dtype=np.int32)[None] % 200)
+    cache = llama_mod.new_cache(cfg, 1, 64)
+    logits_ref, _ = jax.jit(llama_mod.forward, static_argnums=1)(
+        params, cfg, tokens, cache)
+    return cfg, params, tokens, logits_ref
+
+
+def test_specs_cover_qtensor_fields(setup):
+    cfg, params, _, _ = setup
+    mesh = make_mesh(tp=8)
+    specs = llama_param_specs(params, mesh)
+    qspec = specs["layers"]["q_proj"]  # stacked QTensor of specs
+    # col-parallel: every field sharded on its last axis
+    assert qspec.data == P(None, None, "tp")
+    assert qspec.scale == P(None, None, "tp")
+    # row-parallel: sharded on the K-ish axis; scales follow blocks.
+    # (tp=2 here: the tiny model has K//block = 2 scale rows, and the
+    # divisibility fallback replicates any leaf the axis doesn't divide.)
+    mesh2 = make_mesh(tp=2, dp=4)
+    ospec = llama_param_specs(params, mesh2)["layers"]["o_proj"]
+    assert ospec.data == P(None, "tp", None)
+    assert ospec.scale == P(None, "tp", None)
+
+
+@pytest.mark.parametrize("tp", [2, 8])
+def test_tp_forward_matches_single_device(setup, tp):
+    cfg, params, tokens, logits_ref = setup
+    mesh = make_mesh(tp=tp, dp=len(jax.devices()) // tp)
+    with mesh:
+        sharded = shard_params(params, mesh)
+        cache = llama_mod.new_cache(cfg, 1, 64)
+        logits, cache2 = jax.jit(llama_mod.forward, static_argnums=1)(
+            sharded, cfg, tokens, cache)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(logits_ref), rtol=2e-2, atol=2e-2)
+    assert int(cache2.pos) == tokens.shape[1]
+
+
+def test_tp_decode_matches_single_device(setup):
+    cfg, params, tokens, _ = setup
+    mesh = make_mesh(tp=8)
+
+    def run(p):
+        cache = llama_mod.new_cache(cfg, 1, 64)
+        logits, cache = jax.jit(llama_mod.forward, static_argnums=1)(
+            p, cfg, tokens, cache)
+        tok = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+        outs = [tok]
+        for _ in range(4):
+            logits, cache = jax.jit(llama_mod.forward, static_argnums=1)(
+                p, cfg, tok, cache)
+            tok = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+            outs.append(tok)
+        return np.concatenate([np.asarray(o) for o in outs], axis=1)
+
+    ref = run(params)
+    with mesh:
+        got = run(shard_params(params, mesh))
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_dense_bf16_params_shard_too(setup):
+    cfg, *_ = setup
+    params = tiny_params(cfg, qtype=None)
+    tokens = jnp.asarray(np.arange(8, dtype=np.int32)[None])
+    cache = llama_mod.new_cache(cfg, 1, 32)
+    ref, _ = jax.jit(llama_mod.forward, static_argnums=1)(
+        params, cfg, tokens, cache)
+    mesh = make_mesh(tp=4, dp=2)
+    with mesh:
+        sharded = shard_params(params, mesh)
+        cache = llama_mod.new_cache(cfg, 1, 32)
+        got, _ = jax.jit(llama_mod.forward, static_argnums=1)(
+            sharded, cfg, tokens, cache)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
