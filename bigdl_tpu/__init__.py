@@ -18,3 +18,4 @@ from bigdl_tpu.ops.quant import (  # noqa: F401
     quantize_linear,
     dequantize_linear,
 )
+from bigdl_tpu.optimize import optimize_model  # noqa: F401

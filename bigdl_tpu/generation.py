@@ -218,8 +218,10 @@ class Generator:
 
         out = [tok_host]
         finished = np.zeros((b,), bool)
+        finished_dev = jnp.zeros((b,), jnp.bool_)
         if gen.eos_token_id is not None:
             finished |= tok_host == gen.eos_token_id
+            finished_dev = jnp.asarray(finished)
 
         for _ in range(gen.max_new_tokens - 1):
             if finished.all():
@@ -230,10 +232,12 @@ class Generator:
             key, sk = jax.random.split(key)
             tok = self._sample(logits[:, -1, :], sk, temperature=temp,
                                top_k=gen.top_k, top_p=gen.top_p)
+            if gen.eos_token_id is not None:
+                # post-EOS rows emit pad (0): parity with generate_on_device.
+                # Mask and track EOS on device; nothing is uploaded per step.
+                tok = jnp.where(finished_dev, 0, tok)
+                finished_dev = finished_dev | (tok == gen.eos_token_id)
             tok_host = np.asarray(tok)
-            # post-EOS rows emit pad (0): parity with generate_on_device
-            tok_host = np.where(finished, 0, tok_host)
-            tok = jnp.asarray(tok_host)
             if stats is not None:
                 stats.rest_token_s.append(time.perf_counter() - t1)
             out.append(tok_host)

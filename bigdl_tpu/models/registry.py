@@ -1,0 +1,77 @@
+"""Architecture registry: HF `architectures[0]` -> family adapter.
+
+The reference's conversion engine special-cases 30 model families via
+monkey-patched forwards chosen in `_optimize_post` (reference
+transformers/convert.py:785-1357). Here each family is an adapter bundling
+config parsing, checkpoint conversion, and forward functions; families that
+are llama-shaped (mistral, qwen2, ...) reuse the llama module with config
+deltas instead of carrying 400-line forks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class FamilyAdapter:
+    name: str
+    config_from_hf: Callable[[Dict[str, Any]], Any]
+    convert_params: Callable[..., Any]     # (tensors, cfg, qtype, ...) -> pytree
+    forward: Callable                       # (params, cfg, tokens, cache)
+    prefill: Callable                       # last-token-only variant
+    forward_train: Optional[Callable]
+    new_cache: Callable                     # (cfg, batch, max_seq, quantized)
+
+
+_REGISTRY: Dict[str, FamilyAdapter] = {}
+
+
+def register_family(arch_names, adapter: FamilyAdapter) -> None:
+    for a in arch_names:
+        _REGISTRY[a] = adapter
+
+
+def get_family(arch: str) -> FamilyAdapter:
+    try:
+        return _REGISTRY[arch]
+    except KeyError:
+        raise ValueError(
+            f"unsupported architecture {arch!r}; supported: "
+            f"{sorted(_REGISTRY)}") from None
+
+
+def supported_architectures():
+    return sorted(_REGISTRY)
+
+
+def _register_builtin() -> None:
+    from bigdl_tpu.models import llama as llama_mod
+
+    def llama_adapter(config_tweak=None):
+        def cfg_from_hf(hf):
+            cfg = llama_mod.LlamaConfig.from_hf(hf)
+            return config_tweak(cfg, hf) if config_tweak else cfg
+        return FamilyAdapter(
+            name="llama",
+            config_from_hf=cfg_from_hf,
+            convert_params=llama_mod.convert_hf_params,
+            forward=llama_mod.forward,
+            prefill=llama_mod.forward_last_token,
+            forward_train=llama_mod.forward_train,
+            new_cache=llama_mod.new_cache,
+        )
+
+    register_family(
+        ["LlamaForCausalLM", "MistralForCausalLM", "CodeLlamaForCausalLM"],
+        llama_adapter())
+
+    def qwen2_tweak(cfg, hf):
+        # HF Qwen2 has QKV bias but no attention_bias flag in config.json
+        return dataclasses.replace(cfg, attention_bias=True)
+
+    register_family(["Qwen2ForCausalLM"], llama_adapter(qwen2_tweak))
+
+
+_register_builtin()

@@ -1,0 +1,68 @@
+"""optimize_model: quantize an already-loaded parameter pytree.
+
+The reference's generic entry point (`ipex_llm.optimize_model`, reference
+optimize.py:196) walks an arbitrary nn.Module replacing Linears. Here the
+equivalent walks a parameter pytree: any dense contraction-major [.., K, N]
+linear leaf whose name isn't excluded becomes a QTensor (stacked per-layer
+leaves are vmapped through the quantizer). Norm scales, biases and
+embeddings stay dense, matching the reference's default module filter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Tuple
+
+import jax
+
+from bigdl_tpu.ops.quant import QTensor, quantize
+
+# leaf-name suffixes never quantized (reference skips non-Linear modules and
+# `modules_to_not_convert`; embedding quantization is a separate opt-in)
+_DEFAULT_SKIP = ("norm", "layernorm", "bias", "embed_tokens", "rotary")
+
+
+def _should_quantize(name: str, leaf: Any, skip: Tuple[str, ...]) -> bool:
+    if isinstance(leaf, QTensor) or not hasattr(leaf, "ndim"):
+        return False
+    if leaf.ndim < 2 or not jax.numpy.issubdtype(leaf.dtype, jax.numpy.floating):
+        return False
+    lname = name.lower()
+    return not any(s in lname for s in skip)
+
+
+def optimize_model(
+    model_or_params: Any,
+    low_bit: str = "sym_int4",
+    modules_to_not_convert: Iterable[str] = (),
+    optimize_llm: bool = True,   # parity kwarg; forwards are always optimized
+) -> Any:
+    """Quantize dense linear leaves of a model/pytree to `low_bit`.
+
+    Accepts a TpuCausalLM (returns the same object with quantized params)
+    or a raw parameter pytree (returns a new pytree).
+    """
+    from bigdl_tpu.transformers.model import TpuCausalLM
+
+    skip = tuple(_DEFAULT_SKIP) + tuple(
+        m.lower() for m in modules_to_not_convert)
+
+    if isinstance(model_or_params, TpuCausalLM):
+        model = model_or_params
+        model.params = _quantize_tree(model.params, low_bit, skip)
+        model.qtype = low_bit
+        model._generator = None   # recompile against the new leaf types
+        return model
+    return _quantize_tree(model_or_params, low_bit, skip)
+
+
+def _quantize_tree(tree: Any, qtype: str, skip: Tuple[str, ...],
+                   _name: str = "") -> Any:
+    if isinstance(tree, dict):
+        return {k: _quantize_tree(v, qtype, skip, f"{_name}.{k}")
+                for k, v in tree.items()}
+    if _should_quantize(_name, tree, skip):
+        if tree.ndim == 2:
+            return quantize(tree, qtype)
+        if tree.ndim == 3:  # stacked per-layer [L, K, N]
+            return jax.vmap(lambda w: quantize(w, qtype))(tree)
+    return tree

@@ -58,9 +58,14 @@ def init_distributed(
     """Multi-host bootstrap (the `mpirun`/PMI analog, training_patch.py).
 
     On TPU pods the args are discovered from the environment; explicit args
-    support manual (GPU/CPU) clusters. Safe to call when single-host.
+    support manual (GPU/CPU) clusters. Safe to call when single-host. Must
+    run before any backend-initializing JAX call (so no jax.devices() /
+    process_count() probes here — the initialized-guard reads the
+    distributed client state directly).
     """
-    if jax.process_count() > 1:
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "client", None) is not None:
         return  # already initialized
     env_has_tpu = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
         "MEGASCALE_COORDINATOR_ADDRESS")
@@ -93,7 +98,8 @@ def make_mesh(
     if cfg is None:
         if tp is None and dp is None:
             tp = n
-        tp = tp or 1
+        if tp is None:
+            tp = max(1, n // ((dp or 1) * sp * ep * fsdp))
         dp = dp or max(1, n // (tp * sp * ep * fsdp))
         cfg = MeshConfig(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
     if cfg.size != n:

@@ -1,0 +1,11 @@
+"""User API layer (the reference's `ipex_llm.transformers` equivalent)."""
+
+from bigdl_tpu.transformers.model import (  # noqa: F401
+    AutoModel,
+    AutoModelForCausalLM,
+    TpuCausalLM,
+)
+from bigdl_tpu.transformers.lowbit_io import (  # noqa: F401
+    load_low_bit,
+    save_low_bit,
+)

@@ -1,0 +1,186 @@
+"""User-facing model API: the reference's Auto* façade, TPU-native.
+
+Mirrors `ipex_llm.transformers.AutoModelForCausalLM.from_pretrained(
+load_in_4bit=True / load_in_low_bit="nf4")` (reference transformers/
+model.py:104-336), `save_low_bit`/`load_low_bit` (model.py:56, 465), and the
+`generate()` entry point — except nothing is monkey-patched: from_pretrained
+streams HF safetensors straight into a quantized JAX pytree (one tensor on
+host at a time) and returns a `TpuCausalLM` owning compiled prefill/decode
+executables.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from bigdl_tpu.generation import GenerationConfig, GenerationStats, Generator
+from bigdl_tpu.models.registry import FamilyAdapter, get_family
+from bigdl_tpu.ops.quant import FLOAT_QTYPES, get_qtype
+from bigdl_tpu.transformers import lowbit_io
+from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
+
+_TOKENIZER_FILES = (
+    "tokenizer.json", "tokenizer.model", "tokenizer_config.json",
+    "special_tokens_map.json", "vocab.json", "merges.txt",
+    "generation_config.json",
+)
+
+
+class TpuCausalLM:
+    """A loaded (possibly quantized) causal LM + compiled generation."""
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: Any,
+        family: FamilyAdapter,
+        hf_config: Dict[str, Any],
+        qtype: Optional[str],
+        model_path: Optional[str] = None,
+        max_seq: int = 2048,
+        kv_quantized: bool = False,
+    ):
+        self.params = params
+        self.config = cfg
+        self.family = family
+        self.hf_config = hf_config
+        self.qtype = qtype
+        self.model_path = model_path
+        self.max_seq = max_seq
+        self.kv_quantized = kv_quantized
+        self._generator: Optional[Generator] = None
+
+    # -- generation ---------------------------------------------------------
+    @property
+    def generator(self) -> Generator:
+        if self._generator is None:
+            self._generator = Generator(
+                self.params, self.config,
+                forward_fn=self.family.forward,
+                prefill_fn=self.family.prefill,
+                max_seq=self.max_seq,
+                kv_quantized=self.kv_quantized,
+            )
+        return self._generator
+
+    def generate(
+        self,
+        input_ids,
+        max_new_tokens: int = 32,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+        stats: Optional[GenerationStats] = None,
+        **_ignored,
+    ) -> np.ndarray:
+        """HF-style generate: returns [B, prompt+new] (prompt included)."""
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        if eos_token_id is None:
+            eos_token_id = self.hf_config.get("eos_token_id")
+            if isinstance(eos_token_id, list):
+                eos_token_id = eos_token_id[0]
+        gen = GenerationConfig(
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, do_sample=do_sample,
+            eos_token_id=eos_token_id, seed=seed)
+        new = self.generator.generate(ids, gen, stats=stats)
+        return np.concatenate([ids, new], axis=1)
+
+    # -- persistence --------------------------------------------------------
+    def save_low_bit(self, path: str) -> None:
+        """Persist quantized weights + config (+tokenizer files if known)."""
+        lowbit_io.save_low_bit(
+            self.params, path,
+            config=self.hf_config,
+            family=self.family.name,
+            qtype=self.qtype,
+            extra={"max_seq": self.max_seq},
+        )
+        if self.model_path and os.path.isdir(self.model_path):
+            for fname in _TOKENIZER_FILES:
+                src = os.path.join(self.model_path, fname)
+                if os.path.exists(src):
+                    shutil.copy(src, os.path.join(path, fname))
+
+
+def _resolve_qtype(load_in_4bit: bool,
+                   load_in_low_bit: Optional[str]) -> Optional[str]:
+    if load_in_low_bit is not None:
+        if load_in_low_bit not in FLOAT_QTYPES:
+            get_qtype(load_in_low_bit)  # validate the name early
+        return load_in_low_bit
+    if load_in_4bit:
+        return "sym_int4"
+    return None
+
+
+class _BaseAutoModelClass:
+    """from_pretrained / load_low_bit, shared by the Auto* classes."""
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        pretrained_model_name_or_path: str,
+        *,
+        load_in_4bit: bool = False,
+        load_in_low_bit: Optional[str] = None,
+        optimize_model: bool = True,   # accepted for API parity
+        modules_to_not_convert=(),
+        max_seq: Optional[int] = None,
+        quantize_kv_cache: bool = False,
+        **_ignored,
+    ) -> TpuCausalLM:
+        path = pretrained_model_name_or_path
+        if lowbit_io.is_low_bit_dir(path):
+            # max_seq=None lets the manifest's saved value win
+            return cls.load_low_bit(path, max_seq=max_seq,
+                                    quantize_kv_cache=quantize_kv_cache)
+        max_seq = max_seq or 2048
+
+        qtype = _resolve_qtype(load_in_4bit, load_in_low_bit)
+        hf_config = load_hf_config(path)
+        archs = hf_config.get("architectures") or ["?"]
+        family = get_family(archs[0])
+        cfg = family.config_from_hf(hf_config)
+
+        cvt_qtype = None if (qtype in FLOAT_QTYPES) else qtype
+        params = family.convert_params(
+            iter_hf_tensors(path), cfg, qtype=cvt_qtype,
+            modules_to_not_convert=tuple(modules_to_not_convert))
+        return TpuCausalLM(params, cfg, family, hf_config, qtype,
+                           model_path=path, max_seq=max_seq,
+                           kv_quantized=quantize_kv_cache)
+
+    @classmethod
+    def load_low_bit(cls, path: str, max_seq: Optional[int] = None,
+                     quantize_kv_cache: bool = False,
+                     **_ignored) -> TpuCausalLM:
+        params, manifest = lowbit_io.load_low_bit(path)
+        hf_config = manifest["config"]
+        archs = hf_config.get("architectures") or ["?"]
+        family = get_family(archs[0])
+        cfg = family.config_from_hf(hf_config)
+        return TpuCausalLM(
+            params, cfg, family, hf_config,
+            qtype=manifest.get(lowbit_io.MARKER),
+            model_path=path,
+            max_seq=max_seq or manifest.get("extra", {}).get("max_seq", 2048),
+            kv_quantized=quantize_kv_cache,
+        )
+
+
+class AutoModelForCausalLM(_BaseAutoModelClass):
+    pass
+
+
+class AutoModel(_BaseAutoModelClass):
+    pass
