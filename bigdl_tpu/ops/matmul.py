@@ -20,6 +20,7 @@ The public entry is `q_matmul(x, w)` where `w` is a QTensor of logical shape
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Optional
 
@@ -57,12 +58,7 @@ def _q_matmul_xla(x: jax.Array, w: QTensor) -> jax.Array:
     return y.astype(x.dtype)
 
 
-def q_matmul(x: jax.Array, w: QTensor, *, backend: Optional[str] = None) -> jax.Array:
-    """Compute x @ W for a quantized W of logical shape [K, N].
-
-    x: [..., K] float array. Returns [..., N] in x.dtype.
-    """
-    be = backend or _backend()
+def _q_matmul_dispatch(x: jax.Array, w: QTensor, be: str) -> jax.Array:
     if be == "xla":
         return _q_matmul_xla(x, w)
     if be in ("auto", "pallas"):
@@ -79,6 +75,48 @@ def q_matmul(x: jax.Array, w: QTensor, *, backend: Optional[str] = None) -> jax.
     raise ValueError(f"unknown matmul backend {be!r}")
 
 
+def _zero_cotangent(leaf):
+    # int-packed leaves take float0 cotangents under AD
+    import numpy as _np
+
+    if jnp.issubdtype(leaf.dtype, jnp.inexact):
+        return jnp.zeros_like(leaf)
+    return _np.zeros(leaf.shape, jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _q_matmul_vjp(x: jax.Array, w: QTensor, be: str) -> jax.Array:
+    return _q_matmul_dispatch(x, w, be)
+
+
+def _q_matmul_fwd(x, w, be):
+    return _q_matmul_dispatch(x, w, be), w
+
+
+def _q_matmul_bwd(be, w, dy):
+    # MatMulLowBit.backward equivalent (reference low_bit_linear.py:470-486):
+    # dx = dy @ dequantize(W)^T; the quantized weight is never trainable, so
+    # its cotangent is zero. This also makes the non-differentiable Pallas
+    # forward transparently trainable-through.
+    wd = dequantize(w, dtype=jnp.bfloat16)
+    dx = jnp.dot(dy.astype(jnp.bfloat16), wd.T,
+                 preferred_element_type=jnp.float32)
+    dw = jax.tree.map(_zero_cotangent, w)
+    return dx.astype(dy.dtype), dw
+
+
+_q_matmul_vjp.defvjp(_q_matmul_fwd, _q_matmul_bwd)
+
+
+def q_matmul(x: jax.Array, w: QTensor, *, backend: Optional[str] = None) -> jax.Array:
+    """Compute x @ W for a quantized W of logical shape [K, N].
+
+    x: [..., K] float array. Returns [..., N] in x.dtype. Differentiable
+    w.r.t. x (dequant-matmul backward); the weight gets zero cotangent.
+    """
+    return _q_matmul_vjp(x, w, backend or _backend())
+
+
 def linear(
     x: jax.Array,
     w,
@@ -90,8 +128,13 @@ def linear(
 
     Model code calls this uniformly; float-qtype models (fp16/bf16 paths of
     the reference's BF16Linear/FP16Linear, low_bit_linear.py:671-827) carry
-    dense leaves, quantized models carry QTensors.
+    dense leaves, quantized models carry QTensors. Adapter-wrapped weights
+    (bigdl_tpu.qlora.LoraWeight — or any leaf exposing `apply_linear`)
+    dispatch to themselves, which is how LoRA reaches every model family
+    with no model-code changes.
     """
+    if hasattr(w, "apply_linear"):
+        return w.apply_linear(x, bias, backend=backend)
     if isinstance(w, QTensor):
         return q_linear(x, w, bias, backend=backend)
     y = jnp.dot(x, w.astype(x.dtype), preferred_element_type=jnp.float32)

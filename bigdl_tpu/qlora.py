@@ -1,0 +1,268 @@
+"""QLoRA / LoRA / QA-LoRA finetuning over frozen quantized weights.
+
+TPU-native re-design of the reference's PEFT integration (reference
+transformers/qlora.py: `LoraLowBitLinear` at :65, `LoraBF16Linear` at :137,
+`get_peft_model` at :271, `LoraConfig(training_mode=...)` at :243) and its
+autograd path through quantized weights (`MatMulLowBit`,
+transformers/low_bit_linear.py:456-487: forward = dequant-matmul kernel,
+backward = explicit dequantize + matmul, no gradient for the weight).
+
+Design differences, by design:
+
+- No nn.Module wrapping/monkey-patching. A LoRA-adapted weight is a pytree
+  node (`LoraWeight`) that *replaces* the weight leaf in the parameter tree;
+  `bigdl_tpu.ops.matmul.linear` dispatches on it, so every model family gains
+  LoRA support with zero model-code changes — including under `lax.scan`
+  (stacked per-layer LoraWeights slice leaf-wise like everything else).
+- The backward through the frozen base is a `jax.custom_vjp`
+  (`q_matmul_frozen`): dx = dy @ dequantize(W)^T, and the QTensor gets zero
+  cotangent — the exact MatMulLowBit contract, but the "kernel" is the same
+  fused dequant-matmul used in inference.
+- QA-LoRA (reference qlora.py:102-134: AvgPool1d(qk_size) on the adapter
+  input) is the `pool` field: the A-side input is mean-pooled over
+  quantization groups, so merged adapters stay exactly representable in the
+  quantized format.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops.matmul import linear, q_matmul
+from bigdl_tpu.ops.quant import QTensor, dequantize, quantize
+
+# Default adapter targets: every linear in a llama-family block (the
+# reference's alpaca recipes target the same set).
+DEFAULT_TARGET_MODULES: Tuple[str, ...] = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+
+# ---------------------------------------------------------------------------
+# Frozen-base matmul (MatMulLowBit equivalent)
+# ---------------------------------------------------------------------------
+
+# The custom VJP (fast fused fwd, dequant-matmul bwd, zero weight cotangent —
+# the MatMulLowBit contract, low_bit_linear.py:456-487) lives on q_matmul
+# itself (ops/matmul.py): every quantized matmul in the framework is
+# trainable-through by construction.
+q_matmul_frozen = q_matmul
+
+
+def frozen_linear(x: jax.Array, w, bias: Optional[jax.Array] = None) -> jax.Array:
+    """Linear through a frozen base weight (QTensor or dense)."""
+    if isinstance(w, QTensor):
+        y = q_matmul_frozen(x, w)
+    else:
+        y = jnp.dot(x, jax.lax.stop_gradient(w).astype(x.dtype),
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        y = y + jax.lax.stop_gradient(bias).astype(y.dtype)
+    return y
+
+
+def _dequantize_any(base, dtype=jnp.float32) -> jax.Array:
+    """Dequantize a QTensor, including layer-stacked ([L, ...]) ones."""
+    if not isinstance(base, QTensor):
+        return base.astype(dtype)
+    lead = tuple(base.scale.shape[:-2])
+    if not lead:
+        return dequantize(base, dtype=dtype)
+    flat = jax.tree.map(
+        lambda x: x.reshape((-1,) + x.shape[len(lead):]), base)
+    out = jax.vmap(lambda q: dequantize(q, dtype=dtype))(flat)
+    return out.reshape(lead + out.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# LoraWeight pytree node
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class LoraWeight:
+    """A weight leaf with a trainable low-rank delta on a frozen base.
+
+    y = frozen_linear(x, base) + (alpha/r) * (pool(x) @ a) @ b
+
+    base: QTensor or dense [K, N] (leading layer-stack axes allowed)
+    a:    [..., K//pool, r] trainable
+    b:    [..., r, N] trainable (zero-init: adapter starts as identity)
+    pool: QA-LoRA group size (1 = plain LoRA)
+    """
+    base: Any
+    a: jax.Array
+    b: jax.Array
+    alpha: float = 16.0
+    pool: int = 1
+
+    def tree_flatten(self):
+        return (self.base, self.a, self.b), (self.alpha, self.pool)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], children[2], aux[0], aux[1])
+
+    @property
+    def rank(self) -> int:
+        return self.a.shape[-1]
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+    def apply_linear(self, x: jax.Array, bias: Optional[jax.Array] = None,
+                     **_: Any) -> jax.Array:
+        y = frozen_linear(x, self.base, bias)
+        xa = x
+        if self.pool > 1:
+            k = x.shape[-1]
+            xa = x.reshape(*x.shape[:-1], k // self.pool, self.pool)
+            xa = jnp.mean(xa, axis=-1)
+        delta = jnp.dot(jnp.dot(xa, self.a.astype(xa.dtype)),
+                        self.b.astype(xa.dtype),
+                        preferred_element_type=jnp.float32)
+        return y + (self.scaling * delta).astype(y.dtype)
+
+    def merged_dense(self, dtype=jnp.float32) -> jax.Array:
+        """Base + adapter as one dense [..., K, N] array."""
+        wd = _dequantize_any(self.base, dtype)
+        a = self.a.astype(dtype)
+        if self.pool > 1:
+            # pooled-mean input == full-K input against a row-repeated A/pool
+            a = jnp.repeat(a, self.pool, axis=-2) / self.pool
+        return wd + self.scaling * jnp.matmul(a, self.b.astype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# Attach / merge / filter (the get_peft_model surface)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """Reference `LoraConfig(training_mode=...)` (qlora.py:243) equivalent.
+
+    training_mode: "qlora" (frozen QTensor base), "lora" (frozen dense
+    base), "qalora" (qlora + group pooling). The base kind is whatever the
+    params carry; the mode just sets pooling defaults.
+    """
+    r: int = 8
+    lora_alpha: float = 16.0
+    target_modules: Sequence[str] = DEFAULT_TARGET_MODULES
+    training_mode: str = "qlora"
+    qa_pool: int = 1
+
+    def __post_init__(self):
+        if self.training_mode == "qalora" and self.qa_pool == 1:
+            object.__setattr__(self, "qa_pool", 16)
+
+
+def _leaf_kn(w) -> Tuple[int, int]:
+    if isinstance(w, QTensor):
+        return w.k, w.n
+    return w.shape[-2], w.shape[-1]
+
+
+def _stack_dims(w) -> Tuple[int, ...]:
+    """Leading (layer-stack) dims of a possibly-stacked weight leaf."""
+    if isinstance(w, QTensor):
+        return tuple(w.scale.shape[:-2])
+    return tuple(w.shape[:-2])
+
+
+def attach_lora(
+    params: Dict[str, Any],
+    config: LoraConfig = LoraConfig(),
+    *,
+    key: Optional[jax.Array] = None,
+    dtype=jnp.float32,
+) -> Dict[str, Any]:
+    """Wrap target weight leaves in LoraWeight. Returns a new pytree.
+
+    The reference walks nn.Modules replacing Linear with LoraLowBitLinear
+    (qlora.py:201-232); here the walk is over the parameter dict, and the
+    stacked-layer layout means ONE LoraWeight covers all L layers of a
+    projection (a: [L, K/pool, r]).
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in config.target_modules:
+        if name not in layers:
+            continue
+        w = layers[name]
+        kdim, ndim = _leaf_kn(w)
+        lead = _stack_dims(w)
+        if kdim % config.qa_pool:
+            raise ValueError(
+                f"qa_pool={config.qa_pool} must divide K={kdim} ({name})")
+        key, ka = jax.random.split(key)
+        a = jax.random.normal(
+            ka, (*lead, kdim // config.qa_pool, config.r), dtype
+        ) * (1.0 / jnp.sqrt(jnp.array(kdim, jnp.float32))).astype(dtype)
+        b = jnp.zeros((*lead, config.r, ndim), dtype)
+        layers[name] = LoraWeight(w, a, b, config.lora_alpha, config.qa_pool)
+    out["layers"] = layers
+    return out
+
+
+def merge_lora(params: Dict[str, Any], *, requantize: bool = True) -> Dict[str, Any]:
+    """Fold adapters into base weights (export / ReLoRA restart).
+
+    requantize=True re-quantizes merged weights to the base qtype (the
+    reference merges into dequantized fp and saves fp16; requantizing keeps
+    the deployed artifact low-bit).
+    """
+    def merge_leaf(w):
+        if not isinstance(w, LoraWeight):
+            return w
+        dense = w.merged_dense()
+        if isinstance(w.base, QTensor) and requantize:
+            qt = w.base.qtype
+            lead = _stack_dims(w.base)
+            if lead:
+                flat = dense.reshape((-1,) + dense.shape[len(lead):])
+                qs = [quantize(flat[i], qt) for i in range(flat.shape[0])]
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *qs)
+                return jax.tree.map(
+                    lambda s: s.reshape(lead + s.shape[1:]), stacked)
+            return quantize(dense, qt)
+        return dense.astype(jnp.bfloat16)
+
+    return jax.tree.map(
+        merge_leaf, params,
+        is_leaf=lambda x: isinstance(x, (LoraWeight, QTensor)))
+
+
+def lora_trainable_mask(params: Any) -> Any:
+    """Pytree of bool: True only on adapter (a/b) leaves.
+
+    Feed to `make_train_step(trainable_filter=...)` and `optax.masked` so
+    frozen-base optimizer state is never allocated (the 7B-scale equivalent
+    of the reference freezing base modules in prepare_model_for_kbit_training,
+    qlora.py:294-342).
+    """
+    def mask_leaf(w):
+        if isinstance(w, LoraWeight):
+            return LoraWeight(
+                jax.tree.map(lambda _: False, w.base),
+                True, True, w.alpha, w.pool)
+        if isinstance(w, QTensor):
+            return jax.tree.map(lambda _: False, w)
+        return False
+
+    return jax.tree.map(
+        mask_leaf, params,
+        is_leaf=lambda x: isinstance(x, (LoraWeight, QTensor)))
+
+
+def mark_only_lora_trainable(params: Any) -> Callable[[Any], Any]:
+    """trainable_filter factory for bigdl_tpu.training.make_train_step."""
+    return lambda p: lora_trainable_mask(p)

@@ -68,3 +68,59 @@ def make_train_step(
         return params, opt_state, loss
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# Partitioned training (QLoRA): differentiate ONLY trainable leaves
+# ---------------------------------------------------------------------------
+
+def partition(params: Any, mask: Any) -> Tuple[Any, Any]:
+    """Split a pytree into (trainable, frozen) by a bool mask pytree.
+
+    Frozen positions become None in the trainable tree and vice versa
+    (recombined with `combine`). This is how QLoRA avoids both AD through
+    int-packed QTensor leaves and optimizer state for the frozen base —
+    the reference instead freezes modules and relies on requires_grad
+    (qlora.py:294-342).
+    """
+    train = jax.tree.map(lambda p, m: p if m else None, params, mask)
+    frozen = jax.tree.map(lambda p, m: None if m else p, params, mask)
+    return train, frozen
+
+
+def combine(train: Any, frozen: Any) -> Any:
+    """Inverse of `partition`."""
+    return jax.tree.map(
+        lambda a, b: b if a is None else a, train, frozen,
+        is_leaf=lambda x: x is None)
+
+
+def make_lora_train_step(
+    forward_train: Callable,   # (params, cfg, tokens) -> logits
+    cfg: Any,
+    optimizer: optax.GradientTransformation,
+    mask: Any,                 # bool pytree (bigdl_tpu.qlora.lora_trainable_mask)
+) -> Callable:
+    """Build `step(train, opt_state, frozen, batch)` for adapter training.
+
+    Usage:
+        train, frozen = partition(params, lora_trainable_mask(params))
+        opt_state = optimizer.init(train)
+        step = make_lora_train_step(fwd, cfg, opt, mask)
+        train, opt_state, loss = step(train, opt_state, frozen, batch)
+    """
+
+    def loss_fn(train, frozen, batch):
+        params = combine(train, frozen)
+        logits = forward_train(params, cfg, batch["input_ids"])
+        return next_token_loss(logits, batch["input_ids"],
+                               batch.get("attention_mask"))
+
+    @jax.jit
+    def step(train, opt_state, frozen, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(train, frozen, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, train)
+        train = optax.apply_updates(train, updates)
+        return train, opt_state, loss
+
+    return step

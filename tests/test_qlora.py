@@ -1,0 +1,146 @@
+"""QLoRA/LoRA tests: zero-init identity, frozen-base VJP, training step,
+merge, QA-LoRA pooling. Mirrors the reference's layer-equivalence test style
+(SURVEY.md §4) on tiny models."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bigdl_tpu.models import llama as llama_mod
+from bigdl_tpu.ops.quant import QTensor, dequantize, quantize
+from bigdl_tpu.qlora import (
+    LoraConfig,
+    LoraWeight,
+    attach_lora,
+    lora_trainable_mask,
+    merge_lora,
+    q_matmul_frozen,
+)
+from bigdl_tpu.training import (
+    combine,
+    make_lora_train_step,
+    next_token_loss,
+    partition,
+)
+from bigdl_tpu.utils.testing import TINY_LLAMA, random_llama_params
+
+
+def tiny_params(qtype="sym_int4"):
+    return random_llama_params(TINY_LLAMA, qtype=qtype, seed=3)
+
+
+def test_zero_init_is_identity():
+    params = tiny_params()
+    lparams = attach_lora(params, LoraConfig(r=4))
+    toks = jnp.arange(12, dtype=jnp.int32).reshape(1, 12) % TINY_LLAMA.vocab_size
+    base = llama_mod.forward_train(params, TINY_LLAMA, toks)
+    lora = llama_mod.forward_train(lparams, TINY_LLAMA, toks)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(lora), atol=0, rtol=0)
+
+
+def test_q_matmul_frozen_vjp_matches_dense():
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (64, 32), jnp.float32) * 0.1
+    qt = quantize(w, "sym_int4")
+    wd = dequantize(qt, jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64), jnp.bfloat16)
+
+    def f_frozen(x):
+        return jnp.sum(q_matmul_frozen(x, qt).astype(jnp.float32) ** 2)
+
+    def f_dense(x):
+        y = jnp.dot(x.astype(jnp.float32), wd)
+        return jnp.sum(y ** 2)
+
+    gx_frozen = jax.grad(f_frozen)(x)
+    gx_dense = jax.grad(f_dense)(x)
+    np.testing.assert_allclose(
+        np.asarray(gx_frozen, dtype=np.float32),
+        np.asarray(gx_dense, dtype=np.float32),
+        atol=0.15, rtol=0.1)
+
+
+def test_no_gradient_to_quantized_base():
+    qt = quantize(jnp.ones((32, 16), jnp.float32), "sym_int4")
+    x = jnp.ones((2, 32), jnp.bfloat16)
+
+    def f(qt):
+        return jnp.sum(q_matmul_frozen(x, qt).astype(jnp.float32))
+
+    g = jax.grad(f, allow_int=True)(qt)
+    assert float(jnp.sum(jnp.abs(g.scale.astype(jnp.float32)))) == 0.0
+
+
+def test_lora_train_step_updates_only_adapters():
+    params = attach_lora(tiny_params(), LoraConfig(r=4),
+                         key=jax.random.PRNGKey(7))
+    mask = lora_trainable_mask(params)
+    train, frozen = partition(params, mask)
+    optimizer = optax.adamw(1e-2)
+    opt_state = optimizer.init(train)
+    step = make_lora_train_step(
+        llama_mod.forward_train, TINY_LLAMA, optimizer, mask)
+
+    batch = {
+        "input_ids": (jnp.arange(32, dtype=jnp.int32).reshape(2, 16)
+                      % TINY_LLAMA.vocab_size),
+        "attention_mask": jnp.ones((2, 16), jnp.int32),
+    }
+    b_before = np.asarray(params["layers"]["q_proj"].b)
+    train2, opt_state, loss1 = step(train, opt_state, frozen, batch)
+    train3, opt_state, loss2 = step(train2, opt_state, frozen, batch)
+    assert np.isfinite(float(loss1)) and float(loss2) < float(loss1)
+
+    p2 = combine(train3, frozen)
+    # adapters moved
+    assert not np.allclose(np.asarray(p2["layers"]["q_proj"].b), b_before)
+    # frozen base untouched (same buffers recombined)
+    np.testing.assert_array_equal(
+        np.asarray(p2["layers"]["q_proj"].base.data),
+        np.asarray(params["layers"]["q_proj"].base.data))
+
+
+def test_merge_lora_matches_adapter_forward():
+    params = attach_lora(tiny_params(), LoraConfig(r=4),
+                         key=jax.random.PRNGKey(5))
+    # random non-zero B so merge is non-trivial
+    lw = params["layers"]["q_proj"]
+    b = jax.random.normal(jax.random.PRNGKey(9), lw.b.shape, lw.b.dtype) * 0.02
+    params["layers"]["q_proj"] = LoraWeight(lw.base, lw.a, b, lw.alpha, lw.pool)
+
+    toks = jnp.arange(10, dtype=jnp.int32).reshape(1, 10) % TINY_LLAMA.vocab_size
+    lora_out = llama_mod.forward_train(params, TINY_LLAMA, toks)
+    merged = merge_lora(params, requantize=False)
+    assert not isinstance(merged["layers"]["q_proj"], LoraWeight)
+    merged_out = llama_mod.forward_train(merged, TINY_LLAMA, toks)
+    # merged forward dequantizes the base; small bf16/quant noise allowed
+    np.testing.assert_allclose(
+        np.asarray(lora_out), np.asarray(merged_out), atol=0.1, rtol=0.1)
+
+
+def test_merge_lora_requantize_keeps_qtype():
+    params = attach_lora(tiny_params(), LoraConfig(r=4))
+    merged = merge_lora(params, requantize=True)
+    w = merged["layers"]["q_proj"]
+    assert isinstance(w, QTensor) and w.qtype == "sym_int4"
+    # stacked layer axis preserved
+    assert w.scale.shape[0] == TINY_LLAMA.num_hidden_layers
+
+
+def test_qalora_pooling_shapes_and_forward():
+    params = attach_lora(
+        tiny_params(), LoraConfig(r=4, training_mode="qalora", qa_pool=8))
+    lw = params["layers"]["q_proj"]
+    assert lw.a.shape[-2] == TINY_LLAMA.hidden_size // 8
+    toks = jnp.arange(8, dtype=jnp.int32).reshape(1, 8) % TINY_LLAMA.vocab_size
+    out = llama_mod.forward_train(params, TINY_LLAMA, toks)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_lora_on_dense_base():
+    params = attach_lora(tiny_params(qtype=None), LoraConfig(r=2))
+    toks = jnp.arange(8, dtype=jnp.int32).reshape(1, 8) % TINY_LLAMA.vocab_size
+    out = llama_mod.forward_train(params, TINY_LLAMA, toks)
+    assert np.all(np.isfinite(np.asarray(out)))
