@@ -206,6 +206,8 @@ def forward_train(
     cfg: LlamaConfig,
     tokens: jax.Array,       # [B, S] int32
     compute_dtype=jnp.bfloat16,
+    attn_fn=None,            # (q, k, v) -> out; default causal sdp
+    pos_offset=0,            # global position of tokens[:, 0] (seq parallel)
 ) -> jax.Array:
     """Cacheless causal forward for training: returns logits [B, S, V].
 
@@ -213,15 +215,24 @@ def forward_train(
     through this; no KV cache is materialized, attention is causal over the
     in-flight sequence, and `jax.checkpoint` on the layer body trades FLOPs
     for HBM during backward (the scan carries only layer inputs).
+
+    `attn_fn`/`pos_offset` let sequence parallelism swap in ring attention
+    over the sp mesh axis (bigdl_tpu.parallel.sp) with per-shard RoPE
+    offsets — the model body is otherwise unchanged.
     """
     b, s = tokens.shape
     x = params["embed_tokens"][tokens].astype(compute_dtype)
     inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
                           scaling_factor=cfg.rope_scaling_factor)
-    positions = jnp.arange(s, dtype=jnp.int32)
+    positions = pos_offset + jnp.arange(s, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions[None, :], inv_freq)
 
     h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+
+    if attn_fn is None:
+        def attn_fn(q, k, v):
+            return sdp_attention(q, k, v, jnp.zeros((), jnp.int32),
+                                 sliding_window=cfg.sliding_window)
 
     @jax.checkpoint
     def layer(x, lp):
@@ -232,8 +243,7 @@ def forward_train(
         q = apply_rope(q.reshape(b, s, h, hd), cos, sin)
         k = apply_rope(k.reshape(b, s, hkv, hd), cos, sin)
         v = v.reshape(b, s, hkv, hd)
-        attn = sdp_attention(q, k, v, jnp.zeros((), jnp.int32),
-                             sliding_window=cfg.sliding_window)
+        attn = attn_fn(q, k, v)
         x = x + linear(attn.reshape(b, s, h * hd), lp["o_proj"],
                        lp.get("o_proj_bias"))
         hidden = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)

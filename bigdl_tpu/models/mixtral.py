@@ -1,0 +1,286 @@
+"""Mixtral (sparse MoE) model: functional, static-shape, expert-sharded.
+
+TPU-native re-design of the reference's Mixtral path (reference
+transformers/models/mixtral.py: `mixtral_moeblock_forward` at :79-138 — a
+Python loop over experts with a `.cpu().tolist()` host sync to pick the
+top-k on decode, which is unacceptable on TPU). Here expert dispatch is a
+one-hot einsum combine with NO host sync and no data-dependent shapes:
+
+- All experts are evaluated and combined with routing weights
+  (`combine[n,e]`), the standard dense-MoE formulation that XLA maps onto
+  batched MXU matmuls. With int4-packed experts the full-expert weight read
+  is the same byte count as reading 2 bf16 experts, so even decode stays
+  HBM-reasonable; a top-k-gathering Pallas kernel is the planned upgrade.
+- Expert weights are stacked [L, E, K, N] (layer, expert leading axes on
+  every QTensor leaf), so the `ep` mesh axis shards axis E and `tp` shards
+  N — XLA inserts the all-to-all/psum (SURVEY.md §2.2: the reference has NO
+  cross-device expert parallelism at all).
+
+Attention/embeddings/lm_head reuse the llama module's layout exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.models import llama as llama_mod
+from bigdl_tpu.models.llama import LlamaConfig
+from bigdl_tpu.ops.attention import sdp_attention
+from bigdl_tpu.ops.kvcache import KVCache, read_layer, update_layer
+from bigdl_tpu.ops.matmul import linear, q_matmul
+from bigdl_tpu.ops.norms import rms_norm
+from bigdl_tpu.ops.quant import QTensor
+from bigdl_tpu.ops.rope import apply_rope, rope_cos_sin, rope_freqs
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+
+    @classmethod
+    def from_hf(cls, hf: Dict[str, Any]) -> "MixtralConfig":
+        base = LlamaConfig.from_hf(hf)
+        return cls(
+            **dataclasses.asdict(base),
+            num_local_experts=hf.get("num_local_experts", 8),
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        )
+
+
+# Parameter pytree layout: llama's, with the mlp keys replaced by
+# {
+#   "router":       [L, D, E] dense (small; kept full precision, as the
+#                   reference excludes the gate from quantization),
+#   "experts_gate": QTensor/dense stacked [L, E, D, F],   (HF w1)
+#   "experts_up":   QTensor/dense stacked [L, E, D, F],   (HF w3)
+#   "experts_down": QTensor/dense stacked [L, E, F, D],   (HF w2)
+# }
+
+
+def moe_block(x: jax.Array, lp: Dict[str, Any], cfg: MixtralConfig) -> jax.Array:
+    """Sparse-MoE MLP: route, evaluate experts, one-hot combine. [B,T,D]."""
+    b, t, d = x.shape
+    xf = x.reshape(-1, d)                                   # [N, D]
+    router_logits = jnp.dot(xf, lp["router"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)  # [N, E]
+    topv, topi = lax.top_k(router_logits, cfg.num_experts_per_tok)
+    w = jax.nn.softmax(topv, axis=-1)                       # [N, k] f32
+    combine = jnp.sum(
+        jax.nn.one_hot(topi, cfg.num_local_experts, dtype=w.dtype)
+        * w[..., None], axis=1)                             # [N, E]
+
+    def expert_fn(gate_w, up_w, down_w):
+        g = linear(xf, gate_w)
+        u = linear(xf, up_w)
+        return linear(jax.nn.silu(g) * u, down_w)           # [N, D]
+
+    all_out = jax.vmap(expert_fn)(
+        lp["experts_gate"], lp["experts_up"], lp["experts_down"])  # [E,N,D]
+    y = jnp.einsum("ne,end->nd", combine.astype(x.dtype), all_out)
+    return y.reshape(b, t, d)
+
+
+def _layer_step(cfg: MixtralConfig, carry, xs):
+    x, ck, cv, pos, cos, sin = carry
+    lp, lidx = xs
+    b, sq, d = x.shape
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+
+    hidden = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
+    q = linear(hidden, lp["q_proj"]).reshape(b, sq, h, hd)
+    k = linear(hidden, lp["k_proj"]).reshape(b, sq, hkv, hd)
+    v = linear(hidden, lp["v_proj"]).reshape(b, sq, hkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    ck, cv = update_layer(ck, cv, lidx, k, v, pos)
+    kf, vf = read_layer(ck, cv, lidx)
+    attn = sdp_attention(q, kf, vf, pos, sliding_window=cfg.sliding_window)
+    x = x + linear(attn.reshape(b, sq, h * hd), lp["o_proj"])
+
+    hidden = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+    x = x + moe_block(hidden, lp, cfg)
+    return (x, ck, cv, pos, cos, sin), None
+
+
+def forward(
+    params: Dict[str, Any],
+    cfg: MixtralConfig,
+    tokens: jax.Array,
+    cache: KVCache,
+    compute_dtype=jnp.bfloat16,
+    last_only: bool = False,
+) -> Tuple[jax.Array, KVCache]:
+    b, sq = tokens.shape
+    pos = cache.pos
+    x = params["embed_tokens"][tokens].astype(compute_dtype)
+    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
+                          scaling_factor=cfg.rope_scaling_factor)
+    positions = pos + jnp.arange(sq, dtype=jnp.int32)
+    cos, sin = rope_cos_sin(positions[None, :], inv_freq)
+
+    lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
+    (x, ck, cv, _, _, _), _ = lax.scan(
+        lambda c, xs: _layer_step(cfg, c, xs),
+        (x, cache.k, cache.v, pos, cos, sin),
+        (params["layers"], lidx),
+    )
+
+    if last_only:
+        x = x[:, -1:, :]
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        logits = jnp.dot(x, params["embed_tokens"].T.astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+    else:
+        logits = linear(x, lm_head)
+    return logits.astype(jnp.float32), KVCache(ck, cv, pos + sq)
+
+
+def forward_last_token(params, cfg, tokens, cache, compute_dtype=jnp.bfloat16):
+    return forward(params, cfg, tokens, cache, compute_dtype=compute_dtype,
+                   last_only=True)
+
+
+def forward_train(
+    params: Dict[str, Any],
+    cfg: MixtralConfig,
+    tokens: jax.Array,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Cacheless causal forward (QLoRA finetuning of MoE models)."""
+    b, s = tokens.shape
+    x = params["embed_tokens"][tokens].astype(compute_dtype)
+    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
+                          scaling_factor=cfg.rope_scaling_factor)
+    cos, sin = rope_cos_sin(jnp.arange(s, dtype=jnp.int32)[None, :], inv_freq)
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+
+    @jax.checkpoint
+    def layer(x, lp):
+        hidden = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
+        q = apply_rope(linear(hidden, lp["q_proj"]).reshape(b, s, h, hd),
+                       cos, sin)
+        k = apply_rope(linear(hidden, lp["k_proj"]).reshape(b, s, hkv, hd),
+                       cos, sin)
+        v = linear(hidden, lp["v_proj"]).reshape(b, s, hkv, hd)
+        attn = sdp_attention(q, k, v, jnp.zeros((), jnp.int32),
+                             sliding_window=cfg.sliding_window)
+        x = x + linear(attn.reshape(b, s, h * hd), lp["o_proj"])
+        hidden = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+        return x + moe_block(hidden, lp, cfg)
+
+    x, _ = lax.scan(lambda c, lp: (layer(c, lp), None), x, params["layers"])
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        logits = jnp.dot(x, params["embed_tokens"].T.astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+    else:
+        logits = linear(x, lm_head)
+    return logits.astype(jnp.float32)
+
+
+def new_cache(cfg: MixtralConfig, batch: int, max_seq: int,
+              quantized: bool = False) -> KVCache:
+    return llama_mod.new_cache(cfg, batch, max_seq, quantized)
+
+
+def convert_hf_params(
+    tensors,
+    cfg: MixtralConfig,
+    qtype: Optional[str] = "sym_int4",
+    compute_dtype=jnp.bfloat16,
+    modules_to_not_convert: Tuple[str, ...] = (),
+) -> Dict[str, Any]:
+    """HF MixtralForCausalLM tensors -> stacked [L, E, ...] pytree.
+
+    HF names: model.layers.N.block_sparse_moe.gate.weight [E, D];
+    experts.M.{w1,w3} [F, D] (gate/up), w2 [D, F] (down). The router stays
+    dense (the reference also leaves the tiny gate unquantized in practice
+    via modules_to_not_convert).
+    """
+    from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize_linear
+
+    L, E = cfg.num_hidden_layers, cfg.num_local_experts
+    do_quant = qtype is not None and qtype not in FLOAT_QTYPES
+
+    def cvt_linear(name, w):
+        w = jnp.asarray(np.asarray(w))
+        if do_quant and not any(m in name for m in modules_to_not_convert):
+            return quantize_linear(w, qtype)
+        return w.T.astype(compute_dtype)
+
+    attn_keys = {"self_attn.q_proj": "q_proj", "self_attn.k_proj": "k_proj",
+                 "self_attn.v_proj": "v_proj", "self_attn.o_proj": "o_proj"}
+    expert_keys = {"w1": "experts_gate", "w3": "experts_up",
+                   "w2": "experts_down"}
+
+    layer_acc: Dict[str, list] = {}
+    params: Dict[str, Any] = {}
+
+    def put(key, idx, val):
+        layer_acc.setdefault(key, [None] * L)[idx] = val
+
+    def put_expert(key, lidx, eidx, val):
+        slot = layer_acc.setdefault(key, [None] * L)
+        if slot[lidx] is None:
+            slot[lidx] = [None] * E
+        slot[lidx][eidx] = val
+
+    for name, w in tensors:
+        if name == "model.embed_tokens.weight":
+            params["embed_tokens"] = jnp.asarray(np.asarray(w)).astype(
+                compute_dtype)
+        elif name == "model.norm.weight":
+            params["norm"] = jnp.asarray(np.asarray(w)).astype(compute_dtype)
+        elif name == "lm_head.weight":
+            params["lm_head"] = cvt_linear(name, w)
+        elif name.startswith("model.layers."):
+            parts = name.split(".")
+            idx = int(parts[2])
+            sub = ".".join(parts[3:-1])
+            if sub in attn_keys:
+                put(attn_keys[sub], idx, cvt_linear(name, w))
+            elif sub in ("input_layernorm", "post_attention_layernorm"):
+                put(sub, idx,
+                    jnp.asarray(np.asarray(w)).astype(compute_dtype))
+            elif sub == "block_sparse_moe.gate":
+                put("router", idx,
+                    jnp.asarray(np.asarray(w)).T.astype(compute_dtype))
+            elif sub.startswith("block_sparse_moe.experts."):
+                eidx = int(sub.split(".")[2])
+                wname = sub.split(".")[3]
+                put_expert(expert_keys[wname], idx, eidx,
+                           cvt_linear(name, w))
+
+    missing = [k for k, v in layer_acc.items()
+               if any(x is None for x in v)
+               or (k.startswith("experts_")
+                   and any(e is None for x in v for e in x))]
+    if missing:
+        raise ValueError(f"checkpoint missing layer tensors for: {missing}")
+
+    layers: Dict[str, Any] = {}
+    for key, per_layer in layer_acc.items():
+        if key.startswith("experts_"):
+            stacked_e = [jax.tree.map(lambda *xs: jnp.stack(xs), *experts)
+                         for experts in per_layer]
+            layers[key] = jax.tree.map(lambda *xs: jnp.stack(xs), *stacked_e)
+        else:
+            layers[key] = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+    params["layers"] = layers
+
+    if cfg.tie_word_embeddings:
+        params.pop("lm_head", None)
+    elif "lm_head" not in params:
+        raise ValueError("checkpoint has no lm_head.weight")
+    return params

@@ -73,5 +73,19 @@ def _register_builtin() -> None:
 
     register_family(["Qwen2ForCausalLM"], llama_adapter(qwen2_tweak))
 
+    from bigdl_tpu.models import mixtral as mixtral_mod
+
+    register_family(
+        ["MixtralForCausalLM"],
+        FamilyAdapter(
+            name="mixtral",
+            config_from_hf=mixtral_mod.MixtralConfig.from_hf,
+            convert_params=mixtral_mod.convert_hf_params,
+            forward=mixtral_mod.forward,
+            prefill=mixtral_mod.forward_last_token,
+            forward_train=mixtral_mod.forward_train,
+            new_cache=mixtral_mod.new_cache,
+        ))
+
 
 _register_builtin()

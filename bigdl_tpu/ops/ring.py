@@ -1,0 +1,126 @@
+"""Ring attention: exact causal attention over a sequence-parallel mesh axis.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.2: its
+long-context story is FP8 KV + per-model 32k variants, all single-device).
+This is the planned superset capability: shard the sequence over the `sp`
+mesh axis, keep Q local, and rotate K/V chunks around the ring with
+`lax.ppermute` while accumulating flash-style online softmax — peak memory
+per chip is O(S/sp), communication rides ICI and overlaps with the chunk
+matmuls (XLA schedules the ppermute DMA concurrently with compute).
+
+Two layers:
+- `ring_attention(q, k, v, axis_name)` — call INSIDE `shard_map` over a
+  mesh with `axis_name`; q/k/v are the local sequence chunks.
+- `sp_attention(q, k, v, mesh, axis)` — convenience wrapper that shard_maps
+  over full arrays.
+
+Math: online softmax accumulation in f32 (m: running row max, l: running
+normalizer, o: unnormalized output), causal mask computed from *global*
+positions (chunk index x chunk length + local offset). Matches
+`sdp_attention` to float tolerance, verified in tests on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _chunk_scores(q, k, scale, logits_soft_cap):
+    # q [B, Sq, Hkv, G, D], k [B, Sk, Hkv, D] -> [B, Hkv, G, Sq, Sk] f32
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if logits_soft_cap is not None:
+        s = jnp.tanh(s / logits_soft_cap) * logits_soft_cap
+    return s
+
+
+def ring_attention(
+    q: jax.Array,          # [B, Sq_loc, H, D] local query chunk
+    k: jax.Array,          # [B, Sk_loc, Hkv, D] local key chunk
+    v: jax.Array,          # [B, Sk_loc, Hkv, D]
+    axis_name: str,
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Exact causal attention with K/V rotating around `axis_name`.
+
+    Sequence is laid out contiguously across the axis: device i holds
+    global positions [i*Sq_loc, (i+1)*Sq_loc). Returns [B, Sq_loc, H, D].
+    """
+    b, sq, h, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    g = h // hkv
+    if scale is None:
+        scale = d ** -0.5
+
+    p = lax.axis_index(axis_name)
+    n = lax.psum(1, axis_name)
+
+    qf = q.reshape(b, sq, hkv, g, d).astype(jnp.bfloat16)
+    q_ids = p * sq + jnp.arange(sq, dtype=jnp.int32)        # global q pos
+
+    o0 = jnp.zeros((b, hkv, g, sq, d), jnp.float32)
+    m0 = jnp.full((b, hkv, g, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, sq), jnp.float32)
+    # the loop body makes these device-varying (they depend on axis_index);
+    # mark the initial values accordingly for shard_map's vma tracking
+    o0, m0, l0 = (lax.pcast(x, (axis_name,), to="varying")
+                  for x in (o0, m0, l0))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        src = (p - i) % n                                   # chunk we hold
+        k_ids = src * sk + jnp.arange(sk, dtype=jnp.int32)
+        s = _chunk_scores(qf, k_cur.astype(jnp.bfloat16), scale,
+                          logits_soft_cap)                  # [B,Hkv,G,Sq,Sk]
+        mask = k_ids[None, :] <= q_ids[:, None]             # [Sq, Sk]
+        if sliding_window is not None:
+            mask &= k_ids[None, :] > q_ids[:, None] - sliding_window
+        s = jnp.where(mask[None, None, None], s, -jnp.inf)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # fully-masked rows keep m == -inf; guard the exp against NaN
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+        pexp = jnp.exp(s - m_new[..., None])
+        pexp = jnp.where(jnp.isfinite(s), pexp, 0.0)
+        l = l * alpha + jnp.sum(pexp, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhgqk,bkhd->bhgqd", pexp.astype(jnp.bfloat16),
+            v_cur.astype(jnp.bfloat16), preferred_element_type=jnp.float32)
+
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m_new, l, k_nxt, v_nxt)
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    out = o / jnp.maximum(l, 1e-30)[..., None]              # [B,Hkv,G,Sq,D]
+    out = jnp.moveaxis(out, 3, 1).reshape(b, sq, h, d)
+    return out.astype(q.dtype)
+
+
+def sp_attention(
+    q: jax.Array,          # [B, S, H, D] (global, sharded on S)
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """shard_map wrapper: sequence-parallel exact causal attention."""
+    fn = functools.partial(ring_attention, axis_name=axis, scale=scale,
+                           logits_soft_cap=logits_soft_cap,
+                           sliding_window=sliding_window)
+    spec = P(None, axis, None, None)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)

@@ -1,0 +1,123 @@
+"""Sequence/context-parallel training: ring attention over the sp axis.
+
+A capability the reference does NOT have (SURVEY.md §5 "Long-context":
+its options are FP8 KV caches and 32k model variants, single-device only).
+Here a long sequence is sharded over the `sp` mesh axis; every layer runs
+on local chunks; attention is exact ring attention (ops/ring.py) with K/V
+rotating over ICI; RoPE uses global position offsets; and the next-token
+loss handles the shard-boundary shift with a single ppermute of the
+neighbouring first token. Peak activation memory per chip is O(S/sp).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.ops.ring import ring_attention
+
+
+def sp_loss_fn(
+    params: Any,
+    cfg: Any,
+    tokens_local: jax.Array,       # [B, S_loc] this shard's sequence chunk
+    mask_local: Optional[jax.Array],
+    forward_train: Callable,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Mean next-token loss, computed collectively. Call inside shard_map."""
+    b, s_loc = tokens_local.shape
+    p = lax.axis_index(axis_name)
+    n = lax.psum(1, axis_name)
+
+    attn = functools.partial(ring_attention, axis_name=axis_name,
+                             sliding_window=getattr(cfg, "sliding_window",
+                                                    None))
+    logits = forward_train(params, cfg, tokens_local,
+                           attn_fn=attn, pos_offset=p * s_loc)  # [B,S_loc,V]
+
+    # targets: local tokens shifted by one; the last position's target is
+    # the NEXT shard's first token (ppermute right-to-left)
+    perm = [((i + 1) % n, i) for i in range(n)]   # recv from right neighbor
+    nxt_first = lax.ppermute(tokens_local[:, :1], axis_name, perm)
+    targets = jnp.concatenate([tokens_local[:, 1:], nxt_first], axis=1)
+
+    valid = jnp.ones((b, s_loc), jnp.float32)
+    if mask_local is not None:
+        m = mask_local.astype(jnp.float32)
+        nxt_mask = lax.ppermute(m[:, :1], axis_name, perm)
+        valid = jnp.concatenate([m[:, 1:], nxt_mask], axis=1)
+    # global last position has no target
+    is_last_shard = (p == n - 1)
+    last_pos_mask = jnp.where(
+        is_last_shard & (jnp.arange(s_loc) == s_loc - 1), 0.0, 1.0)
+    valid = valid * last_pos_mask[None, :]
+
+    ll = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(ll, targets[..., None], axis=-1)[..., 0]
+    local_sum = jnp.sum(nll * valid)
+    local_cnt = jnp.sum(valid)
+    total = lax.psum(local_sum, axis_name)
+    count = lax.psum(local_cnt, axis_name)
+    return total / jnp.maximum(count, 1.0)
+
+
+def make_sp_train_step(
+    forward_train: Callable,
+    cfg: Any,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    axis_name: str = "sp",
+) -> Callable:
+    """Build `step(params, opt_state, batch) -> (params, opt_state, loss)`
+    with the sequence axis of batch["input_ids"] sharded over `axis_name`.
+
+    Params are replicated over sp (grads come back psum'd); compose with tp
+    by sharding param leaves on other axes as usual — shard_map only
+    manualizes the sp axis.
+    """
+    def loss(params, tokens_local, mask_local):
+        return sp_loss_fn(params, cfg, tokens_local, mask_local,
+                          forward_train, axis_name)
+
+    grad_fn = jax.value_and_grad(loss)
+
+    def sharded_grads(params, tokens_local, mask_local):
+        l, g = grad_fn(params, tokens_local, mask_local)
+        # psum's transpose is psum, so each shard's local grad already
+        # carries an n-factor from the collective loss; pmean both combines
+        # the per-shard contributions and cancels it exactly
+        g = jax.tree.map(lambda x: lax.pmean(x, axis_name), g)
+        return l, g
+
+    seq_spec = P(None, axis_name)
+    rep = P()
+
+    shard_grad = jax.shard_map(
+        sharded_grads, mesh=mesh,
+        in_specs=(rep, seq_spec, seq_spec),
+        out_specs=(rep, rep),
+    )
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        mask = batch.get("attention_mask")
+        if mask is None:
+            mask = jnp.ones_like(batch["input_ids"])
+        l, grads = shard_grad(params, batch["input_ids"], mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, l
+
+    return step
+
+
+def shard_batch_sp(batch, mesh: Mesh, axis_name: str = "sp"):
+    spec = NamedSharding(mesh, P(None, axis_name))
+    return jax.tree.map(lambda x: jax.device_put(x, spec), batch)

@@ -1,0 +1,219 @@
+"""Self-speculative decoding: low-bit draft proposes, target verifies.
+
+TPU-native re-design of the reference's `speculative_generate` (reference
+transformers/speculative.py:443-1022: host-side draft loop with adaptive
+early stop, batched verify forward, greedy prefix-match or min(1,q/p)
+rejection-sampling accept, and KV-cache rollback done by slicing/copying
+cache tensors per architecture, speculative.py:393-439).
+
+Everything that made the reference's version hard on accelerators is
+restructured for XLA:
+
+- **One dispatch per round.** Draft loop (fixed gamma steps, `lax.scan`),
+  target verify (one gamma-token forward), accept computation, and the cache
+  rollback all run inside ONE jitted function; the host reads back one small
+  (tokens, n_accept) tuple per round. The reference pays a host round-trip
+  per draft token.
+- **Rollback is index bookkeeping, not realloc.** Our KV caches are
+  pre-allocated with validity tracked by a scalar `pos` (ops/kvcache.py);
+  rejected entries beyond the accepted prefix are simply left in place —
+  masked by position until overwritten. The reference copies/extends cache
+  tensors (`_check_and_extend_kv_cache`).
+- **Static accept bound.** At most gamma-1 drafts are accepted per round
+  (full-accept forfeits the reference's "bonus token"), which keeps both
+  caches exactly consistent with no variable-length catch-up forward.
+
+The draft is typically the same checkpoint at sym_int4 (self-speculation,
+reference model.py:323-331) and the target bf16/fp8 — both share one
+tokenizer, so only token ids cross model boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.ops.kvcache import KVCache
+
+
+@dataclasses.dataclass
+class SpecStats:
+    """Reference telemetry equivalent (speculative.py:143-151:
+    draft_time/verify_time/accept_num)."""
+    rounds: int = 0
+    accepted: List[int] = dataclasses.field(default_factory=list)
+    round_s: List[float] = dataclasses.field(default_factory=list)
+    first_token_s: float = 0.0
+
+    @property
+    def mean_accept(self) -> float:
+        return float(np.mean(self.accepted)) if self.accepted else 0.0
+
+    @property
+    def tokens_per_round(self) -> float:
+        return self.mean_accept + 1.0
+
+
+def make_spec_round(
+    fwd_target: Callable,
+    cfg_target: Any,
+    fwd_draft: Callable,
+    cfg_draft: Any,
+    gamma: int,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+):
+    """Build the fused per-round executable.
+
+    round(params_t, params_d, cache_t, cache_d, cur_tok, key) ->
+        (out_tokens [B, gamma], n_accept [B], cache_t, cache_d, key)
+
+    Emits n_accept+1 valid tokens per round (accepted drafts + the target's
+    next token at the first divergence).
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    def spec_round(params_t, params_d, cache_t: KVCache, cache_d: KVCache,
+                   cur_tok: jax.Array, key: jax.Array):
+        b = cur_tok.shape[0]
+        pos0 = cache_t.pos
+
+        # --- draft: gamma greedy steps (reference's draft loop, fused) ---
+        def dstep(carry, _):
+            tok, cache = carry
+            logits, cache = fwd_draft(params_d, cfg_draft, tok[:, None], cache)
+            lg = logits[:, -1, :]
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            q = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+            qprob = jnp.take_along_axis(q, nxt[:, None], axis=-1)[:, 0]
+            return (nxt, cache), (nxt, qprob)
+
+        (_, cache_d), (draft_toks, draft_q) = lax.scan(
+            dstep, (cur_tok, cache_d), None, length=gamma)
+        draft_toks = draft_toks.T          # [B, gamma]
+        draft_q = draft_q.T                # [B, gamma] (for future sampling accept)
+
+        # --- verify: ONE target forward over [cur_tok, d_1..d_{gamma-1}] ---
+        verify_in = jnp.concatenate([cur_tok[:, None], draft_toks[:, :-1]],
+                                    axis=1)  # [B, gamma]
+        logits_t, cache_t = fwd_target(params_t, cfg_target, verify_in, cache_t)
+        if do_sample and temperature > 0.0:
+            from bigdl_tpu.generation import sample_token
+
+            key, sk = jax.random.split(key)
+            bsz, g_, vocab = logits_t.shape
+            target_pred = sample_token(
+                logits_t.astype(jnp.float32).reshape(bsz * g_, vocab), sk,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+            ).reshape(bsz, g_)                      # [B, gamma]
+        else:
+            target_pred = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+
+        # --- accept: greedy prefix match, capped at gamma-1 ---
+        matches = (draft_toks == target_pred)       # [B, gamma]
+        n_accept = jnp.minimum(
+            jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1),
+            gamma - 1)                              # [B]
+
+        # out[i] = d_{i+1} for i < n_accept, target_pred[n_accept] at i==n,
+        # garbage after (host slices by n_accept+1)
+        idx = jnp.arange(gamma)[None, :]
+        out = jnp.where(idx < n_accept[:, None], draft_toks,
+                        jnp.take_along_axis(
+                            target_pred, n_accept[:, None], axis=1))
+
+        # --- rollback: pure index bookkeeping ---
+        new_pos = pos0 + n_accept[0] + 1            # B=1: scalar pos
+        cache_t = KVCache(cache_t.k, cache_t.v, new_pos)
+        cache_d = KVCache(cache_d.k, cache_d.v, new_pos)
+        return out, n_accept, cache_t, cache_d, key
+
+    return spec_round
+
+
+def speculative_generate(
+    params_target: Any,
+    params_draft: Any,
+    cfg_target: Any,
+    cfg_draft: Any,
+    input_ids,                              # [S] or [1, S] ints
+    *,
+    family_forward: Callable,
+    family_prefill: Callable,
+    new_cache: Callable,                    # (cfg, batch, max_seq) -> KVCache
+    max_new_tokens: int = 128,
+    gamma: int = 4,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_token_id: Optional[int] = None,
+    max_seq: int = 2048,
+    seed: int = 0,
+    kv_quantized: bool = False,
+    stats: Optional[SpecStats] = None,
+) -> np.ndarray:
+    """Generate with draft/verify speculation. Returns new tokens [1, <=N].
+
+    `family_forward/prefill` serve both models (self-speculation: same
+    architecture, different qtype).
+    """
+    ids = np.asarray(input_ids, np.int32)
+    if ids.ndim == 1:
+        ids = ids[None]
+    if ids.shape[0] != 1:
+        raise ValueError("speculative decoding supports batch size 1 "
+                         "(as the reference does)")
+    s = ids.shape[1]
+    if s + max_new_tokens + gamma > max_seq:
+        raise ValueError(f"prompt ({s}) + max_new_tokens ({max_new_tokens}) "
+                         f"+ gamma ({gamma}) exceeds max_seq {max_seq}")
+
+    cache_t = new_cache(cfg_target, 1, max_seq, kv_quantized)
+    cache_d = new_cache(cfg_draft, 1, max_seq, kv_quantized)
+
+    prefill = jax.jit(family_prefill, static_argnums=1, donate_argnums=3)
+
+    t0 = time.perf_counter()
+    toks = jnp.asarray(ids)
+    logits_t, cache_t = prefill(params_target, cfg_target, toks, cache_t)
+    _, cache_d = prefill(params_draft, cfg_draft, toks, cache_d)
+    cur = jnp.argmax(logits_t[:, -1, :], axis=-1).astype(jnp.int32)
+    cur_host = int(np.asarray(cur)[0])
+    if stats is not None:
+        stats.first_token_s = time.perf_counter() - t0
+
+    spec_round = make_spec_round(
+        family_forward, cfg_target, family_forward, cfg_draft, gamma,
+        do_sample=do_sample, temperature=temperature, top_k=top_k,
+        top_p=top_p)
+
+    out: List[int] = [cur_host]
+    key = jax.random.PRNGKey(seed)
+    while len(out) < max_new_tokens:
+        if eos_token_id is not None and out and out[-1] == eos_token_id:
+            break
+        t1 = time.perf_counter()
+        toks_r, n_acc, cache_t, cache_d, key = spec_round(
+            params_target, params_draft, cache_t, cache_d, cur, key)
+        toks_host = np.asarray(toks_r)[0]
+        n = int(np.asarray(n_acc)[0])
+        if stats is not None:
+            stats.rounds += 1
+            stats.accepted.append(n)
+            stats.round_s.append(time.perf_counter() - t1)
+        emitted = list(toks_host[: n + 1])
+        if eos_token_id is not None and eos_token_id in emitted:
+            emitted = emitted[: emitted.index(eos_token_id) + 1]
+        out.extend(int(t) for t in emitted)
+        cur = toks_r[:, n]
+    return np.asarray(out[:max_new_tokens], np.int32)[None]

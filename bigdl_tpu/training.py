@@ -99,14 +99,13 @@ def make_lora_train_step(
     forward_train: Callable,   # (params, cfg, tokens) -> logits
     cfg: Any,
     optimizer: optax.GradientTransformation,
-    mask: Any,                 # bool pytree (bigdl_tpu.qlora.lora_trainable_mask)
 ) -> Callable:
     """Build `step(train, opt_state, frozen, batch)` for adapter training.
 
     Usage:
         train, frozen = partition(params, lora_trainable_mask(params))
         opt_state = optimizer.init(train)
-        step = make_lora_train_step(fwd, cfg, opt, mask)
+        step = make_lora_train_step(fwd, cfg, opt)
         train, opt_state, loss = step(train, opt_state, frozen, batch)
     """
 

@@ -52,6 +52,7 @@ class TpuCausalLM:
         self.model_path = model_path
         self.max_seq = max_seq
         self.kv_quantized = kv_quantized
+        self.draft_params: Any = None   # set when loaded with speculative=True
         self._generator: Optional[Generator] = None
 
     # -- generation ---------------------------------------------------------
@@ -78,9 +79,16 @@ class TpuCausalLM:
         eos_token_id: Optional[int] = None,
         seed: int = 0,
         stats: Optional[GenerationStats] = None,
+        gamma: int = 4,
+        spec_stats=None,
         **_ignored,
     ) -> np.ndarray:
-        """HF-style generate: returns [B, prompt+new] (prompt included)."""
+        """HF-style generate: returns [B, prompt+new] (prompt included).
+
+        When the model was loaded with speculative=True, decoding runs
+        draft/verify speculation (bigdl_tpu.speculative) transparently —
+        the reference patches GenerationMixin.generate the same way
+        (speculative.py:42-103)."""
         ids = np.asarray(input_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
@@ -88,6 +96,28 @@ class TpuCausalLM:
             eos_token_id = self.hf_config.get("eos_token_id")
             if isinstance(eos_token_id, list):
                 eos_token_id = eos_token_id[0]
+        if self.draft_params is not None and ids.shape[0] == 1:
+            from bigdl_tpu.speculative import speculative_generate
+
+            new = speculative_generate(
+                self.params, self.draft_params, self.config, self.config,
+                ids,
+                family_forward=self.family.forward,
+                family_prefill=self.family.prefill,
+                new_cache=self.family.new_cache,
+                max_new_tokens=max_new_tokens,
+                gamma=gamma,
+                do_sample=do_sample,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                eos_token_id=eos_token_id,
+                max_seq=self.max_seq,
+                seed=seed,
+                kv_quantized=self.kv_quantized,
+                stats=spec_stats,
+            )
+            return np.concatenate([ids, new], axis=1)
         gen = GenerationConfig(
             max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, do_sample=do_sample,
@@ -137,13 +167,35 @@ class _BaseAutoModelClass:
         modules_to_not_convert=(),
         max_seq: Optional[int] = None,
         quantize_kv_cache: bool = False,
+        speculative: bool = False,
         **_ignored,
     ) -> TpuCausalLM:
         path = pretrained_model_name_or_path
         if lowbit_io.is_low_bit_dir(path):
+            if speculative:
+                raise ValueError(
+                    "speculative=True needs an original checkpoint to build "
+                    "the low-bit draft (reference model.py:323-331); this "
+                    "path is an already-quantized save_low_bit directory")
             # max_seq=None lets the manifest's saved value win
             return cls.load_low_bit(path, max_seq=max_seq,
                                     quantize_kv_cache=quantize_kv_cache)
+        if os.path.isfile(path) and path.endswith(".gguf"):
+            if speculative:
+                raise ValueError(
+                    "speculative=True is not supported for GGUF inputs "
+                    "(already low-bit); load the original HF checkpoint")
+            # direct GGUF ingestion (reference gguf/api.py:31)
+            from bigdl_tpu.gguf import load_gguf
+
+            params, hf_config, _tok = load_gguf(path)
+            archs = hf_config.get("architectures") or ["?"]
+            family = get_family(archs[0])
+            cfg = family.config_from_hf(hf_config)
+            return TpuCausalLM(params, cfg, family, hf_config,
+                               qtype="gguf", model_path=os.path.dirname(path),
+                               max_seq=max_seq or 2048,
+                               kv_quantized=quantize_kv_cache)
         max_seq = max_seq or 2048
 
         qtype = _resolve_qtype(load_in_4bit, load_in_low_bit)
@@ -156,9 +208,19 @@ class _BaseAutoModelClass:
         params = family.convert_params(
             iter_hf_tensors(path), cfg, qtype=cvt_qtype,
             modules_to_not_convert=tuple(modules_to_not_convert))
-        return TpuCausalLM(params, cfg, family, hf_config, qtype,
-                           model_path=path, max_seq=max_seq,
-                           kv_quantized=quantize_kv_cache)
+        model = TpuCausalLM(params, cfg, family, hf_config, qtype,
+                            model_path=path, max_seq=max_seq,
+                            kv_quantized=quantize_kv_cache)
+        if speculative:
+            # self-speculation: same checkpoint as a sym_int4 draft
+            # (reference model.py:323-331)
+            if cvt_qtype == "sym_int4":
+                model.draft_params = params      # already low-bit: share
+            else:
+                model.draft_params = family.convert_params(
+                    iter_hf_tensors(path), cfg, qtype="sym_int4",
+                    modules_to_not_convert=tuple(modules_to_not_convert))
+        return model
 
     @classmethod
     def load_low_bit(cls, path: str, max_seq: Optional[int] = None,

@@ -97,3 +97,62 @@ def random_llama_params(
     if not cfg.tie_word_embeddings:
         params["lm_head"] = make_linear(d, v)
     return params
+
+
+def random_mixtral_params(
+    cfg,
+    qtype: Optional[str] = "sym_int4",
+    seed: int = 0,
+    compute_dtype=jnp.bfloat16,
+) -> Dict[str, Any]:
+    """Random mixtral parameter pytree: llama attention + stacked experts."""
+    from bigdl_tpu.ops.quant import quantize
+
+    key = jax.random.PRNGKey(seed)
+    do_quant = qtype is not None and qtype not in FLOAT_QTYPES
+    d, ff, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    L, E = cfg.num_hidden_layers, cfg.num_local_experts
+
+    def nxt():
+        nonlocal key
+        key, sub = jax.random.split(key)
+        return sub
+
+    def make_linear(kdim, ndim):
+        w = jax.random.normal(nxt(), (kdim, ndim), jnp.float32) * 0.02
+        if do_quant:
+            return quantize(w, qtype)
+        return w.astype(compute_dtype)
+
+    def stack(makers):
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *makers)
+
+    layers: Dict[str, Any] = {}
+    for name, (kdim, ndim) in {
+        "q_proj": (d, h * hd), "k_proj": (d, hkv * hd),
+        "v_proj": (d, hkv * hd), "o_proj": (h * hd, d),
+    }.items():
+        layers[name] = stack([make_linear(kdim, ndim) for _ in range(L)])
+    for name, (kdim, ndim) in {
+        "experts_gate": (d, ff), "experts_up": (d, ff),
+        "experts_down": (ff, d),
+    }.items():
+        layers[name] = stack(
+            [stack([make_linear(kdim, ndim) for _ in range(E)])
+             for _ in range(L)])
+    layers["router"] = (jax.random.normal(nxt(), (L, d, E), jnp.float32)
+                        * 0.02).astype(compute_dtype)
+    ones = jnp.ones((L, d), compute_dtype)
+    layers["input_layernorm"] = ones
+    layers["post_attention_layernorm"] = ones
+
+    params: Dict[str, Any] = {
+        "embed_tokens": (jax.random.normal(nxt(), (v, d), jnp.float32)
+                         * 0.02).astype(compute_dtype),
+        "layers": layers,
+        "norm": jnp.ones((d,), compute_dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = make_linear(d, v)
+    return params

@@ -1,0 +1,149 @@
+"""GGUF loader tests: parser roundtrip, bit-faithful q4_0/q8_0 repack,
+whole-model import + generation."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import gguf as G
+from bigdl_tpu.ops.quant import dequantize
+
+
+def test_kv_roundtrip(tmp_path):
+    path = str(tmp_path / "kv.gguf")
+    kv = {
+        "general.architecture": "llama",
+        "llama.block_count": 2,
+        "llama.embedding_length": 64,
+        "llama.rope.freq_base": 10000.0,
+        "tokenizer.ggml.tokens": ["<s>", "</s>", "hello"],
+        "tokenizer.ggml.scores": [0.0, 0.0, -1.0],
+        "flag": True,
+    }
+    w = np.random.default_rng(0).standard_normal((8, 64)).astype(np.float32)
+    G.write_gguf(path, kv, {"token_embd.weight": (w, G.GGML_F32)})
+    gf = G.GGUFFile(path)
+    assert gf.version == 3
+    assert gf.kv["general.architecture"] == "llama"
+    assert gf.kv["llama.block_count"] == 2
+    assert gf.kv["tokenizer.ggml.tokens"] == ["<s>", "</s>", "hello"]
+    assert abs(gf.kv["llama.rope.freq_base"] - 10000.0) < 1e-6
+    assert gf.kv["flag"] is True
+    got = gf.load_dense("token_embd.weight")
+    np.testing.assert_array_equal(got, w)
+
+
+@pytest.mark.parametrize("gt,qtype", [(G.GGML_Q4_0, "sym_int4"),
+                                      (G.GGML_Q8_0, "sym_int8")])
+def test_bit_faithful_repack(tmp_path, gt, qtype):
+    """load_qtensor codes must equal load_dense values exactly (same bits),
+    modulo fp16->bf16 scale rounding."""
+    path = str(tmp_path / "w.gguf")
+    rng = np.random.default_rng(1)
+    w = (rng.standard_normal((16, 64)) * 0.1).astype(np.float32)  # [out, in]
+    G.write_gguf(path, {"general.architecture": "llama"},
+                 {"blk.0.attn_q.weight": (w, gt)})
+    gf = G.GGUFFile(path)
+    dense = gf.load_dense("blk.0.attn_q.weight")        # [out, in], exact
+    qt = gf.load_qtensor("blk.0.attn_q.weight")         # [in, out]
+    assert qt.qtype == qtype
+    got = np.asarray(dequantize(qt, jnp.float32)).T     # [out, in]
+    # only difference allowed: scale fp16->bf16 (<=0.4% relative)
+    np.testing.assert_allclose(got, dense, rtol=5e-3, atol=1e-4)
+
+
+def test_f16_tensor(tmp_path):
+    path = str(tmp_path / "f16.gguf")
+    w = np.random.default_rng(2).standard_normal((4, 32)).astype(np.float32)
+    G.write_gguf(path, {}, {"x": (w, G.GGML_F16)})
+    got = G.GGUFFile(path).load_dense("x")
+    np.testing.assert_allclose(got, w.astype(np.float16), atol=1e-3)
+
+
+def _tiny_llama_gguf(path, cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    d, ff, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    hd, h, hkv = cfg.hd, cfg.num_attention_heads, cfg.num_key_value_heads
+
+    def t(*shape, scale=0.02):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    kv = {
+        "general.architecture": "llama",
+        "llama.block_count": cfg.num_hidden_layers,
+        "llama.embedding_length": d,
+        "llama.feed_forward_length": ff,
+        "llama.attention.head_count": h,
+        "llama.attention.head_count_kv": hkv,
+        "llama.attention.layer_norm_rms_epsilon": cfg.rms_norm_eps,
+        "llama.rope.freq_base": cfg.rope_theta,
+        "llama.context_length": cfg.max_position_embeddings,
+        "tokenizer.ggml.tokens": [f"t{i}" for i in range(v)],
+        "tokenizer.ggml.bos_token_id": 1,
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+    tensors = {
+        "token_embd.weight": (t(v, d), G.GGML_F16),
+        "output_norm.weight": (np.ones((d,), np.float32), G.GGML_F32),
+        "output.weight": (t(v, d), G.GGML_Q4_0),
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"blk.{i}."
+        tensors.update({
+            p + "attn_q.weight": (t(h * hd, d), G.GGML_Q4_0),
+            p + "attn_k.weight": (t(hkv * hd, d), G.GGML_Q4_0),
+            p + "attn_v.weight": (t(hkv * hd, d), G.GGML_Q4_0),
+            p + "attn_output.weight": (t(d, h * hd), G.GGML_Q4_0),
+            p + "ffn_gate.weight": (t(ff, d), G.GGML_Q4_0),
+            p + "ffn_up.weight": (t(ff, d), G.GGML_Q4_0),
+            p + "ffn_down.weight": (t(d, ff), G.GGML_Q8_0),
+            p + "attn_norm.weight": (np.ones((d,), np.float32), G.GGML_F32),
+            p + "ffn_norm.weight": (np.ones((d,), np.float32), G.GGML_F32),
+        })
+    G.write_gguf(path, kv, tensors)
+
+
+def test_whole_model_load_and_generate(tmp_path):
+    from bigdl_tpu.generation import generate_on_device
+    from bigdl_tpu.models import llama as llama_mod
+    from bigdl_tpu.utils.testing import TINY_LLAMA
+
+    path = str(tmp_path / "tiny.gguf")
+    _tiny_llama_gguf(path, TINY_LLAMA)
+    params, hf_config, tok = G.load_gguf(path)
+
+    assert hf_config["architectures"] == ["LlamaForCausalLM"]
+    assert hf_config["vocab_size"] == TINY_LLAMA.vocab_size
+    assert hf_config["num_key_value_heads"] == TINY_LLAMA.num_key_value_heads
+    assert tok["tokens"][0] == "t0" and tok["eos_token_id"] == 2
+    assert params["layers"]["q_proj"].qtype == "sym_int4"
+    assert params["layers"]["down_proj"].qtype == "sym_int8"
+
+    cfg = llama_mod.LlamaConfig.from_hf(hf_config)
+    cache = llama_mod.new_cache(cfg, 1, 64)
+    prompt = jnp.asarray(np.arange(1, 9, dtype=np.int32)[None])
+    out, _ = generate_on_device(params, cfg, llama_mod.forward, prompt,
+                                cache, max_new_tokens=8)
+    out = np.asarray(out)
+    assert out.shape == (1, 8)
+    assert np.all((out >= 0) & (out < cfg.vocab_size))
+
+
+def test_facade_loads_gguf(tmp_path):
+    """AutoModelForCausalLM.from_pretrained on a .gguf path (reference
+    gguf/api.py:31 load_gguf_model equivalent)."""
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+    from bigdl_tpu.utils.testing import TINY_LLAMA
+
+    path = str(tmp_path / "tiny.gguf")
+    _tiny_llama_gguf(path, TINY_LLAMA)
+    model = AutoModelForCausalLM.from_pretrained(path, max_seq=64)
+    out = model.generate(np.arange(1, 6, dtype=np.int32), max_new_tokens=4)
+    assert out.shape[1] == 9
+
+
+def test_bad_magic(tmp_path):
+    p = tmp_path / "bad.gguf"
+    p.write_bytes(b"NOPE" + b"\x00" * 64)
+    with pytest.raises(ValueError, match="not a GGUF"):
+        G.GGUFFile(str(p))

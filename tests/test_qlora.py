@@ -81,7 +81,7 @@ def test_lora_train_step_updates_only_adapters():
     optimizer = optax.adamw(1e-2)
     opt_state = optimizer.init(train)
     step = make_lora_train_step(
-        llama_mod.forward_train, TINY_LLAMA, optimizer, mask)
+        llama_mod.forward_train, TINY_LLAMA, optimizer)
 
     batch = {
         "input_ids": (jnp.arange(32, dtype=jnp.int32).reshape(2, 16)

@@ -1,0 +1,88 @@
+"""Speculative decoding tests.
+
+The key invariant (same as the reference's greedy prefix-match accept,
+speculative.py): greedy speculative output is IDENTICAL to plain greedy
+decoding of the target model, for any draft — speculation changes latency,
+never text."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.generation import generate_on_device
+from bigdl_tpu.models import llama as llama_mod
+from bigdl_tpu.speculative import SpecStats, speculative_generate
+from bigdl_tpu.utils.testing import TINY_LLAMA, random_llama_params
+
+MAX_SEQ = 256
+
+
+def greedy_reference(params, prompt, n):
+    cache = llama_mod.new_cache(TINY_LLAMA, 1, MAX_SEQ)
+    out, _ = generate_on_device(
+        params, TINY_LLAMA, llama_mod.forward, jnp.asarray(prompt), cache,
+        max_new_tokens=n)
+    return np.asarray(out)
+
+
+def spec(params_t, params_d, prompt, n, gamma=4, stats=None):
+    return speculative_generate(
+        params_t, params_d, TINY_LLAMA, TINY_LLAMA, prompt,
+        family_forward=llama_mod.forward,
+        family_prefill=llama_mod.forward_last_token,
+        new_cache=llama_mod.new_cache,
+        max_new_tokens=n, gamma=gamma, max_seq=MAX_SEQ, stats=stats)
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    return np.arange(1, 13, dtype=np.int32).reshape(1, 12) % TINY_LLAMA.vocab_size
+
+
+def test_self_draft_matches_greedy(prompt):
+    """Draft == target: everything accepted, output exact."""
+    params = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=0)
+    ref = greedy_reference(params, prompt, 24)
+    stats = SpecStats()
+    out = spec(params, params, prompt, 24, gamma=4, stats=stats)
+    np.testing.assert_array_equal(out, ref)
+    # identical draft must accept the gamma-1 cap every round
+    assert stats.mean_accept == 3.0
+
+
+def test_different_draft_still_exact(prompt):
+    """A mismatched draft may be rejected often but NEVER changes output."""
+    target = random_llama_params(TINY_LLAMA, qtype=None, seed=0)
+    draft = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=1)  # unrelated
+    ref = greedy_reference(target, prompt, 20)
+    stats = SpecStats()
+    out = spec(target, draft, prompt, 20, gamma=4, stats=stats)
+    np.testing.assert_array_equal(out, ref)
+    assert stats.rounds >= 1
+
+
+def test_quantized_self_speculation_exact(prompt):
+    """The real self-speculation setup: bf16 target, int4 draft of the
+    same weights — high accept rate, exact output."""
+    target = random_llama_params(TINY_LLAMA, qtype=None, seed=0)
+    draft = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=0)
+    ref = greedy_reference(target, prompt, 24)
+    stats = SpecStats()
+    out = spec(target, draft, prompt, 24, gamma=4, stats=stats)
+    np.testing.assert_array_equal(out, ref)
+    assert stats.mean_accept > 0.5  # same weights -> drafts mostly accepted
+
+
+def test_gamma_variants(prompt):
+    params = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=2)
+    ref = greedy_reference(params, prompt, 16)
+    for gamma in (2, 3, 6):
+        out = spec(params, params, prompt, 16, gamma=gamma)
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_batch_size_guard():
+    params = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=0)
+    with pytest.raises(ValueError, match="batch size 1"):
+        spec(params, params, np.ones((2, 4), np.int32), 8)
