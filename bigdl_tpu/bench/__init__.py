@@ -1,0 +1,2 @@
+from bigdl_tpu.bench.benchmark_util import BenchmarkWrapper  # noqa: F401
+from bigdl_tpu.bench.perplexity import perplexity  # noqa: F401

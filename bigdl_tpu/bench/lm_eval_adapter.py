@@ -1,0 +1,99 @@
+"""lm-evaluation-harness adapter (accuracy benchmarking).
+
+Equivalent of the reference's harness adapter `BigDLLM`
+(dev/benchmark/harness/bigdl_llm.py:38). Gated: lm_eval is optional; the
+loglikelihood core below is also used directly by tests without lm_eval
+installed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bucket(n: int, lo: int = 32) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def sequence_loglikelihood(model: Any, context_ids, continuation_ids
+                           ) -> Tuple[float, bool]:
+    """(sum log p(continuation | context), is_greedy) for one pair.
+
+    Sequences are right-padded to power-of-two buckets so a harness run
+    compiles one forward per bucket, not one per distinct length (causality
+    makes the pad rows inert for the scored positions)."""
+    params, cfg = model.params, model.config
+    fwd = model.family.forward_train
+    ids = np.concatenate([np.asarray(context_ids, np.int32),
+                          np.asarray(continuation_ids, np.int32)])
+    padded = np.zeros((_bucket(len(ids)),), np.int32)
+    padded[: len(ids)] = ids
+    logits = np.asarray(jax.jit(fwd, static_argnums=1)(
+        params, cfg, jnp.asarray(padded[None])))[0][: len(ids)]
+    ll = logits - logits.max(-1, keepdims=True)
+    ll = ll - np.log(np.exp(ll).sum(-1, keepdims=True))
+    nctx = len(context_ids)
+    tgt = ids[nctx:]
+    rows = np.arange(nctx - 1, len(ids) - 1)
+    token_ll = ll[rows, tgt]
+    greedy = bool((logits[rows].argmax(-1) == tgt).all())
+    return float(token_ll.sum()), greedy
+
+
+try:
+    import lm_eval
+    from lm_eval.api.model import LM
+
+    class BigdlTpuLM(LM):
+        """Use as: lm_eval.simple_evaluate(model=BigdlTpuLM(model, tok))."""
+
+        def __init__(self, model: Any, tokenizer: Any, batch_size: int = 1):
+            super().__init__()
+            self.model = model
+            self.tokenizer = tokenizer
+
+        def loglikelihood(self, requests) -> List[Tuple[float, bool]]:
+            out = []
+            for req in requests:
+                ctx, cont = req.args
+                ctx_ids = self.tokenizer(ctx)["input_ids"]
+                cont_ids = self.tokenizer(cont,
+                                          add_special_tokens=False)["input_ids"]
+                out.append(sequence_loglikelihood(self.model, ctx_ids,
+                                                  cont_ids))
+            return out
+
+        def loglikelihood_rolling(self, requests) -> List[float]:
+            out = []
+            for req in requests:
+                (text,) = req.args
+                ids = self.tokenizer(text)["input_ids"]
+                ll, _ = sequence_loglikelihood(self.model, ids[:1], ids[1:])
+                out.append(ll)
+            return out
+
+        def generate_until(self, requests) -> List[str]:
+            out = []
+            for req in requests:
+                ctx, kwargs = req.args
+                ids = self.tokenizer(ctx)["input_ids"]
+                full = self.model.generate(
+                    ids, max_new_tokens=kwargs.get("max_gen_toks", 128))
+                new = full[0][len(ids):]
+                text = self.tokenizer.decode(new, skip_special_tokens=True)
+                for stop in kwargs.get("until", []):
+                    idx = text.find(stop)
+                    if idx >= 0:
+                        text = text[:idx]
+                out.append(text)
+            return out
+
+except ImportError:   # lm_eval not installed: core helpers still usable
+    BigdlTpuLM = None

@@ -1,0 +1,56 @@
+"""Perplexity evaluation over a token stream.
+
+Equivalent of the reference's perplexity runner
+(dev/benchmark/perplexity/ppl.py): strided windows over a long token
+sequence, NLL of each window's non-overlapping tail, exp of the mean.
+Windows are a fixed size so ONE compiled forward serves the whole run.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def perplexity(
+    model_or_parts: Any,
+    token_ids,                     # [N] long token stream
+    window: int = 512,
+    stride: int = 256,
+    max_windows: Optional[int] = None,
+) -> float:
+    """Sliding-window perplexity. Accepts a TpuCausalLM or a
+    (params, cfg, forward_train) triple."""
+    if isinstance(model_or_parts, tuple):
+        params, cfg, fwd = model_or_parts
+    else:
+        m = model_or_parts
+        params, cfg = m.params, m.config
+        fwd = m.family.forward_train
+
+    ids = np.asarray(token_ids, np.int32).reshape(-1)
+    if ids.size < window + 1:
+        raise ValueError(f"need > {window + 1} tokens, got {ids.size}")
+
+    logp = jax.jit(lambda p, t: jax.nn.log_softmax(
+        fwd(p, cfg, t).astype(jnp.float32), axis=-1), static_argnums=())
+
+    total_nll, total_cnt = 0.0, 0
+    starts = range(0, ids.size - window - 1, stride)
+    for wi, s in enumerate(starts):
+        if max_windows is not None and wi >= max_windows:
+            break
+        chunk = ids[s:s + window + 1]
+        inp = jnp.asarray(chunk[None, :-1])
+        ll = np.asarray(logp(params, inp))[0]         # [window, V]
+        targets = chunk[1:]
+        nll = -ll[np.arange(window), targets]
+        # only score the non-overlapping tail (first window scores all)
+        score_from = 0 if s == 0 else window - stride
+        total_nll += float(nll[score_from:].sum())
+        total_cnt += window - score_from
+    return math.exp(total_nll / max(total_cnt, 1))

@@ -1,0 +1,101 @@
+"""All-in-one benchmark runner: config-driven latency sweeps.
+
+Equivalent of the reference's `dev/benchmark/all-in-one/run.py:66-124`
+(YAML config with repo_id matrix, in_out_pairs like "1024-128", test_api
+selection, CSV output). Differences: APIs here are the TPU framework's own
+paths, and results also land as one JSON line per run for machine
+consumption.
+
+Config (YAML or JSON):
+    model_paths: [/path/to/llama-2-7b]    # HF dir, low-bit dir, or .gguf
+    low_bit: sym_int4
+    in_out_pairs: ["32-32", "1024-128"]
+    test_api: transformers_int4           # | speculative
+    num_trials: 3
+    warm_up: 1
+Output: CSV-ish stdout table + list of result dicts.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from bigdl_tpu.bench.benchmark_util import BenchmarkWrapper
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    text = open(path).read()
+    if path.endswith(".json"):
+        return json.loads(text)
+    import yaml
+
+    return yaml.safe_load(text)
+
+
+def run_one(model_path: str, low_bit: str, in_len: int, out_len: int,
+            api: str, num_trials: int, warm_up: int) -> Dict[str, Any]:
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    max_seq = 1 << (in_len + out_len + 8 - 1).bit_length()
+    model = AutoModelForCausalLM.from_pretrained(
+        model_path, load_in_low_bit=low_bit,
+        max_seq=max_seq, speculative=(api == "speculative"))
+    bench = BenchmarkWrapper(model)
+    vocab = model.config.vocab_size
+    prompt = (np.arange(1, in_len + 1, dtype=np.int32) * 977) % vocab
+
+    firsts, rests = [], []
+    for trial in range(warm_up + num_trials):
+        t0 = time.perf_counter()
+        bench.generate(prompt, max_new_tokens=out_len)
+        wall = time.perf_counter() - t0
+        res = bench.results[-1]
+        if trial >= warm_up:
+            firsts.append(res.first_cost)
+            rests.append(res.rest_cost_mean)
+    return {
+        "model": model_path,
+        "low_bit": low_bit,
+        "api": api,
+        "in_out": f"{in_len}-{out_len}",
+        "first_token_ms": round(min(firsts) * 1e3, 3),
+        "rest_token_ms": round(min(rests) * 1e3, 3),
+        "peak_memory": bench.results[-1].peak_memory,
+    }
+
+
+def run(config: Dict[str, Any]) -> List[Dict[str, Any]]:
+    rows = []
+    for model_path in config["model_paths"]:
+        for pair in config.get("in_out_pairs", ["32-32"]):
+            in_len, out_len = (int(x) for x in pair.split("-"))
+            row = run_one(
+                model_path,
+                config.get("low_bit", "sym_int4"),
+                in_len, out_len,
+                config.get("test_api", "transformers_int4"),
+                int(config.get("num_trials", 3)),
+                int(config.get("warm_up", 1)),
+            )
+            print(json.dumps(row))
+            rows.append(row)
+    return rows
+
+
+def main() -> None:
+    cfg_path = sys.argv[1] if len(sys.argv) > 1 else "config.yaml"
+    rows = run(load_config(cfg_path))
+    if rows:
+        cols = list(rows[0].keys())
+        print(",".join(cols))
+        for r in rows:
+            print(",".join(str(r[c]) for c in cols))
+
+
+if __name__ == "__main__":
+    main()

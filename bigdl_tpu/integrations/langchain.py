@@ -1,0 +1,100 @@
+"""LangChain integration: LLM + embeddings wrappers.
+
+Equivalent of the reference's langchain package (reference
+langchain/llms/bigdlllm.py `TransformersLLM`, langchain/embeddings/
+bigdlllm.py `TransformersEmbeddings`; SURVEY.md §2). langchain is optional:
+the `TpuLLMCore` below is dependency-free and the LangChain classes are
+thin shells over it, generated only when langchain is importable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+
+class TpuLLMCore:
+    """Framework-only text-in/text-out core shared by the integrations."""
+
+    def __init__(self, model_path: str, low_bit: str = "sym_int4",
+                 max_seq: int = 2048, **model_kwargs: Any):
+        from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+        self.model = AutoModelForCausalLM.from_pretrained(
+            model_path, load_in_low_bit=low_bit, max_seq=max_seq,
+            **model_kwargs)
+        from transformers import AutoTokenizer
+
+        self.tokenizer = AutoTokenizer.from_pretrained(model_path)
+
+    def complete(self, prompt: str, max_new_tokens: int = 256,
+                 temperature: float = 0.0, stop: Optional[List[str]] = None
+                 ) -> str:
+        ids = self.tokenizer(prompt)["input_ids"]
+        out = self.model.generate(
+            ids, max_new_tokens=max_new_tokens,
+            do_sample=temperature > 0, temperature=temperature)
+        text = self.tokenizer.decode(out[0][len(ids):],
+                                     skip_special_tokens=True)
+        for s in stop or []:
+            idx = text.find(s)
+            if idx >= 0:
+                text = text[:idx]
+        return text
+
+    def embed(self, texts: List[str]) -> List[List[float]]:
+        """Mean-pooled token embeddings: hidden_size-dimensional vectors
+        from the model's embedding table (the reference's transformers
+        embeddings similarly pool model representations)."""
+        m = self.model
+        table = np.asarray(m.params["embed_tokens"], np.float32)
+        outs = []
+        for t in texts:
+            ids = np.asarray(self.tokenizer(t)["input_ids"], np.int32)
+            vec = table[ids].mean(axis=0)
+            outs.append(vec.astype(np.float32).tolist())
+        return outs
+
+
+def _make_langchain_classes():
+    from langchain_core.embeddings import Embeddings
+    from langchain_core.language_models.llms import LLM
+
+    class TransformersLLM(LLM):
+        """LangChain LLM over bigdl_tpu (reference TransformersLLM)."""
+        core: Any = None
+
+        @classmethod
+        def from_model_id(cls, model_id: str, model_kwargs=None, **kw):
+            return cls(core=TpuLLMCore(model_id, **(model_kwargs or {})),
+                       **kw)
+
+        @property
+        def _llm_type(self) -> str:
+            return "bigdl-tpu"
+
+        def _call(self, prompt: str, stop=None, run_manager=None, **kw):
+            return self.core.complete(prompt, stop=stop, **kw)
+
+    class TransformersEmbeddings(Embeddings):
+        def __init__(self, core: TpuLLMCore):
+            self.core = core
+
+        @classmethod
+        def from_model_id(cls, model_id: str, **kw):
+            return cls(TpuLLMCore(model_id, **kw))
+
+        def embed_documents(self, texts: List[str]) -> List[List[float]]:
+            return self.core.embed(texts)
+
+        def embed_query(self, text: str) -> List[float]:
+            return self.core.embed([text])[0]
+
+    return TransformersLLM, TransformersEmbeddings
+
+
+try:
+    TransformersLLM, TransformersEmbeddings = _make_langchain_classes()
+except ImportError:
+    TransformersLLM = TransformersEmbeddings = None

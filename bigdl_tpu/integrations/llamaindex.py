@@ -1,0 +1,60 @@
+"""LlamaIndex integration.
+
+Equivalent of the reference's `BigdlLLM` llama-index class (reference
+llamaindex/llms/bigdlllm.py:1-467). llama-index is optional; the class is
+defined only when importable, over the same TpuLLMCore as langchain.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from bigdl_tpu.integrations.langchain import TpuLLMCore
+
+
+def _make_llamaindex_class():
+    from llama_index.core.llms import (CompletionResponse, CompletionResponseGen,
+                                       CustomLLM, LLMMetadata)
+    from llama_index.core.llms.callbacks import llm_completion_callback
+
+    class BigdlTpuLLM(CustomLLM):
+        """llama-index LLM over bigdl_tpu."""
+        core: Any = None
+        context_window: int = 2048
+        num_output: int = 256
+
+        @classmethod
+        def from_model_id(cls, model_id: str, **kw):
+            return cls(core=TpuLLMCore(model_id), **kw)
+
+        @property
+        def metadata(self) -> LLMMetadata:
+            return LLMMetadata(context_window=self.context_window,
+                               num_output=self.num_output,
+                               model_name="bigdl-tpu")
+
+        @llm_completion_callback()
+        def complete(self, prompt: str, **kw) -> CompletionResponse:
+            return CompletionResponse(
+                text=self.core.complete(prompt,
+                                        max_new_tokens=self.num_output))
+
+        @llm_completion_callback()
+        def stream_complete(self, prompt: str, **kw) -> CompletionResponseGen:
+            text = self.core.complete(prompt, max_new_tokens=self.num_output)
+
+            def gen():
+                acc = ""
+                for ch in text:
+                    acc += ch
+                    yield CompletionResponse(text=acc, delta=ch)
+
+            return gen()
+
+    return BigdlTpuLLM
+
+
+try:
+    BigdlTpuLLM = _make_llamaindex_class()
+except ImportError:
+    BigdlTpuLLM = None

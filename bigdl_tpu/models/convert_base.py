@@ -1,0 +1,120 @@
+"""Shared checkpoint-conversion scaffolding.
+
+One conversion engine for every family (used by models/llama.py and
+models/families.py): per-layer accumulation + leading-L stacking, linear
+quantization gating, missing-tensor validation, tied-embedding handling,
+and fused-QKV de-interleave helpers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Acc:
+    """Accumulates per-layer leaves and stacks them along L."""
+
+    def __init__(self, cfg, qtype, compute_dtype, modules_to_not_convert):
+        from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize_linear
+
+        self.cfg = cfg
+        self.L = cfg.num_hidden_layers
+        self.compute_dtype = compute_dtype
+        self.do_quant = qtype is not None and qtype not in FLOAT_QTYPES
+        self.qtype = qtype
+        self.skip = modules_to_not_convert
+        self._quantize_linear = quantize_linear
+        self.layers: Dict[str, list] = {}
+        self.top: Dict[str, Any] = {}
+
+    def linear(self, name: str, w: np.ndarray):
+        """HF [out, in] -> contraction-major leaf (QTensor or dense).
+
+        Quantization prefers the native C++ kernels (bigdl_tpu.native, the
+        quantize-llama-binary equivalent) — bit-identical to the JAX path,
+        which remains the fallback."""
+        if self.do_quant and not any(m in name for m in self.skip):
+            from bigdl_tpu.native import quantize_native
+            from bigdl_tpu.ops.quant import QTensor
+
+            wt = np.ascontiguousarray(np.asarray(w).T, np.float32)
+            native = quantize_native(wt, self.qtype)
+            if native is not None:
+                data, scale = native
+                return QTensor(jnp.asarray(data),
+                               jnp.asarray(scale).astype(jnp.bfloat16),
+                               None, self.qtype, wt.shape)
+            return self._quantize_linear(jnp.asarray(np.asarray(w)),
+                                         self.qtype)
+        return jnp.asarray(np.asarray(w)).T.astype(self.compute_dtype)
+
+    def dense(self, w) -> jax.Array:
+        return jnp.asarray(np.asarray(w)).astype(self.compute_dtype)
+
+    def put(self, key: str, idx: int, val):
+        self.layers.setdefault(key, [None] * self.L)[idx] = val
+
+    def finish(self, tie: bool) -> Dict[str, Any]:
+        missing = [k for k, v in self.layers.items()
+                   if any(x is None for x in v)]
+        if missing:
+            raise ValueError(f"checkpoint missing layer tensors: {missing}")
+        params = dict(self.top)
+        params["layers"] = {
+            k: jax.tree.map(lambda *xs: jnp.stack(xs), *v)
+            for k, v in self.layers.items()
+        }
+        if tie:
+            params.pop("lm_head", None)
+        elif "lm_head" not in params:
+            raise ValueError("checkpoint has no lm_head and embeddings are "
+                             "not tied")
+        return params
+
+
+def make_convert(map_tensor: Callable) -> Callable:
+    """Build a convert_hf_params from a per-tensor mapping callback.
+
+    map_tensor(acc, name, w) handles one HF tensor (calls acc.put /
+    acc.top). Unknown tensors are ignored (rotary inv_freq etc.)."""
+
+    def convert(tensors, cfg, qtype="sym_int4", compute_dtype=jnp.bfloat16,
+                modules_to_not_convert: Tuple[str, ...] = ()):
+        acc = Acc(cfg, qtype, compute_dtype, modules_to_not_convert)
+        for name, w in tensors:
+            map_tensor(acc, name, np.asarray(w))
+        return acc.finish(cfg.tie_word_embeddings)
+
+    return convert
+
+
+def split_rows(w: np.ndarray, sizes) -> list:
+    """Split an HF [out, in] fused weight along out into len(sizes) parts."""
+    out = []
+    off = 0
+    for s in sizes:
+        out.append(w[off:off + s])
+        off += s
+    return out
+
+
+def deinterleave_qkv(w: np.ndarray, heads: int, hd: int):
+    """gptneox/bloom fused qkv [(H*3*hd), in] with per-head (h, 3, hd)
+    layout -> (q, k, v) each [H*hd, in]. Works for bias ([H*3*hd])."""
+    lead = w.shape[1:] if w.ndim > 1 else ()
+    w = w.reshape(heads, 3, hd, *lead)
+    q, k, v = w[:, 0], w[:, 1], w[:, 2]
+    flat = lambda x: x.reshape(heads * hd, *lead)
+    return flat(q), flat(k), flat(v)
+
+
+def layer_idx(name: str, prefix: str) -> Optional[Tuple[int, str]]:
+    if not name.startswith(prefix):
+        return None
+    rest = name[len(prefix):]
+    idx_s, _, sub = rest.partition(".")
+    return int(idx_s), sub

@@ -1,0 +1,572 @@
+"""Family adapters: config deltas + checkpoint converters over the
+generalized decoder (models/llama.py).
+
+The reference ships a 400-line monkey-patched forward per family
+(transformers/models/{gemma,phi,gptneox,bloom,falcon,starcoder2,baichuan,
+chatglm2}.py — SURVEY.md §2, 30 files / 12.4k LoC). Here each family is a
+LlamaConfig delta plus an HF-tensor-name mapping; the model body is the one
+scan-based decoder. Fused QKV layouts (gptneox/bloom per-head interleave,
+falcon MQA block, baichuan W_pack, chatglm2 grouped) are de-interleaved at
+conversion time so the runtime never special-cases them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models.llama import LlamaConfig
+from bigdl_tpu.models import llama as llama_mod
+# NOTE: bigdl_tpu.models.registry is imported lazily inside register_all()
+# to keep `import bigdl_tpu.models.families` free of an import cycle
+# (registry's builtin registration imports this module).
+from bigdl_tpu.models.convert_base import (Acc as _Acc, make_convert as
+    _make_convert, split_rows as _split_rows, deinterleave_qkv as
+    _deinterleave_qkv, layer_idx as _layer_idx)
+
+
+# ---------------------------------------------------------------------------
+# Gemma — llama-shaped with scaled embeddings and (1+w) RMSNorm
+# (reference transformers/models/gemma.py)
+# ---------------------------------------------------------------------------
+
+def _gemma_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    import dataclasses
+
+    base = LlamaConfig.from_hf(hf)
+    return dataclasses.replace(
+        base,
+        head_dim=hf.get("head_dim", 256),
+        rms_weight_offset=1.0,
+        hidden_act="gelu_tanh",
+        embed_scale=math.sqrt(hf["hidden_size"]),
+        tie_word_embeddings=hf.get("tie_word_embeddings", True),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Phi (phi-1/1.5/2) — parallel residual, shared LN, dense gelu MLP,
+# partial rotary, biases everywhere (reference models/phixtral.py kin)
+# ---------------------------------------------------------------------------
+
+def _phi_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    hd = hf["hidden_size"] // hf["num_attention_heads"]
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_hidden_layers=hf["num_hidden_layers"],
+        num_attention_heads=hf["num_attention_heads"],
+        num_key_value_heads=hf.get("num_key_value_heads") or
+        hf["num_attention_heads"],
+        rms_norm_eps=hf.get("layer_norm_eps", 1e-5),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        max_position_embeddings=hf.get("max_position_embeddings", 2048),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        attention_bias=True,
+        norm_type="layernorm",
+        parallel_residual=True,
+        shared_input_norm=True,
+        mlp_gated=False,
+        hidden_act="gelu_tanh",
+        rotary_dim=int(hf.get("partial_rotary_factor", 0.5) * hd),
+        lm_head_bias=True,
+    )
+
+
+def _phi_map(acc: _Acc, name: str, w) -> None:
+    if name == "model.embed_tokens.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name == "model.final_layernorm.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name == "model.final_layernorm.bias":
+        acc.top["norm_bias"] = acc.dense(w)
+    elif name == "lm_head.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    elif name == "lm_head.bias":
+        acc.top["lm_head_bias"] = acc.dense(w)
+    else:
+        hit = _layer_idx(name, "model.layers.")
+        if hit is None:
+            return
+        idx, sub = hit
+        m = {
+            "self_attn.q_proj.weight": ("q_proj", "linear"),
+            "self_attn.k_proj.weight": ("k_proj", "linear"),
+            "self_attn.v_proj.weight": ("v_proj", "linear"),
+            "self_attn.dense.weight": ("o_proj", "linear"),
+            "mlp.fc1.weight": ("up_proj", "linear"),
+            "mlp.fc2.weight": ("down_proj", "linear"),
+            "self_attn.q_proj.bias": ("q_proj_bias", "dense"),
+            "self_attn.k_proj.bias": ("k_proj_bias", "dense"),
+            "self_attn.v_proj.bias": ("v_proj_bias", "dense"),
+            "self_attn.dense.bias": ("o_proj_bias", "dense"),
+            "mlp.fc1.bias": ("up_proj_bias", "dense"),
+            "mlp.fc2.bias": ("down_proj_bias", "dense"),
+            "input_layernorm.weight": ("input_layernorm", "dense"),
+            "input_layernorm.bias": ("input_layernorm_bias", "dense"),
+        }.get(sub)
+        if m:
+            key, kind = m
+            acc.put(key, idx,
+                    acc.linear(name, w) if kind == "linear" else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
+# GPT-NeoX — parallel residual (two LNs), fused per-head QKV, partial rotary
+# (reference transformers/models/gptneox.py)
+# ---------------------------------------------------------------------------
+
+def _gptneox_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    hd = hf["hidden_size"] // hf["num_attention_heads"]
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_hidden_layers=hf["num_hidden_layers"],
+        num_attention_heads=hf["num_attention_heads"],
+        num_key_value_heads=hf["num_attention_heads"],
+        rms_norm_eps=hf.get("layer_norm_eps", 1e-5),
+        rope_theta=hf.get("rotary_emb_base", hf.get("rope_theta", 10000.0)),
+        max_position_embeddings=hf.get("max_position_embeddings", 2048),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        attention_bias=True,
+        norm_type="layernorm",
+        parallel_residual=hf.get("use_parallel_residual", True),
+        mlp_gated=False,
+        hidden_act="gelu",
+        rotary_dim=int(hf.get("rotary_pct", 0.25) * hd),
+    )
+
+
+def _gptneox_map(acc: _Acc, name: str, w) -> None:
+    cfg = acc.cfg
+    h, hd = cfg.num_attention_heads, cfg.hd
+    if name == "gpt_neox.embed_in.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name == "gpt_neox.final_layer_norm.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name == "gpt_neox.final_layer_norm.bias":
+        acc.top["norm_bias"] = acc.dense(w)
+    elif name == "embed_out.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    else:
+        hit = _layer_idx(name, "gpt_neox.layers.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub == "attention.query_key_value.weight":
+            q, k, v = _deinterleave_qkv(w, h, hd)
+            acc.put("q_proj", idx, acc.linear(name, q))
+            acc.put("k_proj", idx, acc.linear(name, k))
+            acc.put("v_proj", idx, acc.linear(name, v))
+        elif sub == "attention.query_key_value.bias":
+            q, k, v = _deinterleave_qkv(w, h, hd)
+            acc.put("q_proj_bias", idx, acc.dense(q))
+            acc.put("k_proj_bias", idx, acc.dense(k))
+            acc.put("v_proj_bias", idx, acc.dense(v))
+        else:
+            m = {
+                "attention.dense.weight": ("o_proj", "linear"),
+                "attention.dense.bias": ("o_proj_bias", "dense"),
+                "mlp.dense_h_to_4h.weight": ("up_proj", "linear"),
+                "mlp.dense_h_to_4h.bias": ("up_proj_bias", "dense"),
+                "mlp.dense_4h_to_h.weight": ("down_proj", "linear"),
+                "mlp.dense_4h_to_h.bias": ("down_proj_bias", "dense"),
+                "input_layernorm.weight": ("input_layernorm", "dense"),
+                "input_layernorm.bias": ("input_layernorm_bias", "dense"),
+                "post_attention_layernorm.weight":
+                    ("post_attention_layernorm", "dense"),
+                "post_attention_layernorm.bias":
+                    ("post_attention_layernorm_bias", "dense"),
+            }.get(sub)
+            if m:
+                key, kind = m
+                acc.put(key, idx, acc.linear(name, w) if kind == "linear"
+                        else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
+# Bloom — ALiBi, embedding LN, fused per-head QKV, dense gelu MLP
+# (reference transformers/models/bloom.py + ggml/model/bloom native engine)
+# ---------------------------------------------------------------------------
+
+def _bloom_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    h = hf.get("n_head", hf.get("num_attention_heads"))
+    d = hf.get("hidden_size", hf.get("n_embed"))
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=d,
+        intermediate_size=4 * d,
+        num_hidden_layers=hf.get("n_layer", hf.get("num_hidden_layers")),
+        num_attention_heads=h,
+        num_key_value_heads=h,
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        tie_word_embeddings=True,
+        attention_bias=True,
+        norm_type="layernorm",
+        mlp_gated=False,
+        hidden_act="gelu_tanh",
+        use_rope=False,
+        use_alibi=True,
+        embed_norm=True,
+    )
+
+
+def _bloom_map(acc: _Acc, name: str, w) -> None:
+    cfg = acc.cfg
+    h, hd = cfg.num_attention_heads, cfg.hd
+    if name.startswith("transformer."):
+        name_ = name[len("transformer."):]
+    else:
+        name_ = name
+    if name_ == "word_embeddings.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name_ == "word_embeddings_layernorm.weight":
+        acc.top["embed_norm"] = acc.dense(w)
+    elif name_ == "word_embeddings_layernorm.bias":
+        acc.top["embed_norm_bias"] = acc.dense(w)
+    elif name_ == "ln_f.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name_ == "ln_f.bias":
+        acc.top["norm_bias"] = acc.dense(w)
+    else:
+        hit = _layer_idx(name_, "h.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub == "self_attention.query_key_value.weight":
+            q, k, v = _deinterleave_qkv(w, h, hd)
+            acc.put("q_proj", idx, acc.linear(name, q))
+            acc.put("k_proj", idx, acc.linear(name, k))
+            acc.put("v_proj", idx, acc.linear(name, v))
+        elif sub == "self_attention.query_key_value.bias":
+            q, k, v = _deinterleave_qkv(w, h, hd)
+            acc.put("q_proj_bias", idx, acc.dense(q))
+            acc.put("k_proj_bias", idx, acc.dense(k))
+            acc.put("v_proj_bias", idx, acc.dense(v))
+        else:
+            m = {
+                "self_attention.dense.weight": ("o_proj", "linear"),
+                "self_attention.dense.bias": ("o_proj_bias", "dense"),
+                "mlp.dense_h_to_4h.weight": ("up_proj", "linear"),
+                "mlp.dense_h_to_4h.bias": ("up_proj_bias", "dense"),
+                "mlp.dense_4h_to_h.weight": ("down_proj", "linear"),
+                "mlp.dense_4h_to_h.bias": ("down_proj_bias", "dense"),
+                "input_layernorm.weight": ("input_layernorm", "dense"),
+                "input_layernorm.bias": ("input_layernorm_bias", "dense"),
+                "post_attention_layernorm.weight":
+                    ("post_attention_layernorm", "dense"),
+                "post_attention_layernorm.bias":
+                    ("post_attention_layernorm_bias", "dense"),
+            }.get(sub)
+            if m:
+                key, kind = m
+                acc.put(key, idx, acc.linear(name, w) if kind == "linear"
+                        else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
+# Falcon (7b-style: multi_query + parallel_attn + single LN)
+# (reference transformers/models/falcon.py)
+# ---------------------------------------------------------------------------
+
+def _falcon_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    h = hf.get("num_attention_heads", hf.get("n_head"))
+    d = hf["hidden_size"]
+    if hf.get("new_decoder_architecture"):
+        raise NotImplementedError(
+            "falcon new_decoder_architecture (40b/180b) conversion not "
+            "supported yet; falcon-7b-style checkpoints only")
+    hkv = 1 if hf.get("multi_query", True) else h
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=d,
+        intermediate_size=4 * d,
+        num_hidden_layers=hf.get("num_hidden_layers", hf.get("n_layer")),
+        num_attention_heads=h,
+        num_key_value_heads=hkv,
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        max_position_embeddings=hf.get("max_position_embeddings", 2048),
+        tie_word_embeddings=hf.get("tie_word_embeddings", True),
+        attention_bias=bool(hf.get("bias", False)),
+        norm_type="layernorm",
+        parallel_residual=bool(hf.get("parallel_attn", True)),
+        shared_input_norm=True,
+        mlp_gated=False,
+        hidden_act="gelu",
+    )
+
+
+def _falcon_map(acc: _Acc, name: str, w) -> None:
+    cfg = acc.cfg
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    name_ = name[len("transformer."):] if name.startswith("transformer.") \
+        else name
+    if name_ == "word_embeddings.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name_ == "ln_f.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name_ == "ln_f.bias":
+        acc.top["norm_bias"] = acc.dense(w)
+    elif name_ == "lm_head.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    else:
+        hit = _layer_idx(name_, "h.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub == "self_attention.query_key_value.weight":
+            q, k, v = _split_rows(w, [h * hd, hkv * hd, hkv * hd])
+            acc.put("q_proj", idx, acc.linear(name, q))
+            acc.put("k_proj", idx, acc.linear(name, k))
+            acc.put("v_proj", idx, acc.linear(name, v))
+        else:
+            m = {
+                "self_attention.dense.weight": ("o_proj", "linear"),
+                "mlp.dense_h_to_4h.weight": ("up_proj", "linear"),
+                "mlp.dense_4h_to_h.weight": ("down_proj", "linear"),
+                "input_layernorm.weight": ("input_layernorm", "dense"),
+                "input_layernorm.bias": ("input_layernorm_bias", "dense"),
+            }.get(sub)
+            if m:
+                key, kind = m
+                acc.put(key, idx, acc.linear(name, w) if kind == "linear"
+                        else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
+# Starcoder2 — LN + dense gelu MLP + GQA + rope
+# (reference transformers/models/starcoder2.py)
+# ---------------------------------------------------------------------------
+
+def _starcoder2_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_hidden_layers=hf["num_hidden_layers"],
+        num_attention_heads=hf["num_attention_heads"],
+        num_key_value_heads=hf.get("num_key_value_heads", 4),
+        rms_norm_eps=hf.get("norm_epsilon", 1e-5),
+        rope_theta=hf.get("rope_theta", 100000.0),
+        max_position_embeddings=hf.get("max_position_embeddings", 4096),
+        tie_word_embeddings=hf.get("tie_word_embeddings", True),
+        attention_bias=bool(hf.get("use_bias", True)),
+        mlp_bias=bool(hf.get("use_bias", True)),
+        sliding_window=hf.get("sliding_window"),
+        norm_type="layernorm",
+        mlp_gated=False,
+        hidden_act="gelu_tanh",
+    )
+
+
+def _starcoder2_map(acc: _Acc, name: str, w) -> None:
+    if name == "model.embed_tokens.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name == "model.norm.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name == "model.norm.bias":
+        acc.top["norm_bias"] = acc.dense(w)
+    elif name == "lm_head.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    else:
+        hit = _layer_idx(name, "model.layers.")
+        if hit is None:
+            return
+        idx, sub = hit
+        table = {
+            "self_attn.q_proj": "q_proj", "self_attn.k_proj": "k_proj",
+            "self_attn.v_proj": "v_proj", "self_attn.o_proj": "o_proj",
+            "mlp.c_fc": "up_proj", "mlp.c_proj": "down_proj",
+        }
+        base, _, leaf = sub.rpartition(".")
+        if base in table:
+            key = table[base]
+            if leaf == "weight":
+                acc.put(key, idx, acc.linear(name, w))
+            else:
+                acc.put(f"{key}_bias", idx, acc.dense(w))
+        elif sub in ("input_layernorm.weight",
+                     "post_attention_layernorm.weight"):
+            acc.put(sub[:-len(".weight")], idx, acc.dense(w))
+        elif sub in ("input_layernorm.bias",
+                     "post_attention_layernorm.bias"):
+            acc.put(sub.replace(".bias", "_bias"), idx, acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
+# Baichuan (7B rope / 13B alibi, W_pack fused QKV, baichuan2 NormHead)
+# (reference transformers/models/baichuan.py + baichuan2)
+# ---------------------------------------------------------------------------
+
+def _baichuan_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    import dataclasses
+
+    base = LlamaConfig.from_hf(hf)
+    # 13B has no rope: HF config carries no explicit flag; the 13B shape
+    # (40 heads / hidden 5120) is the discriminator the reference also
+    # keys on (convert.py picks baichuan_13b forwards by hidden size)
+    if hf["hidden_size"] >= 5120:
+        base = dataclasses.replace(base, use_rope=False, use_alibi=True)
+    return base
+
+
+def _baichuan_map(acc: _Acc, name: str, w) -> None:
+    d = acc.cfg.hidden_size
+    if name == "model.embed_tokens.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name == "model.norm.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name == "lm_head.weight":
+        if acc.cfg.vocab_size > 100000:   # baichuan2 NormHead
+            wn = np.asarray(w, np.float32)
+            wn = wn / (np.linalg.norm(wn, axis=-1, keepdims=True) + 1e-12)
+            w = wn
+        acc.top["lm_head"] = acc.linear(name, w)
+    else:
+        hit = _layer_idx(name, "model.layers.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub == "self_attn.W_pack.weight":
+            q, k, v = _split_rows(w, [d, d, d])
+            acc.put("q_proj", idx, acc.linear(name, q))
+            acc.put("k_proj", idx, acc.linear(name, k))
+            acc.put("v_proj", idx, acc.linear(name, v))
+        else:
+            m = {
+                "self_attn.o_proj.weight": "o_proj",
+                "mlp.gate_proj.weight": "gate_proj",
+                "mlp.up_proj.weight": "up_proj",
+                "mlp.down_proj.weight": "down_proj",
+                "input_layernorm.weight": "input_layernorm",
+                "post_attention_layernorm.weight": "post_attention_layernorm",
+            }.get(sub)
+            if m:
+                is_lin = m.endswith("_proj")
+                acc.put(m, idx,
+                        acc.linear(name, w) if is_lin else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
+# ChatGLM2/3 — RMSNorm, grouped fused QKV+bias, swiglu fused gate|up,
+# interleaved half-dim rotary (reference transformers/models/chatglm2.py)
+# ---------------------------------------------------------------------------
+
+def _chatglm2_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    h = hf["num_attention_heads"]
+    d = hf["hidden_size"]
+    hkv = (hf.get("multi_query_group_num", h)
+           if hf.get("multi_query_attention") else h)
+    return LlamaConfig(
+        vocab_size=hf.get("padded_vocab_size", hf.get("vocab_size", 65024)),
+        hidden_size=d,
+        intermediate_size=hf["ffn_hidden_size"],
+        num_hidden_layers=hf["num_layers"],
+        num_attention_heads=h,
+        num_key_value_heads=hkv,
+        rms_norm_eps=hf.get("layernorm_epsilon", 1e-5),
+        rope_theta=10000.0 * hf.get("rope_ratio", 1.0),
+        max_position_embeddings=hf.get("seq_length", 32768),
+        tie_word_embeddings=False,
+        attention_bias=bool(hf.get("add_qkv_bias", True)),
+        norm_type="rmsnorm" if hf.get("rmsnorm", True) else "layernorm",
+        hidden_act="silu",
+        mlp_gated=True,
+        rope_interleaved=True,
+        rotary_dim=(d // h) // 2,
+    )
+
+
+def _chatglm2_map(acc: _Acc, name: str, w) -> None:
+    cfg = acc.cfg
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    ff = cfg.intermediate_size
+    if name == "transformer.embedding.word_embeddings.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name == "transformer.encoder.final_layernorm.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name == "transformer.output_layer.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    else:
+        hit = _layer_idx(name, "transformer.encoder.layers.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub == "self_attention.query_key_value.weight":
+            q, k, v = _split_rows(w, [h * hd, hkv * hd, hkv * hd])
+            acc.put("q_proj", idx, acc.linear(name, q))
+            acc.put("k_proj", idx, acc.linear(name, k))
+            acc.put("v_proj", idx, acc.linear(name, v))
+        elif sub == "self_attention.query_key_value.bias":
+            q, k, v = _split_rows(w, [h * hd, hkv * hd, hkv * hd])
+            acc.put("q_proj_bias", idx, acc.dense(q))
+            acc.put("k_proj_bias", idx, acc.dense(k))
+            acc.put("v_proj_bias", idx, acc.dense(v))
+        elif sub == "mlp.dense_h_to_4h.weight":
+            gate, up = _split_rows(w, [ff, ff])
+            acc.put("gate_proj", idx, acc.linear(name, gate))
+            acc.put("up_proj", idx, acc.linear(name, up))
+        else:
+            m = {
+                "self_attention.dense.weight": "o_proj",
+                "mlp.dense_4h_to_h.weight": "down_proj",
+                "input_layernorm.weight": "input_layernorm",
+                "post_attention_layernorm.weight": "post_attention_layernorm",
+            }.get(sub)
+            if m:
+                is_lin = m in ("o_proj", "down_proj")
+                acc.put(m, idx,
+                        acc.linear(name, w) if is_lin else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
+# Registration
+# ---------------------------------------------------------------------------
+
+def _adapter(name: str, cfg_fn, map_fn):
+    from bigdl_tpu.models.registry import FamilyAdapter
+
+    return FamilyAdapter(
+        name=name,
+        config_from_hf=cfg_fn,
+        convert_params=_make_convert(map_fn),
+        forward=llama_mod.forward,
+        prefill=llama_mod.forward_last_token,
+        forward_train=llama_mod.forward_train,
+        new_cache=llama_mod.new_cache,
+    )
+
+
+def register_all() -> None:
+    from bigdl_tpu.models.llama import convert_hf_params as llama_convert
+    from bigdl_tpu.models.registry import FamilyAdapter, register_family
+
+    register_family(["GemmaForCausalLM"], FamilyAdapter(
+        name="gemma",
+        config_from_hf=_gemma_cfg,
+        convert_params=llama_convert,     # same tensor names as llama
+        forward=llama_mod.forward,
+        prefill=llama_mod.forward_last_token,
+        forward_train=llama_mod.forward_train,
+        new_cache=llama_mod.new_cache,
+    ))
+    register_family(["PhiForCausalLM"], _adapter("phi", _phi_cfg, _phi_map))
+    register_family(["GPTNeoXForCausalLM"],
+                    _adapter("gptneox", _gptneox_cfg, _gptneox_map))
+    register_family(["BloomForCausalLM", "BloomModel"],
+                    _adapter("bloom", _bloom_cfg, _bloom_map))
+    register_family(["FalconForCausalLM", "RWForCausalLM"],
+                    _adapter("falcon", _falcon_cfg, _falcon_map))
+    register_family(["Starcoder2ForCausalLM"],
+                    _adapter("starcoder2", _starcoder2_cfg, _starcoder2_map))
+    register_family(["BaichuanForCausalLM", "BaiChuanForCausalLM"],
+                    _adapter("baichuan", _baichuan_cfg, _baichuan_map))
+    register_family(["ChatGLMModel", "ChatGLMForConditionalGeneration"],
+                    _adapter("chatglm", _chatglm2_cfg, _chatglm2_map))

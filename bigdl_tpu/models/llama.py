@@ -31,15 +31,38 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+import functools
+
 from bigdl_tpu.ops.attention import sdp_attention
 from bigdl_tpu.ops.kvcache import KVCache, init_cache, read_layer, update_layer
 from bigdl_tpu.ops.matmul import linear
-from bigdl_tpu.ops.norms import rms_norm
+from bigdl_tpu.ops.norms import layer_norm, rms_norm
 from bigdl_tpu.ops.rope import apply_rope, rope_cos_sin, rope_freqs
+
+
+def _lm_head(x, params, cfg):
+    """Final projection (tied or separate), f32 logits, optional softcap."""
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        logits = jnp.dot(x, params["embed_tokens"].T.astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+    else:
+        logits = linear(x, lm_head, params.get("lm_head_bias"))
+    logits = logits.astype(jnp.float32)
+    if cfg.logits_soft_cap is not None:
+        logits = jnp.tanh(logits / cfg.logits_soft_cap) * cfg.logits_soft_cap
+    return logits
 
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
+    """Config for the generalized decoder module.
+
+    The base fields describe llama; the knobs below let one scan-based code
+    path serve the reference's other monkey-patched families (SURVEY.md §2:
+    transformers/models/{gptneox,bloom,falcon,phi,gemma,starcoder2,...}.py)
+    as config deltas instead of 400-line forks.
+    """
     vocab_size: int = 32000
     hidden_size: int = 4096
     intermediate_size: int = 11008
@@ -55,6 +78,22 @@ class LlamaConfig:
     attention_bias: bool = False
     mlp_bias: bool = False
     sliding_window: Optional[int] = None
+    # --- family knobs ---
+    norm_type: str = "rmsnorm"          # "rmsnorm" | "layernorm"
+    rms_weight_offset: float = 0.0      # gemma: y * (offset + w)
+    hidden_act: str = "silu"            # "silu" | "gelu" | "gelu_tanh"
+    mlp_gated: bool = True              # False: dense 2-proj (up/down) MLP
+    rope_interleaved: bool = False      # gptj/chatglm rotation convention
+    rotary_dim: Optional[int] = None    # partial rotary (gptneox/phi)
+    use_rope: bool = True               # False for alibi families
+    parallel_residual: bool = False     # x + attn(n1(x)) + mlp(n2(x))
+    shared_input_norm: bool = False     # phi/falcon-7b: mlp reuses n1(x)
+    use_alibi: bool = False             # bloom/baichuan-13b
+    embed_scale: float = 1.0            # gemma: sqrt(hidden_size)
+    embed_norm: bool = False            # bloom: LN right after embedding
+    logits_soft_cap: Optional[float] = None   # gemma2 final logits
+    attn_soft_cap: Optional[float] = None     # gemma2 attention scores
+    lm_head_bias: bool = False          # phi
 
     @property
     def hd(self) -> int:
@@ -108,37 +147,110 @@ class LlamaConfig:
 # }
 
 
-def _layer_step(cfg: LlamaConfig, carry, xs):
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Standard ALiBi slope schedule (bloom/baichuan-13b families)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+
+    if np.log2(n_heads).is_integer():
+        return pow2_slopes(n_heads).astype(np.float32)
+    closest = 2 ** int(np.floor(np.log2(n_heads)))
+    base = pow2_slopes(closest)
+    extra = pow2_slopes(2 * closest)[0::2][: n_heads - closest]
+    return np.concatenate([base, extra]).astype(np.float32)
+
+
+def _norm(x, w, b, cfg: LlamaConfig):
+    if cfg.norm_type == "layernorm":
+        return layer_norm(x, w, b, cfg.rms_norm_eps)
+    if cfg.rms_weight_offset:
+        w = w.astype(jnp.float32) + cfg.rms_weight_offset
+    return rms_norm(x, w, cfg.rms_norm_eps)
+
+
+_ACTS = {
+    "silu": jax.nn.silu,
+    "gelu": functools.partial(jax.nn.gelu, approximate=False),
+    "gelu_tanh": functools.partial(jax.nn.gelu, approximate=True),
+    "gelu_new": functools.partial(jax.nn.gelu, approximate=True),
+    "gelu_pytorch_tanh": functools.partial(jax.nn.gelu, approximate=True),
+    "relu": jax.nn.relu,
+}
+
+
+def _mlp(hidden, lp, cfg: LlamaConfig):
+    act = _ACTS[cfg.hidden_act]
+    if cfg.mlp_gated:
+        gate = linear(hidden, lp["gate_proj"], lp.get("gate_proj_bias"))
+        up = linear(hidden, lp["up_proj"], lp.get("up_proj_bias"))
+        inner = act(gate) * up
+    else:
+        inner = act(linear(hidden, lp["up_proj"], lp.get("up_proj_bias")))
+    return linear(inner, lp["down_proj"], lp.get("down_proj_bias"))
+
+
+def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
+                cache_ctx=None):
+    """QKV + rope + (cached) attention + output projection."""
+    b, sq, _ = hidden.shape
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias")).reshape(
+        b, sq, h, hd)
+    k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias")).reshape(
+        b, sq, hkv, hd)
+    v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias")).reshape(
+        b, sq, hkv, hd)
+    if cfg.use_rope:
+        q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
+        k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
+
+    if cache_ctx is not None:
+        ck, cv, lidx, pos = cache_ctx
+        ck, cv = update_layer(ck, cv, lidx, k, v, pos)
+        kf, vf = read_layer(ck, cv, lidx)
+        attn = sdp_attention(q, kf, vf, pos,
+                             sliding_window=cfg.sliding_window,
+                             logits_soft_cap=cfg.attn_soft_cap,
+                             alibi_slopes=slopes)
+        out = (ck, cv)
+    else:
+        attn = sdp_attention(q, k, v, jnp.zeros((), jnp.int32),
+                             sliding_window=cfg.sliding_window,
+                             logits_soft_cap=cfg.attn_soft_cap,
+                             alibi_slopes=slopes)
+        out = None
+    attn = attn.reshape(b, sq, h * hd)
+    return linear(attn, lp["o_proj"], lp.get("o_proj_bias")), out
+
+
+def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, slopes,
+                   cache_ctx=None):
+    """One transformer block, sequential or parallel residual."""
+    hidden = _norm(x, lp["input_layernorm"],
+                   lp.get("input_layernorm_bias"), cfg)
+    attn_out, cache_out = _attn_block(hidden, lp, cfg, cos, sin, slopes,
+                                      cache_ctx)
+    if cfg.parallel_residual:
+        if cfg.shared_input_norm:
+            mlp_in = hidden
+        else:
+            mlp_in = _norm(x, lp["post_attention_layernorm"],
+                           lp.get("post_attention_layernorm_bias"), cfg)
+        x = x + attn_out + _mlp(mlp_in, lp, cfg)
+    else:
+        x = x + attn_out
+        hidden2 = _norm(x, lp["post_attention_layernorm"],
+                        lp.get("post_attention_layernorm_bias"), cfg)
+        x = x + _mlp(hidden2, lp, cfg)
+    return x, cache_out
+
+
+def _layer_step(cfg: LlamaConfig, slopes, carry, xs):
     x, ck, cv, pos, cos, sin = carry
     lp, lidx = xs
-    b, sq, d = x.shape
-    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
-
-    # --- attention block ---
-    hidden = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
-    q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias"))
-    k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias"))
-    v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias"))
-    q = q.reshape(b, sq, h, hd)
-    k = k.reshape(b, sq, hkv, hd)
-    v = v.reshape(b, sq, hkv, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
-
-    ck, cv = update_layer(ck, cv, lidx, k, v, pos)
-    kf, vf = read_layer(ck, cv, lidx)
-    attn = sdp_attention(q, kf, vf, pos, sliding_window=cfg.sliding_window)
-    attn = attn.reshape(b, sq, h * hd)
-    x = x + linear(attn, lp["o_proj"], lp.get("o_proj_bias"))
-
-    # --- mlp block (fused gate/up + SiLU, the reference's mlp_forward_xpu) ---
-    hidden = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
-    gate = linear(hidden, lp["gate_proj"], lp.get("gate_proj_bias"))
-    up = linear(hidden, lp["up_proj"], lp.get("up_proj_bias"))
-    mlp = linear(jax.nn.silu(gate) * up, lp["down_proj"],
-                 lp.get("down_proj_bias"))
-    x = x + mlp
-
+    x, (ck, cv) = _decoder_layer(x, lp, cfg, cos, sin, slopes,
+                                 cache_ctx=(ck, cv, lidx, pos))
     return (x, ck, cv, pos, cos, sin), None
 
 
@@ -162,30 +274,33 @@ def forward(
     pos = cache.pos
 
     x = params["embed_tokens"][tokens].astype(compute_dtype)
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
+    if cfg.embed_norm:
+        x = _norm(x, params["embed_norm"], params.get("embed_norm_bias"), cfg)
 
-    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
+    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta, rotary_dim=cfg.rotary_dim,
                           scaling_factor=cfg.rope_scaling_factor)
-    positions = pos + jnp.arange(sq, dtype=jnp.int32)
-    cos, sin = rope_cos_sin(positions[None, :], inv_freq)  # [1, Sq, hd/2]
+    if getattr(pos, "ndim", 0) == 1:   # per-slot positions (serving)
+        positions = pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None, :]
+        cos, sin = rope_cos_sin(positions, inv_freq)       # [B, Sq, hd/2]
+    else:
+        positions = pos + jnp.arange(sq, dtype=jnp.int32)
+        cos, sin = rope_cos_sin(positions[None, :], inv_freq)  # [1, Sq, hd/2]
+    slopes = (jnp.asarray(alibi_slopes(cfg.num_attention_heads))
+              if cfg.use_alibi else None)
 
     lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
     (x, ck, cv, _, _, _), _ = lax.scan(
-        lambda c, xs: _layer_step(cfg, c, xs),
+        lambda c, xs: _layer_step(cfg, slopes, c, xs),
         (x, cache.k, cache.v, pos, cos, sin),
         (params["layers"], lidx),
     )
 
     if last_only:
         x = x[:, -1:, :]
-    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
-    lm_head = params.get("lm_head")
-    if lm_head is None:
-        logits = jnp.dot(x, params["embed_tokens"].T.astype(x.dtype),
-                         preferred_element_type=jnp.float32)
-    else:
-        logits = linear(x, lm_head)
-    logits = logits.astype(jnp.float32)
-
+    x = _norm(x, params["norm"], params.get("norm_bias"), cfg)
+    logits = _lm_head(x, params, cfg)
     return logits, KVCache(ck, cv, pos + sq)
 
 
@@ -222,46 +337,63 @@ def forward_train(
     """
     b, s = tokens.shape
     x = params["embed_tokens"][tokens].astype(compute_dtype)
-    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
+    if cfg.embed_norm:
+        x = _norm(x, params["embed_norm"], params.get("embed_norm_bias"), cfg)
+    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta, rotary_dim=cfg.rotary_dim,
                           scaling_factor=cfg.rope_scaling_factor)
     positions = pos_offset + jnp.arange(s, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions[None, :], inv_freq)
 
     h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
 
-    if attn_fn is None:
-        def attn_fn(q, k, v):
-            return sdp_attention(q, k, v, jnp.zeros((), jnp.int32),
-                                 sliding_window=cfg.sliding_window)
+    slopes = (jnp.asarray(alibi_slopes(cfg.num_attention_heads))
+              if cfg.use_alibi else None)
 
-    @jax.checkpoint
-    def layer(x, lp):
-        hidden = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
-        q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias"))
-        k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias"))
-        v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias"))
-        q = apply_rope(q.reshape(b, s, h, hd), cos, sin)
-        k = apply_rope(k.reshape(b, s, hkv, hd), cos, sin)
-        v = v.reshape(b, s, hkv, hd)
-        attn = attn_fn(q, k, v)
-        x = x + linear(attn.reshape(b, s, h * hd), lp["o_proj"],
-                       lp.get("o_proj_bias"))
-        hidden = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
-        gate = linear(hidden, lp["gate_proj"], lp.get("gate_proj_bias"))
-        up = linear(hidden, lp["up_proj"], lp.get("up_proj_bias"))
-        x = x + linear(jax.nn.silu(gate) * up, lp["down_proj"],
-                       lp.get("down_proj_bias"))
-        return x
+    if attn_fn is not None:
+        if cfg.use_alibi or cfg.attn_soft_cap is not None:
+            raise NotImplementedError(
+                "external attn_fn (sequence-parallel ring attention) does "
+                "not support ALiBi or attention soft-cap families yet; "
+                "train these single-device or add bias support to "
+                "ops/ring.py")
+        ext_attn = attn_fn
+
+        @jax.checkpoint
+        def layer(x, lp):
+            hidden = _norm(x, lp["input_layernorm"],
+                           lp.get("input_layernorm_bias"), cfg)
+            q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias"))
+            k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias"))
+            v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias"))
+            q = q.reshape(b, s, h, hd)
+            k = k.reshape(b, s, hkv, hd)
+            v = v.reshape(b, s, hkv, hd)
+            if cfg.use_rope:
+                q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
+                k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
+            attn_out = linear(ext_attn(q, k, v).reshape(b, s, h * hd),
+                              lp["o_proj"], lp.get("o_proj_bias"))
+            if cfg.parallel_residual:
+                mlp_in = hidden if cfg.shared_input_norm else _norm(
+                    x, lp["post_attention_layernorm"],
+                    lp.get("post_attention_layernorm_bias"), cfg)
+                return x + attn_out + _mlp(mlp_in, lp, cfg)
+            x2 = x + attn_out
+            hidden2 = _norm(x2, lp["post_attention_layernorm"],
+                            lp.get("post_attention_layernorm_bias"), cfg)
+            return x2 + _mlp(hidden2, lp, cfg)
+    else:
+        @jax.checkpoint
+        def layer(x, lp):
+            out, _ = _decoder_layer(x, lp, cfg, cos, sin, slopes,
+                                    cache_ctx=None)
+            return out
 
     x, _ = lax.scan(lambda c, lp: (layer(c, lp), None), x, params["layers"])
-    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
-    lm_head = params.get("lm_head")
-    if lm_head is None:
-        logits = jnp.dot(x, params["embed_tokens"].T.astype(x.dtype),
-                         preferred_element_type=jnp.float32)
-    else:
-        logits = linear(x, lm_head)
-    return logits.astype(jnp.float32)
+    x = _norm(x, params["norm"], params.get("norm_bias"), cfg)
+    return _lm_head(x, params, cfg)
 
 
 def new_cache(cfg: LlamaConfig, batch: int, max_seq: int,
@@ -287,6 +419,30 @@ _LAYER_LINEARS = {
 }
 
 
+def _llama_map(acc, name: str, w) -> None:
+    """HF llama/mistral/qwen2-style tensor names -> pytree keys."""
+    if name in ("model.embed_tokens.weight", "transformer.wte.weight"):
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name == "model.norm.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name == "lm_head.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    elif name.startswith("model.layers."):
+        parts = name.split(".")
+        idx = int(parts[2])
+        sub = ".".join(parts[3:-1])   # e.g. self_attn.q_proj
+        leaf = parts[-1]              # weight | bias
+        if sub in _LAYER_LINEARS:
+            key = _LAYER_LINEARS[sub]
+            if leaf == "weight":
+                acc.put(key, idx, acc.linear(name, w))
+            else:
+                acc.put(f"{key}_bias", idx, acc.dense(w))
+        elif sub in ("input_layernorm", "post_attention_layernorm"):
+            acc.put(sub, idx, acc.dense(w))
+        # rotary_emb.inv_freq etc. are derived, skip
+
+
 def convert_hf_params(
     tensors,                      # iterable of (name, np.ndarray)
     cfg: LlamaConfig,
@@ -300,63 +456,11 @@ def convert_hf_params(
     the reference's optimize_model(low_bit=False) / BF16Linear path.
     Weights are converted tensor-by-tensor (host holds one at a time) and
     per-layer results are stacked along a leading L axis for lax.scan.
+    Shares the conversion engine in models/convert_base.py with every
+    other family (models/families.py).
     """
-    from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize_linear
+    from bigdl_tpu.models.convert_base import make_convert
 
-    L = cfg.num_hidden_layers
-    do_quant = qtype is not None and qtype not in FLOAT_QTYPES
-
-    def cvt_linear(name: str, w) -> Any:
-        w = jnp.asarray(np.asarray(w))
-        if do_quant and not any(m in name for m in modules_to_not_convert):
-            return quantize_linear(w, qtype)
-        return w.T.astype(compute_dtype)  # contraction-major dense
-
-    layer_acc: Dict[str, list] = {}
-    params: Dict[str, Any] = {}
-
-    def put_layer(key: str, idx: int, val):
-        slot = layer_acc.setdefault(key, [None] * L)
-        slot[idx] = val
-
-    for name, w in tensors:
-        if name in ("model.embed_tokens.weight", "transformer.wte.weight"):
-            params["embed_tokens"] = jnp.asarray(np.asarray(w)).astype(
-                compute_dtype)
-        elif name == "model.norm.weight":
-            params["norm"] = jnp.asarray(np.asarray(w)).astype(compute_dtype)
-        elif name == "lm_head.weight":
-            params["lm_head"] = cvt_linear(name, w)
-        elif name.startswith("model.layers."):
-            parts = name.split(".")
-            idx = int(parts[2])
-            sub = ".".join(parts[3:-1])   # e.g. self_attn.q_proj
-            leaf = parts[-1]              # weight | bias
-            if sub in _LAYER_LINEARS:
-                key = _LAYER_LINEARS[sub]
-                if leaf == "weight":
-                    put_layer(key, idx, cvt_linear(name, w))
-                else:
-                    put_layer(f"{key}_bias", idx,
-                              jnp.asarray(np.asarray(w)).astype(compute_dtype))
-            elif sub in ("input_layernorm", "post_attention_layernorm"):
-                put_layer(sub, idx,
-                          jnp.asarray(np.asarray(w)).astype(compute_dtype))
-            # rotary_emb.inv_freq etc. are derived, skip
-        # else: ignore non-model tensors
-
-    missing = [k for k, v in layer_acc.items() if any(x is None for x in v)]
-    if missing:
-        raise ValueError(f"checkpoint missing layer tensors for: {missing}")
-
-    layers = {}
-    for key, per_layer in layer_acc.items():
-        layers[key] = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
-    params["layers"] = layers
-
-    if cfg.tie_word_embeddings:
-        params.pop("lm_head", None)
-    elif "lm_head" not in params:
-        raise ValueError("checkpoint has no lm_head.weight and config does "
-                         "not tie word embeddings")
-    return params
+    return make_convert(_llama_map)(
+        tensors, cfg, qtype=qtype, compute_dtype=compute_dtype,
+        modules_to_not_convert=modules_to_not_convert)

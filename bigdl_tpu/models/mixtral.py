@@ -123,8 +123,12 @@ def forward(
     x = params["embed_tokens"][tokens].astype(compute_dtype)
     inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
                           scaling_factor=cfg.rope_scaling_factor)
-    positions = pos + jnp.arange(sq, dtype=jnp.int32)
-    cos, sin = rope_cos_sin(positions[None, :], inv_freq)
+    if getattr(pos, "ndim", 0) == 1:   # per-slot positions (serving)
+        positions = pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None, :]
+        cos, sin = rope_cos_sin(positions, inv_freq)
+    else:
+        positions = pos + jnp.arange(sq, dtype=jnp.int32)
+        cos, sin = rope_cos_sin(positions[None, :], inv_freq)
 
     lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
     (x, ck, cv, _, _, _), _ = lax.scan(

@@ -73,6 +73,10 @@ def _register_builtin() -> None:
 
     register_family(["Qwen2ForCausalLM"], llama_adapter(qwen2_tweak))
 
+    from bigdl_tpu.models import families
+
+    families.register_all()
+
     from bigdl_tpu.models import mixtral as mixtral_mod
 
     register_family(

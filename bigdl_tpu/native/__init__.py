@@ -1,0 +1,130 @@
+"""Native host-runtime loader: compile-on-first-use C++ kernels via ctypes.
+
+The reference ships prebuilt ISA-dispatched binaries downloaded at package
+build (setup.py:59-133) and loads them with ctypes
+(ggml/model/llama/llama_cpp.py:71-109). Here the source is in-tree
+(quant_kernels.cpp), compiled once with the system g++ into a cached .so;
+every entry point has a pure-JAX/numpy fallback so the native layer is an
+accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "quant_kernels.cpp")
+_CACHE_DIR = os.environ.get(
+    "BIGDL_TPU_NATIVE_CACHE",
+    os.path.join(tempfile.gettempdir(), "bigdl_tpu_native"))
+_DISABLE_ENV = "BIGDL_TPU_DISABLE_NATIVE"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    src_mtime = os.path.getmtime(_SRC)
+    so_path = os.path.join(_CACHE_DIR, f"quant_kernels_{int(src_mtime)}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-pthread", _SRC, "-o", so_path + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(so_path + ".tmp", so_path)
+        return so_path
+    except (subprocess.SubprocessError, OSError):
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, or None (disabled / no compiler)."""
+    global _lib, _tried
+    if os.environ.get(_DISABLE_ENV):
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        path = _build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i8p = ctypes.POINTER(ctypes.c_int8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i64 = ctypes.c_int64
+        lib.bigdl_quantize_q4_0.argtypes = [f32p, i64, i64, u8p, f32p]
+        lib.bigdl_quantize_q8_0.argtypes = [f32p, i64, i64, i8p, f32p]
+        lib.bigdl_dequantize_q4_0.argtypes = [u8p, f32p, i64, i64, f32p]
+        lib.bigdl_repack_gguf_q4_0.argtypes = [u8p, i64, i64, u8p, f32p]
+        _lib = lib
+        return _lib
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def quantize_native(w_kn: np.ndarray, qtype: str):
+    """Quantize [K, N] f32 (K % 32 == 0) natively.
+
+    Returns (data, scale_f32) numpy arrays in QTensor field layout, or None
+    when the native path is unavailable/unsupported (caller falls back to
+    ops/quant.quantize)."""
+    lib = get_lib()
+    if lib is None or qtype not in ("sym_int4", "sym_int8"):
+        return None
+    w = np.ascontiguousarray(w_kn, np.float32)
+    k, n = w.shape
+    if k % 32:
+        return None
+    scale = np.empty((k // 32, n), np.float32)
+    if qtype == "sym_int4":
+        data = np.empty((k // 2, n), np.uint8)
+        lib.bigdl_quantize_q4_0(_ptr(w, ctypes.c_float), k, n,
+                                _ptr(data, ctypes.c_uint8),
+                                _ptr(scale, ctypes.c_float))
+    else:
+        data = np.empty((k, n), np.int8)
+        lib.bigdl_quantize_q8_0(_ptr(w, ctypes.c_float), k, n,
+                                _ptr(data, ctypes.c_int8),
+                                _ptr(scale, ctypes.c_float))
+    return data, scale
+
+
+def dequantize_q4_0_native(data: np.ndarray, scale_f32: np.ndarray):
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data, np.uint8)
+    scale = np.ascontiguousarray(scale_f32, np.float32)
+    k2, n = data.shape
+    out = np.empty((k2 * 2, n), np.float32)
+    lib.bigdl_dequantize_q4_0(_ptr(data, ctypes.c_uint8),
+                              _ptr(scale, ctypes.c_float), k2 * 2, n,
+                              _ptr(out, ctypes.c_float))
+    return out
+
+
+def repack_gguf_q4_0_native(blocks: np.ndarray, n_rows: int, k: int):
+    """GGUF q4_0 raw blocks -> (data [K/2, N], scale [K/32, N] f32)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    blocks = np.ascontiguousarray(blocks, np.uint8)
+    data = np.empty((k // 2, n_rows), np.uint8)
+    scale = np.empty((k // 32, n_rows), np.float32)
+    lib.bigdl_repack_gguf_q4_0(_ptr(blocks, ctypes.c_uint8), n_rows, k,
+                               _ptr(data, ctypes.c_uint8),
+                               _ptr(scale, ctypes.c_float))
+    return data, scale

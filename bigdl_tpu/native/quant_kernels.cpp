@@ -1,0 +1,182 @@
+// Host-side native quantization kernels.
+//
+// TPU-native equivalent of the reference's offline quantizer executables
+// (reference setup.py:94-133 ships quantize-llama/gptneox/bloom/starcoder
+// binaries driven by ggml/quantize.py:73-128 via subprocess) and of the
+// ggml C quantize API (ggml_quantize_tensor, bound at
+// ggml/model/llama/llama_cpp.py:946-989). Checkpoint conversion is
+// host-bound (the TPU only sees already-packed blocks), so the hot loop is
+// plain C++ + threads, bound to Python with ctypes — no pybind11 needed.
+//
+// Semantics are BIT-IDENTICAL to ops/quant.py's jitted quantizers:
+//  - sym scale d = signed-absmax / -(1<<(bits-1)), first-max-index tie rule
+//  - codes = clip(nearbyint(x/d) + half, 0, 2*half-1)  (round half-to-even)
+//  - split-block nibble packing: byte j of a block holds values j (lo) and
+//    j + block/2 (hi)
+// Layout: input w is [K, N] f32 contraction-major; data/scales are the
+// QTensor field layouts ([K/2, N] u8 + [K/32, N] f32-scale).
+
+#include <cmath>
+#include <cstdint>
+#include <cfenv>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kBlock = 32;
+
+inline float block_signed_absmax(const float* w, int64_t n_cols,
+                                 int64_t col, int64_t row0) {
+  float amax = 0.0f, signed_max = 0.0f;
+  for (int j = 0; j < kBlock; ++j) {
+    const float x = w[(row0 + j) * n_cols + col];
+    const float a = std::fabs(x);
+    if (a > amax) {          // strict >: first-max tie rule (jnp.argmax)
+      amax = a;
+      signed_max = x;
+    }
+  }
+  return signed_max;
+}
+
+template <typename Fn>
+void parallel_cols(int64_t n_cols, Fn&& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t n_threads = std::max<int64_t>(1, std::min<int64_t>(hw, n_cols));
+  if (n_threads == 1) {
+    fn(0, n_cols);
+    return;
+  }
+  std::vector<std::thread> ts;
+  const int64_t chunk = (n_cols + n_threads - 1) / n_threads;
+  for (int64_t t = 0; t < n_threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(n_cols, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back([=, &fn] { fn(lo, hi); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// w [K, N] f32 (K % 32 == 0) -> data [K/2, N] u8, scale [K/32, N] f32
+void bigdl_quantize_q4_0(const float* w, int64_t k, int64_t n,
+                         uint8_t* data, float* scale) {
+  const int64_t n_blk = k / kBlock;
+  parallel_cols(n, [&](int64_t lo, int64_t hi) {
+    std::fesetround(FE_TONEAREST);
+    for (int64_t col = lo; col < hi; ++col) {
+      for (int64_t b = 0; b < n_blk; ++b) {
+        const int64_t row0 = b * kBlock;
+        const float mx = block_signed_absmax(w, n, col, row0);
+        const float d = mx / -8.0f;
+        const float inv = d != 0.0f ? 1.0f / d : 0.0f;
+        scale[b * n + col] = d;
+        uint8_t codes[kBlock];
+        for (int j = 0; j < kBlock; ++j) {
+          const float q =
+              std::nearbyintf(w[(row0 + j) * n + col] * inv) + 8.0f;
+          codes[j] = (uint8_t)std::clamp(q, 0.0f, 15.0f);
+        }
+        uint8_t* out = data + (b * (kBlock / 2)) * n + col;
+        for (int j = 0; j < kBlock / 2; ++j) {
+          out[j * n] = (uint8_t)(codes[j] | (codes[j + kBlock / 2] << 4));
+        }
+      }
+    }
+  });
+}
+
+// w [K, N] f32 -> data [K, N] i8, scale [K/32, N] f32
+void bigdl_quantize_q8_0(const float* w, int64_t k, int64_t n,
+                         int8_t* data, float* scale) {
+  const int64_t n_blk = k / kBlock;
+  parallel_cols(n, [&](int64_t lo, int64_t hi) {
+    std::fesetround(FE_TONEAREST);
+    for (int64_t col = lo; col < hi; ++col) {
+      for (int64_t b = 0; b < n_blk; ++b) {
+        const int64_t row0 = b * kBlock;
+        const float mx = block_signed_absmax(w, n, col, row0);
+        const float d = mx / -128.0f;
+        const float inv = d != 0.0f ? 1.0f / d : 0.0f;
+        scale[b * n + col] = d;
+        for (int j = 0; j < kBlock; ++j) {
+          const float q =
+              std::nearbyintf(w[(row0 + j) * n + col] * inv) + 128.0f;
+          data[(row0 + j) * n + col] =
+              (int8_t)((int)std::clamp(q, 0.0f, 255.0f) - 128);
+        }
+      }
+    }
+  });
+}
+
+// data [K/2, N] u8 + scale [K/32, N] f32 -> out [K, N] f32
+void bigdl_dequantize_q4_0(const uint8_t* data, const float* scale,
+                           int64_t k, int64_t n, float* out) {
+  const int64_t n_blk = k / kBlock;
+  parallel_cols(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t col = lo; col < hi; ++col) {
+      for (int64_t b = 0; b < n_blk; ++b) {
+        const float d = scale[b * n + col];
+        const uint8_t* in = data + (b * (kBlock / 2)) * n + col;
+        float* o = out + (b * kBlock) * n + col;
+        for (int j = 0; j < kBlock / 2; ++j) {
+          const uint8_t byte = in[j * n];
+          o[j * n] = ((int)(byte & 0x0F) - 8) * d;
+          o[(j + kBlock / 2) * n] = ((int)(byte >> 4) - 8) * d;
+        }
+      }
+    }
+  });
+}
+
+// GGUF q4_0 blocks ([n_rows, n_blk, 18] bytes, row-major over K) ->
+// QTensor layout: data [K/2, N] u8 + scale [K/32, N] f32. The repack is
+// the transpose described in bigdl_tpu/gguf.py, fused into one pass.
+void bigdl_repack_gguf_q4_0(const uint8_t* blocks, int64_t n_rows,
+                            int64_t k, uint8_t* data, float* scale) {
+  const int64_t n_blk = k / kBlock;
+  const int64_t bpb = 18;
+  parallel_cols(n_rows, [&](int64_t lo, int64_t hi) {
+    for (int64_t row = lo; row < hi; ++row) {       // row == output column
+      for (int64_t b = 0; b < n_blk; ++b) {
+        const uint8_t* blk = blocks + (row * n_blk + b) * bpb;
+        uint16_t h;
+        __builtin_memcpy(&h, blk, 2);
+        // fp16 -> f32 (scalar; scales are 1/576th of the bytes)
+        const uint32_t sign = (uint32_t)(h & 0x8000) << 16;
+        const uint32_t expo = (h >> 10) & 0x1F;
+        const uint32_t mant = h & 0x3FF;
+        uint32_t f;
+        if (expo == 0) {
+          if (mant == 0) {
+            f = sign;
+          } else {
+            int e = -1;
+            uint32_t m = mant;
+            do { m <<= 1; ++e; } while (!(m & 0x400));
+            f = sign | ((127 - 15 - e) << 23) | ((m & 0x3FF) << 13);
+          }
+        } else if (expo == 31) {
+          f = sign | 0x7F800000 | (mant << 13);
+        } else {
+          f = sign | ((expo - 15 + 127) << 23) | (mant << 13);
+        }
+        float fd;
+        __builtin_memcpy(&fd, &f, 4);
+        scale[b * n_rows + row] = fd;
+        const uint8_t* qs = blk + 2;
+        uint8_t* out = data + (b * (kBlock / 2)) * n_rows + row;
+        for (int j = 0; j < kBlock / 2; ++j) out[j * n_rows] = qs[j];
+      }
+    }
+  });
+}
+
+}  // extern "C"

@@ -33,6 +33,7 @@ def sdp_attention(
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
+    alibi_slopes: Optional[jax.Array] = None,   # [H] f32 (bloom families)
 ) -> jax.Array:
     """Causal SDP against a (possibly partially-filled) KV cache.
 
@@ -53,15 +54,30 @@ def sdp_attention(
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf,
                         preferred_element_type=jnp.float32)
     scores = scores * scale
+    if alibi_slopes is not None:
+        # bias slopes[h] * k_pos; per-query-row constants cancel in softmax,
+        # so keying on absolute key position is the standard causal form
+        sl = alibi_slopes.reshape(hkv, g).astype(jnp.float32)
+        kpos = jnp.arange(skv, dtype=jnp.float32)
+        scores = scores + sl[None, :, :, None, None] * kpos[None, None, None, None, :]
     if logits_soft_cap is not None:
         scores = jnp.tanh(scores / logits_soft_cap) * logits_soft_cap
 
-    q_ids = q_pos + jnp.arange(sq, dtype=jnp.int32)          # [Sq]
     k_ids = jnp.arange(skv, dtype=jnp.int32)                 # [Skv]
-    mask = k_ids[None, :] <= q_ids[:, None]                  # [Sq, Skv]
-    if sliding_window is not None:
-        mask &= k_ids[None, :] > q_ids[:, None] - sliding_window
-    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    if getattr(q_pos, "ndim", 0) == 1:
+        # per-slot positions (continuous batching): [B, Sq, Skv] mask
+        q_ids = q_pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None, :]
+        mask = k_ids[None, None, :] <= q_ids[:, :, None]
+        if sliding_window is not None:
+            mask &= k_ids[None, None, :] > q_ids[:, :, None] - sliding_window
+        # [B, Skv->k, Sq->q] -> broadcast over (Hkv, G): [B,1,1,Sq,Skv]
+        scores = jnp.where(mask[:, None, None, :, :], scores, -jnp.inf)
+    else:
+        q_ids = q_pos + jnp.arange(sq, dtype=jnp.int32)      # [Sq]
+        mask = k_ids[None, :] <= q_ids[:, None]              # [Sq, Skv]
+        if sliding_window is not None:
+            mask &= k_ids[None, :] > q_ids[:, None] - sliding_window
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
 
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(jnp.bfloat16), vf,

@@ -62,14 +62,21 @@ def init_cache(
     head_dim: int,
     dtype=jnp.bfloat16,
     quantized: bool = False,
+    per_slot_pos: bool = False,
 ) -> KVCache:
-    """Allocate an empty cache. quantized=True stores float8_e5m2."""
+    """Allocate an empty cache. quantized=True stores float8_e5m2.
+
+    per_slot_pos=True gives every batch row its own position counter —
+    the continuous-batching layout (each serving slot decodes at its own
+    depth, the capability the reference's vLLM port builds from per-seq
+    KV dicts, vllm/model_executor/models/bigdl_model.py:88-139)."""
     dt = jnp.float8_e5m2 if quantized else dtype
     shape = (num_layers, batch, max_seq, kv_heads, head_dim)
     return KVCache(
         k=jnp.zeros(shape, dt),
         v=jnp.zeros(shape, dt),
-        pos=jnp.zeros((), jnp.int32),
+        pos=(jnp.zeros((batch,), jnp.int32) if per_slot_pos
+             else jnp.zeros((), jnp.int32)),
     )
 
 
@@ -79,19 +86,32 @@ def update_layer(
     layer: jax.Array | int,
     k_new: jax.Array,   # [B, S_new, H_kv, D]
     v_new: jax.Array,
-    pos: jax.Array,     # scalar int32: write offset
+    pos: jax.Array,     # scalar int32 write offset, or [B] per-slot offsets
 ) -> Tuple[jax.Array, jax.Array]:
     """Write k_new/v_new into layer `layer` at sequence offset `pos`.
 
-    Returns the updated full-stack arrays. Under jit with donated inputs this
-    lowers to an in-place dynamic-update-slice.
+    `pos` may be a vector of per-batch offsets (continuous-batching serving:
+    every slot decodes at its own depth). Returns the updated full-stack
+    arrays; under jit with donated inputs this lowers to in-place updates.
     """
-    k_new = k_new.astype(cache_k.dtype)[None]
-    v_new = v_new.astype(cache_v.dtype)[None]
+    k_new = k_new.astype(cache_k.dtype)
+    v_new = v_new.astype(cache_v.dtype)
+    if getattr(pos, "ndim", 0) == 1:
+        def write(c_b, n_b, p):           # [S,H,D], [S_new,H,D]
+            return jax.lax.dynamic_update_slice(c_b, n_b, (p, 0, 0))
+
+        ck_l = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
+        cv_l = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
+        ck_l = jax.vmap(write)(ck_l, k_new, pos)
+        cv_l = jax.vmap(write)(cv_l, v_new, pos)
+        return (
+            jax.lax.dynamic_update_index_in_dim(cache_k, ck_l, layer, 0),
+            jax.lax.dynamic_update_index_in_dim(cache_v, cv_l, layer, 0),
+        )
     idx = (layer, 0, pos, 0, 0)
     return (
-        jax.lax.dynamic_update_slice(cache_k, k_new, idx),
-        jax.lax.dynamic_update_slice(cache_v, v_new, idx),
+        jax.lax.dynamic_update_slice(cache_k, k_new[None], idx),
+        jax.lax.dynamic_update_slice(cache_v, v_new[None], idx),
     )
 
 

@@ -1,0 +1,7 @@
+from bigdl_tpu.serving.engine import (  # noqa: F401
+    EngineConfig,
+    LLMEngine,
+    Request,
+    RequestOutput,
+    SamplingParams,
+)

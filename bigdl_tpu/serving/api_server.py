@@ -1,0 +1,291 @@
+"""OpenAI-compatible HTTP server over the continuous-batching engine.
+
+Equivalent of the reference's FastAPI server (reference
+vllm/entrypoints/openai/api_server.py:229-425: /v1/completions and
+/v1/chat/completions with SSE streaming, client-disconnect abort) — built on
+the stdlib ThreadingHTTPServer so it runs with zero extra dependencies
+(FastAPI/uvicorn are not in the image; the engine below is framework-
+agnostic regardless).
+
+Endpoints: GET /v1/models, POST /v1/completions, POST /v1/chat/completions
+(stream=true -> text/event-stream chunks, OpenAI wire format).
+
+Tokenization: pass a HF tokenizer (transformers.AutoTokenizer) at
+construction; prompts may also be raw token-id lists, in which case
+completions return token ids (useful for tests and token-level clients).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, List, Optional
+
+from bigdl_tpu.serving.engine import LLMEngine, SamplingParams
+
+
+class _EngineLoop:
+    """Background thread driving engine.step() (the reference's asyncio
+    engine loop, async_llm_engine.py, minus asyncio)."""
+
+    def __init__(self, engine: LLMEngine):
+        self.engine = engine
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                did = self.engine.step()
+            except Exception:   # a dead loop thread would hang every client
+                import traceback
+
+                traceback.print_exc()
+                did = False
+            if not did:
+                self._wake.wait(timeout=0.01)
+                self._wake.clear()
+
+    def notify(self):
+        self._wake.set()
+
+    def stop(self):
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=2)
+
+
+def _chat_to_prompt(messages: List[dict], tokenizer) -> Any:
+    if tokenizer is not None and hasattr(tokenizer, "apply_chat_template"):
+        try:
+            return tokenizer.apply_chat_template(
+                messages, tokenize=True, add_generation_prompt=True)
+        except Exception:
+            pass
+    text = ""
+    for m in messages:
+        text += f"{m.get('role', 'user')}: {m.get('content', '')}\n"
+    text += "assistant:"
+    return text
+
+
+class OpenAIServer:
+    def __init__(self, engine: LLMEngine, tokenizer=None,
+                 model_name: str = "bigdl-tpu-model"):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.loop = _EngineLoop(engine)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -- request handling ---------------------------------------------------
+
+    def _encode(self, prompt) -> List[int]:
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            return list(prompt)
+        if self.tokenizer is None:
+            raise ValueError("string prompts need a tokenizer; pass token "
+                             "ids or construct the server with one")
+        return list(self.tokenizer(prompt)["input_ids"])
+
+    def _decode_text(self, ids: List[int]) -> str:
+        if self.tokenizer is None:
+            return json.dumps(ids)
+        return self.tokenizer.decode(ids, skip_special_tokens=True)
+
+    def _params(self, body: dict) -> SamplingParams:
+        return SamplingParams(
+            max_tokens=int(body.get("max_tokens", 128)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+        )
+
+    def _run_request(self, token_ids, params, stream_cb=None):
+        rid = f"cmpl-{uuid.uuid4().hex[:16]}"
+        self.engine.add_request(rid, token_ids, params)
+        self.loop.notify()
+        out_ids: List[int] = []
+        finish_reason = None
+        while finish_reason is None:
+            outs = self.engine.get_outputs(rid)
+            if not outs:
+                time.sleep(0.002)
+                continue
+            for o in outs:
+                out_ids.extend(o.new_token_ids)
+                if stream_cb is not None and o.new_token_ids:
+                    try:
+                        stream_cb(o.new_token_ids)
+                    except OSError:
+                        # client went away: free the slot, then keep
+                        # draining until the engine emits the abort-finish
+                        # (reference api_server.py:371 disconnect -> abort)
+                        self.engine.abort_request(rid)
+                        self.loop.notify()
+                        stream_cb = None
+                if o.finished:
+                    finish_reason = o.finish_reason or "stop"
+        return rid, out_ids, finish_reason
+
+    # -- http ---------------------------------------------------------------
+
+    def make_handler(server):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet
+                pass
+
+            def _json(self, code: int, obj: dict):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/v1/models":
+                    self._json(200, {"object": "list", "data": [
+                        {"id": server.model_name, "object": "model"}]})
+                elif self.path in ("/health", "/ping"):
+                    self._json(200, {"status": "ok"})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    return self._json(400, {"error": "bad json"})
+                try:
+                    if self.path == "/v1/completions":
+                        return self._completions(body, chat=False)
+                    if self.path == "/v1/chat/completions":
+                        return self._completions(body, chat=True)
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                self._json(404, {"error": "not found"})
+
+            def _completions(self, body: dict, chat: bool):
+                if chat:
+                    prompt = _chat_to_prompt(body.get("messages", []),
+                                             server.tokenizer)
+                else:
+                    prompt = body.get("prompt", "")
+                ids = server._encode(prompt)
+                params = server._params(body)
+                created = int(time.time())
+
+                if body.get("stream"):
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+
+                    def cb(new_ids):
+                        text = server._decode_text(new_ids)
+                        delta = ({"role": "assistant", "content": text}
+                                 if chat else None)
+                        chunk = {
+                            "id": "chunk", "object":
+                                ("chat.completion.chunk" if chat
+                                 else "text_completion"),
+                            "created": created, "model": server.model_name,
+                            "choices": [{
+                                "index": 0,
+                                **({"delta": delta} if chat
+                                   else {"text": text}),
+                                "finish_reason": None}],
+                        }
+                        self.wfile.write(
+                            b"data: " + json.dumps(chunk).encode() + b"\n\n")
+                        self.wfile.flush()
+
+                    rid, out_ids, reason = server._run_request(
+                        ids, params, stream_cb=cb)
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                    return
+
+                rid, out_ids, reason = server._run_request(ids, params)
+                text = server._decode_text(out_ids)
+                choice = ({"index": 0, "message":
+                           {"role": "assistant", "content": text},
+                           "finish_reason": reason}
+                          if chat else
+                          {"index": 0, "text": text,
+                           "finish_reason": reason})
+                self._json(200, {
+                    "id": rid,
+                    "object": "chat.completion" if chat else "text_completion",
+                    "created": created,
+                    "model": server.model_name,
+                    "choices": [choice],
+                    "usage": {
+                        "prompt_tokens": len(ids),
+                        "completion_tokens": len(out_ids),
+                        "total_tokens": len(ids) + len(out_ids)},
+                })
+
+        return Handler
+
+    def serve(self, host: str = "127.0.0.1", port: int = 8000,
+              background: bool = False) -> ThreadingHTTPServer:
+        self._httpd = ThreadingHTTPServer((host, port), self.make_handler())
+        if background:
+            t = threading.Thread(target=self._httpd.serve_forever,
+                                 daemon=True)
+            t.start()
+        else:
+            self._httpd.serve_forever()
+        return self._httpd
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+        self.loop.stop()
+
+
+def main():
+    """CLI: python -m bigdl_tpu.serving.api_server --model PATH [...]"""
+    import argparse
+
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--load-in-low-bit", default="sym_int4")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=2048)
+    args = ap.parse_args()
+
+    model = AutoModelForCausalLM.from_pretrained(
+        args.model, load_in_low_bit=args.load_in_low_bit,
+        max_seq=args.max_seq)
+    tokenizer = None
+    try:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(args.model)
+    except Exception:
+        pass
+
+    from bigdl_tpu.serving.engine import EngineConfig
+
+    engine = LLMEngine(model, EngineConfig(max_batch=args.max_batch,
+                                           max_seq=args.max_seq))
+    server = OpenAIServer(engine, tokenizer)
+    print(f"serving on http://{args.host}:{args.port}/v1")
+    server.serve(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,343 @@
+"""Continuous-batching serving engine, TPU-native.
+
+Re-design of the reference's vLLM port (reference vllm/engine/llm_engine.py:
+66-687 `LLMEngine.step`, vllm/core/scheduler.py:93 `FixedWindowScheduler`,
+vllm/worker/worker.py:260 single in-process worker, per-sequence padded KV
+dicts at vllm/model_executor/models/bigdl_model.py:88-139).
+
+The reference re-pads and re-assembles a python dict of per-sequence KV
+tensors every step — unusable under XLA. Here the design is slot-based and
+fully static:
+
+- ONE batched KV cache [L, max_batch, max_seq, H, D] with a per-slot
+  position vector (ops/kvcache.py per_slot_pos). A slot is a sequence's
+  home for its whole lifetime; admission = prefill into the slot,
+  completion = slot freed (pos reset), nothing ever re-pads or copies KV.
+- ONE compiled decode executable for the whole engine lifetime: tokens
+  [max_batch, 1] + cache -> logits. Finished/empty slots decode garbage
+  that is never read — the FLOP cost of static shapes, repaid by zero
+  recompiles and an always-full MXU batch.
+- Prefill is compiled per prompt-length bucket and writes K/V straight
+  into the batched cache at the slot index.
+- Scheduling is FCFS admission (the reference's FixedWindowScheduler
+  semantics) driven from `step()`; sampling runs on host per-slot so every
+  request can carry its own temperature/top-k/top-p (the reference's
+  BigDLSampler is also host-side).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.ops.kvcache import KVCache, init_cache
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling (reference vllm/sampling_params.py surface)."""
+    max_tokens: int = 128
+    temperature: float = 0.0       # 0 = greedy
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_token_ids: Tuple[int, ...] = ()
+    ignore_eos: bool = False
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_token_ids: List[int]
+    params: SamplingParams
+    arrival: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    new_token_ids: List[int]
+    finished: bool
+    finish_reason: Optional[str] = None
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    max_batch: int = 8
+    max_seq: int = 2048
+    prefill_bucket: int = 16       # smallest prefill compile bucket
+    kv_quantized: bool = False
+
+
+class _Slot:
+    __slots__ = ("req", "generated", "last_token", "active")
+
+    def __init__(self):
+        self.req: Optional[Request] = None
+        self.generated: List[int] = []
+        self.last_token: int = 0
+        self.active: bool = False
+
+
+class LLMEngine:
+    """Synchronous continuous-batching engine over one model.
+
+    model: a TpuCausalLM (bigdl_tpu.transformers.model) or anything exposing
+    .params/.config/.family. Drive with add_request() + step(), identical in
+    spirit to the reference engine loop (llm_engine.py:543).
+    """
+
+    def __init__(self, model: Any, config: Optional[EngineConfig] = None):
+        self.cfg_engine = config or EngineConfig()
+        self.params = model.params
+        self.cfg = model.config
+        self.family = model.family
+        self.eos_token_id = None
+        hf = getattr(model, "hf_config", None) or {}
+        eos = hf.get("eos_token_id")
+        self.eos_token_id = eos[0] if isinstance(eos, list) else eos
+
+        ce = self.cfg_engine
+        B = ce.max_batch
+        self.cache = init_cache(
+            self.cfg.num_hidden_layers, B, ce.max_seq,
+            self.cfg.num_key_value_heads, self.cfg.hd,
+            quantized=ce.kv_quantized, per_slot_pos=True)
+
+        self.slots = [_Slot() for _ in range(B)]
+        self.waiting: "queue.Queue[Request]" = queue.Queue()
+        self._outputs: Dict[str, List[RequestOutput]] = {}
+        self._abort: set = set()
+        self._lock = threading.Lock()
+
+        fwd = self.family.forward
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def decode(params, tokens, cache):   # tokens [B] int32
+            logits, cache = fwd(params, self.cfg, tokens[:, None], cache)
+            return logits[:, -1, :], cache
+
+        self._decode = decode
+
+        # prefill one sequence on a private 1-row cache, then splice its K/V
+        # and position into the batched cache at the slot index
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def insert(cache: KVCache, k1, v1, slot, plen):
+            k = jax.lax.dynamic_update_slice(
+                cache.k, k1.astype(cache.k.dtype), (0, slot, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(
+                cache.v, v1.astype(cache.v.dtype), (0, slot, 0, 0, 0))
+            pos = cache.pos.at[slot].set(plen)
+            return KVCache(k, v, pos)
+
+        self._insert = insert
+        self._prefills: Dict[int, Callable] = {}
+
+    # -- public api ---------------------------------------------------------
+
+    def add_request(self, request_id: str, prompt_token_ids, params=None):
+        params = params or SamplingParams()
+        ids = list(prompt_token_ids)
+        if len(ids) + 1 > self.cfg_engine.max_seq:
+            raise ValueError(
+                f"prompt length {len(ids)} exceeds engine max_seq "
+                f"{self.cfg_engine.max_seq}")
+        if not ids:
+            raise ValueError("empty prompt")
+        self.waiting.put(Request(request_id, ids, params))
+        with self._lock:
+            self._outputs[request_id] = []
+
+    def abort_request(self, request_id: str) -> None:
+        """Reference api_server behavior on client disconnect
+        (vllm/entrypoints/openai/api_server.py:371)."""
+        self._abort.add(request_id)
+
+    def has_unfinished(self) -> bool:
+        return (not self.waiting.empty()) or any(
+            s.active for s in self.slots)
+
+    def get_outputs(self, request_id: str) -> List[RequestOutput]:
+        with self._lock:
+            out = self._outputs.get(request_id, [])
+            if any(o.finished for o in out):
+                # request complete: drop the entry (unread finished entries
+                # of aborted streams must not accumulate)
+                self._outputs.pop(request_id, None)
+            elif out:
+                self._outputs[request_id] = []
+        return out
+
+    # -- engine internals ---------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        b = self.cfg_engine.prefill_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.cfg_engine.max_seq)
+
+    def _prefill_fn(self, bucket: int) -> Callable:
+        fn = self._prefills.get(bucket)
+        if fn is None:
+            fwd = self.family.forward
+
+            @jax.jit
+            def prefill(params, tokens):      # [1, bucket]
+                cache1 = init_cache(
+                    self.cfg.num_hidden_layers, 1, bucket,
+                    self.cfg.num_key_value_heads, self.cfg.hd,
+                    quantized=self.cfg_engine.kv_quantized)
+                logits, cache1 = fwd(params, self.cfg, tokens, cache1)
+                return logits, cache1.k, cache1.v
+
+            fn = self._prefills[bucket] = prefill
+        return fn
+
+    def _admit(self, req: Request, slot_idx: int) -> None:
+        s = self.slots[slot_idx]
+        plen = len(req.prompt_token_ids)
+        bucket = self._bucket(plen)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = req.prompt_token_ids
+        logits, k1, v1 = self._prefill_fn(bucket)(
+            self.params, jnp.asarray(padded))
+        self.cache = self._insert(self.cache, k1, v1, slot_idx, plen)
+        first = self._sample_host(
+            np.asarray(logits)[0, plen - 1], req.params)
+        s.req = req
+        s.generated = [int(first)]
+        s.last_token = int(first)
+        s.active = True
+        self._emit(s)
+
+    @staticmethod
+    def _sample_host(logits: np.ndarray, p: SamplingParams) -> int:
+        if p.temperature <= 0.0:
+            return int(np.argmax(logits))
+        lg = logits.astype(np.float64) / p.temperature
+        if p.top_k > 0:
+            kth = np.sort(lg)[-p.top_k]
+            lg = np.where(lg < kth, -np.inf, lg)
+        if p.top_p < 1.0:
+            order = np.argsort(lg)[::-1]
+            probs = np.exp(lg[order] - np.max(lg))
+            probs /= probs.sum()
+            cum = np.cumsum(probs)
+            cut = int(np.searchsorted(cum, p.top_p)) + 1
+            mask = np.full_like(lg, -np.inf)
+            mask[order[:cut]] = lg[order[:cut]]
+            lg = mask
+        probs = np.exp(lg - np.max(lg[np.isfinite(lg)]))
+        probs = np.where(np.isfinite(lg), probs, 0.0)
+        probs /= probs.sum()
+        return int(np.random.choice(len(probs), p=probs))
+
+    def _finish(self, idx: int, reason: str) -> None:
+        s = self.slots[idx]
+        if s.req is None:
+            return
+        with self._lock:
+            self._outputs.setdefault(s.req.request_id, []).append(
+                RequestOutput(s.req.request_id, [], True, reason))
+        s.req = None
+        s.active = False
+        s.generated = []
+        # reset the slot's position so the idle row stops deepening
+        self.cache = KVCache(self.cache.k, self.cache.v,
+                             self.cache.pos.at[idx].set(0))
+
+    def _emit(self, s: _Slot) -> None:
+        with self._lock:
+            self._outputs.setdefault(s.req.request_id, []).append(
+                RequestOutput(s.req.request_id, [s.last_token], False))
+
+    def _check_done(self, idx: int) -> bool:
+        s = self.slots[idx]
+        p = s.req.params
+        tok = s.last_token
+        if (not p.ignore_eos and self.eos_token_id is not None
+                and tok == self.eos_token_id):
+            self._finish(idx, "stop")
+            return True
+        if tok in p.stop_token_ids:
+            self._finish(idx, "stop")
+            return True
+        if len(s.generated) >= p.max_tokens:
+            self._finish(idx, "length")
+            return True
+        plen = len(s.req.prompt_token_ids)
+        if plen + len(s.generated) + 1 >= self.cfg_engine.max_seq:
+            self._finish(idx, "length")
+            return True
+        return False
+
+    def step(self) -> bool:
+        """One engine iteration (reference LLMEngine.step): admit waiting
+        requests into free slots, then run one batched decode step.
+        Returns True if any work was done."""
+        # aborts
+        for i, s in enumerate(self.slots):
+            if s.active and s.req.request_id in self._abort:
+                self._abort.discard(s.req.request_id)
+                self._finish(i, "abort")
+
+        # admission
+        for i, s in enumerate(self.slots):
+            if not s.active and not self.waiting.empty():
+                try:
+                    req = self.waiting.get_nowait()
+                except queue.Empty:
+                    break
+                if req.request_id in self._abort:
+                    self._abort.discard(req.request_id)
+                    continue
+                self._admit(req, i)
+                if self._check_done(i):
+                    pass
+
+        active = [i for i, s in enumerate(self.slots) if s.active]
+        if not active:
+            return False
+
+        tokens = np.zeros((self.cfg_engine.max_batch,), np.int32)
+        for i in active:
+            tokens[i] = self.slots[i].last_token
+        logits, self.cache = self._decode(
+            self.params, jnp.asarray(tokens), self.cache)
+        logits = np.asarray(logits)
+
+        for i in active:
+            s = self.slots[i]
+            tok = self._sample_host(logits[i], s.req.params)
+            s.last_token = tok
+            s.generated.append(tok)
+            self._emit(s)
+            self._check_done(i)
+        return True
+
+    # -- convenience: blocking one-shot generation --------------------------
+
+    def generate(self, prompts: List[List[int]],
+                 params: Optional[SamplingParams] = None) -> List[List[int]]:
+        """Batch-generate (the reference's offline `LLM.generate` analog)."""
+        ids = [f"gen-{i}" for i in range(len(prompts))]
+        for rid, p in zip(ids, prompts):
+            self.add_request(rid, p, params)
+        done: Dict[str, List[int]] = {rid: [] for rid in ids}
+        finished: set = set()
+        while len(finished) < len(ids):
+            if not self.step():
+                time.sleep(0.001)
+            for rid in ids:
+                for out in self.get_outputs(rid):
+                    done[rid].extend(out.new_token_ids)
+                    if out.finished:
+                        finished.add(rid)
+        return [done[rid] for rid in ids]

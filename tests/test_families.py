@@ -1,0 +1,291 @@
+"""Model-family tests: synthetic HF checkpoints per family -> convert ->
+cacheless forward vs prefill+decode consistency -> generate.
+
+Mirrors the reference's per-family optimized-forward coverage (SURVEY.md §2
+transformers/models/, 30 files) with one parameterized harness."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.generation import generate_on_device
+from bigdl_tpu.models import llama as llama_mod
+from bigdl_tpu.models.registry import get_family, supported_architectures
+
+
+def t(rng, *shape, scale=0.05):
+    return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+
+def ln_pair(rng, prefix, d, bias=True):
+    out = [(f"{prefix}.weight", np.ones((d,), np.float32))]
+    if bias:
+        out.append((f"{prefix}.bias", np.zeros((d,), np.float32)))
+    return out
+
+
+D, FF, V, L, H = 64, 128, 96, 2, 8
+
+
+def fake_ckpt(arch):
+    """(hf_config, [(name, tensor)]) for a tiny model of each family."""
+    rng = np.random.default_rng(0)
+    hd = D // H
+
+    if arch == "GemmaForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
+              "intermediate_size": FF, "num_hidden_layers": L,
+              "num_attention_heads": H, "num_key_value_heads": 4,
+              "head_dim": hd, "rms_norm_eps": 1e-6,
+              "tie_word_embeddings": True}
+        ts = [("model.embed_tokens.weight", t(rng, V, D)),
+              ("model.norm.weight", np.zeros((D,), np.float32))]
+        for i in range(L):
+            p = f"model.layers.{i}."
+            ts += [(p + "self_attn.q_proj.weight", t(rng, H * hd, D)),
+                   (p + "self_attn.k_proj.weight", t(rng, 4 * hd, D)),
+                   (p + "self_attn.v_proj.weight", t(rng, 4 * hd, D)),
+                   (p + "self_attn.o_proj.weight", t(rng, D, H * hd)),
+                   (p + "mlp.gate_proj.weight", t(rng, FF, D)),
+                   (p + "mlp.up_proj.weight", t(rng, FF, D)),
+                   (p + "mlp.down_proj.weight", t(rng, D, FF)),
+                   (p + "input_layernorm.weight", np.zeros((D,), np.float32)),
+                   (p + "post_attention_layernorm.weight",
+                    np.zeros((D,), np.float32))]
+        return hf, ts
+
+    if arch == "PhiForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
+              "intermediate_size": FF, "num_hidden_layers": L,
+              "num_attention_heads": H, "layer_norm_eps": 1e-5,
+              "partial_rotary_factor": 0.5}
+        ts = [("model.embed_tokens.weight", t(rng, V, D)),
+              ("lm_head.weight", t(rng, V, D)),
+              ("lm_head.bias", np.zeros((V,), np.float32))]
+        ts += ln_pair(rng, "model.final_layernorm", D)
+        for i in range(L):
+            p = f"model.layers.{i}."
+            for nm, shp in [("self_attn.q_proj", (D, D)),
+                            ("self_attn.k_proj", (D, D)),
+                            ("self_attn.v_proj", (D, D)),
+                            ("self_attn.dense", (D, D)),
+                            ("mlp.fc1", (FF, D)), ("mlp.fc2", (D, FF))]:
+                ts += [(p + nm + ".weight", t(rng, *shp)),
+                       (p + nm + ".bias", np.zeros((shp[0],), np.float32))]
+            ts += ln_pair(rng, p + "input_layernorm", D)
+        return hf, ts
+
+    if arch == "GPTNeoXForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
+              "intermediate_size": FF, "num_hidden_layers": L,
+              "num_attention_heads": H, "layer_norm_eps": 1e-5,
+              "rotary_pct": 0.25, "use_parallel_residual": True}
+        ts = [("gpt_neox.embed_in.weight", t(rng, V, D)),
+              ("embed_out.weight", t(rng, V, D))]
+        ts += ln_pair(rng, "gpt_neox.final_layer_norm", D)
+        for i in range(L):
+            p = f"gpt_neox.layers.{i}."
+            ts += [(p + "attention.query_key_value.weight", t(rng, 3 * D, D)),
+                   (p + "attention.query_key_value.bias",
+                    np.zeros((3 * D,), np.float32)),
+                   (p + "attention.dense.weight", t(rng, D, D)),
+                   (p + "attention.dense.bias", np.zeros((D,), np.float32)),
+                   (p + "mlp.dense_h_to_4h.weight", t(rng, FF, D)),
+                   (p + "mlp.dense_h_to_4h.bias",
+                    np.zeros((FF,), np.float32)),
+                   (p + "mlp.dense_4h_to_h.weight", t(rng, D, FF)),
+                   (p + "mlp.dense_4h_to_h.bias",
+                    np.zeros((D,), np.float32))]
+            ts += ln_pair(rng, p + "input_layernorm", D)
+            ts += ln_pair(rng, p + "post_attention_layernorm", D)
+        return hf, ts
+
+    if arch == "BloomForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
+              "n_layer": L, "n_head": H, "layer_norm_epsilon": 1e-5}
+        ts = [("transformer.word_embeddings.weight", t(rng, V, D))]
+        ts += ln_pair(rng, "transformer.word_embeddings_layernorm", D)
+        ts += ln_pair(rng, "transformer.ln_f", D)
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            ts += [(p + "self_attention.query_key_value.weight",
+                    t(rng, 3 * D, D)),
+                   (p + "self_attention.query_key_value.bias",
+                    np.zeros((3 * D,), np.float32)),
+                   (p + "self_attention.dense.weight", t(rng, D, D)),
+                   (p + "self_attention.dense.bias",
+                    np.zeros((D,), np.float32)),
+                   (p + "mlp.dense_h_to_4h.weight", t(rng, 4 * D, D)),
+                   (p + "mlp.dense_h_to_4h.bias",
+                    np.zeros((4 * D,), np.float32)),
+                   (p + "mlp.dense_4h_to_h.weight", t(rng, D, 4 * D)),
+                   (p + "mlp.dense_4h_to_h.bias",
+                    np.zeros((D,), np.float32))]
+            ts += ln_pair(rng, p + "input_layernorm", D)
+            ts += ln_pair(rng, p + "post_attention_layernorm", D)
+        return hf, ts
+
+    if arch == "FalconForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
+              "num_hidden_layers": L, "num_attention_heads": H,
+              "layer_norm_epsilon": 1e-5, "multi_query": True,
+              "parallel_attn": True, "bias": False,
+              "tie_word_embeddings": True}
+        ts = [("transformer.word_embeddings.weight", t(rng, V, D))]
+        ts += ln_pair(rng, "transformer.ln_f", D)
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            ts += [(p + "self_attention.query_key_value.weight",
+                    t(rng, (H + 2) * hd, D)),
+                   (p + "self_attention.dense.weight", t(rng, D, H * hd)),
+                   (p + "mlp.dense_h_to_4h.weight", t(rng, 4 * D, D)),
+                   (p + "mlp.dense_4h_to_h.weight", t(rng, D, 4 * D))]
+            ts += ln_pair(rng, p + "input_layernorm", D)
+        return hf, ts
+
+    if arch == "Starcoder2ForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
+              "intermediate_size": FF, "num_hidden_layers": L,
+              "num_attention_heads": H, "num_key_value_heads": 4,
+              "norm_epsilon": 1e-5, "use_bias": True,
+              "tie_word_embeddings": True}
+        ts = [("model.embed_tokens.weight", t(rng, V, D))]
+        ts += ln_pair(rng, "model.norm", D)
+        for i in range(L):
+            p = f"model.layers.{i}."
+            for nm, shp in [("self_attn.q_proj", (H * hd, D)),
+                            ("self_attn.k_proj", (4 * hd, D)),
+                            ("self_attn.v_proj", (4 * hd, D)),
+                            ("self_attn.o_proj", (D, H * hd)),
+                            ("mlp.c_fc", (FF, D)), ("mlp.c_proj", (D, FF))]:
+                ts += [(p + nm + ".weight", t(rng, *shp)),
+                       (p + nm + ".bias", np.zeros((shp[0],), np.float32))]
+            ts += ln_pair(rng, p + "input_layernorm", D)
+            ts += ln_pair(rng, p + "post_attention_layernorm", D)
+        return hf, ts
+
+    if arch == "BaichuanForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
+              "intermediate_size": FF, "num_hidden_layers": L,
+              "num_attention_heads": H, "num_key_value_heads": H,
+              "rms_norm_eps": 1e-6}
+        ts = [("model.embed_tokens.weight", t(rng, V, D)),
+              ("model.norm.weight", np.ones((D,), np.float32)),
+              ("lm_head.weight", t(rng, V, D))]
+        for i in range(L):
+            p = f"model.layers.{i}."
+            ts += [(p + "self_attn.W_pack.weight", t(rng, 3 * D, D)),
+                   (p + "self_attn.o_proj.weight", t(rng, D, D)),
+                   (p + "mlp.gate_proj.weight", t(rng, FF, D)),
+                   (p + "mlp.up_proj.weight", t(rng, FF, D)),
+                   (p + "mlp.down_proj.weight", t(rng, D, FF)),
+                   (p + "input_layernorm.weight", np.ones((D,), np.float32)),
+                   (p + "post_attention_layernorm.weight",
+                    np.ones((D,), np.float32))]
+        return hf, ts
+
+    if arch == "ChatGLMModel":
+        g = 2  # multi-query groups
+        hf = {"architectures": [arch], "padded_vocab_size": V,
+              "hidden_size": D, "ffn_hidden_size": FF, "num_layers": L,
+              "num_attention_heads": H, "multi_query_attention": True,
+              "multi_query_group_num": g, "layernorm_epsilon": 1e-5,
+              "rmsnorm": True, "add_qkv_bias": True, "seq_length": 512}
+        ts = [("transformer.embedding.word_embeddings.weight", t(rng, V, D)),
+              ("transformer.encoder.final_layernorm.weight",
+               np.ones((D,), np.float32)),
+              ("transformer.output_layer.weight", t(rng, V, D))]
+        for i in range(L):
+            p = f"transformer.encoder.layers.{i}."
+            qkv = H * hd + 2 * g * hd
+            ts += [(p + "self_attention.query_key_value.weight",
+                    t(rng, qkv, D)),
+                   (p + "self_attention.query_key_value.bias",
+                    np.zeros((qkv,), np.float32)),
+                   (p + "self_attention.dense.weight", t(rng, D, H * hd)),
+                   (p + "mlp.dense_h_to_4h.weight", t(rng, 2 * FF, D)),
+                   (p + "mlp.dense_4h_to_h.weight", t(rng, D, FF)),
+                   (p + "input_layernorm.weight", np.ones((D,), np.float32)),
+                   (p + "post_attention_layernorm.weight",
+                    np.ones((D,), np.float32))]
+        return hf, ts
+
+    raise AssertionError(arch)
+
+
+ARCHS = ["GemmaForCausalLM", "PhiForCausalLM", "GPTNeoXForCausalLM",
+         "BloomForCausalLM", "FalconForCausalLM", "Starcoder2ForCausalLM",
+         "BaichuanForCausalLM", "ChatGLMModel"]
+
+
+@pytest.mark.parametrize("arch", ARCHS)
+def test_family_end_to_end(arch):
+    hf, tensors = fake_ckpt(arch)
+    fam = get_family(arch)
+    cfg = fam.config_from_hf(hf)
+    params = fam.convert_params(iter(tensors), cfg, qtype="sym_int4")
+
+    toks = np.asarray([[3, 17, 9, 42, 7, 23, 11, 5]], np.int32) % cfg.vocab_size
+    # cacheless forward
+    full = np.asarray(fam.forward_train(params, cfg, jnp.asarray(toks)))
+    assert full.shape == (1, 8, cfg.vocab_size)
+    assert np.all(np.isfinite(full))
+
+    # prefill + decode consistency
+    cache = fam.new_cache(cfg, 1, 64)
+    lg, cache = fam.forward(params, cfg, jnp.asarray(toks[:, :5]), cache)
+    stepped = [np.asarray(lg)[0]]
+    for i in range(5, 8):
+        lg, cache = fam.forward(params, cfg, jnp.asarray(toks[:, i:i+1]),
+                                cache)
+        stepped.append(np.asarray(lg)[0])
+    stepped = np.concatenate(stepped, axis=0)
+    assert (full[0].argmax(-1) == stepped.argmax(-1)).mean() > 0.85, arch
+
+    # generation runs
+    cache = fam.new_cache(cfg, 1, 64)
+    out, _ = generate_on_device(params, cfg, fam.forward,
+                                jnp.asarray(toks), cache, max_new_tokens=6)
+    out = np.asarray(out)
+    assert out.shape == (1, 6)
+    assert np.all((out >= 0) & (out < cfg.vocab_size))
+
+
+def test_registry_covers_families():
+    archs = supported_architectures()
+    for a in ARCHS + ["LlamaForCausalLM", "MistralForCausalLM",
+                      "Qwen2ForCausalLM", "MixtralForCausalLM"]:
+        assert a in archs, a
+
+
+def test_alibi_slopes_values():
+    s8 = llama_mod.alibi_slopes(8)
+    assert s8.shape == (8,)
+    np.testing.assert_allclose(s8[0], 2 ** -1.0, rtol=1e-6)
+    assert np.all(np.diff(s8) < 0)
+    s12 = llama_mod.alibi_slopes(12)   # non-power-of-two path
+    assert s12.shape == (12,) and np.all(s12 > 0)
+
+
+def test_falcon_new_arch_rejected():
+    fam = get_family("FalconForCausalLM")
+    with pytest.raises(NotImplementedError, match="new_decoder"):
+        fam.config_from_hf({"architectures": ["FalconForCausalLM"],
+                            "vocab_size": V, "hidden_size": D,
+                            "num_hidden_layers": L,
+                            "num_attention_heads": H,
+                            "new_decoder_architecture": True})
+
+
+def test_alibi_with_external_attn_fn_rejected():
+    """sequence-parallel attn_fn + ALiBi must fail loudly, not silently."""
+    hf, tensors = fake_ckpt("BloomForCausalLM")
+    fam = get_family("BloomForCausalLM")
+    cfg = fam.config_from_hf(hf)
+    params = fam.convert_params(iter(tensors), cfg, qtype="sym_int4")
+    toks = jnp.asarray(np.asarray([[1, 2, 3, 4]], np.int32))
+    with pytest.raises(NotImplementedError, match="ALiBi"):
+        llama_mod.forward_train(params, cfg, toks,
+                                attn_fn=lambda q, k, v: q)

@@ -1,0 +1,106 @@
+"""Native C++ kernel tests: bit-exact parity with the JAX quantizers."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import native
+from bigdl_tpu.ops.quant import dequantize, quantize
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None,
+                                reason="no native toolchain")
+
+
+@pytest.mark.parametrize("qtype", ["sym_int4", "sym_int8"])
+@pytest.mark.parametrize("shape", [(32, 8), (128, 64), (96, 33)])
+def test_native_quantize_bit_exact(qtype, shape):
+    rng = np.random.default_rng(0)
+    w = (rng.standard_normal(shape) * 0.3).astype(np.float32)
+    ref = quantize(jnp.asarray(w), qtype)
+    got = native.quantize_native(w, qtype)
+    assert got is not None
+    data, scale = got
+    np.testing.assert_array_equal(np.asarray(ref.data), data)
+    np.testing.assert_array_equal(
+        np.asarray(ref.scale, np.float32),
+        np.asarray(jnp.asarray(scale).astype(jnp.bfloat16), np.float32))
+
+
+def test_native_dequantize_matches_jax():
+    rng = np.random.default_rng(1)
+    w = (rng.standard_normal((64, 16)) * 0.2).astype(np.float32)
+    data, scale = native.quantize_native(w, "sym_int4")
+    out = native.dequantize_q4_0_native(data, scale)
+    qt = quantize(jnp.asarray(w), "sym_int4")
+    ref = np.asarray(dequantize(qt, jnp.float32))
+    # native keeps f32 scales; JAX path rounds through bf16 — small delta
+    np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-2)
+
+
+def test_native_gguf_repack_matches_python():
+    """C++ fused repack == the numpy byte shuffle in gguf.py."""
+    from bigdl_tpu import gguf as G
+
+    rng = np.random.default_rng(2)
+    n_rows, k = 16, 64
+    w = (rng.standard_normal((n_rows, k)) * 0.1).astype(np.float32)
+    raw = G._quantize_block_np(w, G.GGML_Q4_0)
+
+    got = native.repack_gguf_q4_0_native(raw, n_rows, k)
+    assert got is not None
+    data, scale = got
+
+    blk = raw.reshape(n_rows, k // 32, 18)
+    want_scale = np.ascontiguousarray(
+        blk[:, :, :2]).view(np.float16)[..., 0].T.astype(np.float32)
+    want_data = blk[:, :, 2:].transpose(1, 2, 0).reshape(k // 2, n_rows)
+    np.testing.assert_array_equal(data, want_data)
+    np.testing.assert_allclose(scale, want_scale, rtol=1e-3)
+
+
+def test_conversion_uses_native_and_matches(monkeypatch):
+    """convert through Acc with native on vs off: identical QTensors."""
+    from bigdl_tpu.models import llama as llama_mod
+    from bigdl_tpu.utils.testing import TINY_LLAMA
+
+    rng = np.random.default_rng(3)
+    d, v = TINY_LLAMA.hidden_size, TINY_LLAMA.vocab_size
+
+    def tensors():
+        ts = [("model.embed_tokens.weight",
+               (rng.standard_normal((v, d)) * .02).astype(np.float32)),
+              ("model.norm.weight", np.ones((d,), np.float32)),
+              ("lm_head.weight",
+               (rng.standard_normal((v, d)) * .02).astype(np.float32))]
+        for i in range(TINY_LLAMA.num_hidden_layers):
+            p = f"model.layers.{i}."
+            ff, hd = TINY_LLAMA.intermediate_size, TINY_LLAMA.hd
+            h, hkv = (TINY_LLAMA.num_attention_heads,
+                      TINY_LLAMA.num_key_value_heads)
+            for nm, shp in [("self_attn.q_proj", (h * hd, d)),
+                            ("self_attn.k_proj", (hkv * hd, d)),
+                            ("self_attn.v_proj", (hkv * hd, d)),
+                            ("self_attn.o_proj", (d, h * hd)),
+                            ("mlp.gate_proj", (ff, d)),
+                            ("mlp.up_proj", (ff, d)),
+                            ("mlp.down_proj", (d, ff))]:
+                ts.append((p + nm + ".weight",
+                           (rng.standard_normal(shp) * .02).astype(
+                               np.float32)))
+            ts.append((p + "input_layernorm.weight",
+                       np.ones((d,), np.float32)))
+            ts.append((p + "post_attention_layernorm.weight",
+                       np.ones((d,), np.float32)))
+        return ts
+
+    ts = tensors()
+    p_native = llama_mod.convert_hf_params(iter(ts), TINY_LLAMA,
+                                           qtype="sym_int4")
+    monkeypatch.setenv("BIGDL_TPU_DISABLE_NATIVE", "1")
+    p_jax = llama_mod.convert_hf_params(iter(ts), TINY_LLAMA,
+                                        qtype="sym_int4")
+    a = p_native["layers"]["q_proj"]
+    b = p_jax["layers"]["q_proj"]
+    np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
+    np.testing.assert_array_equal(
+        np.asarray(a.scale, np.float32), np.asarray(b.scale, np.float32))
