@@ -1,0 +1,64 @@
+"""Central runtime flags: one typed object + env overrides.
+
+The reference's de-facto flag system is ~12 scattered environment
+variables (SURVEY.md §5: BIGDL_OPT_IPEX, IPEX_LLM_QUANTIZE_KV_CACHE,
+IPEX_LLM_LOW_MEM, BIGDL_LLM_XMX_DISABLED, KV_CACHE_ALLOC_BLOCK_LENGTH...).
+Here every knob lives on one dataclass, read once from the environment and
+overridable in code — `flags()` is the single source of truth the rest of
+the framework consults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+@dataclasses.dataclass
+class RuntimeFlags:
+    # kernel dispatch: "auto" (Pallas on TPU when supported), "xla", "pallas"
+    matmul_backend: str = "auto"
+    # host-side C++ kernels (bigdl_tpu.native); disable to force pure JAX
+    disable_native: bool = False
+    native_cache_dir: Optional[str] = None
+    # default for quantize_kv_cache when the caller doesn't specify
+    # (reference IPEX_LLM_QUANTIZE_KV_CACHE)
+    quantize_kv_cache: bool = False
+    # default max sequence length for loaded models
+    default_max_seq: int = 2048
+
+    @classmethod
+    def from_env(cls) -> "RuntimeFlags":
+        return cls(
+            matmul_backend=os.environ.get("BIGDL_TPU_MATMUL_BACKEND", "auto"),
+            disable_native=_env_bool("BIGDL_TPU_DISABLE_NATIVE"),
+            native_cache_dir=os.environ.get("BIGDL_TPU_NATIVE_CACHE"),
+            quantize_kv_cache=_env_bool("BIGDL_TPU_QUANTIZE_KV_CACHE"),
+            default_max_seq=int(os.environ.get("BIGDL_TPU_MAX_SEQ", "2048")),
+        )
+
+
+_flags: Optional[RuntimeFlags] = None
+
+
+def flags() -> RuntimeFlags:
+    global _flags
+    if _flags is None:
+        _flags = RuntimeFlags.from_env()
+    return _flags
+
+
+def set_flags(**kwargs) -> RuntimeFlags:
+    """Override flags in code (tests, notebooks). Returns the new flags."""
+    global _flags
+    f = dataclasses.replace(flags(), **kwargs)
+    _flags = f
+    return f

@@ -1,0 +1,46 @@
+"""One-line patch of Hugging Face transformers onto bigdl-tpu.
+
+Equivalent of the reference's `llm_patch`/`llm_unpatch`
+(reference llm_patching.py:48: swaps transformers Auto* classes for the
+ipex-llm ones so third-party code gains low-bit loading unmodified).
+
+    import bigdl_tpu
+    bigdl_tpu.llm_patch()          # transformers.AutoModelForCausalLM is ours
+    ...
+    bigdl_tpu.llm_unpatch()
+"""
+
+from __future__ import annotations
+
+_saved = {}
+
+
+def llm_patch(load_in_4bit_default: bool = True) -> None:
+    """Replace transformers.AutoModelForCausalLM/AutoModel with the
+    bigdl-tpu facades (4-bit by default, like the reference's patch)."""
+    import transformers
+
+    from bigdl_tpu.transformers import model as _m
+
+    if _saved:
+        return
+
+    class _PatchedCausalLM(_m.AutoModelForCausalLM):
+        @classmethod
+        def from_pretrained(cls, *args, **kw):
+            kw.setdefault("load_in_4bit", load_in_4bit_default)
+            return super().from_pretrained(*args, **kw)
+
+    _saved["AutoModelForCausalLM"] = transformers.AutoModelForCausalLM
+    _saved["AutoModel"] = transformers.AutoModel
+    transformers.AutoModelForCausalLM = _PatchedCausalLM
+    transformers.AutoModel = _m.AutoModel
+
+
+def llm_unpatch() -> None:
+    import transformers
+
+    if not _saved:
+        return
+    transformers.AutoModelForCausalLM = _saved.pop("AutoModelForCausalLM")
+    transformers.AutoModel = _saved.pop("AutoModel")

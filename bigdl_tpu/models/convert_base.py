@@ -36,7 +36,12 @@ class Acc:
 
         Quantization prefers the native C++ kernels (bigdl_tpu.native, the
         quantize-llama-binary equivalent) — bit-identical to the JAX path,
-        which remains the fallback."""
+        which remains the fallback. Already-quantized leaves (GPTQ/AWQ
+        repack, transformers/gptq_awq.py) pass through unchanged."""
+        from bigdl_tpu.ops.quant import QTensor as _QT
+
+        if isinstance(w, _QT):
+            return w
         if self.do_quant and not any(m in name for m in self.skip):
             from bigdl_tpu.native import quantize_native
             from bigdl_tpu.ops.quant import QTensor
@@ -84,9 +89,12 @@ def make_convert(map_tensor: Callable) -> Callable:
 
     def convert(tensors, cfg, qtype="sym_int4", compute_dtype=jnp.bfloat16,
                 modules_to_not_convert: Tuple[str, ...] = ()):
+        from bigdl_tpu.ops.quant import QTensor
+
         acc = Acc(cfg, qtype, compute_dtype, modules_to_not_convert)
         for name, w in tensors:
-            map_tensor(acc, name, np.asarray(w))
+            map_tensor(acc, name,
+                       w if isinstance(w, QTensor) else np.asarray(w))
         return acc.finish(cfg.tie_word_embeddings)
 
     return convert

@@ -48,6 +48,23 @@ def _gemma_cfg(hf: Dict[str, Any]) -> LlamaConfig:
     )
 
 
+def _gemma2_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    """Gemma2 (reference transformers/models/gemma2 path): gemma plus
+    sandwich norms, attention/final soft caps, scaled queries, and a
+    sliding window on even layers."""
+    import dataclasses
+
+    return dataclasses.replace(
+        _gemma_cfg(hf),
+        sandwich_norms=True,
+        attn_soft_cap=hf.get("attn_logit_softcapping", 50.0),
+        logits_soft_cap=hf.get("final_logit_softcapping", 30.0),
+        query_pre_attn_scalar=float(hf.get("query_pre_attn_scalar", 256)),
+        sliding_window=hf.get("sliding_window", 4096),
+        alt_sliding_window=True,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Phi (phi-1/1.5/2) — parallel residual, shared LN, dense gelu MLP,
 # partial rotary, biases everywhere (reference models/phixtral.py kin)
@@ -552,6 +569,15 @@ def register_all() -> None:
         name="gemma",
         config_from_hf=_gemma_cfg,
         convert_params=llama_convert,     # same tensor names as llama
+        forward=llama_mod.forward,
+        prefill=llama_mod.forward_last_token,
+        forward_train=llama_mod.forward_train,
+        new_cache=llama_mod.new_cache,
+    ))
+    register_family(["Gemma2ForCausalLM"], FamilyAdapter(
+        name="gemma2",
+        config_from_hf=_gemma2_cfg,
+        convert_params=llama_convert,
         forward=llama_mod.forward,
         prefill=llama_mod.forward_last_token,
         forward_train=llama_mod.forward_train,

@@ -36,16 +36,24 @@ import functools
 from bigdl_tpu.ops.attention import sdp_attention
 from bigdl_tpu.ops.kvcache import KVCache, init_cache, read_layer, update_layer
 from bigdl_tpu.ops.matmul import linear
+from bigdl_tpu.ops.embedding import embedding_lookup
 from bigdl_tpu.ops.norms import layer_norm, rms_norm
-from bigdl_tpu.ops.rope import apply_rope, rope_cos_sin, rope_freqs
+from bigdl_tpu.ops.rope import (apply_rope, rope_cos_sin, rope_freqs,
+                                scaled_rope_freqs)
 
 
 def _lm_head(x, params, cfg):
     """Final projection (tied or separate), f32 logits, optional softcap."""
+    from bigdl_tpu.ops.quant import QTensor
+
     lm_head = params.get("lm_head")
     if lm_head is None:
-        logits = jnp.dot(x, params["embed_tokens"].T.astype(x.dtype),
-                         preferred_element_type=jnp.float32)
+        emb = params["embed_tokens"]
+        if isinstance(emb, QTensor):      # quantized table is [D, V]
+            logits = linear(x, emb)
+        else:
+            logits = jnp.dot(x, emb.T.astype(x.dtype),
+                             preferred_element_type=jnp.float32)
     else:
         logits = linear(x, lm_head, params.get("lm_head_bias"))
     logits = logits.astype(jnp.float32)
@@ -94,6 +102,14 @@ class LlamaConfig:
     logits_soft_cap: Optional[float] = None   # gemma2 final logits
     attn_soft_cap: Optional[float] = None     # gemma2 attention scores
     lm_head_bias: bool = False          # phi
+    # non-linear rope scaling (yarn/dynamic/llama3) as a hashable
+    # sorted-items tuple; linear scaling uses rope_scaling_factor
+    rope_scaling: Optional[Tuple[Tuple[str, Any], ...]] = None
+    # gemma2 block shape: norms AFTER attn/mlp outputs too, scaled queries,
+    # sliding window on even layers only
+    sandwich_norms: bool = False
+    query_pre_attn_scalar: Optional[float] = None
+    alt_sliding_window: bool = False
 
     @property
     def hd(self) -> int:
@@ -104,14 +120,19 @@ class LlamaConfig:
         """Build from an HF config dict (config.json of llama/mistral...)."""
         rs = hf.get("rope_scaling") or {}
         factor = 1.0
+        rs_tuple = None
         if rs:
             rtype = rs.get("rope_type", rs.get("type", "linear"))
             if rtype == "linear":
                 factor = float(rs.get("factor", 1.0))
-            elif rtype != "default":
-                raise NotImplementedError(
-                    f"rope_scaling type {rtype!r} not supported yet "
-                    "(supported: linear)")
+            elif rtype in ("default", "none"):
+                pass
+            else:
+                # yarn / dynamic / llama3: handled by scaled_rope_freqs;
+                # stored as a hashable tuple (config is a jit static arg)
+                rs_tuple = tuple(sorted(
+                    (k, v) for k, v in rs.items()
+                    if isinstance(v, (int, float, str))))
         return cls(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -124,6 +145,7 @@ class LlamaConfig:
             rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
             rope_theta=hf.get("rope_theta", 10000.0),
             rope_scaling_factor=factor,
+            rope_scaling=rs_tuple,
             max_position_embeddings=hf.get("max_position_embeddings", 4096),
             tie_word_embeddings=hf.get("tie_word_embeddings", False),
             attention_bias=hf.get("attention_bias", False),
@@ -145,6 +167,17 @@ class LlamaConfig:
 #   "norm": [D],
 #   "lm_head": QTensor/dense [D, V] (absent when tied),
 # }
+
+
+def model_rope_freqs(cfg: "LlamaConfig"):
+    """(inv_freq, attention_factor) honoring cfg.rope_scaling."""
+    if cfg.rope_scaling is not None:
+        return scaled_rope_freqs(
+            cfg.hd, cfg.rope_theta, dict(cfg.rope_scaling),
+            rotary_dim=cfg.rotary_dim,
+            max_position_embeddings=cfg.max_position_embeddings)
+    return rope_freqs(cfg.hd, cfg.rope_theta, rotary_dim=cfg.rotary_dim,
+                      scaling_factor=cfg.rope_scaling_factor), 1.0
 
 
 def alibi_slopes(n_heads: int) -> np.ndarray:
@@ -191,10 +224,16 @@ def _mlp(hidden, lp, cfg: LlamaConfig):
 
 
 def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
-                cache_ctx=None):
+                cache_ctx=None, lidx=None):
     """QKV + rope + (cached) attention + output projection."""
     b, sq, _ = hidden.shape
     h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    scale = (cfg.query_pre_attn_scalar ** -0.5
+             if cfg.query_pre_attn_scalar is not None else None)
+    sw = cfg.sliding_window
+    if cfg.alt_sliding_window and sw is not None and lidx is not None:
+        # gemma2: sliding attention on even layers, global on odd
+        sw = jnp.where(lidx % 2 == 0, sw, jnp.int32(1 << 30))
     q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias")).reshape(
         b, sq, h, hd)
     k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias")).reshape(
@@ -206,17 +245,17 @@ def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
         k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
 
     if cache_ctx is not None:
-        ck, cv, lidx, pos = cache_ctx
-        ck, cv = update_layer(ck, cv, lidx, k, v, pos)
-        kf, vf = read_layer(ck, cv, lidx)
-        attn = sdp_attention(q, kf, vf, pos,
-                             sliding_window=cfg.sliding_window,
+        ck, cv, clidx, pos = cache_ctx
+        ck, cv = update_layer(ck, cv, clidx, k, v, pos)
+        kf, vf = read_layer(ck, cv, clidx)
+        attn = sdp_attention(q, kf, vf, pos, scale=scale,
+                             sliding_window=sw,
                              logits_soft_cap=cfg.attn_soft_cap,
                              alibi_slopes=slopes)
         out = (ck, cv)
     else:
-        attn = sdp_attention(q, k, v, jnp.zeros((), jnp.int32),
-                             sliding_window=cfg.sliding_window,
+        attn = sdp_attention(q, k, v, jnp.zeros((), jnp.int32), scale=scale,
+                             sliding_window=sw,
                              logits_soft_cap=cfg.attn_soft_cap,
                              alibi_slopes=slopes)
         out = None
@@ -225,12 +264,23 @@ def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
 
 
 def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, slopes,
-                   cache_ctx=None):
-    """One transformer block, sequential or parallel residual."""
+                   cache_ctx=None, lidx=None):
+    """One transformer block, sequential/parallel/sandwich residual."""
     hidden = _norm(x, lp["input_layernorm"],
                    lp.get("input_layernorm_bias"), cfg)
     attn_out, cache_out = _attn_block(hidden, lp, cfg, cos, sin, slopes,
-                                      cache_ctx)
+                                      cache_ctx, lidx=lidx)
+    if cfg.sandwich_norms:
+        # gemma2: x += postnorm(attn(prenorm(x))); same sandwich for mlp
+        attn_out = _norm(attn_out, lp["post_attention_layernorm"],
+                         lp.get("post_attention_layernorm_bias"), cfg)
+        x = x + attn_out
+        mlp_in = _norm(x, lp["pre_feedforward_layernorm"],
+                       lp.get("pre_feedforward_layernorm_bias"), cfg)
+        mlp_out = _mlp(mlp_in, lp, cfg)
+        mlp_out = _norm(mlp_out, lp["post_feedforward_layernorm"],
+                        lp.get("post_feedforward_layernorm_bias"), cfg)
+        return x + mlp_out, cache_out
     if cfg.parallel_residual:
         if cfg.shared_input_norm:
             mlp_in = hidden
@@ -250,7 +300,7 @@ def _layer_step(cfg: LlamaConfig, slopes, carry, xs):
     x, ck, cv, pos, cos, sin = carry
     lp, lidx = xs
     x, (ck, cv) = _decoder_layer(x, lp, cfg, cos, sin, slopes,
-                                 cache_ctx=(ck, cv, lidx, pos))
+                                 cache_ctx=(ck, cv, lidx, pos), lidx=lidx)
     return (x, ck, cv, pos, cos, sin), None
 
 
@@ -273,20 +323,21 @@ def forward(
     b, sq = tokens.shape
     pos = cache.pos
 
-    x = params["embed_tokens"][tokens].astype(compute_dtype)
+    x = embedding_lookup(params["embed_tokens"], tokens, compute_dtype)
     if cfg.embed_scale != 1.0:
         x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
     if cfg.embed_norm:
         x = _norm(x, params["embed_norm"], params.get("embed_norm_bias"), cfg)
 
-    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta, rotary_dim=cfg.rotary_dim,
-                          scaling_factor=cfg.rope_scaling_factor)
+    inv_freq, rope_mscale = model_rope_freqs(cfg)
     if getattr(pos, "ndim", 0) == 1:   # per-slot positions (serving)
         positions = pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None, :]
         cos, sin = rope_cos_sin(positions, inv_freq)       # [B, Sq, hd/2]
     else:
         positions = pos + jnp.arange(sq, dtype=jnp.int32)
         cos, sin = rope_cos_sin(positions[None, :], inv_freq)  # [1, Sq, hd/2]
+    if rope_mscale != 1.0:             # yarn attention temperature
+        cos, sin = cos * rope_mscale, sin * rope_mscale
     slopes = (jnp.asarray(alibi_slopes(cfg.num_attention_heads))
               if cfg.use_alibi else None)
 
@@ -336,15 +387,16 @@ def forward_train(
     offsets — the model body is otherwise unchanged.
     """
     b, s = tokens.shape
-    x = params["embed_tokens"][tokens].astype(compute_dtype)
+    x = embedding_lookup(params["embed_tokens"], tokens, compute_dtype)
     if cfg.embed_scale != 1.0:
         x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
     if cfg.embed_norm:
         x = _norm(x, params["embed_norm"], params.get("embed_norm_bias"), cfg)
-    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta, rotary_dim=cfg.rotary_dim,
-                          scaling_factor=cfg.rope_scaling_factor)
+    inv_freq, rope_mscale = model_rope_freqs(cfg)
     positions = pos_offset + jnp.arange(s, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions[None, :], inv_freq)
+    if rope_mscale != 1.0:             # yarn attention temperature
+        cos, sin = cos * rope_mscale, sin * rope_mscale
 
     h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
 
@@ -352,12 +404,13 @@ def forward_train(
               if cfg.use_alibi else None)
 
     if attn_fn is not None:
-        if cfg.use_alibi or cfg.attn_soft_cap is not None:
+        if (cfg.use_alibi or cfg.attn_soft_cap is not None
+                or cfg.sandwich_norms or cfg.alt_sliding_window
+                or cfg.query_pre_attn_scalar is not None):
             raise NotImplementedError(
                 "external attn_fn (sequence-parallel ring attention) does "
-                "not support ALiBi or attention soft-cap families yet; "
-                "train these single-device or add bias support to "
-                "ops/ring.py")
+                "not support ALiBi/soft-cap/gemma2-style families yet; "
+                "train these single-device or extend ops/ring.py")
         ext_attn = attn_fn
 
         @jax.checkpoint
@@ -386,12 +439,18 @@ def forward_train(
             return x2 + _mlp(hidden2, lp, cfg)
     else:
         @jax.checkpoint
-        def layer(x, lp):
+        def layer(x, lp, lidx):
             out, _ = _decoder_layer(x, lp, cfg, cos, sin, slopes,
-                                    cache_ctx=None)
+                                    cache_ctx=None, lidx=lidx)
             return out
 
-    x, _ = lax.scan(lambda c, lp: (layer(c, lp), None), x, params["layers"])
+    if attn_fn is not None:
+        x, _ = lax.scan(lambda c, lp: (layer(c, lp), None), x,
+                        params["layers"])
+    else:
+        lids = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
+        x, _ = lax.scan(lambda c, xs: (layer(c, xs[0], xs[1]), None), x,
+                        (params["layers"], lids))
     x = _norm(x, params["norm"], params.get("norm_bias"), cfg)
     return _lm_head(x, params, cfg)
 
@@ -438,7 +497,9 @@ def _llama_map(acc, name: str, w) -> None:
                 acc.put(key, idx, acc.linear(name, w))
             else:
                 acc.put(f"{key}_bias", idx, acc.dense(w))
-        elif sub in ("input_layernorm", "post_attention_layernorm"):
+        elif sub in ("input_layernorm", "post_attention_layernorm",
+                     "pre_feedforward_layernorm",
+                     "post_feedforward_layernorm"):
             acc.put(sub, idx, acc.dense(w))
         # rotary_emb.inv_freq etc. are derived, skip
 

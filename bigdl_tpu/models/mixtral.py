@@ -36,7 +36,7 @@ from bigdl_tpu.ops.kvcache import KVCache, read_layer, update_layer
 from bigdl_tpu.ops.matmul import linear, q_matmul
 from bigdl_tpu.ops.norms import rms_norm
 from bigdl_tpu.ops.quant import QTensor
-from bigdl_tpu.ops.rope import apply_rope, rope_cos_sin, rope_freqs
+from bigdl_tpu.ops.rope import apply_rope, rope_cos_sin
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,15 +120,17 @@ def forward(
 ) -> Tuple[jax.Array, KVCache]:
     b, sq = tokens.shape
     pos = cache.pos
-    x = params["embed_tokens"][tokens].astype(compute_dtype)
-    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
-                          scaling_factor=cfg.rope_scaling_factor)
+    x = llama_mod.embedding_lookup(params["embed_tokens"], tokens,
+                                   compute_dtype)
+    inv_freq, rope_mscale = llama_mod.model_rope_freqs(cfg)
     if getattr(pos, "ndim", 0) == 1:   # per-slot positions (serving)
         positions = pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None, :]
         cos, sin = rope_cos_sin(positions, inv_freq)
     else:
         positions = pos + jnp.arange(sq, dtype=jnp.int32)
         cos, sin = rope_cos_sin(positions[None, :], inv_freq)
+    if rope_mscale != 1.0:
+        cos, sin = cos * rope_mscale, sin * rope_mscale
 
     lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
     (x, ck, cv, _, _, _), _ = lax.scan(
@@ -162,10 +164,12 @@ def forward_train(
 ) -> jax.Array:
     """Cacheless causal forward (QLoRA finetuning of MoE models)."""
     b, s = tokens.shape
-    x = params["embed_tokens"][tokens].astype(compute_dtype)
-    inv_freq = rope_freqs(cfg.hd, cfg.rope_theta,
-                          scaling_factor=cfg.rope_scaling_factor)
+    x = llama_mod.embedding_lookup(params["embed_tokens"], tokens,
+                                   compute_dtype)
+    inv_freq, rope_mscale = llama_mod.model_rope_freqs(cfg)
     cos, sin = rope_cos_sin(jnp.arange(s, dtype=jnp.int32)[None, :], inv_freq)
+    if rope_mscale != 1.0:
+        cos, sin = cos * rope_mscale, sin * rope_mscale
     h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
 
     @jax.checkpoint

@@ -1,0 +1,42 @@
+"""Quantized embedding table: low-bit storage + gather-dequantize lookup.
+
+Equivalent of the reference's `LowBitEmbedding` (reference transformers/
+embedding.py:77-114: quantized table + native `dequantize_rows` gather; the
+CPU-pinned `LLMEmbedding` at :57 exists for Windows iGPU memory pressure
+and has no TPU analog — HBM is the only tier).
+
+Storage layout: the [V, D] table is kept as a QTensor of logical shape
+[D, V] (blocks along D, vocab on the N axis), so a lookup is a gather of
+PACKED columns followed by block dequantization of just the gathered ids —
+HBM traffic is ids x D/2 bytes, and a TIED lm_head is exactly
+`q_matmul(x, table)` with no extra transform.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops.quant import QTensor, dequantize, quantize
+
+
+def quantize_embedding(table_vd: jax.Array, qtype: str) -> QTensor:
+    """[V, D] float table -> QTensor [D, V] (blocks along D)."""
+    return quantize(jnp.asarray(table_vd).T, qtype)
+
+
+def embedding_lookup(table, ids: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    """ids [...] -> embeddings [..., D]; table is dense [V, D] or QTensor."""
+    if not isinstance(table, QTensor):
+        return table[ids].astype(dtype)
+    flat = ids.reshape(-1)                       # [n]
+    gathered = QTensor(
+        table.data[:, flat],
+        table.scale[:, flat],
+        None if table.zero is None else table.zero[:, flat],
+        table.qtype,
+        (table.k, flat.shape[0]),
+        aux=None if table.aux is None else table.aux[:, flat],
+    )
+    dense = dequantize(gathered, dtype=dtype)    # [D, n]
+    return dense.T.reshape(*ids.shape, table.k)

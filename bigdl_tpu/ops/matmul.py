@@ -40,7 +40,10 @@ _PALLAS_QTYPES = frozenset({"sym_int4", "asym_int4", "nf4", "fp4", "nf3", "sym_i
 
 
 def _backend() -> str:
-    return os.environ.get(_BACKEND_ENV, "auto")
+    # flags() folds BIGDL_TPU_MATMUL_BACKEND in at init; set_flags() wins
+    from bigdl_tpu.config import flags
+
+    return flags().matmul_backend
 
 
 def _on_tpu(x: jax.Array) -> bool:

@@ -26,11 +26,78 @@ def rope_freqs(
     rotary_dim: Optional[int] = None,
     scaling_factor: float = 1.0,
 ) -> jax.Array:
-    """Inverse frequencies [rotary_dim // 2] (f32)."""
+    """Inverse frequencies [rotary_dim // 2] (f32), linear scaling only."""
     rd = rotary_dim or head_dim
     exponent = jnp.arange(0, rd, 2, dtype=jnp.float32) / rd
     inv_freq = 1.0 / (base ** exponent)
     return inv_freq / scaling_factor
+
+
+def scaled_rope_freqs(
+    head_dim: int,
+    base: float,
+    scaling: dict,
+    rotary_dim: Optional[int] = None,
+    max_position_embeddings: int = 4096,
+):
+    """(inv_freq [rd//2], attention_factor) for every HF rope_scaling type.
+
+    Long-context rope variants the reference only reaches via per-model
+    forks (chatglm2_32k etc., convert.py:862-888) are first-class here:
+    linear, dynamic-NTK (static form), yarn (with the ln-scaled attention
+    factor), and llama3's piecewise frequency remapping.
+    """
+    import math
+
+    rd = rotary_dim or head_dim
+    rtype = scaling.get("rope_type", scaling.get("type", "linear"))
+    factor = float(scaling.get("factor", 1.0))
+    half = jnp.arange(0, rd, 2, dtype=jnp.float32)
+
+    if rtype in ("default", "none"):
+        return rope_freqs(head_dim, base, rotary_dim), 1.0
+    if rtype == "linear":
+        return rope_freqs(head_dim, base, rotary_dim, factor), 1.0
+    if rtype in ("dynamic", "ntk"):
+        # static NTK-aware base adjustment at the scaled context length
+        base = base * (factor ** (rd / (rd - 2)))
+        return 1.0 / (base ** (half / rd)), 1.0
+    if rtype == "llama3":
+        inv = 1.0 / (base ** (half / rd))
+        orig = float(scaling.get("original_max_position_embeddings", 8192))
+        lo_f = float(scaling.get("low_freq_factor", 1.0))
+        hi_f = float(scaling.get("high_freq_factor", 4.0))
+        low_wl = orig / lo_f
+        high_wl = orig / hi_f
+        wavelen = 2.0 * jnp.pi / inv
+        smooth = (orig / wavelen - lo_f) / (hi_f - lo_f)
+        mid = (1.0 - smooth) * inv / factor + smooth * inv
+        out = jnp.where(wavelen > low_wl, inv / factor, inv)
+        out = jnp.where((wavelen <= low_wl) & (wavelen >= high_wl), mid, out)
+        return out, 1.0
+    if rtype == "yarn":
+        orig = float(scaling.get("original_max_position_embeddings",
+                                 max_position_embeddings))
+        beta_fast = float(scaling.get("beta_fast", 32.0))
+        beta_slow = float(scaling.get("beta_slow", 1.0))
+        inv = 1.0 / (base ** (half / rd))
+
+        def correction_dim(n_rot):
+            return (rd * math.log(orig / (n_rot * 2 * math.pi))
+                    / (2 * math.log(base)))
+
+        low = math.floor(correction_dim(beta_fast))
+        high = math.ceil(correction_dim(beta_slow))
+        low, high = max(low, 0), min(high, rd - 1)
+        span = max(high - low, 1e-3)
+        ramp = jnp.clip((jnp.arange(rd // 2, dtype=jnp.float32) - low)
+                        / span, 0.0, 1.0)
+        extrap_mask = 1.0 - ramp     # 1 where NO interpolation (high freq)
+        out = (inv / factor) * ramp + inv * extrap_mask
+        attn = float(scaling.get(
+            "attention_factor", 0.1 * math.log(factor) + 1.0))
+        return out, attn
+    raise NotImplementedError(f"rope_scaling type {rtype!r} not supported")
 
 
 def rope_cos_sin(

@@ -1,0 +1,116 @@
+"""ReLoRA: periodic LoRA merge-and-restart training.
+
+Equivalent of the reference's ReLoRA stack (reference transformers/
+relora.py: `ReLoRATrainer` at :64, `ReLoRACallback` merging adapters every
+`relora_steps` at :149, optimizer reset at :128, jagged-cosine LR schedule
+`ReLoRAScheduler` at :286, `merge_and_save` at :383). High-rank updates
+accumulate through a sequence of low-rank cycles.
+
+Functional form: no Trainer subclass — a restart is a pure transformation
+(merge adapters into the (re-quantized) base, re-init fresh adapters, reset
+optimizer state) applied between train steps, and the jagged-cosine LR is
+an optax-style schedule. Everything composes with training.py's partitioned
+step and with sequence/data parallelism unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bigdl_tpu.qlora import LoraConfig, attach_lora, lora_trainable_mask, merge_lora
+from bigdl_tpu.training import combine, partition
+
+
+def jagged_cosine_schedule(
+    base_lr: float,
+    relora_steps: int,
+    warmup_steps: int = 10,
+    min_lr_ratio: float = 0.1,
+) -> Callable:
+    """The reference's ReLoRAScheduler (relora.py:286): every cycle does a
+    short linear re-warmup then cosine-decays to min_lr_ratio.
+
+    Note: train_relora re-inits the optimizer at each restart, which
+    resets optax's step count — there the `mod` below is a no-op and each
+    cycle is just warmup+cosine. The mod matters when this schedule is
+    used with a single long-lived optimizer (no state resets)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        cycle_pos = jnp.mod(step, relora_steps)
+        warm = jnp.minimum(cycle_pos / max(warmup_steps, 1), 1.0)
+        cos = min_lr_ratio + (1.0 - min_lr_ratio) * 0.5 * (
+            1.0 + jnp.cos(math.pi * cycle_pos / relora_steps))
+        return base_lr * warm * cos
+
+    return schedule
+
+
+def relora_restart(
+    train: Any,
+    frozen: Any,
+    optimizer: optax.GradientTransformation,
+    config: LoraConfig,
+    *,
+    key: Optional[jax.Array] = None,
+    requantize: bool = True,
+) -> Tuple[Any, Any, Any, Any]:
+    """Merge current adapters into the base and start a fresh cycle.
+
+    Returns (train, frozen, opt_state, mask): the merged base becomes the
+    new frozen tree, adapters re-initialize (B zero, so the restart is
+    loss-neutral), and optimizer state resets (the reference prunes
+    optimizer moments at :128; a fresh init is the clean equivalent for
+    adapters that are themselves fresh).
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    params = combine(train, frozen)
+    merged = merge_lora(params, requantize=requantize)
+    fresh = attach_lora(merged, config, key=key)
+    mask = lora_trainable_mask(fresh)
+    train2, frozen2 = partition(fresh, mask)
+    opt_state = optimizer.init(train2)
+    return train2, frozen2, opt_state, mask
+
+
+def train_relora(
+    forward_train: Callable,
+    cfg: Any,
+    params_lora: Any,
+    batches,                        # iterable of batch dicts
+    *,
+    config: LoraConfig = LoraConfig(),
+    base_lr: float = 1e-3,
+    relora_steps: int = 50,
+    warmup_steps: int = 5,
+    seed: int = 0,
+    requantize: bool = True,
+) -> Tuple[Any, list]:
+    """Reference ReLoRATrainer.train, functional: run `batches` with a
+    merge-restart every `relora_steps`. Returns (merged_params, losses)."""
+    from bigdl_tpu.training import make_lora_train_step
+
+    sched = jagged_cosine_schedule(base_lr, relora_steps, warmup_steps)
+    optimizer = optax.adamw(sched)
+    mask = lora_trainable_mask(params_lora)
+    train, frozen = partition(params_lora, mask)
+    opt_state = optimizer.init(train)
+    step_fn = make_lora_train_step(forward_train, cfg, optimizer)
+    key = jax.random.PRNGKey(seed)
+
+    losses = []
+    for i, batch in enumerate(batches):
+        if i > 0 and i % relora_steps == 0:
+            key, sub = jax.random.split(key)
+            train, frozen, opt_state, mask = relora_restart(
+                train, frozen, optimizer, config, key=sub,
+                requantize=requantize)
+        train, opt_state, loss = step_fn(train, opt_state, frozen, batch)
+        losses.append(float(loss))
+    return merge_lora(combine(train, frozen), requantize=requantize), losses

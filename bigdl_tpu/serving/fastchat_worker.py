@@ -1,0 +1,151 @@
+"""FastChat model worker over the bigdl-tpu engine.
+
+Equivalent of the reference's FastChat integration (reference
+serving/fastchat/ipex_llm_worker.py:52 `BigDLLLMWorker`: registers with a
+FastChat controller, serves generate_stream). fastchat is optional; the
+streaming core (`WorkerCore`) is dependency-free and unit-tested, the HTTP
+worker shell is created only when fastchat is importable.
+
+Run: python -m bigdl_tpu.serving.fastchat_worker --model-path PATH \
+         --controller-address http://... --worker-address http://...
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+from bigdl_tpu.serving.engine import EngineConfig, LLMEngine, SamplingParams
+
+
+class WorkerCore:
+    """Model + engine + tokenizer; yields FastChat-wire-format chunks."""
+
+    def __init__(self, model_path: str, low_bit: str = "sym_int4",
+                 max_batch: int = 4, max_seq: int = 2048):
+        from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+        self.model = AutoModelForCausalLM.from_pretrained(
+            model_path, load_in_low_bit=low_bit, max_seq=max_seq)
+        self.tokenizer = None
+        try:
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(model_path)
+        except Exception:
+            pass
+        self.engine = LLMEngine(self.model, EngineConfig(
+            max_batch=max_batch, max_seq=max_seq))
+        self.context_len = max_seq
+
+    def generate_stream(self, params: Dict[str, Any]) -> Iterator[Dict]:
+        """FastChat generate_stream protocol: yields dicts with
+        {text, error_code, usage} as tokens arrive."""
+        prompt = params["prompt"]
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("string prompt needs a tokenizer")
+            ids = self.tokenizer(prompt)["input_ids"]
+        else:
+            ids = list(prompt)
+        sp = SamplingParams(
+            max_tokens=int(params.get("max_new_tokens", 256)),
+            temperature=float(params.get("temperature", 0.0)),
+            top_k=int(params.get("top_k", 0)),
+            top_p=float(params.get("top_p", 1.0)),
+        )
+        rid = f"fc-{uuid.uuid4().hex[:12]}"
+        self.engine.add_request(rid, ids, sp)
+        out_ids = []
+        finished = False
+        while not finished:
+            if not self.engine.step():
+                time.sleep(0.002)
+            for o in self.engine.get_outputs(rid):
+                out_ids.extend(o.new_token_ids)
+                finished |= o.finished
+                text = (self.tokenizer.decode(out_ids,
+                                              skip_special_tokens=True)
+                        if self.tokenizer else json.dumps(out_ids))
+                yield {
+                    "text": text,
+                    "error_code": 0,
+                    "usage": {"prompt_tokens": len(ids),
+                              "completion_tokens": len(out_ids),
+                              "total_tokens": len(ids) + len(out_ids)},
+                    "finish_reason": o.finish_reason if o.finished else None,
+                }
+
+
+def _make_fastchat_worker():
+    import asyncio
+
+    from fastchat.serve.base_model_worker import BaseModelWorker, app
+
+    class BigdlTpuWorker(BaseModelWorker):
+        """The reference's BigDLLLMWorker equivalent."""
+
+        def __init__(self, controller_addr, worker_addr, worker_id,
+                     model_path, model_names, limit_worker_concurrency,
+                     conv_template=None, **core_kwargs):
+            super().__init__(controller_addr, worker_addr, worker_id,
+                             model_path, model_names,
+                             limit_worker_concurrency,
+                             conv_template=conv_template)
+            self.core = WorkerCore(model_path, **core_kwargs)
+            self.context_len = self.core.context_len
+            self.init_heart_beat()
+
+        def generate_stream_gate(self, params):
+            try:
+                for chunk in self.core.generate_stream(params):
+                    yield json.dumps(chunk).encode() + b"\0"
+            except Exception as e:
+                yield json.dumps({"text": str(e), "error_code": 1}).encode() \
+                    + b"\0"
+
+        async def generate_gate(self, params):
+            out = None
+            for chunk in self.core.generate_stream(params):
+                out = chunk
+            return out
+
+        def get_embeddings(self, params):
+            raise NotImplementedError
+
+    return BigdlTpuWorker, app
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-path", required=True)
+    ap.add_argument("--low-bit", default="sym_int4")
+    ap.add_argument("--controller-address", default="http://localhost:21001")
+    ap.add_argument("--worker-address", default="http://localhost:21002")
+    ap.add_argument("--host", default="localhost")
+    ap.add_argument("--port", type=int, default=21002)
+    ap.add_argument("--model-names", default=None)
+    args = ap.parse_args()
+
+    try:
+        BigdlTpuWorker, app = _make_fastchat_worker()
+    except ImportError as e:
+        raise SystemExit(
+            f"fastchat is not installed ({e}); the WorkerCore API is still "
+            "usable programmatically") from e
+    import uvicorn
+
+    worker = BigdlTpuWorker(
+        args.controller_address, args.worker_address,
+        str(uuid.uuid4())[:8], args.model_path,
+        (args.model_names or args.model_path).split(","), 5,
+        low_bit=args.low_bit)
+    uvicorn.run(app, host=args.host, port=args.port, log_level="info")
+
+
+if __name__ == "__main__":
+    main()

@@ -81,55 +81,95 @@ def make_spec_round(
     next token at the first divergence).
     """
 
+    sampling = do_sample and temperature > 0.0
+
     @functools.partial(jax.jit, donate_argnums=(2, 3))
     def spec_round(params_t, params_d, cache_t: KVCache, cache_d: KVCache,
                    cur_tok: jax.Array, key: jax.Array):
         b = cur_tok.shape[0]
         pos0 = cache_t.pos
 
-        # --- draft: gamma greedy steps (reference's draft loop, fused) ---
+        # --- draft: gamma steps (greedy, or sampled under the same
+        # temperature as the target — required for rejection sampling) ---
         def dstep(carry, _):
-            tok, cache = carry
+            tok, cache, k = carry
             logits, cache = fwd_draft(params_d, cfg_draft, tok[:, None], cache)
-            lg = logits[:, -1, :]
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            q = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
-            qprob = jnp.take_along_axis(q, nxt[:, None], axis=-1)[:, 0]
-            return (nxt, cache), (nxt, qprob)
+            lg = logits[:, -1, :].astype(jnp.float32)
+            if sampling:
+                # identical tempering for the draw and the recorded q —
+                # the accept ratio must use the true draft distribution
+                tempered = lg / max(temperature, 1e-6)
+                k, sk = jax.random.split(k)
+                nxt = jax.random.categorical(
+                    sk, tempered, axis=-1).astype(jnp.int32)
+                q = jax.nn.softmax(tempered, axis=-1)
+            else:
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                q = jax.nn.softmax(lg, axis=-1)
+            return (nxt, cache, k), (nxt, q)
 
-        (_, cache_d), (draft_toks, draft_q) = lax.scan(
-            dstep, (cur_tok, cache_d), None, length=gamma)
-        draft_toks = draft_toks.T          # [B, gamma]
-        draft_q = draft_q.T                # [B, gamma] (for future sampling accept)
+        key, dk = jax.random.split(key)
+        (_, cache_d, _), (draft_toks, draft_q) = lax.scan(
+            dstep, (cur_tok, cache_d, dk), None, length=gamma)
+        draft_toks = draft_toks.T                   # [B, gamma]
+        draft_q = jnp.moveaxis(draft_q, 0, 1)       # [B, gamma, V]
 
         # --- verify: ONE target forward over [cur_tok, d_1..d_{gamma-1}] ---
         verify_in = jnp.concatenate([cur_tok[:, None], draft_toks[:, :-1]],
                                     axis=1)  # [B, gamma]
         logits_t, cache_t = fwd_target(params_t, cfg_target, verify_in, cache_t)
-        if do_sample and temperature > 0.0:
-            from bigdl_tpu.generation import sample_token
 
-            key, sk = jax.random.split(key)
-            bsz, g_, vocab = logits_t.shape
-            target_pred = sample_token(
-                logits_t.astype(jnp.float32).reshape(bsz * g_, vocab), sk,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-            ).reshape(bsz, g_)                      # [B, gamma]
+        if sampling:
+            # min(1, p/q) rejection sampling (the reference's sampling
+            # accept, speculative.py ~:775: q>=p accept / rejected resample)
+            from bigdl_tpu.generation import filter_logits
+
+            p = jax.nn.softmax(filter_logits(
+                logits_t.astype(jnp.float32) / temperature, top_k, top_p),
+                axis=-1)
+            p_tok = jnp.take_along_axis(p, draft_toks[..., None],
+                                        axis=-1)[..., 0]     # [B, gamma]
+            q_tok = jnp.take_along_axis(draft_q, draft_toks[..., None],
+                                        axis=-1)[..., 0]
+            key, uk, rk = jax.random.split(key, 3)
+            u = jax.random.uniform(uk, p_tok.shape)
+            accepted = u < jnp.minimum(1.0, p_tok / jnp.maximum(q_tok, 1e-20))
+            n_accept = jnp.minimum(
+                jnp.sum(jnp.cumprod(accepted.astype(jnp.int32), axis=1),
+                        axis=1),
+                gamma - 1)                          # [B]
+            # correction at position n: sample from (p - q)+ if n was a
+            # true rejection, else (cap hit) from p directly
+            p_n = jnp.take_along_axis(
+                p, n_accept[:, None, None], axis=1)[:, 0]    # [B, V]
+            q_n = jnp.take_along_axis(
+                draft_q, n_accept[:, None, None], axis=1)[:, 0]
+            resid = jnp.maximum(p_n - q_n, 0.0)
+            resid_sum = jnp.sum(resid, axis=-1, keepdims=True)
+            was_rejected = jnp.take_along_axis(
+                ~accepted, n_accept[:, None], axis=1)[:, 0]
+            dist = jnp.where((was_rejected & (resid_sum[:, 0] > 1e-9))[:, None],
+                             resid / jnp.maximum(resid_sum, 1e-20), p_n)
+            correction = jax.random.categorical(
+                rk, jnp.log(jnp.maximum(dist, 1e-20)), axis=-1
+            ).astype(jnp.int32)                     # [B]
+            idx = jnp.arange(gamma)[None, :]
+            out = jnp.where(idx < n_accept[:, None], draft_toks,
+                            correction[:, None])
         else:
             target_pred = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
-
-        # --- accept: greedy prefix match, capped at gamma-1 ---
-        matches = (draft_toks == target_pred)       # [B, gamma]
-        n_accept = jnp.minimum(
-            jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1),
-            gamma - 1)                              # [B]
-
-        # out[i] = d_{i+1} for i < n_accept, target_pred[n_accept] at i==n,
-        # garbage after (host slices by n_accept+1)
-        idx = jnp.arange(gamma)[None, :]
-        out = jnp.where(idx < n_accept[:, None], draft_toks,
-                        jnp.take_along_axis(
-                            target_pred, n_accept[:, None], axis=1))
+            # --- accept: greedy prefix match, capped at gamma-1 ---
+            matches = (draft_toks == target_pred)   # [B, gamma]
+            n_accept = jnp.minimum(
+                jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1),
+                        axis=1),
+                gamma - 1)                          # [B]
+            # out[i] = d_{i+1} for i < n_accept, target_pred[n_accept] at
+            # i==n, garbage after (host slices by n_accept+1)
+            idx = jnp.arange(gamma)[None, :]
+            out = jnp.where(idx < n_accept[:, None], draft_toks,
+                            jnp.take_along_axis(
+                                target_pred, n_accept[:, None], axis=1))
 
         # --- rollback: pure index bookkeeping ---
         new_pos = pos0 + n_accept[0] + 1            # B=1: scalar pos

@@ -1,0 +1,158 @@
+"""GPTQ / AWQ checkpoint ingestion: repack to asym_int4 QTensors.
+
+Equivalent of the reference's quantized-checkpoint ingestion
+(reference transformers/model.py:237-283 detects GPTQ/AWQ configs;
+convert.py:122-188 `convert_gptq` repacks `QuantLinearCudaOld`/
+`WQLinear_GEMM` modules into ggml asym_int4; awq/linear.py defines the AWQ
+packing; gptq/convert/convert_gptq_to_ggml.py is the offline variant).
+
+Both formats store per-group asymmetric 4-bit: w = (code - zero) * scale.
+Our asym_int4 is w = code * scale + min with min = -zero * scale, so the
+repack is EXACT whenever the group size is a multiple of our block (32):
+group scales/zeros are repeated down to block granularity, codes are
+re-packed bytes — no dequantize/requantize round trip.
+
+Layouts handled:
+- GPTQ (AutoGPTQ): qweight int32 [K/8, N], 8 codes per int32 along K
+  (low nibble first); qzeros int32 [K/G, N/8] packed along N; scales f16
+  [K/G, N]; g_idx [K] must be the trivial arange//G order (actorder
+  checkpoints fall back to an error). v1 checkpoints store zero-1
+  (the famous +1); v2 ("checkpoint_format": "gptq_v2") stores zero.
+- AWQ (GEMM): qweight int32 [K, N/8] packed along N with the interleaved
+  order [0, 2, 4, 6, 1, 3, 5, 7]; qzeros likewise; scales f16 [K/G, N].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+AWQ_ORDER = np.array([0, 2, 4, 6, 1, 3, 5, 7])
+
+
+def _unpack_int32_nibbles_rows(qw: np.ndarray) -> np.ndarray:
+    """GPTQ qweight [K/8, N] int32 -> codes [K, N] uint8 (K-major)."""
+    k8, n = qw.shape
+    shifts = (4 * np.arange(8, dtype=np.uint32))[None, :, None]
+    codes = (qw.astype(np.uint32)[:, None, :] >> shifts) & 0xF
+    return codes.reshape(k8 * 8, n).astype(np.uint8)
+
+
+def _unpack_int32_nibbles_cols(qz: np.ndarray, order=None) -> np.ndarray:
+    """[R, C/8] int32 -> [R, C] uint8 (N-major, optional interleave)."""
+    r, c8 = qz.shape
+    shifts = (4 * np.arange(8, dtype=np.uint32))[None, None, :]
+    z = (qz.astype(np.uint32)[:, :, None] >> shifts) & 0xF   # [R, C/8, 8]
+    if order is not None:
+        inv = np.empty_like(order)
+        inv[order] = np.arange(8)
+        z = z[:, :, inv]
+    return z.reshape(r, c8 * 8).astype(np.uint8)
+
+
+def _pack4_np(codes: np.ndarray) -> np.ndarray:
+    """[K, N] uint8 codes -> our split-block packed [K/2, N] (block 32)."""
+    k, n = codes.shape
+    blk = codes.reshape(k // 32, 32, n)
+    return (blk[:, :16] | (blk[:, 16:] << 4)).reshape(k // 2, n)
+
+
+def _to_qtensor(codes: np.ndarray, scales: np.ndarray, zeros: np.ndarray,
+                group: int):
+    """codes [K,N], scales/zeros [K/G, N] -> asym_int4 QTensor [K, N]."""
+    from bigdl_tpu.ops.quant import QTensor
+
+    k, n = codes.shape
+    if group % 32:
+        raise ValueError(f"group_size {group} is not a multiple of 32")
+    rep = group // 32
+    scale_b = np.repeat(scales.astype(np.float32), rep, axis=0)
+    zero_b = -zeros.astype(np.float32) * scales.astype(np.float32)
+    zero_b = np.repeat(zero_b, rep, axis=0)
+    return QTensor(
+        jnp.asarray(_pack4_np(codes)),
+        jnp.asarray(scale_b).astype(jnp.bfloat16),
+        jnp.asarray(zero_b).astype(jnp.bfloat16),
+        "asym_int4", (k, n))
+
+
+def _build_gptq(buf: Dict[str, np.ndarray], group: int,
+                zero_plus_one: bool):
+    codes = _unpack_int32_nibbles_rows(buf["qweight"])
+    k, n = codes.shape
+    g = group if group > 0 else k
+    if "g_idx" in buf:
+        expect = np.arange(k, dtype=np.int64) // g
+        if not np.array_equal(np.asarray(buf["g_idx"], np.int64), expect):
+            raise NotImplementedError(
+                "GPTQ act-order (non-trivial g_idx) checkpoints are not "
+                "supported; re-quantize without desc_act")
+    zeros = _unpack_int32_nibbles_cols(buf["qzeros"]).astype(np.int32)
+    if zero_plus_one:
+        zeros = zeros + 1
+    return _to_qtensor(codes, np.asarray(buf["scales"]), zeros, g)
+
+
+def _build_awq(buf: Dict[str, np.ndarray], group: int):
+    codes = _unpack_int32_nibbles_cols(buf["qweight"], AWQ_ORDER)  # [K, N]
+    zeros = _unpack_int32_nibbles_cols(buf["qzeros"], AWQ_ORDER)
+    return _to_qtensor(codes, np.asarray(buf["scales"]),
+                       zeros.astype(np.int32), group)
+
+
+def detect_quant_config(hf_config: Dict[str, Any]):
+    """(method, group_size, zero_plus_one) or None."""
+    qc = hf_config.get("quantization_config")
+    if not qc:
+        return None
+    method = qc.get("quant_method")
+    if method not in ("gptq", "awq"):
+        return None
+    if int(qc.get("bits", 4)) != 4:
+        raise NotImplementedError(
+            f"{method} bits={qc.get('bits')} not supported (4 only)")
+    group = int(qc.get("group_size", 128))
+    v2 = qc.get("checkpoint_format") == "gptq_v2"
+    return method, group, not v2
+
+
+def repack_stream(
+    tensors: Iterator[Tuple[str, np.ndarray]],
+    method: str,
+    group: int,
+    zero_plus_one: bool = True,
+) -> Iterator[Tuple[str, Any]]:
+    """Transform a GPTQ/AWQ tensor stream into dense-weight-style names.
+
+    (module.qweight, module.qzeros, module.scales[, module.g_idx]) triples
+    are buffered and emitted as a single (module.weight, QTensor); all
+    other tensors pass through. Feed the result to any family converter —
+    the conversion engine passes QTensor leaves through unchanged.
+    """
+    bufs: Dict[str, Dict[str, np.ndarray]] = {}
+    need = {"qweight", "qzeros", "scales"}
+    for name, w in tensors:
+        base, _, leaf = name.rpartition(".")
+        if leaf in ("qweight", "qzeros", "scales", "g_idx"):
+            buf = bufs.setdefault(base, {})
+            buf[leaf] = np.asarray(w)
+            if need.issubset(buf):
+                if method == "gptq":
+                    # wait one more tensor in case g_idx follows scales
+                    if "g_idx" not in buf and "g_idx_pending" not in buf:
+                        buf["g_idx_pending"] = True
+                        continue
+                yield base + ".weight", (
+                    _build_gptq(buf, group, zero_plus_one)
+                    if method == "gptq" else _build_awq(buf, group))
+                del bufs[base]
+        else:
+            yield name, w
+    # modules whose g_idx never arrived
+    for base, buf in list(bufs.items()):
+        if need.issubset(buf):
+            yield base + ".weight", (
+                _build_gptq(buf, group, zero_plus_one)
+                if method == "gptq" else _build_awq(buf, group))

@@ -1,0 +1,56 @@
+"""Uniform model loader for serving and benchmarks.
+
+Equivalent of the reference's `transformers/loader.py:43-89` (`load_model`
+used by FastChat serving and the benchmark harness; benchmark wrapping
+injected via env there, via the `benchmark` flag here).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+
+def get_model_path(repo_id_or_path: str,
+                   local_model_hub: Optional[str] = None) -> str:
+    """Reference get_model_path (loader.py:89): map a repo id into a local
+    hub directory when one is configured."""
+    if local_model_hub:
+        candidate = os.path.join(local_model_hub,
+                                 repo_id_or_path.replace("/", os.sep))
+        if os.path.exists(candidate):
+            return candidate
+        candidate = os.path.join(local_model_hub,
+                                 repo_id_or_path.split("/")[-1])
+        if os.path.exists(candidate):
+            return candidate
+    return repo_id_or_path
+
+
+def load_model(
+    model_path: str,
+    device: str = "tpu",            # accepted for API parity; JAX decides
+    low_bit: str = "sym_int4",
+    max_seq: Optional[int] = None,
+    benchmark: bool = False,
+    **kwargs: Any,
+) -> Tuple[Any, Any]:
+    """Returns (model, tokenizer). `benchmark=True` wraps the model in
+    BenchmarkWrapper (the reference injects it via env, loader.py:43-77)."""
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        model_path, load_in_low_bit=low_bit, max_seq=max_seq, **kwargs)
+    tokenizer = None
+    try:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(model_path,
+                                                  trust_remote_code=True)
+    except Exception:
+        pass
+    if benchmark:
+        from bigdl_tpu.bench import BenchmarkWrapper
+
+        model = BenchmarkWrapper(model)
+    return model, tokenizer

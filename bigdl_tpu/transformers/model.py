@@ -166,10 +166,15 @@ class _BaseAutoModelClass:
         optimize_model: bool = True,   # accepted for API parity
         modules_to_not_convert=(),
         max_seq: Optional[int] = None,
-        quantize_kv_cache: bool = False,
+        quantize_kv_cache: Optional[bool] = None,
         speculative: bool = False,
+        embedding_qtype: Optional[str] = None,
         **_ignored,
     ) -> TpuCausalLM:
+        from bigdl_tpu.config import flags
+
+        if quantize_kv_cache is None:
+            quantize_kv_cache = flags().quantize_kv_cache
         path = pretrained_model_name_or_path
         if lowbit_io.is_low_bit_dir(path):
             if speculative:
@@ -196,7 +201,7 @@ class _BaseAutoModelClass:
                                qtype="gguf", model_path=os.path.dirname(path),
                                max_seq=max_seq or 2048,
                                kv_quantized=quantize_kv_cache)
-        max_seq = max_seq or 2048
+        max_seq = max_seq or flags().default_max_seq
 
         qtype = _resolve_qtype(load_in_4bit, load_in_low_bit)
         hf_config = load_hf_config(path)
@@ -204,10 +209,34 @@ class _BaseAutoModelClass:
         family = get_family(archs[0])
         cfg = family.config_from_hf(hf_config)
 
+        tensor_stream = iter_hf_tensors(path)
+        # GPTQ/AWQ checkpoints: repack already-quantized modules directly
+        # (reference model.py:237-283 + convert.py:122-188 convert_gptq)
+        from bigdl_tpu.transformers.gptq_awq import (detect_quant_config,
+                                                     repack_stream)
+
+        qc = detect_quant_config(hf_config)
+        if qc is not None:
+            if qtype not in (None, "sym_int4", "asym_int4"):
+                raise ValueError(
+                    f"checkpoint is already {qc[0]}-quantized (asym_int4 "
+                    f"after repack); conflicting load_in_low_bit={qtype!r}")
+            method, group, plus_one = qc
+            tensor_stream = repack_stream(tensor_stream, method, group,
+                                          plus_one)
+            qtype = "asym_int4"   # remaining dense linears match the ckpt
+
         cvt_qtype = None if (qtype in FLOAT_QTYPES) else qtype
         params = family.convert_params(
-            iter_hf_tensors(path), cfg, qtype=cvt_qtype,
+            tensor_stream, cfg, qtype=cvt_qtype,
             modules_to_not_convert=tuple(modules_to_not_convert))
+        if embedding_qtype is not None:
+            # LowBitEmbedding equivalent (reference embedding.py:77-114,
+            # embedding_qtype kwarg at model.py:104)
+            from bigdl_tpu.ops.embedding import quantize_embedding
+
+            params["embed_tokens"] = quantize_embedding(
+                params["embed_tokens"], embedding_qtype)
         model = TpuCausalLM(params, cfg, family, hf_config, qtype,
                             model_path=path, max_seq=max_seq,
                             kv_quantized=quantize_kv_cache)

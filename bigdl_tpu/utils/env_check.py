@@ -1,0 +1,71 @@
+"""Environment sanity check.
+
+Equivalent of the reference's env-check scripts (reference
+python/llm/scripts/env-check.sh + check.py and the `ipex-llm-init`
+allocator/OMP setup — the TPU analog reports the XLA backend, device
+inventory, memory, native-kernel availability, and key env flags).
+
+Run: python -m bigdl_tpu.utils.env_check
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def collect() -> dict:
+    info: dict = {"python": sys.version.split()[0]}
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+        info["backend"] = jax.default_backend()
+        devs = jax.devices()
+        info["devices"] = [str(d) for d in devs]
+        try:
+            stats = devs[0].memory_stats() or {}
+            lim = stats.get("bytes_limit")
+            if lim:
+                info["device_memory_gb"] = round(lim / 2**30, 2)
+        except Exception:
+            pass
+    except Exception as e:  # pragma: no cover
+        info["jax_error"] = repr(e)
+
+    try:
+        from bigdl_tpu import __version__, native
+
+        info["bigdl_tpu"] = __version__
+        info["native_kernels"] = native.get_lib() is not None
+    except Exception as e:
+        info["bigdl_tpu_error"] = repr(e)
+
+    for mod in ("flax", "optax", "transformers", "safetensors"):
+        try:
+            info[mod] = __import__(mod).__version__
+        except Exception:
+            info[mod] = None
+
+    info["env"] = {k: v for k, v in os.environ.items()
+                   if k.startswith(("JAX_", "XLA_", "BIGDL_", "LIBTPU"))}
+    return info
+
+
+def main() -> int:
+    info = collect()
+    width = max(len(k) for k in info)
+    for k, v in info.items():
+        if k == "env":
+            print("env flags:")
+            for ek, ev in sorted(v.items()):
+                print(f"  {ek}={ev}")
+        else:
+            print(f"{k:<{width}} : {v}")
+    ok = "jax_error" not in info and "bigdl_tpu_error" not in info
+    print("status :", "OK" if ok else "PROBLEMS FOUND")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,3 +105,50 @@ def test_unsupported_arch_raises(tmp_path):
         {"architectures": ["TotallyUnknownModel"], "vocab_size": 8}))
     with pytest.raises(ValueError, match="unsupported architecture"):
         AutoModelForCausalLM.from_pretrained(str(d))
+
+
+def test_llm_patch_roundtrip():
+    import transformers
+
+    import bigdl_tpu.llm_patching as lp
+    from bigdl_tpu.transformers.model import _BaseAutoModelClass
+
+    orig = transformers.AutoModelForCausalLM
+    lp.llm_patch()
+    try:
+        assert issubclass(transformers.AutoModelForCausalLM,
+                          _BaseAutoModelClass)
+    finally:
+        lp.llm_unpatch()
+    assert transformers.AutoModelForCausalLM is orig
+
+
+def test_runtime_flags():
+    from bigdl_tpu import config as C
+
+    f = C.flags()
+    assert f.matmul_backend in ("auto", "xla", "pallas")
+    C.set_flags(default_max_seq=123)
+    assert C.flags().default_max_seq == 123
+    C.set_flags(default_max_seq=2048)
+
+
+def test_example_packing():
+    from bigdl_tpu.examples.qlora_finetune import format_alpaca, pack_batches
+
+    text = format_alpaca({"instruction": "add", "input": "1+1",
+                          "output": "2"})
+    assert "### Input:" in text and text.endswith("2")
+    assert format_alpaca({"text": "raw"}) == "raw"
+    batches = list(pack_batches([[1, 2, 3]] * 30, batch=2, seq_len=8))
+    assert len(batches) == 5
+    assert batches[0]["input_ids"].shape == (2, 8)
+
+
+def test_loader_util(tmp_path):
+    from bigdl_tpu.transformers.loader import get_model_path
+
+    d = tmp_path / "hub" / "meta" / "llama"
+    d.mkdir(parents=True)
+    assert get_model_path("meta/llama", str(tmp_path / "hub")) == str(d)
+    assert get_model_path("/abs/path", None) == "/abs/path"

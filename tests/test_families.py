@@ -56,6 +56,31 @@ def fake_ckpt(arch):
                     np.zeros((D,), np.float32))]
         return hf, ts
 
+    if arch == "Gemma2ForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
+              "intermediate_size": FF, "num_hidden_layers": L,
+              "num_attention_heads": H, "num_key_value_heads": 4,
+              "head_dim": hd, "rms_norm_eps": 1e-6,
+              "tie_word_embeddings": True, "query_pre_attn_scalar": 16,
+              "attn_logit_softcapping": 50.0,
+              "final_logit_softcapping": 30.0, "sliding_window": 8}
+        ts = [("model.embed_tokens.weight", t(rng, V, D)),
+              ("model.norm.weight", np.zeros((D,), np.float32))]
+        for i in range(L):
+            p = f"model.layers.{i}."
+            ts += [(p + "self_attn.q_proj.weight", t(rng, H * hd, D)),
+                   (p + "self_attn.k_proj.weight", t(rng, 4 * hd, D)),
+                   (p + "self_attn.v_proj.weight", t(rng, 4 * hd, D)),
+                   (p + "self_attn.o_proj.weight", t(rng, D, H * hd)),
+                   (p + "mlp.gate_proj.weight", t(rng, FF, D)),
+                   (p + "mlp.up_proj.weight", t(rng, FF, D)),
+                   (p + "mlp.down_proj.weight", t(rng, D, FF))]
+            for nm in ("input_layernorm", "post_attention_layernorm",
+                       "pre_feedforward_layernorm",
+                       "post_feedforward_layernorm"):
+                ts.append((p + nm + ".weight", np.zeros((D,), np.float32)))
+        return hf, ts
+
     if arch == "PhiForCausalLM":
         hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
               "intermediate_size": FF, "num_hidden_layers": L,
@@ -215,7 +240,7 @@ def fake_ckpt(arch):
     raise AssertionError(arch)
 
 
-ARCHS = ["GemmaForCausalLM", "PhiForCausalLM", "GPTNeoXForCausalLM",
+ARCHS = ["GemmaForCausalLM", "Gemma2ForCausalLM", "PhiForCausalLM", "GPTNeoXForCausalLM",
          "BloomForCausalLM", "FalconForCausalLM", "Starcoder2ForCausalLM",
          "BaichuanForCausalLM", "ChatGLMModel"]
 
@@ -289,3 +314,39 @@ def test_alibi_with_external_attn_fn_rejected():
     with pytest.raises(NotImplementedError, match="ALiBi"):
         llama_mod.forward_train(params, cfg, toks,
                                 attn_fn=lambda q, k, v: q)
+
+
+def test_quantized_embedding_lookup():
+    """LowBitEmbedding equivalent: quantized table lookup ~= dense lookup,
+    and a tied quantized lm_head produces finite logits."""
+    from bigdl_tpu.ops.embedding import embedding_lookup, quantize_embedding
+
+    rng = np.random.default_rng(0)
+    table = (rng.standard_normal((96, 64)) * 0.1).astype(np.float32)
+    qt = quantize_embedding(table, "sym_int8")
+    ids = jnp.asarray(rng.integers(0, 96, (2, 5), dtype=np.int32))
+    got = np.asarray(embedding_lookup(qt, ids, jnp.float32))
+    want = table[np.asarray(ids)]
+    np.testing.assert_allclose(got, want, atol=5e-3, rtol=5e-2)
+    assert got.shape == (2, 5, 64)
+
+
+def test_facade_embedding_qtype(tmp_path):
+    import json
+    import os
+
+    import safetensors.numpy as stnp
+
+    from bigdl_tpu.ops.quant import QTensor
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    hf, tensors = fake_ckpt("GemmaForCausalLM")
+    d = str(tmp_path / "g")
+    os.makedirs(d)
+    stnp.save_file(dict(tensors), os.path.join(d, "model.safetensors"))
+    json.dump(hf, open(os.path.join(d, "config.json"), "w"))
+    m = AutoModelForCausalLM.from_pretrained(
+        d, load_in_4bit=True, embedding_qtype="sym_int8", max_seq=64)
+    assert isinstance(m.params["embed_tokens"], QTensor)
+    out = m.generate(np.arange(1, 7, dtype=np.int32), max_new_tokens=4)
+    assert out.shape == (1, 10)

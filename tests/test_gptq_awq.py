@@ -1,0 +1,173 @@
+"""GPTQ/AWQ ingestion tests: pack synthetic checkpoints with the exact
+on-disk layouts, repack, verify EXACT dequantized values vs the format's
+reference formula, and load end-to-end through the facade."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.ops.quant import dequantize
+from bigdl_tpu.transformers import gptq_awq as GA
+
+
+def make_gptq_module(rng, k, n, group):
+    """Synthesize (qweight, qzeros, scales, g_idx) + reference dense."""
+    codes = rng.integers(0, 16, (k, n), dtype=np.uint8)
+    zeros_true = rng.integers(1, 15, (k // group, n), dtype=np.uint8)
+    scales = (rng.random((k // group, n), dtype=np.float32) * 0.02 + 0.001
+              ).astype(np.float16)
+    # reference dequant: (c - z) * s
+    z_rep = np.repeat(zeros_true, group, axis=0)
+    s_rep = np.repeat(scales.astype(np.float32), group, axis=0)
+    dense = (codes.astype(np.float32) - z_rep) * s_rep
+
+    # pack qweight [K/8, N]: 8 codes per int32 along K, low nibble first
+    c = codes.reshape(k // 8, 8, n).astype(np.uint32)
+    qweight = np.zeros((k // 8, n), np.uint32)
+    for j in range(8):
+        qweight |= c[:, j, :] << (4 * j)
+    # pack qzeros [K/G, N/8] along N, storing z-1 (v1 convention)
+    zm1 = (zeros_true - 1).reshape(k // group, n // 8, 8).astype(np.uint32)
+    qzeros = np.zeros((k // group, n // 8), np.uint32)
+    for j in range(8):
+        qzeros |= zm1[:, :, j] << (4 * j)
+    g_idx = (np.arange(k) // group).astype(np.int32)
+    return (qweight.view(np.int32), qzeros.view(np.int32), scales, g_idx,
+            dense)
+
+
+def make_awq_module(rng, k, n, group):
+    codes = rng.integers(0, 16, (k, n), dtype=np.uint8)
+    zeros = rng.integers(0, 16, (k // group, n), dtype=np.uint8)
+    scales = (rng.random((k // group, n), dtype=np.float32) * 0.02 + 0.001
+              ).astype(np.float16)
+    z_rep = np.repeat(zeros, group, axis=0)
+    s_rep = np.repeat(scales.astype(np.float32), group, axis=0)
+    dense = (codes.astype(np.float32) - z_rep) * s_rep
+
+    def pack_cols(arr):   # [R, C] -> [R, C/8] with AWQ interleave
+        r, c = arr.shape
+        a = arr.reshape(r, c // 8, 8).astype(np.uint32)
+        out = np.zeros((r, c // 8), np.uint32)
+        for j in range(8):
+            out |= a[:, :, GA.AWQ_ORDER[j]] << (4 * j)
+        return out.view(np.int32)
+
+    return pack_cols(codes), pack_cols(zeros), scales, dense
+
+
+@pytest.mark.parametrize("group", [32, 64, 128])
+def test_gptq_repack_exact(group):
+    rng = np.random.default_rng(0)
+    k, n = 256, 32
+    qw, qz, sc, gi, dense = make_gptq_module(rng, k, n, group)
+    qt = GA._build_gptq({"qweight": qw, "qzeros": qz, "scales": sc,
+                         "g_idx": gi}, group, zero_plus_one=True)
+    got = np.asarray(dequantize(qt, jnp.float32))
+    # bf16 scale/min rounding is the only loss
+    np.testing.assert_allclose(got, dense, atol=3e-3, rtol=2e-2)
+    assert qt.qtype == "asym_int4" and qt.shape == (k, n)
+
+
+def test_gptq_actorder_rejected():
+    rng = np.random.default_rng(1)
+    qw, qz, sc, gi, _ = make_gptq_module(rng, 64, 16, 32)
+    gi_perm = gi[::-1].copy()
+    with pytest.raises(NotImplementedError, match="act-order"):
+        GA._build_gptq({"qweight": qw, "qzeros": qz, "scales": sc,
+                        "g_idx": gi_perm}, 32, True)
+
+
+def test_awq_repack_exact():
+    rng = np.random.default_rng(2)
+    k, n = 128, 64
+    qw, qz, sc, dense = make_awq_module(rng, k, n, 32)
+    qt = GA._build_awq({"qweight": qw, "qzeros": qz, "scales": sc}, 32)
+    got = np.asarray(dequantize(qt, jnp.float32))
+    np.testing.assert_allclose(got, dense, atol=3e-3, rtol=2e-2)
+
+
+def test_facade_loads_gptq_checkpoint(tmp_path):
+    """Full GPTQ llama checkpoint -> from_pretrained -> generate."""
+    import safetensors.numpy as stnp
+
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+    from bigdl_tpu.utils.testing import TINY_LLAMA
+
+    cfg = TINY_LLAMA
+    rng = np.random.default_rng(3)
+    d, ff, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    hd, h, hkv = cfg.hd, cfg.num_attention_heads, cfg.num_key_value_heads
+    group = 32
+
+    tensors = {
+        "model.embed_tokens.weight":
+            (rng.standard_normal((v, d)) * .02).astype(np.float32),
+        "model.norm.weight": np.ones((d,), np.float32),
+        "lm_head.weight":
+            (rng.standard_normal((v, d)) * .02).astype(np.float32),
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        for nm, (out_d, in_d) in [("self_attn.q_proj", (h * hd, d)),
+                                  ("self_attn.k_proj", (hkv * hd, d)),
+                                  ("self_attn.v_proj", (hkv * hd, d)),
+                                  ("self_attn.o_proj", (d, h * hd)),
+                                  ("mlp.gate_proj", (ff, d)),
+                                  ("mlp.up_proj", (ff, d)),
+                                  ("mlp.down_proj", (d, ff))]:
+            # GPTQ tensors are stored [K(in), N(out)]-blocked: qweight
+            # [in/8, out], scales [in/G, out]
+            qw, qz, sc, gi, _ = make_gptq_module(rng, in_d, out_d, group)
+            tensors[p + nm + ".qweight"] = qw
+            tensors[p + nm + ".qzeros"] = qz
+            tensors[p + nm + ".scales"] = sc
+            tensors[p + nm + ".g_idx"] = gi
+        tensors[p + "input_layernorm.weight"] = np.ones((d,), np.float32)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(
+            (d,), np.float32)
+
+    mdir = str(tmp_path / "gptq")
+    os.makedirs(mdir)
+    stnp.save_file(tensors, os.path.join(mdir, "model.safetensors"))
+    json.dump({
+        "architectures": ["LlamaForCausalLM"], "vocab_size": v,
+        "hidden_size": d, "intermediate_size": ff,
+        "num_hidden_layers": cfg.num_hidden_layers,
+        "num_attention_heads": h, "num_key_value_heads": hkv,
+        "rms_norm_eps": 1e-5, "max_position_embeddings": 256,
+        "quantization_config": {"quant_method": "gptq", "bits": 4,
+                                "group_size": group},
+    }, open(os.path.join(mdir, "config.json"), "w"))
+
+    model = AutoModelForCausalLM.from_pretrained(mdir, max_seq=64)
+    assert model.params["layers"]["q_proj"].qtype == "asym_int4"
+    assert model.params["lm_head"].qtype == "asym_int4"  # dense -> asym
+    out = model.generate(np.arange(1, 8, dtype=np.int32), max_new_tokens=5)
+    assert out.shape == (1, 12)
+    assert np.all((out >= 0) & (out < v))
+
+
+def test_conflicting_low_bit_rejected(tmp_path):
+    import json
+    import os
+
+    import safetensors.numpy as stnp
+
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    d = str(tmp_path / "q")
+    os.makedirs(d)
+    stnp.save_file({"x": np.zeros((2, 2), np.float32)},
+                   os.path.join(d, "model.safetensors"))
+    json.dump({"architectures": ["LlamaForCausalLM"], "vocab_size": 8,
+               "hidden_size": 8, "intermediate_size": 16,
+               "num_hidden_layers": 1, "num_attention_heads": 2,
+               "quantization_config": {"quant_method": "gptq", "bits": 4,
+                                       "group_size": 32}},
+              open(os.path.join(d, "config.json"), "w"))
+    with pytest.raises(ValueError, match="conflicting load_in_low_bit"):
+        AutoModelForCausalLM.from_pretrained(d, load_in_low_bit="sym_int8")

@@ -203,3 +203,36 @@ def test_generate_on_device_matches_host_loop(tiny_hf_model):
         lambda p, t, kv: generate_on_device(p, cfg, fwd, t, kv, 8),
     )(params, jnp.asarray(ids), new_cache(cfg, 1, 64))
     np.testing.assert_array_equal(np.asarray(dev_out), host_out)
+
+
+def test_rope_scaling_modes():
+    """yarn/dynamic/llama3 configs load, run, and differ from unscaled."""
+    from bigdl_tpu.models import llama as llama_mod
+    from bigdl_tpu.models.llama import LlamaConfig, model_rope_freqs
+    from bigdl_tpu.utils.testing import TINY_LLAMA, random_llama_params
+
+    base_hf = {"vocab_size": 256, "hidden_size": 64,
+               "intermediate_size": 128, "num_hidden_layers": 2,
+               "num_attention_heads": 8, "num_key_value_heads": 4,
+               "max_position_embeddings": 256}
+    params = random_llama_params(TINY_LLAMA, qtype=None, seed=0)
+    toks = jnp.asarray(np.arange(1, 9, dtype=np.int32)[None])
+    ref = np.asarray(llama_mod.forward_train(params, TINY_LLAMA, toks))
+
+    for rs in [{"rope_type": "llama3", "factor": 8.0,
+                "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 128},
+               {"type": "yarn", "factor": 4.0,
+                "original_max_position_embeddings": 64},
+               {"type": "dynamic", "factor": 2.0}]:
+        cfg = LlamaConfig.from_hf({**base_hf, "rope_scaling": rs})
+        inv, mscale = model_rope_freqs(cfg)
+        assert inv.shape == (TINY_LLAMA.hd // 2,)
+        out = np.asarray(llama_mod.forward_train(params, cfg, toks))
+        assert np.all(np.isfinite(out))
+        assert not np.allclose(out, ref), rs  # scaling changes outputs
+
+    with pytest.raises(NotImplementedError, match="longrope"):
+        cfg = LlamaConfig.from_hf(
+            {**base_hf, "rope_scaling": {"type": "longrope"}})
+        model_rope_freqs(cfg)

@@ -174,3 +174,44 @@ def test_engine_serves_mixtral():
             jnp.asarray(np.asarray(p, np.int32)[None]), cache,
             max_new_tokens=6)
         assert got == list(np.asarray(want)[0]), p
+
+
+def test_fastchat_worker_core(model, tmp_path, monkeypatch):
+    """WorkerCore streaming protocol without fastchat installed."""
+    from bigdl_tpu.serving.fastchat_worker import WorkerCore
+
+    # build a low-bit dir so WorkerCore can from_pretrained it
+    import json as _json
+    import os as _os
+
+    from bigdl_tpu.transformers.lowbit_io import save_low_bit
+
+    d = str(tmp_path / "m")
+    save_low_bit(model.params, d,
+                 config={"architectures": ["LlamaForCausalLM"],
+                         "vocab_size": TINY_LLAMA.vocab_size,
+                         "hidden_size": TINY_LLAMA.hidden_size,
+                         "intermediate_size": TINY_LLAMA.intermediate_size,
+                         "num_hidden_layers": TINY_LLAMA.num_hidden_layers,
+                         "num_attention_heads":
+                             TINY_LLAMA.num_attention_heads,
+                         "num_key_value_heads":
+                             TINY_LLAMA.num_key_value_heads,
+                         "max_position_embeddings": 256},
+                 family="llama", qtype="sym_int4")
+    core = WorkerCore(d, max_batch=2, max_seq=128)
+    chunks = list(core.generate_stream(
+        {"prompt": [1, 2, 3, 4], "max_new_tokens": 6}))
+    assert chunks[-1]["finish_reason"] in ("length", "stop")
+    assert chunks[-1]["usage"]["completion_tokens"] == 6
+    got = json.loads(chunks[-1]["text"])
+    assert got == plain_greedy(model.params, [1, 2, 3, 4], 6)
+
+
+def test_env_check():
+    from bigdl_tpu.utils.env_check import collect
+
+    info = collect()
+    assert info["backend"] == "cpu"          # conftest pins the CPU mesh
+    assert len(info["devices"]) == 8
+    assert "native_kernels" in info

@@ -86,3 +86,29 @@ def test_batch_size_guard():
     params = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=0)
     with pytest.raises(ValueError, match="batch size 1"):
         spec(params, params, np.ones((2, 4), np.int32), 8)
+
+
+def test_sampling_mode_runs_and_accepts_self_draft(prompt):
+    """Rejection sampling with draft == target: p == q so min(1,p/q)=1 and
+    nearly every draft is accepted; output is deterministic per seed."""
+    params = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=0)
+    stats = SpecStats()
+    out1 = speculative_generate(
+        params, params, TINY_LLAMA, TINY_LLAMA, prompt,
+        family_forward=llama_mod.forward,
+        family_prefill=llama_mod.forward_last_token,
+        new_cache=llama_mod.new_cache,
+        max_new_tokens=24, gamma=4, max_seq=MAX_SEQ,
+        do_sample=True, temperature=0.9, seed=11, stats=stats)
+    out2 = speculative_generate(
+        params, params, TINY_LLAMA, TINY_LLAMA, prompt,
+        family_forward=llama_mod.forward,
+        family_prefill=llama_mod.forward_last_token,
+        new_cache=llama_mod.new_cache,
+        max_new_tokens=24, gamma=4, max_seq=MAX_SEQ,
+        do_sample=True, temperature=0.9, seed=11)
+    np.testing.assert_array_equal(out1, out2)
+    assert out1.shape[1] <= 24
+    assert np.all((out1 >= 0) & (out1 < TINY_LLAMA.vocab_size))
+    # identical models: acceptance should be high (p == q)
+    assert stats.mean_accept > 2.0, stats.accepted
