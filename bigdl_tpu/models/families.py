@@ -544,6 +544,198 @@ def _chatglm2_map(acc: _Acc, name: str, w) -> None:
 
 
 # ---------------------------------------------------------------------------
+# MPT — ALiBi, LayerNorm (usually bias-free), fused plain-thirds Wqkv
+# (reference transformers/models/mpt.py)
+# ---------------------------------------------------------------------------
+
+def _mpt_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    d = hf["d_model"]
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=d,
+        intermediate_size=hf.get("expansion_ratio", 4) * d,
+        num_hidden_layers=hf["n_layers"],
+        num_attention_heads=hf["n_heads"],
+        num_key_value_heads=hf["n_heads"],
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=True,
+        norm_type="layernorm",
+        mlp_gated=False,
+        hidden_act="gelu",
+        use_rope=False,
+        use_alibi=True,
+        max_position_embeddings=hf.get("max_seq_len", 2048),
+    )
+
+
+def _mpt_map(acc: _Acc, name: str, w) -> None:
+    d = acc.cfg.hidden_size
+    name_ = name[len("transformer."):] if name.startswith("transformer.") \
+        else name
+    if name_ == "wte.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name_ == "norm_f.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name_ == "norm_f.bias":
+        acc.top["norm_bias"] = acc.dense(w)
+    else:
+        hit = _layer_idx(name_, "blocks.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub == "attn.Wqkv.weight":
+            q, k, v = _split_rows(w, [d, d, d])
+            acc.put("q_proj", idx, acc.linear(name, q))
+            acc.put("k_proj", idx, acc.linear(name, k))
+            acc.put("v_proj", idx, acc.linear(name, v))
+        else:
+            m = {
+                "attn.out_proj.weight": ("o_proj", "linear"),
+                "ffn.up_proj.weight": ("up_proj", "linear"),
+                "ffn.down_proj.weight": ("down_proj", "linear"),
+                "norm_1.weight": ("input_layernorm", "dense"),
+                "norm_1.bias": ("input_layernorm_bias", "dense"),
+                "norm_2.weight": ("post_attention_layernorm", "dense"),
+                "norm_2.bias": ("post_attention_layernorm_bias", "dense"),
+            }.get(sub)
+            if m:
+                key, kind = m
+                acc.put(key, idx, acc.linear(name, w) if kind == "linear"
+                        else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
+# GPT-J — parallel residual with ONE shared LN, interleaved partial rotary,
+# dense gelu MLP with biases (reference transformers/models/gptj.py)
+# ---------------------------------------------------------------------------
+
+def _gptj_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["n_embd"],
+        intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+        num_hidden_layers=hf["n_layer"],
+        num_attention_heads=hf["n_head"],
+        num_key_value_heads=hf["n_head"],
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        max_position_embeddings=hf.get("n_positions", 2048),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        norm_type="layernorm",
+        parallel_residual=True,
+        shared_input_norm=True,
+        mlp_gated=False,
+        hidden_act="gelu_tanh",
+        rope_interleaved=True,
+        rotary_dim=hf.get("rotary_dim", 64),
+        lm_head_bias=True,
+    )
+
+
+def _gptj_map(acc: _Acc, name: str, w) -> None:
+    name_ = name[len("transformer."):] if name.startswith("transformer.") \
+        else name
+    if name_ == "wte.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name_ == "ln_f.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name_ == "ln_f.bias":
+        acc.top["norm_bias"] = acc.dense(w)
+    elif name_ == "lm_head.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    elif name_ == "lm_head.bias":
+        acc.top["lm_head_bias"] = acc.dense(w)
+    else:
+        hit = _layer_idx(name_, "h.")
+        if hit is None:
+            return
+        idx, sub = hit
+        m = {
+            "attn.q_proj.weight": ("q_proj", "linear"),
+            "attn.k_proj.weight": ("k_proj", "linear"),
+            "attn.v_proj.weight": ("v_proj", "linear"),
+            "attn.out_proj.weight": ("o_proj", "linear"),
+            "mlp.fc_in.weight": ("up_proj", "linear"),
+            "mlp.fc_in.bias": ("up_proj_bias", "dense"),
+            "mlp.fc_out.weight": ("down_proj", "linear"),
+            "mlp.fc_out.bias": ("down_proj_bias", "dense"),
+            "ln_1.weight": ("input_layernorm", "dense"),
+            "ln_1.bias": ("input_layernorm_bias", "dense"),
+        }.get(sub)
+        if m:
+            key, kind = m
+            acc.put(key, idx, acc.linear(name, w) if kind == "linear"
+                    else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
+# InternLM2 — grouped fused wqkv, llama-style otherwise
+# (reference transformers/models/internlm.py)
+# ---------------------------------------------------------------------------
+
+def _internlm2_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    return LlamaConfig.from_hf(hf)
+
+
+def _internlm2_map(acc: _Acc, name: str, w) -> None:
+    cfg = acc.cfg
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    g = h // hkv
+    if name == "model.tok_embeddings.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name == "model.norm.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name == "output.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    else:
+        hit = _layer_idx(name, "model.layers.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub == "attention.wqkv.weight":
+            # grouped layout: per kv head, (g q heads, 1 k, 1 v)
+            wg = w.reshape(hkv, g + 2, hd, -1)
+            q = wg[:, :g].reshape(h * hd, -1)
+            k = wg[:, g].reshape(hkv * hd, -1)
+            v = wg[:, g + 1].reshape(hkv * hd, -1)
+            acc.put("q_proj", idx, acc.linear(name, q))
+            acc.put("k_proj", idx, acc.linear(name, k))
+            acc.put("v_proj", idx, acc.linear(name, v))
+        else:
+            m = {
+                "attention.wo.weight": "o_proj",
+                "feed_forward.w1.weight": "gate_proj",
+                "feed_forward.w3.weight": "up_proj",
+                "feed_forward.w2.weight": "down_proj",
+                "attention_norm.weight": "input_layernorm",
+                "ffn_norm.weight": "post_attention_layernorm",
+            }.get(sub)
+            if m:
+                is_lin = "norm" not in m
+                acc.put(m, idx, acc.linear(name, w) if is_lin
+                        else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
+# StableLM — LN with bias, partial rotary, gated silu MLP
+# (reference transformers/models/stablelm.py)
+# ---------------------------------------------------------------------------
+
+def _stablelm_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    import dataclasses
+
+    base = LlamaConfig.from_hf(hf)
+    hd = base.hd
+    return dataclasses.replace(
+        base,
+        norm_type="layernorm",
+        rms_norm_eps=hf.get("layer_norm_eps", 1e-5),
+        rotary_dim=int(hf.get("partial_rotary_factor",
+                               hf.get("rope_pct", 0.25)) * hd),
+        attention_bias=bool(hf.get("use_qkv_bias", False)),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Registration
 # ---------------------------------------------------------------------------
 
@@ -596,3 +788,18 @@ def register_all() -> None:
                     _adapter("baichuan", _baichuan_cfg, _baichuan_map))
     register_family(["ChatGLMModel", "ChatGLMForConditionalGeneration"],
                     _adapter("chatglm", _chatglm2_cfg, _chatglm2_map))
+    register_family(["MPTForCausalLM"], _adapter("mpt", _mpt_cfg, _mpt_map))
+    register_family(["GPTJForCausalLM"],
+                    _adapter("gptj", _gptj_cfg, _gptj_map))
+    register_family(["InternLM2ForCausalLM"],
+                    _adapter("internlm2", _internlm2_cfg, _internlm2_map))
+    register_family(["StableLmForCausalLM", "StableLMEpochForCausalLM"],
+                    FamilyAdapter(
+                        name="stablelm",
+                        config_from_hf=_stablelm_cfg,
+                        convert_params=llama_convert,
+                        forward=llama_mod.forward,
+                        prefill=llama_mod.forward_last_token,
+                        forward_train=llama_mod.forward_train,
+                        new_cache=llama_mod.new_cache,
+                    ))

@@ -484,8 +484,12 @@ def _llama_map(acc, name: str, w) -> None:
         acc.top["embed_tokens"] = acc.dense(w)
     elif name == "model.norm.weight":
         acc.top["norm"] = acc.dense(w)
+    elif name == "model.norm.bias":
+        acc.top["norm_bias"] = acc.dense(w)
     elif name == "lm_head.weight":
         acc.top["lm_head"] = acc.linear(name, w)
+    elif name == "lm_head.bias":
+        acc.top["lm_head_bias"] = acc.dense(w)
     elif name.startswith("model.layers."):
         parts = name.split(".")
         idx = int(parts[2])
@@ -500,7 +504,9 @@ def _llama_map(acc, name: str, w) -> None:
         elif sub in ("input_layernorm", "post_attention_layernorm",
                      "pre_feedforward_layernorm",
                      "post_feedforward_layernorm"):
-            acc.put(sub, idx, acc.dense(w))
+            # biased LayerNorm families (stablelm) route .bias separately
+            acc.put(sub if leaf == "weight" else f"{sub}_bias", idx,
+                    acc.dense(w))
         # rotary_emb.inv_freq etc. are derived, skip
 
 

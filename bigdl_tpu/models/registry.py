@@ -64,7 +64,11 @@ def _register_builtin() -> None:
         )
 
     register_family(
-        ["LlamaForCausalLM", "MistralForCausalLM", "CodeLlamaForCausalLM"],
+        ["LlamaForCausalLM", "MistralForCausalLM", "CodeLlamaForCausalLM",
+         # llama-shaped aliases (the reference also routes these through
+         # its llama forwards, convert.py:785-1357)
+         "AquilaForCausalLM", "InternLMForCausalLM", "YiForCausalLM",
+         "DeciLMForCausalLM"],
         llama_adapter())
 
     def qwen2_tweak(cfg, hf):

@@ -97,6 +97,11 @@ _FP8_MAX = {"fp8_e4m3": 448.0, "fp8_e5m2": 57344.0}
 _FP8_DTYPE = {"fp8_e4m3": jnp.float8_e4m3fn, "fp8_e5m2": jnp.float8_e5m2}
 
 
+def is_valid_qtype(name: str) -> bool:
+    """True for concrete qtypes AND mixed_* policies."""
+    return name in QTYPES or name in MIXED_QTYPES
+
+
 def get_qtype(name: str) -> QType:
     try:
         return QTYPES[name]
@@ -399,9 +404,33 @@ def dequantize(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+# Mixed-precision policies: per-TENSOR candidate pick by dequantization MSE
+# (the reference's mixed_fp4/mixed_fp8, low_bit_linear.py:302-335: each
+# layer independently gets whichever 4-/8-bit format reconstructs it best).
+MIXED_QTYPES = {
+    "mixed_fp4": ("fp4", "nf4", "sym_int4"),
+    "mixed_fp8": ("fp8_e4m3", "fp8_e5m2", "sym_int8"),
+}
+
+
+def quantize_auto(x: jax.Array, qtype: str) -> QTensor:
+    """quantize(), plus the mixed_* policies (MSE-picked candidate)."""
+    if qtype not in MIXED_QTYPES:
+        return quantize(x, qtype)
+    xf = jnp.asarray(x, jnp.float32)
+    best_qt, best_err = None, None
+    for cand in MIXED_QTYPES[qtype]:
+        qt = quantize(xf, cand)
+        err = float(jnp.mean(
+            (dequantize(qt, jnp.float32) - xf) ** 2))
+        if best_err is None or err < best_err:
+            best_qt, best_err = qt, err
+    return best_qt
+
+
 def quantize_linear(w_out_in: jax.Array, qtype: str) -> QTensor:
     """Quantize an HF-layout linear weight [out, in] -> QTensor [in, out]."""
-    return quantize(jnp.asarray(w_out_in).T, qtype)
+    return quantize_auto(jnp.asarray(w_out_in).T, qtype)
 
 
 def dequantize_linear(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:

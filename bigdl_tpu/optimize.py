@@ -57,12 +57,28 @@ def optimize_model(
 
 def _quantize_tree(tree: Any, qtype: str, skip: Tuple[str, ...],
                    _name: str = "") -> Any:
+    from bigdl_tpu.ops.quant import MIXED_QTYPES, quantize_auto
+
     if isinstance(tree, dict):
         return {k: _quantize_tree(v, qtype, skip, f"{_name}.{k}")
                 for k, v in tree.items()}
     if _should_quantize(_name, tree, skip):
         if tree.ndim == 2:
-            return quantize(tree, qtype)
+            return quantize_auto(tree, qtype)
         if tree.ndim == 3:  # stacked per-layer [L, K, N]
+            if qtype in MIXED_QTYPES:
+                # per-layer MSE pick needs host sync: quantize layer by
+                # layer (load-time only), then restack
+                qs = [quantize_auto(tree[i], qtype)
+                      for i in range(tree.shape[0])]
+                if len({q.qtype for q in qs}) > 1:
+                    # candidates may differ per layer; a stacked leaf needs
+                    # one format — pick the majority and requantize strays
+                    from collections import Counter
+
+                    best = Counter(q.qtype for q in qs).most_common(1)[0][0]
+                    qs = [q if q.qtype == best else quantize(tree[i], best)
+                          for i, q in enumerate(qs)]
+                return jax.tree.map(lambda *xs: jax.numpy.stack(xs), *qs)
             return jax.vmap(lambda w: quantize(w, qtype))(tree)
     return tree

@@ -145,8 +145,11 @@ class TpuCausalLM:
 def _resolve_qtype(load_in_4bit: bool,
                    load_in_low_bit: Optional[str]) -> Optional[str]:
     if load_in_low_bit is not None:
-        if load_in_low_bit not in FLOAT_QTYPES:
-            get_qtype(load_in_low_bit)  # validate the name early
+        from bigdl_tpu.ops.quant import is_valid_qtype
+
+        if (load_in_low_bit not in FLOAT_QTYPES
+                and not is_valid_qtype(load_in_low_bit)):
+            get_qtype(load_in_low_bit)  # raises with the known-qtype list
         return load_in_low_bit
     if load_in_4bit:
         return "sym_int4"

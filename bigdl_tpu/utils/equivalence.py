@@ -1,0 +1,88 @@
+"""Layer-wise numerical-equivalence harness.
+
+The reference's most interesting test pattern (SURVEY.md §4, reference
+test/inference_gpu/test_transformers_api_attention.py:45-100): load a model
+optimized and unoptimized, replay identical layer inputs, and compare
+per-layer outputs against a mean-absolute-difference bound. Here the
+"unoptimized" model is the f32 dense pytree and the "optimized" one is any
+quantized variant; the per-layer capture is a scan that stacks each
+layer's hidden state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.models import llama as llama_mod
+
+
+def layer_hidden_states(
+    params: Dict[str, Any],
+    cfg,
+    tokens: jax.Array,          # [B, S]
+    compute_dtype=jnp.float32,
+) -> np.ndarray:
+    """Hidden state AFTER each decoder layer: [L, B, S, D] (cacheless)."""
+    b, s = tokens.shape
+    x = llama_mod.embedding_lookup(params["embed_tokens"], tokens,
+                                   compute_dtype)
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
+    if cfg.embed_norm:
+        x = llama_mod._norm(x, params["embed_norm"],
+                            params.get("embed_norm_bias"), cfg)
+    inv_freq, mscale = llama_mod.model_rope_freqs(cfg)
+    from bigdl_tpu.ops.rope import rope_cos_sin
+
+    cos, sin = rope_cos_sin(jnp.arange(s, dtype=jnp.int32)[None, :],
+                            inv_freq)
+    if mscale != 1.0:
+        cos, sin = cos * mscale, sin * mscale
+    slopes = (jnp.asarray(llama_mod.alibi_slopes(cfg.num_attention_heads))
+              if cfg.use_alibi else None)
+
+    def step(x, xs):
+        lp, lidx = xs
+        out, _ = llama_mod._decoder_layer(x, lp, cfg, cos, sin, slopes,
+                                          cache_ctx=None, lidx=lidx)
+        return out, out
+
+    lids = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
+    _, per_layer = lax.scan(step, x, (params["layers"], lids))
+    return np.asarray(per_layer, np.float32)
+
+
+def layer_equivalence_report(
+    params_ref: Dict[str, Any],
+    params_opt: Dict[str, Any],
+    cfg,
+    tokens,
+) -> List[Dict[str, float]]:
+    """Per-layer MAD + relative error between two parameter variants."""
+    toks = jnp.asarray(np.asarray(tokens, np.int32))
+    if toks.ndim == 1:
+        toks = toks[None]
+    ref = layer_hidden_states(params_ref, cfg, toks)
+    opt = layer_hidden_states(params_opt, cfg, toks)
+    out = []
+    for i in range(ref.shape[0]):
+        mad = float(np.mean(np.abs(ref[i] - opt[i])))
+        scale = float(np.mean(np.abs(ref[i]))) + 1e-9
+        out.append({"layer": i, "mad": mad, "relative": mad / scale})
+    return out
+
+
+def assert_equivalent(params_ref, params_opt, cfg, tokens,
+                      max_relative: float = 0.1) -> List[Dict[str, float]]:
+    """The reference's lower_bound assertion, per layer."""
+    report = layer_equivalence_report(params_ref, params_opt, cfg, tokens)
+    bad = [r for r in report if r["relative"] > max_relative]
+    if bad:
+        raise AssertionError(
+            f"layer equivalence exceeded {max_relative}: {bad}")
+    return report

@@ -1,0 +1,71 @@
+"""Mixed-qtype + layer-equivalence tests (the reference's numerical-
+equivalence harness pattern, SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.ops.quant import (MIXED_QTYPES, QTensor, dequantize,
+                                 quantize, quantize_auto)
+from bigdl_tpu.utils.equivalence import (assert_equivalent,
+                                         layer_equivalence_report)
+from bigdl_tpu.utils.testing import TINY_LLAMA, random_llama_params
+
+
+def test_mixed_fp4_picks_best_candidate():
+    rng = np.random.default_rng(0)
+    # gaussian weights: nf4 (normal-optimized codebook) should beat fp4
+    w = jnp.asarray(rng.standard_normal((64, 32)).astype(np.float32) * 0.02)
+    qt = quantize_auto(w, "mixed_fp4")
+    assert qt.qtype in MIXED_QTYPES["mixed_fp4"]
+    err_mixed = float(jnp.mean((dequantize(qt, jnp.float32) - w) ** 2))
+    for cand in MIXED_QTYPES["mixed_fp4"]:
+        err_c = float(jnp.mean(
+            (dequantize(quantize(w, cand), jnp.float32) - w) ** 2))
+        assert err_mixed <= err_c + 1e-12
+
+
+def test_mixed_qtype_through_facade_params():
+    from bigdl_tpu.models import llama as llama_mod
+
+    params = llama_mod.convert_hf_params(
+        iter([("model.embed_tokens.weight",
+               np.random.default_rng(0).standard_normal(
+                   (TINY_LLAMA.vocab_size, 64)).astype(np.float32) * .02),
+              ]), TINY_LLAMA.__class__(  # minimal config, no layers needed
+                  vocab_size=TINY_LLAMA.vocab_size, hidden_size=64,
+                  intermediate_size=128, num_hidden_layers=0,
+                  num_attention_heads=8, tie_word_embeddings=True),
+        qtype="mixed_fp4")
+    assert "embed_tokens" in params
+
+
+def test_layer_equivalence_quantized_vs_dense():
+    dense = random_llama_params(TINY_LLAMA, qtype=None, seed=0,
+                                compute_dtype=jnp.float32)
+    from bigdl_tpu.optimize import optimize_model
+
+    q4 = optimize_model(
+        {k: v for k, v in dense.items()}, low_bit="sym_int4")
+    toks = np.arange(1, 13, dtype=np.int32) % TINY_LLAMA.vocab_size
+
+    report = assert_equivalent(dense, q4, TINY_LLAMA, toks,
+                               max_relative=0.2)
+    assert len(report) == TINY_LLAMA.num_hidden_layers
+    assert all(r["relative"] > 0 for r in report)
+
+    # int8 must be closer than int4 layer-by-layer
+    q8 = optimize_model({k: v for k, v in dense.items()}, low_bit="sym_int8")
+    rep8 = layer_equivalence_report(dense, q8, TINY_LLAMA, toks)
+    rep4 = layer_equivalence_report(dense, q4, TINY_LLAMA, toks)
+    assert all(a["mad"] < b["mad"] for a, b in zip(rep8, rep4))
+
+
+def test_equivalence_failure_raises():
+    dense = random_llama_params(TINY_LLAMA, qtype=None, seed=0,
+                                compute_dtype=jnp.float32)
+    other = random_llama_params(TINY_LLAMA, qtype=None, seed=9,
+                                compute_dtype=jnp.float32)
+    toks = np.arange(1, 9, dtype=np.int32)
+    with pytest.raises(AssertionError, match="equivalence"):
+        assert_equivalent(dense, other, TINY_LLAMA, toks, max_relative=0.05)

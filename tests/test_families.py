@@ -211,6 +211,87 @@ def fake_ckpt(arch):
                     np.ones((D,), np.float32))]
         return hf, ts
 
+    if arch == "MPTForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "d_model": D,
+              "n_layers": L, "n_heads": H, "expansion_ratio": 2,
+              "max_seq_len": 256}
+        ts = [("transformer.wte.weight", t(rng, V, D)),
+              ("transformer.norm_f.weight", np.ones((D,), np.float32))]
+        for i in range(L):
+            p = f"transformer.blocks.{i}."
+            ts += [(p + "attn.Wqkv.weight", t(rng, 3 * D, D)),
+                   (p + "attn.out_proj.weight", t(rng, D, D)),
+                   (p + "ffn.up_proj.weight", t(rng, 2 * D, D)),
+                   (p + "ffn.down_proj.weight", t(rng, D, 2 * D)),
+                   (p + "norm_1.weight", np.ones((D,), np.float32)),
+                   (p + "norm_2.weight", np.ones((D,), np.float32))]
+        return hf, ts
+
+    if arch == "GPTJForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "n_embd": D,
+              "n_layer": L, "n_head": H, "n_positions": 256,
+              "rotary_dim": 4, "layer_norm_epsilon": 1e-5}
+        ts = [("transformer.wte.weight", t(rng, V, D)),
+              ("lm_head.weight", t(rng, V, D)),
+              ("lm_head.bias", np.zeros((V,), np.float32))]
+        ts += ln_pair(rng, "transformer.ln_f", D)
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            ts += [(p + "attn.q_proj.weight", t(rng, D, D)),
+                   (p + "attn.k_proj.weight", t(rng, D, D)),
+                   (p + "attn.v_proj.weight", t(rng, D, D)),
+                   (p + "attn.out_proj.weight", t(rng, D, D)),
+                   (p + "mlp.fc_in.weight", t(rng, 4 * D, D)),
+                   (p + "mlp.fc_in.bias", np.zeros((4 * D,), np.float32)),
+                   (p + "mlp.fc_out.weight", t(rng, D, 4 * D)),
+                   (p + "mlp.fc_out.bias", np.zeros((D,), np.float32))]
+            ts += ln_pair(rng, p + "ln_1", D)
+        return hf, ts
+
+    if arch == "InternLM2ForCausalLM":
+        hkv = 4
+        g = H // hkv
+        hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
+              "intermediate_size": FF, "num_hidden_layers": L,
+              "num_attention_heads": H, "num_key_value_heads": hkv,
+              "rms_norm_eps": 1e-6}
+        ts = [("model.tok_embeddings.weight", t(rng, V, D)),
+              ("model.norm.weight", np.ones((D,), np.float32)),
+              ("output.weight", t(rng, V, D))]
+        for i in range(L):
+            p = f"model.layers.{i}."
+            ts += [(p + "attention.wqkv.weight",
+                    t(rng, hkv * (g + 2) * hd, D)),
+                   (p + "attention.wo.weight", t(rng, D, H * hd)),
+                   (p + "feed_forward.w1.weight", t(rng, FF, D)),
+                   (p + "feed_forward.w3.weight", t(rng, FF, D)),
+                   (p + "feed_forward.w2.weight", t(rng, D, FF)),
+                   (p + "attention_norm.weight", np.ones((D,), np.float32)),
+                   (p + "ffn_norm.weight", np.ones((D,), np.float32))]
+        return hf, ts
+
+    if arch == "StableLmForCausalLM":
+        hf = {"architectures": [arch], "vocab_size": V, "hidden_size": D,
+              "intermediate_size": FF, "num_hidden_layers": L,
+              "num_attention_heads": H, "num_key_value_heads": H,
+              "layer_norm_eps": 1e-5, "partial_rotary_factor": 0.25,
+              "use_qkv_bias": False}
+        ts = [("model.embed_tokens.weight", t(rng, V, D)),
+              ("lm_head.weight", t(rng, V, D))]
+        ts += ln_pair(rng, "model.norm", D)
+        for i in range(L):
+            p = f"model.layers.{i}."
+            ts += [(p + "self_attn.q_proj.weight", t(rng, D, D)),
+                   (p + "self_attn.k_proj.weight", t(rng, D, D)),
+                   (p + "self_attn.v_proj.weight", t(rng, D, D)),
+                   (p + "self_attn.o_proj.weight", t(rng, D, D)),
+                   (p + "mlp.gate_proj.weight", t(rng, FF, D)),
+                   (p + "mlp.up_proj.weight", t(rng, FF, D)),
+                   (p + "mlp.down_proj.weight", t(rng, D, FF))]
+            ts += ln_pair(rng, p + "input_layernorm", D)
+            ts += ln_pair(rng, p + "post_attention_layernorm", D)
+        return hf, ts
+
     if arch == "ChatGLMModel":
         g = 2  # multi-query groups
         hf = {"architectures": [arch], "padded_vocab_size": V,
@@ -240,9 +321,11 @@ def fake_ckpt(arch):
     raise AssertionError(arch)
 
 
-ARCHS = ["GemmaForCausalLM", "Gemma2ForCausalLM", "PhiForCausalLM", "GPTNeoXForCausalLM",
+ARCHS = ["GemmaForCausalLM", "Gemma2ForCausalLM", "PhiForCausalLM",
+         "GPTNeoXForCausalLM",
          "BloomForCausalLM", "FalconForCausalLM", "Starcoder2ForCausalLM",
-         "BaichuanForCausalLM", "ChatGLMModel"]
+         "BaichuanForCausalLM", "ChatGLMModel", "MPTForCausalLM",
+         "GPTJForCausalLM", "InternLM2ForCausalLM", "StableLmForCausalLM"]
 
 
 @pytest.mark.parametrize("arch", ARCHS)
@@ -350,3 +433,46 @@ def test_facade_embedding_qtype(tmp_path):
     assert isinstance(m.params["embed_tokens"], QTensor)
     out = m.generate(np.arange(1, 7, dtype=np.int32), max_new_tokens=4)
     assert out.shape == (1, 10)
+
+
+def test_stablelm_ln_bias_mapped_not_overwritten():
+    """Regression: biased-LayerNorm checkpoints must route .bias to
+    *_bias keys, never overwrite the scale under the same key."""
+    hf, tensors = fake_ckpt("StableLmForCausalLM")
+    # give biases distinctive non-zero values
+    tensors = [(n, (np.full_like(w, 0.25) if n.endswith("layernorm.bias")
+                    or n.endswith("norm.bias") else w))
+               for n, w in tensors]
+    fam = get_family("StableLmForCausalLM")
+    cfg = fam.config_from_hf(hf)
+    params = fam.convert_params(iter(tensors), cfg, qtype="sym_int4")
+    ly = params["layers"]
+    assert "input_layernorm_bias" in ly
+    np.testing.assert_allclose(np.asarray(ly["input_layernorm_bias"],
+                                          np.float32), 0.25, atol=1e-3)
+    # scales must still be the ones (not overwritten by 0.25 biases)
+    np.testing.assert_allclose(np.asarray(ly["input_layernorm"],
+                                          np.float32), 1.0, atol=1e-3)
+    assert "norm_bias" in params
+    # and the biases must influence the forward
+    toks = jnp.asarray(np.asarray([[1, 2, 3, 4]], np.int32))
+    out_b = np.asarray(fam.forward_train(params, cfg, toks))
+    params0 = fam.convert_params(iter(fake_ckpt("StableLmForCausalLM")[1]),
+                                 cfg, qtype="sym_int4")
+    out_0 = np.asarray(fam.forward_train(params0, cfg, toks))
+    assert not np.allclose(out_b, out_0)
+
+
+def test_optimize_model_mixed_qtype():
+    from bigdl_tpu.optimize import optimize_model
+    from bigdl_tpu.ops.quant import MIXED_QTYPES, QTensor
+    from bigdl_tpu.utils.testing import TINY_LLAMA, random_llama_params
+
+    dense = random_llama_params(TINY_LLAMA, qtype=None, seed=0)
+    q = optimize_model(dict(dense), low_bit="mixed_fp4")
+    leaf = q["layers"]["q_proj"]
+    assert isinstance(leaf, QTensor)
+    assert leaf.qtype in MIXED_QTYPES["mixed_fp4"]
+    out = llama_mod.forward_train(q, TINY_LLAMA,
+                                  jnp.asarray([[1, 2, 3, 4]], jnp.int32))
+    assert np.all(np.isfinite(np.asarray(out)))
