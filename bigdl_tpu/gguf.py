@@ -48,6 +48,7 @@ GGML_F32, GGML_F16 = 0, 1
 GGML_Q4_0, GGML_Q4_1 = 2, 3
 GGML_Q5_0, GGML_Q5_1 = 6, 7
 GGML_Q8_0 = 8
+GGML_Q2_K = 10
 GGML_BF16 = 30
 
 # (block size in values, bytes per block)
@@ -56,13 +57,28 @@ _BLOCK = {
     GGML_Q4_0: (32, 18), GGML_Q4_1: (32, 20),
     GGML_Q5_0: (32, 22), GGML_Q5_1: (32, 24),
     GGML_Q8_0: (32, 34),
+    GGML_Q2_K: (256, 84),
 }
 
 _GGML_TO_QTYPE = {
     GGML_Q4_0: "sym_int4", GGML_Q4_1: "asym_int4",
     GGML_Q5_0: "sym_int5", GGML_Q5_1: "asym_int5",
-    GGML_Q8_0: "sym_int8",
+    GGML_Q8_0: "sym_int8", GGML_Q2_K: "q2_k",
 }
+
+
+def _decode_q2k(blk: np.ndarray):
+    """Q2_K blocks [nblk, 84] -> (codes [nblk,256] u8, scales [nblk,16] u8,
+    d [nblk] f32, dmin [nblk] f32). ggml block_q2_K layout: scales[16],
+    qs[64], d fp16, dmin fp16; value (c*128 + s*32 + l) = (qs[c*32+l]>>2s)&3.
+    """
+    scales = blk[:, :16]
+    qs = blk[:, 16:80].reshape(-1, 2, 32)
+    codes = np.stack([(qs >> s) & 3 for s in (0, 2, 4, 6)],
+                     axis=2).reshape(-1, 256).astype(np.uint8)
+    d = np.ascontiguousarray(blk[:, 80:82]).view(np.float16)[:, 0]
+    dmin = np.ascontiguousarray(blk[:, 82:84]).view(np.float16)[:, 0]
+    return codes, scales, d.astype(np.float32), dmin.astype(np.float32)
 
 
 def _read_str(f: BinaryIO) -> str:
@@ -225,6 +241,15 @@ class GGUFFile:
                 m = blk[:, 2:4].copy().view(np.float16).astype(np.float32)
                 vals = q * d + m
             return vals.reshape(shape).astype(dtype)
+        if gt == GGML_Q2_K:
+            codes, scales, d, dmin = _decode_q2k(blk)
+            sc = (scales & 0x0F).astype(np.float32)        # [nblk, 16]
+            m = (scales >> 4).astype(np.float32)
+            sc_r = np.repeat(sc, 16, axis=1)               # [nblk, 256]
+            m_r = np.repeat(m, 16, axis=1)
+            vals = (d[:, None] * sc_r * codes.astype(np.float32)
+                    - dmin[:, None] * m_r)
+            return vals.reshape(shape).astype(dtype)
         if gt in (GGML_Q5_0, GGML_Q5_1):
             hdr = 2 if gt == GGML_Q5_0 else 4
             qh = blk[:, hdr:hdr + 4].copy().view(np.uint32)[:, 0]
@@ -269,6 +294,22 @@ class GGUFFile:
         def f16(sl):
             return np.ascontiguousarray(sl).view(np.float16)[..., 0]
 
+        if gt == GGML_Q2_K:
+            # decode codes in ggml order, re-encode into our 4-plane layout
+            codes, scales, d, dmin = _decode_q2k(blk.reshape(-1, bpb))
+            codes = codes.reshape(n, nblk, 256)
+            # ours: byte j of a 256-block holds values j, j+64, j+128, j+192
+            planes = codes.reshape(n, nblk, 4, 64)
+            packed = (planes[:, :, 0] | (planes[:, :, 1] << 2)
+                      | (planes[:, :, 2] << 4) | (planes[:, :, 3] << 6))
+            data = packed.reshape(n, k // 4).T             # [K/4, N]
+            aux = scales.reshape(n, k // 16).T             # [K/16, N]
+            return QTensor(
+                jnp.asarray(np.ascontiguousarray(data)),
+                jnp.asarray(d.reshape(n, nblk).T).astype(jnp.bfloat16),
+                jnp.asarray(dmin.reshape(n, nblk).T).astype(jnp.bfloat16),
+                "q2_k", (k, n),
+                aux=jnp.asarray(np.ascontiguousarray(aux)))
         if gt == GGML_Q8_0:
             d = f16(blk[:, :, 0:2])                    # [N, nblk]
             q = blk[:, :, 2:].view(np.int8)            # [N, nblk, 32]

@@ -79,6 +79,10 @@ QTYPES = {
     "fp4": _q("fp4", 4, 64, "codebook", codebook="fp4"),
     "fp8_e4m3": _q("fp8_e4m3", 8, 128, "fp8"),
     "fp8_e5m2": _q("fp8_e5m2", 8, 128, "fp8"),
+    # 2-bit k-quant: 256-value superblocks of 16 sub-blocks, 4-bit
+    # sub-scales/mins under fp16 super scales (ggml Q2_K; the format behind
+    # the reference's "Mixtral on 16 GB" claim, README.md:16)
+    "q2_k": _q("q2_k", 2, 256, "q2k"),
 }
 # Aliases used throughout the reference API surface.
 QTYPES["int4"] = QTYPES["sym_int4"]
@@ -124,9 +128,12 @@ class QTensor:
     Fields:
       data:  packed codes. 4-bit: uint8 [K//2, N] split-block nibble packing.
              8-bit sym: int8 [K, N]. fp8: float8_* [K, N].
-      scale: bf16 [K // block, N] per-block scale.
-      zero:  bf16 [K // block, N] per-block minimum (asym kinds) or None.
-      aux:   uint8 [K // 8, N] high-bit plane (int5 kinds) or None.
+      scale: bf16 [K // block, N] per-block scale (q2_k: superblock d).
+      zero:  bf16 [K // block, N] per-block minimum (asym kinds), the
+             superblock dmin (q2_k), or None.
+      aux:   uint8 extra plane or None. int5 kinds: [K // 8, N] high-bit
+             plane. q2_k: [K // 16, N] packed 4-bit sub-scale (low nibble)
+             and sub-min (high nibble) per 16-value sub-block.
       qtype: qtype name (static).
       shape: logical (K, N) before padding (static). K may be padded up to a
              block multiple in `data`; `shape` records the true K.
@@ -185,6 +192,11 @@ class QTensor:
 # ---------------------------------------------------------------------------
 
 
+def _safe_inv(x: jax.Array) -> jax.Array:
+    """1/x with 0 -> 0 (no NaNs from empty/zero blocks)."""
+    return jnp.where(x == 0, 0.0, 1.0 / jnp.where(x == 0, 1.0, x))
+
+
 def _pack4(codes: jax.Array, block: int) -> jax.Array:
     """[K, N] uint8 codes (0..15) -> [K//2, N] split-block packed bytes."""
     k, n = codes.shape
@@ -220,6 +232,26 @@ def _unpack_bits1(plane: jax.Array) -> jax.Array:
     shifts = jnp.arange(8, dtype=jnp.uint8).reshape(1, 8, 1)
     bits = (plane[:, None, :] >> shifts) & jnp.uint8(1)
     return bits.reshape(k8 * 8, n)
+
+
+def _pack2(codes: jax.Array, block: int) -> jax.Array:
+    """[K, N] uint8 codes (0..3) -> [K//4, N]: 4 planes of block//4 rows."""
+    k, n = codes.shape
+    b4 = block // 4
+    blk = codes.reshape(k // block, 4, b4, n)
+    packed = (blk[:, 0] | (blk[:, 1] << 2) | (blk[:, 2] << 4)
+              | (blk[:, 3] << 6)).astype(jnp.uint8)
+    return packed.reshape(k // 4, n)
+
+
+def _unpack2(packed: jax.Array, block: int) -> jax.Array:
+    """[K//4, N] -> [K, N] uint8 codes (0..3)."""
+    k4, n = packed.shape
+    b4 = block // 4
+    blk = packed.reshape(k4 // b4, b4, n)
+    planes = jnp.stack([(blk >> (2 * i)) & jnp.uint8(3) for i in range(4)],
+                       axis=1)
+    return planes.reshape(k4 * 4, n)
 
 
 def _pad_k(x: jax.Array, block: int) -> jax.Array:
@@ -272,7 +304,7 @@ def quantize(x: jax.Array, qtype: str) -> QTensor:
         mx = jnp.take_along_axis(xb, amax_i, axis=1)  # [nblk, 1, n], signed
         half = float(1 << (qt.bits - 1))
         d = mx / -half
-        inv = jnp.where(d == 0, 0.0, 1.0 / jnp.where(d == 0, 1.0, d))
+        inv = _safe_inv(d)
         q = jnp.clip(jnp.round(xb * inv) + half, 0, 2 * half - 1)
         q = q.reshape(kp, n).astype(jnp.uint8)
         scale = d.reshape(nblk, n).astype(jnp.bfloat16)
@@ -292,7 +324,7 @@ def quantize(x: jax.Array, qtype: str) -> QTensor:
         mxv = jnp.max(xb, axis=1, keepdims=True)
         levels = float((1 << qt.bits) - 1)
         d = (mxv - mn) / levels
-        inv = jnp.where(d == 0, 0.0, 1.0 / jnp.where(d == 0, 1.0, d))
+        inv = _safe_inv(d)
         q = jnp.clip(jnp.round((xb - mn) * inv), 0, levels)
         q = q.reshape(kp, n).astype(jnp.uint8)
         scale = d.reshape(nblk, n).astype(jnp.bfloat16)
@@ -309,17 +341,44 @@ def quantize(x: jax.Array, qtype: str) -> QTensor:
         code = CODEBOOKS[qt.codebook]
         amax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
         d = amax
-        inv = jnp.where(d == 0, 0.0, 1.0 / jnp.where(d == 0, 1.0, d))
+        inv = _safe_inv(d)
         q = _codebook_encode(code, xb * inv).reshape(kp, n).astype(jnp.uint8)
         scale = d.reshape(nblk, n).astype(jnp.bfloat16)
         return QTensor(_pack4(q, b), scale, None, qtype, (k, n))
+
+    if qt.kind == "q2k":
+        # per 16-value sub-block: asymmetric 2-bit with 4-bit quantized
+        # sub scale/min under per-superblock fp16 scales (ggml Q2_K shape)
+        sub = xb.reshape(nblk, b // 16, 16, n)
+        mn = jnp.minimum(jnp.min(sub, axis=2), 0.0)        # [nblk, 16, n]
+        mxv = jnp.max(sub, axis=2)
+        ssc = jnp.maximum(mxv - mn, 0.0) / 3.0             # sub scale
+        smin = -mn                                          # sub min (>=0)
+        d = jnp.max(ssc, axis=1, keepdims=True) / 15.0     # [nblk, 1, n]
+        dmin = jnp.max(smin, axis=1, keepdims=True) / 15.0
+        dinv = _safe_inv(d)
+        minv = _safe_inv(dmin)
+        sc4 = jnp.clip(jnp.round(ssc * dinv), 0, 15).astype(jnp.uint8)
+        m4 = jnp.clip(jnp.round(smin * minv), 0, 15).astype(jnp.uint8)
+        eff_sc = d * sc4                                    # [nblk, 16, n]
+        eff_m = dmin * m4
+        inv_sc = _safe_inv(eff_sc)
+        q = jnp.clip(jnp.round((sub + eff_m[:, :, None, :])
+                               * inv_sc[:, :, None, :]), 0, 3)
+        q = q.reshape(kp, n).astype(jnp.uint8)
+        aux = (sc4 | (m4 << 4)).reshape(kp // 16, n)        # [K/16, N]
+        return QTensor(
+            _pack2(q, b),
+            d[:, 0, :].astype(jnp.bfloat16),
+            dmin[:, 0, :].astype(jnp.bfloat16),
+            qtype, (k, n), aux=aux)
 
     if qt.kind == "fp8":
         fmax = _FP8_MAX[qt.name]
         fdt = _FP8_DTYPE[qt.name]
         amax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
         d = amax / fmax
-        inv = jnp.where(d == 0, 0.0, 1.0 / jnp.where(d == 0, 1.0, d))
+        inv = _safe_inv(d)
         q = (xb * inv).astype(fdt).reshape(kp, n)
         scale = d.reshape(nblk, n).astype(jnp.bfloat16)
         return QTensor(q, scale, None, qtype, (k, n))
@@ -384,6 +443,17 @@ def dequantize(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
         d = _expand_scale(qt.scale, b, kp)
         m = _expand_scale(qt.zero, b, kp)
         out = codes.astype(jnp.float32) * d + m
+        return out[:k].astype(dtype)
+
+    if t.kind == "q2k":
+        codes = _unpack2(qt.data, b).astype(jnp.float32)    # [Kp, N]
+        kp = codes.shape[0]
+        sc4 = (qt.aux & jnp.uint8(0xF)).astype(jnp.float32)  # [Kp/16, N]
+        m4 = (qt.aux >> 4).astype(jnp.float32)
+        rep16 = lambda a: jnp.repeat(a, 16, axis=0)
+        d = _expand_scale(qt.scale, b, kp)
+        dmin = _expand_scale(qt.zero, b, kp)
+        out = d * rep16(sc4) * codes - dmin * rep16(m4)
         return out[:k].astype(dtype)
 
     if t.kind == "asym" and t.bits == 5:
