@@ -19,3 +19,25 @@ from bigdl_tpu.ops.quant import (  # noqa: F401
     dequantize_linear,
 )
 from bigdl_tpu.optimize import optimize_model  # noqa: F401
+from bigdl_tpu.llm_patching import llm_patch, llm_unpatch  # noqa: F401
+
+
+def __getattr__(name):
+    # heavyweight subsystems resolve lazily so `import bigdl_tpu` stays light
+    if name == "AutoModelForCausalLM":
+        from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+        return AutoModelForCausalLM
+    if name == "AutoModel":
+        from bigdl_tpu.transformers.model import AutoModel
+
+        return AutoModel
+    if name == "LLMEngine":
+        from bigdl_tpu.serving import LLMEngine
+
+        return LLMEngine
+    if name == "speculative_generate":
+        from bigdl_tpu.speculative import speculative_generate
+
+        return speculative_generate
+    raise AttributeError(f"module 'bigdl_tpu' has no attribute {name!r}")

@@ -1,0 +1,99 @@
+"""Multiple-choice accuracy evaluation (C-Eval / MMLU style).
+
+Equivalent of the reference's C-Eval runner (reference dev/benchmark/ceval:
+per-subject CSVs of question + 4 choices scored by option loglikelihood).
+This runner is dataset-agnostic: feed records {"question", "choices",
+"answer"} (answer = index or letter) from any source; scoring picks the
+choice with the highest length-normalized loglikelihood, sharing
+`sequence_loglikelihood` with the lm-eval adapter.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.bench.lm_eval_adapter import sequence_loglikelihood
+
+_LETTERS = "ABCDEFGH"
+
+
+def _answer_index(ans, n_choices: int) -> int:
+    if isinstance(ans, str) and ans.strip().upper() in tuple(_LETTERS):
+        idx = _LETTERS.index(ans.strip().upper())
+    else:
+        idx = int(ans)
+    if not 0 <= idx < n_choices:
+        raise ValueError(f"answer {ans!r} out of range for {n_choices}")
+    return idx
+
+
+def format_mcq(question: str, choices: Sequence[str]) -> str:
+    lines = [question.strip()]
+    for i, c in enumerate(choices):
+        lines.append(f"{_LETTERS[i]}. {c}")
+    lines.append("Answer:")
+    return "\n".join(lines)
+
+
+def evaluate_mcq(
+    model: Any,
+    tokenizer: Any,
+    records: Iterable[Dict[str, Any]],
+    max_records: Optional[int] = None,
+    length_normalize: bool = True,
+) -> Dict[str, Any]:
+    """Returns {"accuracy", "n", "per_record": [...]}."""
+    n = 0
+    correct = 0
+    details: List[Dict[str, Any]] = []
+    for rec in records:
+        if max_records is not None and n >= max_records:
+            break
+        choices = rec["choices"]
+        prompt = format_mcq(rec["question"], choices)
+        ctx_ids = tokenizer(prompt)["input_ids"]
+        scores = []
+        for i, choice in enumerate(choices):
+            cont = tokenizer(f" {_LETTERS[i]}",
+                             add_special_tokens=False)["input_ids"]
+            if not cont:
+                raise ValueError(
+                    f"tokenizer produced no ids for option letter "
+                    f"{_LETTERS[i]!r}; its vocabulary cannot score this "
+                    "dataset")
+            ll, _ = sequence_loglikelihood(model, ctx_ids, cont)
+            scores.append(ll / (len(cont) if length_normalize else 1))
+        pred = int(np.argmax(scores))
+        truth = _answer_index(rec["answer"], len(choices))
+        correct += int(pred == truth)
+        n += 1
+        details.append({"pred": pred, "answer": truth, "scores": scores})
+    return {"accuracy": correct / max(n, 1), "n": n, "per_record": details}
+
+
+def main() -> None:
+    """CLI: python -m bigdl_tpu.bench.mcq_eval --model M --data D.json"""
+    import argparse
+
+    from bigdl_tpu.transformers.loader import load_model
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--data", required=True,
+                    help="JSON list of {question, choices, answer}")
+    ap.add_argument("--low-bit", default="sym_int4")
+    ap.add_argument("--max-records", type=int, default=None)
+    args = ap.parse_args()
+
+    model, tokenizer = load_model(args.model, low_bit=args.low_bit)
+    records = json.load(open(args.data))
+    res = evaluate_mcq(model, tokenizer, records,
+                       max_records=args.max_records)
+    print(json.dumps({"accuracy": res["accuracy"], "n": res["n"]}))
+
+
+if __name__ == "__main__":
+    main()

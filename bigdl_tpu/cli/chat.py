@@ -48,7 +48,21 @@ def _load(args):
 
         tokenizer = AutoTokenizer.from_pretrained(args.model)
     except Exception:
-        print("warning: no tokenizer found; token-id mode", file=sys.stderr)
+        tok_info = getattr(model, "gguf_tokenizer_info", None)
+        if tok_info:
+            # reconstruct from the GGUF vocabulary already parsed at load
+            # (reference gguf/api.py)
+            try:
+                from bigdl_tpu.gguf_tokenizer import GGUFTokenizer
+
+                tokenizer = GGUFTokenizer.from_tokenizer_info(tok_info)
+                print("using tokenizer reconstructed from GGUF vocab",
+                      file=sys.stderr)
+            except ValueError as e:
+                print(f"gguf tokenizer unusable ({e})", file=sys.stderr)
+        if tokenizer is None:
+            print("warning: no tokenizer found; token-id mode",
+                  file=sys.stderr)
     return model, tokenizer
 
 

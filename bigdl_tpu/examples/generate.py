@@ -1,0 +1,51 @@
+"""Minimal generation example (the reference's example/GPU/HF-Transformers-
+AutoModels/Model/llama2 generate.py pattern, TPU-native).
+
+    python -m bigdl_tpu.examples.generate --repo-id-or-model-path PATH \
+        --prompt "Once upon a time" --n-predict 64 [--low-bit nf4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repo-id-or-model-path", required=True)
+    ap.add_argument("--prompt", default="Once upon a time, there existed a "
+                    "little girl who liked to have adventures.")
+    ap.add_argument("--n-predict", type=int, default=64)
+    ap.add_argument("--low-bit", default="sym_int4")
+    ap.add_argument("--speculative", action="store_true")
+    args = ap.parse_args()
+
+    from transformers import AutoTokenizer
+
+    from bigdl_tpu.generation import GenerationStats
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        args.repo_id_or_model_path, load_in_low_bit=args.low_bit,
+        speculative=args.speculative)
+    tokenizer = AutoTokenizer.from_pretrained(args.repo_id_or_model_path)
+
+    ids = tokenizer(args.prompt)["input_ids"]
+    stats = GenerationStats()
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=args.n_predict, stats=stats)
+    wall = time.perf_counter() - t0
+    text = tokenizer.decode(out[0], skip_special_tokens=True)
+    print("-" * 20, "Output", "-" * 20)
+    print(text)
+    print("-" * 48)
+    n_new = out.shape[1] - len(ids)
+    print(f"{n_new} tokens in {wall:.2f}s | "
+          f"first {stats.first_token_s * 1e3:.0f} ms | "
+          f"rest {stats.rest_cost_mean * 1e3:.2f} ms/tok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

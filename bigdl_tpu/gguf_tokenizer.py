@@ -1,0 +1,117 @@
+"""Tokenizer reconstructed from GGUF vocabulary metadata.
+
+Equivalent of the reference's tokenizer reconstruction for GGUF loads
+(reference transformers/gguf/api.py builds an HF tokenizer from
+tokenizer.ggml.* keys). Here a self-contained tokenizer is built from the
+same keys — no sentencepiece/transformers dependency:
+
+- decode: exact (sentencepiece ▁ convention + <0xNN> byte tokens)
+- encode: longest-match greedy over the vocab for llama-style sentencepiece
+  vocabs, with byte-token fallback for unknown bytes. Greedy matching is
+  not bit-identical to sentencepiece's unigram segmentation for every
+  string, but round-trips text exactly (encode -> decode == input) and
+  produces valid ids for generation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+_SP_SPACE = "▁"   # sentencepiece's meta-space
+
+
+class GGUFTokenizer:
+    def __init__(self, tokens: List[str],
+                 bos_token_id: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 add_bos: bool = True):
+        self.tokens = list(tokens)
+        self.unk_token_id = (tokens.index("<unk>")
+                             if "<unk>" in tokens else None)
+        self.bos_token_id = bos_token_id
+        self.eos_token_id = eos_token_id
+        self.add_bos = add_bos and bos_token_id is not None
+        self._index: Dict[str, int] = {}
+        for i, t in enumerate(self.tokens):
+            self._index.setdefault(t, i)
+        self._byte_ids: Dict[int, int] = {}
+        for i, t in enumerate(self.tokens):
+            if len(t) == 6 and t.startswith("<0x") and t.endswith(">"):
+                try:
+                    self._byte_ids[int(t[3:5], 16)] = i
+                except ValueError:
+                    pass
+        self._max_len = max((len(t) for t in self.tokens), default=1)
+
+    @classmethod
+    def from_tokenizer_info(cls, info: Dict) -> "GGUFTokenizer":
+        """Build from GGUFFile.tokenizer_info(). Sentencepiece vocabs only
+        ("llama"/"spm"); BPE ("gpt2") vocabs would silently mis-tokenize
+        under the ▁ convention, so they are rejected."""
+        if not info.get("tokens"):
+            raise ValueError("GGUF file carries no tokenizer vocabulary")
+        model = info.get("model")
+        if model not in (None, "llama", "spm"):
+            raise ValueError(
+                f"GGUF tokenizer model {model!r} is not sentencepiece; "
+                "use the original HF tokenizer")
+        return cls(info["tokens"], info.get("bos_token_id"),
+                   info.get("eos_token_id"))
+
+    # -- encode -------------------------------------------------------------
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> List[int]:
+        norm = _SP_SPACE + text.replace(" ", _SP_SPACE)
+        ids: List[int] = []
+        i = 0
+        while i < len(norm):
+            match = None
+            for ln in range(min(self._max_len, len(norm) - i), 0, -1):
+                cand = self._index.get(norm[i:i + ln])
+                if cand is not None:
+                    match = (cand, ln)
+                    break
+            if match is not None:
+                ids.append(match[0])
+                i += match[1]
+            else:
+                # byte fallback; unk preserves position when bytes missing
+                emitted = False
+                for b in norm[i].encode("utf-8"):
+                    if b in self._byte_ids:
+                        ids.append(self._byte_ids[b])
+                        emitted = True
+                if not emitted and self.unk_token_id is not None:
+                    ids.append(self.unk_token_id)
+                i += 1
+        if add_special_tokens and self.add_bos:
+            ids = [self.bos_token_id] + ids
+        return ids
+
+    def __call__(self, text: str, add_special_tokens: bool = True) -> Dict:
+        return {"input_ids": self.encode(text, add_special_tokens)}
+
+    # -- decode -------------------------------------------------------------
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        out: List[bytes] = []
+        for i in ids:
+            i = int(i)
+            if not 0 <= i < len(self.tokens):
+                continue
+            if skip_special_tokens and i in (self.bos_token_id,
+                                             self.eos_token_id):
+                continue
+            t = self.tokens[i]
+            if len(t) == 6 and t.startswith("<0x") and t.endswith(">"):
+                try:
+                    out.append(bytes([int(t[3:5], 16)]))
+                    continue
+                except ValueError:
+                    pass
+            out.append(t.encode("utf-8"))
+        text = b"".join(out).decode("utf-8", errors="replace")
+        text = text.replace(_SP_SPACE, " ")
+        # drop exactly the ONE meta-space encode() prepends — lstrip would
+        # also eat genuine leading whitespace from the original text
+        return text[1:] if text.startswith(" ") else text

@@ -196,14 +196,19 @@ class _BaseAutoModelClass:
             # direct GGUF ingestion (reference gguf/api.py:31)
             from bigdl_tpu.gguf import load_gguf
 
-            params, hf_config, _tok = load_gguf(path)
+            params, hf_config, tok_info = load_gguf(path)
             archs = hf_config.get("architectures") or ["?"]
             family = get_family(archs[0])
             cfg = family.config_from_hf(hf_config)
-            return TpuCausalLM(params, cfg, family, hf_config,
-                               qtype="gguf", model_path=os.path.dirname(path),
-                               max_seq=max_seq or 2048,
-                               kv_quantized=quantize_kv_cache)
+            model = TpuCausalLM(params, cfg, family, hf_config,
+                                qtype="gguf",
+                                model_path=os.path.dirname(path),
+                                max_seq=max_seq or 2048,
+                                kv_quantized=quantize_kv_cache)
+            # vocab already parsed once; CLIs reconstruct a tokenizer from
+            # this instead of re-reading the file
+            model.gguf_tokenizer_info = tok_info
+            return model
         max_seq = max_seq or flags().default_max_seq
 
         qtype = _resolve_qtype(load_in_4bit, load_in_low_bit)

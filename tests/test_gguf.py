@@ -255,3 +255,67 @@ def test_q2k_golden_block():
     got = (dd[0] * np.repeat(scs[0] & 0xF, 16) * codes[0].astype(np.float32)
            - dm[0] * np.repeat(scs[0] >> 4, 16))
     np.testing.assert_allclose(got, expected, atol=1e-3)
+
+
+def test_gguf_tokenizer_roundtrip():
+    from bigdl_tpu.gguf_tokenizer import GGUFTokenizer
+
+    vocab = (["<unk>", "<s>", "</s>"]
+             + [f"<0x{b:02X}>" for b in range(256)]
+             + ["▁the", "▁cat", "▁sat", "▁on", "▁mat", "▁", "the",
+                "cat", "s", "at", "he", "t"])
+    tok = GGUFTokenizer(vocab, bos_token_id=1, eos_token_id=2)
+
+    text = "the cat sat on the mat"
+    ids = tok.encode(text)
+    assert ids[0] == 1                       # bos prepended
+    assert tok.decode(ids) == text           # exact roundtrip
+    # greedy matching picked the multi-char tokens
+    assert tok._index["▁the"] in ids and tok._index["▁cat"] in ids
+
+    # unknown unicode falls back to byte tokens and still roundtrips
+    text2 = "the ¢at"
+    assert tok.decode(tok.encode(text2)) == text2
+
+    # call protocol mirrors HF tokenizers
+    assert tok("the cat")["input_ids"] == tok.encode("the cat")
+
+
+def test_cli_uses_gguf_tokenizer(tmp_path, capsys, monkeypatch):
+    """CLI falls back to the GGUF-reconstructed tokenizer for .gguf files
+    without sibling HF tokenizer files."""
+    from bigdl_tpu.cli import chat as chat_cli
+    from bigdl_tpu.utils.testing import TINY_LLAMA
+
+    path = str(tmp_path / "tok.gguf")
+    _tiny_llama_gguf(path, TINY_LLAMA)
+    # tokens in the fixture are "t0".."t255"; "t1 t2" encodes via fallback
+    rc = chat_cli.main(["-m", path, "-p", "t1", "-n", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip()
+    assert out  # decoded text (tokens "tNN" concatenated)
+
+
+def test_gguf_tokenizer_edge_cases():
+    from bigdl_tpu.gguf_tokenizer import GGUFTokenizer
+
+    vocab = ["<unk>", "<s>", "</s>", "▁a", "a", "▁"]
+    tok = GGUFTokenizer(vocab, bos_token_id=1, eos_token_id=2)
+    # leading space preserved exactly (no lstrip over-strip)
+    assert tok.decode(tok.encode(" a")) == " a"
+    # OOV char with no byte tokens -> unk id, position preserved
+    ids = tok.encode("a¢a", add_special_tokens=False)
+    assert tok._index is not None and 0 in ids  # unk present
+    # BPE vocab rejected
+    import pytest as _p
+
+    with _p.raises(ValueError, match="not sentencepiece"):
+        GGUFTokenizer.from_tokenizer_info(
+            {"tokens": ["Ġthe"], "model": "gpt2"})
+    # malformed MCQ answers raise
+    from bigdl_tpu.bench.mcq_eval import _answer_index
+
+    with _p.raises(ValueError):
+        _answer_index("", 4)
+    with _p.raises(ValueError):
+        _answer_index("AB", 4)
