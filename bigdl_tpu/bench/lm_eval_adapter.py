@@ -8,6 +8,7 @@ installed.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -20,6 +21,28 @@ def _bucket(n: int, lo: int = 32) -> int:
     while b < n:
         b *= 2
     return b
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(fwd):
+    """One persistent jit wrapper per family forward — a fresh jax.jit per
+    call would retrace/recompile every request."""
+    return jax.jit(fwd, static_argnums=1)
+
+
+def context_logprobs(model: Any, context_ids) -> np.ndarray:
+    """log p(next token | context) over the vocab, from ONE forward.
+
+    Scores every single-token continuation of the same context at once
+    (the multiple-choice fast path: n choices for the price of one)."""
+    ids = np.asarray(context_ids, np.int32)
+    padded = np.zeros((_bucket(len(ids)),), np.int32)
+    padded[: len(ids)] = ids
+    logits = np.asarray(_jitted(model.family.forward_train)(
+        model.params, model.config, jnp.asarray(padded[None])))
+    row = logits[0, len(ids) - 1]
+    row = row - row.max()
+    return row - np.log(np.exp(row).sum())
 
 
 def sequence_loglikelihood(model: Any, context_ids, continuation_ids
@@ -35,7 +58,7 @@ def sequence_loglikelihood(model: Any, context_ids, continuation_ids
                           np.asarray(continuation_ids, np.int32)])
     padded = np.zeros((_bucket(len(ids)),), np.int32)
     padded[: len(ids)] = ids
-    logits = np.asarray(jax.jit(fwd, static_argnums=1)(
+    logits = np.asarray(_jitted(fwd)(
         params, cfg, jnp.asarray(padded[None])))[0][: len(ids)]
     ll = logits - logits.max(-1, keepdims=True)
     ll = ll - np.log(np.exp(ll).sum(-1, keepdims=True))

@@ -15,7 +15,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
-from bigdl_tpu.bench.lm_eval_adapter import sequence_loglikelihood
+from bigdl_tpu.bench.lm_eval_adapter import (context_logprobs,
+                                             sequence_loglikelihood)
 
 _LETTERS = "ABCDEFGH"
 
@@ -31,6 +32,10 @@ def _answer_index(ans, n_choices: int) -> int:
 
 
 def format_mcq(question: str, choices: Sequence[str]) -> str:
+    if len(choices) > len(_LETTERS):
+        raise ValueError(
+            f"record has {len(choices)} choices; at most {len(_LETTERS)} "
+            f"({_LETTERS[0]}-{_LETTERS[-1]}) are supported")
     lines = [question.strip()]
     for i, c in enumerate(choices):
         lines.append(f"{_LETTERS[i]}. {c}")
@@ -55,8 +60,8 @@ def evaluate_mcq(
         choices = rec["choices"]
         prompt = format_mcq(rec["question"], choices)
         ctx_ids = tokenizer(prompt)["input_ids"]
-        scores = []
-        for i, choice in enumerate(choices):
+        conts = []
+        for i in range(len(choices)):
             cont = tokenizer(f" {_LETTERS[i]}",
                              add_special_tokens=False)["input_ids"]
             if not cont:
@@ -64,8 +69,17 @@ def evaluate_mcq(
                     f"tokenizer produced no ids for option letter "
                     f"{_LETTERS[i]!r}; its vocabulary cannot score this "
                     "dataset")
-            ll, _ = sequence_loglikelihood(model, ctx_ids, cont)
-            scores.append(ll / (len(cont) if length_normalize else 1))
+            conts.append(cont)
+        if all(len(c) == 1 for c in conts):
+            # every option letter is a single token: score all of them
+            # from the softmax of ONE context forward
+            lp = context_logprobs(model, ctx_ids)
+            scores = [float(lp[c[0]]) for c in conts]
+        else:
+            scores = []
+            for cont in conts:
+                ll, _ = sequence_loglikelihood(model, ctx_ids, cont)
+                scores.append(ll / (len(cont) if length_normalize else 1))
         pred = int(np.argmax(scores))
         truth = _answer_index(rec["answer"], len(choices))
         correct += int(pred == truth)

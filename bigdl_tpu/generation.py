@@ -144,11 +144,12 @@ class Generator:
 
     def __init__(self, params: Dict[str, Any], cfg,
                  forward_fn=None, prefill_fn=None, max_seq: int = 2048,
-                 kv_quantized: bool = False):
+                 kv_quantized: bool = False, new_cache_fn=None):
         self.params = params
         self.cfg = cfg
         self.max_seq = max_seq
         self.kv_quantized = kv_quantized
+        self.new_cache = new_cache_fn or llama_mod.new_cache
         fwd = forward_fn or llama_mod.forward
         pre = prefill_fn or llama_mod.forward_last_token
 
@@ -186,15 +187,20 @@ class Generator:
                 f"prompt ({s}) + max_new_tokens ({gen.max_new_tokens}) "
                 f"exceeds max_seq {self.max_seq}")
 
-        bucket = self._bucket(s)
+        cache = self.new_cache(self.cfg, b, self.max_seq,
+                               self.kv_quantized)
+        if isinstance(cache, KVCache):
+            bucket = self._bucket(s)
+        else:
+            # recurrent families (RWKV): the state absorbs every token it
+            # sees, so pad tokens cannot be masked retroactively — prefill
+            # at the exact prompt length (one executable per length).
+            bucket = s
         # right-pad into the bucket: positions stay correct for RoPE, the
         # garbage keys the pad writes are overwritten/masked (see below)
         pad = bucket - s
         padded = np.zeros((b, bucket), np.int32)
         padded[:, :s] = ids
-
-        cache = llama_mod.new_cache(self.cfg, b, self.max_seq,
-                                    self.kv_quantized)
 
         key = jax.random.PRNGKey(gen.seed)
         t0 = time.perf_counter()

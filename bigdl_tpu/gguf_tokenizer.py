@@ -20,20 +20,41 @@ from typing import Dict, List, Optional
 _SP_SPACE = "▁"   # sentencepiece's meta-space
 
 
+# GGUF tokenizer.ggml.token_type values (llama.cpp llama_token_type)
+_TYPE_UNKNOWN, _TYPE_CONTROL, _TYPE_BYTE = 2, 3, 6
+
+
 class GGUFTokenizer:
     def __init__(self, tokens: List[str],
                  bos_token_id: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
-                 add_bos: bool = True):
+                 add_bos: bool = True,
+                 token_type: Optional[List[int]] = None):
         self.tokens = list(tokens)
         self.unk_token_id = (tokens.index("<unk>")
                              if "<unk>" in tokens else None)
         self.bos_token_id = bos_token_id
         self.eos_token_id = eos_token_id
         self.add_bos = add_bos and bos_token_id is not None
+        types = list(token_type) if token_type is not None else None
+
+        def is_plain(i: int, t: str) -> bool:
+            """Token eligible for greedy TEXT matching. Byte and control
+            tokens must not match their literal spelling in user text
+            (a prompt containing \"</s>\" or \"<0x41>\" means those
+            characters, not the special token)."""
+            if types is not None and i < len(types):
+                return types[i] not in (_TYPE_UNKNOWN, _TYPE_CONTROL,
+                                        _TYPE_BYTE)
+            # no type metadata: fall back on the spelling conventions
+            if len(t) == 6 and t.startswith("<0x") and t.endswith(">"):
+                return False
+            return t not in ("<s>", "</s>", "<unk>", "<pad>")
+
         self._index: Dict[str, int] = {}
         for i, t in enumerate(self.tokens):
-            self._index.setdefault(t, i)
+            if is_plain(i, t):
+                self._index.setdefault(t, i)
         self._byte_ids: Dict[int, int] = {}
         for i, t in enumerate(self.tokens):
             if len(t) == 6 and t.startswith("<0x") and t.endswith(">"):
@@ -56,7 +77,8 @@ class GGUFTokenizer:
                 f"GGUF tokenizer model {model!r} is not sentencepiece; "
                 "use the original HF tokenizer")
         return cls(info["tokens"], info.get("bos_token_id"),
-                   info.get("eos_token_id"))
+                   info.get("eos_token_id"),
+                   token_type=info.get("token_type"))
 
     # -- encode -------------------------------------------------------------
 
@@ -75,13 +97,13 @@ class GGUFTokenizer:
                 ids.append(match[0])
                 i += match[1]
             else:
-                # byte fallback; unk preserves position when bytes missing
-                emitted = False
-                for b in norm[i].encode("utf-8"):
-                    if b in self._byte_ids:
-                        ids.append(self._byte_ids[b])
-                        emitted = True
-                if not emitted and self.unk_token_id is not None:
+                # byte fallback — all-or-nothing per character: a partial
+                # byte emission would decode to mojibake, so any missing
+                # byte token downgrades the whole character to unk
+                bs = norm[i].encode("utf-8")
+                if all(b in self._byte_ids for b in bs):
+                    ids.extend(self._byte_ids[b] for b in bs)
+                elif self.unk_token_id is not None:
                     ids.append(self.unk_token_id)
                 i += 1
         if add_special_tokens and self.add_bos:

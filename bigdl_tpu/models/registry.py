@@ -95,5 +95,23 @@ def _register_builtin() -> None:
             new_cache=mixtral_mod.new_cache,
         ))
 
+    from bigdl_tpu.models import rwkv as rwkv_mod
+
+    def rwkv_adapter(version: int) -> FamilyAdapter:
+        return FamilyAdapter(
+            name=f"rwkv{version}",
+            config_from_hf=lambda hf: rwkv_mod.RwkvConfig.from_hf(
+                hf, version),
+            convert_params=rwkv_mod.convert_hf_params,
+            forward=rwkv_mod.forward,
+            prefill=rwkv_mod.forward_last_token,
+            forward_train=rwkv_mod.forward_train,
+            new_cache=rwkv_mod.new_cache,
+        )
+
+    register_family(["RwkvForCausalLM"], rwkv_adapter(4))
+    register_family(["Rwkv5ForCausalLM", "RwkvWorldForCausalLM"],
+                    rwkv_adapter(5))
+
 
 _register_builtin()

@@ -1,0 +1,410 @@
+"""RWKV v4/v5 family: recurrent (attention-free) language models.
+
+TPU-native re-design of the reference's RWKV support
+(reference transformers/models/rwkv4.py and rwkv5.py, whose hot loops call
+the native SYCL ops `rwkv_linear_attention_v4`, `rwkv_linear_attention_v5`
+and `rwkv_time_shift` — SURVEY.md §2.3-C). Here the same computation is
+expressed the XLA way:
+
+- All projections (key/value/receptance/gate/output, and the channel-mix
+  MLP) are hoisted OUT of the recurrence and run as big [B*T, D] x [D, N]
+  matmuls — quantizable QTensors on the MXU, exactly like the transformer
+  families.
+- Only the tiny elementwise state recurrence (the WKV scan) runs under
+  `lax.scan` over time; its carry is the recurrent state, so prefill and
+  decode are the same code at different T. Decode cost is O(state), with
+  no KV cache at all — RWKV's selling point survives intact.
+- State is a first-class pytree (`RwkvState`), donated between decode
+  steps like the transformer KV cache.
+
+v4 ("RwkvForCausalLM", HF transformers modeling_rwkv semantics): scalar
+channel state (aa, bb, pp) with the exp-max stabilization trick.
+v5.2 ("Rwkv5ForCausalLM", BlinkDL Eagle): per-head matrix state
+S[H, hd, hd], decayed by exp(-exp(w)) with bonus u (time_faaaa), grouped
+LayerNorm over heads, silu gate.
+
+Numerics: the recurrence and norms run in f32; projections run in the
+compute dtype (bf16 by default) so quantized weights hit the fused
+dequant-matmul path. The reference's fp16 `rescale_every` weight-halving
+exists only to dodge fp16 overflow and has no bf16/f32 analog here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.ops.embedding import embedding_lookup
+from bigdl_tpu.ops.matmul import linear
+from bigdl_tpu.ops.norms import layer_norm
+
+_NEG_INF = -1e38
+
+
+@dataclasses.dataclass(frozen=True)
+class RwkvConfig:
+    vocab_size: int = 50277
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    intermediate_size: int = 3072
+    attention_hidden_size: int = 768
+    layer_norm_eps: float = 1e-5
+    head_size: int = 64            # v5
+    version: int = 4               # 4 | 5
+    tie_word_embeddings: bool = False
+    # BlinkDL group_norm eps: 64e-5 (= 1e-5 * head_size_divisor**2, 8**2)
+    ln_x_eps: float = 64e-5
+
+    @property
+    def num_heads(self) -> int:
+        return self.attention_hidden_size // self.head_size
+
+    @classmethod
+    def from_hf(cls, hf: Dict[str, Any], version: int) -> "RwkvConfig":
+        d = hf["hidden_size"]
+        inter = hf.get("intermediate_size")
+        if inter is None:
+            # HF defaults: v4 = 4*D; v5 world = round(3.5*D) down to /32
+            inter = 4 * d if version == 4 else int(d * 3.5) // 32 * 32
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=d,
+            num_hidden_layers=hf["num_hidden_layers"],
+            intermediate_size=inter,
+            attention_hidden_size=hf.get("attention_hidden_size", d),
+            layer_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            head_size=hf.get("head_size", 64),
+            version=version,
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RwkvState:
+    """Recurrent state. v4: (aa, bb, pp) per channel; v5: matrix state s.
+
+    att_x / ffn_x are the previous token's normed activations (the
+    reference's `rwkv_time_shift` native op is this one-element history).
+    `max_seq` is nominal — RWKV state is O(1) in sequence length; it only
+    satisfies the generation API's capacity check.
+    """
+
+    att_x: jax.Array                 # [L, B, D]
+    ffn_x: jax.Array                 # [L, B, D]
+    aa: Optional[jax.Array]          # v4 [L, B, Da]
+    bb: Optional[jax.Array]          # v4 [L, B, Da]
+    pp: Optional[jax.Array]          # v4 [L, B, Da]
+    s: Optional[jax.Array]           # v5 [L, B, H, hd, hd]
+    pos: jax.Array                   # scalar int32
+    _max_seq: int = 1 << 30
+
+    def tree_flatten(self):
+        return ((self.att_x, self.ffn_x, self.aa, self.bb, self.pp,
+                 self.s, self.pos), (self._max_seq,))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, _max_seq=aux[0])
+
+    @property
+    def max_seq(self) -> int:
+        return self._max_seq
+
+
+def new_cache(cfg: RwkvConfig, batch: int, max_seq: int,
+              quantized: bool = False) -> RwkvState:
+    """Fresh zero state (the `new_cache` adapter hook; `quantized` is
+    accepted for interface parity — state is tiny, nothing to quantize)."""
+    L, B, D = cfg.num_hidden_layers, batch, cfg.hidden_size
+    Da = cfg.attention_hidden_size
+    zeros = lambda *shape: jnp.zeros(shape, jnp.float32)
+    if cfg.version == 4:
+        return RwkvState(
+            att_x=zeros(L, B, D), ffn_x=zeros(L, B, D),
+            aa=zeros(L, B, Da), bb=zeros(L, B, Da),
+            pp=jnp.full((L, B, Da), _NEG_INF, jnp.float32),
+            s=None, pos=jnp.zeros((), jnp.int32), _max_seq=max_seq)
+    H, hd = cfg.num_heads, cfg.head_size
+    return RwkvState(
+        att_x=zeros(L, B, D), ffn_x=zeros(L, B, D),
+        aa=None, bb=None, pp=None,
+        s=zeros(L, B, H, hd, hd),
+        pos=jnp.zeros((), jnp.int32), _max_seq=max_seq)
+
+
+def _token_shift(xn: jax.Array, prev_x: jax.Array) -> jax.Array:
+    """[B, T, D] -> previous-token view: [prev_x, xn[:, :-1]]."""
+    return jnp.concatenate([prev_x[:, None, :], xn[:, :-1, :]], axis=1)
+
+
+def _lerp(xn, prev, mix):
+    """RWKV time-mix interpolation x*mu + x_prev*(1-mu), f32."""
+    m = mix.astype(jnp.float32)
+    return xn * m + prev * (1.0 - m)
+
+
+def _wkv_v4(k, v, w, u, aa, bb, pp):
+    """v4 WKV recurrence with exp-max stabilization.
+
+    k, v: [B, T, Da] f32; w (= -exp(time_decay)), u: [Da];
+    state aa/bb/pp: [B, Da]. Returns (out [B, T, Da], new state).
+    """
+    kT = k.transpose(1, 0, 2)
+    vT = v.transpose(1, 0, 2)
+
+    def step(carry, kv):
+        aa, bb, pp = carry
+        kt, vt = kv
+        ww = u + kt
+        qq = jnp.maximum(pp, ww)
+        e1 = jnp.exp(pp - qq)
+        e2 = jnp.exp(ww - qq)
+        out = (e1 * aa + e2 * vt) / (e1 * bb + e2)
+        ww = pp + w
+        qq = jnp.maximum(ww, kt)
+        e1 = jnp.exp(ww - qq)
+        e2 = jnp.exp(kt - qq)
+        return (e1 * aa + e2 * vt, e1 * bb + e2, qq), out
+
+    (aa, bb, pp), outT = lax.scan(step, (aa, bb, pp), (kT, vT))
+    return outT.transpose(1, 0, 2), (aa, bb, pp)
+
+
+def _wkv_v5(r, k, v, w, u, s):
+    """v5 matrix-state recurrence.
+
+    r, k, v: [B, T, H, hd] f32; w (= exp(-exp(time_decay))), u: [H, hd];
+    s: [B, H, hd, hd] (k-index first). Returns (out [B, T, H, hd], s).
+    """
+    rT = r.transpose(1, 0, 2, 3)
+    kT = k.transpose(1, 0, 2, 3)
+    vT = v.transpose(1, 0, 2, 3)
+
+    def step(s, rkv):
+        rt, kt, vt = rkv
+        at = jnp.einsum("bhi,bhj->bhij", kt, vt)
+        yt = jnp.einsum("bhi,bhij->bhj", rt,
+                        u[None, :, :, None] * at + s)
+        s = at + w[None, :, :, None] * s
+        return s, yt
+
+    s, yT = lax.scan(step, s, (rT, kT, vT))
+    return yT.transpose(1, 0, 2, 3), s
+
+
+def _group_norm(x, weight, bias, num_groups: int, eps: float):
+    """GroupNorm over the channel dim of [B, T, D] (v5 ln_x)."""
+    b, t, d = x.shape
+    xg = x.reshape(b, t, num_groups, d // num_groups).astype(jnp.float32)
+    mu = jnp.mean(xg, axis=-1, keepdims=True)
+    var = jnp.var(xg, axis=-1, keepdims=True)
+    y = ((xg - mu) * lax.rsqrt(var + eps)).reshape(b, t, d)
+    return y * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+
+
+def _time_mix(x, lp, cfg: RwkvConfig, st, compute_dtype):
+    """Attention-analog block. x [B,T,D] f32. Returns (out, new state)."""
+    xn = layer_norm(x, lp["ln1"], lp["ln1_bias"], cfg.layer_norm_eps)
+    prev = _token_shift(xn, st["att_x"])
+    new_att_x = xn[:, -1, :]
+
+    proj = lambda y, wkey, bkey=None: linear(
+        y.astype(compute_dtype), lp[wkey]).astype(jnp.float32)
+
+    k = proj(_lerp(xn, prev, lp["att_mix_k"]), "att_key")
+    v = proj(_lerp(xn, prev, lp["att_mix_v"]), "att_value")
+    r = proj(_lerp(xn, prev, lp["att_mix_r"]), "att_receptance")
+
+    if cfg.version == 4:
+        w = -jnp.exp(lp["att_decay"].astype(jnp.float32))
+        u = lp["att_first"].astype(jnp.float32)
+        wkv, (aa, bb, pp) = _wkv_v4(k, v, w, u, st["aa"], st["bb"], st["pp"])
+        out = jax.nn.sigmoid(r) * wkv
+        out = linear(out.astype(compute_dtype), lp["att_output"])
+        return out.astype(jnp.float32), dict(
+            att_x=new_att_x, aa=aa, bb=bb, pp=pp)
+
+    b, t, _ = x.shape
+    H, hd = cfg.num_heads, cfg.head_size
+    g = proj(_lerp(xn, prev, lp["att_mix_g"]), "att_gate")
+    w = jnp.exp(-jnp.exp(lp["att_decay"].astype(jnp.float32))).reshape(H, hd)
+    u = lp["att_first"].astype(jnp.float32).reshape(H, hd)
+    y, s = _wkv_v5(r.reshape(b, t, H, hd), k.reshape(b, t, H, hd),
+                   v.reshape(b, t, H, hd), w, u, st["s"])
+    y = _group_norm(y.reshape(b, t, H * hd), lp["ln_x"], lp["ln_x_bias"],
+                    H, cfg.ln_x_eps)
+    y = y * jax.nn.silu(g)
+    out = linear(y.astype(compute_dtype), lp["att_output"])
+    return out.astype(jnp.float32), dict(att_x=new_att_x, s=s)
+
+
+def _channel_mix(x, lp, cfg: RwkvConfig, prev_ffn_x, compute_dtype):
+    """MLP-analog block: r ⊙ Wv(relu(Wk(x̃))²). Returns (out, new ffn_x)."""
+    xn = layer_norm(x, lp["ln2"], lp["ln2_bias"], cfg.layer_norm_eps)
+    prev = _token_shift(xn, prev_ffn_x)
+    proj = lambda y, wkey: linear(
+        y.astype(compute_dtype), lp[wkey]).astype(jnp.float32)
+    k = proj(_lerp(xn, prev, lp["ffn_mix_k"]), "ffn_key")
+    r = proj(_lerp(xn, prev, lp["ffn_mix_r"]), "ffn_receptance")
+    inner = jnp.square(jax.nn.relu(k))
+    out = jax.nn.sigmoid(r) * proj(inner, "ffn_value")
+    return out, xn[:, -1, :]
+
+
+def forward(
+    params: Dict[str, Any],
+    cfg: RwkvConfig,
+    tokens: jax.Array,        # [B, T] int32
+    state: RwkvState,
+    compute_dtype=jnp.bfloat16,
+    last_only: bool = False,
+) -> Tuple[jax.Array, RwkvState]:
+    """Run T tokens through the recurrence; returns (logits f32, state).
+
+    Prefill and decode are the same function (T = prompt length vs 1);
+    the state carry replaces the transformer KV cache.
+    """
+    x = embedding_lookup(params["embed_tokens"], tokens, jnp.float32)
+    x = layer_norm(x, params["pre_ln"], params["pre_ln_bias"],
+                   cfg.layer_norm_eps)
+
+    if cfg.version == 4:
+        st_slices = dict(att_x=state.att_x, ffn_x=state.ffn_x,
+                         aa=state.aa, bb=state.bb, pp=state.pp)
+    else:
+        st_slices = dict(att_x=state.att_x, ffn_x=state.ffn_x, s=state.s)
+
+    def step(x, xs):
+        lp, st = xs
+        att, new_att = _time_mix(x, lp, cfg, st, compute_dtype)
+        x = x + att
+        ffn, new_ffn_x = _channel_mix(x, lp, cfg, st["ffn_x"], compute_dtype)
+        x = x + ffn
+        new_att["ffn_x"] = new_ffn_x
+        return x, new_att
+
+    x, new_st = lax.scan(step, x, (params["layers"], st_slices))
+
+    if last_only:
+        x = x[:, -1:, :]
+    x = layer_norm(x, params["norm"], params["norm_bias"],
+                   cfg.layer_norm_eps)
+    logits = linear(x.astype(compute_dtype), params["lm_head"])
+    logits = logits.astype(jnp.float32)
+
+    if cfg.version == 4:
+        out_state = RwkvState(
+            att_x=new_st["att_x"], ffn_x=new_st["ffn_x"], aa=new_st["aa"],
+            bb=new_st["bb"], pp=new_st["pp"], s=None,
+            pos=state.pos + tokens.shape[1], _max_seq=state._max_seq)
+    else:
+        out_state = RwkvState(
+            att_x=new_st["att_x"], ffn_x=new_st["ffn_x"],
+            aa=None, bb=None, pp=None, s=new_st["s"],
+            pos=state.pos + tokens.shape[1], _max_seq=state._max_seq)
+    return logits, out_state
+
+
+def forward_last_token(params, cfg, tokens, state, compute_dtype=jnp.bfloat16):
+    return forward(params, cfg, tokens, state, compute_dtype=compute_dtype,
+                   last_only=True)
+
+
+def forward_train(params, cfg, tokens, compute_dtype=jnp.bfloat16,
+                  attn_fn=None, pos_offset=0):
+    """Cacheless training forward (fresh zero state). Sequence-parallel
+    attn_fn does not apply to a recurrence; train long contexts with
+    BPTT-style chunking instead."""
+    if attn_fn is not None:
+        raise NotImplementedError(
+            "RWKV is recurrent; ring-attention sequence parallelism does "
+            "not apply (chunk the sequence and carry state instead)")
+    b = tokens.shape[0]
+    logits, _ = forward(params, cfg, tokens,
+                        new_cache(cfg, b, int(tokens.shape[1])),
+                        compute_dtype=compute_dtype)
+    return logits
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint conversion (reference analog: convert.py routes rwkv
+# architectures to models/rwkv4.py / rwkv5.py forwards)
+# ---------------------------------------------------------------------------
+
+_ATT_LINEARS = {
+    "attention.key.weight": "att_key",
+    "attention.value.weight": "att_value",
+    "attention.receptance.weight": "att_receptance",
+    "attention.gate.weight": "att_gate",
+    "attention.output.weight": "att_output",
+    "feed_forward.key.weight": "ffn_key",
+    "feed_forward.receptance.weight": "ffn_receptance",
+    "feed_forward.value.weight": "ffn_value",
+}
+
+_MIX_PARAMS = {
+    "attention.time_mix_key": "att_mix_k",
+    "attention.time_mix_value": "att_mix_v",
+    "attention.time_mix_receptance": "att_mix_r",
+    "attention.time_mix_gate": "att_mix_g",
+    # v6-style names map to the same slots when encountered
+    "attention.time_decay": "att_decay",
+    "attention.time_first": "att_first",
+    "attention.time_faaaa": "att_first",
+    "feed_forward.time_mix_key": "ffn_mix_k",
+    "feed_forward.time_mix_receptance": "ffn_mix_r",
+}
+
+_NORMS = {
+    "ln1.weight": "ln1", "ln1.bias": "ln1_bias",
+    "ln2.weight": "ln2", "ln2.bias": "ln2_bias",
+    "attention.ln_x.weight": "ln_x", "attention.ln_x.bias": "ln_x_bias",
+}
+
+
+def _rwkv_map(acc, name: str, w) -> None:
+    from bigdl_tpu.models.convert_base import layer_idx
+
+    name_ = name[len("rwkv."):] if name.startswith("rwkv.") else name
+    f32 = lambda a: jnp.asarray(np.asarray(a), jnp.float32)
+    if name_ == "embeddings.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name_ == "blocks.0.pre_ln.weight":
+        acc.top["pre_ln"] = f32(w)
+    elif name_ == "blocks.0.pre_ln.bias":
+        acc.top["pre_ln_bias"] = f32(w)
+    elif name_ == "ln_out.weight":
+        acc.top["norm"] = f32(w)
+    elif name_ == "ln_out.bias":
+        acc.top["norm_bias"] = f32(w)
+    elif name_ == "head.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    else:
+        hit = layer_idx(name_, "blocks.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub in _ATT_LINEARS:
+            acc.put(_ATT_LINEARS[sub], idx, acc.linear(name, w))
+        elif sub in _MIX_PARAMS:
+            # recurrence parameters stay f32: decay enters a double exp,
+            # where bf16 rounding visibly shifts the state trajectory
+            acc.put(_MIX_PARAMS[sub], idx, f32(w).reshape(-1))
+        elif sub in _NORMS:
+            acc.put(_NORMS[sub], idx, f32(w))
+
+
+def convert_hf_params(tensors, cfg: RwkvConfig, qtype="sym_int4",
+                      compute_dtype=jnp.bfloat16,
+                      modules_to_not_convert: Tuple[str, ...] = ()):
+    from bigdl_tpu.models.convert_base import make_convert
+
+    return make_convert(_rwkv_map)(
+        tensors, cfg, qtype=qtype, compute_dtype=compute_dtype,
+        modules_to_not_convert=modules_to_not_convert)

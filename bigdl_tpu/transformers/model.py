@@ -65,6 +65,7 @@ class TpuCausalLM:
                 prefill_fn=self.family.prefill,
                 max_seq=self.max_seq,
                 kv_quantized=self.kv_quantized,
+                new_cache_fn=self.family.new_cache,
             )
         return self._generator
 
@@ -251,6 +252,11 @@ class _BaseAutoModelClass:
         if speculative:
             # self-speculation: same checkpoint as a sym_int4 draft
             # (reference model.py:323-331)
+            if family.name.startswith("rwkv"):
+                raise ValueError(
+                    "speculative=True is not supported for recurrent "
+                    "(RWKV) families: verification rollback rewinds a KV "
+                    "cache, and recurrent state cannot be rewound")
             if cvt_qtype == "sym_int4":
                 model.draft_params = params      # already low-bit: share
             else:
