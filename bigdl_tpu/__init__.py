@@ -40,4 +40,8 @@ def __getattr__(name):
         from bigdl_tpu.speculative import speculative_generate
 
         return speculative_generate
+    if name in ("collect_imatrix", "load_imatrix", "save_imatrix"):
+        from bigdl_tpu import imatrix
+
+        return getattr(imatrix, name)
     raise AttributeError(f"module 'bigdl_tpu' has no attribute {name!r}")

@@ -144,12 +144,14 @@ class Generator:
 
     def __init__(self, params: Dict[str, Any], cfg,
                  forward_fn=None, prefill_fn=None, max_seq: int = 2048,
-                 kv_quantized: bool = False, new_cache_fn=None):
+                 kv_quantized: bool = False, new_cache_fn=None,
+                 recurrent: Optional[bool] = None):
         self.params = params
         self.cfg = cfg
         self.max_seq = max_seq
         self.kv_quantized = kv_quantized
         self.new_cache = new_cache_fn or llama_mod.new_cache
+        self.recurrent = recurrent      # None: sniff from the cache type
         fwd = forward_fn or llama_mod.forward
         pre = prefill_fn or llama_mod.forward_last_token
 
@@ -189,13 +191,15 @@ class Generator:
 
         cache = self.new_cache(self.cfg, b, self.max_seq,
                                self.kv_quantized)
-        if isinstance(cache, KVCache):
-            bucket = self._bucket(s)
-        else:
+        recurrent = (not isinstance(cache, KVCache)
+                     if self.recurrent is None else self.recurrent)
+        if recurrent:
             # recurrent families (RWKV): the state absorbs every token it
             # sees, so pad tokens cannot be masked retroactively — prefill
             # at the exact prompt length (one executable per length).
             bucket = s
+        else:
+            bucket = self._bucket(s)
         # right-pad into the bucket: positions stay correct for RoPE, the
         # garbage keys the pad writes are overwritten/masked (see below)
         pad = bucket - s

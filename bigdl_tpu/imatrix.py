@@ -1,0 +1,248 @@
+"""Importance-matrix (imatrix) support: collect, load/save, apply.
+
+The reference loads llama.cpp imatrix files and threads per-channel
+importance weights into native quantization for the ultra-low-bit formats
+(`load_imatrix` + per-layer mixed-qtype policy, reference
+transformers/utils.py:187-323; `ggml_quantize_tensor_with_weights`,
+ggml/model/llama/llama_cpp.py:946-989; `imatrix=` kwarg of
+from_pretrained, transformers/model.py:104).
+
+This module provides all three legs, TPU-native:
+
+- `load_imatrix` / `save_imatrix`: the llama.cpp binary imatrix format
+  (entries of name / ncall / float32 sums), with llama.cpp tensor names
+  ("blk.N.attn_q.weight") translated to HF names so conversion can look
+  weights up by the checkpoint tensor name.
+- `collect_imatrix`: computes the imatrix directly on OUR model — a
+  layer-by-layer replay of the generalized decoder that accumulates the
+  mean squared activation entering every linear (the same statistic
+  llama.cpp's imatrix tool collects). No hooks: the functional model is
+  re-run with its internals exposed.
+- `low_bit_policy`: the per-layer mixed-qtype policy for ultra-low-bit
+  quantization (the reference bumps sensitive tensors to higher-bit
+  formats when quantizing to IQ2/Q2_K).
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+from typing import Any, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# qtypes low enough that sensitive tensors get bumped (reference
+# transformers/utils.py: IQ2/Q2_K loads rewrite embedding/lm_head/
+# attn_v qtypes)
+ULTRA_LOW_QTYPES = ("iq2_xxs", "gguf_iq2_xxs", "iq1_s", "gguf_iq1_s",
+                    "q2_k")
+
+
+# -- llama.cpp name translation ---------------------------------------------
+
+_LCPP_LAYER = {
+    "attn_q": "self_attn.q_proj",
+    "attn_k": "self_attn.k_proj",
+    "attn_v": "self_attn.v_proj",
+    "attn_output": "self_attn.o_proj",
+    "ffn_gate": "mlp.gate_proj",
+    "ffn_up": "mlp.up_proj",
+    "ffn_down": "mlp.down_proj",
+}
+
+
+def lcpp_to_hf_name(name: str) -> Optional[str]:
+    """"blk.3.attn_q.weight" -> "model.layers.3.self_attn.q_proj.weight"."""
+    if name == "token_embd.weight":
+        return "model.embed_tokens.weight"
+    if name == "output.weight":
+        return "lm_head.weight"
+    m = re.match(r"blk\.(\d+)\.(\w+)\.weight$", name)
+    if m and m.group(2) in _LCPP_LAYER:
+        return f"model.layers.{m.group(1)}.{_LCPP_LAYER[m.group(2)]}.weight"
+    return None
+
+
+# -- llama.cpp imatrix file format ------------------------------------------
+
+
+def load_imatrix(path: str) -> Dict[str, np.ndarray]:
+    """Parse a llama.cpp imatrix file -> {hf_tensor_name: importance[K]}.
+
+    Stored values are per-channel sums of squared activations over ncall
+    evaluations; they are normalized by ncall here. Names that cannot be
+    translated keep their llama.cpp spelling (callers match by name)."""
+    out: Dict[str, np.ndarray] = {}
+    with open(path, "rb") as f:
+        (n_entries,) = struct.unpack("<i", f.read(4))
+        for _ in range(n_entries):
+            (ln,) = struct.unpack("<i", f.read(4))
+            name = f.read(ln).decode("utf-8")
+            ncall, nval = struct.unpack("<ii", f.read(8))
+            vals = np.frombuffer(f.read(4 * nval), dtype="<f4").copy()
+            if ncall > 0:
+                vals /= ncall
+            out[lcpp_to_hf_name(name) or name] = vals
+    return out
+
+
+def save_imatrix(imatrix: Dict[str, np.ndarray], path: str,
+                 ncall: int = 1) -> None:
+    """Write {name: importance[K]} in the llama.cpp imatrix layout (names
+    are stored as given; HF names round-trip through load_imatrix)."""
+    with open(path, "wb") as f:
+        f.write(struct.pack("<i", len(imatrix)))
+        for name, vals in imatrix.items():
+            raw = name.encode("utf-8")
+            v = np.asarray(vals, np.float32) * max(ncall, 1)
+            f.write(struct.pack("<i", len(raw)))
+            f.write(raw)
+            f.write(struct.pack("<ii", ncall, v.size))
+            f.write(v.astype("<f4").tobytes())
+
+
+# -- collection on our model -------------------------------------------------
+
+
+def collect_imatrix(params: Dict[str, Any], cfg, tokens,
+                    compute_dtype=jnp.bfloat16) -> Dict[str, np.ndarray]:
+    """Run calibration tokens through the generalized decoder, recording
+    E[x^2] per input channel of every linear. Returns HF-named vectors
+    usable as `quantize_linear(..., qw=...)` / `from_pretrained(imatrix=)`.
+
+    Works for any family served by models/llama.py (the scan decoder);
+    layer params are unstacked and replayed one layer at a time so the
+    intermediate activations are observable.
+    """
+    from bigdl_tpu.models import llama as M
+
+    tokens = jnp.asarray(np.asarray(tokens, np.int32))
+    if tokens.ndim == 1:
+        tokens = tokens[None]
+    b, s = tokens.shape
+
+    from bigdl_tpu.ops.embedding import embedding_lookup
+
+    x = embedding_lookup(params["embed_tokens"], tokens, compute_dtype)
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
+    if cfg.embed_norm:
+        x = M._norm(x, params["embed_norm"], params.get("embed_norm_bias"),
+                    cfg)
+
+    inv_freq, rope_mscale = M.model_rope_freqs(cfg)
+    positions = jnp.arange(s, dtype=jnp.int32)
+    from bigdl_tpu.ops.rope import rope_cos_sin
+
+    cos, sin = rope_cos_sin(positions[None, :], inv_freq)
+    if rope_mscale != 1.0:
+        cos, sin = cos * rope_mscale, sin * rope_mscale
+    slopes = (jnp.asarray(M.alibi_slopes(cfg.num_attention_heads))
+              if cfg.use_alibi else None)
+
+    stats: Dict[str, np.ndarray] = {}
+
+    def record(name: str, act: jax.Array):
+        v = np.asarray(jnp.mean(
+            jnp.square(act.astype(jnp.float32)), axis=tuple(
+                range(act.ndim - 1))))
+        stats[name] = stats.get(name, 0.0) + v
+
+    # token_embd importance = token frequency (what llama.cpp records);
+    # kept for file parity — our embedding quantizer blocks along D, so
+    # conversion only applies qw vectors whose length matches K
+    stats["model.embed_tokens.weight"] = np.bincount(
+        np.asarray(tokens).ravel(), minlength=cfg.vocab_size
+    ).astype(np.float32) / tokens.size
+
+    L = cfg.num_hidden_layers
+    from bigdl_tpu.ops.attention import sdp_attention
+    from bigdl_tpu.ops.matmul import linear
+    from bigdl_tpu.ops.rope import apply_rope
+
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    for i in range(L):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        pre = f"model.layers.{i}."
+        hidden = M._norm(x, lp["input_layernorm"],
+                         lp.get("input_layernorm_bias"), cfg)
+        record(pre + "self_attn.q_proj.weight", hidden)
+        record(pre + "self_attn.k_proj.weight", hidden)
+        record(pre + "self_attn.v_proj.weight", hidden)
+        q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias")).reshape(
+            b, s, h, hd)
+        k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias")).reshape(
+            b, s, hkv, hd)
+        v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias")).reshape(
+            b, s, hkv, hd)
+        if cfg.use_rope:
+            q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
+            k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
+        scale = (cfg.query_pre_attn_scalar ** -0.5
+                 if cfg.query_pre_attn_scalar is not None else None)
+        attn = sdp_attention(q, k, v, jnp.zeros((), jnp.int32), scale=scale,
+                             sliding_window=cfg.sliding_window,
+                             logits_soft_cap=cfg.attn_soft_cap,
+                             alibi_slopes=slopes).reshape(b, s, h * hd)
+        record(pre + "self_attn.o_proj.weight", attn)
+        attn_out = linear(attn, lp["o_proj"], lp.get("o_proj_bias"))
+
+        if cfg.parallel_residual:
+            mlp_in = hidden if cfg.shared_input_norm else M._norm(
+                x, lp["post_attention_layernorm"],
+                lp.get("post_attention_layernorm_bias"), cfg)
+            record(pre + "mlp.gate_proj.weight", mlp_in)
+            record(pre + "mlp.up_proj.weight", mlp_in)
+            inner = _mlp_inner(mlp_in, lp, cfg)
+            record(pre + "mlp.down_proj.weight", inner)
+            x = x + attn_out + linear(inner, lp["down_proj"],
+                                      lp.get("down_proj_bias"))
+        else:
+            x = x + attn_out
+            mlp_in = M._norm(x, lp["post_attention_layernorm"],
+                             lp.get("post_attention_layernorm_bias"), cfg)
+            record(pre + "mlp.gate_proj.weight", mlp_in)
+            record(pre + "mlp.up_proj.weight", mlp_in)
+            inner = _mlp_inner(mlp_in, lp, cfg)
+            record(pre + "mlp.down_proj.weight", inner)
+            x = x + linear(inner, lp["down_proj"], lp.get("down_proj_bias"))
+
+    x = M._norm(x, params["norm"], params.get("norm_bias"), cfg)
+    record("lm_head.weight", x)
+    return stats
+
+
+def _mlp_inner(hidden, lp, cfg):
+    """The activation entering down_proj (gate/up already applied)."""
+    from bigdl_tpu.models.llama import _ACTS
+    from bigdl_tpu.ops.matmul import linear
+
+    act = _ACTS[cfg.hidden_act]
+    if cfg.mlp_gated:
+        gate = linear(hidden, lp["gate_proj"], lp.get("gate_proj_bias"))
+        up = linear(hidden, lp["up_proj"], lp.get("up_proj_bias"))
+        return act(gate) * up
+    return act(linear(hidden, lp["up_proj"], lp.get("up_proj_bias")))
+
+
+# -- mixed-qtype policy ------------------------------------------------------
+
+
+def low_bit_policy(base_qtype: str, hf_name: str) -> str:
+    """Per-tensor qtype under an ultra-low-bit load.
+
+    Mirrors the reference's (and llama.cpp's) practice of protecting the
+    most sensitive tensors when the bulk of the model drops below ~2.5
+    bpw (reference transformers/utils.py:187-323): the output head keeps
+    8 bits, attention V and FFN down keep 4 bits.
+    """
+    if base_qtype not in ULTRA_LOW_QTYPES:
+        return base_qtype
+    if hf_name.endswith(("lm_head.weight", "output.weight", "head.weight")):
+        return "sym_int8"
+    if (".v_proj." in hf_name or ".down_proj." in hf_name
+            or ".w2." in hf_name):     # .w2 = mixtral expert down_proj
+        return "sym_int4"
+    return base_qtype

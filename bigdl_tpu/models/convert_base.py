@@ -18,7 +18,8 @@ import numpy as np
 class Acc:
     """Accumulates per-layer leaves and stacks them along L."""
 
-    def __init__(self, cfg, qtype, compute_dtype, modules_to_not_convert):
+    def __init__(self, cfg, qtype, compute_dtype, modules_to_not_convert,
+                 imatrix: Optional[Dict[str, np.ndarray]] = None):
         from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize_linear
 
         self.cfg = cfg
@@ -27,6 +28,7 @@ class Acc:
         self.do_quant = qtype is not None and qtype not in FLOAT_QTYPES
         self.qtype = qtype
         self.skip = modules_to_not_convert
+        self.imatrix = imatrix
         self._quantize_linear = quantize_linear
         self.layers: Dict[str, list] = {}
         self.top: Dict[str, Any] = {}
@@ -37,24 +39,36 @@ class Acc:
         Quantization prefers the native C++ kernels (bigdl_tpu.native, the
         quantize-llama-binary equivalent) — bit-identical to the JAX path,
         which remains the fallback. Already-quantized leaves (GPTQ/AWQ
-        repack, transformers/gptq_awq.py) pass through unchanged."""
+        repack, transformers/gptq_awq.py) pass through unchanged. With an
+        imatrix, quantization is importance-weighted and ultra-low-bit
+        loads apply the per-tensor protection policy
+        (bigdl_tpu.imatrix.low_bit_policy) — the reference's
+        quantize-with-weights path (transformers/utils.py:187-323)."""
         from bigdl_tpu.ops.quant import QTensor as _QT
 
         if isinstance(w, _QT):
             return w
         if self.do_quant and not any(m in name for m in self.skip):
+            from bigdl_tpu.imatrix import low_bit_policy
             from bigdl_tpu.native import quantize_native
             from bigdl_tpu.ops.quant import QTensor
 
-            wt = np.ascontiguousarray(np.asarray(w).T, np.float32)
-            native = quantize_native(wt, self.qtype)
-            if native is not None:
-                data, scale = native
-                return QTensor(jnp.asarray(data),
-                               jnp.asarray(scale).astype(jnp.bfloat16),
-                               None, self.qtype, wt.shape)
+            qtype = low_bit_policy(self.qtype, name)
+            qw = None
+            if self.imatrix is not None:
+                qw = self.imatrix.get(name)
+                if qw is not None and len(qw) != np.asarray(w).shape[1]:
+                    qw = None     # wrong orientation (e.g. embedding row)
+            if qw is None:
+                wt = np.ascontiguousarray(np.asarray(w).T, np.float32)
+                native = quantize_native(wt, qtype)
+                if native is not None:
+                    data, scale = native
+                    return QTensor(jnp.asarray(data),
+                                   jnp.asarray(scale).astype(jnp.bfloat16),
+                                   None, qtype, wt.shape)
             return self._quantize_linear(jnp.asarray(np.asarray(w)),
-                                         self.qtype)
+                                         qtype, qw=qw)
         return jnp.asarray(np.asarray(w)).T.astype(self.compute_dtype)
 
     def dense(self, w) -> jax.Array:
@@ -88,10 +102,12 @@ def make_convert(map_tensor: Callable) -> Callable:
     acc.top). Unknown tensors are ignored (rotary inv_freq etc.)."""
 
     def convert(tensors, cfg, qtype="sym_int4", compute_dtype=jnp.bfloat16,
-                modules_to_not_convert: Tuple[str, ...] = ()):
+                modules_to_not_convert: Tuple[str, ...] = (),
+                imatrix: Optional[Dict[str, np.ndarray]] = None):
         from bigdl_tpu.ops.quant import QTensor
 
-        acc = Acc(cfg, qtype, compute_dtype, modules_to_not_convert)
+        acc = Acc(cfg, qtype, compute_dtype, modules_to_not_convert,
+                  imatrix=imatrix)
         for name, w in tensors:
             map_tensor(acc, name,
                        w if isinstance(w, QTensor) else np.asarray(w))

@@ -516,6 +516,7 @@ def convert_hf_params(
     qtype: Optional[str] = "sym_int4",
     compute_dtype=jnp.bfloat16,
     modules_to_not_convert: Tuple[str, ...] = (),
+    imatrix=None,                 # {hf_name: importance[K]} (bigdl_tpu.imatrix)
 ) -> Dict[str, Any]:
     """Build the parameter pytree from HF-named tensors, quantizing linears.
 
@@ -530,4 +531,4 @@ def convert_hf_params(
 
     return make_convert(_llama_map)(
         tensors, cfg, qtype=qtype, compute_dtype=compute_dtype,
-        modules_to_not_convert=modules_to_not_convert)
+        modules_to_not_convert=modules_to_not_convert, imatrix=imatrix)

@@ -208,14 +208,20 @@ def convert_hf_params(
     qtype: Optional[str] = "sym_int4",
     compute_dtype=jnp.bfloat16,
     modules_to_not_convert: Tuple[str, ...] = (),
+    imatrix=None,     # {hf_name: importance[K]} (bigdl_tpu.imatrix)
 ) -> Dict[str, Any]:
     """HF MixtralForCausalLM tensors -> stacked [L, E, ...] pytree.
 
     HF names: model.layers.N.block_sparse_moe.gate.weight [E, D];
     experts.M.{w1,w3} [F, D] (gate/up), w2 [D, F] (down). The router stays
     dense (the reference also leaves the tiny gate unquantized in practice
-    via modules_to_not_convert).
+    via modules_to_not_convert). Like the Acc-based families, an imatrix
+    weights the quantization and ultra-low-bit loads apply the per-tensor
+    protection policy (bigdl_tpu.imatrix.low_bit_policy) — MoE is the
+    main consumer of those formats (the reference's "Mixtral on 16 GB"
+    IQ2 claim, README.md:16).
     """
+    from bigdl_tpu.imatrix import low_bit_policy
     from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize_linear
 
     L, E = cfg.num_hidden_layers, cfg.num_local_experts
@@ -224,7 +230,10 @@ def convert_hf_params(
     def cvt_linear(name, w):
         w = jnp.asarray(np.asarray(w))
         if do_quant and not any(m in name for m in modules_to_not_convert):
-            return quantize_linear(w, qtype)
+            qw = None if imatrix is None else imatrix.get(name)
+            if qw is not None and len(qw) != w.shape[1]:
+                qw = None
+            return quantize_linear(w, low_bit_policy(qtype, name), qw=qw)
         return w.T.astype(compute_dtype)
 
     attn_keys = {"self_attn.q_proj": "q_proj", "self_attn.k_proj": "k_proj",

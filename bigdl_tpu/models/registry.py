@@ -23,6 +23,10 @@ class FamilyAdapter:
     prefill: Callable                       # last-token-only variant
     forward_train: Optional[Callable]
     new_cache: Callable                     # (cfg, batch, max_seq, quantized)
+    # Recurrent families (RWKV/mamba-style): the "cache" is absorbed state,
+    # not a KV cache. Gates (a) speculative decoding (no rollback) and
+    # (b) prompt padding in the Generator (state cannot mask pads).
+    is_recurrent: bool = False
 
 
 _REGISTRY: Dict[str, FamilyAdapter] = {}
@@ -107,6 +111,7 @@ def _register_builtin() -> None:
             prefill=rwkv_mod.forward_last_token,
             forward_train=rwkv_mod.forward_train,
             new_cache=rwkv_mod.new_cache,
+            is_recurrent=True,
         )
 
     register_family(["RwkvForCausalLM"], rwkv_adapter(4))

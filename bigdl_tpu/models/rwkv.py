@@ -402,9 +402,10 @@ def _rwkv_map(acc, name: str, w) -> None:
 
 def convert_hf_params(tensors, cfg: RwkvConfig, qtype="sym_int4",
                       compute_dtype=jnp.bfloat16,
-                      modules_to_not_convert: Tuple[str, ...] = ()):
+                      modules_to_not_convert: Tuple[str, ...] = (),
+                      imatrix=None):
     from bigdl_tpu.models.convert_base import make_convert
 
     return make_convert(_rwkv_map)(
         tensors, cfg, qtype=qtype, compute_dtype=compute_dtype,
-        modules_to_not_convert=modules_to_not_convert)
+        modules_to_not_convert=modules_to_not_convert, imatrix=imatrix)

@@ -14,6 +14,8 @@ Values:
   matching bitsandbytes' fp4 table.
 """
 
+from functools import lru_cache
+
 import numpy as np
 
 # QLoRA NF4 levels (exact values from the QLoRA paper / bitsandbytes).
@@ -58,3 +60,50 @@ CODEBOOKS = {
     "nf3": NF3_CODE,
     "fp4": FP4_CODE,
 }
+
+
+# ---------------------------------------------------------------------------
+# Group (vector) codebooks for the ultra-low-bit iq formats.
+#
+# The reference's IQ2_XXS/IQ1_S formats (ggml_quantize_tensor_with_weights,
+# SURVEY.md §2.3-B) quantize GROUPS of 8 values to an entry of a fixed
+# E8-lattice grid + signs. These are TPU-native re-designs of the same idea
+# rather than bit-copies of ggml's grids: the codebook is the top-N most
+# probable magnitude patterns under an iid half-Gaussian model — a
+# deterministic construction (no trained tables), so encode/decode stay
+# reproducible across machines.
+# ---------------------------------------------------------------------------
+
+_GROUP = 8
+
+
+def _top_patterns(levels, level_logp, count: int) -> np.ndarray:
+    """All len(levels)^8 patterns ranked by iid log-probability (then
+    lexicographically for a deterministic tie-break); top `count` rows."""
+    nl = len(levels)
+    idx = np.indices((nl,) * _GROUP).reshape(_GROUP, -1).T  # [nl^8, 8]
+    logp = np.asarray(level_logp)[idx].sum(axis=1)
+    order = np.lexsort(tuple(idx.T[::-1]) + (-logp,))
+    chosen = idx[order[:count]]
+    return np.asarray(levels, np.float32)[chosen]            # [count, 8]
+
+
+@lru_cache(maxsize=None)
+def group_codebook(name: str) -> np.ndarray:
+    """[n_entries, 8] float32 group codebook.
+
+    - "iq2_xxs": magnitudes {1,3,5,7} (signs stored separately), 256
+      entries; probabilities from half-normal bin masses at the working
+      scale (amax -> 7).
+    - "iq1_s": signed ternary {-1,0,+1}, 256 entries; p(0)=1/2,
+      p(+-1)=1/4.
+    """
+    if name == "iq2_xxs":
+        return _top_patterns(
+            [1.0, 3.0, 5.0, 7.0],
+            np.log([0.55, 0.25, 0.13, 0.07]), 256)
+    if name == "iq1_s":
+        return _top_patterns(
+            [0.0, 1.0, -1.0],
+            np.log([0.5, 0.25, 0.25]), 256)
+    raise ValueError(f"unknown group codebook {name!r}")

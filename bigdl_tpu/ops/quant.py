@@ -40,6 +40,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from bigdl_tpu.ops.codebooks import CODEBOOKS
 
@@ -83,6 +84,15 @@ QTYPES = {
     # sub-scales/mins under fp16 super scales (ggml Q2_K; the format behind
     # the reference's "Mixtral on 16 GB" claim, README.md:16)
     "q2_k": _q("q2_k", 2, 256, "q2k"),
+    # Ultra-low-bit group-codebook formats (TPU-native re-designs of the
+    # reference's imatrix-weighted gguf_iq2_xxs / gguf_iq1_s, SURVEY.md
+    # §2.3-B ggml_quantize_tensor_with_weights): groups of 8 values map to
+    # one entry of a deterministic codebook (ops/codebooks.py
+    # group_codebook) + per-32 4-bit sub-scales + per-256 bf16 scales.
+    # iq2_xxs: 8-bit magnitude-pattern index + 8 sign bits = 2.19 bpw.
+    # iq1_s: 8-bit signed-ternary index = 1.19 bpw.
+    "iq2_xxs": _q("iq2_xxs", 2, 256, "iqx", codebook="iq2_xxs"),
+    "iq1_s": _q("iq1_s", 1, 256, "iqx", codebook="iq1_s"),
 }
 # Aliases used throughout the reference API surface.
 QTYPES["int4"] = QTYPES["sym_int4"]
@@ -93,6 +103,9 @@ QTYPES["q5_1"] = QTYPES["asym_int5"]
 QTYPES["int8"] = QTYPES["sym_int8"]
 QTYPES["q8_0"] = QTYPES["sym_int8"]
 QTYPES["fp8"] = QTYPES["fp8_e5m2"]
+# the reference's user-facing names for the iq formats (load_in_low_bit=...)
+QTYPES["gguf_iq2_xxs"] = QTYPES["iq2_xxs"]
+QTYPES["gguf_iq1_s"] = QTYPES["iq1_s"]
 
 # float passthrough "qtypes" accepted by the convert API (no QTensor made).
 FLOAT_QTYPES = ("fp16", "bf16", "fp32")
@@ -277,19 +290,36 @@ def _codebook_encode(code: np.ndarray, xn: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("qtype",))
-def quantize(x: jax.Array, qtype: str) -> QTensor:
+def quantize(x: jax.Array, qtype: str,
+             qw: Optional[jax.Array] = None) -> QTensor:
     """Quantize a [K, N] float array along K (blockwise) into a QTensor.
 
     For an HF linear weight w of shape [out, in], call
     ``quantize(w.T, qtype)`` (see `quantize_linear`).
+
+    `qw` is an optional per-row importance vector [K] (the imatrix — the
+    reference's `ggml_quantize_tensor_with_weights`, SURVEY.md §2.3-B):
+    sym/asym/codebook formats run a weighted scale search, and the iq
+    formats weight their codebook match. Other kinds ignore it.
     """
-    qt = get_qtype(qtype)
     if x.ndim != 2:
         raise ValueError(
             f"quantize expects a 2-D [K, N] array, got shape {x.shape}; "
             "reshape/flatten leading dims first"
         )
+    qt = get_qtype(qtype)
+    if qt.kind == "iqx":
+        return _quantize_iqx(x, qt.name, qw)
+    if qw is not None and qt.kind in ("sym", "asym", "codebook"):
+        return _quantize_weighted(x, jnp.asarray(qw, jnp.float32), qt.name)
+    if qw is not None and qt.kind == "q2k":
+        return _quantize_q2k_weighted(x, jnp.asarray(qw, jnp.float32))
+    return _quantize_core(x, qt.name)
+
+
+@functools.partial(jax.jit, static_argnames=("qtype",))
+def _quantize_core(x: jax.Array, qtype: str) -> QTensor:
+    qt = get_qtype(qtype)
     k, n = x.shape
     b = qt.block_size
     x = _pad_k(x.astype(jnp.float32), b)
@@ -386,6 +416,288 @@ def quantize(x: jax.Array, qtype: str) -> QTensor:
     raise ValueError(f"unsupported qtype kind {qt.kind}")
 
 
+# ---------------------------------------------------------------------------
+# Imatrix-weighted quantization (reference: ggml_quantize_tensor_with_weights
+# bound at ggml/model/llama/llama_cpp.py:946-989; used by the reference for
+# IQ2/IQ1/Q2_K with an importance matrix, transformers/utils.py:187-323)
+# ---------------------------------------------------------------------------
+
+_WEIGHTED_NCAND = 17        # scale candidates searched per block
+_WEIGHTED_SPAN = 0.25       # +-25% around the absmax-derived scale
+
+
+@functools.partial(jax.jit, static_argnames=("qtype",))
+def _quantize_weighted(x: jax.Array, qw: jax.Array, qtype: str) -> QTensor:
+    """Weighted-MSE scale search: per block, try scale candidates around
+    the absmax scale and keep the one minimizing sum(qw * (x - deq)^2).
+    The candidate loop is a `lax.scan` so memory stays one-candidate-deep.
+    """
+    qt = get_qtype(qtype)
+    k, n = x.shape
+    b = qt.block_size
+    x = _pad_k(x.astype(jnp.float32), b)
+    kp = x.shape[0]
+    nblk = kp // b
+    xb = x.reshape(nblk, b, n)
+    wb = _pad_k(qw.reshape(-1, 1).astype(jnp.float32), b)
+    wb = jnp.maximum(wb, 1e-12).reshape(nblk, b, 1)
+
+    factors = jnp.linspace(1.0 - _WEIGHTED_SPAN, 1.0 + _WEIGHTED_SPAN,
+                           _WEIGHTED_NCAND)
+
+    if qt.kind == "sym":
+        amax_i = jnp.argmax(jnp.abs(xb), axis=1, keepdims=True)
+        mx = jnp.take_along_axis(xb, amax_i, axis=1)
+        half = float(1 << (qt.bits - 1))
+        base_d = mx / -half                                   # [nblk, 1, n]
+        lo, hi = 0.0, 2 * half - 1
+
+        def encode(d):
+            q = jnp.clip(jnp.round(xb * _safe_inv(d)) + half, lo, hi)
+            return q, (q - half) * d
+    elif qt.kind == "asym":
+        mn = jnp.min(xb, axis=1, keepdims=True)
+        mxv = jnp.max(xb, axis=1, keepdims=True)
+        levels = float((1 << qt.bits) - 1)
+        base_d = (mxv - mn) / levels
+
+        def encode(d):
+            q = jnp.clip(jnp.round((xb - mn) * _safe_inv(d)), 0, levels)
+            return q, q * d + mn
+    else:                                       # codebook
+        code = CODEBOOKS[qt.codebook]
+        base_d = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
+        code_j = jnp.asarray(code)
+
+        def encode(d):
+            q = _codebook_encode(code, xb * _safe_inv(d))
+            return q, code_j[q] * d
+
+    def try_factor(best, f):
+        best_d, best_err = best
+        d = base_d * f
+        _, recon = encode(d)
+        err = jnp.sum(wb * (xb - recon) ** 2, axis=1)          # [nblk, n]
+        better = err < best_err
+        return (jnp.where(better[:, None, :], d, best_d),
+                jnp.where(better, err, best_err)), None
+
+    init = (base_d, jnp.full((nblk, n), jnp.inf))
+    (d_best, _), _ = lax.scan(try_factor, init, factors)
+
+    q, _ = encode(d_best)
+    q = q.reshape(kp, n).astype(jnp.uint8)
+    scale = d_best.reshape(nblk, n).astype(jnp.bfloat16)
+
+    if qt.kind == "asym":
+        zero = mn.reshape(nblk, n).astype(jnp.bfloat16)
+        if qt.bits == 4:
+            return QTensor(_pack4(q, b), scale, zero, qtype, (k, n))
+        lo4 = _pack4(q & jnp.uint8(0x0F), b)
+        return QTensor(lo4, scale, zero, qtype, (k, n),
+                       aux=_pack_bits1(q >> 4))
+    if qt.kind == "codebook":
+        return QTensor(_pack4(q, b), scale, None, qtype, (k, n))
+    # sym
+    if qt.bits == 4:
+        return QTensor(_pack4(q, b), scale, None, qtype, (k, n))
+    if qt.bits == 5:
+        lo4 = _pack4(q & jnp.uint8(0x0F), b)
+        return QTensor(lo4, scale, None, qtype, (k, n),
+                       aux=_pack_bits1(q >> 4))
+    q8 = (q.astype(jnp.int16) - 128).astype(jnp.int8)
+    return QTensor(q8, scale, None, qtype, (k, n))
+
+
+@jax.jit
+def _quantize_q2k_weighted(x: jax.Array, qw: jax.Array) -> QTensor:
+    """Imatrix-weighted q2_k: per sub-block, search scale candidates for
+    the (ssc, smin) fit minimizing the weighted reconstruction error
+    (the reference's Q2_K-with-imatrix path of
+    ggml_quantize_tensor_with_weights)."""
+    qt = get_qtype("q2_k")
+    k, n = x.shape
+    b = qt.block_size
+    x = _pad_k(x.astype(jnp.float32), b)
+    kp = x.shape[0]
+    nblk = kp // b
+    xb = x.reshape(nblk, b, n)
+    wb = _pad_k(qw.reshape(-1, 1).astype(jnp.float32), b)
+    wb = jnp.maximum(wb, 1e-12).reshape(nblk, b // 16, 16, 1)
+
+    sub = xb.reshape(nblk, b // 16, 16, n)
+    mn = jnp.minimum(jnp.min(sub, axis=2), 0.0)
+    mxv = jnp.max(sub, axis=2)
+    base_ssc = jnp.maximum(mxv - mn, 0.0) / 3.0          # [nblk, 16, n]
+    smin = -mn
+
+    factors = jnp.linspace(1.0 - _WEIGHTED_SPAN, 1.0 + _WEIGHTED_SPAN,
+                           _WEIGHTED_NCAND)
+
+    def recon_err(ssc):
+        inv = _safe_inv(ssc)
+        q = jnp.clip(jnp.round((sub + smin[:, :, None, :])
+                               * inv[:, :, None, :]), 0, 3)
+        rec = q * ssc[:, :, None, :] - smin[:, :, None, :]
+        err = jnp.sum(wb * (sub - rec) ** 2, axis=2)      # [nblk, 16, n]
+        return err
+
+    def try_factor(best, f):
+        best_ssc, best_err = best
+        ssc = base_ssc * f
+        err = recon_err(ssc)
+        better = err < best_err
+        return (jnp.where(better, ssc, best_ssc),
+                jnp.where(better, err, best_err)), None
+
+    init = (base_ssc, jnp.full(base_ssc.shape, jnp.inf))
+    (ssc, _), _ = lax.scan(try_factor, init, factors)
+
+    # same superblock packing as the unweighted core
+    d = jnp.max(ssc, axis=1, keepdims=True) / 15.0
+    dmin = jnp.max(smin, axis=1, keepdims=True) / 15.0
+    dinv = _safe_inv(d)
+    minv = _safe_inv(dmin)
+    sc4 = jnp.clip(jnp.round(ssc * dinv), 0, 15).astype(jnp.uint8)
+    m4 = jnp.clip(jnp.round(smin * minv), 0, 15).astype(jnp.uint8)
+    eff_sc = d * sc4
+    eff_m = dmin * m4
+    inv_sc = _safe_inv(eff_sc)
+    q = jnp.clip(jnp.round((sub + eff_m[:, :, None, :])
+                           * inv_sc[:, :, None, :]), 0, 3)
+    q = q.reshape(kp, n).astype(jnp.uint8)
+    aux = (sc4 | (m4 << 4)).reshape(kp // 16, n)
+    return QTensor(
+        _pack2(q, b),
+        d[:, 0, :].astype(jnp.bfloat16),
+        dmin[:, 0, :].astype(jnp.bfloat16),
+        "q2_k", (k, n), aux=aux)
+
+
+# ---------------------------------------------------------------------------
+# iq formats: group-of-8 codebook quantization (iq2_xxs / iq1_s)
+# ---------------------------------------------------------------------------
+
+_IQ_CHUNK = 1024          # encode N columns at a time (bounds the [G,256,Nc]
+                          # score tensor to ~0.5 GB f32 for K=4096)
+
+
+def _iq_scales(xc: jax.Array, gmax: float):
+    """Per-32 sub-scale (4-bit) under per-256 bf16 superscale.
+
+    Returns (d [K/256, Nc], s4 [K/32, Nc] uint8, effk [K, Nc])."""
+    kp, nc = xc.shape
+    s = jnp.max(jnp.abs(xc.reshape(kp // 32, 32, nc)), axis=1) / gmax
+    d = jnp.max(s.reshape(kp // 256, 8, nc), axis=1) / 15.0
+    drep = jnp.repeat(d, 8, axis=0)
+    s4 = jnp.clip(jnp.round(s * _safe_inv(drep)), 0, 15).astype(jnp.uint8)
+    eff = drep * s4.astype(jnp.float32)
+    return d, s4, jnp.repeat(eff, 32, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("qtype",))
+def _iqx_encode_chunk(xc: jax.Array, wv: jax.Array, qtype: str):
+    """Encode one [K, Nc] chunk. wv: [K, 1] importance (ones if no imatrix).
+
+    Codebook match maximizes sum(w * y * c) - 0.5 * sum(w * c^2) per group
+    (equivalent to weighted-MSE argmin), computed as one [G, 256, Nc]
+    einsum — MXU work, not a loop."""
+    from bigdl_tpu.ops.codebooks import group_codebook
+
+    qt = get_qtype(qtype)
+    cb = jnp.asarray(group_codebook(qt.codebook))             # [256, 8]
+    signed_cb = qt.name == "iq1_s"
+    gmax = float(np.max(np.abs(group_codebook(qt.codebook))))
+    kp, nc = xc.shape
+    g = kp // 8
+
+    d, s4, effk = _iq_scales(xc, gmax)
+    y = xc * _safe_inv(effk)                                   # [K, Nc]
+    w = wv.reshape(g, 8, 1)
+
+    if signed_cb:
+        a = y.reshape(g, 8, nc)
+    else:
+        a = jnp.abs(y).reshape(g, 8, nc)
+    # scores[j] = sum_k w_k a_k c_jk - 0.5 sum_k w_k c_jk^2
+    s1 = jnp.einsum("gkn,jk->gjn", a * w, cb)
+    s2 = jnp.einsum("gk,jk->gj", w[..., 0], cb * cb)
+    idx = jnp.argmax(s1 - 0.5 * s2[:, :, None], axis=1).astype(jnp.uint8)
+
+    # pack sub-scales: 2 nibbles per byte along K
+    s4p = s4.reshape(kp // 64, 2, nc)
+    aux = (s4p[:, 0] | (s4p[:, 1] << 4)).astype(jnp.uint8)
+
+    if signed_cb:
+        data = idx                                             # [K/8, Nc]
+    else:
+        neg = (xc < 0).astype(jnp.int32).reshape(g, 8, nc)
+        shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
+        signs = jnp.sum(neg << shifts, axis=1).astype(jnp.uint8)
+        data = jnp.stack([idx, signs], axis=1).reshape(2 * g, nc)
+    return data, d.astype(jnp.bfloat16), aux
+
+
+def _quantize_iqx(x: jax.Array, qtype: str,
+                  qw: Optional[jax.Array]) -> QTensor:
+    """Host-chunked iq encode (runs once at load time; the [G,256,N]
+    score tensor is why this is chunked over N rather than one jit)."""
+    k, n = x.shape
+    x = _pad_k(jnp.asarray(x, jnp.float32), 256)
+    kp = x.shape[0]
+    if qw is None:
+        wv = jnp.ones((kp, 1), jnp.float32)
+    else:
+        wv = _pad_k(jnp.asarray(qw, jnp.float32).reshape(-1, 1), 256)
+        wv = jnp.maximum(wv, 1e-12)
+
+    datas, ds, auxs = [], [], []
+    for c0 in range(0, n, _IQ_CHUNK):
+        xc = x[:, c0:c0 + _IQ_CHUNK]
+        data, d, aux = _iqx_encode_chunk(xc, wv, qtype)
+        datas.append(data)
+        ds.append(d)
+        auxs.append(aux)
+    return QTensor(jnp.concatenate(datas, axis=1),
+                   jnp.concatenate(ds, axis=1),
+                   None, get_qtype(qtype).name, (k, n),
+                   aux=jnp.concatenate(auxs, axis=1))
+
+
+def _dequantize_iqx(qt_t: QTensor, dtype) -> jax.Array:
+    from bigdl_tpu.ops.codebooks import group_codebook
+
+    t = qt_t.qt
+    k, n = qt_t.shape
+    cb = jnp.asarray(group_codebook(t.codebook))               # [256, 8]
+    signed_cb = t.name == "iq1_s"
+
+    if signed_cb:
+        idx = qt_t.data                                        # [Kp/8, N]
+        g = idx.shape[0]
+        vals = cb[idx]                                         # [g, N, 8]
+        vals = vals.transpose(0, 2, 1)                         # [g, 8, N]
+    else:
+        gi = qt_t.data.reshape(-1, 2, qt_t.data.shape[1])
+        idx, signs = gi[:, 0], gi[:, 1]
+        g = idx.shape[0]
+        vals = cb[idx].transpose(0, 2, 1)                      # [g, 8, N]
+        shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
+        neg = (signs.astype(jnp.int32)[:, None, :] >> shifts) & 1
+        vals = vals * (1.0 - 2.0 * neg.astype(jnp.float32))
+    kp = g * 8
+
+    s4p = qt_t.aux
+    lo = (s4p & jnp.uint8(0xF)).astype(jnp.float32)
+    hi = (s4p >> 4).astype(jnp.float32)
+    s4 = jnp.stack([lo, hi], axis=1).reshape(kp // 32, n)
+    drep = jnp.repeat(qt_t.scale.astype(jnp.float32), 8, axis=0)
+    effk = jnp.repeat(drep * s4, 32, axis=0)                   # [Kp, N]
+
+    out = vals.reshape(kp, n) * effk
+    return out[:k].astype(dtype)
+
+
 def _expand_scale(scale: jax.Array, block: int, kp: int) -> jax.Array:
     """[nblk, N] -> [K, N] by repeating each block row `block` times."""
     nblk, n = scale.shape
@@ -400,6 +712,9 @@ def dequantize(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
     t = qt.qt
     k, n = qt.shape
     b = t.block_size
+
+    if t.kind == "iqx":
+        return _dequantize_iqx(qt, dtype)
 
     if t.kind == "sym" and t.bits == 8:
         kp = qt.data.shape[0]
@@ -483,24 +798,34 @@ MIXED_QTYPES = {
 }
 
 
-def quantize_auto(x: jax.Array, qtype: str) -> QTensor:
-    """quantize(), plus the mixed_* policies (MSE-picked candidate)."""
+def quantize_auto(x: jax.Array, qtype: str,
+                  qw: Optional[jax.Array] = None) -> QTensor:
+    """quantize(), plus the mixed_* policies (MSE-picked candidate; the
+    MSE is imatrix-weighted when qw is given)."""
     if qtype not in MIXED_QTYPES:
-        return quantize(x, qtype)
+        return quantize(x, qtype, qw=qw)
     xf = jnp.asarray(x, jnp.float32)
+    wcol = (None if qw is None
+            else jnp.asarray(qw, jnp.float32).reshape(-1, 1))
     best_qt, best_err = None, None
     for cand in MIXED_QTYPES[qtype]:
-        qt = quantize(xf, cand)
-        err = float(jnp.mean(
-            (dequantize(qt, jnp.float32) - xf) ** 2))
+        qt = quantize(xf, cand, qw=qw)
+        sq = (dequantize(qt, jnp.float32) - xf) ** 2
+        if wcol is not None:
+            sq = sq * wcol
+        err = float(jnp.mean(sq))
         if best_err is None or err < best_err:
             best_qt, best_err = qt, err
     return best_qt
 
 
-def quantize_linear(w_out_in: jax.Array, qtype: str) -> QTensor:
-    """Quantize an HF-layout linear weight [out, in] -> QTensor [in, out]."""
-    return quantize_auto(jnp.asarray(w_out_in).T, qtype)
+def quantize_linear(w_out_in: jax.Array, qtype: str,
+                    qw: Optional[jax.Array] = None) -> QTensor:
+    """Quantize an HF-layout linear weight [out, in] -> QTensor [in, out].
+
+    `qw` is the imatrix row for this weight: importance per INPUT feature
+    (length in_features = our contraction dim K)."""
+    return quantize_auto(jnp.asarray(w_out_in).T, qtype, qw=qw)
 
 
 def dequantize_linear(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:

@@ -66,6 +66,7 @@ class TpuCausalLM:
                 max_seq=self.max_seq,
                 kv_quantized=self.kv_quantized,
                 new_cache_fn=self.family.new_cache,
+                recurrent=self.family.is_recurrent,
             )
         return self._generator
 
@@ -173,6 +174,7 @@ class _BaseAutoModelClass:
         quantize_kv_cache: Optional[bool] = None,
         speculative: bool = False,
         embedding_qtype: Optional[str] = None,
+        imatrix: Optional[Any] = None,
         **_ignored,
     ) -> TpuCausalLM:
         from bigdl_tpu.config import flags
@@ -235,10 +237,18 @@ class _BaseAutoModelClass:
                                           plus_one)
             qtype = "asym_int4"   # remaining dense linears match the ckpt
 
+        if isinstance(imatrix, str):
+            # llama.cpp imatrix file, importance-weighted quantization
+            # (reference imatrix= kwarg, model.py:104 + utils.py:187-323)
+            from bigdl_tpu.imatrix import load_imatrix
+
+            imatrix = load_imatrix(imatrix)
+
         cvt_qtype = None if (qtype in FLOAT_QTYPES) else qtype
         params = family.convert_params(
             tensor_stream, cfg, qtype=cvt_qtype,
-            modules_to_not_convert=tuple(modules_to_not_convert))
+            modules_to_not_convert=tuple(modules_to_not_convert),
+            imatrix=imatrix)
         if embedding_qtype is not None:
             # LowBitEmbedding equivalent (reference embedding.py:77-114,
             # embedding_qtype kwarg at model.py:104)
@@ -252,11 +262,11 @@ class _BaseAutoModelClass:
         if speculative:
             # self-speculation: same checkpoint as a sym_int4 draft
             # (reference model.py:323-331)
-            if family.name.startswith("rwkv"):
+            if family.is_recurrent:
                 raise ValueError(
                     "speculative=True is not supported for recurrent "
-                    "(RWKV) families: verification rollback rewinds a KV "
-                    "cache, and recurrent state cannot be rewound")
+                    "(RWKV-style) families: verification rollback rewinds "
+                    "a KV cache, and recurrent state cannot be rewound")
             if cvt_qtype == "sym_int4":
                 model.draft_params = params      # already low-bit: share
             else:

@@ -1,0 +1,160 @@
+"""Imatrix + ultra-low-bit (iq2_xxs / iq1_s) tests.
+
+Covers the reference's imatrix-weighted quantization surface
+(ggml_quantize_tensor_with_weights + imatrix loader + per-layer mixed
+qtype policy, SURVEY.md §2.3-B and transformers/utils.py:187-323)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.imatrix import (collect_imatrix, lcpp_to_hf_name,
+                               load_imatrix, low_bit_policy, save_imatrix)
+from bigdl_tpu.ops.quant import QTensor, dequantize, get_qtype, quantize
+
+
+def _rand(k, n, seed=0):
+    return np.random.default_rng(seed).standard_normal((k, n)).astype(
+        np.float32)
+
+
+@pytest.mark.parametrize("qtype", ["sym_int4", "asym_int4", "nf4",
+                                   "q2_k", "iq2_xxs", "iq1_s"])
+def test_weighted_beats_unweighted(qtype):
+    """quantize(qw=...) must reduce the IMPORTANCE-WEIGHTED error."""
+    x = _rand(512, 64)
+    qw = (np.abs(_rand(512, 1, seed=1)[:, 0]) ** 2 + 0.01).astype(np.float32)
+    d0 = np.asarray(dequantize(quantize(jnp.asarray(x), qtype), jnp.float32))
+    dw = np.asarray(dequantize(
+        quantize(jnp.asarray(x), qtype, qw=jnp.asarray(qw)), jnp.float32))
+    werr0 = float(np.mean(qw[:, None] * (x - d0) ** 2))
+    werrw = float(np.mean(qw[:, None] * (x - dw) ** 2))
+    assert werrw <= werr0 * 1.001
+
+
+@pytest.mark.parametrize("qtype,min_corr,max_bpw", [
+    ("iq2_xxs", 0.90, 2.3), ("iq1_s", 0.70, 1.3)])
+def test_iq_roundtrip(qtype, min_corr, max_bpw):
+    x = _rand(512, 96)
+    q = quantize(jnp.asarray(x), qtype)
+    assert isinstance(q, QTensor) and q.shape == (512, 96)
+    d = np.asarray(dequantize(q, jnp.float32))
+    assert d.shape == x.shape and np.isfinite(d).all()
+    corr = np.corrcoef(x.ravel(), d.ravel())[0, 1]
+    assert corr > min_corr, corr
+    assert q.nbytes * 8 / x.size < max_bpw
+
+
+def test_iq_matmul_and_padding():
+    """iq QTensors must work through q_matmul (XLA fallback) and
+    handle K not a multiple of the 256 superblock."""
+    from bigdl_tpu.ops.matmul import q_matmul
+
+    x = _rand(300, 32)          # K=300 -> padded to 512
+    q = quantize(jnp.asarray(x), "iq2_xxs")
+    assert q.shape == (300, 32)
+    a = _rand(4, 300, seed=3)
+    y = np.asarray(q_matmul(jnp.asarray(a), q))
+    ref = a @ np.asarray(dequantize(q, jnp.float32))
+    np.testing.assert_allclose(y, ref, rtol=0.1, atol=0.1)
+
+
+def test_imatrix_file_roundtrip(tmp_path):
+    path = os.path.join(tmp_path, "calib.imatrix")
+    im = {"model.layers.0.self_attn.q_proj.weight":
+          np.abs(_rand(64, 1)[:, 0]),
+          "lm_head.weight": np.abs(_rand(64, 1, seed=2)[:, 0])}
+    save_imatrix(im, path, ncall=4)
+    back = load_imatrix(path)
+    assert set(back) == set(im)
+    for k in im:
+        np.testing.assert_allclose(back[k], im[k], rtol=1e-6)
+
+
+def test_lcpp_name_translation():
+    assert (lcpp_to_hf_name("blk.3.attn_q.weight")
+            == "model.layers.3.self_attn.q_proj.weight")
+    assert (lcpp_to_hf_name("blk.0.ffn_down.weight")
+            == "model.layers.0.mlp.down_proj.weight")
+    assert lcpp_to_hf_name("output.weight") == "lm_head.weight"
+    assert lcpp_to_hf_name("token_embd.weight") == "model.embed_tokens.weight"
+    assert lcpp_to_hf_name("blk.0.attn_norm.weight") is None
+
+
+def test_low_bit_policy():
+    assert low_bit_policy("iq2_xxs", "lm_head.weight") == "sym_int8"
+    assert (low_bit_policy("iq1_s",
+                           "model.layers.3.self_attn.v_proj.weight")
+            == "sym_int4")
+    assert (low_bit_policy("iq2_xxs",
+                           "model.layers.3.self_attn.q_proj.weight")
+            == "iq2_xxs")
+    # policy only bites for ultra-low qtypes
+    assert low_bit_policy("sym_int4", "lm_head.weight") == "sym_int4"
+
+
+def tiny_ckpt(D=64, FF=128, V=96, L=2, H=4, HKV=2):
+    """Synthetic llama checkpoint (hf_config, [(name, tensor)])."""
+    rng = np.random.default_rng(11)
+    t = lambda *s: (rng.standard_normal(s) * 0.05).astype(np.float32)
+    hd = D // H
+    hf = {"architectures": ["LlamaForCausalLM"], "vocab_size": V,
+          "hidden_size": D, "intermediate_size": FF,
+          "num_hidden_layers": L, "num_attention_heads": H,
+          "num_key_value_heads": HKV, "rms_norm_eps": 1e-5}
+    ts = [("model.embed_tokens.weight", t(V, D)),
+          ("model.norm.weight", np.ones((D,), np.float32)),
+          ("lm_head.weight", t(V, D))]
+    for i in range(L):
+        p = f"model.layers.{i}."
+        ts += [(p + "self_attn.q_proj.weight", t(H * hd, D)),
+               (p + "self_attn.k_proj.weight", t(HKV * hd, D)),
+               (p + "self_attn.v_proj.weight", t(HKV * hd, D)),
+               (p + "self_attn.o_proj.weight", t(D, H * hd)),
+               (p + "mlp.gate_proj.weight", t(FF, D)),
+               (p + "mlp.up_proj.weight", t(FF, D)),
+               (p + "mlp.down_proj.weight", t(D, FF)),
+               (p + "input_layernorm.weight", np.ones((D,), np.float32)),
+               (p + "post_attention_layernorm.weight",
+                np.ones((D,), np.float32))]
+    return hf, ts
+
+
+def test_collect_and_quantize_end_to_end():
+    """collect_imatrix on a tiny llama -> weighted iq2 load improves the
+    weighted reconstruction of the most-used channels; model generates."""
+    hf, ts = tiny_ckpt()
+    from bigdl_tpu.models.registry import get_family
+
+    fam = get_family(hf["architectures"][0])
+    cfg = fam.config_from_hf(hf)
+    dense_params = fam.convert_params(list(ts), cfg, qtype=None,
+                                      compute_dtype=jnp.float32)
+    calib = np.array([[1, 5, 9, 13, 2, 7, 11, 3]], np.int32)
+    im = collect_imatrix(dense_params, cfg, calib)
+    # every linear got a vector of the right length
+    q_key = "model.layers.0.self_attn.q_proj.weight"
+    assert q_key in im and im[q_key].shape == (cfg.hidden_size,)
+    assert (im[q_key] >= 0).all() and im[q_key].max() > 0
+    dkey = "model.layers.0.mlp.down_proj.weight"
+    assert im[dkey].shape == (cfg.intermediate_size,)
+
+    # quantize WITH the imatrix through the family conversion
+    qparams = fam.convert_params(list(ts), cfg, qtype="iq2_xxs", imatrix=im)
+    lm = qparams.get("lm_head")
+    if lm is not None:       # policy: head protected at 8 bit
+        assert lm.qtype == "sym_int8"
+    q0 = qparams["layers"]["q_proj"]
+    assert q0.qtype == "iq2_xxs"
+    v0 = qparams["layers"]["v_proj"]
+    assert v0.qtype == "sym_int4"
+
+    from bigdl_tpu.generation import Generator, GenerationConfig
+
+    gen = Generator(qparams, cfg, forward_fn=fam.forward,
+                    prefill_fn=fam.prefill, max_seq=64,
+                    new_cache_fn=fam.new_cache)
+    out = gen.generate(calib[:, :4], GenerationConfig(max_new_tokens=4))
+    assert out.shape == (1, 4)
