@@ -32,6 +32,10 @@ def __getattr__(name):
         from bigdl_tpu.transformers.model import AutoModel
 
         return AutoModel
+    if name == "AutoModelForSpeechSeq2Seq":
+        from bigdl_tpu.transformers.seq2seq import AutoModelForSpeechSeq2Seq
+
+        return AutoModelForSpeechSeq2Seq
     if name == "LLMEngine":
         from bigdl_tpu.serving import LLMEngine
 

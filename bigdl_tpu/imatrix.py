@@ -53,6 +53,17 @@ _LCPP_LAYER = {
 }
 
 
+# MoE: llama.cpp keeps ONE imatrix entry per expert stack (experts share
+# the input activations); translated to an expert-index-free HF name that
+# mixtral's conversion falls back to for every expert
+_LCPP_MOE = {
+    "ffn_gate_exps": "block_sparse_moe.experts.w1",
+    "ffn_up_exps": "block_sparse_moe.experts.w3",
+    "ffn_down_exps": "block_sparse_moe.experts.w2",
+    "ffn_gate_inp": "block_sparse_moe.gate",
+}
+
+
 def lcpp_to_hf_name(name: str) -> Optional[str]:
     """"blk.3.attn_q.weight" -> "model.layers.3.self_attn.q_proj.weight"."""
     if name == "token_embd.weight":
@@ -62,6 +73,33 @@ def lcpp_to_hf_name(name: str) -> Optional[str]:
     m = re.match(r"blk\.(\d+)\.(\w+)\.weight$", name)
     if m and m.group(2) in _LCPP_LAYER:
         return f"model.layers.{m.group(1)}.{_LCPP_LAYER[m.group(2)]}.weight"
+    if m and m.group(2) in _LCPP_MOE:
+        return f"model.layers.{m.group(1)}.{_LCPP_MOE[m.group(2)]}.weight"
+    return None
+
+
+def imatrix_lookup(imatrix: Optional[Dict[str, np.ndarray]],
+                   name: str) -> Optional[np.ndarray]:
+    """Importance vector for an HF tensor name, resolving the synthetic
+    forms conversion produces:
+
+    - "...query_key_value.weight#v_proj" (fused-QKV split): falls back to
+      the fused tensor's entry — the split shares its input channels.
+    - "...experts.4.w1.weight" (per-expert): falls back to the
+      expert-index-free "...experts.w1.weight" entry (llama.cpp keeps one
+      per stack).
+    """
+    if imatrix is None:
+        return None
+    hit = imatrix.get(name)
+    if hit is not None:
+        return hit
+    base = name.split("#", 1)[0]
+    if base != name and base in imatrix:
+        return imatrix[base]
+    m = re.match(r"(.*\.experts)\.\d+\.(w\d\.weight)$", base)
+    if m:
+        return imatrix.get(f"{m.group(1)}.{m.group(2)}")
     return None
 
 
@@ -106,24 +144,36 @@ def save_imatrix(imatrix: Dict[str, np.ndarray], path: str,
 # -- collection on our model -------------------------------------------------
 
 
+_KEY_TO_HF = {
+    "q_proj": "model.layers.{i}.self_attn.q_proj.weight",
+    "k_proj": "model.layers.{i}.self_attn.k_proj.weight",
+    "v_proj": "model.layers.{i}.self_attn.v_proj.weight",
+    "o_proj": "model.layers.{i}.self_attn.o_proj.weight",
+    "gate_proj": "model.layers.{i}.mlp.gate_proj.weight",
+    "up_proj": "model.layers.{i}.mlp.up_proj.weight",
+    "down_proj": "model.layers.{i}.mlp.down_proj.weight",
+}
+
+
 def collect_imatrix(params: Dict[str, Any], cfg, tokens,
                     compute_dtype=jnp.bfloat16) -> Dict[str, np.ndarray]:
     """Run calibration tokens through the generalized decoder, recording
     E[x^2] per input channel of every linear. Returns HF-named vectors
     usable as `quantize_linear(..., qw=...)` / `from_pretrained(imatrix=)`.
 
-    Works for any family served by models/llama.py (the scan decoder);
-    layer params are unstacked and replayed one layer at a time so the
-    intermediate activations are observable.
+    Works for any family served by models/llama.py: layers are replayed
+    one at a time through the REAL `_decoder_layer` with its `record`
+    hook, so the statistics follow every family knob (sandwich norms,
+    parallel residual, alternating sliding windows, ...) by construction.
     """
     from bigdl_tpu.models import llama as M
+    from bigdl_tpu.ops.embedding import embedding_lookup
+    from bigdl_tpu.ops.rope import rope_cos_sin
 
     tokens = jnp.asarray(np.asarray(tokens, np.int32))
     if tokens.ndim == 1:
         tokens = tokens[None]
     b, s = tokens.shape
-
-    from bigdl_tpu.ops.embedding import embedding_lookup
 
     x = embedding_lookup(params["embed_tokens"], tokens, compute_dtype)
     if cfg.embed_scale != 1.0:
@@ -134,8 +184,6 @@ def collect_imatrix(params: Dict[str, Any], cfg, tokens,
 
     inv_freq, rope_mscale = M.model_rope_freqs(cfg)
     positions = jnp.arange(s, dtype=jnp.int32)
-    from bigdl_tpu.ops.rope import rope_cos_sin
-
     cos, sin = rope_cos_sin(positions[None, :], inv_freq)
     if rope_mscale != 1.0:
         cos, sin = cos * rope_mscale, sin * rope_mscale
@@ -144,7 +192,7 @@ def collect_imatrix(params: Dict[str, Any], cfg, tokens,
 
     stats: Dict[str, np.ndarray] = {}
 
-    def record(name: str, act: jax.Array):
+    def accumulate(name: str, act: jax.Array):
         v = np.asarray(jnp.mean(
             jnp.square(act.astype(jnp.float32)), axis=tuple(
                 range(act.ndim - 1))))
@@ -157,74 +205,19 @@ def collect_imatrix(params: Dict[str, Any], cfg, tokens,
         np.asarray(tokens).ravel(), minlength=cfg.vocab_size
     ).astype(np.float32) / tokens.size
 
-    L = cfg.num_hidden_layers
-    from bigdl_tpu.ops.attention import sdp_attention
-    from bigdl_tpu.ops.matmul import linear
-    from bigdl_tpu.ops.rope import apply_rope
-
-    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
-    for i in range(L):
+    for i in range(cfg.num_hidden_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
-        pre = f"model.layers.{i}."
-        hidden = M._norm(x, lp["input_layernorm"],
-                         lp.get("input_layernorm_bias"), cfg)
-        record(pre + "self_attn.q_proj.weight", hidden)
-        record(pre + "self_attn.k_proj.weight", hidden)
-        record(pre + "self_attn.v_proj.weight", hidden)
-        q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias")).reshape(
-            b, s, h, hd)
-        k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias")).reshape(
-            b, s, hkv, hd)
-        v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias")).reshape(
-            b, s, hkv, hd)
-        if cfg.use_rope:
-            q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
-            k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
-        scale = (cfg.query_pre_attn_scalar ** -0.5
-                 if cfg.query_pre_attn_scalar is not None else None)
-        attn = sdp_attention(q, k, v, jnp.zeros((), jnp.int32), scale=scale,
-                             sliding_window=cfg.sliding_window,
-                             logits_soft_cap=cfg.attn_soft_cap,
-                             alibi_slopes=slopes).reshape(b, s, h * hd)
-        record(pre + "self_attn.o_proj.weight", attn)
-        attn_out = linear(attn, lp["o_proj"], lp.get("o_proj_bias"))
 
-        if cfg.parallel_residual:
-            mlp_in = hidden if cfg.shared_input_norm else M._norm(
-                x, lp["post_attention_layernorm"],
-                lp.get("post_attention_layernorm_bias"), cfg)
-            record(pre + "mlp.gate_proj.weight", mlp_in)
-            record(pre + "mlp.up_proj.weight", mlp_in)
-            inner = _mlp_inner(mlp_in, lp, cfg)
-            record(pre + "mlp.down_proj.weight", inner)
-            x = x + attn_out + linear(inner, lp["down_proj"],
-                                      lp.get("down_proj_bias"))
-        else:
-            x = x + attn_out
-            mlp_in = M._norm(x, lp["post_attention_layernorm"],
-                             lp.get("post_attention_layernorm_bias"), cfg)
-            record(pre + "mlp.gate_proj.weight", mlp_in)
-            record(pre + "mlp.up_proj.weight", mlp_in)
-            inner = _mlp_inner(mlp_in, lp, cfg)
-            record(pre + "mlp.down_proj.weight", inner)
-            x = x + linear(inner, lp["down_proj"], lp.get("down_proj_bias"))
+        def rec(key, act, _i=i):
+            accumulate(_KEY_TO_HF[key].format(i=_i), act)
+
+        x, _ = M._decoder_layer(x, lp, cfg, cos, sin, slopes,
+                                cache_ctx=None,
+                                lidx=jnp.asarray(i, jnp.int32), record=rec)
 
     x = M._norm(x, params["norm"], params.get("norm_bias"), cfg)
-    record("lm_head.weight", x)
+    accumulate("lm_head.weight", x)
     return stats
-
-
-def _mlp_inner(hidden, lp, cfg):
-    """The activation entering down_proj (gate/up already applied)."""
-    from bigdl_tpu.models.llama import _ACTS
-    from bigdl_tpu.ops.matmul import linear
-
-    act = _ACTS[cfg.hidden_act]
-    if cfg.mlp_gated:
-        gate = linear(hidden, lp["gate_proj"], lp.get("gate_proj_bias"))
-        up = linear(hidden, lp["up_proj"], lp.get("up_proj_bias"))
-        return act(gate) * up
-    return act(linear(hidden, lp["up_proj"], lp.get("up_proj_bias")))
 
 
 # -- mixed-qtype policy ------------------------------------------------------
@@ -243,6 +236,8 @@ def low_bit_policy(base_qtype: str, hf_name: str) -> str:
     if hf_name.endswith(("lm_head.weight", "output.weight", "head.weight")):
         return "sym_int8"
     if (".v_proj." in hf_name or ".down_proj." in hf_name
-            or ".w2." in hf_name):     # .w2 = mixtral expert down_proj
+            or ".w2." in hf_name       # .w2 = mixtral expert down_proj
+            # fused-QKV splits carry the logical slot as a "#" suffix
+            or hf_name.endswith(("#v_proj", "#down_proj"))):
         return "sym_int4"
     return base_qtype

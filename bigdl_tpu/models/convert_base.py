@@ -39,26 +39,24 @@ class Acc:
         Quantization prefers the native C++ kernels (bigdl_tpu.native, the
         quantize-llama-binary equivalent) — bit-identical to the JAX path,
         which remains the fallback. Already-quantized leaves (GPTQ/AWQ
-        repack, transformers/gptq_awq.py) pass through unchanged. With an
-        imatrix, quantization is importance-weighted and ultra-low-bit
-        loads apply the per-tensor protection policy
-        (bigdl_tpu.imatrix.low_bit_policy) — the reference's
-        quantize-with-weights path (transformers/utils.py:187-323)."""
+        repack, transformers/gptq_awq.py) pass through unchanged. An
+        imatrix makes quantization importance-weighted; independent of
+        that, ultra-low-bit qtypes ALWAYS apply the per-tensor protection
+        policy (bigdl_tpu.imatrix.low_bit_policy — part of those formats'
+        semantics, as in the reference's transformers/utils.py:187-323)."""
         from bigdl_tpu.ops.quant import QTensor as _QT
 
         if isinstance(w, _QT):
             return w
         if self.do_quant and not any(m in name for m in self.skip):
-            from bigdl_tpu.imatrix import low_bit_policy
+            from bigdl_tpu.imatrix import imatrix_lookup, low_bit_policy
             from bigdl_tpu.native import quantize_native
             from bigdl_tpu.ops.quant import QTensor
 
             qtype = low_bit_policy(self.qtype, name)
-            qw = None
-            if self.imatrix is not None:
-                qw = self.imatrix.get(name)
-                if qw is not None and len(qw) != np.asarray(w).shape[1]:
-                    qw = None     # wrong orientation (e.g. embedding row)
+            qw = imatrix_lookup(self.imatrix, name)
+            if qw is not None and len(qw) != np.asarray(w).shape[1]:
+                qw = None     # wrong orientation (e.g. embedding row)
             if qw is None:
                 wt = np.ascontiguousarray(np.asarray(w).T, np.float32)
                 native = quantize_native(wt, qtype)

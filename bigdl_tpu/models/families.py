@@ -178,9 +178,11 @@ def _gptneox_map(acc: _Acc, name: str, w) -> None:
         idx, sub = hit
         if sub == "attention.query_key_value.weight":
             q, k, v = _deinterleave_qkv(w, h, hd)
-            acc.put("q_proj", idx, acc.linear(name, q))
-            acc.put("k_proj", idx, acc.linear(name, k))
-            acc.put("v_proj", idx, acc.linear(name, v))
+            # "#<slot>" marks the logical projection inside a fused tensor
+            # (drives low_bit_policy and imatrix_lookup fallback)
+            acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+            acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+            acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
         elif sub == "attention.query_key_value.bias":
             q, k, v = _deinterleave_qkv(w, h, hd)
             acc.put("q_proj_bias", idx, acc.dense(q))
@@ -258,9 +260,11 @@ def _bloom_map(acc: _Acc, name: str, w) -> None:
         idx, sub = hit
         if sub == "self_attention.query_key_value.weight":
             q, k, v = _deinterleave_qkv(w, h, hd)
-            acc.put("q_proj", idx, acc.linear(name, q))
-            acc.put("k_proj", idx, acc.linear(name, k))
-            acc.put("v_proj", idx, acc.linear(name, v))
+            # "#<slot>" marks the logical projection inside a fused tensor
+            # (drives low_bit_policy and imatrix_lookup fallback)
+            acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+            acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+            acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
         elif sub == "self_attention.query_key_value.bias":
             q, k, v = _deinterleave_qkv(w, h, hd)
             acc.put("q_proj_bias", idx, acc.dense(q))
@@ -340,9 +344,11 @@ def _falcon_map(acc: _Acc, name: str, w) -> None:
         idx, sub = hit
         if sub == "self_attention.query_key_value.weight":
             q, k, v = _split_rows(w, [h * hd, hkv * hd, hkv * hd])
-            acc.put("q_proj", idx, acc.linear(name, q))
-            acc.put("k_proj", idx, acc.linear(name, k))
-            acc.put("v_proj", idx, acc.linear(name, v))
+            # "#<slot>" marks the logical projection inside a fused tensor
+            # (drives low_bit_policy and imatrix_lookup fallback)
+            acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+            acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+            acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
         else:
             m = {
                 "self_attention.dense.weight": ("o_proj", "linear"),
@@ -453,9 +459,11 @@ def _baichuan_map(acc: _Acc, name: str, w) -> None:
         idx, sub = hit
         if sub == "self_attn.W_pack.weight":
             q, k, v = _split_rows(w, [d, d, d])
-            acc.put("q_proj", idx, acc.linear(name, q))
-            acc.put("k_proj", idx, acc.linear(name, k))
-            acc.put("v_proj", idx, acc.linear(name, v))
+            # "#<slot>" marks the logical projection inside a fused tensor
+            # (drives low_bit_policy and imatrix_lookup fallback)
+            acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+            acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+            acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
         else:
             m = {
                 "self_attn.o_proj.weight": "o_proj",
@@ -518,9 +526,11 @@ def _chatglm2_map(acc: _Acc, name: str, w) -> None:
         idx, sub = hit
         if sub == "self_attention.query_key_value.weight":
             q, k, v = _split_rows(w, [h * hd, hkv * hd, hkv * hd])
-            acc.put("q_proj", idx, acc.linear(name, q))
-            acc.put("k_proj", idx, acc.linear(name, k))
-            acc.put("v_proj", idx, acc.linear(name, v))
+            # "#<slot>" marks the logical projection inside a fused tensor
+            # (drives low_bit_policy and imatrix_lookup fallback)
+            acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+            acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+            acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
         elif sub == "self_attention.query_key_value.bias":
             q, k, v = _split_rows(w, [h * hd, hkv * hd, hkv * hd])
             acc.put("q_proj_bias", idx, acc.dense(q))
@@ -528,8 +538,8 @@ def _chatglm2_map(acc: _Acc, name: str, w) -> None:
             acc.put("v_proj_bias", idx, acc.dense(v))
         elif sub == "mlp.dense_h_to_4h.weight":
             gate, up = _split_rows(w, [ff, ff])
-            acc.put("gate_proj", idx, acc.linear(name, gate))
-            acc.put("up_proj", idx, acc.linear(name, up))
+            acc.put("gate_proj", idx, acc.linear(name + "#gate_proj", gate))
+            acc.put("up_proj", idx, acc.linear(name + "#up_proj", up))
         else:
             m = {
                 "self_attention.dense.weight": "o_proj",
@@ -585,9 +595,11 @@ def _mpt_map(acc: _Acc, name: str, w) -> None:
         idx, sub = hit
         if sub == "attn.Wqkv.weight":
             q, k, v = _split_rows(w, [d, d, d])
-            acc.put("q_proj", idx, acc.linear(name, q))
-            acc.put("k_proj", idx, acc.linear(name, k))
-            acc.put("v_proj", idx, acc.linear(name, v))
+            # "#<slot>" marks the logical projection inside a fused tensor
+            # (drives low_bit_policy and imatrix_lookup fallback)
+            acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+            acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+            acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
         else:
             m = {
                 "attn.out_proj.weight": ("o_proj", "linear"),
@@ -697,9 +709,11 @@ def _internlm2_map(acc: _Acc, name: str, w) -> None:
             q = wg[:, :g].reshape(h * hd, -1)
             k = wg[:, g].reshape(hkv * hd, -1)
             v = wg[:, g + 1].reshape(hkv * hd, -1)
-            acc.put("q_proj", idx, acc.linear(name, q))
-            acc.put("k_proj", idx, acc.linear(name, k))
-            acc.put("v_proj", idx, acc.linear(name, v))
+            # "#<slot>" marks the logical projection inside a fused tensor
+            # (drives low_bit_policy and imatrix_lookup fallback)
+            acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+            acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+            acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
         else:
             m = {
                 "attention.wo.weight": "o_proj",

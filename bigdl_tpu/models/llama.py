@@ -212,19 +212,25 @@ _ACTS = {
 }
 
 
-def _mlp(hidden, lp, cfg: LlamaConfig):
+def _mlp(hidden, lp, cfg: LlamaConfig, record=None):
     act = _ACTS[cfg.hidden_act]
+    if record is not None:
+        record("gate_proj" if cfg.mlp_gated else "up_proj", hidden)
+        if cfg.mlp_gated:
+            record("up_proj", hidden)
     if cfg.mlp_gated:
         gate = linear(hidden, lp["gate_proj"], lp.get("gate_proj_bias"))
         up = linear(hidden, lp["up_proj"], lp.get("up_proj_bias"))
         inner = act(gate) * up
     else:
         inner = act(linear(hidden, lp["up_proj"], lp.get("up_proj_bias")))
+    if record is not None:
+        record("down_proj", inner)
     return linear(inner, lp["down_proj"], lp.get("down_proj_bias"))
 
 
 def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
-                cache_ctx=None, lidx=None):
+                cache_ctx=None, lidx=None, record=None):
     """QKV + rope + (cached) attention + output projection."""
     b, sq, _ = hidden.shape
     h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
@@ -234,6 +240,10 @@ def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
     if cfg.alt_sliding_window and sw is not None and lidx is not None:
         # gemma2: sliding attention on even layers, global on odd
         sw = jnp.where(lidx % 2 == 0, sw, jnp.int32(1 << 30))
+    if record is not None:
+        record("q_proj", hidden)
+        record("k_proj", hidden)
+        record("v_proj", hidden)
     q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias")).reshape(
         b, sq, h, hd)
     k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias")).reshape(
@@ -260,16 +270,22 @@ def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
                              alibi_slopes=slopes)
         out = None
     attn = attn.reshape(b, sq, h * hd)
+    if record is not None:
+        record("o_proj", attn)
     return linear(attn, lp["o_proj"], lp.get("o_proj_bias")), out
 
 
 def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, slopes,
-                   cache_ctx=None, lidx=None):
-    """One transformer block, sequential/parallel/sandwich residual."""
+                   cache_ctx=None, lidx=None, record=None):
+    """One transformer block, sequential/parallel/sandwich residual.
+
+    `record(key, activation)` (optional, trace-time) observes the input of
+    every linear — the imatrix collection hook (bigdl_tpu.imatrix), kept
+    here so statistics always match the real forward."""
     hidden = _norm(x, lp["input_layernorm"],
                    lp.get("input_layernorm_bias"), cfg)
     attn_out, cache_out = _attn_block(hidden, lp, cfg, cos, sin, slopes,
-                                      cache_ctx, lidx=lidx)
+                                      cache_ctx, lidx=lidx, record=record)
     if cfg.sandwich_norms:
         # gemma2: x += postnorm(attn(prenorm(x))); same sandwich for mlp
         attn_out = _norm(attn_out, lp["post_attention_layernorm"],
@@ -277,7 +293,7 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, slopes,
         x = x + attn_out
         mlp_in = _norm(x, lp["pre_feedforward_layernorm"],
                        lp.get("pre_feedforward_layernorm_bias"), cfg)
-        mlp_out = _mlp(mlp_in, lp, cfg)
+        mlp_out = _mlp(mlp_in, lp, cfg, record=record)
         mlp_out = _norm(mlp_out, lp["post_feedforward_layernorm"],
                         lp.get("post_feedforward_layernorm_bias"), cfg)
         return x + mlp_out, cache_out
@@ -287,12 +303,12 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, slopes,
         else:
             mlp_in = _norm(x, lp["post_attention_layernorm"],
                            lp.get("post_attention_layernorm_bias"), cfg)
-        x = x + attn_out + _mlp(mlp_in, lp, cfg)
+        x = x + attn_out + _mlp(mlp_in, lp, cfg, record=record)
     else:
         x = x + attn_out
         hidden2 = _norm(x, lp["post_attention_layernorm"],
                         lp.get("post_attention_layernorm_bias"), cfg)
-        x = x + _mlp(hidden2, lp, cfg)
+        x = x + _mlp(hidden2, lp, cfg, record=record)
     return x, cache_out
 
 

@@ -221,7 +221,7 @@ def convert_hf_params(
     main consumer of those formats (the reference's "Mixtral on 16 GB"
     IQ2 claim, README.md:16).
     """
-    from bigdl_tpu.imatrix import low_bit_policy
+    from bigdl_tpu.imatrix import imatrix_lookup, low_bit_policy
     from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize_linear
 
     L, E = cfg.num_hidden_layers, cfg.num_local_experts
@@ -230,7 +230,7 @@ def convert_hf_params(
     def cvt_linear(name, w):
         w = jnp.asarray(np.asarray(w))
         if do_quant and not any(m in name for m in modules_to_not_convert):
-            qw = None if imatrix is None else imatrix.get(name)
+            qw = imatrix_lookup(imatrix, name)
             if qw is not None and len(qw) != w.shape[1]:
                 qw = None
             return quantize_linear(w, low_bit_policy(qtype, name), qw=qw)

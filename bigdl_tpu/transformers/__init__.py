@@ -9,3 +9,7 @@ from bigdl_tpu.transformers.lowbit_io import (  # noqa: F401
     load_low_bit,
     save_low_bit,
 )
+from bigdl_tpu.transformers.seq2seq import (  # noqa: F401
+    AutoModelForSpeechSeq2Seq,
+    TpuSpeechSeq2Seq,
+)

@@ -1,0 +1,128 @@
+"""AutoModelForSpeechSeq2Seq: the encoder-decoder facade (Whisper).
+
+Reference analog: ipex-llm's `AutoModelForSpeechSeq2Seq`
+(transformers/model.py:688-725) — whisper quantized via optimize_model
+(optimize.py:196) and driven through HF generate. Here loading streams the
+checkpoint into a quantized pytree (models/whisper.py) and generation is a
+jit-compiled encode + decode loop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import whisper as W
+from bigdl_tpu.ops.quant import FLOAT_QTYPES
+from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
+
+
+class TpuSpeechSeq2Seq:
+    """A loaded (possibly quantized) Whisper + compiled generation."""
+
+    def __init__(self, params: Any, cfg: W.WhisperConfig,
+                 hf_config: Dict[str, Any], qtype: Optional[str],
+                 model_path: Optional[str] = None):
+        self.params = params
+        self.config = cfg
+        self.hf_config = hf_config
+        self.qtype = qtype
+        self.model_path = model_path
+        self._encode = jax.jit(W.encode, static_argnums=(1,))
+        self._decode = jax.jit(W.decode_step, static_argnums=(1,),
+                               donate_argnums=(3,))
+        self._init_cache = jax.jit(W.init_decoder_cache,
+                                   static_argnums=(1, 3))
+
+    def encode(self, input_features) -> jax.Array:
+        mel = jnp.asarray(np.asarray(input_features, np.float32))
+        if mel.ndim == 2:
+            mel = mel[None]
+        return self._encode(self.params, self.config, mel)
+
+    def generate(
+        self,
+        input_features,                   # [B, n_mels, T] log-mel
+        decoder_input_ids=None,           # forced tokens; default start id
+        max_new_tokens: int = 128,
+        eos_token_id: Optional[int] = None,
+        **_ignored,
+    ) -> np.ndarray:
+        """Greedy transcription. Returns [B, forced + new] token ids."""
+        cfg = self.config
+        enc_out = self.encode(input_features)
+        b = enc_out.shape[0]
+        if decoder_input_ids is None:
+            decoder_input_ids = np.full((b, 1), cfg.decoder_start_token_id,
+                                        np.int32)
+        ids = np.asarray(decoder_input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        eos = cfg.eos_token_id if eos_token_id is None else eos_token_id
+        max_seq = min(cfg.max_target_positions,
+                      ids.shape[1] + max_new_tokens)
+
+        if ids.shape[1] + max_new_tokens > cfg.max_target_positions:
+            raise ValueError(
+                f"forced tokens ({ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the decoder's "
+                f"max_target_positions ({cfg.max_target_positions})")
+        cache = self._init_cache(self.params, cfg, enc_out, max_seq)
+        logits, cache = self._decode(self.params, cfg, jnp.asarray(ids),
+                                     cache)
+        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+        out = [np.asarray(tok)]
+        finished = out[0] == eos
+        for _ in range(max_new_tokens - 1):
+            if finished.all():
+                break
+            logits, cache = self._decode(self.params, cfg, tok[:, None],
+                                         cache)
+            tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            t = np.asarray(tok)
+            t = np.where(finished, eos, t)
+            out.append(t)
+            finished |= t == eos
+        return np.concatenate([ids, np.stack(out, axis=1)], axis=1)
+
+
+class AutoModelForSpeechSeq2Seq:
+    """from_pretrained with the reference's low-bit kwargs (whisper)."""
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        pretrained_model_name_or_path: str,
+        load_in_4bit: bool = False,
+        load_in_low_bit: Optional[str] = None,
+        modules_to_not_convert=(),
+        imatrix=None,
+        **_ignored,
+    ) -> TpuSpeechSeq2Seq:
+        from bigdl_tpu.transformers.model import _resolve_qtype
+
+        path = pretrained_model_name_or_path
+        hf_config = load_hf_config(path)
+        archs = hf_config.get("architectures") or ["?"]
+        if archs[0] != "WhisperForConditionalGeneration":
+            raise ValueError(
+                f"AutoModelForSpeechSeq2Seq supports whisper checkpoints; "
+                f"got {archs[0]!r}")
+        qtype = _resolve_qtype(load_in_4bit, load_in_low_bit)
+        cfg = W.WhisperConfig.from_hf(hf_config)
+        if isinstance(imatrix, str):
+            from bigdl_tpu.imatrix import load_imatrix
+
+            imatrix = load_imatrix(imatrix)
+        cvt_qtype = None if qtype in FLOAT_QTYPES else qtype
+        params = W.convert_hf_params(
+            iter_hf_tensors(path), cfg, qtype=cvt_qtype,
+            modules_to_not_convert=tuple(modules_to_not_convert),
+            imatrix=imatrix)
+        return TpuSpeechSeq2Seq(params, cfg, hf_config, qtype,
+                                model_path=path)

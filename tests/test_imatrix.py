@@ -122,6 +122,47 @@ def tiny_ckpt(D=64, FF=128, V=96, L=2, H=4, HKV=2):
     return hf, ts
 
 
+def test_collect_follows_family_knobs():
+    """collect_imatrix must run the REAL decoder layer: gemma2's sandwich
+    norms + alternating sliding window go through the same code path."""
+    import dataclasses
+
+    from bigdl_tpu.models.llama import LlamaConfig, forward_train
+    from bigdl_tpu.models.registry import get_family
+
+    D, FF, V, L, H = 32, 64, 48, 2, 4
+    cfg = dataclasses.replace(
+        LlamaConfig(vocab_size=V, hidden_size=D, intermediate_size=FF,
+                    num_hidden_layers=L, num_attention_heads=H,
+                    num_key_value_heads=H, tie_word_embeddings=True),
+        sandwich_norms=True, attn_soft_cap=50.0,
+        query_pre_attn_scalar=float(D // H), sliding_window=4,
+        alt_sliding_window=True)
+    rng = np.random.default_rng(3)
+    t = lambda *s: jnp.asarray((rng.standard_normal(s) * 0.05
+                                ).astype(np.float32))
+    ones = lambda *s: jnp.ones(s, jnp.float32)
+    layers = {
+        "q_proj": t(L, D, D), "k_proj": t(L, D, D), "v_proj": t(L, D, D),
+        "o_proj": t(L, D, D), "gate_proj": t(L, D, FF),
+        "up_proj": t(L, D, FF), "down_proj": t(L, FF, D),
+        "input_layernorm": ones(L, D), "post_attention_layernorm":
+        ones(L, D), "pre_feedforward_layernorm": ones(L, D),
+        "post_feedforward_layernorm": ones(L, D)}
+    params = {"embed_tokens": t(V, D), "norm": ones(D), "layers": layers}
+    toks = np.array([[1, 5, 9, 2, 7, 11]], np.int32)
+    im = collect_imatrix(params, cfg, toks)
+    # the recorded residual stream must match the real forward: re-derive
+    # down_proj input importance through forward_train equivalence is
+    # indirect; assert the hook fired for every linear with right shapes
+    assert im["model.layers.1.mlp.down_proj.weight"].shape == (FF,)
+    assert im["model.layers.1.self_attn.o_proj.weight"].shape == (D,)
+    assert all(np.all(v >= 0) for v in im.values())
+    # sanity: the model itself runs with these params (same code path)
+    lg = forward_train(params, cfg, jnp.asarray(toks))
+    assert np.isfinite(np.asarray(lg)).all()
+
+
 def test_collect_and_quantize_end_to_end():
     """collect_imatrix on a tiny llama -> weighted iq2 load improves the
     weighted reconstruction of the most-used channels; model generates."""
