@@ -33,17 +33,18 @@ class MeshConfig:
     unit axes at zero cost), so one spec set serves every topology."""
     dp: int = 1
     fsdp: int = 1
+    pp: int = 1
     tp: int = 1
     sp: int = 1
     ep: int = 1
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
-        return ("dp", "fsdp", "tp", "sp", "ep")
+        return ("dp", "fsdp", "pp", "tp", "sp", "ep")
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.dp, self.fsdp, self.tp, self.sp, self.ep)
+        return (self.dp, self.fsdp, self.pp, self.tp, self.sp, self.ep)
 
     @property
     def size(self) -> int:
@@ -86,6 +87,7 @@ def make_mesh(
     sp: int = 1,
     ep: int = 1,
     fsdp: int = 1,
+    pp: int = 1,
 ) -> Mesh:
     """Build a named Mesh over the available devices.
 
@@ -97,11 +99,11 @@ def make_mesh(
     n = len(devs)
     if cfg is None:
         if tp is None and dp is None:
-            tp = n
+            tp = max(1, n // (sp * ep * fsdp * pp))
         if tp is None:
-            tp = max(1, n // ((dp or 1) * sp * ep * fsdp))
-        dp = dp or max(1, n // (tp * sp * ep * fsdp))
-        cfg = MeshConfig(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
+            tp = max(1, n // ((dp or 1) * sp * ep * fsdp * pp))
+        dp = dp or max(1, n // (tp * sp * ep * fsdp * pp))
+        cfg = MeshConfig(dp=dp, fsdp=fsdp, pp=pp, tp=tp, sp=sp, ep=ep)
     if cfg.size != n:
         raise ValueError(
             f"mesh shape {cfg.shape} needs {cfg.size} devices, have {n}")

@@ -9,7 +9,6 @@ jit-compiled encode + decode loop.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -63,14 +62,14 @@ class TpuSpeechSeq2Seq:
         if ids.ndim == 1:
             ids = ids[None]
         eos = cfg.eos_token_id if eos_token_id is None else eos_token_id
-        max_seq = min(cfg.max_target_positions,
-                      ids.shape[1] + max_new_tokens)
-
         if ids.shape[1] + max_new_tokens > cfg.max_target_positions:
             raise ValueError(
                 f"forced tokens ({ids.shape[1]}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the decoder's "
                 f"max_target_positions ({cfg.max_target_positions})")
+        if max_new_tokens <= 0:
+            return ids
+        max_seq = ids.shape[1] + max_new_tokens
         cache = self._init_cache(self.params, cfg, enc_out, max_seq)
         logits, cache = self._decode(self.params, cfg, jnp.asarray(ids),
                                      cache)
