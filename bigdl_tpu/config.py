@@ -26,6 +26,8 @@ def _env_bool(name: str, default: bool = False) -> bool:
 class RuntimeFlags:
     # kernel dispatch: "auto" (Pallas on TPU when supported), "xla", "pallas"
     matmul_backend: str = "auto"
+    # decode-attention dispatch, same values (ops/pallas/decode_attention)
+    attention_backend: str = "auto"
     # host-side C++ kernels (bigdl_tpu.native); disable to force pure JAX
     disable_native: bool = False
     native_cache_dir: Optional[str] = None
@@ -39,6 +41,8 @@ class RuntimeFlags:
     def from_env(cls) -> "RuntimeFlags":
         return cls(
             matmul_backend=os.environ.get("BIGDL_TPU_MATMUL_BACKEND", "auto"),
+            attention_backend=os.environ.get(
+                "BIGDL_TPU_ATTENTION_BACKEND", "auto"),
             disable_native=_env_bool("BIGDL_TPU_DISABLE_NATIVE"),
             native_cache_dir=os.environ.get("BIGDL_TPU_NATIVE_CACHE"),
             quantize_kv_cache=_env_bool("BIGDL_TPU_QUANTIZE_KV_CACHE"),

@@ -1,0 +1,211 @@
+"""BERT encoder: quantized sentence/token embeddings.
+
+The reference optimizes bert through merged-QKV + SDP forwards
+(reference transformers/models/bert.py:42-147) and exposes it to users as
+the embedding backend of its langchain integration
+(`TransformersEmbeddings`, langchain/embeddings/bigdlllm.py). TPU-native
+counterpart: a functional post-LN encoder over stacked layer params —
+bidirectional attention with a key-padding mask (sdp_attention is causal
+by construction, so bert computes its masked attention inline), quantized
+linears everywhere, mean/CLS pooling for sentence embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.ops.matmul import linear
+from bigdl_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @property
+    def hd(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_hf(cls, hf: Dict[str, Any]) -> "BertConfig":
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            num_hidden_layers=hf["num_hidden_layers"],
+            num_attention_heads=hf["num_attention_heads"],
+            intermediate_size=hf["intermediate_size"],
+            max_position_embeddings=hf.get("max_position_embeddings", 512),
+            type_vocab_size=hf.get("type_vocab_size", 2),
+            layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+        )
+
+
+def _masked_attention(q, k, v, key_mask, scale):
+    """Bidirectional SDP with a key-padding mask. q/k/v [B, S, H, hd]."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.bfloat16),
+                        k.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(key_mask[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(jnp.bfloat16),
+                     v.astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _encoder_layer(x, lp, cfg: BertConfig, key_mask):
+    """Post-LN block (original-BERT residual order)."""
+    b, s, _ = x.shape
+    h, hd = cfg.num_attention_heads, cfg.hd
+    q = linear(x, lp["q_proj"], lp["q_proj_bias"]).reshape(b, s, h, hd)
+    k = linear(x, lp["k_proj"], lp["k_proj_bias"]).reshape(b, s, h, hd)
+    v = linear(x, lp["v_proj"], lp["v_proj_bias"]).reshape(b, s, h, hd)
+    attn = _masked_attention(q, k, v, key_mask, hd ** -0.5)
+    attn = linear(attn.reshape(b, s, h * hd), lp["o_proj"],
+                  lp["o_proj_bias"])
+    x = layer_norm(x + attn, lp["attn_norm"], lp["attn_norm_bias"],
+                   cfg.layer_norm_eps)
+    inner = jax.nn.gelu(linear(x, lp["fc1"], lp["fc1_bias"]),
+                        approximate=False)
+    out = linear(inner, lp["fc2"], lp["fc2_bias"])
+    return layer_norm(x + out, lp["out_norm"], lp["out_norm_bias"],
+                      cfg.layer_norm_eps)
+
+
+def forward(
+    params: Dict[str, Any],
+    cfg: BertConfig,
+    input_ids: jax.Array,                 # [B, S] int32
+    attention_mask: Optional[jax.Array] = None,   # [B, S] 1=real
+    token_type_ids: Optional[jax.Array] = None,
+    compute_dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (last_hidden [B, S, D], pooled CLS [B, D])."""
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), jnp.int32)
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros((b, s), jnp.int32)
+    key_mask = attention_mask.astype(bool)
+
+    emb = params["word_embeddings"][input_ids]
+    emb = emb + params["position_embeddings"][jnp.arange(s)][None]
+    emb = emb + params["token_type_embeddings"][token_type_ids]
+    x = layer_norm(emb.astype(compute_dtype), params["embed_norm"],
+                   params["embed_norm_bias"], cfg.layer_norm_eps)
+
+    x, _ = lax.scan(
+        lambda c, lp: (_encoder_layer(c, lp, cfg, key_mask), None),
+        x, params["layers"])
+
+    pooled = x[:, 0, :]
+    if "pooler" in params:
+        pooled = jnp.tanh(linear(pooled, params["pooler"],
+                                 params["pooler_bias"]))
+    return x, pooled
+
+
+def mean_pool(last_hidden: jax.Array, attention_mask: jax.Array) -> jax.Array:
+    """Masked mean over tokens — the standard sentence-embedding pool."""
+    m = attention_mask.astype(jnp.float32)[..., None]
+    return (jnp.sum(last_hidden.astype(jnp.float32) * m, axis=1)
+            / jnp.maximum(jnp.sum(m, axis=1), 1e-9))
+
+
+# -- conversion ---------------------------------------------------------------
+
+_LAYER_MAP = {
+    "attention.self.query": ("q_proj", True),
+    "attention.self.key": ("k_proj", True),
+    "attention.self.value": ("v_proj", True),
+    "attention.output.dense": ("o_proj", True),
+    "attention.output.LayerNorm": ("attn_norm", False),
+    "intermediate.dense": ("fc1", True),
+    "output.dense": ("fc2", True),
+    "output.LayerNorm": ("out_norm", False),
+}
+
+
+def convert_hf_params(
+    tensors,
+    cfg: BertConfig,
+    qtype: Optional[str] = "sym_int4",
+    compute_dtype=jnp.bfloat16,
+    modules_to_not_convert: Tuple[str, ...] = (),
+    imatrix=None,
+) -> Dict[str, Any]:
+    from bigdl_tpu.imatrix import imatrix_lookup, low_bit_policy
+    from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize_linear
+
+    do_quant = qtype is not None and qtype not in FLOAT_QTYPES
+
+    def cvt_linear(name, w):
+        w = jnp.asarray(np.asarray(w))
+        if do_quant and not any(m in name for m in modules_to_not_convert):
+            qw = imatrix_lookup(imatrix, name)
+            if qw is not None and len(qw) != w.shape[1]:
+                qw = None
+            return quantize_linear(w, low_bit_policy(qtype, name), qw=qw)
+        return w.T.astype(compute_dtype)
+
+    dense = lambda w: jnp.asarray(np.asarray(w)).astype(compute_dtype)
+
+    top: Dict[str, Any] = {}
+    acc: Dict[str, list] = {}
+    L = cfg.num_hidden_layers
+
+    def put(key, idx, val):
+        acc.setdefault(key, [None] * L)[idx] = val
+
+    for name, w in tensors:
+        n = name[len("bert."):] if name.startswith("bert.") else name
+        if n == "embeddings.word_embeddings.weight":
+            top["word_embeddings"] = dense(w)
+        elif n == "embeddings.position_embeddings.weight":
+            top["position_embeddings"] = dense(w)
+        elif n == "embeddings.token_type_embeddings.weight":
+            top["token_type_embeddings"] = dense(w)
+        elif n == "embeddings.LayerNorm.weight":
+            top["embed_norm"] = dense(w)
+        elif n == "embeddings.LayerNorm.bias":
+            top["embed_norm_bias"] = dense(w)
+        elif n == "pooler.dense.weight":
+            top["pooler"] = cvt_linear(name, w)
+        elif n == "pooler.dense.bias":
+            top["pooler_bias"] = dense(w)
+        elif n.startswith("encoder.layer."):
+            parts = n.split(".")
+            idx = int(parts[2])
+            sub = ".".join(parts[3:-1])
+            leaf = parts[-1]
+            hit = _LAYER_MAP.get(sub)
+            if hit is None:
+                continue
+            key, is_lin = hit
+            if is_lin and leaf == "weight":
+                put(key, idx, cvt_linear(name, w))
+            elif is_lin:
+                put(f"{key}_bias", idx, dense(w))
+            else:
+                put(key if leaf == "weight" else f"{key}_bias", idx,
+                    dense(w))
+
+    missing = [k for k, v in acc.items() if any(x is None for x in v)]
+    if missing:
+        raise ValueError(f"bert checkpoint missing layer tensors: {missing}")
+    top["layers"] = {k: jax.tree.map(lambda *xs: jnp.stack(xs), *v)
+                     for k, v in acc.items()}
+    return top

@@ -730,6 +730,75 @@ def _internlm2_map(acc: _Acc, name: str, w) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Qwen (v1, incl. the text decoder of Qwen-VL) — fused c_attn with bias,
+# RMSNorm, silu-gated MLP with HALF intermediate width (w1/w2 each
+# intermediate_size//2), llama rope
+# (reference transformers/models/qwen.py + qwen_vl.py)
+# ---------------------------------------------------------------------------
+
+def _qwen1_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        # Qwen1 splits config intermediate_size across w1/w2
+        intermediate_size=hf["intermediate_size"] // 2,
+        num_hidden_layers=hf["num_hidden_layers"],
+        num_attention_heads=hf["num_attention_heads"],
+        num_key_value_heads=hf["num_attention_heads"],
+        head_dim=hf.get("kv_channels"),
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-6),
+        rope_theta=hf.get("rotary_emb_base", 10000.0),
+        max_position_embeddings=hf.get("seq_length", 8192),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        attention_bias=True,
+        hidden_act="silu",
+        mlp_gated=True,
+    )
+
+
+def _qwen1_map(acc: _Acc, name: str, w) -> None:
+    d = acc.cfg.num_attention_heads * acc.cfg.hd
+    name_ = name[len("transformer."):] if name.startswith("transformer.") \
+        else name
+    if name_ == "wte.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name_ == "ln_f.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name_ == "lm_head.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    else:
+        hit = _layer_idx(name_, "h.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub == "attn.c_attn.weight":
+            q, k, v = _split_rows(w, [d, d, d])
+            acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+            acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+            acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
+        elif sub == "attn.c_attn.bias":
+            q, k, v = _split_rows(w, [d, d, d])
+            acc.put("q_proj_bias", idx, acc.dense(q))
+            acc.put("k_proj_bias", idx, acc.dense(k))
+            acc.put("v_proj_bias", idx, acc.dense(v))
+        else:
+            m = {
+                "attn.c_proj.weight": "o_proj",
+                # Qwen1 MLP: c_proj(silu(w2(x)) * w1(x)) — w2 is the
+                # activated branch, i.e. our gate slot
+                "mlp.w2.weight": "gate_proj",
+                "mlp.w1.weight": "up_proj",
+                "mlp.c_proj.weight": "down_proj",
+                "ln_1.weight": "input_layernorm",
+                "ln_2.weight": "post_attention_layernorm",
+            }.get(sub)
+            if m:
+                is_lin = "norm" not in m
+                acc.put(m, idx, acc.linear(name, w) if is_lin
+                        else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
 # StableLM — LN with bias, partial rotary, gated silu MLP
 # (reference transformers/models/stablelm.py)
 # ---------------------------------------------------------------------------
@@ -747,6 +816,107 @@ def _stablelm_cfg(hf: Dict[str, Any]) -> LlamaConfig:
                                hf.get("rope_pct", 0.25)) * hd),
         attention_bias=bool(hf.get("use_qkv_bias", False)),
     )
+
+
+# ---------------------------------------------------------------------------
+# Phixtral — phi-2 body (parallel residual, ONE shared LN, biases, partial
+# rotary, gelu) with a mixture of dense fc1/fc2 experts
+# (reference transformers/models/phixtral.py:73-138)
+# ---------------------------------------------------------------------------
+
+def _phixtral_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    d = hf["n_embd"]
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=d,
+        intermediate_size=hf.get("n_inner") or 4 * d,
+        num_hidden_layers=hf["n_layer"],
+        num_attention_heads=hf["n_head"],
+        num_key_value_heads=hf.get("n_head_kv") or hf["n_head"],
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        max_position_embeddings=hf.get("n_positions", 2048),
+        tie_word_embeddings=False,
+        attention_bias=True,
+        norm_type="layernorm",
+        parallel_residual=True,
+        shared_input_norm=True,
+        mlp_gated=False,
+        hidden_act="gelu_tanh",
+        rotary_dim=hf.get("rotary_dim", 32),
+        lm_head_bias=True,
+        num_local_experts=hf.get("num_local_experts", 4),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+    )
+
+
+def _phixtral_map(acc: _Acc, name: str, w) -> None:
+    d = acc.cfg.hidden_size
+    name_ = name[len("transformer."):] if name.startswith("transformer.") \
+        else name
+    if name_ == "embd.wte.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name_ == "lm_head.ln.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name_ == "lm_head.ln.bias":
+        acc.top["norm_bias"] = acc.dense(w)
+    elif name_ == "lm_head.linear.weight":
+        acc.top["lm_head"] = acc.linear(name, w)
+    elif name_ == "lm_head.linear.bias":
+        acc.top["lm_head_bias"] = acc.dense(w)
+    else:
+        hit = _layer_idx(name_, "h.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub == "mixer.Wqkv.weight":
+            q, k, v = _split_rows(w, [d, d, d])
+            acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+            acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+            acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
+        elif sub == "mixer.Wqkv.bias":
+            q, k, v = _split_rows(w, [d, d, d])
+            acc.put("q_proj_bias", idx, acc.dense(q))
+            acc.put("k_proj_bias", idx, acc.dense(k))
+            acc.put("v_proj_bias", idx, acc.dense(v))
+        elif sub == "mixer.out_proj.weight":
+            acc.put("o_proj", idx, acc.linear(name, w))
+        elif sub == "mixer.out_proj.bias":
+            acc.put("o_proj_bias", idx, acc.dense(w))
+        elif sub == "ln.weight":
+            acc.put("input_layernorm", idx, acc.dense(w))
+        elif sub == "ln.bias":
+            acc.put("input_layernorm_bias", idx, acc.dense(w))
+        elif sub == "moe.gate.weight":
+            # router kept dense [D, E] (the reference also leaves the tiny
+            # gate unquantized)
+            acc.put("router", idx,
+                    jnp.asarray(np.asarray(w)).T.astype(acc.compute_dtype))
+        elif sub.startswith("moe.mlp."):
+            parts = sub.split(".")
+            e, proj, leaf = int(parts[2]), parts[3], parts[4]
+            key = {"fc1": "experts_up", "fc2": "experts_down"}[proj]
+            if leaf == "weight":
+                acc.put(f"{key}__{e}", idx, acc.linear(name, w))
+            else:
+                acc.put(f"{key}_bias__{e}", idx, acc.dense(w))
+
+
+def _phixtral_convert(tensors, cfg, qtype="sym_int4",
+                      compute_dtype=jnp.bfloat16,
+                      modules_to_not_convert=(), imatrix=None):
+    """Per-expert keys are accumulated flat, then re-stacked to the
+    [L, E, ...] expert layout _moe_mlp vmaps over."""
+    params = _make_convert(_phixtral_map)(
+        tensors, cfg, qtype=qtype, compute_dtype=compute_dtype,
+        modules_to_not_convert=modules_to_not_convert, imatrix=imatrix)
+    layers = params["layers"]
+    E = cfg.num_local_experts
+    for base in ("experts_up", "experts_down",
+                 "experts_up_bias", "experts_down_bias"):
+        parts = [layers.pop(f"{base}__{e}") for e in range(E)]
+        layers[base] = jax.tree.map(
+            lambda *xs: jnp.stack(xs, axis=1), *parts)
+    return params
 
 
 # ---------------------------------------------------------------------------
@@ -807,6 +977,20 @@ def register_all() -> None:
                     _adapter("gptj", _gptj_cfg, _gptj_map))
     register_family(["InternLM2ForCausalLM"],
                     _adapter("internlm2", _internlm2_cfg, _internlm2_map))
+    # Qwen v1; QWenLMHeadModel is also the text decoder of Qwen-VL
+    # (the reference routes qwen_vl's LLM through the same qwen forwards,
+    # transformers/models/qwen_vl.py — the ViT tower stays unquantized)
+    register_family(["QWenLMHeadModel"],
+                    _adapter("qwen", _qwen1_cfg, _qwen1_map))
+    register_family(["PhixtralForCausalLM"], FamilyAdapter(
+        name="phixtral",
+        config_from_hf=_phixtral_cfg,
+        convert_params=_phixtral_convert,
+        forward=llama_mod.forward,
+        prefill=llama_mod.forward_last_token,
+        forward_train=llama_mod.forward_train,
+        new_cache=llama_mod.new_cache,
+    ))
     register_family(["StableLmForCausalLM", "StableLMEpochForCausalLM"],
                     FamilyAdapter(
                         name="stablelm",

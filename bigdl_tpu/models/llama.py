@@ -110,6 +110,10 @@ class LlamaConfig:
     sandwich_norms: bool = False
     query_pre_attn_scalar: Optional[float] = None
     alt_sliding_window: bool = False
+    # sparse-MoE MLP (phixtral-style; layer params carry "router" +
+    # "experts_*" stacks instead of the dense mlp keys)
+    num_local_experts: int = 0
+    num_experts_per_tok: int = 2
 
     @property
     def hd(self) -> int:
@@ -212,7 +216,48 @@ _ACTS = {
 }
 
 
+def _moe_mlp(hidden, lp, cfg: LlamaConfig):
+    """Sparse-MoE MLP for generalized-decoder families (phixtral: phi body
+    with a mixture of dense fc1/fc2 experts, reference transformers/models/
+    phixtral.py:73-138 — there a Python loop with host syncs; here the
+    one-hot einsum combine, like models/mixtral.py)."""
+    b, t, d = hidden.shape
+    act = _ACTS[cfg.hidden_act]
+    xf = hidden.reshape(-1, d)
+    router_logits = jnp.dot(xf, lp["router"].astype(hidden.dtype),
+                            preferred_element_type=jnp.float32)
+    topv, topi = lax.top_k(router_logits, cfg.num_experts_per_tok)
+    w = jax.nn.softmax(topv, axis=-1)
+    combine = jnp.sum(
+        jax.nn.one_hot(topi, cfg.num_local_experts, dtype=w.dtype)
+        * w[..., None], axis=1)                               # [N, E]
+
+    if cfg.mlp_gated:
+        def expert_fn(gw, uw, dw):
+            return linear(act(linear(xf, gw)) * linear(xf, uw), dw)
+
+        all_out = jax.vmap(expert_fn)(
+            lp["experts_gate"], lp["experts_up"], lp["experts_down"])
+    elif "experts_up_bias" in lp:
+        def expert_fn(uw, ub, dw, db):
+            return linear(act(linear(xf, uw, ub)), dw, db)
+
+        all_out = jax.vmap(expert_fn)(
+            lp["experts_up"], lp["experts_up_bias"],
+            lp["experts_down"], lp["experts_down_bias"])
+    else:
+        def expert_fn(uw, dw):
+            return linear(act(linear(xf, uw)), dw)
+
+        all_out = jax.vmap(expert_fn)(lp["experts_up"],
+                                      lp["experts_down"])
+    y = jnp.einsum("ne,end->nd", combine.astype(hidden.dtype), all_out)
+    return y.reshape(b, t, d)
+
+
 def _mlp(hidden, lp, cfg: LlamaConfig, record=None):
+    if "router" in lp:
+        return _moe_mlp(hidden, lp, cfg)
     act = _ACTS[cfg.hidden_act]
     if record is not None:
         record("gate_proj" if cfg.mlp_gated else "up_proj", hidden)

@@ -46,9 +46,11 @@ class MixtralConfig(LlamaConfig):
 
     @classmethod
     def from_hf(cls, hf: Dict[str, Any]) -> "MixtralConfig":
-        base = LlamaConfig.from_hf(hf)
+        kw = dataclasses.asdict(LlamaConfig.from_hf(hf))
+        kw.pop("num_local_experts", None)   # now also LlamaConfig fields
+        kw.pop("num_experts_per_tok", None)
         return cls(
-            **dataclasses.asdict(base),
+            **kw,
             num_local_experts=hf.get("num_local_experts", 8),
             num_experts_per_tok=hf.get("num_experts_per_tok", 2),
         )
@@ -65,26 +67,12 @@ class MixtralConfig(LlamaConfig):
 
 
 def moe_block(x: jax.Array, lp: Dict[str, Any], cfg: MixtralConfig) -> jax.Array:
-    """Sparse-MoE MLP: route, evaluate experts, one-hot combine. [B,T,D]."""
-    b, t, d = x.shape
-    xf = x.reshape(-1, d)                                   # [N, D]
-    router_logits = jnp.dot(xf, lp["router"].astype(x.dtype),
-                            preferred_element_type=jnp.float32)  # [N, E]
-    topv, topi = lax.top_k(router_logits, cfg.num_experts_per_tok)
-    w = jax.nn.softmax(topv, axis=-1)                       # [N, k] f32
-    combine = jnp.sum(
-        jax.nn.one_hot(topi, cfg.num_local_experts, dtype=w.dtype)
-        * w[..., None], axis=1)                             # [N, E]
+    """Sparse-MoE MLP: route, evaluate experts, one-hot combine. [B,T,D].
 
-    def expert_fn(gate_w, up_w, down_w):
-        g = linear(xf, gate_w)
-        u = linear(xf, up_w)
-        return linear(jax.nn.silu(g) * u, down_w)           # [N, D]
-
-    all_out = jax.vmap(expert_fn)(
-        lp["experts_gate"], lp["experts_up"], lp["experts_down"])  # [E,N,D]
-    y = jnp.einsum("ne,end->nd", combine.astype(x.dtype), all_out)
-    return y.reshape(b, t, d)
+    One implementation serves every MoE family: the generalized decoder's
+    `_moe_mlp` (models/llama.py) handles mixtral's gated expert layout
+    (cfg.mlp_gated=True) and phixtral's dense fc1/fc2 experts."""
+    return llama_mod._moe_mlp(x, lp, cfg)
 
 
 def _layer_step(cfg: MixtralConfig, carry, xs):

@@ -118,5 +118,19 @@ def _register_builtin() -> None:
     register_family(["Rwkv5ForCausalLM", "RwkvWorldForCausalLM"],
                     rwkv_adapter(5))
 
+    from bigdl_tpu.models import yuan as yuan_mod
+
+    register_family(["YuanForCausalLM"], FamilyAdapter(
+        name="yuan",
+        config_from_hf=yuan_mod.config_from_hf,
+        convert_params=yuan_mod.convert_hf_params,
+        forward=yuan_mod.forward,
+        prefill=yuan_mod.forward_last_token,
+        forward_train=yuan_mod.forward_train,
+        new_cache=yuan_mod.new_cache,
+        # the LFA conv history cannot mask pad tokens or rewind
+        is_recurrent=True,
+    ))
+
 
 _register_builtin()

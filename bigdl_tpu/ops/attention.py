@@ -24,6 +24,36 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_kernel_compiles() -> bool:
+    """One-time eager probe: does the Pallas decode kernel compile on this
+    backend? Runs a tiny concrete call OUTSIDE any trace (probing inside
+    jit would surface Mosaic errors at the outer compile, where they can't
+    be caught). Auto mode consults this; pallas mode bypasses it so forced
+    runs still raise their real error."""
+    try:
+        import numpy as _np
+
+        from bigdl_tpu.ops.pallas.decode_attention import (
+            decode_attention_pallas)
+
+        q = jnp.zeros((1, 1, 8, 128), jnp.bfloat16)
+        kv = jnp.zeros((1, 128, 8, 128), jnp.bfloat16)
+        out = decode_attention_pallas(q, kv, kv, jnp.asarray(0, jnp.int32),
+                                      0.1)
+        _np.asarray(out)
+        return True
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "fused decode-attention kernel unavailable (%s: %s); using the "
+            "XLA path for this process", type(e).__name__, e)
+        return False
+
 
 def sdp_attention(
     q: jax.Array,          # [B, Sq, H, D] (post-RoPE)
@@ -39,12 +69,33 @@ def sdp_attention(
 
     Query i attends keys j where j <= q_pos + i (and within the sliding
     window if set). Returns [B, Sq, H, D] in q.dtype. Softmax in f32.
+
+    Decode (Sq=1) on TPU dispatches to the fused Pallas kernel
+    (ops/pallas/decode_attention — the reference's `sdp_fp8`/ESIMD
+    `sdp_forward` equivalent) unless BIGDL_TPU_ATTENTION_BACKEND=xla.
     """
     b, sq, h, d = q.shape
     skv, hkv = k.shape[1], k.shape[2]
     g = h // hkv
     if scale is None:
         scale = d ** -0.5
+
+    from bigdl_tpu.config import flags
+
+    be = flags().attention_backend
+    if be in ("auto", "pallas"):
+        from bigdl_tpu.ops.pallas.decode_attention import (
+            decode_attention_pallas, decode_attention_supported)
+
+        supported = decode_attention_supported(
+            q, k, v, q_pos, scale, logits_soft_cap, sliding_window,
+            alibi_slopes)
+        on_tpu = jax.default_backend() == "tpu"
+        if supported and be == "pallas":
+            return decode_attention_pallas(q, k, v, q_pos, float(scale),
+                                           interpret=not on_tpu)
+        if supported and on_tpu and _decode_kernel_compiles():
+            return decode_attention_pallas(q, k, v, q_pos, float(scale))
 
     qf = q.reshape(b, sq, hkv, g, d).astype(jnp.bfloat16)
     kf = k.astype(jnp.bfloat16)

@@ -1,0 +1,111 @@
+"""Pallas TPU kernel: fused single-token (decode) attention over the cache.
+
+TPU-native replacement for the reference's decode-attention kernels —
+`linear_q4_0.sdp_fp8` (FP8-KV decode SDP, reference transformers/models/
+llama.py:435) and ESIMD `sdp_forward` (low_bit_linear.py:744-745 gates at
+models/utils.py:315-355).
+
+Decode attention is memory-bound: the whole KV cache is read to produce one
+token. The XLA fallback computes scores/softmax/values as separate fusions
+with an [B,H,1,S] intermediate round-trip; this kernel walks each (batch,
+kv-head) pair once — K and V stream HBM->VMEM exactly one time, the
+scores/softmax/combine never leave VMEM, and FP8 caches upcast in-register
+(the reference needs dedicated fp8 GEMM kernels for the same effect).
+
+Shapes: q [B, 1, H, hd]; cache k/v [B, S, Hkv, hd] (bf16 or float8_e5m2);
+pos int32 scalar or per-slot [B] (continuous batching). GQA queries ride
+the sublane axis: each grid step computes the whole G = H/Hkv query group
+against its kv head with one [G, hd] x [hd, S] MXU pass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, *, scale, s, gp):
+    b = pl.program_id(0)
+    pos = pos_ref[b]
+
+    q = q_ref[0, 0].astype(jnp.bfloat16)              # [Gp, hd]
+    k = k_ref[0, :, 0, :].astype(jnp.bfloat16)        # [S, hd]
+    v = v_ref[0, :, 0, :].astype(jnp.bfloat16)        # [S, hd]
+
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale    # [Gp, S]
+    ids = jax.lax.broadcasted_iota(jnp.int32, (gp, s), 1)
+    scores = jnp.where(ids <= pos, scores, -jnp.inf)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jax.lax.dot_general(
+        p.astype(jnp.bfloat16), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) / l        # [Gp, hd]
+    out_ref[0, 0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def decode_attention_pallas(
+    q: jax.Array,          # [B, 1, H, hd]
+    k: jax.Array,          # [B, S, Hkv, hd] bf16 | float8_e5m2
+    v: jax.Array,
+    q_pos: jax.Array,      # scalar int32 or [B] int32
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused decode SDP. Returns [B, 1, H, hd] in q.dtype."""
+    b, sq, h, hd = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    if sq != 1:
+        raise NotImplementedError("decode kernel handles Sq == 1 only")
+    g = h // hkv
+    gp = max(16, -(-g // 8) * 8)      # pad query group to a clean sublane run
+
+    qr = q.reshape(b, hkv, g, hd)
+    if gp != g:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+
+    pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, hd), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, s, 1, hd), lambda bi, hi, pos_ref: (bi, 0, hi, 0)),
+            pl.BlockSpec((1, s, 1, hd), lambda bi, hi, pos_ref: (bi, 0, hi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gp, hd),
+                               lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, s=s, gp=gp),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, gp, hd), q.dtype),
+        interpret=interpret,
+    )(pos, qr, k, v)
+
+    return out[:, :, :g, :].reshape(b, 1, h, hd)
+
+
+def decode_attention_supported(q, k, v, q_pos, scale, logits_soft_cap,
+                               sliding_window, alibi_slopes) -> bool:
+    """Gate for the sdp_attention dispatch (bigdl_tpu.ops.attention)."""
+    if q.shape[1] != 1 or alibi_slopes is not None:
+        return False
+    if logits_soft_cap is not None or sliding_window is not None:
+        return False
+    b, _, h, hd = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    if h % hkv != 0 or hd % 64 != 0 or s % 128 != 0:
+        return False
+    if k.dtype not in (jnp.bfloat16, jnp.float8_e5m2):
+        return False
+    return True

@@ -56,8 +56,13 @@ def _walk(tree: Any, prefix, arrays, meta):
 
 
 def _to_numpy(x) -> Tuple[np.ndarray, str]:
-    """Return (storable ndarray, logical dtype string)."""
-    arr = np.asarray(jax.device_get(x))
+    """Return (storable ndarray, logical dtype string).
+
+    device_get can hand back a NON-contiguous host array (observed with
+    bf16 over the tunneled TPU backend); safetensors serializes the raw
+    buffer without honoring strides, so everything is made C-contiguous
+    before the dtype reinterpret."""
+    arr = np.ascontiguousarray(np.asarray(jax.device_get(x)))
     name = str(arr.dtype)
     if arr.dtype == jnp.bfloat16:
         return arr.view(np.uint16), "bfloat16"

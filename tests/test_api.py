@@ -152,3 +152,24 @@ def test_loader_util(tmp_path):
     d.mkdir(parents=True)
     assert get_model_path("meta/llama", str(tmp_path / "hub")) == str(d)
     assert get_model_path("/abs/path", None) == "/abs/path"
+
+
+def test_lowbit_to_numpy_contiguous():
+    """device_get can return non-C-contiguous hosts arrays (seen on the
+    tunneled TPU backend); safetensors ignores strides, so _to_numpy must
+    always hand back C-contiguous memory."""
+    import numpy as np
+
+    from bigdl_tpu.transformers.lowbit_io import _to_numpy
+
+    strided = np.arange(24, dtype=np.float32).reshape(4, 6).T  # F-order view
+    out, dt = _to_numpy(strided)
+    assert out.flags["C_CONTIGUOUS"]
+    np.testing.assert_array_equal(out, strided)
+
+    import ml_dtypes
+
+    bf = np.arange(12, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    bf_strided = np.broadcast_to(bf.reshape(3, 4).T, (4, 3))[:, ::-1]
+    out, dt = _to_numpy(bf_strided)
+    assert out.flags["C_CONTIGUOUS"] and dt == "bfloat16"

@@ -1,0 +1,235 @@
+"""Tests for qwen1, phixtral, yuan, and bert.
+
+Same harness shape as test_families: synthetic checkpoints -> convert ->
+prefill/decode parity -> generate. Bert additionally gets HF numerical
+equivalence (transformers.BertModel is available offline)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.generation import Generator, GenerationConfig
+from bigdl_tpu.models.registry import get_family
+
+D, FF, V, L, H = 64, 128, 96, 2, 4
+HD = D // H
+
+
+def t(rng, *shape, scale=0.05):
+    return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+
+def qwen1_ckpt():
+    rng = np.random.default_rng(0)
+    hf = {"architectures": ["QWenLMHeadModel"], "vocab_size": V,
+          "hidden_size": D, "intermediate_size": 2 * FF,
+          "num_hidden_layers": L, "num_attention_heads": H,
+          "kv_channels": HD, "layer_norm_epsilon": 1e-6,
+          "rotary_emb_base": 10000.0, "seq_length": 128}
+    ts = [("transformer.wte.weight", t(rng, V, D, scale=0.2)),
+          ("transformer.ln_f.weight", np.ones((D,), np.float32)),
+          ("lm_head.weight", t(rng, V, D))]
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        ts += [(p + "ln_1.weight", np.ones((D,), np.float32)),
+               (p + "ln_2.weight", np.ones((D,), np.float32)),
+               (p + "attn.c_attn.weight", t(rng, 3 * D, D)),
+               (p + "attn.c_attn.bias", t(rng, 3 * D)),
+               (p + "attn.c_proj.weight", t(rng, D, D)),
+               (p + "mlp.w1.weight", t(rng, FF, D)),
+               (p + "mlp.w2.weight", t(rng, FF, D)),
+               (p + "mlp.c_proj.weight", t(rng, D, FF))]
+    return hf, ts
+
+
+def phixtral_ckpt(E=4):
+    rng = np.random.default_rng(1)
+    hf = {"architectures": ["PhixtralForCausalLM"], "vocab_size": V,
+          "n_embd": D, "n_inner": FF, "n_layer": L, "n_head": H,
+          "n_positions": 128, "rotary_dim": HD // 2,
+          "layer_norm_epsilon": 1e-5, "num_local_experts": E,
+          "num_experts_per_tok": 2}
+    ts = [("transformer.embd.wte.weight", t(rng, V, D, scale=0.2)),
+          ("lm_head.ln.weight", np.ones((D,), np.float32)),
+          ("lm_head.ln.bias", np.zeros((D,), np.float32)),
+          ("lm_head.linear.weight", t(rng, V, D)),
+          ("lm_head.linear.bias", np.zeros((V,), np.float32))]
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        ts += [(p + "ln.weight", np.ones((D,), np.float32)),
+               (p + "ln.bias", np.zeros((D,), np.float32)),
+               (p + "mixer.Wqkv.weight", t(rng, 3 * D, D)),
+               (p + "mixer.Wqkv.bias", t(rng, 3 * D)),
+               (p + "mixer.out_proj.weight", t(rng, D, D)),
+               (p + "mixer.out_proj.bias", t(rng, D)),
+               (p + "moe.gate.weight", t(rng, E, D))]
+        for e in range(E):
+            ts += [(p + f"moe.mlp.{e}.fc1.weight", t(rng, FF, D)),
+                   (p + f"moe.mlp.{e}.fc1.bias", t(rng, FF)),
+                   (p + f"moe.mlp.{e}.fc2.weight", t(rng, D, FF)),
+                   (p + f"moe.mlp.{e}.fc2.bias", t(rng, D))]
+    return hf, ts
+
+
+def yuan_ckpt():
+    rng = np.random.default_rng(2)
+    hf = {"architectures": ["YuanForCausalLM"], "vocab_size": V,
+          "hidden_size": D, "intermediate_size": FF,
+          "num_hidden_layers": L, "num_attention_heads": H,
+          "num_key_value_heads": H, "rms_norm_eps": 1e-6,
+          "max_position_embeddings": 128}
+    ts = [("model.embed_tokens.weight", t(rng, V, D, scale=0.2)),
+          ("model.norm.weight", np.ones((D,), np.float32)),
+          ("lm_head.weight", t(rng, V, D))]
+    for i in range(L):
+        p = f"model.layers.{i}."
+        ts += [(p + "self_attn.q_proj.weight", t(rng, D, D)),
+               (p + "self_attn.k_proj.weight", t(rng, D, D)),
+               (p + "self_attn.v_proj.weight", t(rng, D, D)),
+               (p + "self_attn.o_proj.weight", t(rng, D, D)),
+               (p + "self_attn.lf_gate.conv1.weight",
+                t(rng, D, D, 2, 1, scale=0.02)),
+               (p + "self_attn.lf_gate.conv1.bias", t(rng, D)),
+               (p + "self_attn.lf_gate.conv2.weight",
+                t(rng, D, D, 2, 1, scale=0.02)),
+               (p + "self_attn.lf_gate.conv2.bias", t(rng, D)),
+               (p + "self_attn.lf_gate.output_layernorm.weight",
+                np.ones((D,), np.float32)),
+               (p + "self_attn.lf_gate.output_layernorm.bias",
+                np.zeros((D,), np.float32)),
+               (p + "mlp.gate_proj.weight", t(rng, FF, D)),
+               (p + "mlp.up_proj.weight", t(rng, FF, D)),
+               (p + "mlp.down_proj.weight", t(rng, D, FF)),
+               (p + "input_layernorm.weight", np.ones((D,), np.float32)),
+               (p + "post_attention_layernorm.weight",
+                np.ones((D,), np.float32))]
+    return hf, ts
+
+
+@pytest.mark.parametrize("make", [qwen1_ckpt, phixtral_ckpt, yuan_ckpt])
+def test_prefill_decode_parity(make):
+    hf, ts = make()
+    fam = get_family(hf["architectures"][0])
+    cfg = fam.config_from_hf(hf)
+    params = fam.convert_params(ts, cfg, qtype=None,
+                                compute_dtype=jnp.float32)
+
+    toks = np.array([[5, 17, 33, 2, 8, 41]], np.int32)
+    full, _ = fam.forward(params, cfg, jnp.asarray(toks),
+                          fam.new_cache(cfg, 1, 32),
+                          compute_dtype=jnp.float32)
+
+    cache = fam.new_cache(cfg, 1, 32)
+    steps = []
+    for i in range(toks.shape[1]):
+        lg, cache = fam.forward(params, cfg, jnp.asarray(toks[:, i:i + 1]),
+                                cache, compute_dtype=jnp.float32)
+        steps.append(np.asarray(lg[:, 0]))
+    np.testing.assert_allclose(np.asarray(full), np.stack(steps, 1),
+                               rtol=3e-3, atol=3e-3)
+
+
+@pytest.mark.parametrize("make", [qwen1_ckpt, phixtral_ckpt, yuan_ckpt])
+def test_quantized_generate(make):
+    hf, ts = make()
+    fam = get_family(hf["architectures"][0])
+    cfg = fam.config_from_hf(hf)
+    params = fam.convert_params(ts, cfg, qtype="sym_int4")
+    gen = Generator(params, cfg, forward_fn=fam.forward,
+                    prefill_fn=fam.prefill, max_seq=64,
+                    new_cache_fn=fam.new_cache,
+                    recurrent=fam.is_recurrent)
+    out = gen.generate(np.array([[5, 17, 33]], np.int32),
+                       GenerationConfig(max_new_tokens=6))
+    out2 = gen.generate(np.array([[5, 17, 33]], np.int32),
+                        GenerationConfig(max_new_tokens=6))
+    np.testing.assert_array_equal(out, out2)
+    assert out.shape == (1, 6) and (out >= 0).all() and (out < V).all()
+
+
+def test_bert_matches_hf(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import BertConfig as HFBertConfig, BertModel
+
+    torch.manual_seed(0)
+    hfc = HFBertConfig(
+        vocab_size=V, hidden_size=D, num_hidden_layers=L,
+        num_attention_heads=H, intermediate_size=FF,
+        max_position_embeddings=64, type_vocab_size=2)
+    ref = BertModel(hfc).eval()
+    ref.save_pretrained(tmp_path)
+
+    from bigdl_tpu.transformers.embedder import BertEmbedder
+
+    ids = np.array([[2, 7, 11, 13, 5], [3, 9, 0, 0, 0]], np.int32)
+    mask = np.array([[1, 1, 1, 1, 1], [1, 1, 0, 0, 0]], np.int32)
+    with torch.no_grad():
+        out = ref(input_ids=torch.tensor(ids.astype(np.int64)),
+                  attention_mask=torch.tensor(mask.astype(np.int64)))
+        ref_hidden = out.last_hidden_state.numpy()
+        ref_pooled = out.pooler_output.numpy()
+
+    m = BertEmbedder.from_pretrained(str(tmp_path))  # dense path
+    from bigdl_tpu.models import bert as B
+
+    params = B.convert_hf_params(
+        __import__("bigdl_tpu.utils.hf", fromlist=["iter_hf_tensors"]
+                   ).iter_hf_tensors(str(tmp_path)),
+        m.config, qtype=None, compute_dtype=jnp.float32)
+    hidden, pooled = B.forward(params, m.config, jnp.asarray(ids),
+                               jnp.asarray(mask),
+                               compute_dtype=jnp.float32)
+    # positions beyond the mask are unconstrained (HF still attends rows
+    # of padding queries to real keys; we match that), compare real rows
+    for b in range(2):
+        n = int(mask[b].sum())
+        np.testing.assert_allclose(np.asarray(hidden)[b, :n],
+                                   ref_hidden[b, :n], rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(pooled), ref_pooled,
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_bert_embed_quantized(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import BertConfig as HFBertConfig, BertModel
+
+    torch.manual_seed(1)
+    ref = BertModel(HFBertConfig(
+        vocab_size=V, hidden_size=D, num_hidden_layers=L,
+        num_attention_heads=H, intermediate_size=FF,
+        max_position_embeddings=64)).eval()
+    ref.save_pretrained(tmp_path)
+
+    from bigdl_tpu.transformers.embedder import BertEmbedder
+
+    m = BertEmbedder.from_pretrained(str(tmp_path), load_in_4bit=True)
+    ids = np.array([[2, 7, 11], [3, 9, 0]], np.int32)
+    mask = np.array([[1, 1, 1], [1, 1, 0]], np.int32)
+    emb = m.embed(ids, mask)
+    assert emb.shape == (2, D) and np.isfinite(emb).all()
+    cls = m.embed(ids, mask, pooling="cls")
+    assert cls.shape == (2, D)
+
+    class FakeTok:
+        def __call__(self, text):
+            return {"input_ids": [2] + [5] * (len(text) % 7 + 1)}
+
+    out = m.embed_texts(["hello world", "tpu"], FakeTok())
+    assert out.shape == (2, D)
+
+
+def test_speculative_rejected_for_yuan(tmp_path):
+    import json, os
+    from safetensors.numpy import save_file
+
+    hf, ts = yuan_ckpt()
+    save_file({k: np.asarray(v) for k, v in ts},
+              os.path.join(tmp_path, "model.safetensors"))
+    json.dump(hf, open(os.path.join(tmp_path, "config.json"), "w"))
+    from bigdl_tpu.transformers import AutoModelForCausalLM
+
+    with pytest.raises(ValueError, match="recurrent"):
+        AutoModelForCausalLM.from_pretrained(str(tmp_path),
+                                             load_in_4bit=True,
+                                             speculative=True)

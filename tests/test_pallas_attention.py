@@ -1,0 +1,97 @@
+"""Fused decode-attention kernel vs the XLA reference (interpret mode on
+CPU; the same kernel compiles for real on TPU via the auto dispatch)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.config import set_flags
+from bigdl_tpu.ops.attention import sdp_attention
+from bigdl_tpu.ops.pallas.decode_attention import (
+    decode_attention_pallas, decode_attention_supported)
+
+
+def _mk(b, s, h, hkv, hd, seed=0, kv_dtype=jnp.bfloat16):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, 1, h, hd)).astype(np.float32),
+                    jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, hd)).astype(np.float32),
+                    kv_dtype)
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, hd)).astype(np.float32),
+                    kv_dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("h,hkv,hd", [(8, 8, 64), (8, 2, 64), (4, 1, 128)])
+def test_matches_xla(h, hkv, hd):
+    q, k, v = _mk(2, 128, h, hkv, hd)
+    pos = jnp.asarray(37, jnp.int32)
+    try:
+        set_flags(attention_backend="xla")
+        ref = sdp_attention(q, k, v, pos)
+    finally:
+        set_flags(attention_backend="auto")
+    got = decode_attention_pallas(q, k, v, pos, hd ** -0.5, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_per_slot_positions():
+    q, k, v = _mk(3, 128, 4, 4, 64, seed=1)
+    pos = jnp.asarray([5, 60, 127], jnp.int32)
+    try:
+        set_flags(attention_backend="xla")
+        ref = sdp_attention(q, k, v, pos)
+    finally:
+        set_flags(attention_backend="auto")
+    got = decode_attention_pallas(q, k, v, pos, 64 ** -0.5, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_fp8_kv():
+    q, k, v = _mk(1, 128, 4, 2, 64, seed=2, kv_dtype=jnp.float8_e5m2)
+    pos = jnp.asarray(100, jnp.int32)
+    try:
+        set_flags(attention_backend="xla")
+        ref = sdp_attention(q, k, v, pos)
+    finally:
+        set_flags(attention_backend="auto")
+    got = decode_attention_pallas(q, k, v, pos, 64 ** -0.5, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=6e-2, atol=6e-2)
+
+
+def test_mask_strictness():
+    """Keys beyond pos must have exactly zero influence."""
+    q, k, v = _mk(1, 128, 2, 2, 64, seed=3)
+    pos = jnp.asarray(10, jnp.int32)
+    out1 = decode_attention_pallas(q, k, v, pos, 64 ** -0.5, interpret=True)
+    # poison the tail — result must not move
+    k2 = k.at[:, 11:].set(100.0)
+    v2 = v.at[:, 11:].set(-100.0)
+    out2 = decode_attention_pallas(q, k2, v2, pos, 64 ** -0.5,
+                                   interpret=True)
+    np.testing.assert_allclose(np.asarray(out1, np.float32),
+                               np.asarray(out2, np.float32), rtol=1e-5)
+
+
+def test_supported_gate():
+    q, k, v = _mk(1, 128, 4, 2, 64)
+    pos = jnp.asarray(0, jnp.int32)
+    assert decode_attention_supported(q, k, v, pos, 0.125, None, None, None)
+    # prefill, softcap, bad S, alibi -> fallback
+    q2 = jnp.zeros((1, 4, 4, 64), jnp.bfloat16)
+    assert not decode_attention_supported(q2, k, v, pos, 0.125, None, None,
+                                          None)
+    assert not decode_attention_supported(q, k, v, pos, 0.125, 50.0, None,
+                                          None)
+    k3 = jnp.zeros((1, 100, 2, 64), jnp.bfloat16)
+    assert not decode_attention_supported(q, k3, v, pos, 0.125, None, None,
+                                          None)
+    assert not decode_attention_supported(q, k, v, pos, 0.125, None, None,
+                                          jnp.ones((4,)))
