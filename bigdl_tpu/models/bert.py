@@ -125,7 +125,71 @@ def mean_pool(last_hidden: jax.Array, attention_mask: jax.Array) -> jax.Array:
             / jnp.maximum(jnp.sum(m, axis=1), 1e-9))
 
 
-# -- conversion ---------------------------------------------------------------
+# -- task heads (the bert-based Auto classes, reference transformers/
+#    model.py:704-725: SequenceClassification / TokenClassification /
+#    QuestionAnswering / MaskedLM / NextSentencePrediction / MultipleChoice)
+
+
+def sequence_logits(params, cfg, input_ids, attention_mask=None,
+                    token_type_ids=None, compute_dtype=jnp.bfloat16):
+    """[B, num_labels] classification logits (pooled CLS -> classifier)."""
+    _, pooled = forward(params, cfg, input_ids, attention_mask,
+                        token_type_ids, compute_dtype)
+    return linear(pooled, params["head_classifier"],
+                  params.get("head_classifier_bias")).astype(jnp.float32)
+
+
+def token_logits(params, cfg, input_ids, attention_mask=None,
+                 token_type_ids=None, compute_dtype=jnp.bfloat16):
+    """[B, S, num_labels] per-token classification logits."""
+    hidden, _ = forward(params, cfg, input_ids, attention_mask,
+                        token_type_ids, compute_dtype)
+    return linear(hidden, params["head_classifier"],
+                  params.get("head_classifier_bias")).astype(jnp.float32)
+
+
+def qa_logits(params, cfg, input_ids, attention_mask=None,
+              token_type_ids=None, compute_dtype=jnp.bfloat16):
+    """(start_logits [B, S], end_logits [B, S])."""
+    hidden, _ = forward(params, cfg, input_ids, attention_mask,
+                        token_type_ids, compute_dtype)
+    se = linear(hidden, params["head_qa"],
+                params.get("head_qa_bias")).astype(jnp.float32)
+    return se[..., 0], se[..., 1]
+
+
+def mlm_logits(params, cfg, input_ids, attention_mask=None,
+               token_type_ids=None, compute_dtype=jnp.bfloat16):
+    """[B, S, V] masked-LM logits (transform + LN + tied decoder)."""
+    hidden, _ = forward(params, cfg, input_ids, attention_mask,
+                        token_type_ids, compute_dtype)
+    h = jax.nn.gelu(linear(hidden, params["mlm_transform"],
+                           params.get("mlm_transform_bias")),
+                    approximate=False)
+    h = layer_norm(h, params["mlm_norm"], params.get("mlm_norm_bias"),
+                   cfg.layer_norm_eps)
+    dec = params.get("mlm_decoder")
+    if dec is None:                          # tied to word embeddings
+        logits = jnp.dot(h, params["word_embeddings"].T.astype(h.dtype),
+                         preferred_element_type=jnp.float32)
+    else:
+        logits = linear(h, dec)
+    logits = logits.astype(jnp.float32)
+    if "mlm_decoder_bias" in params:
+        logits = logits + params["mlm_decoder_bias"].astype(jnp.float32)
+    return logits
+
+
+def nsp_logits(params, cfg, input_ids, attention_mask=None,
+               token_type_ids=None, compute_dtype=jnp.bfloat16):
+    """[B, 2] next-sentence-prediction logits."""
+    _, pooled = forward(params, cfg, input_ids, attention_mask,
+                        token_type_ids, compute_dtype)
+    return linear(pooled, params["head_nsp"],
+                  params.get("head_nsp_bias")).astype(jnp.float32)
+
+
+# -- conversion (shared Acc engine, models/convert_base.py) ------------------
 
 _LAYER_MAP = {
     "attention.self.query": ("q_proj", True),
@@ -138,6 +202,62 @@ _LAYER_MAP = {
     "output.LayerNorm": ("out_norm", False),
 }
 
+# embeddings/norm-like tensors stored as-is in the top-level tree
+_TOP_DENSE = {
+    "embeddings.word_embeddings.weight": "word_embeddings",
+    "embeddings.position_embeddings.weight": "position_embeddings",
+    "embeddings.token_type_embeddings.weight": "token_type_embeddings",
+    "embeddings.LayerNorm.weight": "embed_norm",
+    "embeddings.LayerNorm.bias": "embed_norm_bias",
+    "pooler.dense.bias": "pooler_bias",
+    "classifier.bias": "head_classifier_bias",
+    "qa_outputs.bias": "head_qa_bias",
+    "cls.predictions.transform.dense.bias": "mlm_transform_bias",
+    "cls.predictions.transform.LayerNorm.weight": "mlm_norm",
+    "cls.predictions.transform.LayerNorm.bias": "mlm_norm_bias",
+    "cls.predictions.bias": "mlm_decoder_bias",
+    "cls.predictions.decoder.bias": "mlm_decoder_bias",
+    "cls.seq_relationship.bias": "head_nsp_bias",
+}
+
+# task heads kept dense-transposed (tiny, accuracy-critical); quantizable
+# projections go through acc.linear
+_TOP_LINEAR = {
+    "pooler.dense.weight": ("pooler", True),
+    "cls.predictions.transform.dense.weight": ("mlm_transform", True),
+    "cls.predictions.decoder.weight": ("mlm_decoder", True),
+    "classifier.weight": ("head_classifier", False),
+    "qa_outputs.weight": ("head_qa", False),
+    "cls.seq_relationship.weight": ("head_nsp", False),
+}
+
+
+def _bert_map(acc, name: str, w) -> None:
+    n = name[len("bert."):] if name.startswith("bert.") else name
+    if n in _TOP_DENSE:
+        acc.top[_TOP_DENSE[n]] = acc.dense(w)
+    elif n in _TOP_LINEAR:
+        key, quantize = _TOP_LINEAR[n]
+        acc.top[key] = (acc.linear(name, w) if quantize
+                        else jnp.asarray(np.asarray(w)).T.astype(
+                            acc.compute_dtype))
+    elif n.startswith("encoder.layer."):
+        parts = n.split(".")
+        idx = int(parts[2])
+        sub = ".".join(parts[3:-1])
+        leaf = parts[-1]
+        hit = _LAYER_MAP.get(sub)
+        if hit is None:
+            return
+        key, is_lin = hit
+        if is_lin and leaf == "weight":
+            acc.put(key, idx, acc.linear(name, w))
+        elif is_lin:
+            acc.put(f"{key}_bias", idx, acc.dense(w))
+        else:
+            acc.put(key if leaf == "weight" else f"{key}_bias", idx,
+                    acc.dense(w))
+
 
 def convert_hf_params(
     tensors,
@@ -147,65 +267,8 @@ def convert_hf_params(
     modules_to_not_convert: Tuple[str, ...] = (),
     imatrix=None,
 ) -> Dict[str, Any]:
-    from bigdl_tpu.imatrix import imatrix_lookup, low_bit_policy
-    from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize_linear
+    from bigdl_tpu.models.convert_base import make_convert
 
-    do_quant = qtype is not None and qtype not in FLOAT_QTYPES
-
-    def cvt_linear(name, w):
-        w = jnp.asarray(np.asarray(w))
-        if do_quant and not any(m in name for m in modules_to_not_convert):
-            qw = imatrix_lookup(imatrix, name)
-            if qw is not None and len(qw) != w.shape[1]:
-                qw = None
-            return quantize_linear(w, low_bit_policy(qtype, name), qw=qw)
-        return w.T.astype(compute_dtype)
-
-    dense = lambda w: jnp.asarray(np.asarray(w)).astype(compute_dtype)
-
-    top: Dict[str, Any] = {}
-    acc: Dict[str, list] = {}
-    L = cfg.num_hidden_layers
-
-    def put(key, idx, val):
-        acc.setdefault(key, [None] * L)[idx] = val
-
-    for name, w in tensors:
-        n = name[len("bert."):] if name.startswith("bert.") else name
-        if n == "embeddings.word_embeddings.weight":
-            top["word_embeddings"] = dense(w)
-        elif n == "embeddings.position_embeddings.weight":
-            top["position_embeddings"] = dense(w)
-        elif n == "embeddings.token_type_embeddings.weight":
-            top["token_type_embeddings"] = dense(w)
-        elif n == "embeddings.LayerNorm.weight":
-            top["embed_norm"] = dense(w)
-        elif n == "embeddings.LayerNorm.bias":
-            top["embed_norm_bias"] = dense(w)
-        elif n == "pooler.dense.weight":
-            top["pooler"] = cvt_linear(name, w)
-        elif n == "pooler.dense.bias":
-            top["pooler_bias"] = dense(w)
-        elif n.startswith("encoder.layer."):
-            parts = n.split(".")
-            idx = int(parts[2])
-            sub = ".".join(parts[3:-1])
-            leaf = parts[-1]
-            hit = _LAYER_MAP.get(sub)
-            if hit is None:
-                continue
-            key, is_lin = hit
-            if is_lin and leaf == "weight":
-                put(key, idx, cvt_linear(name, w))
-            elif is_lin:
-                put(f"{key}_bias", idx, dense(w))
-            else:
-                put(key if leaf == "weight" else f"{key}_bias", idx,
-                    dense(w))
-
-    missing = [k for k, v in acc.items() if any(x is None for x in v)]
-    if missing:
-        raise ValueError(f"bert checkpoint missing layer tensors: {missing}")
-    top["layers"] = {k: jax.tree.map(lambda *xs: jnp.stack(xs), *v)
-                     for k, v in acc.items()}
-    return top
+    return make_convert(_bert_map, lm_head_required=False)(
+        tensors, cfg, qtype=qtype, compute_dtype=compute_dtype,
+        modules_to_not_convert=modules_to_not_convert, imatrix=imatrix)

@@ -75,7 +75,8 @@ class Acc:
     def put(self, key: str, idx: int, val):
         self.layers.setdefault(key, [None] * self.L)[idx] = val
 
-    def finish(self, tie: bool) -> Dict[str, Any]:
+    def finish(self, tie: bool, lm_head_required: bool = True
+               ) -> Dict[str, Any]:
         missing = [k for k, v in self.layers.items()
                    if any(x is None for x in v)]
         if missing:
@@ -87,17 +88,19 @@ class Acc:
         }
         if tie:
             params.pop("lm_head", None)
-        elif "lm_head" not in params:
+        elif lm_head_required and "lm_head" not in params:
             raise ValueError("checkpoint has no lm_head and embeddings are "
                              "not tied")
         return params
 
 
-def make_convert(map_tensor: Callable) -> Callable:
+def make_convert(map_tensor: Callable,
+                 lm_head_required: bool = True) -> Callable:
     """Build a convert_hf_params from a per-tensor mapping callback.
 
     map_tensor(acc, name, w) handles one HF tensor (calls acc.put /
-    acc.top). Unknown tensors are ignored (rotary inv_freq etc.)."""
+    acc.top). Unknown tensors are ignored (rotary inv_freq etc.).
+    lm_head_required=False serves headless encoders (bert)."""
 
     def convert(tensors, cfg, qtype="sym_int4", compute_dtype=jnp.bfloat16,
                 modules_to_not_convert: Tuple[str, ...] = (),
@@ -109,7 +112,8 @@ def make_convert(map_tensor: Callable) -> Callable:
         for name, w in tensors:
             map_tensor(acc, name,
                        w if isinstance(w, QTensor) else np.asarray(w))
-        return acc.finish(cfg.tie_word_embeddings)
+        return acc.finish(getattr(cfg, "tie_word_embeddings", False),
+                          lm_head_required=lm_head_required)
 
     return convert
 

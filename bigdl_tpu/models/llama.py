@@ -257,6 +257,13 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
 
 def _mlp(hidden, lp, cfg: LlamaConfig, record=None):
     if "router" in lp:
+        if record is not None:
+            # silent no-stats would quietly degrade every expert weight
+            # to unweighted quantization — the bulk of an MoE model
+            raise NotImplementedError(
+                "imatrix collection over MoE expert MLPs is not supported "
+                "yet; quantize MoE models without an imatrix (attention "
+                "projections would be the only weighted tensors)")
         return _moe_mlp(hidden, lp, cfg)
     act = _ACTS[cfg.hidden_act]
     if record is not None:

@@ -101,11 +101,17 @@ def _lfa_prefill(xn, lp, eps):
     return layer_norm(out + xn, lp["lf_norm"], lp["lf_norm_bias"], eps)
 
 
-def _lfa_decode(x1, hist, lp, eps):
-    """One-token filter from the [B, 2, D] history. x1 [B, 1, D] f32."""
+def _lfa_decode(x1, hist, lp, eps, pos):
+    """One-token filter from the [B, 2, D] history. x1 [B, 1, D] f32.
+
+    `pos` = tokens already consumed. The prefill path's shifted sequence
+    has an exact ZERO for c1_{-1} (no conv bias); with an empty history
+    the naive conv of zeros would inject the bias, so c1_prev is masked
+    out until a real t-1 exists (pos >= 1)."""
     h0, h1 = hist[:, 0], hist[:, 1]
     x = x1[:, 0]
     c1_prev = _conv_tap(h0, h1, lp["lf_conv1"], lp["lf_conv1_bias"])
+    c1_prev = jnp.where(pos >= 1, c1_prev, 0.0)
     c1_cur = _conv_tap(h1, x, lp["lf_conv1"], lp["lf_conv1_bias"])
     out = _conv_tap(c1_prev, c1_cur, lp["lf_conv2"], lp["lf_conv2_bias"])
     lf = layer_norm((out + x)[:, None, :], lp["lf_norm"],
@@ -120,7 +126,7 @@ def _layer(x, lp, cfg, cos, sin, ck, cv, lidx, pos, hist):
 
     hidden = rms_norm(x, lp["input_layernorm"], eps).astype(jnp.float32)
     if sq == 1:
-        lf = _lfa_decode(hidden, hist, lp, eps)
+        lf = _lfa_decode(hidden, hist, lp, eps, pos)
         new_hist = jnp.concatenate([hist[:, 1:], hidden], axis=1)
     else:
         lf = _lfa_prefill(hidden, lp, eps)

@@ -28,30 +28,34 @@ import functools
 
 
 @functools.lru_cache(maxsize=None)
-def _decode_kernel_compiles() -> bool:
-    """One-time eager probe: does the Pallas decode kernel compile on this
-    backend? Runs a tiny concrete call OUTSIDE any trace (probing inside
-    jit would surface Mosaic errors at the outer compile, where they can't
-    be caught). Auto mode consults this; pallas mode bypasses it so forced
-    runs still raise their real error."""
+def _decode_kernel_compiles(h: int, hkv: int, hd: int, s: int,
+                            kv_dtype_name: str) -> bool:
+    """Eager probe, cached PER SHAPE: does the Pallas decode kernel compile
+    for this attention geometry? Mosaic failures can be shape-dependent,
+    and a failure inside a model's outer jit is uncatchable — so the probe
+    runs the exact geometry as a tiny concrete call OUTSIDE any trace.
+    Auto mode consults this; pallas mode bypasses it so forced runs still
+    raise their real error."""
     try:
         import numpy as _np
 
         from bigdl_tpu.ops.pallas.decode_attention import (
             decode_attention_pallas)
 
-        q = jnp.zeros((1, 1, 8, 128), jnp.bfloat16)
-        kv = jnp.zeros((1, 128, 8, 128), jnp.bfloat16)
+        kdt = jnp.dtype(kv_dtype_name)
+        q = jnp.zeros((1, 1, h, hd), jnp.bfloat16)
+        kv = jnp.zeros((1, s, hkv, hd), kdt)
         out = decode_attention_pallas(q, kv, kv, jnp.asarray(0, jnp.int32),
-                                      0.1)
+                                      hd ** -0.5)
         _np.asarray(out)
         return True
     except Exception as e:
         import logging
 
         logging.getLogger(__name__).warning(
-            "fused decode-attention kernel unavailable (%s: %s); using the "
-            "XLA path for this process", type(e).__name__, e)
+            "fused decode-attention kernel unavailable for shape "
+            "(H=%d, Hkv=%d, hd=%d, S=%d, %s) — %s: %s; using the XLA path",
+            h, hkv, hd, s, kv_dtype_name, type(e).__name__, e)
         return False
 
 
@@ -94,7 +98,8 @@ def sdp_attention(
         if supported and be == "pallas":
             return decode_attention_pallas(q, k, v, q_pos, float(scale),
                                            interpret=not on_tpu)
-        if supported and on_tpu and _decode_kernel_compiles():
+        if supported and on_tpu and _decode_kernel_compiles(
+                h, hkv, d, skv, str(k.dtype)):
             return decode_attention_pallas(q, k, v, q_pos, float(scale))
 
     qf = q.reshape(b, sq, hkv, g, d).astype(jnp.bfloat16)

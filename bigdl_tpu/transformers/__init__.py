@@ -13,3 +13,12 @@ from bigdl_tpu.transformers.seq2seq import (  # noqa: F401
     AutoModelForSpeechSeq2Seq,
     TpuSpeechSeq2Seq,
 )
+from bigdl_tpu.transformers.bert_heads import (  # noqa: F401
+    AutoModelForMaskedLM,
+    AutoModelForMultipleChoice,
+    AutoModelForNextSentencePrediction,
+    AutoModelForQuestionAnswering,
+    AutoModelForSequenceClassification,
+    AutoModelForTokenClassification,
+)
+from bigdl_tpu.transformers.embedder import BertEmbedder  # noqa: F401

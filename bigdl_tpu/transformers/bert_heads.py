@@ -1,0 +1,139 @@
+"""Bert-head Auto classes: the remaining facades of the reference's
+ten-class Auto surface (reference transformers/model.py:704-725 —
+SequenceClassification, TokenClassification, QuestionAnswering, MaskedLM,
+NextSentencePrediction, MultipleChoice). Each loads a (possibly
+quantized) bert encoder + its task head and exposes a jitted forward.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.models import bert as B
+from bigdl_tpu.ops.quant import FLOAT_QTYPES
+from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
+
+
+class _BertTaskModel:
+    """Shared loader + jitted head dispatch."""
+
+    HEAD_FN = None                    # staticmethod in subclasses
+    ACCEPT_ARCHS: tuple = ()
+    REQUIRED_KEYS: tuple = ()         # head params that must exist at load
+
+    def __init__(self, params: Any, cfg: B.BertConfig,
+                 hf_config: Dict[str, Any], qtype: Optional[str]):
+        self.params = params
+        self.config = cfg
+        self.hf_config = hf_config
+        self.qtype = qtype
+        self._fwd = jax.jit(type(self).HEAD_FN, static_argnums=(1,))
+
+    def _ids(self, input_ids, attention_mask, token_type_ids):
+        ids = jnp.asarray(np.asarray(input_ids, np.int32))
+        if ids.ndim == 1:
+            ids = ids[None]
+        am = (None if attention_mask is None
+              else jnp.asarray(np.asarray(attention_mask, np.int32)))
+        tt = (None if token_type_ids is None
+              else jnp.asarray(np.asarray(token_type_ids, np.int32)))
+        return ids, am, tt
+
+    def forward(self, input_ids, attention_mask=None, token_type_ids=None):
+        ids, am, tt = self._ids(input_ids, attention_mask, token_type_ids)
+        out = self._fwd(self.params, self.config, ids, am, tt)
+        return jax.tree.map(np.asarray, out)
+
+    __call__ = forward
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        pretrained_model_name_or_path: str,
+        load_in_4bit: bool = False,
+        load_in_low_bit: Optional[str] = None,
+        modules_to_not_convert=(),
+        **_ignored,
+    ):
+        from bigdl_tpu.transformers.model import _resolve_qtype
+
+        path = pretrained_model_name_or_path
+        hf_config = load_hf_config(path)
+        archs = tuple(hf_config.get("architectures") or ("?",))
+        if cls.ACCEPT_ARCHS and archs[0] not in cls.ACCEPT_ARCHS:
+            raise ValueError(
+                f"{cls.__name__} supports {cls.ACCEPT_ARCHS}; "
+                f"got {archs[0]!r}")
+        qtype = _resolve_qtype(load_in_4bit, load_in_low_bit)
+        cfg = B.BertConfig.from_hf(hf_config)
+        cvt_qtype = None if qtype in FLOAT_QTYPES else qtype
+        params = B.convert_hf_params(
+            iter_hf_tensors(path), cfg, qtype=cvt_qtype,
+            modules_to_not_convert=tuple(modules_to_not_convert))
+        missing = [k for k in cls.REQUIRED_KEYS if k not in params]
+        if missing:
+            raise ValueError(
+                f"checkpoint at {path} has no {missing} tensors — "
+                f"{cls.__name__} needs a checkpoint saved WITH its task "
+                f"head (architectures={archs})")
+        return cls(params, cfg, hf_config, qtype)
+
+
+class AutoModelForSequenceClassification(_BertTaskModel):
+    HEAD_FN = staticmethod(B.sequence_logits)
+    ACCEPT_ARCHS = ("BertForSequenceClassification",)
+    REQUIRED_KEYS = ("head_classifier",)
+
+
+class AutoModelForTokenClassification(_BertTaskModel):
+    HEAD_FN = staticmethod(B.token_logits)
+    ACCEPT_ARCHS = ("BertForTokenClassification",)
+    REQUIRED_KEYS = ("head_classifier",)
+
+
+class AutoModelForQuestionAnswering(_BertTaskModel):
+    HEAD_FN = staticmethod(B.qa_logits)
+    ACCEPT_ARCHS = ("BertForQuestionAnswering",)
+    REQUIRED_KEYS = ("head_qa",)
+
+
+class AutoModelForMaskedLM(_BertTaskModel):
+    HEAD_FN = staticmethod(B.mlm_logits)
+    ACCEPT_ARCHS = ("BertForMaskedLM", "BertForPreTraining")
+    REQUIRED_KEYS = ("mlm_transform", "mlm_norm")
+
+
+class AutoModelForNextSentencePrediction(_BertTaskModel):
+    HEAD_FN = staticmethod(B.nsp_logits)
+    ACCEPT_ARCHS = ("BertForNextSentencePrediction", "BertForPreTraining")
+    REQUIRED_KEYS = ("head_nsp",)
+
+
+class AutoModelForMultipleChoice(_BertTaskModel):
+    """Choices fold into the batch: input [B, C, S] -> logits [B, C]."""
+
+    HEAD_FN = staticmethod(B.sequence_logits)
+    ACCEPT_ARCHS = ("BertForMultipleChoice",)
+    REQUIRED_KEYS = ("head_classifier",)
+
+    def forward(self, input_ids, attention_mask=None, token_type_ids=None):
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 2:
+            ids = ids[None]
+        b, c, s = ids.shape
+        flat = lambda x: (None if x is None
+                          else np.asarray(x, np.int32).reshape(b * c, s))
+        out = self._fwd(self.params, self.config,
+                        jnp.asarray(ids.reshape(b * c, s)),
+                        None if attention_mask is None
+                        else jnp.asarray(flat(attention_mask)),
+                        None if token_type_ids is None
+                        else jnp.asarray(flat(token_type_ids)))
+        return np.asarray(out).reshape(b, c)
+
+    __call__ = forward

@@ -2,46 +2,28 @@
 
 Reference analog: bert served through `optimize_model` +
 `TransformersEmbeddings` (reference transformers/models/bert.py:42-147;
-langchain/embeddings/bigdlllm.py). `BertEmbedder` is the loader +
+langchain/embeddings/bigdlllm.py). `BertEmbedder` shares the bert loader
+with the task-head Auto classes (transformers/bert_heads.py) and adds the
 `embed_texts` API the langchain/llamaindex integrations build on.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import List
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.models import bert as B
-from bigdl_tpu.ops.quant import FLOAT_QTYPES
-from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
-
-_BERT_ARCHS = ("BertModel", "BertForMaskedLM",
-               "BertForSequenceClassification")
+from bigdl_tpu.transformers.bert_heads import _BertTaskModel
 
 
-class BertEmbedder:
+class BertEmbedder(_BertTaskModel):
     """A loaded (possibly quantized) BERT + compiled embedding forward."""
 
-    def __init__(self, params: Any, cfg: B.BertConfig,
-                 hf_config: Dict[str, Any], qtype: Optional[str],
-                 model_path: Optional[str] = None):
-        self.params = params
-        self.config = cfg
-        self.hf_config = hf_config
-        self.qtype = qtype
-        self.model_path = model_path
-        self._fwd = jax.jit(B.forward, static_argnums=(1,))
-
-    def forward(self, input_ids, attention_mask=None):
-        ids = jnp.asarray(np.asarray(input_ids, np.int32))
-        if ids.ndim == 1:
-            ids = ids[None]
-        mask = (jnp.asarray(np.asarray(attention_mask, np.int32))
-                if attention_mask is not None else None)
-        return self._fwd(self.params, self.config, ids, mask)
+    HEAD_FN = staticmethod(B.forward)     # (last_hidden, pooled)
+    ACCEPT_ARCHS = ("BertModel", "BertForMaskedLM",
+                    "BertForSequenceClassification", "BertForPreTraining")
 
     def embed(self, input_ids, attention_mask=None,
               pooling: str = "mean") -> np.ndarray:
@@ -51,7 +33,9 @@ class BertEmbedder:
             ids = ids[None]
         if attention_mask is None:
             attention_mask = np.ones_like(ids)
-        hidden, pooled = self.forward(ids, attention_mask)
+        ids_j, am, _ = self._ids(ids, attention_mask, None)
+        hidden, pooled = self._fwd(self.params, self.config, ids_j, am,
+                                   None)
         if pooling == "cls":
             return np.asarray(pooled, np.float32)
         return np.asarray(B.mean_pool(hidden, jnp.asarray(attention_mask)))
@@ -68,28 +52,3 @@ class BertEmbedder:
             ids[i, :len(e)] = e
             mask[i, :len(e)] = 1
         return self.embed(ids, mask, pooling=pooling)
-
-    @classmethod
-    def from_pretrained(
-        cls,
-        pretrained_model_name_or_path: str,
-        load_in_4bit: bool = False,
-        load_in_low_bit: Optional[str] = None,
-        modules_to_not_convert=(),
-        **_ignored,
-    ) -> "BertEmbedder":
-        from bigdl_tpu.transformers.model import _resolve_qtype
-
-        path = pretrained_model_name_or_path
-        hf_config = load_hf_config(path)
-        archs = hf_config.get("architectures") or ["?"]
-        if archs[0] not in _BERT_ARCHS:
-            raise ValueError(
-                f"BertEmbedder supports {_BERT_ARCHS}; got {archs[0]!r}")
-        qtype = _resolve_qtype(load_in_4bit, load_in_low_bit)
-        cfg = B.BertConfig.from_hf(hf_config)
-        cvt_qtype = None if qtype in FLOAT_QTYPES else qtype
-        params = B.convert_hf_params(
-            iter_hf_tensors(path), cfg, qtype=cvt_qtype,
-            modules_to_not_convert=tuple(modules_to_not_convert))
-        return cls(params, cfg, hf_config, qtype, model_path=path)

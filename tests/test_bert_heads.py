@@ -1,0 +1,129 @@
+"""Bert-head Auto classes vs HF torch on shared tiny random weights."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import BertConfig as HFBertConfig  # noqa: E402
+
+D, FF, V, L, H = 64, 128, 96, 2, 4
+
+
+def _cfg(**kw):
+    return HFBertConfig(
+        vocab_size=V, hidden_size=D, num_hidden_layers=L,
+        num_attention_heads=H, intermediate_size=FF,
+        max_position_embeddings=64, type_vocab_size=2, **kw)
+
+
+IDS = np.array([[2, 7, 11, 13, 5], [3, 9, 4, 0, 0]], np.int32)
+MASK = np.array([[1, 1, 1, 1, 1], [1, 1, 1, 0, 0]], np.int32)
+
+
+def _t(x):
+    return torch.tensor(x.astype(np.int64))
+
+
+def test_sequence_classification(tmp_path):
+    from transformers import BertForSequenceClassification
+
+    torch.manual_seed(0)
+    ref = BertForSequenceClassification(_cfg(num_labels=3)).eval()
+    ref.save_pretrained(tmp_path)
+    with torch.no_grad():
+        want = ref(input_ids=_t(IDS), attention_mask=_t(MASK)).logits.numpy()
+
+    from bigdl_tpu.transformers import AutoModelForSequenceClassification
+
+    m = AutoModelForSequenceClassification.from_pretrained(str(tmp_path))
+    got = m(IDS, MASK)
+    np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
+    assert np.argmax(got, -1).tolist() == np.argmax(want, -1).tolist()
+
+
+def test_question_answering(tmp_path):
+    from transformers import BertForQuestionAnswering
+
+    torch.manual_seed(1)
+    ref = BertForQuestionAnswering(_cfg()).eval()
+    ref.save_pretrained(tmp_path)
+    with torch.no_grad():
+        out = ref(input_ids=_t(IDS), attention_mask=_t(MASK))
+        ws, we = out.start_logits.numpy(), out.end_logits.numpy()
+
+    from bigdl_tpu.transformers import AutoModelForQuestionAnswering
+
+    m = AutoModelForQuestionAnswering.from_pretrained(str(tmp_path))
+    gs, ge = m(IDS, MASK)
+    n = 3  # compare non-pad positions of row 1 and all of row 0
+    np.testing.assert_allclose(gs[0], ws[0], rtol=3e-2, atol=3e-2)
+    np.testing.assert_allclose(ge[1][:n], we[1][:n], rtol=3e-2, atol=3e-2)
+
+
+def test_masked_lm(tmp_path):
+    from transformers import BertForMaskedLM
+
+    torch.manual_seed(2)
+    ref = BertForMaskedLM(_cfg()).eval()
+    ref.save_pretrained(tmp_path)
+    with torch.no_grad():
+        want = ref(input_ids=_t(IDS), attention_mask=_t(MASK)).logits.numpy()
+
+    from bigdl_tpu.transformers import AutoModelForMaskedLM
+
+    m = AutoModelForMaskedLM.from_pretrained(str(tmp_path))
+    got = m(IDS, MASK)
+    np.testing.assert_allclose(got[0], want[0], rtol=4e-2, atol=4e-2)
+    assert np.argmax(got[0], -1).tolist() == np.argmax(want[0], -1).tolist()
+
+
+def test_token_classification_and_mc(tmp_path):
+    from transformers import (BertForMultipleChoice,
+                              BertForTokenClassification)
+
+    torch.manual_seed(3)
+    ref = BertForTokenClassification(_cfg(num_labels=5)).eval()
+    p1 = tmp_path / "tok"
+    ref.save_pretrained(p1)
+    with torch.no_grad():
+        want = ref(input_ids=_t(IDS), attention_mask=_t(MASK)).logits.numpy()
+
+    from bigdl_tpu.transformers import (AutoModelForMultipleChoice,
+                                        AutoModelForTokenClassification)
+
+    m = AutoModelForTokenClassification.from_pretrained(str(p1))
+    got = m(IDS, MASK)
+    np.testing.assert_allclose(got[0], want[0], rtol=3e-2, atol=3e-2)
+
+    torch.manual_seed(4)
+    ref2 = BertForMultipleChoice(_cfg()).eval()
+    p2 = tmp_path / "mc"
+    ref2.save_pretrained(p2)
+    choices = np.stack([IDS, IDS[:, ::-1]], axis=1)   # [B, 2, S]
+    cmask = np.stack([MASK, MASK], axis=1)
+    with torch.no_grad():
+        want2 = ref2(input_ids=_t(choices),
+                     attention_mask=_t(cmask)).logits.numpy()
+    m2 = AutoModelForMultipleChoice.from_pretrained(str(p2))
+    got2 = m2(choices, cmask)
+    np.testing.assert_allclose(got2, want2, rtol=3e-2, atol=3e-2)
+
+
+def test_quantized_head_runs(tmp_path):
+    from transformers import BertForSequenceClassification
+
+    torch.manual_seed(5)
+    BertForSequenceClassification(_cfg(num_labels=2)).eval().save_pretrained(
+        tmp_path)
+
+    from bigdl_tpu.transformers import AutoModelForSequenceClassification
+
+    m = AutoModelForSequenceClassification.from_pretrained(
+        str(tmp_path), load_in_4bit=True)
+    got = m(IDS, MASK)
+    assert got.shape == (2, 2) and np.isfinite(got).all()
+
+    with pytest.raises(ValueError, match="supports"):
+        from bigdl_tpu.transformers import AutoModelForQuestionAnswering
+
+        AutoModelForQuestionAnswering.from_pretrained(str(tmp_path))

@@ -88,12 +88,15 @@ def yuan_ckpt():
                (p + "self_attn.k_proj.weight", t(rng, D, D)),
                (p + "self_attn.v_proj.weight", t(rng, D, D)),
                (p + "self_attn.o_proj.weight", t(rng, D, D)),
+               # unit-ish conv scales + big biases: the first-token decode
+               # path must mask the phantom c1_{-1} bias (a tiny-scale
+               # checkpoint would hide that divergence under tolerance)
                (p + "self_attn.lf_gate.conv1.weight",
-                t(rng, D, D, 2, 1, scale=0.02)),
-               (p + "self_attn.lf_gate.conv1.bias", t(rng, D)),
+                t(rng, D, D, 2, 1, scale=0.1)),
+               (p + "self_attn.lf_gate.conv1.bias", t(rng, D, scale=0.5)),
                (p + "self_attn.lf_gate.conv2.weight",
-                t(rng, D, D, 2, 1, scale=0.02)),
-               (p + "self_attn.lf_gate.conv2.bias", t(rng, D)),
+                t(rng, D, D, 2, 1, scale=0.1)),
+               (p + "self_attn.lf_gate.conv2.bias", t(rng, D, scale=0.5)),
                (p + "self_attn.lf_gate.output_layernorm.weight",
                 np.ones((D,), np.float32)),
                (p + "self_attn.lf_gate.output_layernorm.bias",
