@@ -10,7 +10,9 @@ from bigdl_tpu.transformers.lowbit_io import (  # noqa: F401
     save_low_bit,
 )
 from bigdl_tpu.transformers.seq2seq import (  # noqa: F401
+    AutoModelForSeq2SeqLM,
     AutoModelForSpeechSeq2Seq,
+    TpuSeq2SeqLM,
     TpuSpeechSeq2Seq,
 )
 from bigdl_tpu.transformers.bert_heads import (  # noqa: F401

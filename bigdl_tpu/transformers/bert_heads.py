@@ -81,7 +81,9 @@ class _BertTaskModel:
                 f"checkpoint at {path} has no {missing} tensors — "
                 f"{cls.__name__} needs a checkpoint saved WITH its task "
                 f"head (architectures={archs})")
-        return cls(params, cfg, hf_config, qtype)
+        model = cls(params, cfg, hf_config, qtype)
+        model.model_path = path
+        return model
 
 
 class AutoModelForSequenceClassification(_BertTaskModel):

@@ -25,6 +25,15 @@ class BertEmbedder(_BertTaskModel):
     ACCEPT_ARCHS = ("BertModel", "BertForMaskedLM",
                     "BertForSequenceClassification", "BertForPreTraining")
 
+    def forward(self, input_ids, attention_mask=None, token_type_ids=None):
+        """(last_hidden, pooled) as JAX arrays (unlike the task heads,
+        which return numpy — downstream embedding code often keeps
+        computing on device)."""
+        ids, am, tt = self._ids(input_ids, attention_mask, token_type_ids)
+        return self._fwd(self.params, self.config, ids, am, tt)
+
+    __call__ = forward
+
     def embed(self, input_ids, attention_mask=None,
               pooling: str = "mean") -> np.ndarray:
         """Sentence embeddings [B, D] (pooling: "mean" | "cls")."""

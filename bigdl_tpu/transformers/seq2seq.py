@@ -90,6 +90,116 @@ class TpuSpeechSeq2Seq:
         return np.concatenate([ids, np.stack(out, axis=1)], axis=1)
 
 
+class TpuSeq2SeqLM:
+    """A loaded (possibly quantized) BART-family text seq2seq model."""
+
+    def __init__(self, params: Any, cfg, hf_config: Dict[str, Any],
+                 qtype: Optional[str], model_path: Optional[str] = None):
+        from bigdl_tpu.models import bart as Bt
+
+        self.params = params
+        self.config = cfg
+        self.hf_config = hf_config
+        self.qtype = qtype
+        self.model_path = model_path
+        self._encode = jax.jit(Bt.encode, static_argnums=(1,))
+        self._decode = jax.jit(Bt.decode_step, static_argnums=(1,),
+                               donate_argnums=(3,))
+        self._init_cache = jax.jit(Bt.init_decoder_cache,
+                                   static_argnums=(1, 3, 4))
+
+    def generate(
+        self,
+        input_ids,                        # [B, S] source tokens
+        attention_mask=None,              # [B, S] 1=real (source padding)
+        decoder_input_ids=None,
+        max_new_tokens: int = 128,
+        eos_token_id: Optional[int] = None,
+        **_ignored,
+    ) -> np.ndarray:
+        """Greedy seq2seq generation. Returns [B, forced + new] ids."""
+        cfg = self.config
+        src = np.asarray(input_ids, np.int32)
+        if src.ndim == 1:
+            src = src[None]
+        mask = (None if attention_mask is None
+                else jnp.asarray(np.asarray(attention_mask, np.int32)))
+        enc_out = self._encode(self.params, cfg, jnp.asarray(src), mask)
+        b = src.shape[0]
+        if decoder_input_ids is None:
+            decoder_input_ids = np.full((b, 1), cfg.decoder_start_token_id,
+                                        np.int32)
+        ids = np.asarray(decoder_input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        eos = cfg.eos_token_id if eos_token_id is None else eos_token_id
+        if ids.shape[1] + max_new_tokens > cfg.max_position_embeddings:
+            raise ValueError(
+                f"forced ({ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_position_embeddings "
+                f"({cfg.max_position_embeddings})")
+        if max_new_tokens <= 0:
+            return ids
+        cache = self._init_cache(self.params, cfg, enc_out,
+                                 ids.shape[1] + max_new_tokens, False, mask)
+        logits, cache = self._decode(self.params, cfg, jnp.asarray(ids),
+                                     cache)
+        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        out = [np.asarray(tok)]
+        finished = out[0] == eos
+        for _ in range(max_new_tokens - 1):
+            if finished.all():
+                break
+            logits, cache = self._decode(self.params, cfg, tok[:, None],
+                                         cache)
+            tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            t = np.where(finished, eos, np.asarray(tok))
+            out.append(t)
+            finished |= t == eos
+        return np.concatenate([ids, np.stack(out, axis=1)], axis=1)
+
+
+class AutoModelForSeq2SeqLM:
+    """Text encoder-decoder facade (the reference's tenth Auto class,
+    transformers/model.py:701). BART-family checkpoints."""
+
+    _ARCHS = ("BartForConditionalGeneration",)
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        pretrained_model_name_or_path: str,
+        load_in_4bit: bool = False,
+        load_in_low_bit: Optional[str] = None,
+        modules_to_not_convert=(),
+        imatrix=None,
+        **_ignored,
+    ) -> TpuSeq2SeqLM:
+        from bigdl_tpu.models import bart as Bt
+        from bigdl_tpu.transformers.model import _resolve_qtype
+
+        path = pretrained_model_name_or_path
+        hf_config = load_hf_config(path)
+        archs = hf_config.get("architectures") or ["?"]
+        if archs[0] not in cls._ARCHS:
+            raise ValueError(
+                f"AutoModelForSeq2SeqLM supports {cls._ARCHS}; got "
+                f"{archs[0]!r} (whisper loads via "
+                "AutoModelForSpeechSeq2Seq)")
+        qtype = _resolve_qtype(load_in_4bit, load_in_low_bit)
+        cfg = Bt.BartConfig.from_hf(hf_config)
+        if isinstance(imatrix, str):
+            from bigdl_tpu.imatrix import load_imatrix
+
+            imatrix = load_imatrix(imatrix)
+        cvt_qtype = None if qtype in FLOAT_QTYPES else qtype
+        params = Bt.convert_hf_params(
+            iter_hf_tensors(path), cfg, qtype=cvt_qtype,
+            modules_to_not_convert=tuple(modules_to_not_convert),
+            imatrix=imatrix)
+        return TpuSeq2SeqLM(params, cfg, hf_config, qtype, model_path=path)
+
+
 class AutoModelForSpeechSeq2Seq:
     """from_pretrained with the reference's low-bit kwargs (whisper)."""
 

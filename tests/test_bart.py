@@ -1,0 +1,179 @@
+"""BART seq2seq: HF numerical equivalence + quantized generation."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+from transformers import BartConfig as HFBartConfig  # noqa: E402
+from transformers import BartForConditionalGeneration  # noqa: E402
+
+TINY = dict(
+    vocab_size=128,
+    d_model=32,
+    encoder_layers=2,
+    decoder_layers=2,
+    encoder_attention_heads=4,
+    decoder_attention_heads=4,
+    encoder_ffn_dim=64,
+    decoder_ffn_dim=64,
+    max_position_embeddings=64,
+    activation_function="gelu",
+    scale_embedding=False,
+    decoder_start_token_id=2,
+    eos_token_id=2,
+    bos_token_id=0,
+    pad_token_id=1,
+    forced_eos_token_id=None,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_bart(tmp_path_factory):
+    torch.manual_seed(0)
+    model = BartForConditionalGeneration(HFBartConfig(**TINY)).eval()
+    path = tmp_path_factory.mktemp("tiny_bart")
+    model.save_pretrained(path)
+    return str(path), model
+
+
+SRC = np.array([[0, 17, 23, 31, 7, 2]], np.int32)
+DEC = np.array([[2, 0, 15, 9]], np.int32)
+
+
+def test_logits_match_hf(tiny_bart):
+    path, ref = tiny_bart
+    from bigdl_tpu.models import bart as Bt
+    from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
+
+    cfg = Bt.BartConfig.from_hf(load_hf_config(path))
+    params = Bt.convert_hf_params(iter_hf_tensors(path), cfg, qtype=None,
+                                  compute_dtype=jnp.float32)
+    with torch.no_grad():
+        want = ref(input_ids=torch.tensor(SRC.astype(np.int64)),
+                   decoder_input_ids=torch.tensor(DEC.astype(np.int64))
+                   ).logits.numpy()
+
+    enc = Bt.encode(params, cfg, jnp.asarray(SRC),
+                    compute_dtype=jnp.float32)
+    cache = Bt.init_decoder_cache(params, cfg, enc, 16)
+    logits, _ = Bt.decode_step(params, cfg, jnp.asarray(DEC), cache,
+                               compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_decode_matches_prefill(tiny_bart):
+    path, _ = tiny_bart
+    from bigdl_tpu.transformers import AutoModelForSeq2SeqLM
+    from bigdl_tpu.models import bart as Bt
+
+    m = AutoModelForSeq2SeqLM.from_pretrained(path, load_in_4bit=True)
+    enc = m._encode(m.params, m.config, jnp.asarray(SRC))
+
+    cache = Bt.init_decoder_cache(m.params, m.config, enc, 16)
+    full, _ = Bt.decode_step(m.params, m.config, jnp.asarray(DEC), cache)
+
+    cache = Bt.init_decoder_cache(m.params, m.config, enc, 16)
+    steps = []
+    for i in range(DEC.shape[1]):
+        lg, cache = Bt.decode_step(m.params, m.config,
+                                   jnp.asarray(DEC[:, i:i + 1]), cache)
+        steps.append(np.asarray(lg[:, 0]))
+    np.testing.assert_allclose(np.asarray(full), np.stack(steps, 1),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_greedy_generate_matches_hf(tiny_bart):
+    path, ref = tiny_bart
+    from bigdl_tpu.transformers import AutoModelForSeq2SeqLM
+
+    m = AutoModelForSeq2SeqLM.from_pretrained(path)
+
+    with torch.no_grad():
+        ids = torch.tensor([[TINY["decoder_start_token_id"]]])
+        src = torch.tensor(SRC.astype(np.int64))
+        for _ in range(6):
+            lg = ref(input_ids=src, decoder_input_ids=ids).logits
+            ids = torch.cat([ids, lg[:, -1:].argmax(-1)], dim=1)
+    ref_ids = ids.numpy()[0]
+
+    ours = m.generate(SRC, max_new_tokens=6)[0]
+    n = min(len(ref_ids), len(ours))
+    stop = n
+    for j in range(1, n):
+        if ref_ids[j] == TINY["eos_token_id"]:
+            stop = j
+            break
+    np.testing.assert_array_equal(ours[:stop], ref_ids[:stop])
+
+
+def test_padded_batch_matches_hf(tiny_bart):
+    """A padded source with attention_mask must match HF exactly — pads
+    may not leak into encoder self- or decoder cross-attention."""
+    path, ref = tiny_bart
+    from bigdl_tpu.models import bart as Bt
+    from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
+
+    cfg = Bt.BartConfig.from_hf(load_hf_config(path))
+    params = Bt.convert_hf_params(iter_hf_tensors(path), cfg, qtype=None,
+                                  compute_dtype=jnp.float32)
+    src = np.array([[0, 17, 23, 2, 1, 1]], np.int32)    # 2 pads
+    mask = np.array([[1, 1, 1, 1, 0, 0]], np.int32)
+    with torch.no_grad():
+        want = ref(input_ids=torch.tensor(src.astype(np.int64)),
+                   attention_mask=torch.tensor(mask.astype(np.int64)),
+                   decoder_input_ids=torch.tensor(DEC.astype(np.int64))
+                   ).logits.numpy()
+    enc = Bt.encode(params, cfg, jnp.asarray(src), jnp.asarray(mask),
+                    compute_dtype=jnp.float32)
+    cache = Bt.init_decoder_cache(params, cfg, enc, 16,
+                                  src_mask=jnp.asarray(mask))
+    logits, _ = Bt.decode_step(params, cfg, jnp.asarray(DEC), cache,
+                               compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3,
+                               atol=2e-3)
+
+    # poisoned pads must not change the masked result
+    src2 = src.copy()
+    src2[0, 4:] = 99
+    enc2 = Bt.encode(params, cfg, jnp.asarray(src2), jnp.asarray(mask),
+                     compute_dtype=jnp.float32)
+    cache2 = Bt.init_decoder_cache(params, cfg, enc2, 16,
+                                   src_mask=jnp.asarray(mask))
+    logits2, _ = Bt.decode_step(params, cfg, jnp.asarray(DEC), cache2,
+                                compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits2), np.asarray(logits),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_encoder_length_guard(tiny_bart):
+    path, _ = tiny_bart
+    from bigdl_tpu.transformers import AutoModelForSeq2SeqLM
+
+    m = AutoModelForSeq2SeqLM.from_pretrained(path, load_in_4bit=True)
+    with pytest.raises(ValueError, match="source length"):
+        m.generate(np.zeros((1, 80), np.int32), max_new_tokens=2)
+
+
+def test_quantized_and_guards(tiny_bart):
+    path, _ = tiny_bart
+    from bigdl_tpu.transformers import AutoModelForSeq2SeqLM
+
+    m = AutoModelForSeq2SeqLM.from_pretrained(path, load_in_4bit=True)
+    out = m.generate(SRC, max_new_tokens=5)
+    out2 = m.generate(SRC, max_new_tokens=5)
+    np.testing.assert_array_equal(out, out2)
+    assert (out >= 0).all() and (out < TINY["vocab_size"]).all()
+    assert m.params["enc_layers"]["q_proj"].qtype == "sym_int4"
+
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        m.generate(SRC, max_new_tokens=10_000)
+    with pytest.raises(ValueError, match="supports"):
+        import json, os, tempfile
+
+        d = tempfile.mkdtemp()
+        json.dump({"architectures": ["LlamaForCausalLM"]},
+                  open(os.path.join(d, "config.json"), "w"))
+        AutoModelForSeq2SeqLM.from_pretrained(d)
