@@ -81,6 +81,7 @@ class BartConfig:
     decoder_start_token_id: int = 2
     eos_token_id: int = 2
     pad_token_id: int = 1
+    forced_bos_token_id: Optional[int] = None   # bart-large-cnn style
 
     @property
     def hd(self) -> int:
@@ -103,6 +104,7 @@ class BartConfig:
             decoder_start_token_id=hf.get("decoder_start_token_id", 2),
             eos_token_id=hf.get("eos_token_id", 2),
             pad_token_id=hf.get("pad_token_id", 1),
+            forced_bos_token_id=hf.get("forced_bos_token_id"),
         )
 
 
@@ -308,7 +310,8 @@ def convert_hf_params(
             top["shared"] = dense(w)
         elif name in ("model.encoder.embed_tokens.weight",
                       "model.decoder.embed_tokens.weight", "lm_head.weight"):
-            top.setdefault("shared", dense(w))     # tied duplicates
+            if "shared" not in top:                # tied duplicates: skip
+                top["shared"] = dense(w)           # re-uploading [V, D]
         elif name == "model.encoder.embed_positions.weight":
             top["enc_pos"] = dense(w)
         elif name == "model.decoder.embed_positions.weight":

@@ -30,6 +30,7 @@ import dataclasses
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from bigdl_tpu.ops.matmul import linear, q_matmul
@@ -266,3 +267,92 @@ def lora_trainable_mask(params: Any) -> Any:
 def mark_only_lora_trainable(params: Any) -> Callable[[Any], Any]:
     """trainable_filter factory for bigdl_tpu.training.make_train_step."""
     return lambda p: lora_trainable_mask(p)
+
+
+# ---------------------------------------------------------------------------
+# Adapter persistence (the reference's PEFT adapter checkpoints: alpaca
+# scripts save adapters with Trainer, export_merged_model.py merges them;
+# SURVEY.md §5 checkpoint/resume)
+# ---------------------------------------------------------------------------
+
+
+def _walk_adapters(tree: Any, prefix: Tuple[str, ...], out: Dict[str, Any]):
+    if isinstance(tree, LoraWeight):
+        out[".".join(prefix)] = tree
+    elif isinstance(tree, dict):
+        for k, v in tree.items():
+            _walk_adapters(v, prefix + (str(k),), out)
+
+
+def save_adapter(params: Any, path: str) -> None:
+    """Persist ONLY the LoRA a/b deltas (+ static alpha/pool) to `path`.
+
+    Tiny files (rank x dims), the base stays wherever it was loaded from —
+    the same separation as PEFT adapter checkpoints."""
+    import json
+    import os
+
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    found: Dict[str, LoraWeight] = {}
+    _walk_adapters(params, (), found)
+    if not found:
+        raise ValueError("no LoraWeight leaves in params; attach_lora first")
+    arrays = {}
+    meta = {}
+    for key, lw in found.items():
+        arrays[f"{key}#a"] = np.ascontiguousarray(
+            np.asarray(jax.device_get(lw.a), np.float32))
+        arrays[f"{key}#b"] = np.ascontiguousarray(
+            np.asarray(jax.device_get(lw.b), np.float32))
+        meta[key] = {"alpha": lw.alpha, "pool": lw.pool}
+    save_file(arrays, os.path.join(path, "adapter_weights.safetensors"))
+    with open(os.path.join(path, "adapter_manifest.json"), "w") as f:
+        json.dump({"format_version": 1, "adapters": meta}, f, indent=1)
+
+
+def load_adapter(params: Any, path: str) -> Any:
+    """Re-attach saved adapters onto a matching base pytree.
+
+    `params` is the freshly loaded (quantized) base; every adapter key in
+    the checkpoint must resolve to a leaf at the same tree path."""
+    import json
+    import os
+
+    from safetensors.numpy import load_file
+
+    with open(os.path.join(path, "adapter_manifest.json")) as f:
+        manifest = json.load(f)
+    store = load_file(os.path.join(path, "adapter_weights.safetensors"))
+
+    def attach(node, prefix):
+        if isinstance(node, dict):
+            return {k: attach(v, prefix + (str(k),)) for k, v in
+                    node.items()}
+        key = ".".join(prefix)
+        if key in manifest["adapters"]:
+            info = manifest["adapters"][key]
+            base = node.base if isinstance(node, LoraWeight) else node
+            return LoraWeight(
+                base,
+                jnp.asarray(store[f"{key}#a"]),
+                jnp.asarray(store[f"{key}#b"]),
+                float(info["alpha"]), int(info["pool"]))
+        return node
+
+    out = attach(params, ())
+    missing = [k for k in manifest["adapters"]
+               if _tree_get(out, k) is None]
+    if missing:
+        raise ValueError(f"adapter keys not found in base params: {missing}")
+    return out
+
+
+def _tree_get(tree: Any, dotted: str):
+    node = tree
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node if isinstance(node, LoraWeight) else None

@@ -20,6 +20,26 @@ from bigdl_tpu.ops.quant import FLOAT_QTYPES
 from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
 
 
+def _greedy_decode_loop(decode_fn, params, cfg, ids: np.ndarray,
+                        cache, max_new_tokens: int, eos: int) -> np.ndarray:
+    """Shared forced-prefix greedy loop (whisper + bart facades):
+    prefill the forced ids, then argmax-decode with eos substitution.
+    Returns [B, forced + new]."""
+    logits, cache = decode_fn(params, cfg, jnp.asarray(ids), cache)
+    tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    out = [np.asarray(tok)]
+    finished = out[0] == eos
+    for _ in range(max_new_tokens - 1):
+        if finished.all():
+            break
+        logits, cache = decode_fn(params, cfg, tok[:, None], cache)
+        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        t = np.where(finished, eos, np.asarray(tok))
+        out.append(t)
+        finished |= t == eos
+    return np.concatenate([ids, np.stack(out, axis=1)], axis=1)
+
+
 class TpuSpeechSeq2Seq:
     """A loaded (possibly quantized) Whisper + compiled generation."""
 
@@ -71,23 +91,8 @@ class TpuSpeechSeq2Seq:
             return ids
         max_seq = ids.shape[1] + max_new_tokens
         cache = self._init_cache(self.params, cfg, enc_out, max_seq)
-        logits, cache = self._decode(self.params, cfg, jnp.asarray(ids),
-                                     cache)
-        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-
-        out = [np.asarray(tok)]
-        finished = out[0] == eos
-        for _ in range(max_new_tokens - 1):
-            if finished.all():
-                break
-            logits, cache = self._decode(self.params, cfg, tok[:, None],
-                                         cache)
-            tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-            t = np.asarray(tok)
-            t = np.where(finished, eos, t)
-            out.append(t)
-            finished |= t == eos
-        return np.concatenate([ids, np.stack(out, axis=1)], axis=1)
+        return _greedy_decode_loop(self._decode, self.params, cfg, ids,
+                                   cache, max_new_tokens, eos)
 
 
 class TpuSeq2SeqLM:
@@ -127,8 +132,13 @@ class TpuSeq2SeqLM:
         enc_out = self._encode(self.params, cfg, jnp.asarray(src), mask)
         b = src.shape[0]
         if decoder_input_ids is None:
-            decoder_input_ids = np.full((b, 1), cfg.decoder_start_token_id,
-                                        np.int32)
+            start = [cfg.decoder_start_token_id]
+            if cfg.forced_bos_token_id is not None:
+                # HF forces bos as the first generated token
+                # (bart-large-cnn style); folding it into the prefix is
+                # equivalent and keeps the loop force-free
+                start.append(cfg.forced_bos_token_id)
+            decoder_input_ids = np.tile(np.asarray(start, np.int32), (b, 1))
         ids = np.asarray(decoder_input_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
@@ -142,21 +152,8 @@ class TpuSeq2SeqLM:
             return ids
         cache = self._init_cache(self.params, cfg, enc_out,
                                  ids.shape[1] + max_new_tokens, False, mask)
-        logits, cache = self._decode(self.params, cfg, jnp.asarray(ids),
-                                     cache)
-        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-        out = [np.asarray(tok)]
-        finished = out[0] == eos
-        for _ in range(max_new_tokens - 1):
-            if finished.all():
-                break
-            logits, cache = self._decode(self.params, cfg, tok[:, None],
-                                         cache)
-            tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-            t = np.where(finished, eos, np.asarray(tok))
-            out.append(t)
-            finished |= t == eos
-        return np.concatenate([ids, np.stack(out, axis=1)], axis=1)
+        return _greedy_decode_loop(self._decode, self.params, cfg, ids,
+                                   cache, max_new_tokens, eos)
 
 
 class AutoModelForSeq2SeqLM:
