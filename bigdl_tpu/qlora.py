@@ -288,11 +288,15 @@ def save_adapter(params: Any, path: str) -> None:
     """Persist ONLY the LoRA a/b deltas (+ static alpha/pool) to `path`.
 
     Tiny files (rank x dims), the base stays wherever it was loaded from —
-    the same separation as PEFT adapter checkpoints."""
+    the same separation as PEFT adapter checkpoints. Serialization reuses
+    lowbit_io's dtype-preserving converters (bf16 adapters round-trip as
+    bf16 — dtype drift on resume would silently change training)."""
     import json
     import os
 
     from safetensors.numpy import save_file
+
+    from bigdl_tpu.transformers.lowbit_io import _to_numpy
 
     os.makedirs(path, exist_ok=True)
     found: Dict[str, LoraWeight] = {}
@@ -300,31 +304,39 @@ def save_adapter(params: Any, path: str) -> None:
     if not found:
         raise ValueError("no LoraWeight leaves in params; attach_lora first")
     arrays = {}
+    dtypes = {}
     meta = {}
     for key, lw in found.items():
-        arrays[f"{key}#a"] = np.ascontiguousarray(
-            np.asarray(jax.device_get(lw.a), np.float32))
-        arrays[f"{key}#b"] = np.ascontiguousarray(
-            np.asarray(jax.device_get(lw.b), np.float32))
+        arrays[f"{key}#a"], dtypes[f"{key}#a"] = _to_numpy(lw.a)
+        arrays[f"{key}#b"], dtypes[f"{key}#b"] = _to_numpy(lw.b)
         meta[key] = {"alpha": lw.alpha, "pool": lw.pool}
     save_file(arrays, os.path.join(path, "adapter_weights.safetensors"))
     with open(os.path.join(path, "adapter_manifest.json"), "w") as f:
-        json.dump({"format_version": 1, "adapters": meta}, f, indent=1)
+        json.dump({"format_version": 1, "adapters": meta,
+                   "dtypes": dtypes}, f, indent=1)
 
 
 def load_adapter(params: Any, path: str) -> Any:
     """Re-attach saved adapters onto a matching base pytree.
 
     `params` is the freshly loaded (quantized) base; every adapter key in
-    the checkpoint must resolve to a leaf at the same tree path."""
+    the checkpoint must resolve to a leaf at the same tree path, and the
+    saved a/b shapes must fit that leaf's [K, N] (fail here with names,
+    not later inside a jitted dot_general)."""
     import json
     import os
 
     from safetensors.numpy import load_file
 
+    from bigdl_tpu.transformers.lowbit_io import _from_numpy
+
     with open(os.path.join(path, "adapter_manifest.json")) as f:
         manifest = json.load(f)
     store = load_file(os.path.join(path, "adapter_weights.safetensors"))
+    dtypes = manifest.get("dtypes", {})
+
+    def get(key):
+        return _from_numpy(store[key], dtypes.get(key, str(store[key].dtype)))
 
     def attach(node, prefix):
         if isinstance(node, dict):
@@ -334,11 +346,18 @@ def load_adapter(params: Any, path: str) -> Any:
         if key in manifest["adapters"]:
             info = manifest["adapters"][key]
             base = node.base if isinstance(node, LoraWeight) else node
-            return LoraWeight(
-                base,
-                jnp.asarray(store[f"{key}#a"]),
-                jnp.asarray(store[f"{key}#b"]),
-                float(info["alpha"]), int(info["pool"]))
+            a = get(f"{key}#a")
+            b = get(f"{key}#b")
+            k_dim, n_dim = _leaf_kn(base)
+            pool = int(info["pool"])
+            if (a.shape[-2] * pool != k_dim or b.shape[-1] != n_dim
+                    or a.shape[-1] != b.shape[-2]):
+                raise ValueError(
+                    f"adapter {key!r} shapes a{tuple(a.shape)} / "
+                    f"b{tuple(b.shape)} (pool={pool}) do not fit base "
+                    f"[K={k_dim}, N={n_dim}] — adapter saved from a "
+                    "different model size?")
+            return LoraWeight(base, a, b, float(info["alpha"]), pool)
         return node
 
     out = attach(params, ())

@@ -99,6 +99,12 @@ class LLMEngine:
         self.params = model.params
         self.cfg = model.config
         self.family = model.family
+        if getattr(self.family, "is_recurrent", False):
+            raise ValueError(
+                f"continuous batching is KV-cache based; the "
+                f"{self.family.name!r} family carries recurrent state "
+                "whose slots cannot be rewound/packed — serve it through "
+                "model.generate() instead")
         self.eos_token_id = None
         hf = getattr(model, "hf_config", None) or {}
         eos = hf.get("eos_token_id")

@@ -132,16 +132,19 @@ class TpuSeq2SeqLM:
         enc_out = self._encode(self.params, cfg, jnp.asarray(src), mask)
         b = src.shape[0]
         if decoder_input_ids is None:
-            start = [cfg.decoder_start_token_id]
-            if cfg.forced_bos_token_id is not None:
-                # HF forces bos as the first generated token
-                # (bart-large-cnn style); folding it into the prefix is
-                # equivalent and keeps the loop force-free
-                start.append(cfg.forced_bos_token_id)
-            decoder_input_ids = np.tile(np.asarray(start, np.int32), (b, 1))
+            decoder_input_ids = np.full((b, 1), cfg.decoder_start_token_id,
+                                        np.int32)
         ids = np.asarray(decoder_input_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
+        if cfg.forced_bos_token_id is not None and ids.shape[1] == 1:
+            # HF's ForcedBOSTokenLogitsProcessor forces bos at sequence
+            # length 1 (bart-large-cnn style) whether or not the caller
+            # supplied the start token; folding it into the prefix is
+            # equivalent and keeps the decode loop force-free
+            ids = np.concatenate(
+                [ids, np.full((ids.shape[0], 1), cfg.forced_bos_token_id,
+                              np.int32)], axis=1)
         eos = cfg.eos_token_id if eos_token_id is None else eos_token_id
         if ids.shape[1] + max_new_tokens > cfg.max_position_embeddings:
             raise ValueError(

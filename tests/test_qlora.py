@@ -188,3 +188,50 @@ def test_adapter_save_load_roundtrip(tmp_path):
     # empty params guard
     with _pytest.raises(ValueError, match="attach_lora"):
         save_adapter({"layers": {}}, str(tmp_path / "x"))
+
+
+def test_adapter_shape_mismatch_rejected(tmp_path):
+    import numpy as np
+
+    from bigdl_tpu.ops.quant import quantize_linear
+    from bigdl_tpu.qlora import (LoraConfig, attach_lora, load_adapter,
+                                 save_adapter)
+
+    rng = np.random.default_rng(1)
+    small = {"layers": {"q_proj": quantize_linear(
+        jnp.asarray(rng.standard_normal((48, 32)).astype(np.float32)),
+        "sym_int4")}}
+    params = attach_lora(small, LoraConfig(r=4, target_modules=("q_proj",)))
+    d = tmp_path / "ad"
+    save_adapter(params, str(d))
+
+    big = {"layers": {"q_proj": quantize_linear(
+        jnp.asarray(rng.standard_normal((96, 64)).astype(np.float32)),
+        "sym_int4")}}
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="do not fit base"):
+        load_adapter(big, str(d))
+
+
+def test_adapter_dtype_roundtrip(tmp_path):
+    """bf16 adapters must come back bf16 (no silent f32 drift)."""
+    import numpy as np
+
+    from bigdl_tpu.ops.quant import quantize_linear
+    from bigdl_tpu.qlora import (LoraConfig, attach_lora, load_adapter,
+                                 save_adapter)
+
+    rng = np.random.default_rng(2)
+    base = {"layers": {"q_proj": quantize_linear(
+        jnp.asarray(rng.standard_normal((48, 32)).astype(np.float32)),
+        "sym_int4")}}
+    params = attach_lora(base, LoraConfig(r=4, target_modules=("q_proj",)))
+    lw = params["layers"]["q_proj"]
+    lw.a = lw.a.astype(jnp.bfloat16)
+    lw.b = lw.b.astype(jnp.bfloat16)
+    d = tmp_path / "ad"
+    save_adapter(params, str(d))
+    restored = load_adapter(base, str(d))
+    assert restored["layers"]["q_proj"].a.dtype == jnp.bfloat16
+    assert restored["layers"]["q_proj"].b.dtype == jnp.bfloat16

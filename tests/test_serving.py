@@ -215,3 +215,20 @@ def test_env_check():
     assert info["backend"] == "cpu"          # conftest pins the CPU mesh
     assert len(info["devices"]) == 8
     assert "native_kernels" in info
+
+
+def test_engine_rejects_recurrent_families():
+    """Slot-based continuous batching is KV-cache-only; recurrent state
+    (RWKV/yuan) cannot be packed per slot — must fail loudly at setup."""
+    import types
+
+    import pytest as _pytest
+
+    from bigdl_tpu.serving.engine import LLMEngine
+
+    fake = types.SimpleNamespace(
+        params={}, config=None,
+        family=types.SimpleNamespace(is_recurrent=True, name="rwkv4"),
+        hf_config={})
+    with _pytest.raises(ValueError, match="recurrent"):
+        LLMEngine(fake)
