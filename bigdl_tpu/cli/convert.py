@@ -24,12 +24,15 @@ def main(argv=None) -> int:
                     help="qtype: sym_int4/asym_int4/nf4/fp8_e4m3/... ")
     ap.add_argument("-f", "--format", default="lowbit",
                     choices=["lowbit", "gguf"])
+    ap.add_argument("--imatrix", default=None,
+                    help="llama.cpp-format importance matrix file for "
+                         "weighted quantization (ultra-low-bit qtypes)")
     args = ap.parse_args(argv)
 
     from bigdl_tpu.transformers.model import AutoModelForCausalLM
 
     model = AutoModelForCausalLM.from_pretrained(
-        args.model, load_in_low_bit=args.outtype)
+        args.model, load_in_low_bit=args.outtype, imatrix=args.imatrix)
 
     if args.format == "lowbit":
         model.save_low_bit(args.outfile)

@@ -312,7 +312,10 @@ def save_adapter(params: Any, path: str) -> None:
         meta[key] = {"alpha": lw.alpha, "pool": lw.pool}
     save_file(arrays, os.path.join(path, "adapter_weights.safetensors"))
     with open(os.path.join(path, "adapter_manifest.json"), "w") as f:
-        json.dump({"format_version": 1, "adapters": meta,
+        # v2: arrays are stored via lowbit_io._to_numpy (bf16 as uint16
+        # views) and need the "dtypes" map to decode — v1 readers would
+        # reinterpret them as raw integers, so the version must gate
+        json.dump({"format_version": 2, "adapters": meta,
                    "dtypes": dtypes}, f, indent=1)
 
 
@@ -332,6 +335,11 @@ def load_adapter(params: Any, path: str) -> Any:
 
     with open(os.path.join(path, "adapter_manifest.json")) as f:
         manifest = json.load(f)
+    version = manifest.get("format_version")
+    if version not in (1, 2):
+        raise ValueError(
+            f"adapter checkpoint format_version {version!r} is newer than "
+            "this build understands; upgrade bigdl_tpu")
     store = load_file(os.path.join(path, "adapter_weights.safetensors"))
     dtypes = manifest.get("dtypes", {})
 
@@ -350,13 +358,16 @@ def load_adapter(params: Any, path: str) -> Any:
             b = get(f"{key}#b")
             k_dim, n_dim = _leaf_kn(base)
             pool = int(info["pool"])
+            stack = _stack_dims(base)     # leading [L, ...] layer axes
             if (a.shape[-2] * pool != k_dim or b.shape[-1] != n_dim
-                    or a.shape[-1] != b.shape[-2]):
+                    or a.shape[-1] != b.shape[-2]
+                    or tuple(a.shape[:-2]) != stack
+                    or tuple(b.shape[:-2]) != stack):
                 raise ValueError(
                     f"adapter {key!r} shapes a{tuple(a.shape)} / "
                     f"b{tuple(b.shape)} (pool={pool}) do not fit base "
-                    f"[K={k_dim}, N={n_dim}] — adapter saved from a "
-                    "different model size?")
+                    f"[*{stack}, K={k_dim}, N={n_dim}] — adapter saved "
+                    "from a different model size?")
             return LoraWeight(base, a, b, float(info["alpha"]), pool)
         return node
 

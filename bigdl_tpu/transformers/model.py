@@ -188,6 +188,11 @@ class _BaseAutoModelClass:
                     "speculative=True needs an original checkpoint to build "
                     "the low-bit draft (reference model.py:323-331); this "
                     "path is an already-quantized save_low_bit directory")
+            if imatrix is not None:
+                raise ValueError(
+                    "imatrix applies at quantization time; this path is an "
+                    "already-quantized save_low_bit directory — re-convert "
+                    "from the original checkpoint with the imatrix")
             # max_seq=None lets the manifest's saved value win
             return cls.load_low_bit(path, max_seq=max_seq,
                                     quantize_kv_cache=quantize_kv_cache)
@@ -196,6 +201,11 @@ class _BaseAutoModelClass:
                 raise ValueError(
                     "speculative=True is not supported for GGUF inputs "
                     "(already low-bit); load the original HF checkpoint")
+            if imatrix is not None:
+                raise ValueError(
+                    "imatrix applies at quantization time; GGUF weights "
+                    "are already quantized — use the original HF "
+                    "checkpoint with load_in_low_bit + imatrix")
             # direct GGUF ingestion (reference gguf/api.py:31)
             from bigdl_tpu.gguf import load_gguf
 

@@ -199,3 +199,27 @@ def test_collect_and_quantize_end_to_end():
                     new_cache_fn=fam.new_cache)
     out = gen.generate(calib[:, :4], GenerationConfig(max_new_tokens=4))
     assert out.shape == (1, 4)
+
+
+def test_imatrix_rejected_for_prequantized_inputs(tmp_path):
+    """--imatrix with already-quantized inputs must error, not no-op."""
+    import json
+    import os
+
+    import pytest as _pytest
+    from safetensors.numpy import save_file
+
+    from bigdl_tpu.transformers import AutoModelForCausalLM
+
+    hf, ts = tiny_ckpt()
+    src = tmp_path / "src"
+    os.makedirs(src)
+    save_file({k: np.asarray(v) for k, v in ts},
+              str(src / "model.safetensors"))
+    json.dump(hf, open(src / "config.json", "w"))
+    m = AutoModelForCausalLM.from_pretrained(str(src), load_in_4bit=True,
+                                             max_seq=64)
+    lb = tmp_path / "lowbit"
+    m.save_low_bit(str(lb))
+    with _pytest.raises(ValueError, match="already-quantized"):
+        AutoModelForCausalLM.from_pretrained(str(lb), imatrix={"x": [1.0]})
