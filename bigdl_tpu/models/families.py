@@ -972,7 +972,9 @@ def register_all() -> None:
                     _adapter("baichuan", _baichuan_cfg, _baichuan_map))
     register_family(["ChatGLMModel", "ChatGLMForConditionalGeneration"],
                     _adapter("chatglm", _chatglm2_cfg, _chatglm2_map))
-    register_family(["MPTForCausalLM"], _adapter("mpt", _mpt_cfg, _mpt_map))
+    # HF transformers writes "MptForCausalLM"; community ckpts "MPT..."
+    register_family(["MPTForCausalLM", "MptForCausalLM"],
+                    _adapter("mpt", _mpt_cfg, _mpt_map))
     register_family(["GPTJForCausalLM"],
                     _adapter("gptj", _gptj_cfg, _gptj_map))
     register_family(["InternLM2ForCausalLM"],

@@ -338,8 +338,9 @@ def load_adapter(params: Any, path: str) -> Any:
     version = manifest.get("format_version")
     if version not in (1, 2):
         raise ValueError(
-            f"adapter checkpoint format_version {version!r} is newer than "
-            "this build understands; upgrade bigdl_tpu")
+            f"adapter checkpoint format_version {version!r} is not one "
+            "this build understands (known: 1, 2) — a newer bigdl_tpu "
+            "wrote it, or the manifest is corrupt")
     store = load_file(os.path.join(path, "adapter_weights.safetensors"))
     dtypes = manifest.get("dtypes", {})
 

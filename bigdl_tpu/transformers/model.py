@@ -242,6 +242,12 @@ class _BaseAutoModelClass:
                 raise ValueError(
                     f"checkpoint is already {qc[0]}-quantized (asym_int4 "
                     f"after repack); conflicting load_in_low_bit={qtype!r}")
+            if imatrix is not None:
+                raise ValueError(
+                    f"imatrix applies at quantization time; this "
+                    f"{qc[0]}-quantized checkpoint repacks as-is — use "
+                    "the original float checkpoint with load_in_low_bit "
+                    "+ imatrix")
             method, group, plus_one = qc
             tensor_stream = repack_stream(tensor_stream, method, group,
                                           plus_one)

@@ -204,9 +204,7 @@ def test_collect_and_quantize_end_to_end():
 def test_imatrix_rejected_for_prequantized_inputs(tmp_path):
     """--imatrix with already-quantized inputs must error, not no-op."""
     import json
-    import os
 
-    import pytest as _pytest
     from safetensors.numpy import save_file
 
     from bigdl_tpu.transformers import AutoModelForCausalLM
@@ -221,5 +219,17 @@ def test_imatrix_rejected_for_prequantized_inputs(tmp_path):
                                              max_seq=64)
     lb = tmp_path / "lowbit"
     m.save_low_bit(str(lb))
-    with _pytest.raises(ValueError, match="already-quantized"):
+    with pytest.raises(ValueError, match="already-quantized"):
         AutoModelForCausalLM.from_pretrained(str(lb), imatrix={"x": [1.0]})
+
+    # GPTQ-marked checkpoints repack as-is: imatrix must also error
+    gp = tmp_path / "gptq"
+    os.makedirs(gp)
+    hf2 = dict(hf)
+    hf2["quantization_config"] = {"quant_method": "gptq", "bits": 4,
+                                  "group_size": 32, "desc_act": False}
+    json.dump(hf2, open(gp / "config.json", "w"))
+    save_file({k: np.asarray(v) for k, v in ts},
+              str(gp / "model.safetensors"))
+    with pytest.raises(ValueError, match="quantization time"):
+        AutoModelForCausalLM.from_pretrained(str(gp), imatrix={"x": [1.0]})
