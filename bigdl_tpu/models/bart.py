@@ -288,21 +288,16 @@ def convert_hf_params(
     """Two Acc accumulators (encoder / decoder stacks) share the standard
     conversion leaf helpers (models/convert_base.py: native-kernel
     quantization preference, imatrix weighting, protection policy)."""
-    import types
-
     from bigdl_tpu.models.convert_base import Acc
 
     accs = {
-        True: Acc(types.SimpleNamespace(
-            num_hidden_layers=cfg.encoder_layers), qtype, compute_dtype,
-            modules_to_not_convert, imatrix=imatrix),
-        False: Acc(types.SimpleNamespace(
-            num_hidden_layers=cfg.decoder_layers), qtype, compute_dtype,
-            modules_to_not_convert, imatrix=imatrix),
+        True: Acc.for_layer_count(cfg.encoder_layers, qtype, compute_dtype,
+                                  modules_to_not_convert, imatrix=imatrix),
+        False: Acc.for_layer_count(cfg.decoder_layers, qtype, compute_dtype,
+                                   modules_to_not_convert, imatrix=imatrix),
     }
-    enc_acc = accs[True]
     top: Dict[str, Any] = {}
-    dense = enc_acc.dense
+    dense = accs[True].dense
 
     for name, w in tensors:
         w = np.asarray(w)
@@ -347,7 +342,7 @@ def convert_hf_params(
                         acc.dense(w))
 
     top["enc_layers"] = accs[True].finish(
-        tie=False, lm_head_required=False)["layers"]
+        tie=False, lm_head_required=False, what="bart encoder")["layers"]
     top["dec_layers"] = accs[False].finish(
-        tie=False, lm_head_required=False)["layers"]
+        tie=False, lm_head_required=False, what="bart decoder")["layers"]
     return top

@@ -75,12 +75,23 @@ class Acc:
     def put(self, key: str, idx: int, val):
         self.layers.setdefault(key, [None] * self.L)[idx] = val
 
-    def finish(self, tie: bool, lm_head_required: bool = True
-               ) -> Dict[str, Any]:
+    @classmethod
+    def for_layer_count(cls, num_layers: int, qtype, compute_dtype,
+                        modules_to_not_convert, imatrix=None) -> "Acc":
+        """Accumulator for a bare layer stack (encoder-decoder models
+        build one per stack; whisper/bart conversions)."""
+        import types
+
+        return cls(types.SimpleNamespace(num_hidden_layers=num_layers),
+                   qtype, compute_dtype, modules_to_not_convert,
+                   imatrix=imatrix)
+
+    def finish(self, tie: bool, lm_head_required: bool = True,
+               what: str = "checkpoint") -> Dict[str, Any]:
         missing = [k for k, v in self.layers.items()
                    if any(x is None for x in v)]
         if missing:
-            raise ValueError(f"checkpoint missing layer tensors: {missing}")
+            raise ValueError(f"{what} missing layer tensors: {missing}")
         params = dict(self.top)
         params["layers"] = {
             k: jax.tree.map(lambda *xs: jnp.stack(xs), *v)

@@ -258,32 +258,23 @@ def convert_hf_params(
     """HF WhisperForConditionalGeneration tensors -> pytree.
 
     Linears quantize (imatrix-weighted when given); convs, embeddings and
-    norms stay dense. Fused into two stacked layer trees (enc_layers /
-    dec_layers) for lax.scan.
+    norms stay dense. Two Acc accumulators (encoder / decoder stacks)
+    share the standard conversion leaf helpers (models/convert_base.py:
+    native-kernel preference, imatrix weighting, protection policy) —
+    same structure as models/bart.py.
     """
-    from bigdl_tpu.imatrix import imatrix_lookup, low_bit_policy
-    from bigdl_tpu.ops.quant import FLOAT_QTYPES, quantize_linear
+    from bigdl_tpu.models.convert_base import Acc
 
-    do_quant = qtype is not None and qtype not in FLOAT_QTYPES
-
-    def cvt_linear(name, w):
-        w = jnp.asarray(np.asarray(w))
-        if do_quant and not any(m in name for m in modules_to_not_convert):
-            qw = imatrix_lookup(imatrix, name)
-            if qw is not None and len(qw) != w.shape[1]:
-                qw = None
-            return quantize_linear(w, low_bit_policy(qtype, name), qw=qw)
-        return w.T.astype(compute_dtype)
-
-    dense = lambda w: jnp.asarray(np.asarray(w)).astype(compute_dtype)
+    accs = {
+        True: Acc.for_layer_count(cfg.encoder_layers, qtype, compute_dtype,
+                                  modules_to_not_convert, imatrix=imatrix),
+        False: Acc.for_layer_count(cfg.decoder_layers, qtype, compute_dtype,
+                                   modules_to_not_convert, imatrix=imatrix),
+    }
+    dense = accs[True].dense
     f32 = lambda w: jnp.asarray(np.asarray(w), jnp.float32)
 
     top: Dict[str, Any] = {}
-    enc: Dict[str, list] = {}
-    dec: Dict[str, list] = {}
-
-    def put(store, key, idx, L, val):
-        store.setdefault(key, [None] * L)[idx] = val
 
     _SELF = {"self_attn.q_proj": ("q_proj", True),
              "self_attn.k_proj": ("k_proj", True),
@@ -326,8 +317,7 @@ def convert_hf_params(
         elif name.startswith(("model.encoder.layers.",
                               "model.decoder.layers.")):
             is_enc = name.startswith("model.encoder.")
-            store = enc if is_enc else dec
-            L = cfg.encoder_layers if is_enc else cfg.decoder_layers
+            acc = accs[is_enc]
             parts = name.split(".")
             idx = int(parts[3])
             sub = ".".join(parts[4:-1])
@@ -337,20 +327,17 @@ def convert_hf_params(
                 continue
             key, is_lin = hit
             if is_lin and leaf == "weight":
-                put(store, key, idx, L, cvt_linear(name, w))
+                acc.put(key, idx, acc.linear(name, w))
             elif is_lin:
-                put(store, f"{key}_bias", idx, L, dense(w))
+                acc.put(f"{key}_bias", idx, acc.dense(w))
             else:
-                put(store, key if leaf == "weight" else f"{key}_bias",
-                    idx, L, dense(w))
+                acc.put(key if leaf == "weight" else f"{key}_bias", idx,
+                        acc.dense(w))
 
-    def finish(store, L, what):
-        missing = [k for k, v in store.items() if any(x is None for x in v)]
-        if missing:
-            raise ValueError(f"whisper {what} missing tensors: {missing}")
-        return {k: jax.tree.map(lambda *xs: jnp.stack(xs), *v)
-                for k, v in store.items()}
-
-    top["enc_layers"] = finish(enc, cfg.encoder_layers, "encoder")
-    top["dec_layers"] = finish(dec, cfg.decoder_layers, "decoder")
+    top["enc_layers"] = accs[True].finish(
+        tie=False, lm_head_required=False,
+        what="whisper encoder")["layers"]
+    top["dec_layers"] = accs[False].finish(
+        tie=False, lm_head_required=False,
+        what="whisper decoder")["layers"]
     return top
