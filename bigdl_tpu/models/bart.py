@@ -261,22 +261,6 @@ def decode_step(
 
 # -- conversion ---------------------------------------------------------------
 
-_SELF = {
-    "self_attn.q_proj": ("q_proj", True),
-    "self_attn.k_proj": ("k_proj", True),
-    "self_attn.v_proj": ("v_proj", True),
-    "self_attn.out_proj": ("o_proj", True),
-    "encoder_attn.q_proj": ("cross_q_proj", True),
-    "encoder_attn.k_proj": ("cross_k_proj", True),
-    "encoder_attn.v_proj": ("cross_v_proj", True),
-    "encoder_attn.out_proj": ("cross_o_proj", True),
-    "fc1": ("fc1", True), "fc2": ("fc2", True),
-    "self_attn_layer_norm": ("ln1", False),
-    "encoder_attn_layer_norm": ("ln_cross", False),
-    "final_layer_norm": ("ln2", False),
-}
-
-
 def convert_hf_params(
     tensors,
     cfg: BartConfig,
@@ -288,7 +272,8 @@ def convert_hf_params(
     """Two Acc accumulators (encoder / decoder stacks) share the standard
     conversion leaf helpers (models/convert_base.py: native-kernel
     quantization preference, imatrix weighting, protection policy)."""
-    from bigdl_tpu.models.convert_base import Acc
+    from bigdl_tpu.models.convert_base import (Acc,
+                                               map_encdec_layer_tensor)
 
     accs = {
         True: Acc.for_layer_count(cfg.encoder_layers, qtype, compute_dtype,
@@ -301,7 +286,9 @@ def convert_hf_params(
 
     for name, w in tensors:
         w = np.asarray(w)
-        if name in ("model.shared.weight", "shared.weight"):
+        if map_encdec_layer_tensor(accs, name, w):
+            pass
+        elif name in ("model.shared.weight", "shared.weight"):
             top["shared"] = dense(w)
         elif name in ("model.encoder.embed_tokens.weight",
                       "model.decoder.embed_tokens.weight", "lm_head.weight"):
@@ -321,25 +308,6 @@ def convert_hf_params(
             top["dec_embed_norm_bias"] = dense(w)
         elif name == "final_logits_bias":
             top["final_logits_bias"] = jnp.asarray(w, jnp.float32).reshape(-1)
-        elif name.startswith(("model.encoder.layers.",
-                              "model.decoder.layers.")):
-            is_enc = name.startswith("model.encoder.")
-            acc = accs[is_enc]
-            parts = name.split(".")
-            idx = int(parts[3])
-            sub = ".".join(parts[4:-1])
-            leaf = parts[-1]
-            hit = _SELF.get(sub)
-            if hit is None:
-                continue
-            key, is_lin = hit
-            if is_lin and leaf == "weight":
-                acc.put(key, idx, acc.linear(name, w))
-            elif is_lin:
-                acc.put(f"{key}_bias", idx, acc.dense(w))
-            else:
-                acc.put(key if leaf == "weight" else f"{key}_bias", idx,
-                        acc.dense(w))
 
     top["enc_layers"] = accs[True].finish(
         tie=False, lm_head_required=False, what="bart encoder")["layers"]

@@ -155,3 +155,48 @@ def layer_idx(name: str, prefix: str) -> Optional[Tuple[int, str]]:
     rest = name[len(prefix):]
     idx_s, _, sub = rest.partition(".")
     return int(idx_s), sub
+
+
+# HF encoder-decoder layer key map shared by whisper and bart (both use
+# the self_attn/encoder_attn/fc naming); value = (our key, is_linear)
+ENC_DEC_LAYER_MAP: Dict[str, Tuple[str, bool]] = {
+    "self_attn.q_proj": ("q_proj", True),
+    "self_attn.k_proj": ("k_proj", True),
+    "self_attn.v_proj": ("v_proj", True),
+    "self_attn.out_proj": ("o_proj", True),
+    "encoder_attn.q_proj": ("cross_q_proj", True),
+    "encoder_attn.k_proj": ("cross_k_proj", True),
+    "encoder_attn.v_proj": ("cross_v_proj", True),
+    "encoder_attn.out_proj": ("cross_o_proj", True),
+    "fc1": ("fc1", True), "fc2": ("fc2", True),
+    "self_attn_layer_norm": ("ln1", False),
+    "encoder_attn_layer_norm": ("ln_cross", False),
+    "final_layer_norm": ("ln2", False),
+}
+
+
+def map_encdec_layer_tensor(accs: Dict[bool, "Acc"], name: str,
+                            w) -> bool:
+    """Route one 'model.{encoder,decoder}.layers.N.*' tensor into the
+    encoder (accs[True]) or decoder (accs[False]) accumulator. Returns
+    True when the tensor was a layer tensor (handled or skipped)."""
+    if not name.startswith(("model.encoder.layers.",
+                            "model.decoder.layers.")):
+        return False
+    acc = accs[name.startswith("model.encoder.")]
+    parts = name.split(".")
+    idx = int(parts[3])
+    sub = ".".join(parts[4:-1])
+    leaf = parts[-1]
+    hit = ENC_DEC_LAYER_MAP.get(sub)
+    if hit is None:
+        return True
+    key, is_lin = hit
+    if is_lin and leaf == "weight":
+        acc.put(key, idx, acc.linear(name, w))
+    elif is_lin:
+        acc.put(f"{key}_bias", idx, acc.dense(w))
+    else:
+        acc.put(key if leaf == "weight" else f"{key}_bias", idx,
+                acc.dense(w))
+    return True

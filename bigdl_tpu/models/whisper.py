@@ -263,7 +263,8 @@ def convert_hf_params(
     native-kernel preference, imatrix weighting, protection policy) —
     same structure as models/bart.py.
     """
-    from bigdl_tpu.models.convert_base import Acc
+    from bigdl_tpu.models.convert_base import (Acc,
+                                               map_encdec_layer_tensor)
 
     accs = {
         True: Acc.for_layer_count(cfg.encoder_layers, qtype, compute_dtype,
@@ -276,22 +277,11 @@ def convert_hf_params(
 
     top: Dict[str, Any] = {}
 
-    _SELF = {"self_attn.q_proj": ("q_proj", True),
-             "self_attn.k_proj": ("k_proj", True),
-             "self_attn.v_proj": ("v_proj", True),
-             "self_attn.out_proj": ("o_proj", True),
-             "encoder_attn.q_proj": ("cross_q_proj", True),
-             "encoder_attn.k_proj": ("cross_k_proj", True),
-             "encoder_attn.v_proj": ("cross_v_proj", True),
-             "encoder_attn.out_proj": ("cross_o_proj", True),
-             "fc1": ("fc1", True), "fc2": ("fc2", True),
-             "self_attn_layer_norm": ("ln1", False),
-             "encoder_attn_layer_norm": ("ln_cross", False),
-             "final_layer_norm": ("ln2", False)}
-
     for name, w in tensors:
         w = np.asarray(w)
-        if name == "model.encoder.conv1.weight":
+        if map_encdec_layer_tensor(accs, name, w):
+            pass
+        elif name == "model.encoder.conv1.weight":
             top["enc_conv1_w"] = f32(w)
         elif name == "model.encoder.conv1.bias":
             top["enc_conv1_b"] = f32(w)
@@ -314,25 +304,6 @@ def convert_hf_params(
             top["dec_norm"] = dense(w)
         elif name == "model.decoder.layer_norm.bias":
             top["dec_norm_bias"] = dense(w)
-        elif name.startswith(("model.encoder.layers.",
-                              "model.decoder.layers.")):
-            is_enc = name.startswith("model.encoder.")
-            acc = accs[is_enc]
-            parts = name.split(".")
-            idx = int(parts[3])
-            sub = ".".join(parts[4:-1])
-            leaf = parts[-1]
-            hit = _SELF.get(sub)
-            if hit is None:
-                continue
-            key, is_lin = hit
-            if is_lin and leaf == "weight":
-                acc.put(key, idx, acc.linear(name, w))
-            elif is_lin:
-                acc.put(f"{key}_bias", idx, acc.dense(w))
-            else:
-                acc.put(key if leaf == "weight" else f"{key}_bias", idx,
-                        acc.dense(w))
 
     top["enc_layers"] = accs[True].finish(
         tie=False, lm_head_required=False,

@@ -28,34 +28,40 @@ import functools
 
 
 @functools.lru_cache(maxsize=None)
-def _decode_kernel_compiles(h: int, hkv: int, hd: int, s: int,
-                            kv_dtype_name: str) -> bool:
-    """Eager probe, cached PER SHAPE: does the Pallas decode kernel compile
-    for this attention geometry? Mosaic failures can be shape-dependent,
-    and a failure inside a model's outer jit is uncatchable — so the probe
-    runs the exact geometry as a tiny concrete call OUTSIDE any trace.
-    Auto mode consults this; pallas mode bypasses it so forced runs still
-    raise their real error."""
+def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
+                     skv: int, kv_dtype_name: str) -> bool:
+    """Eager probe, cached PER GEOMETRY: does the Pallas kernel compile
+    for this attention shape? Mosaic failures can be shape-dependent, and
+    a failure inside a model's outer jit is uncatchable — so the probe
+    runs the geometry as a tiny concrete call OUTSIDE any trace. Auto
+    mode consults this; pallas mode bypasses it so forced runs still
+    raise their real error. Callers normalize `sq` to the kernel's block
+    class (prefill lengths vary per request; every class needs only one
+    probe compile)."""
     try:
         import numpy as _np
 
-        from bigdl_tpu.ops.pallas.decode_attention import (
-            decode_attention_pallas)
+        if kind == "decode":
+            from bigdl_tpu.ops.pallas.decode_attention import (
+                decode_attention_pallas as kernel)
+        else:
+            from bigdl_tpu.ops.pallas.prefill_attention import (
+                prefill_attention_pallas as kernel)
 
         kdt = jnp.dtype(kv_dtype_name)
-        q = jnp.zeros((1, 1, h, hd), jnp.bfloat16)
-        kv = jnp.zeros((1, s, hkv, hd), kdt)
-        out = decode_attention_pallas(q, kv, kv, jnp.asarray(0, jnp.int32),
-                                      hd ** -0.5)
+        q = jnp.zeros((1, sq, h, hd), jnp.bfloat16)
+        kv = jnp.zeros((1, skv, hkv, hd), kdt)
+        out = kernel(q, kv, kv, jnp.asarray(0, jnp.int32), hd ** -0.5)
         _np.asarray(out)
         return True
     except Exception as e:
         import logging
 
         logging.getLogger(__name__).warning(
-            "fused decode-attention kernel unavailable for shape "
-            "(H=%d, Hkv=%d, hd=%d, S=%d, %s) — %s: %s; using the XLA path",
-            h, hkv, hd, s, kv_dtype_name, type(e).__name__, e)
+            "pallas %s-attention kernel unavailable for shape "
+            "(H=%d, Hkv=%d, hd=%d, Sq=%d, Skv=%d, %s) — %s: %s; using "
+            "the XLA path", kind, h, hkv, hd, sq, skv, kv_dtype_name,
+            type(e).__name__, e)
         return False
 
 
@@ -98,9 +104,29 @@ def sdp_attention(
         if supported and be == "pallas":
             return decode_attention_pallas(q, k, v, q_pos, float(scale),
                                            interpret=not on_tpu)
-        if supported and on_tpu and _decode_kernel_compiles(
-                h, hkv, d, skv, str(k.dtype)):
+        if supported and on_tpu and _kernel_compiles(
+                "decode", h, hkv, d, 1, skv, str(k.dtype)):
             return decode_attention_pallas(q, k, v, q_pos, float(scale))
+
+        from bigdl_tpu.ops.pallas.prefill_attention import (
+            prefill_attention_pallas, prefill_attention_supported)
+
+        # blockwise prefill (flash): scores never touch HBM — the win
+        # grows with S * S_max (the pre-allocated cache is read once);
+        # scalar positions only (serving prefills per slot at Sq=1)
+        pre_ok = (getattr(q_pos, "ndim", 0) == 0
+                  and prefill_attention_supported(
+                      q, k, v, q_pos, scale, logits_soft_cap,
+                      sliding_window, alibi_slopes))
+        if pre_ok and be == "pallas":
+            return prefill_attention_pallas(q, k, v, q_pos, float(scale),
+                                            interpret=not on_tpu)
+        # probe once per BLOCK CLASS of sq (256-aligned vs 128-aligned),
+        # not per exact prompt length
+        probe_sq = 256 if sq % 256 == 0 else 128
+        if pre_ok and on_tpu and _kernel_compiles(
+                "prefill", h, hkv, d, probe_sq, skv, str(k.dtype)):
+            return prefill_attention_pallas(q, k, v, q_pos, float(scale))
 
     qf = q.reshape(b, sq, hkv, g, d).astype(jnp.bfloat16)
     kf = k.astype(jnp.bfloat16)

@@ -1,0 +1,148 @@
+"""Pallas TPU kernel: blockwise (flash) causal attention for prefill.
+
+TPU-native replacement for the reference's prefill flash-attention path
+(`use_flash_attention` gating ipex's F.scaled_dot_product_attention,
+reference transformers/models/utils.py:33-120 and the native_sdp python
+fallback at models/llama.py:1320-1349).
+
+Why: prefill attention against the pre-allocated cache computes scores
+[B, H, S, S_max]; at S=1024, S_max=2048 that is a quarter-gigabyte f32
+intermediate per 32-head batch that XLA writes to HBM between the QK
+matmul and the softmax. This kernel runs the classic online-softmax
+sweep: for each query tile, stream key/value tiles through VMEM keeping
+only [bq, hd] accumulators — scores never exist in HBM, and the KV cache
+is read exactly once.
+
+Grid: (B*H, S/bq, S_max/bk), kv innermost; m/l/acc live in VMEM scratch
+and persist across the kv sweep (TPU grid order guarantees sequential
+iteration of the last axis per outer step). Causality and the unwritten
+cache tail share one mask: k_pos <= q_pos + q_idx.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
+            *, scale, bq, bk, nk):
+    kj = pl.program_id(2)
+    qi = pl.program_id(1)
+    b = pl.program_id(0)
+    pos = pos_ref[b]
+
+    @pl.when(kj == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.bfloat16)                  # [bq, hd]
+    k = k_ref[0, :, 0, :].astype(jnp.bfloat16)         # [bk, hd]
+    v = v_ref[0, :, 0, :].astype(jnp.bfloat16)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    q_ids = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_ids = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    s = jnp.where(k_ids <= pos + q_ids, s, _NEG_INF)
+
+    m_prev = m_ref[:, :1]                              # [bq, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                             # [bq, bk]
+    l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(p.astype(jnp.bfloat16), v,
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    acc_ref[:] = acc_ref[:] * corr + pv
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kj == nk - 1)
+    def _():
+        # fully masked rows (query beyond pos with an empty cache) keep
+        # l == 0; guard the division — their output is garbage that the
+        # caller's position masking never reads
+        l = jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
+        out_ref[0] = (acc_ref[:] / l).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def prefill_attention_pallas(
+    q: jax.Array,          # [B, S, H, hd]
+    k: jax.Array,          # [B, S_max, Hkv, hd] bf16 | float8_e5m2
+    v: jax.Array,
+    q_pos: jax.Array,      # scalar int32 or [B]
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Blockwise causal SDP. Returns [B, S, H, hd] in q.dtype."""
+    b, s, h, hd = q.shape
+    smax, hkv = k.shape[1], k.shape[2]
+    g = h // hkv
+
+    bq = 256 if s % 256 == 0 else 128
+    bk = 512 if smax % 512 == 0 else 128
+    nq, nk = s // bq, smax // bk
+
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, hd)
+    pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
+    # per-(b*h) pos lookup: repeat to [B*H]
+    pos_bh = jnp.repeat(pos, h)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, hd),
+                         lambda bh, qi, kj, pos_ref: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, 1, hd),
+                         lambda bh, qi, kj, pos_ref:
+                         (bh // h, kj, (bh % h) // g, 0)),
+            pl.BlockSpec((1, bk, 1, hd),
+                         lambda bh, qi, kj, pos_ref:
+                         (bh // h, kj, (bh % h) // g, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, hd),
+                               lambda bh, qi, kj, pos_ref: (bh, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, bq=bq, bk=bk, nk=nk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, hd), q.dtype),
+        interpret=interpret,
+    )(pos_bh, qr, k, v)
+
+    return out.reshape(b, h, s, hd).transpose(0, 2, 1, 3)
+
+
+def prefill_attention_supported(q, k, v, q_pos, scale, logits_soft_cap,
+                                sliding_window, alibi_slopes) -> bool:
+    """Gate for the sdp_attention prefill dispatch."""
+    if q.shape[1] < 2 or alibi_slopes is not None:
+        return False
+    if logits_soft_cap is not None or sliding_window is not None:
+        return False
+    b, s, h, hd = q.shape
+    smax, hkv = k.shape[1], k.shape[2]
+    if h % hkv != 0 or hd % 64 != 0:
+        return False
+    if s % 128 != 0 or smax % 128 != 0:
+        return False
+    if k.dtype not in (jnp.bfloat16, jnp.float8_e5m2):
+        return False
+    return True

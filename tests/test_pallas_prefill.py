@@ -1,0 +1,100 @@
+"""Blockwise (flash) prefill kernel vs the XLA reference (interpret)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.config import set_flags
+from bigdl_tpu.ops.attention import sdp_attention
+from bigdl_tpu.ops.pallas.prefill_attention import (
+    prefill_attention_pallas, prefill_attention_supported)
+
+
+def _mk(b, s, smax, h, hkv, hd, seed=0, kv_dtype=jnp.bfloat16):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, s, h, hd)).astype(np.float32),
+                    jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, smax, hkv, hd)).astype(
+        np.float32), kv_dtype)
+    v = jnp.asarray(rng.standard_normal((b, smax, hkv, hd)).astype(
+        np.float32), kv_dtype)
+    return q, k, v
+
+
+def _xla(q, k, v, pos):
+    try:
+        set_flags(attention_backend="xla")
+        return sdp_attention(q, k, v, pos)
+    finally:
+        set_flags(attention_backend="auto")
+
+
+@pytest.mark.parametrize("h,hkv,hd", [(4, 4, 64), (8, 2, 64)])
+def test_matches_xla_prefill(h, hkv, hd):
+    """Fresh prefill (pos=0): cache tail beyond S is garbage that must be
+    masked by the causal/tail comparison."""
+    q, k, v = _mk(2, 128, 256, h, hkv, hd)
+    pos = jnp.asarray(0, jnp.int32)
+    ref = _xla(q, k, v, pos)
+    got = prefill_attention_pallas(q, k, v, pos, hd ** -0.5,
+                                   interpret=True)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_chunked_prefill_offset():
+    """Second prefill chunk (pos > 0) attends earlier cached keys."""
+    q, k, v = _mk(1, 128, 512, 4, 4, 64, seed=1)
+    pos = jnp.asarray(137, jnp.int32)
+    ref = _xla(q, k, v, pos)
+    got = prefill_attention_pallas(q, k, v, pos, 64 ** -0.5,
+                                   interpret=True)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_causality_strict():
+    """Future keys must have exactly zero influence on earlier queries."""
+    q, k, v = _mk(1, 128, 128, 2, 2, 64, seed=2)
+    pos = jnp.asarray(0, jnp.int32)
+    out1 = prefill_attention_pallas(q, k, v, pos, 64 ** -0.5,
+                                    interpret=True)
+    k2 = k.at[:, 64:].add(37.0)
+    v2 = v.at[:, 64:].add(-11.0)
+    out2 = prefill_attention_pallas(q, k2, v2, pos, 64 ** -0.5,
+                                    interpret=True)
+    np.testing.assert_allclose(np.asarray(out1[:, :64], np.float32),
+                               np.asarray(out2[:, :64], np.float32),
+                               rtol=1e-5, atol=1e-5)
+    assert not np.allclose(np.asarray(out1[:, 64:], np.float32),
+                           np.asarray(out2[:, 64:], np.float32))
+
+
+def test_fp8_kv_prefill():
+    q, k, v = _mk(1, 128, 128, 4, 2, 64, seed=3, kv_dtype=jnp.float8_e5m2)
+    pos = jnp.asarray(0, jnp.int32)
+    ref = _xla(q, k, v, pos)
+    got = prefill_attention_pallas(q, k, v, pos, 64 ** -0.5,
+                                   interpret=True)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=8e-2, atol=8e-2)
+
+
+def test_supported_gate():
+    q, k, v = _mk(1, 128, 256, 4, 2, 64)
+    pos = jnp.asarray(0, jnp.int32)
+    assert prefill_attention_supported(q, k, v, pos, 0.125, None, None,
+                                       None)
+    # decode shape, softcap, misaligned S -> not supported
+    qd = jnp.zeros((1, 1, 4, 64), jnp.bfloat16)
+    assert not prefill_attention_supported(qd, k, v, pos, 0.125, None,
+                                           None, None)
+    assert not prefill_attention_supported(q, k, v, pos, 0.125, 30.0,
+                                           None, None)
+    q2 = jnp.zeros((1, 100, 4, 64), jnp.bfloat16)
+    assert not prefill_attention_supported(q2, k, v, pos, 0.125, None,
+                                           None, None)
