@@ -219,38 +219,79 @@ _ACTS = {
 def _moe_mlp(hidden, lp, cfg: LlamaConfig):
     """Sparse-MoE MLP for generalized-decoder families (phixtral: phi body
     with a mixture of dense fc1/fc2 experts, reference transformers/models/
-    phixtral.py:73-138 — there a Python loop with host syncs; here the
-    one-hot einsum combine, like models/mixtral.py)."""
+    phixtral.py:73-138 — there a Python loop with host syncs; here two
+    host-sync-free strategies chosen by token count, like the reference's
+    prefill/decode split in mixtral_moeblock_forward:
+
+    - prefill (many tokens): dense one-hot einsum combine — every expert
+      runs on every token; with enough tokens per expert the full-expert
+      weight read amortizes and everything is big MXU matmuls.
+    - decode (few tokens): per-token expert GATHER — only the top-k
+      experts' weights leave HBM (dynamic-index on the stacked [E, ...]
+      leaves), cutting MoE decode HBM traffic by E/k (4x for Mixtral
+      8x top-2), which is the whole cost of a memory-bound decode step."""
     b, t, d = hidden.shape
     act = _ACTS[cfg.hidden_act]
     xf = hidden.reshape(-1, d)
+    n = xf.shape[0]
     router_logits = jnp.dot(xf, lp["router"].astype(hidden.dtype),
                             preferred_element_type=jnp.float32)
     topv, topi = lax.top_k(router_logits, cfg.num_experts_per_tok)
-    w = jax.nn.softmax(topv, axis=-1)
+    w = jax.nn.softmax(topv, axis=-1)                         # [N, k]
+
+    gated = cfg.mlp_gated
+    biased = (not gated) and ("experts_up_bias" in lp)
+
+    def one_expert(x_row, gw, uw, dw, ub, db, backend=None):
+        """x [1, D] through ONE expert's projections."""
+        if gated:
+            return linear(act(linear(x_row, gw, backend=backend))
+                          * linear(x_row, uw, backend=backend), dw,
+                          backend=backend)
+        return linear(act(linear(x_row, uw, ub, backend=backend)), dw, db,
+                      backend=backend)
+
+    # gather path pays k weight-gathers per token; dense pays E expert
+    # matmuls over all N tokens — switch where gathered bytes win
+    if n * cfg.num_experts_per_tok <= cfg.num_local_experts:
+        def per_token(x_row, idxs, wts):
+            def per_choice(i):
+                gw = (jax.tree.map(lambda a: a[i], lp["experts_gate"])
+                      if gated else None)
+                uw = jax.tree.map(lambda a: a[i], lp["experts_up"])
+                dw = jax.tree.map(lambda a: a[i], lp["experts_down"])
+                ub = lp["experts_up_bias"][i] if biased else None
+                db = lp["experts_down_bias"][i] if biased else None
+                # vmapped pallas_call is not yet validated on this
+                # toolchain; the per-token gather runs the XLA matmul
+                # (the HBM win comes from gathering k of E experts)
+                return one_expert(x_row[None], gw, uw, dw, ub, db,
+                                  backend="xla")[0]
+
+            outs = jnp.stack([per_choice(idxs[j])
+                              for j in range(cfg.num_experts_per_tok)])
+            return jnp.sum(outs * wts[:, None].astype(outs.dtype), axis=0)
+
+        y = jax.vmap(per_token)(xf, topi, w)
+        return y.reshape(b, t, d)
+
     combine = jnp.sum(
         jax.nn.one_hot(topi, cfg.num_local_experts, dtype=w.dtype)
         * w[..., None], axis=1)                               # [N, E]
 
-    if cfg.mlp_gated:
-        def expert_fn(gw, uw, dw):
-            return linear(act(linear(xf, gw)) * linear(xf, uw), dw)
-
-        all_out = jax.vmap(expert_fn)(
+    if gated:
+        all_out = jax.vmap(lambda gw, uw, dw: one_expert(
+            xf, gw, uw, dw, None, None))(
             lp["experts_gate"], lp["experts_up"], lp["experts_down"])
-    elif "experts_up_bias" in lp:
-        def expert_fn(uw, ub, dw, db):
-            return linear(act(linear(xf, uw, ub)), dw, db)
-
-        all_out = jax.vmap(expert_fn)(
+    elif biased:
+        all_out = jax.vmap(lambda uw, ub, dw, db: one_expert(
+            xf, None, uw, dw, ub, db))(
             lp["experts_up"], lp["experts_up_bias"],
             lp["experts_down"], lp["experts_down_bias"])
     else:
-        def expert_fn(uw, dw):
-            return linear(act(linear(xf, uw)), dw)
-
-        all_out = jax.vmap(expert_fn)(lp["experts_up"],
-                                      lp["experts_down"])
+        all_out = jax.vmap(lambda uw, dw: one_expert(
+            xf, None, uw, dw, None, None))(
+            lp["experts_up"], lp["experts_down"])
     y = jnp.einsum("ne,end->nd", combine.astype(hidden.dtype), all_out)
     return y.reshape(b, t, d)
 

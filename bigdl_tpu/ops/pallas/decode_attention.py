@@ -95,17 +95,26 @@ def decode_attention_pallas(
     return out[:, :, :g, :].reshape(b, 1, h, hd)
 
 
-def decode_attention_supported(q, k, v, q_pos, scale, logits_soft_cap,
-                               sliding_window, alibi_slopes) -> bool:
-    """Gate for the sdp_attention dispatch (bigdl_tpu.ops.attention)."""
-    if q.shape[1] != 1 or alibi_slopes is not None:
+def attention_geometry_ok(q, k, logits_soft_cap, sliding_window,
+                          alibi_slopes) -> bool:
+    """Shared feature/geometry gate for BOTH Pallas attention kernels
+    (decode + blockwise prefill): plain softmax attention only, aligned
+    shapes, KV dtypes the kernels upcast in-register."""
+    if alibi_slopes is not None:
         return False
     if logits_soft_cap is not None or sliding_window is not None:
         return False
-    b, _, h, hd = q.shape
+    h, hd = q.shape[2], q.shape[3]
     s, hkv = k.shape[1], k.shape[2]
     if h % hkv != 0 or hd % 64 != 0 or s % 128 != 0:
         return False
     if k.dtype not in (jnp.bfloat16, jnp.float8_e5m2):
         return False
     return True
+
+
+def decode_attention_supported(q, k, v, q_pos, scale, logits_soft_cap,
+                               sliding_window, alibi_slopes) -> bool:
+    """Gate for the sdp_attention dispatch (bigdl_tpu.ops.attention)."""
+    return q.shape[1] == 1 and attention_geometry_ok(
+        q, k, logits_soft_cap, sliding_window, alibi_slopes)

@@ -76,7 +76,6 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
         out_ref[0] = (acc_ref[:] / l).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
 def prefill_attention_pallas(
     q: jax.Array,          # [B, S, H, hd]
     k: jax.Array,          # [B, S_max, Hkv, hd] bf16 | float8_e5m2
@@ -85,7 +84,68 @@ def prefill_attention_pallas(
     scale: float,
     interpret: bool = False,
 ) -> jax.Array:
-    """Blockwise causal SDP. Returns [B, S, H, hd] in q.dtype."""
+    """Blockwise causal SDP. Returns [B, S, H, hd] in q.dtype.
+
+    Differentiable: the forward runs the Pallas sweep; the backward is
+    standard XLA softmax-attention gradients (pallas_call itself has no
+    VJP — without this, dispatching prefill to the kernel would break
+    every training path that reaches sdp_attention with Sq > 1)."""
+    return _pfa_vjp(q, k, v, q_pos, float(scale), bool(interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _pfa_vjp(q, k, v, q_pos, scale, interpret):
+    return _pfa_impl(q, k, v, q_pos, scale, interpret)
+
+
+def _pfa_fwd(q, k, v, q_pos, scale, interpret):
+    return _pfa_impl(q, k, v, q_pos, scale, interpret), (q, k, v, q_pos)
+
+
+def _pfa_bwd(scale, interpret, res, dy):
+    import numpy as _np
+
+    q, k, v, q_pos = res
+    b, s, h, hd = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    g = h // hkv
+
+    qf = q.reshape(b, s, hkv, g, hd).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dyg = dy.reshape(b, s, hkv, g, hd).astype(jnp.float32)
+
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf) * scale
+    pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
+    q_ids = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    k_ids = jnp.arange(skv, dtype=jnp.int32)
+    mask = k_ids[None, None, :] <= q_ids[:, :, None]        # [B, S, Skv]
+    scores = jnp.where(mask[:, None, None, :, :], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+
+    dv = jnp.einsum("bhgqk,bqhgd->bkhd", p, dyg)
+    dp = jnp.einsum("bqhgd,bkhd->bhgqk", dyg, vf)
+    ds = p * (dp - jnp.sum(p * dp, axis=-1, keepdims=True))
+    dq = jnp.einsum("bhgqk,bkhd->bqhgd", ds, kf) * scale
+    dk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qf) * scale
+
+    pos_ct = _np.zeros(jnp.shape(q_pos), jax.dtypes.float0)
+    return (dq.reshape(b, s, h, hd).astype(q.dtype),
+            dk.astype(k.dtype), dv.astype(v.dtype), pos_ct)
+
+
+_pfa_vjp.defvjp(_pfa_fwd, _pfa_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _pfa_impl(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_pos: jax.Array,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
     b, s, h, hd = q.shape
     smax, hkv = k.shape[1], k.shape[2]
     g = h // hkv
@@ -132,17 +192,10 @@ def prefill_attention_pallas(
 
 def prefill_attention_supported(q, k, v, q_pos, scale, logits_soft_cap,
                                 sliding_window, alibi_slopes) -> bool:
-    """Gate for the sdp_attention prefill dispatch."""
-    if q.shape[1] < 2 or alibi_slopes is not None:
-        return False
-    if logits_soft_cap is not None or sliding_window is not None:
-        return False
-    b, s, h, hd = q.shape
-    smax, hkv = k.shape[1], k.shape[2]
-    if h % hkv != 0 or hd % 64 != 0:
-        return False
-    if s % 128 != 0 or smax % 128 != 0:
-        return False
-    if k.dtype not in (jnp.bfloat16, jnp.float8_e5m2):
-        return False
-    return True
+    """Gate for the sdp_attention prefill dispatch (query-length
+    alignment on top of the shared geometry gate)."""
+    from bigdl_tpu.ops.pallas.decode_attention import attention_geometry_ok
+
+    return (q.shape[1] >= 2 and q.shape[1] % 128 == 0
+            and attention_geometry_ok(q, k, logits_soft_cap,
+                                      sliding_window, alibi_slopes))

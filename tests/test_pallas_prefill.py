@@ -98,3 +98,78 @@ def test_supported_gate():
     q2 = jnp.zeros((1, 100, 4, 64), jnp.bfloat16)
     assert not prefill_attention_supported(q2, k, v, pos, 0.125, None,
                                            None, None)
+
+
+def test_gradients_match_xla():
+    """jax.grad through the kernel (custom VJP) must equal grads of the
+    XLA attention — training paths dispatch here on TPU."""
+    import jax
+
+    q, k, v = _mk(1, 128, 128, 4, 2, 64, seed=7)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    pos = jnp.asarray(0, jnp.int32)
+
+    def loss_kernel(q_, k_, v_):
+        out = prefill_attention_pallas(q_, k_, v_, pos, 64 ** -0.5,
+                                       interpret=True)
+        return jnp.sum(jnp.square(out.astype(jnp.float32)))
+
+    def loss_xla(q_, k_, v_):
+        out = _xla(q_, k_, v_, pos)
+        return jnp.sum(jnp.square(out.astype(jnp.float32)))
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(qf, kf, vf)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(qf, kf, vf)
+    for a, b in zip(gk, gx):
+        # both paths round operands to bf16; the summed-squares loss
+        # amplifies that into ~1e-1 absolute noise on O(10) grads
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=1e-1)
+
+
+def test_trainable_through_forward_train():
+    """End to end: jax.grad through forward_train with kernel-aligned
+    shapes (the exact path the dispatch intercepts on TPU)."""
+    import jax
+
+    from bigdl_tpu.config import set_flags
+    from bigdl_tpu.models.llama import LlamaConfig, forward_train
+
+    D, FF, V, L, H = 32, 64, 48, 2, 4
+    cfg = LlamaConfig(vocab_size=V, hidden_size=D, intermediate_size=FF,
+                      num_hidden_layers=L, num_attention_heads=H,
+                      num_key_value_heads=H, tie_word_embeddings=True)
+    rng = np.random.default_rng(0)
+    t = lambda *s: jnp.asarray((rng.standard_normal(s) * 0.05
+                                ).astype(np.float32))
+    params = {"embed_tokens": t(V, D), "norm": jnp.ones((D,)),
+              "layers": {
+                  "q_proj": t(L, D, D), "k_proj": t(L, D, D),
+                  "v_proj": t(L, D, D), "o_proj": t(L, D, D),
+                  "gate_proj": t(L, D, FF), "up_proj": t(L, D, FF),
+                  "down_proj": t(L, FF, D),
+                  "input_layernorm": jnp.ones((L, D)),
+                  "post_attention_layernorm": jnp.ones((L, D))}}
+    toks = jnp.asarray(np.arange(128, dtype=np.int32)[None] % V)
+
+    def loss(p):
+        lg = forward_train(p, cfg, toks, compute_dtype=jnp.float32)
+        return jnp.mean(jnp.square(lg))
+
+    try:
+        set_flags(attention_backend="pallas")   # force kernel (interpret)
+        g_k = jax.grad(loss)(params)
+    finally:
+        set_flags(attention_backend="auto")
+    set_flags(attention_backend="xla")
+    try:
+        g_x = jax.grad(loss)(params)
+    finally:
+        set_flags(attention_backend="auto")
+    fa = jax.tree_util.tree_leaves(g_k)
+    fb = jax.tree_util.tree_leaves(g_x)
+    for a, b in zip(fa, fb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-2)
