@@ -1,0 +1,122 @@
+"""Benchmark result reports: JSON-lines -> CSV / HTML.
+
+Equivalent of the reference's benchmark reporting pipeline
+(test/benchmark/csv_to_html.py + the CSV outputs of
+dev/benchmark/all-in-one/run.py, wired into CI at
+.github/workflows/llm_performance_tests.yml:90-147). `bench/run.py`
+emits one JSON object per (model, qtype, in-out pair); this module turns
+a file of those lines into a CSV table and a self-contained HTML page,
+optionally diffing against a previous run (the check_results.py role).
+"""
+
+from __future__ import annotations
+
+import csv
+import html
+import json
+from typing import Any, Dict, List, Optional
+
+# bench/run.py's row schema (run_one's return dict); extra keys — e.g.
+# the *_prev/*_ratio columns diff_results adds — append after these
+_COLUMNS = ("model", "low_bit", "api", "in_out", "first_token_ms",
+            "rest_token_ms", "peak_memory")
+
+
+def _ordered_columns(results: List[Dict[str, Any]]) -> List[str]:
+    cols = [c for c in _COLUMNS if any(c in r for r in results)]
+    extra = sorted({k for r in results for k in r
+                    if k not in cols and not isinstance(r[k], (dict, list))})
+    return cols + extra
+
+
+def load_results(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def write_csv(results: List[Dict[str, Any]], path: str) -> None:
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=_ordered_columns(results),
+                           extrasaction="ignore")
+        w.writeheader()
+        for r in results:
+            w.writerow(r)
+
+
+def _key(r: Dict[str, Any]):
+    return (r.get("model"), r.get("low_bit"), r.get("api"),
+            r.get("in_out"))
+
+
+def diff_results(current: List[Dict[str, Any]],
+                 previous: List[Dict[str, Any]],
+                 field: str = "rest_token_ms") -> List[Dict[str, Any]]:
+    """Attach `<field>_prev` and `<field>_ratio` (prev/cur: >1 = faster
+    now) where a matching row exists in `previous`."""
+    prev = {_key(r): r for r in previous}
+    out = []
+    for r in current:
+        row = dict(r)
+        p = prev.get(_key(r))
+        if p is not None and p.get(field) and r.get(field):
+            row[f"{field}_prev"] = p[field]
+            row[f"{field}_ratio"] = round(p[field] / r[field], 3)
+        out.append(row)
+    return out
+
+
+def write_html(results: List[Dict[str, Any]], path: str,
+               title: str = "bigdl-tpu benchmark") -> None:
+    cols = _ordered_columns(results)
+    rows = []
+    for r in results:
+        tds = "".join(
+            f"<td>{html.escape(str(r.get(c, '')))}</td>" for c in cols)
+        rows.append(f"<tr>{tds}</tr>")
+    ths = "".join(f"<th>{html.escape(c)}</th>" for c in cols)
+    doc = (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{html.escape(title)}</title>"
+        "<style>body{font-family:sans-serif}table{border-collapse:"
+        "collapse}td,th{border:1px solid #999;padding:4px 8px;"
+        "text-align:right}th{background:#eee}</style></head><body>"
+        f"<h2>{html.escape(title)}</h2><table><tr>{ths}</tr>"
+        f"{''.join(rows)}</table></body></html>")
+    with open(path, "w") as f:
+        f.write(doc)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="bench results (JSON lines) -> csv/html report")
+    ap.add_argument("results", help="JSON-lines file from bench/run.py")
+    ap.add_argument("--csv", default=None)
+    ap.add_argument("--html", default=None)
+    ap.add_argument("--baseline", default=None,
+                    help="previous results file to diff against")
+    args = ap.parse_args(argv)
+
+    results = load_results(args.results)
+    if args.baseline:
+        results = diff_results(results, load_results(args.baseline))
+    if args.csv:
+        write_csv(results, args.csv)
+        print(f"wrote {args.csv}")
+    if args.html:
+        write_html(results, args.html)
+        print(f"wrote {args.html}")
+    if not (args.csv or args.html):
+        for r in results:
+            print(json.dumps(r))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -103,35 +103,30 @@ def _pfa_fwd(q, k, v, q_pos, scale, interpret):
 
 
 def _pfa_bwd(scale, interpret, res, dy):
+    """Backward via jax.vjp over the XLA reference attention — ONE source
+    of truth for the mask/GQA semantics (ops/attention.sdp_attention)
+    instead of a hand-derived gradient to keep in sync."""
     import numpy as _np
 
     q, k, v, q_pos = res
-    b, s, h, hd = q.shape
-    skv, hkv = k.shape[1], k.shape[2]
-    g = h // hkv
 
-    qf = q.reshape(b, s, hkv, g, hd).astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dyg = dy.reshape(b, s, hkv, g, hd).astype(jnp.float32)
+    def ref(q_, k_, v_):
+        from bigdl_tpu.config import flags, set_flags
+        from bigdl_tpu.ops.attention import sdp_attention
 
-    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf) * scale
-    pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
-    q_ids = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
-    k_ids = jnp.arange(skv, dtype=jnp.int32)
-    mask = k_ids[None, None, :] <= q_ids[:, :, None]        # [B, S, Skv]
-    scores = jnp.where(mask[:, None, None, :, :], scores, -jnp.inf)
-    p = jax.nn.softmax(scores, axis=-1)
+        prev = flags().attention_backend
+        set_flags(attention_backend="xla")
+        try:
+            return sdp_attention(q_, k_, v_, q_pos, scale=scale)
+        finally:
+            set_flags(attention_backend=prev)
 
-    dv = jnp.einsum("bhgqk,bqhgd->bkhd", p, dyg)
-    dp = jnp.einsum("bqhgd,bkhd->bhgqk", dyg, vf)
-    ds = p * (dp - jnp.sum(p * dp, axis=-1, keepdims=True))
-    dq = jnp.einsum("bhgqk,bkhd->bqhgd", ds, kf) * scale
-    dk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qf) * scale
-
+    _, vjp = jax.vjp(ref, q.astype(jnp.float32), k.astype(jnp.float32),
+                     v.astype(jnp.float32))
+    dq, dk, dv = vjp(dy.astype(jnp.float32))
     pos_ct = _np.zeros(jnp.shape(q_pos), jax.dtypes.float0)
-    return (dq.reshape(b, s, h, hd).astype(q.dtype),
-            dk.astype(k.dtype), dv.astype(v.dtype), pos_ct)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            pos_ct)
 
 
 _pfa_vjp.defvjp(_pfa_fwd, _pfa_bwd)

@@ -159,3 +159,45 @@ def test_public_exports():
 
     with _pytest.raises(AttributeError):
         bigdl_tpu.not_a_thing
+
+
+def test_report_csv_html_and_diff(tmp_path):
+    """bench/report.py: JSON-lines -> csv + html, with baseline diff
+    (the reference's csv_to_html/check_results role)."""
+    import json
+
+    from bigdl_tpu.bench.report import (diff_results, load_results,
+                                        write_csv, write_html)
+
+    # rows use bench/run.py's real schema (run_one's return dict)
+    cur = [{"model": "m", "low_bit": "sym_int4", "api": "transformers_int4",
+            "in_out": "32-8", "first_token_ms": 10.0, "rest_token_ms": 2.0,
+            "peak_memory": 0},
+           {"model": "m", "low_bit": "sym_int4", "api": "transformers_int4",
+            "in_out": "64-8", "first_token_ms": 20.0, "rest_token_ms": 2.5,
+            "peak_memory": 0}]
+    prev = [{"model": "m", "low_bit": "sym_int4", "api": "transformers_int4",
+             "in_out": "32-8", "first_token_ms": 12.0, "rest_token_ms": 3.0,
+             "peak_memory": 0},
+            {"model": "m", "low_bit": "sym_int4", "api": "transformers_int4",
+             "in_out": "64-8", "first_token_ms": 24.0, "rest_token_ms": 5.0,
+             "peak_memory": 0}]
+    p = tmp_path / "cur.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in cur))
+    assert load_results(str(p)) == cur
+
+    d = diff_results(cur, prev)
+    # per in-out pair ratios (keys must NOT collapse across pairs)
+    assert d[0]["rest_token_ms_ratio"] == 1.5
+    assert d[1]["rest_token_ms_ratio"] == 2.0
+
+    csvp = tmp_path / "r.csv"
+    write_csv(d, str(csvp))
+    csv_text = csvp.read_text()
+    assert "sym_int4" in csv_text and "32-8" in csv_text
+    assert "rest_token_ms_ratio" in csv_text     # diff columns survive
+
+    htmlp = tmp_path / "r.html"
+    write_html(d, str(htmlp))
+    body = htmlp.read_text()
+    assert "<table>" in body and "rest_token_ms_ratio" in body
