@@ -1,0 +1,91 @@
+"""QLoRA finetune-step benchmark: Llama2-7B INT4 base + rank-16 adapters.
+
+The reference's second headline number is QLoRA Alpaca finetuning time
+(21 min for Llama2-7B on 8x Max 1550 — BASELINE.md). Steps/s here x the
+Alpaca step count gives the single-chip equivalent; the multi-chip path
+is the same train step under the dp/fsdp mesh (__graft_entry__.py).
+
+Run: python bench_qlora.py [--steps N]
+Prints ONE JSON line {"metric", "value", "unit", ...} like bench.py.
+(Not driver-run: bench.py stays the headline; this is the training-side
+evidence.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench import _probe_backend
+
+    if not _probe_backend():
+        print("bench_qlora: backend unresponsive; falling back to CPU",
+              file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from bigdl_tpu.models import llama as llama_mod
+    from bigdl_tpu.qlora import LoraConfig, attach_lora, \
+        lora_trainable_mask
+    from bigdl_tpu.training import make_lora_train_step, partition
+    from bigdl_tpu.utils.testing import LLAMA2_7B, TINY_LLAMA, \
+        random_llama_params
+
+    steps = 8
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = LLAMA2_7B if on_tpu else TINY_LLAMA
+    batch, seq = (2, 512) if on_tpu else (1, 64)
+
+    params = random_llama_params(cfg, qtype="sym_int4")
+    params = attach_lora(params, LoraConfig(r=16, training_mode="qlora"))
+    jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+
+    mask = lora_trainable_mask(params)
+    train, frozen = partition(params, mask)
+    optimizer = optax.adamw(1e-4)
+    step = make_lora_train_step(llama_mod.forward_train, cfg, optimizer)
+    opt_state = optimizer.init(train)
+    batch_data = {
+        "input_ids": jnp.ones((batch, seq), jnp.int32),
+        "attention_mask": jnp.ones((batch, seq), jnp.int32),
+    }
+
+    train, opt_state, loss = step(train, opt_state, frozen, batch_data)
+    jax.block_until_ready(loss)                                # compile
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        train, opt_state, loss = step(train, opt_state, frozen, batch_data)
+    jax.block_until_ready(loss)
+    per_step_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    tokens_per_s = batch * seq / (per_step_ms / 1e3)
+    print(json.dumps({
+        "metric": "llama2_7b_qlora_step_time",
+        "value": round(per_step_ms, 2),
+        "unit": "ms",
+        "tokens_per_s": round(tokens_per_s, 1),
+        "batch": batch,
+        "seq_len": seq,
+        "lora_rank": 16,
+        "backend": jax.default_backend(),
+        "model": "llama2-7b" if on_tpu else "tiny-llama(cpu-fallback)",
+        "loss": float(loss),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,7 @@ def sdp_attention(
     logits_soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
     alibi_slopes: Optional[jax.Array] = None,   # [H] f32 (bloom families)
+    backend: Optional[str] = None,   # overrides flags().attention_backend
 ) -> jax.Array:
     """Causal SDP against a (possibly partially-filled) KV cache.
 
@@ -92,7 +93,7 @@ def sdp_attention(
 
     from bigdl_tpu.config import flags
 
-    be = flags().attention_backend
+    be = backend or flags().attention_backend
     if be in ("auto", "pallas"):
         from bigdl_tpu.ops.pallas.decode_attention import (
             decode_attention_pallas, decode_attention_supported)

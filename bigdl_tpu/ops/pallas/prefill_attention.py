@@ -105,25 +105,22 @@ def _pfa_fwd(q, k, v, q_pos, scale, interpret):
 def _pfa_bwd(scale, interpret, res, dy):
     """Backward via jax.vjp over the XLA reference attention — ONE source
     of truth for the mask/GQA semantics (ops/attention.sdp_attention)
-    instead of a hand-derived gradient to keep in sync."""
+    instead of a hand-derived gradient to keep in sync. Gradient
+    precision therefore equals differentiating the XLA path itself
+    (bf16 matmul operands, f32 softmax/accumulation) — exactly what
+    non-kernel training runs get."""
     import numpy as _np
 
     q, k, v, q_pos = res
 
     def ref(q_, k_, v_):
-        from bigdl_tpu.config import flags, set_flags
         from bigdl_tpu.ops.attention import sdp_attention
 
-        prev = flags().attention_backend
-        set_flags(attention_backend="xla")
-        try:
-            return sdp_attention(q_, k_, v_, q_pos, scale=scale)
-        finally:
-            set_flags(attention_backend=prev)
+        return sdp_attention(q_, k_, v_, q_pos, scale=scale,
+                             backend="xla")
 
-    _, vjp = jax.vjp(ref, q.astype(jnp.float32), k.astype(jnp.float32),
-                     v.astype(jnp.float32))
-    dq, dk, dv = vjp(dy.astype(jnp.float32))
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp(dy.astype(q.dtype))
     pos_ct = _np.zeros(jnp.shape(q_pos), jax.dtypes.float0)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             pos_ct)
