@@ -819,6 +819,94 @@ def _stablelm_cfg(hf: Dict[str, Any]) -> LlamaConfig:
 
 
 # ---------------------------------------------------------------------------
+# GPT-BigCode (starcoder v1) — LEARNED positions, MQA (1 kv head), LN,
+# dense gelu MLP, fused c_attn = [q(D) | k(hd) | v(hd)]
+# (reference transformers/models/gptbigcode.py — forward_qk fused kernel)
+# ---------------------------------------------------------------------------
+
+def _gptbigcode_cfg(hf: Dict[str, Any]) -> LlamaConfig:
+    d = hf["n_embd"]
+    h = hf["n_head"]
+    act = hf.get("activation_function", "gelu_pytorch_tanh")
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=d,
+        intermediate_size=hf.get("n_inner") or 4 * d,
+        num_hidden_layers=hf["n_layer"],
+        num_attention_heads=h,
+        num_key_value_heads=1 if hf.get("multi_query", True) else h,
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        max_position_embeddings=hf.get("n_positions", 8192),
+        tie_word_embeddings=hf.get("tie_word_embeddings", True),
+        attention_bias=True,
+        mlp_bias=True,
+        norm_type="layernorm",
+        mlp_gated=False,
+        hidden_act="gelu" if act == "gelu" else "gelu_tanh",
+        use_rope=False,
+        learned_positions=True,
+    )
+
+
+def _gptbigcode_split_qkv(w, cfg):
+    """c_attn rows: MQA = [q(D) | k(hd) | v(hd)] block layout; MHA = the
+    gpt2 per-head interleave [q_h | k_h | v_h] x H (HF reshapes to
+    (H, 3*hd) and splits per head)."""
+    d, hd = cfg.hidden_size, cfg.hd
+    if cfg.num_key_value_heads == 1:
+        return _split_rows(w, [d, hd, hd])
+    return _deinterleave_qkv(w, cfg.num_attention_heads, hd)
+
+
+def _gptbigcode_map(acc: _Acc, name: str, w) -> None:
+    cfg = acc.cfg
+    name_ = name[len("transformer."):] if name.startswith("transformer.") \
+        else name
+    if name_ == "wte.weight":
+        acc.top["embed_tokens"] = acc.dense(w)
+    elif name_ == "wpe.weight":
+        acc.top["embed_positions"] = acc.dense(w)
+    elif name_ == "ln_f.weight":
+        acc.top["norm"] = acc.dense(w)
+    elif name_ == "ln_f.bias":
+        acc.top["norm_bias"] = acc.dense(w)
+    elif name_ == "lm_head.weight":      # untied checkpoints
+        acc.top["lm_head"] = acc.linear(name, w)
+    else:
+        hit = _layer_idx(name_, "h.")
+        if hit is None:
+            return
+        idx, sub = hit
+        if sub == "attn.c_attn.weight":
+            q, k, v = _gptbigcode_split_qkv(w, cfg)
+            acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+            acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+            acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
+        elif sub == "attn.c_attn.bias":
+            q, k, v = _gptbigcode_split_qkv(w, cfg)
+            acc.put("q_proj_bias", idx, acc.dense(q))
+            acc.put("k_proj_bias", idx, acc.dense(k))
+            acc.put("v_proj_bias", idx, acc.dense(v))
+        else:
+            m = {
+                "attn.c_proj.weight": ("o_proj", "linear"),
+                "attn.c_proj.bias": ("o_proj_bias", "dense"),
+                "mlp.c_fc.weight": ("up_proj", "linear"),
+                "mlp.c_fc.bias": ("up_proj_bias", "dense"),
+                "mlp.c_proj.weight": ("down_proj", "linear"),
+                "mlp.c_proj.bias": ("down_proj_bias", "dense"),
+                "ln_1.weight": ("input_layernorm", "dense"),
+                "ln_1.bias": ("input_layernorm_bias", "dense"),
+                "ln_2.weight": ("post_attention_layernorm", "dense"),
+                "ln_2.bias": ("post_attention_layernorm_bias", "dense"),
+            }.get(sub)
+            if m:
+                key, kind = m
+                acc.put(key, idx, acc.linear(name, w) if kind == "linear"
+                        else acc.dense(w))
+
+
+# ---------------------------------------------------------------------------
 # Phixtral — phi-2 body (parallel residual, ONE shared LN, biases, partial
 # rotary, gelu) with a mixture of dense fc1/fc2 experts
 # (reference transformers/models/phixtral.py:73-138)
@@ -984,6 +1072,9 @@ def register_all() -> None:
     # transformers/models/qwen_vl.py — the ViT tower stays unquantized)
     register_family(["QWenLMHeadModel"],
                     _adapter("qwen", _qwen1_cfg, _qwen1_map))
+    register_family(["GPTBigCodeForCausalLM"],
+                    _adapter("gptbigcode", _gptbigcode_cfg,
+                             _gptbigcode_map))
     register_family(["PhixtralForCausalLM"], FamilyAdapter(
         name="phixtral",
         config_from_hf=_phixtral_cfg,

@@ -94,6 +94,7 @@ class LlamaConfig:
     rope_interleaved: bool = False      # gptj/chatglm rotation convention
     rotary_dim: Optional[int] = None    # partial rotary (gptneox/phi)
     use_rope: bool = True               # False for alibi families
+    learned_positions: bool = False     # gptbigcode/gpt2: wpe table added
     parallel_residual: bool = False     # x + attn(n1(x)) + mlp(n2(x))
     shared_input_norm: bool = False     # phi/falcon-7b: mlp reuses n1(x)
     use_alibi: bool = False             # bloom/baichuan-13b
@@ -442,9 +443,14 @@ def forward(
     if getattr(pos, "ndim", 0) == 1:   # per-slot positions (serving)
         positions = pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None, :]
         cos, sin = rope_cos_sin(positions, inv_freq)       # [B, Sq, hd/2]
+        if cfg.learned_positions:
+            x = x + params["embed_positions"][positions].astype(x.dtype)
     else:
         positions = pos + jnp.arange(sq, dtype=jnp.int32)
         cos, sin = rope_cos_sin(positions[None, :], inv_freq)  # [1, Sq, hd/2]
+        if cfg.learned_positions:
+            x = x + params["embed_positions"][positions].astype(
+                x.dtype)[None]
     if rope_mscale != 1.0:             # yarn attention temperature
         cos, sin = cos * rope_mscale, sin * rope_mscale
     slopes = (jnp.asarray(alibi_slopes(cfg.num_attention_heads))
@@ -503,6 +509,8 @@ def forward_train(
         x = _norm(x, params["embed_norm"], params.get("embed_norm_bias"), cfg)
     inv_freq, rope_mscale = model_rope_freqs(cfg)
     positions = pos_offset + jnp.arange(s, dtype=jnp.int32)
+    if cfg.learned_positions:
+        x = x + params["embed_positions"][positions].astype(x.dtype)[None]
     cos, sin = rope_cos_sin(positions[None, :], inv_freq)
     if rope_mscale != 1.0:             # yarn attention temperature
         cos, sin = cos * rope_mscale, sin * rope_mscale

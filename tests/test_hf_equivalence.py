@@ -66,6 +66,19 @@ CASES = {
         num_key_value_heads=2, head_dim=16, query_pre_attn_scalar=16,
         attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
         sliding_window=64, hidden_act="gelu_pytorch_tanh")),
+    "gptbigcode": ("GPTBigCodeConfig", "GPTBigCodeForCausalLM", dict(
+        vocab_size=V, n_embd=D, n_layer=L, n_head=H, n_positions=64,
+        n_inner=FF, multi_query=True,
+        activation_function="gelu_pytorch_tanh")),
+    # MHA variant: per-head interleaved c_attn + exact-erf gelu
+    "gptbigcode_mha": ("GPTBigCodeConfig", "GPTBigCodeForCausalLM", dict(
+        vocab_size=V, n_embd=D, n_layer=L, n_head=H, n_positions=64,
+        n_inner=FF, multi_query=False, activation_function="gelu")),
+    "mixtral": ("MixtralConfig", "MixtralForCausalLM", dict(
+        vocab_size=V, hidden_size=D, intermediate_size=FF,
+        num_hidden_layers=L, num_attention_heads=H,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2)),
 }
 
 
