@@ -167,7 +167,6 @@ def collect_imatrix(params: Dict[str, Any], cfg, tokens,
     parallel residual, alternating sliding windows, ...) by construction.
     """
     from bigdl_tpu.models import llama as M
-    from bigdl_tpu.ops.embedding import embedding_lookup
     from bigdl_tpu.ops.rope import rope_cos_sin
 
     tokens = jnp.asarray(np.asarray(tokens, np.int32))
@@ -175,15 +174,10 @@ def collect_imatrix(params: Dict[str, Any], cfg, tokens,
         tokens = tokens[None]
     b, s = tokens.shape
 
-    x = embedding_lookup(params["embed_tokens"], tokens, compute_dtype)
-    if cfg.embed_scale != 1.0:
-        x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
-    if cfg.embed_norm:
-        x = M._norm(x, params["embed_norm"], params.get("embed_norm_bias"),
-                    cfg)
+    positions = jnp.arange(s, dtype=jnp.int32)
+    x = M.embed_prologue(params, cfg, tokens, positions, compute_dtype)
 
     inv_freq, rope_mscale = M.model_rope_freqs(cfg)
-    positions = jnp.arange(s, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions[None, :], inv_freq)
     if rope_mscale != 1.0:
         cos, sin = cos * rope_mscale, sin * rope_mscale

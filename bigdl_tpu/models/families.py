@@ -827,7 +827,7 @@ def _stablelm_cfg(hf: Dict[str, Any]) -> LlamaConfig:
 def _gptbigcode_cfg(hf: Dict[str, Any]) -> LlamaConfig:
     d = hf["n_embd"]
     h = hf["n_head"]
-    act = hf.get("activation_function", "gelu_pytorch_tanh")
+    act_map = {"gelu": "gelu", "relu": "relu"}   # tanh approximants below
     return LlamaConfig(
         vocab_size=hf["vocab_size"],
         hidden_size=d,
@@ -842,7 +842,9 @@ def _gptbigcode_cfg(hf: Dict[str, Any]) -> LlamaConfig:
         mlp_bias=True,
         norm_type="layernorm",
         mlp_gated=False,
-        hidden_act="gelu" if act == "gelu" else "gelu_tanh",
+        hidden_act=act_map.get(
+            hf.get("activation_function", "gelu_pytorch_tanh"),
+            "gelu_tanh"),
         use_rope=False,
         learned_positions=True,
     )

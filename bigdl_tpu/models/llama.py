@@ -207,6 +207,28 @@ def _norm(x, w, b, cfg: LlamaConfig):
     return rms_norm(x, w, cfg.rms_norm_eps)
 
 
+def embed_prologue(params, cfg: LlamaConfig, tokens, positions,
+                   compute_dtype):
+    """Token embedding + scale + embedding norm + learned positions.
+
+    THE one copy of the embed stage — forward/forward_train here,
+    the pipeline schedule (parallel/pp.py) and imatrix calibration all
+    call it, so a new config knob lands everywhere at once. `positions`
+    is [Sq] (shared) or [B, Sq] (per-slot serving)."""
+    x = embedding_lookup(params["embed_tokens"], tokens, compute_dtype)
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
+    if cfg.embed_norm:
+        x = _norm(x, params["embed_norm"], params.get("embed_norm_bias"),
+                  cfg)
+    if cfg.learned_positions:
+        pe = params["embed_positions"][positions].astype(x.dtype)
+        if pe.ndim == 2:                  # positions [Sq]: add batch axis
+            pe = pe[None]
+        x = x + pe
+    return x
+
+
 _ACTS = {
     "silu": jax.nn.silu,
     "gelu": functools.partial(jax.nn.gelu, approximate=False),
@@ -433,24 +455,14 @@ def forward(
     b, sq = tokens.shape
     pos = cache.pos
 
-    x = embedding_lookup(params["embed_tokens"], tokens, compute_dtype)
-    if cfg.embed_scale != 1.0:
-        x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
-    if cfg.embed_norm:
-        x = _norm(x, params["embed_norm"], params.get("embed_norm_bias"), cfg)
-
     inv_freq, rope_mscale = model_rope_freqs(cfg)
     if getattr(pos, "ndim", 0) == 1:   # per-slot positions (serving)
         positions = pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None, :]
         cos, sin = rope_cos_sin(positions, inv_freq)       # [B, Sq, hd/2]
-        if cfg.learned_positions:
-            x = x + params["embed_positions"][positions].astype(x.dtype)
     else:
         positions = pos + jnp.arange(sq, dtype=jnp.int32)
         cos, sin = rope_cos_sin(positions[None, :], inv_freq)  # [1, Sq, hd/2]
-        if cfg.learned_positions:
-            x = x + params["embed_positions"][positions].astype(
-                x.dtype)[None]
+    x = embed_prologue(params, cfg, tokens, positions, compute_dtype)
     if rope_mscale != 1.0:             # yarn attention temperature
         cos, sin = cos * rope_mscale, sin * rope_mscale
     slopes = (jnp.asarray(alibi_slopes(cfg.num_attention_heads))
@@ -502,15 +514,9 @@ def forward_train(
     offsets — the model body is otherwise unchanged.
     """
     b, s = tokens.shape
-    x = embedding_lookup(params["embed_tokens"], tokens, compute_dtype)
-    if cfg.embed_scale != 1.0:
-        x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
-    if cfg.embed_norm:
-        x = _norm(x, params["embed_norm"], params.get("embed_norm_bias"), cfg)
     inv_freq, rope_mscale = model_rope_freqs(cfg)
     positions = pos_offset + jnp.arange(s, dtype=jnp.int32)
-    if cfg.learned_positions:
-        x = x + params["embed_positions"][positions].astype(x.dtype)[None]
+    x = embed_prologue(params, cfg, tokens, positions, compute_dtype)
     cos, sin = rope_cos_sin(positions[None, :], inv_freq)
     if rope_mscale != 1.0:             # yarn attention temperature
         cos, sin = cos * rope_mscale, sin * rope_mscale

@@ -131,16 +131,9 @@ def _pp_apply(params, cfg, tokens, mesh, num_microbatches, compute_dtype,
         ticks = mcount + pp - 1
         perm = [(i, (i + 1) % pp) for i in range(pp)]
 
-        from bigdl_tpu.ops.embedding import embedding_lookup
-
         def embed(toks):
-            x = embedding_lookup(top["embed_tokens"], toks, compute_dtype)
-            if cfg.embed_scale != 1.0:
-                x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
-            if cfg.embed_norm:
-                x = M._norm(x, top["embed_norm"], top.get("embed_norm_bias"),
-                            cfg)
-            return x
+            return M.embed_prologue(top, cfg, toks, positions,
+                                    compute_dtype)
 
         d = cfg.hidden_size
 

@@ -29,18 +29,13 @@ def layer_hidden_states(
 ) -> np.ndarray:
     """Hidden state AFTER each decoder layer: [L, B, S, D] (cacheless)."""
     b, s = tokens.shape
-    x = llama_mod.embedding_lookup(params["embed_tokens"], tokens,
-                                   compute_dtype)
-    if cfg.embed_scale != 1.0:
-        x = x * jnp.asarray(cfg.embed_scale, compute_dtype)
-    if cfg.embed_norm:
-        x = llama_mod._norm(x, params["embed_norm"],
-                            params.get("embed_norm_bias"), cfg)
+    positions = jnp.arange(s, dtype=jnp.int32)
+    x = llama_mod.embed_prologue(params, cfg, tokens, positions,
+                                 compute_dtype)
     inv_freq, mscale = llama_mod.model_rope_freqs(cfg)
     from bigdl_tpu.ops.rope import rope_cos_sin
 
-    cos, sin = rope_cos_sin(jnp.arange(s, dtype=jnp.int32)[None, :],
-                            inv_freq)
+    cos, sin = rope_cos_sin(positions[None, :], inv_freq)
     if mscale != 1.0:
         cos, sin = cos * mscale, sin * mscale
     slopes = (jnp.asarray(llama_mod.alibi_slopes(cfg.num_attention_heads))

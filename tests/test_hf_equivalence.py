@@ -110,3 +110,34 @@ def test_logits_match_hf(family, tmp_path):
     got = np.asarray(logits)
     np.testing.assert_allclose(got, want, rtol=4e-3, atol=4e-3)
     assert np.argmax(got, -1).tolist() == np.argmax(want, -1).tolist()
+
+
+def test_gptbigcode_decode_matches_prefill(tmp_path):
+    """Learned positions must advance with the cache offset: stepwise
+    decode equals full prefill."""
+    torch.manual_seed(1)
+    cfg_cls, model_cls, kw = CASES["gptbigcode"]
+    ref = getattr(transformers, model_cls)(
+        getattr(transformers, cfg_cls)(**kw)).eval()
+    ref.save_pretrained(tmp_path)
+
+    from bigdl_tpu.models.registry import get_family
+    from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
+
+    hf = load_hf_config(str(tmp_path))
+    fam = get_family(hf["architectures"][0])
+    cfg = fam.config_from_hf(hf)
+    params = fam.convert_params(iter_hf_tensors(str(tmp_path)), cfg,
+                                qtype=None, compute_dtype=jnp.float32)
+    toks = TOKENS[:, :6]
+    full, _ = fam.forward(params, cfg, jnp.asarray(toks),
+                          fam.new_cache(cfg, 1, 32),
+                          compute_dtype=jnp.float32)
+    cache = fam.new_cache(cfg, 1, 32)
+    steps = []
+    for i in range(toks.shape[1]):
+        lg, cache = fam.forward(params, cfg, jnp.asarray(toks[:, i:i + 1]),
+                                cache, compute_dtype=jnp.float32)
+        steps.append(np.asarray(lg[:, 0]))
+    np.testing.assert_allclose(np.asarray(full), np.stack(steps, 1),
+                               rtol=3e-3, atol=3e-3)
