@@ -51,6 +51,12 @@ class _BertTaskModel:
 
     __call__ = forward
 
+    def save_low_bit(self, path: str) -> None:
+        from bigdl_tpu.transformers import lowbit_io
+
+        lowbit_io.save_low_bit(self.params, path, config=self.hf_config,
+                               family="bert", qtype=self.qtype)
+
     @classmethod
     def from_pretrained(
         cls,
@@ -60,9 +66,30 @@ class _BertTaskModel:
         modules_to_not_convert=(),
         **_ignored,
     ):
+        from bigdl_tpu.transformers import lowbit_io
         from bigdl_tpu.transformers.model import _resolve_qtype
 
         path = pretrained_model_name_or_path
+        if lowbit_io.is_low_bit_dir(path):
+            params, manifest = lowbit_io.load_low_bit(path)
+            hf_config = manifest["config"]
+            archs = tuple(hf_config.get("architectures") or ("?",))
+            # shared REQUIRED_KEYS can't distinguish classifier-style
+            # heads (seq/token/choice); the saved architecture can
+            if cls.ACCEPT_ARCHS and archs[0] not in cls.ACCEPT_ARCHS:
+                raise ValueError(
+                    f"low-bit checkpoint at {path} was saved from "
+                    f"{archs[0]!r}; {cls.__name__} supports "
+                    f"{cls.ACCEPT_ARCHS}")
+            missing = [k for k in cls.REQUIRED_KEYS if k not in params]
+            if missing:
+                raise ValueError(
+                    f"low-bit checkpoint at {path} has no {missing} — "
+                    f"saved from a different task head than {cls.__name__}")
+            model = cls(params, B.BertConfig.from_hf(hf_config), hf_config,
+                        manifest.get("bigdl_tpu_low_bit"))
+            model.model_path = path
+            return model
         hf_config = load_hf_config(path)
         archs = tuple(hf_config.get("architectures") or ("?",))
         if cls.ACCEPT_ARCHS and archs[0] not in cls.ACCEPT_ARCHS:

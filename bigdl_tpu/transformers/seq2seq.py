@@ -63,6 +63,14 @@ class TpuSpeechSeq2Seq:
             mel = mel[None]
         return self._encode(self.params, self.config, mel)
 
+    def save_low_bit(self, path: str) -> None:
+        """Persist the quantized pytree (reference: optimize_model attaches
+        save_low_bit to ANY model incl. whisper, optimize.py:41-56)."""
+        from bigdl_tpu.transformers import lowbit_io
+
+        lowbit_io.save_low_bit(self.params, path, config=self.hf_config,
+                               family="whisper", qtype=self.qtype)
+
     def generate(
         self,
         input_features,                   # [B, n_mels, T] log-mel
@@ -112,6 +120,12 @@ class TpuSeq2SeqLM:
                                donate_argnums=(3,))
         self._init_cache = jax.jit(Bt.init_decoder_cache,
                                    static_argnums=(1, 3, 4))
+
+    def save_low_bit(self, path: str) -> None:
+        from bigdl_tpu.transformers import lowbit_io
+
+        lowbit_io.save_low_bit(self.params, path, config=self.hf_config,
+                               family="bart", qtype=self.qtype)
 
     def generate(
         self,
@@ -176,9 +190,22 @@ class AutoModelForSeq2SeqLM:
         **_ignored,
     ) -> TpuSeq2SeqLM:
         from bigdl_tpu.models import bart as Bt
+        from bigdl_tpu.transformers import lowbit_io
         from bigdl_tpu.transformers.model import _resolve_qtype
 
         path = pretrained_model_name_or_path
+        if lowbit_io.is_low_bit_dir(path):
+            params, manifest = lowbit_io.load_low_bit(path)
+            hf_config = manifest["config"]
+            archs = hf_config.get("architectures") or ["?"]
+            if archs[0] not in cls._ARCHS:
+                raise ValueError(
+                    f"low-bit checkpoint at {path} was saved from "
+                    f"{archs[0]!r}; AutoModelForSeq2SeqLM supports "
+                    f"{cls._ARCHS}")
+            return TpuSeq2SeqLM(params, Bt.BartConfig.from_hf(hf_config),
+                                hf_config, manifest.get("bigdl_tpu_low_bit"),
+                                model_path=path)
         hf_config = load_hf_config(path)
         archs = hf_config.get("architectures") or ["?"]
         if archs[0] not in cls._ARCHS:
@@ -213,9 +240,22 @@ class AutoModelForSpeechSeq2Seq:
         imatrix=None,
         **_ignored,
     ) -> TpuSpeechSeq2Seq:
+        from bigdl_tpu.transformers import lowbit_io
         from bigdl_tpu.transformers.model import _resolve_qtype
 
         path = pretrained_model_name_or_path
+        if lowbit_io.is_low_bit_dir(path):
+            params, manifest = lowbit_io.load_low_bit(path)
+            hf_config = manifest["config"]
+            archs = hf_config.get("architectures") or ["?"]
+            if archs[0] != "WhisperForConditionalGeneration":
+                raise ValueError(
+                    f"low-bit checkpoint at {path} was saved from "
+                    f"{archs[0]!r}; AutoModelForSpeechSeq2Seq loads "
+                    "whisper checkpoints")
+            return TpuSpeechSeq2Seq(
+                params, W.WhisperConfig.from_hf(hf_config), hf_config,
+                manifest.get("bigdl_tpu_low_bit"), model_path=path)
         hf_config = load_hf_config(path)
         archs = hf_config.get("architectures") or ["?"]
         if archs[0] != "WhisperForConditionalGeneration":

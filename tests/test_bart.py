@@ -177,3 +177,18 @@ def test_quantized_and_guards(tiny_bart):
         json.dump({"architectures": ["LlamaForCausalLM"]},
                   open(os.path.join(d, "config.json"), "w"))
         AutoModelForSeq2SeqLM.from_pretrained(d)
+
+
+def test_save_load_low_bit_roundtrip(tiny_bart):
+    path, _ = tiny_bart
+    import tempfile
+
+    from bigdl_tpu.transformers import AutoModelForSeq2SeqLM
+
+    m = AutoModelForSeq2SeqLM.from_pretrained(path, load_in_4bit=True)
+    want = m.generate(SRC, max_new_tokens=4)
+    d = tempfile.mkdtemp()
+    m.save_low_bit(d)
+    m2 = AutoModelForSeq2SeqLM.from_pretrained(d)
+    got = m2.generate(SRC, max_new_tokens=4)
+    np.testing.assert_array_equal(got, want)

@@ -127,3 +127,33 @@ def test_quantized_head_runs(tmp_path):
         from bigdl_tpu.transformers import AutoModelForQuestionAnswering
 
         AutoModelForQuestionAnswering.from_pretrained(str(tmp_path))
+
+
+def test_save_load_low_bit_roundtrip(tmp_path):
+    from transformers import BertForSequenceClassification
+
+    torch.manual_seed(9)
+    BertForSequenceClassification(_cfg(num_labels=3)).eval().save_pretrained(
+        tmp_path / "src")
+
+    from bigdl_tpu.transformers import (AutoModelForQuestionAnswering,
+                                        AutoModelForSequenceClassification)
+
+    m = AutoModelForSequenceClassification.from_pretrained(
+        str(tmp_path / "src"), load_in_4bit=True)
+    want = m(IDS, MASK)
+    d = tmp_path / "lb"
+    m.save_low_bit(str(d))
+    m2 = AutoModelForSequenceClassification.from_pretrained(str(d))
+    np.testing.assert_allclose(m2(IDS, MASK), want, rtol=1e-5)
+
+    # a different head class must refuse the checkpoint with a clear error
+    with pytest.raises(ValueError, match="supports"):
+        AutoModelForQuestionAnswering.from_pretrained(str(d))
+
+    # classifier-style heads share REQUIRED_KEYS; the saved architecture
+    # must still disambiguate
+    from bigdl_tpu.transformers import AutoModelForTokenClassification
+
+    with pytest.raises(ValueError, match="supports"):
+        AutoModelForTokenClassification.from_pretrained(str(d))

@@ -149,3 +149,26 @@ def test_wrong_arch_rejected(tiny_whisper, tmp_path):
               open(d / "config.json", "w"))
     with pytest.raises(ValueError, match="whisper"):
         AutoModelForSpeechSeq2Seq.from_pretrained(str(d))
+
+
+def test_save_load_low_bit_roundtrip(tiny_whisper):
+    path, _ = tiny_whisper
+    import tempfile
+
+    from bigdl_tpu.transformers import AutoModelForSpeechSeq2Seq
+
+    m = AutoModelForSpeechSeq2Seq.from_pretrained(path, load_in_4bit=True)
+    mel = _mel(seed=9)
+    want = m.generate(mel, max_new_tokens=5)
+    d = tempfile.mkdtemp()
+    m.save_low_bit(d)
+    m2 = AutoModelForSpeechSeq2Seq.from_pretrained(d)
+    got = m2.generate(mel, max_new_tokens=5)
+    np.testing.assert_array_equal(got, want)
+    assert m2.qtype == "sym_int4"
+
+    # a whisper low-bit dir must not load as bart
+    from bigdl_tpu.transformers import AutoModelForSeq2SeqLM
+
+    with pytest.raises(ValueError, match="saved from"):
+        AutoModelForSeq2SeqLM.from_pretrained(d)
