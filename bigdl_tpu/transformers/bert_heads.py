@@ -71,23 +71,13 @@ class _BertTaskModel:
 
         path = pretrained_model_name_or_path
         if lowbit_io.is_low_bit_dir(path):
-            params, manifest = lowbit_io.load_low_bit(path)
-            hf_config = manifest["config"]
-            archs = tuple(hf_config.get("architectures") or ("?",))
             # shared REQUIRED_KEYS can't distinguish classifier-style
             # heads (seq/token/choice); the saved architecture can
-            if cls.ACCEPT_ARCHS and archs[0] not in cls.ACCEPT_ARCHS:
-                raise ValueError(
-                    f"low-bit checkpoint at {path} was saved from "
-                    f"{archs[0]!r}; {cls.__name__} supports "
-                    f"{cls.ACCEPT_ARCHS}")
-            missing = [k for k in cls.REQUIRED_KEYS if k not in params]
-            if missing:
-                raise ValueError(
-                    f"low-bit checkpoint at {path} has no {missing} — "
-                    f"saved from a different task head than {cls.__name__}")
+            params, _, hf_config, qt = lowbit_io.load_low_bit_checked(
+                path, cls.ACCEPT_ARCHS, cls.__name__,
+                required_keys=cls.REQUIRED_KEYS)
             model = cls(params, B.BertConfig.from_hf(hf_config), hf_config,
-                        manifest.get("bigdl_tpu_low_bit"))
+                        qt)
             model.model_path = path
             return model
         hf_config = load_hf_config(path)

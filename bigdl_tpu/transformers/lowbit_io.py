@@ -124,6 +124,42 @@ def load_manifest(path: str) -> Dict[str, Any]:
         return json.load(f)
 
 
+def load_low_bit_checked(
+    path: str,
+    accept_archs: Tuple[str, ...],
+    class_name: str,
+    imatrix: Any = None,
+    required_keys: Tuple[str, ...] = (),
+) -> Tuple[Any, Dict[str, Any], Dict[str, Any], Optional[str]]:
+    """Manifest-first low-bit load for the facade classes: validates the
+    saved architecture (and head keys) BEFORE deserializing weights, and
+    rejects quantization-time kwargs, so a wrong-family multi-GB
+    checkpoint is refused without touching its tensors.
+
+    Returns (params, manifest, hf_config, qtype)."""
+    if imatrix is not None:
+        raise ValueError(
+            "imatrix applies at quantization time; this path is an "
+            "already-quantized save_low_bit directory — re-convert from "
+            "the original checkpoint with the imatrix")
+    manifest = load_manifest(path)
+    hf_config = manifest["config"]
+    archs = tuple(hf_config.get("architectures") or ("?",))
+    if accept_archs and archs[0] not in accept_archs:
+        raise ValueError(
+            f"low-bit checkpoint at {path} was saved from {archs[0]!r}; "
+            f"{class_name} supports {accept_archs}")
+    missing = [k for k in required_keys
+               if not any(leaf == k or leaf.startswith(f"{k}.")
+                          for leaf in manifest["leaves"])]
+    if missing:
+        raise ValueError(
+            f"low-bit checkpoint at {path} has no {missing} — saved from "
+            f"a different task head than {class_name}")
+    params, manifest = load_low_bit(path)
+    return params, manifest, hf_config, manifest.get(MARKER)
+
+
 def load_low_bit(path: str) -> Tuple[Any, Dict[str, Any]]:
     """Load (params pytree, manifest) saved by save_low_bit."""
     from safetensors.numpy import load_file

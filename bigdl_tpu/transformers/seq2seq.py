@@ -195,17 +195,10 @@ class AutoModelForSeq2SeqLM:
 
         path = pretrained_model_name_or_path
         if lowbit_io.is_low_bit_dir(path):
-            params, manifest = lowbit_io.load_low_bit(path)
-            hf_config = manifest["config"]
-            archs = hf_config.get("architectures") or ["?"]
-            if archs[0] not in cls._ARCHS:
-                raise ValueError(
-                    f"low-bit checkpoint at {path} was saved from "
-                    f"{archs[0]!r}; AutoModelForSeq2SeqLM supports "
-                    f"{cls._ARCHS}")
+            params, _, hf_config, qt = lowbit_io.load_low_bit_checked(
+                path, cls._ARCHS, "AutoModelForSeq2SeqLM", imatrix=imatrix)
             return TpuSeq2SeqLM(params, Bt.BartConfig.from_hf(hf_config),
-                                hf_config, manifest.get("bigdl_tpu_low_bit"),
-                                model_path=path)
+                                hf_config, qt, model_path=path)
         hf_config = load_hf_config(path)
         archs = hf_config.get("architectures") or ["?"]
         if archs[0] not in cls._ARCHS:
@@ -245,17 +238,12 @@ class AutoModelForSpeechSeq2Seq:
 
         path = pretrained_model_name_or_path
         if lowbit_io.is_low_bit_dir(path):
-            params, manifest = lowbit_io.load_low_bit(path)
-            hf_config = manifest["config"]
-            archs = hf_config.get("architectures") or ["?"]
-            if archs[0] != "WhisperForConditionalGeneration":
-                raise ValueError(
-                    f"low-bit checkpoint at {path} was saved from "
-                    f"{archs[0]!r}; AutoModelForSpeechSeq2Seq loads "
-                    "whisper checkpoints")
+            params, _, hf_config, qt = lowbit_io.load_low_bit_checked(
+                path, ("WhisperForConditionalGeneration",),
+                "AutoModelForSpeechSeq2Seq", imatrix=imatrix)
             return TpuSpeechSeq2Seq(
-                params, W.WhisperConfig.from_hf(hf_config), hf_config,
-                manifest.get("bigdl_tpu_low_bit"), model_path=path)
+                params, W.WhisperConfig.from_hf(hf_config), hf_config, qt,
+                model_path=path)
         hf_config = load_hf_config(path)
         archs = hf_config.get("architectures") or ["?"]
         if archs[0] != "WhisperForConditionalGeneration":

@@ -1,0 +1,81 @@
+"""Profiling helpers: traces + named regions around the hot loops.
+
+The reference has no tracer — its observability is BenchmarkWrapper's
+per-token timing (reference dev/benchmark/benchmark_util.py:489-520) and
+manual `torch.xpu.synchronize()` wall-clocks. On TPU the native story is
+`jax.profiler` (XLA device traces viewable in TensorBoard/Perfetto); this
+module makes it a one-liner around our entry points and keeps working on
+CPU test runs.
+
+    from bigdl_tpu.utils.profiling import trace, annotate
+
+    with trace("/tmp/tb"):                     # device + host trace
+        with annotate("prefill"):
+            model.generate(ids, max_new_tokens=64)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace into `log_dir` (TensorBoard format)."""
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=False,
+                             create_perfetto_trace=True)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region that shows up on the trace timeline (TraceAnnotation)
+    AND works as a no-op grouping label outside a trace."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StepTimer:
+    """Blocking wall-clock timer for steps (training loops, engine steps).
+
+    The per-phase analog of GenerationStats: `block_until_ready` on the
+    step output before reading the clock, so tunnel dispatch latency
+    doesn't masquerade as compute time."""
+
+    def __init__(self):
+        self.times: Dict[str, list] = {}
+
+    @contextlib.contextmanager
+    def measure(self, name: str, result=None) -> Iterator[None]:
+        t0 = time.perf_counter()
+        yield
+        if result is not None:
+            jax.block_until_ready(result)
+        self.times.setdefault(name, []).append(time.perf_counter() - t0)
+
+    def timed(self, name: str, fn, *args, **kwargs):
+        """Run fn, block on its output, record the wall time, return it."""
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        self.times.setdefault(name, []).append(time.perf_counter() - t0)
+        return out
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for name, ts in self.times.items():
+            out[name] = {
+                "count": len(ts),
+                "mean_ms": sum(ts) / len(ts) * 1e3,
+                "min_ms": min(ts) * 1e3,
+                "total_s": sum(ts),
+            }
+        return out

@@ -173,3 +173,31 @@ def test_lowbit_to_numpy_contiguous():
     bf_strided = np.broadcast_to(bf.reshape(3, 4).T, (4, 3))[:, ::-1]
     out, dt = _to_numpy(bf_strided)
     assert out.flags["C_CONTIGUOUS"] and dt == "bfloat16"
+
+
+def test_profiling_helpers(tmp_path):
+    """trace/annotate/StepTimer work on the CPU backend (jax.profiler
+    emits a TensorBoard/Perfetto trace directory)."""
+    import os
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu.utils.profiling import StepTimer, annotate, trace
+
+    d = str(tmp_path / "tb")
+    with trace(d):
+        with annotate("matmul"):
+            x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+            x.block_until_ready()
+    # a plugins/profile/<ts> dir with trace artifacts must exist
+    prof = os.path.join(d, "plugins", "profile")
+    assert os.path.isdir(prof) and os.listdir(prof)
+
+    t = StepTimer()
+    out = t.timed("step", lambda a: a @ a, jnp.ones((32, 32)))
+    assert out.shape == (32, 32)
+    with t.measure("region", result=out):
+        out2 = out + 1
+    s = t.summary()
+    assert s["step"]["count"] == 1 and s["step"]["mean_ms"] > 0
+    assert "region" in s
