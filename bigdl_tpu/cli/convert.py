@@ -3,7 +3,7 @@
 Equivalent of the reference's `llm_convert` CLI (reference
 convert_model.py:31-144: pth/HF -> ggml int4/int8 .bin, gptq -> ggml).
 Here: HF dir or .gguf -> quantized save_low_bit directory, or -> GGUF
-export (q4_0/q8_0) for llama.cpp interop.
+export (q4_0/q4_1/q5_0/q5_1/q8_0) for llama.cpp interop.
 """
 
 from __future__ import annotations
@@ -39,14 +39,24 @@ def main(argv=None) -> int:
         print(f"saved low-bit checkpoint to {args.outfile}")
         return 0
 
-    # GGUF export: dequantize leaves back to f32 and write q4_0/q8_0
+    # GGUF export: dequantize leaves back to f32, re-encode as ggml blocks
     import numpy as np
 
     from bigdl_tpu import gguf as G
     from bigdl_tpu.ops.quant import QTensor, dequantize
 
     cfg = model.config
-    gt = G.GGML_Q8_0 if "8" in args.outtype else G.GGML_Q4_0
+    # outtype was validated by from_pretrained above; qtypes without a
+    # matching ggml block format (nf4, fp4, iq*, ...) re-encode at the
+    # nearest width: 8-bit kinds as q8_0, everything else as q4_0
+    gt = {
+        "sym_int8": G.GGML_Q8_0, "int8": G.GGML_Q8_0, "q8_0": G.GGML_Q8_0,
+        "fp8": G.GGML_Q8_0, "fp8_e4m3": G.GGML_Q8_0,
+        "fp8_e5m2": G.GGML_Q8_0,
+        "asym_int4": G.GGML_Q4_1, "q4_1": G.GGML_Q4_1,
+        "sym_int5": G.GGML_Q5_0, "q5_0": G.GGML_Q5_0,
+        "asym_int5": G.GGML_Q5_1, "q5_1": G.GGML_Q5_1,
+    }.get(args.outtype, G.GGML_Q4_0)
 
     def dense_oi(leaf, idx=None):
         """Leaf -> dense HF-orientation [out, in] f32."""

@@ -20,7 +20,7 @@ and split-block nibble layout were chosen to match ggml exactly
 The only lossy step is fp16 -> bf16 scale conversion (TPU has no fp16
 compute; ~0.2% relative, far below int4 quantization noise).
 
-A minimal GGUF *writer* (f32/f16/q4_0/q8_0) is included for tests and for
+A minimal GGUF *writer* (f32/f16/q4_0/q4_1/q5_0/q5_1/q8_0) is included for tests and for
 exporting quantized checkpoints to the llama.cpp ecosystem.
 """
 
@@ -475,28 +475,81 @@ def _write_kv(f: BinaryIO, key: str, value: Any) -> None:
         raise TypeError(f"cannot write KV {key}={value!r}")
 
 
+def _safe_inv_np(d: np.ndarray) -> np.ndarray:
+    df = d.astype(np.float32)
+    return np.where(df == 0, 0.0, 1.0 / np.where(df == 0, 1.0, df))
+
+
 def _quantize_block_np(w: np.ndarray, gt: int) -> np.ndarray:
-    """numpy q4_0/q8_0 block quantizer for the writer. w: [N, K] f32."""
+    """numpy ggml block quantizer for the writer (q4_0/q4_1/q5_0/q5_1/
+    q8_0 — the same formats the reader imports bit-faithfully, so
+    write -> read round-trips exactly). w: [N, K] f32."""
     n, k = w.shape
     blk = w.reshape(n * k // 32, 32)
-    amax_i = np.argmax(np.abs(blk), axis=1)
-    mx = blk[np.arange(blk.shape[0]), amax_i]
+    nb = blk.shape[0]
+
+    def signed_absmax():
+        amax_i = np.argmax(np.abs(blk), axis=1)
+        return blk[np.arange(nb), amax_i]
+
+    F16_MAX = 65504.0
+
+    def f16(x):                                # clamp: f16 overflow would
+        return np.clip(x, -F16_MAX, F16_MAX).astype(np.float16)   # inf the
+
+    def pack_split_nibbles(q):                 # value j -> low nibble of
+        return (q[:, :16] & 0x0F) | (q[:, 16:] << 4)   # byte j; j+16 high
+
+    def high_bit_plane(q5):                    # qh bit i = bit4 of value i
+        bits = (q5 >> 4).astype(np.uint32)
+        return (bits << np.arange(32, dtype=np.uint32)[None, :]).sum(
+            axis=1, dtype=np.uint32)
+
     if gt == GGML_Q4_0:
-        d = (mx / -8.0).astype(np.float16)
-        inv = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1.0,
-                                                   d.astype(np.float32)))
-        q = np.clip(np.round(blk * inv[:, None]) + 8, 0, 15).astype(np.uint8)
-        qs = (q[:, :16] | (q[:, 16:] << 4))
-        out = np.empty((blk.shape[0], 18), np.uint8)
+        d = f16(signed_absmax() / -8.0)
+        q = np.clip(np.round(blk * _safe_inv_np(d)[:, None]) + 8,
+                    0, 15).astype(np.uint8)
+        out = np.empty((nb, 18), np.uint8)
         out[:, :2] = d[:, None].view(np.uint8)
-        out[:, 2:] = qs
+        out[:, 2:] = pack_split_nibbles(q)
+        return out.reshape(-1)
+    if gt == GGML_Q4_1:
+        mn = blk.min(axis=1)
+        d = f16((blk.max(axis=1) - mn) / 15.0)
+        q = np.clip(np.round((blk - mn[:, None])
+                             * _safe_inv_np(d)[:, None]),
+                    0, 15).astype(np.uint8)
+        out = np.empty((nb, 20), np.uint8)
+        out[:, :2] = d[:, None].view(np.uint8)
+        out[:, 2:4] = f16(mn)[:, None].view(np.uint8)
+        out[:, 4:] = pack_split_nibbles(q)
+        return out.reshape(-1)
+    if gt == GGML_Q5_0:
+        d = f16(signed_absmax() / -16.0)
+        q = np.clip(np.round(blk * _safe_inv_np(d)[:, None]) + 16,
+                    0, 31).astype(np.uint8)
+        out = np.empty((nb, 22), np.uint8)
+        out[:, :2] = d[:, None].view(np.uint8)
+        out[:, 2:6] = high_bit_plane(q)[:, None].view(np.uint8)
+        out[:, 6:] = pack_split_nibbles(q & 0x0F)
+        return out.reshape(-1)
+    if gt == GGML_Q5_1:
+        mn = blk.min(axis=1)
+        d = f16((blk.max(axis=1) - mn) / 31.0)
+        q = np.clip(np.round((blk - mn[:, None])
+                             * _safe_inv_np(d)[:, None]),
+                    0, 31).astype(np.uint8)
+        out = np.empty((nb, 24), np.uint8)
+        out[:, :2] = d[:, None].view(np.uint8)
+        out[:, 2:4] = f16(mn)[:, None].view(np.uint8)
+        out[:, 4:8] = high_bit_plane(q)[:, None].view(np.uint8)
+        out[:, 8:] = pack_split_nibbles(q & 0x0F)
         return out.reshape(-1)
     if gt == GGML_Q8_0:
-        d = (mx / -128.0).astype(np.float16)
-        inv = np.where(d == 0, 0.0, 1.0 / np.where(d == 0, 1.0,
-                                                   d.astype(np.float32)))
-        q = np.clip(np.round(blk * inv[:, None]), -128, 127).astype(np.int8)
-        out = np.empty((blk.shape[0], 34), np.uint8)
+        d = f16(signed_absmax() / -128.0)
+        q = np.clip(np.round(blk * _safe_inv_np(d)[:, None]),
+                    -128, 127).astype(np.int8)
+        out = np.empty((nb, 34), np.uint8)
         out[:, :2] = d[:, None].view(np.uint8)
         out[:, 2:] = q.view(np.uint8)
         return out.reshape(-1)
@@ -510,7 +563,7 @@ def write_gguf(
     alignment: int = 32,
 ) -> None:
     """Write a GGUF v3 file. Tensors are given dense f32 and encoded to the
-    requested ggml dtype (F32/F16/Q4_0/Q8_0)."""
+    requested ggml dtype (F32/F16/Q4_0/Q4_1/Q5_0/Q5_1/Q8_0)."""
     payloads: List[bytes] = []
     infos: List[Tuple[str, Tuple[int, ...], int, int]] = []
     offset = 0
@@ -520,7 +573,8 @@ def write_gguf(
             data = arr.astype(np.float32).tobytes()
         elif gt == GGML_F16:
             data = arr.astype(np.float16).tobytes()
-        elif gt in (GGML_Q4_0, GGML_Q8_0):
+        elif gt in (GGML_Q4_0, GGML_Q4_1, GGML_Q5_0, GGML_Q5_1,
+                    GGML_Q8_0):
             data = _quantize_block_np(
                 arr.reshape(arr.shape[0], -1), gt).tobytes()
         else:

@@ -319,3 +319,56 @@ def test_gguf_tokenizer_edge_cases():
         _answer_index("", 4)
     with _p.raises(ValueError):
         _answer_index("AB", 4)
+
+
+def test_writer_q41_q5_roundtrip(tmp_path):
+    """New writer formats (q4_1/q5_0/q5_1) must round-trip bit-faithfully
+    through the reader: write -> read dense == write-time quantization."""
+    import os
+
+    from bigdl_tpu.gguf import (GGML_Q4_1, GGML_Q5_0, GGML_Q5_1, GGUFFile,
+                                write_gguf)
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 64)).astype(np.float32)
+    path = os.path.join(tmp_path, "t.gguf")
+    write_gguf(path, {"general.architecture": "llama"},
+               {"a.weight": (w, GGML_Q4_1),
+                "b.weight": (w, GGML_Q5_0),
+                "c.weight": (w, GGML_Q5_1)})
+    gf = GGUFFile(path)
+    for name, bits, kind in (("a.weight", 4, "asym"),
+                             ("b.weight", 5, "sym"),
+                             ("c.weight", 5, "asym")):
+        dense = gf.load_dense(name, np.float32)
+        assert dense.shape == w.shape
+        err = np.abs(dense - w).max()
+        # quantization error bounded by half a step of the coarsest block
+        step = (w.max() - w.min()) / ((1 << bits) - 1)
+        assert err <= step * 1.1, (name, err, step)
+        # and the QTensor import path agrees with the dense decode exactly
+        qt = gf.load_qtensor(name)
+        from bigdl_tpu.ops.quant import dequantize_linear
+        import jax.numpy as jnp
+
+        np.testing.assert_allclose(
+            np.asarray(dequantize_linear(qt, jnp.float32)), dense,
+            rtol=2e-2, atol=2e-2)
+
+
+def test_writer_f16_overflow_clamped(tmp_path):
+    """Block min/scale beyond f16 range must clamp, not become inf."""
+    import os
+
+    from bigdl_tpu.gguf import GGML_Q4_1, GGUFFile, write_gguf
+
+    w = np.zeros((1, 32), np.float32)
+    w[0, 0] = -70000.0          # beyond f16 max magnitude 65504
+    w[0, 1] = 70000.0
+    path = os.path.join(tmp_path, "o.gguf")
+    write_gguf(path, {"general.architecture": "llama"},
+               {"a.weight": (w, GGML_Q4_1)})
+    dense = GGUFFile(path).load_dense("a.weight", np.float32)
+    assert np.isfinite(dense).all()
+    # clamped reconstruction stays within ~one step of the true extremes
+    assert dense.min() <= -60000 and dense.max() >= 60000
