@@ -49,14 +49,25 @@ def main(argv=None) -> int:
     # outtype was validated by from_pretrained above; qtypes without a
     # matching ggml block format (nf4, fp4, iq*, ...) re-encode at the
     # nearest width: 8-bit kinds as q8_0, everything else as q4_0
-    gt = {
+    exact = {
+        "fp32": G.GGML_F32, "f32": G.GGML_F32,
+        "fp16": G.GGML_F16, "f16": G.GGML_F16,
+        "bf16": G.GGML_F16,    # writer encodes halves as IEEE f16
+        "sym_int4": G.GGML_Q4_0, "int4": G.GGML_Q4_0, "q4_0": G.GGML_Q4_0,
         "sym_int8": G.GGML_Q8_0, "int8": G.GGML_Q8_0, "q8_0": G.GGML_Q8_0,
         "fp8": G.GGML_Q8_0, "fp8_e4m3": G.GGML_Q8_0,
         "fp8_e5m2": G.GGML_Q8_0,
         "asym_int4": G.GGML_Q4_1, "q4_1": G.GGML_Q4_1,
         "sym_int5": G.GGML_Q5_0, "q5_0": G.GGML_Q5_0,
         "asym_int5": G.GGML_Q5_1, "q5_1": G.GGML_Q5_1,
-    }.get(args.outtype, G.GGML_Q4_0)
+    }
+    gt = exact.get(args.outtype)
+    if gt is None:
+        gt = G.GGML_Q4_0
+        print(f"warning: qtype '{args.outtype}' has no matching ggml "
+              "block format; the GGUF will be re-encoded as q4_0 "
+              "(different size and quantization than the in-memory "
+              "model)", file=sys.stderr)
 
     def dense_oi(leaf, idx=None):
         """Leaf -> dense HF-orientation [out, in] f32."""

@@ -63,6 +63,14 @@ _LCPP_MOE = {
     "ffn_gate_inp": "block_sparse_moe.gate",
 }
 
+# old-style per-expert entries ("blk.N.ffn_down.E.weight", parsed by the
+# reference transformers/utils.py:207-217) map to the per-expert HF name
+_LCPP_MOE_PER_EXPERT = {
+    "ffn_gate": "w1",
+    "ffn_down": "w2",
+    "ffn_up": "w3",
+}
+
 
 def lcpp_to_hf_name(name: str) -> Optional[str]:
     """"blk.3.attn_q.weight" -> "model.layers.3.self_attn.q_proj.weight"."""
@@ -75,6 +83,10 @@ def lcpp_to_hf_name(name: str) -> Optional[str]:
         return f"model.layers.{m.group(1)}.{_LCPP_LAYER[m.group(2)]}.weight"
     if m and m.group(2) in _LCPP_MOE:
         return f"model.layers.{m.group(1)}.{_LCPP_MOE[m.group(2)]}.weight"
+    m = re.match(r"blk\.(\d+)\.(\w+)\.(\d+)\.weight$", name)
+    if m and m.group(2) in _LCPP_MOE_PER_EXPERT:
+        return (f"model.layers.{m.group(1)}.block_sparse_moe.experts."
+                f"{m.group(3)}.{_LCPP_MOE_PER_EXPERT[m.group(2)]}.weight")
     return None
 
 

@@ -181,6 +181,14 @@ def init_decoder_cache(params: Dict[str, Any], cfg: BartConfig,
     b, s_enc, _ = enc_out.shape
     h, hd = cfg.decoder_attention_heads, cfg.hd
     max_seq = max_seq or cfg.max_position_embeddings
+    if max_seq > cfg.max_position_embeddings:
+        # decode_step gathers dec_pos[pos] under jit, where an
+        # out-of-range row would clamp silently; refuse here, where
+        # max_seq is still static (mirrors encode()'s length check)
+        raise ValueError(
+            f"max_seq={max_seq} exceeds max_position_embeddings="
+            f"{cfg.max_position_embeddings}: decoder positions past the "
+            "learned table would silently clamp under jit")
 
     def proj(carry, lp):
         k = linear(enc_out, lp["cross_k_proj"],

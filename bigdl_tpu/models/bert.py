@@ -58,7 +58,9 @@ def _masked_attention(q, k, v, key_mask, scale):
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.bfloat16),
                         k.astype(jnp.bfloat16),
                         preferred_element_type=jnp.float32) * scale
-    scores = jnp.where(key_mask[:, None, None, :], scores, -jnp.inf)
+    # finite mask value: an all-pad row (every key False, seen in ragged
+    # batches) must soften to uniform probs, not NaN through -inf - -inf
+    scores = jnp.where(key_mask[:, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(jnp.bfloat16),
                      v.astype(jnp.bfloat16),

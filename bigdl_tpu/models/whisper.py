@@ -163,6 +163,13 @@ def init_decoder_cache(params: Dict[str, Any], cfg: WhisperConfig,
     b, s_enc, _ = enc_out.shape
     h, hd = cfg.decoder_attention_heads, cfg.hd
     max_seq = max_seq or cfg.max_target_positions
+    if max_seq > cfg.max_target_positions:
+        # decode_step gathers dec_pos[pos] under jit, where an
+        # out-of-range row would clamp silently; refuse while static
+        raise ValueError(
+            f"max_seq={max_seq} exceeds max_target_positions="
+            f"{cfg.max_target_positions}: decoder positions past the "
+            "learned table would silently clamp under jit")
 
     def proj(carry, lp):
         k = linear(enc_out, lp["cross_k_proj"]).reshape(b, s_enc, h, hd)

@@ -24,10 +24,26 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-import functools
+
+# error signatures that mean the KERNEL cannot lower for this geometry
+# (cache False forever) — anything else is presumed transient (wedged
+# tunnel, RPC timeout: retried on the next call, at most once per
+# _TRANSIENT_RETRIES, then treated as permanent for the process)
+_COMPILE_ERROR_MARKERS = ("mosaic", "lowering", "unsupported",
+                          "not implemented", "notimplemented",
+                          "unimplemented", "invalid_argument")
+_TRANSIENT_RETRIES = 3
+_probe_cache: dict = {}
+_probe_fail_counts: dict = {}
 
 
-@functools.lru_cache(maxsize=None)
+def reset_probe_cache() -> None:
+    """Forget all kernel-compile probe results (e.g. after a backend
+    outage, or when flipping `flags().attention_backend`)."""
+    _probe_cache.clear()
+    _probe_fail_counts.clear()
+
+
 def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
                      skv: int, kv_dtype_name: str) -> bool:
     """Eager probe, cached PER GEOMETRY: does the Pallas kernel compile
@@ -37,7 +53,13 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
     mode consults this; pallas mode bypasses it so forced runs still
     raise their real error. Callers normalize `sq` to the kernel's block
     class (prefill lengths vary per request; every class needs only one
-    probe compile)."""
+    probe compile). Genuine compile failures pin the geometry to XLA;
+    transient backend failures are retried (reset_probe_cache() clears
+    everything)."""
+    key = (kind, h, hkv, hd, sq, skv, kv_dtype_name)
+    hit = _probe_cache.get(key)
+    if hit is not None:
+        return hit
     try:
         import numpy as _np
 
@@ -53,15 +75,25 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
         kv = jnp.zeros((1, skv, hkv, hd), kdt)
         out = kernel(q, kv, kv, jnp.asarray(0, jnp.int32), hd ** -0.5)
         _np.asarray(out)
+        _probe_cache[key] = True
         return True
     except Exception as e:
         import logging
 
+        msg = f"{type(e).__name__}: {e}".lower()
+        permanent = any(mk in msg for mk in _COMPILE_ERROR_MARKERS)
+        if not permanent:
+            n = _probe_fail_counts.get(key, 0) + 1
+            _probe_fail_counts[key] = n
+            permanent = n >= _TRANSIENT_RETRIES
+        if permanent:
+            _probe_cache[key] = False
         logging.getLogger(__name__).warning(
             "pallas %s-attention kernel unavailable for shape "
             "(H=%d, Hkv=%d, hd=%d, Sq=%d, Skv=%d, %s) — %s: %s; using "
-            "the XLA path", kind, h, hkv, hd, sq, skv, kv_dtype_name,
-            type(e).__name__, e)
+            "the XLA path%s", kind, h, hkv, hd, sq, skv, kv_dtype_name,
+            type(e).__name__, e,
+            "" if permanent else " (transient — will re-probe)")
         return False
 
 

@@ -157,6 +157,41 @@ def test_encoder_length_guard(tiny_bart):
         m.generate(np.zeros((1, 80), np.int32), max_new_tokens=2)
 
 
+def test_decoder_cache_length_guard(tiny_bart):
+    """init_decoder_cache refuses max_seq beyond the position table —
+    direct decode_step callers would otherwise clamp silently under jit."""
+    path, _ = tiny_bart
+    from bigdl_tpu.models import bart as B
+    from bigdl_tpu.transformers import AutoModelForSeq2SeqLM
+
+    m = AutoModelForSeq2SeqLM.from_pretrained(path, load_in_4bit=True)
+    enc = B.encode(m.params, m.config, jnp.asarray(SRC))
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        B.init_decoder_cache(m.params, m.config, enc,
+                             max_seq=TINY["max_position_embeddings"] + 1)
+
+
+def test_all_pad_row_is_finite(tiny_bart):
+    """A batch row whose attention mask is all zeros (all padding) must
+    not NaN the other rows (or itself) through the -inf softmax path."""
+    path, _ = tiny_bart
+    from bigdl_tpu.models import bart as B
+    from bigdl_tpu.transformers import AutoModelForSeq2SeqLM
+
+    m = AutoModelForSeq2SeqLM.from_pretrained(path)
+    src = np.concatenate([SRC, np.full_like(SRC, TINY["pad_token_id"])])
+    mask = np.stack([np.ones(SRC.shape[1], np.int32),
+                     np.zeros(SRC.shape[1], np.int32)])
+    enc = B.encode(m.params, m.config, jnp.asarray(src),
+                   attention_mask=jnp.asarray(mask))
+    assert np.isfinite(np.asarray(enc)).all()
+    cache = B.init_decoder_cache(m.params, m.config, enc,
+                                 max_seq=16, src_mask=jnp.asarray(mask))
+    logits, _ = B.decode_step(m.params, m.config,
+                              jnp.asarray([[2], [2]], jnp.int32), cache)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
 def test_quantized_and_guards(tiny_bart):
     path, _ = tiny_bart
     from bigdl_tpu.transformers import AutoModelForSeq2SeqLM

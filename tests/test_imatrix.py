@@ -81,6 +81,14 @@ def test_lcpp_name_translation():
     assert lcpp_to_hf_name("output.weight") == "lm_head.weight"
     assert lcpp_to_hf_name("token_embd.weight") == "model.embed_tokens.weight"
     assert lcpp_to_hf_name("blk.0.attn_norm.weight") is None
+    # stacked MoE entries (one per expert stack)
+    assert (lcpp_to_hf_name("blk.2.ffn_up_exps.weight")
+            == "model.layers.2.block_sparse_moe.experts.w3.weight")
+    # old-style per-expert entries (reference transformers/utils.py:207-217)
+    assert (lcpp_to_hf_name("blk.0.ffn_down.3.weight")
+            == "model.layers.0.block_sparse_moe.experts.3.w2.weight")
+    assert (lcpp_to_hf_name("blk.5.ffn_gate.0.weight")
+            == "model.layers.5.block_sparse_moe.experts.0.w1.weight")
 
 
 def test_low_bit_policy():
