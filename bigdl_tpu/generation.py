@@ -161,6 +161,10 @@ class Generator:
         self._prefill = jax.jit(
             lambda p, c, t, kv: pre(p, c, t, kv), static_argnums=(1,),
             donate_argnums=(3,))
+        # multimodal prefill (families whose prefill takes visual=):
+        # built lazily so text-only models never trace it
+        self._prefill_raw = pre
+        self._prefill_vis = None
         self._sample = jax.jit(
             sample_token, static_argnames=("temperature", "top_k", "top_p"))
 
@@ -177,6 +181,7 @@ class Generator:
         input_ids,                       # [B, S] or [S] ints
         gen: Optional[GenerationConfig] = None,
         stats: Optional[GenerationStats] = None,
+        visual: Optional[Tuple[Any, Any]] = None,  # (vidx [B,S], vemb [Nv,D])
     ) -> np.ndarray:
         """Returns generated ids [B, <=max_new_tokens] (prompt excluded)."""
         gen = gen or GenerationConfig()
@@ -208,8 +213,36 @@ class Generator:
 
         key = jax.random.PRNGKey(gen.seed)
         t0 = time.perf_counter()
-        logits, cache = self._prefill(
-            self.params, self.cfg, jnp.asarray(padded), cache)
+        if visual is not None:
+            vidx, vemb = visual
+            vidx = np.asarray(vidx, np.int32)
+            if pad > 0 and (vidx[:, s - 1] > 0).any():
+                raise ValueError(
+                    "prompt must end with at least one text token after "
+                    "the final image span (the padded-prefill repair step "
+                    "re-runs the last token without injection)")
+            vpad = np.zeros((b, bucket), np.int32)
+            vpad[:, :s] = vidx
+            # bucket the embedding-row count too (power of two) so a
+            # varying image count reuses one compiled prefill — padding
+            # rows are never gathered (vidx only references real rows)
+            vemb = np.asarray(vemb)
+            rows = max(16, 1 << (int(vemb.shape[0]) - 1).bit_length())
+            if rows != vemb.shape[0]:
+                vemb = np.concatenate(
+                    [vemb, np.zeros((rows - vemb.shape[0],) +
+                                    vemb.shape[1:], vemb.dtype)])
+            if self._prefill_vis is None:
+                self._prefill_vis = jax.jit(
+                    lambda p, c, t, kv, vi, ve: self._prefill_raw(
+                        p, c, t, kv, visual=(vi, ve)),
+                    static_argnums=(1,), donate_argnums=(3,))
+            logits, cache = self._prefill_vis(
+                self.params, self.cfg, jnp.asarray(padded), cache,
+                jnp.asarray(vpad), jnp.asarray(vemb))
+        else:
+            logits, cache = self._prefill(
+                self.params, self.cfg, jnp.asarray(padded), cache)
         # logits from forward_last_token are for the LAST cache position
         # (bucket-1); when padded, recompute pointer: forward_last_token
         # returns position bucket-1 which may be padding. Use full-forward

@@ -443,6 +443,7 @@ def forward(
     cache: KVCache,
     compute_dtype=jnp.bfloat16,
     last_only: bool = False,
+    visual: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """Run the model; returns (logits [B, Sq, V], updated cache).
 
@@ -451,6 +452,12 @@ def forward(
     the compiled executables). last_only=True computes lm_head for the
     final position only — the reference's `optimize_lm_head` trick
     (low_bit_linear.py:251-258), which matters when V=32k+ and Sq is long.
+
+    `visual=(vidx [B, Sq] int32, vemb [Nv, D])` splices multimodal
+    embeddings over the token embeddings: rows where vidx > 0 take
+    vemb[vidx-1] (Qwen-VL image spans, models/qwen_vl.py; the reference
+    mutates hidden_states in place in qwen_vl's QWenModel.forward). One
+    gather + select — shapes stay static, positions/RoPE unchanged.
     """
     b, sq = tokens.shape
     pos = cache.pos
@@ -463,6 +470,10 @@ def forward(
         positions = pos + jnp.arange(sq, dtype=jnp.int32)
         cos, sin = rope_cos_sin(positions[None, :], inv_freq)  # [1, Sq, hd/2]
     x = embed_prologue(params, cfg, tokens, positions, compute_dtype)
+    if visual is not None:
+        vidx, vemb = visual
+        x = jnp.where((vidx > 0)[..., None],
+                      vemb[jnp.clip(vidx - 1, 0)].astype(x.dtype), x)
     if rope_mscale != 1.0:             # yarn attention temperature
         cos, sin = cos * rope_mscale, sin * rope_mscale
     slopes = (jnp.asarray(alibi_slopes(cfg.num_attention_heads))
@@ -488,10 +499,11 @@ def forward_last_token(
     tokens: jax.Array,
     cache: KVCache,
     compute_dtype=jnp.bfloat16,
+    visual: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """Prefill variant of `forward` with lm_head on the final position only."""
     return forward(params, cfg, tokens, cache, compute_dtype=compute_dtype,
-                   last_only=True)
+                   last_only=True, visual=visual)
 
 
 def forward_train(

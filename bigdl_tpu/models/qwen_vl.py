@@ -1,0 +1,358 @@
+"""Qwen-VL vision tower: ViT encoder + cross-attention resampler.
+
+TPU-native equivalent of the reference's Qwen-VL support (reference
+transformers/models/qwen_vl.py:251-289 `qwen_vl_vision_transformer_forward` /
+`qwen_vl_resampler_forward`, and the visual-module conversion hooks at
+transformers/convert.py:696-711). The LLM side of Qwen-VL is the qwen1
+family adapter (models/families.py) — this module adds the image leg:
+
+- `VisualConfig`: the `config.visual` dict of Qwen-VL-Chat checkpoints.
+- `convert_visual_params`: streams `transformer.visual.*` tensors into a
+  stacked pytree (resblocks [L, ...] for `lax.scan`). The tower stays
+  unquantized (the reference also leaves the ViT out of low-bit
+  conversion, convert.py:1071-1080) — it runs once per image, so weight
+  bandwidth is irrelevant next to the 48-layer decode loop.
+- `encode_images`: jittable pixels -> [N, n_queries, output_dim]
+  features. The patch "conv" (stride == kernel) is an unfold + ONE
+  [N*grid^2, 3p^2] x [3p^2, width] matmul — MXU-shaped, no conv op.
+- `visual_token_index` / `extract_image_paths` / `preprocess_images`:
+  the host-side protocol legs. Qwen-VL embeds each image as
+  `<img> ...path bytes... <imgpad>*k </img>` spanning exactly n_queries
+  tokens between the markers; injection replaces those rows of the
+  token-embedding output (reference qwen_vl's QWenModel.forward does
+  `hidden_states[i][a+1:b] = images[idx]`).
+
+Injection itself happens inside the jitted prefill: `llama.forward(...,
+visual=(vidx, vemb))` does one gather + select after the embed prologue —
+data-dependent *values*, static shapes, so the executable is shared with
+the text-only path per prompt bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# CLIP normalization constants (Qwen-VL visual.py image_transform)
+CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+@dataclasses.dataclass(frozen=True)
+class VisualConfig:
+    image_size: int = 448
+    patch_size: int = 14
+    width: int = 1664
+    layers: int = 48
+    heads: int = 16
+    mlp_ratio: float = 4.9231
+    output_dim: int = 4096
+    n_queries: int = 256
+    image_start_id: int = 151857
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def mlp_width(self) -> int:
+        return int(self.width * self.mlp_ratio)
+
+    @property
+    def pool_heads(self) -> int:
+        # Resampler(num_heads=output_dim // 128) in Qwen-VL visual.py;
+        # floor of 1 keeps tiny test configs valid
+        return max(1, self.output_dim // 128)
+
+    @property
+    def image_end_id(self) -> int:
+        return self.image_start_id + 1
+
+    @property
+    def image_pad_id(self) -> int:
+        return self.image_start_id + 2
+
+    @classmethod
+    def from_hf(cls, visual: Dict[str, Any]) -> "VisualConfig":
+        return cls(
+            image_size=visual.get("image_size", 448),
+            patch_size=visual.get("patch_size", 14),
+            width=visual.get("width", 1664),
+            layers=visual.get("layers", 48),
+            heads=visual.get("heads", 16),
+            mlp_ratio=visual.get("mlp_ratio", 4.9231),
+            output_dim=visual.get("output_dim", 4096),
+            n_queries=visual.get("n_queries", 256),
+            image_start_id=visual.get("image_start_id", 151857),
+        )
+
+
+# -- conversion ---------------------------------------------------------------
+
+_BLOCK_KEYS = (
+    "ln_1.weight", "ln_1.bias", "ln_2.weight", "ln_2.bias",
+    "attn.in_proj.weight", "attn.in_proj.bias",
+    "attn.out_proj.weight", "attn.out_proj.bias",
+    "mlp.c_fc.weight", "mlp.c_fc.bias",
+    "mlp.c_proj.weight", "mlp.c_proj.bias",
+)
+
+
+def convert_visual_params(tensors, vcfg: VisualConfig,
+                          compute_dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """`transformer.visual.*` tensors -> pytree (resblocks stacked [L, ...]).
+
+    Linear weights are stored transposed ([in, out]) so every matmul is a
+    plain `x @ w`. Accepts the full checkpoint stream; non-visual names
+    are ignored.
+    """
+    L = vcfg.layers
+    blocks: Dict[str, List[Optional[np.ndarray]]] = {
+        k: [None] * L for k in _BLOCK_KEYS}
+    top: Dict[str, Any] = {}
+
+    def dense(w, transpose=False):
+        a = np.asarray(w, np.float32)
+        if transpose:
+            a = a.T
+        return jnp.asarray(a).astype(compute_dtype)
+
+    for name, w in tensors:
+        if not name.startswith("transformer.visual."):
+            continue
+        sub = name[len("transformer.visual."):]
+        if sub == "conv1.weight":
+            # [width, 3, p, p] -> [3*p*p, width] unfold-matmul operand
+            a = np.asarray(w, np.float32)
+            top["patch_proj"] = jnp.asarray(
+                a.reshape(a.shape[0], -1).T).astype(compute_dtype)
+        elif sub == "positional_embedding":
+            top["pos_embed"] = dense(w)
+        elif sub == "proj":
+            top["proj"] = dense(w)          # [D2, D2], applied as x @ proj
+        elif sub.startswith(("ln_pre.", "ln_post.")):
+            top[sub.replace(".", "_")] = dense(w)
+        elif sub.startswith("attn_pool."):
+            k = sub[len("attn_pool."):]
+            if k in ("kv_proj.weight", "attn.in_proj_weight",
+                     "attn.out_proj.weight"):
+                top["pool_" + k.replace(".", "_")] = dense(w, transpose=True)
+            else:   # query, pos_embed, ln_q/ln_kv, biases
+                top["pool_" + k.replace(".", "_")] = dense(w)
+        elif sub.startswith("transformer.resblocks."):
+            rest = sub[len("transformer.resblocks."):]
+            idx_s, key = rest.split(".", 1)
+            if key in blocks:
+                transpose = key.endswith("weight") and (
+                    "in_proj" in key or "out_proj" in key
+                    or "c_fc" in key or "c_proj" in key)
+                blocks[key][int(idx_s)] = np.asarray(w, np.float32).T \
+                    if transpose else np.asarray(w, np.float32)
+
+    missing = [k for k, v in blocks.items() if any(x is None for x in v)]
+    if missing or "patch_proj" not in top:
+        raise ValueError(
+            f"incomplete Qwen-VL visual tower in checkpoint: missing "
+            f"{missing or ['conv1.weight']}")
+    top["resblocks"] = {
+        k.replace(".", "_"): jnp.asarray(np.stack(v)).astype(compute_dtype)
+        for k, v in blocks.items()}
+    return top
+
+
+# -- forward ------------------------------------------------------------------
+
+
+def _ln(x, w, b, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * w.astype(jnp.float32)
+            + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _interp_pos(table: jax.Array, tgt_len: int) -> jax.Array:
+    """get_abs_pos (reference qwen_vl.py:51-69): bicubic-resize a square
+    [S*S, C] position table to [T*T, C] when the grids differ."""
+    src = int(round(float(np.sqrt(table.shape[0]))))
+    tgt = int(round(float(np.sqrt(tgt_len))))
+    if src == tgt:
+        return table
+    grid = table.reshape(src, src, -1).astype(jnp.float32)
+    out = jax.image.resize(grid, (tgt, tgt, grid.shape[-1]),
+                           method="bicubic")
+    return out.reshape(tgt * tgt, -1).astype(table.dtype)
+
+
+def _mha(q, k, v, heads: int):
+    """Bidirectional multi-head attention. q [B,Lq,D], k/v [B,Lk,D]."""
+    b, lq, d = q.shape
+    lk = k.shape[1]
+    hd = d // heads
+    qh = q.reshape(b, lq, heads, hd).astype(jnp.bfloat16)
+    kh = k.reshape(b, lk, heads, hd).astype(jnp.bfloat16)
+    vh = v.reshape(b, lk, heads, hd).astype(jnp.bfloat16)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) * (hd ** -0.5)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(jnp.bfloat16), vh,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, lq, d).astype(q.dtype)
+
+
+def _resblock(x, lp, heads: int):
+    """Pre-LN ViT block (Qwen-VL visual.py VisualAttentionBlock).
+
+    The fused in_proj uses the Megatron-style PER-HEAD layout: output
+    viewed as [..., heads, 3*hd] and split into q/k/v within each head's
+    block — not [q_all; k_all; v_all]."""
+    b, l, d = x.shape
+    hd = d // heads
+    h = _ln(x, lp["ln_1_weight"], lp["ln_1_bias"])
+    qkv = h @ lp["attn_in_proj_weight"] + lp["attn_in_proj_bias"]
+    qkv = qkv.reshape(b, l, heads, 3 * hd)
+    q = qkv[..., :hd].reshape(b, l, d)
+    k = qkv[..., hd:2 * hd].reshape(b, l, d)
+    v = qkv[..., 2 * hd:].reshape(b, l, d)
+    a = _mha(q, k, v, heads)
+    x = x + (a @ lp["attn_out_proj_weight"] + lp["attn_out_proj_bias"])
+    h = _ln(x, lp["ln_2_weight"], lp["ln_2_bias"])
+    h = jax.nn.gelu(h @ lp["mlp_c_fc_weight"] + lp["mlp_c_fc_bias"],
+                    approximate=False)
+    return x + (h @ lp["mlp_c_proj_weight"] + lp["mlp_c_proj_bias"])
+
+
+def encode_images(vparams: Dict[str, Any], vcfg: VisualConfig,
+                  pixels: jax.Array,            # [N, 3, H, W] f32 normalized
+                  compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Pixels -> [N, n_queries, output_dim] visual features (jittable).
+
+    Mirrors the reference vision forward (qwen_vl.py:268-289): patchify,
+    +abs pos, ln_pre, 48 resblocks, resampler attn_pool, ln_post, proj.
+    """
+    n, c, hh, ww = pixels.shape
+    p = vcfg.patch_size
+    gh, gw = hh // p, ww // p
+    # unfold: [N, 3, gh, p, gw, p] -> [N, gh*gw, 3*p*p]; channel-major
+    # patch layout matches conv1.weight.reshape(width, -1)
+    patches = pixels.reshape(n, c, gh, p, gw, p)
+    patches = patches.transpose(0, 2, 4, 1, 3, 5).reshape(n, gh * gw,
+                                                          c * p * p)
+    x = patches.astype(compute_dtype) @ vparams["patch_proj"]
+
+    x = x + _interp_pos(vparams["pos_embed"], x.shape[1]).astype(x.dtype)
+    x = _ln(x, vparams["ln_pre_weight"], vparams["ln_pre_bias"])
+
+    x, _ = lax.scan(
+        lambda h, lp: (_resblock(h, lp, vcfg.heads), None),
+        x, vparams["resblocks"])
+
+    # resampler (qwen_vl.py:251-266): n_queries learned queries
+    # cross-attend the patch sequence; both sides carry sincos positions
+    kv = x @ vparams["pool_kv_proj_weight"]                  # [N, L, D2]
+    kv = _ln(kv, vparams["pool_ln_kv_weight"], vparams["pool_ln_kv_bias"])
+    q = _ln(vparams["pool_query"], vparams["pool_ln_q_weight"],
+            vparams["pool_ln_q_bias"])                       # [nq, D2]
+    pos_q = vparams["pool_pos_embed"]                        # [nq, D2]
+    pos_k = _interp_pos(vparams["pool_pos_embed"], kv.shape[1])
+
+    d2 = q.shape[-1]
+    w_q, w_k, w_v = jnp.split(vparams["pool_attn_in_proj_weight"], 3,
+                              axis=1)                        # [D2, D2] each
+    b_q, b_k, b_v = jnp.split(vparams["pool_attn_in_proj_bias"], 3)
+    qq = (q + pos_q)[None].astype(compute_dtype) @ w_q + b_q  # [1, nq, D2]
+    kk = (kv + pos_k[None].astype(kv.dtype)) @ w_k + b_k
+    vv = kv @ w_v + b_v
+    out = _mha(jnp.broadcast_to(qq, (n,) + qq.shape[1:]), kk, vv,
+               vcfg.pool_heads)
+    out = out @ vparams["pool_attn_out_proj_weight"] \
+        + vparams["pool_attn_out_proj_bias"]
+
+    out = _ln(out, vparams["ln_post_weight"], vparams["ln_post_bias"])
+    return out @ vparams["proj"]
+
+
+# -- host-side protocol -------------------------------------------------------
+
+
+def visual_token_index(input_ids: np.ndarray,
+                       vcfg: VisualConfig) -> Tuple[np.ndarray, int]:
+    """[B, S] ids -> (vidx [B, S] int32, n_images).
+
+    vidx is 0 on text rows; row j of image i carries i*n_queries + j + 1.
+    Image i is the i-th `<img>...</img>` span in batch-major order, the
+    order `extract_image_paths` / caller-supplied image lists use.
+    """
+    ids = np.asarray(input_ids)
+    vidx = np.zeros(ids.shape, np.int32)
+    count = 0
+    nq = vcfg.n_queries
+    for b in range(ids.shape[0]):
+        starts = np.where(ids[b] == vcfg.image_start_id)[0]
+        ends = np.where(ids[b] == vcfg.image_end_id)[0]
+        if len(starts) != len(ends):
+            raise ValueError(
+                f"unbalanced image markers in row {b}: {len(starts)} "
+                f"<img> vs {len(ends)} </img>")
+        for a, e in zip(starts, ends):
+            if e - a - 1 != nq:
+                raise ValueError(
+                    f"image span at row {b} pos {a} holds {e - a - 1} "
+                    f"tokens; expected n_queries={nq}")
+            vidx[b, a + 1:e] = count * nq + np.arange(nq) + 1
+            count += 1
+    return vidx, count
+
+
+def extract_image_paths(input_ids: np.ndarray,
+                        vcfg: VisualConfig) -> List[str]:
+    """Decode the in-band image paths/URLs the Qwen-VL tokenizer embeds
+    between the markers (reference qwen_vl's QWenModel.forward: bytes up
+    to the first <imgpad> token)."""
+    ids = np.asarray(input_ids)
+    out: List[str] = []
+    for b in range(ids.shape[0]):
+        starts = np.where(ids[b] == vcfg.image_start_id)[0]
+        ends = np.where(ids[b] == vcfg.image_end_id)[0]
+        for a, e in zip(starts, ends):
+            span = ids[b, a + 1:e].tolist()
+            if vcfg.image_pad_id in span:
+                span = span[:span.index(vcfg.image_pad_id)]
+            out.append(bytes(span).decode("utf-8"))
+    return out
+
+
+def preprocess_images(images: Sequence[Any],
+                      vcfg: VisualConfig) -> np.ndarray:
+    """paths / PIL images / [H,W,3] uint8 arrays -> [N,3,S,S] f32 CLIP-
+    normalized pixels (Qwen-VL visual.py image_transform)."""
+    from PIL import Image
+
+    s = vcfg.image_size
+    mean = np.asarray(CLIP_MEAN, np.float32).reshape(3, 1, 1)
+    std = np.asarray(CLIP_STD, np.float32).reshape(3, 1, 1)
+    out = []
+    for im in images:
+        if isinstance(im, str):
+            im = Image.open(im)
+        if isinstance(im, Image.Image):
+            im = np.asarray(
+                im.convert("RGB").resize((s, s), Image.BICUBIC))
+        arr = np.asarray(im)
+        if arr.ndim == 3 and arr.shape[-1] == 3:    # HWC -> CHW
+            arr = arr.transpose(2, 0, 1)
+        if arr.shape[1] != s or arr.shape[2] != s:
+            raise ValueError(
+                f"image array must be {s}x{s} (got {arr.shape}); pass a "
+                "path or PIL image for automatic resizing")
+        arr = arr.astype(np.float32)
+        if arr.max() > 1.5:                         # uint8 range
+            arr = arr / 255.0
+        out.append((arr - mean) / std)
+    return np.stack(out)

@@ -39,9 +39,14 @@ _probe_fail_counts: dict = {}
 
 def reset_probe_cache() -> None:
     """Forget all kernel-compile probe results (e.g. after a backend
-    outage, or when flipping `flags().attention_backend`)."""
+    outage, or when flipping `flags().attention_backend`).
+
+    Also drops jit executable caches: a probe verdict is baked into any
+    executable traced while it held, so clearing only the probe dict
+    would leave already-compiled shapes on their old path."""
     _probe_cache.clear()
     _probe_fail_counts.clear()
+    jax.clear_caches()
 
 
 def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
@@ -93,7 +98,10 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
             "(H=%d, Hkv=%d, hd=%d, Sq=%d, Skv=%d, %s) — %s: %s; using "
             "the XLA path%s", kind, h, hkv, hd, sq, skv, kv_dtype_name,
             type(e).__name__, e,
-            "" if permanent else " (transient — will re-probe)")
+            "" if permanent else
+            " (transient — re-probed on later traces; call "
+            "reset_probe_cache() after the outage to re-trace "
+            "already-compiled shapes)")
         return False
 
 

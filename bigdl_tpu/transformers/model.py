@@ -83,6 +83,7 @@ class TpuCausalLM:
         stats: Optional[GenerationStats] = None,
         gamma: int = 4,
         spec_stats=None,
+        visual=None,     # (vidx [B,S], vemb [Nv,D]) — multimodal prefill
         **_ignored,
     ) -> np.ndarray:
         """HF-style generate: returns [B, prompt+new] (prompt included).
@@ -98,7 +99,8 @@ class TpuCausalLM:
             eos_token_id = self.hf_config.get("eos_token_id")
             if isinstance(eos_token_id, list):
                 eos_token_id = eos_token_id[0]
-        if self.draft_params is not None and ids.shape[0] == 1:
+        if (self.draft_params is not None and ids.shape[0] == 1
+                and visual is None):
             from bigdl_tpu.speculative import speculative_generate
 
             new = speculative_generate(
@@ -124,7 +126,7 @@ class TpuCausalLM:
             max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, do_sample=do_sample,
             eos_token_id=eos_token_id, seed=seed)
-        new = self.generator.generate(ids, gen, stats=stats)
+        new = self.generator.generate(ids, gen, stats=stats, visual=visual)
         return np.concatenate([ids, new], axis=1)
 
     # -- persistence --------------------------------------------------------
@@ -142,6 +144,94 @@ class TpuCausalLM:
                 src = os.path.join(self.model_path, fname)
                 if os.path.exists(src):
                     shutil.copy(src, os.path.join(path, fname))
+
+
+class TpuQwenVLCausalLM(TpuCausalLM):
+    """Qwen-VL: the qwen1 text decoder + the ViT/resampler vision tower
+    (models/qwen_vl.py; reference transformers/models/qwen_vl.py +
+    convert.py:696-711). `generate(images=...)` accepts paths / PIL
+    images / pixel arrays; with no `images`, in-band image paths in the
+    token stream (the Qwen-VL tokenizer protocol) are decoded and loaded.
+    """
+
+    visual_cfg = None            # set by _attach_qwen_vl
+    _encode_jit = None
+
+    def encode_images(self, images) -> np.ndarray:
+        """images -> [N, n_queries, hidden] visual features.
+
+        A float [N, 3, S, S] array is taken as ALREADY CLIP-normalized
+        pixels; uint8 / NHWC / list inputs go through preprocess_images
+        (resize + /255 + CLIP mean/std)."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.models import qwen_vl as QV
+
+        arr = np.asarray(images) if not isinstance(images, (list, tuple)) \
+            else None
+        if (arr is not None and arr.ndim == 4 and
+                np.issubdtype(arr.dtype, np.floating)):
+            if arr.shape[1] != 3:
+                raise ValueError(
+                    f"float pixel batches must be [N, 3, S, S] "
+                    f"CLIP-normalized (got {arr.shape}); pass uint8 / "
+                    "PIL / paths for automatic preprocessing")
+            pixels = arr.astype(np.float32)
+        elif arr is not None and arr.ndim == 4:
+            pixels = QV.preprocess_images(list(arr), self.visual_cfg)
+        else:
+            pixels = QV.preprocess_images(images, self.visual_cfg)
+        if self._encode_jit is None:
+            self._encode_jit = jax.jit(functools.partial(
+                QV.encode_images, vcfg=self.visual_cfg))
+        return np.asarray(self._encode_jit(self.params["visual"],
+                                           pixels=jnp.asarray(pixels)))
+
+    def generate(self, input_ids, images=None, **kw) -> np.ndarray:
+        from bigdl_tpu.models import qwen_vl as QV
+
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        vcfg = self.visual_cfg
+        if images is None and (ids == vcfg.image_start_id).any():
+            images = QV.extract_image_paths(ids, vcfg)
+            if any(p == "" for p in images):
+                raise ValueError(
+                    "prompt contains image spans with no in-band paths; "
+                    "pass the images via generate(images=...)")
+        if images is None or (hasattr(images, "__len__")
+                              and len(images) == 0):
+            return super().generate(ids, **kw)
+        vidx, n_img = QV.visual_token_index(ids, vcfg)
+        n_given = len(images) if hasattr(images, "__len__") else None
+        if n_given is not None and n_given != n_img:
+            raise ValueError(
+                f"{n_img} image span(s) in the prompt but {n_given} "
+                "image(s) supplied")
+        feats = self.encode_images(images)
+        if feats.shape[0] != n_img:
+            raise ValueError(
+                f"{n_img} image span(s) in the prompt but {feats.shape[0]} "
+                "image(s) supplied")
+        vemb = feats.reshape(-1, feats.shape[-1])
+        return super().generate(ids, visual=(vidx, vemb), **kw)
+
+
+def _attach_qwen_vl(model: TpuCausalLM) -> TpuCausalLM:
+    """Upgrade a qwen1 TpuCausalLM to the VL facade when the checkpoint
+    carries a vision tower (config['visual'] + params['visual'])."""
+    if "visual" not in model.hf_config or "visual" not in model.params:
+        return model
+    from bigdl_tpu.models.qwen_vl import VisualConfig
+
+    model.__class__ = TpuQwenVLCausalLM
+    model.visual_cfg = VisualConfig.from_hf(model.hf_config["visual"])
+    model._encode_jit = None
+    return model
 
 
 def _resolve_qtype(load_in_4bit: bool,
@@ -272,9 +362,19 @@ class _BaseAutoModelClass:
 
             params["embed_tokens"] = quantize_embedding(
                 params["embed_tokens"], embedding_qtype)
+        if "visual" in hf_config and archs[0] == "QWenLMHeadModel":
+            # Qwen-VL: stream the (unquantized) vision tower alongside the
+            # quantized decoder (reference convert.py:696-711)
+            from bigdl_tpu.models.qwen_vl import (VisualConfig,
+                                                  convert_visual_params)
+
+            params["visual"] = convert_visual_params(
+                iter_hf_tensors(path),
+                VisualConfig.from_hf(hf_config["visual"]))
         model = TpuCausalLM(params, cfg, family, hf_config, qtype,
                             model_path=path, max_seq=max_seq,
                             kv_quantized=quantize_kv_cache)
+        model = _attach_qwen_vl(model)
         if speculative:
             # self-speculation: same checkpoint as a sym_int4 draft
             # (reference model.py:323-331)
@@ -300,13 +400,13 @@ class _BaseAutoModelClass:
         archs = hf_config.get("architectures") or ["?"]
         family = get_family(archs[0])
         cfg = family.config_from_hf(hf_config)
-        return TpuCausalLM(
+        return _attach_qwen_vl(TpuCausalLM(
             params, cfg, family, hf_config,
             qtype=manifest.get(lowbit_io.MARKER),
             model_path=path,
             max_seq=max_seq or manifest.get("extra", {}).get("max_seq", 2048),
             kv_quantized=quantize_kv_cache,
-        )
+        ))
 
 
 class AutoModelForCausalLM(_BaseAutoModelClass):
