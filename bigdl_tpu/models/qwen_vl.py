@@ -38,6 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from bigdl_tpu.ops.norms import layer_norm
+
 # CLIP normalization constants (Qwen-VL visual.py image_transform)
 CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
 CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
@@ -168,13 +170,9 @@ def convert_visual_params(tensors, vcfg: VisualConfig,
 # -- forward ------------------------------------------------------------------
 
 
-def _ln(x, w, b, eps=1e-6):
-    xf = x.astype(jnp.float32)
-    mu = jnp.mean(xf, axis=-1, keepdims=True)
-    var = jnp.var(xf, axis=-1, keepdims=True)
-    out = (xf - mu) * jax.lax.rsqrt(var + eps)
-    return (out * w.astype(jnp.float32)
-            + b.astype(jnp.float32)).astype(x.dtype)
+def _ln(x, w, b):
+    # norm_layer = partial(nn.LayerNorm, eps=1e-6) in Qwen-VL visual.py
+    return layer_norm(x, w, b, eps=1e-6)
 
 
 def _interp_pos(table: jax.Array, tgt_len: int) -> jax.Array:

@@ -9,19 +9,25 @@ cache tensors per architecture, speculative.py:393-439).
 Everything that made the reference's version hard on accelerators is
 restructured for XLA:
 
-- **One dispatch per round.** Draft loop (fixed gamma steps, `lax.scan`),
-  target verify (one gamma-token forward), accept computation, and the cache
-  rollback all run inside ONE jitted function; the host reads back one small
-  (tokens, n_accept) tuple per round. The reference pays a host round-trip
-  per draft token.
+- **One dispatch per round.** Draft loop (`lax.while_loop`, early-exiting
+  on draft confidence), target verify (one gamma+1-token forward), accept
+  computation, and the cache rollback all run inside ONE jitted function;
+  the host reads back one small (tokens, n_accept) tuple per round. The
+  reference pays a host round-trip per draft token.
 - **Rollback is index bookkeeping, not realloc.** Our KV caches are
   pre-allocated with validity tracked by a scalar `pos` (ops/kvcache.py);
   rejected entries beyond the accepted prefix are simply left in place —
   masked by position until overwritten. The reference copies/extends cache
   tensors (`_check_and_extend_kv_cache`).
-- **Static accept bound.** At most gamma-1 drafts are accepted per round
-  (full-accept forfeits the reference's "bonus token"), which keeps both
-  caches exactly consistent with no variable-length catch-up forward.
+- **Bonus token on full accept.** Verify runs over [cur, d_1..d_gamma]
+  (gamma+1 positions), so a fully-accepted round emits gamma+1 tokens —
+  the reference's bonus token (speculative.py ~:826), kept jit-static by
+  one extra draft catch-up step that writes the last proposed token's KV.
+- **Adaptive draft stop, compiled.** The draft while_loop exits when the
+  draft's own probability of its pick drops below `th_stop_draft`
+  (reference th_stop_draft, speculative.py:63) — saving the remaining
+  draft forwards; the threshold is a traced scalar, so the host can adapt
+  it between rounds (auto_th_stop_draft) with NO recompilation.
 
 The draft is typically the same checkpoint at sym_int4 (self-speculation,
 reference model.py:323-331) and the target bf16/fp8 — both share one
@@ -46,15 +52,22 @@ from bigdl_tpu.ops.kvcache import KVCache
 @dataclasses.dataclass
 class SpecStats:
     """Reference telemetry equivalent (speculative.py:143-151:
-    draft_time/verify_time/accept_num)."""
+    draft_time/verify_time/accept_num + draft_num for the auto
+    threshold)."""
     rounds: int = 0
     accepted: List[int] = dataclasses.field(default_factory=list)
+    drafted: List[int] = dataclasses.field(default_factory=list)
     round_s: List[float] = dataclasses.field(default_factory=list)
     first_token_s: float = 0.0
 
     @property
     def mean_accept(self) -> float:
         return float(np.mean(self.accepted)) if self.accepted else 0.0
+
+    @property
+    def accept_rate(self) -> float:
+        d = float(np.sum(self.drafted))
+        return float(np.sum(self.accepted)) / d if d else 0.0
 
     @property
     def tokens_per_round(self) -> float:
@@ -74,26 +87,31 @@ def make_spec_round(
 ):
     """Build the fused per-round executable.
 
-    round(params_t, params_d, cache_t, cache_d, cur_tok, key) ->
-        (out_tokens [B, gamma], n_accept [B], cache_t, cache_d, key)
+    round(params_t, params_d, cache_t, cache_d, cur_tok, key, th_stop) ->
+        (out_tokens [B, gamma+1], n_accept [B], n_draft scalar,
+         cache_t, cache_d, key)
 
-    Emits n_accept+1 valid tokens per round (accepted drafts + the target's
-    next token at the first divergence).
+    Emits n_accept+1 valid tokens per round: the accepted drafts plus the
+    target's token at the first divergence — or, on a full accept of all
+    n_draft proposals, the target's BONUS token after the last draft.
+    `th_stop` (f32 scalar, traced) stops drafting early when the draft's
+    confidence in its own pick falls below it; 0.0 drafts all gamma.
     """
 
     sampling = do_sample and temperature > 0.0
 
     @functools.partial(jax.jit, donate_argnums=(2, 3))
     def spec_round(params_t, params_d, cache_t: KVCache, cache_d: KVCache,
-                   cur_tok: jax.Array, key: jax.Array):
+                   cur_tok: jax.Array, key: jax.Array, th_stop: jax.Array):
         b = cur_tok.shape[0]
         pos0 = cache_t.pos
 
-        # --- draft: gamma steps (greedy, or sampled under the same
-        # temperature as the target — required for rejection sampling) ---
-        def dstep(carry, _):
-            tok, cache, k = carry
-            logits, cache = fwd_draft(params_d, cfg_draft, tok[:, None], cache)
+        # --- draft: up to gamma proposals + ONE catch-up step that only
+        # writes the last proposal's KV (so a full accept + bonus leaves
+        # the draft cache consistent) ---
+        def one_draft(tok, cache, k):
+            logits, cache = fwd_draft(params_d, cfg_draft, tok[:, None],
+                                      cache)
             lg = logits[:, -1, :].astype(jnp.float32)
             if sampling:
                 # identical tempering for the draw and the recorded q —
@@ -106,18 +124,49 @@ def make_spec_round(
             else:
                 nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
                 q = jax.nn.softmax(lg, axis=-1)
-            return (nxt, cache, k), (nxt, q)
+            conf = jnp.take_along_axis(q, nxt[:, None], axis=-1)[:, 0]
+            return nxt, q, conf, cache, k
 
+        # probe vocab once (first step always runs; also j=0 of the loop)
         key, dk = jax.random.split(key)
-        (_, cache_d, _), (draft_toks, draft_q) = lax.scan(
-            dstep, (cur_tok, cache_d, dk), None, length=gamma)
-        draft_toks = draft_toks.T                   # [B, gamma]
-        draft_q = jnp.moveaxis(draft_q, 0, 1)       # [B, gamma, V]
+        d1, q1, conf1, cache_d, dk = one_draft(cur_tok, cache_d, dk)
+        vocab = q1.shape[-1]
+        buf_toks = jnp.zeros((gamma, b), jnp.int32).at[0].set(d1)
+        buf_q = jnp.zeros((gamma, b, vocab), jnp.float32).at[0].set(q1)
 
-        # --- verify: ONE target forward over [cur_tok, d_1..d_{gamma-1}] ---
-        verify_in = jnp.concatenate([cur_tok[:, None], draft_toks[:, :-1]],
-                                    axis=1)  # [B, gamma]
-        logits_t, cache_t = fwd_target(params_t, cfg_target, verify_in, cache_t)
+        def cond(c):
+            j, _, _, _, going = c
+            return going & (j < gamma)
+
+        def body(c):
+            j, cache, k, bufs, _ = c
+            toks, qs = bufs
+            tok_j = toks[j - 1]                       # consume d_j
+            d, q, cnf, cache, k = one_draft(tok_j, cache, k)
+            toks = toks.at[j].set(d)
+            qs = qs.at[j].set(q)
+            # gate the NEXT iteration on this fresh proposal's confidence
+            going = jnp.all(cnf >= th_stop)
+            return (j + 1, cache, k, (toks, qs), going)
+
+        going0 = jnp.all(conf1 >= th_stop)
+        n_draft, cache_d, dk, (buf_toks, buf_q), _ = lax.while_loop(
+            cond, body,
+            (jnp.asarray(1, jnp.int32), cache_d, dk, (buf_toks, buf_q),
+             going0))
+        # catch-up: consume the last proposal so its KV is written;
+        # its output token is discarded
+        _, _, _, cache_d, _ = one_draft(buf_toks[n_draft - 1], cache_d, dk)
+
+        draft_toks = buf_toks.T                     # [B, gamma]
+        draft_q = jnp.moveaxis(buf_q, 0, 1)         # [B, gamma, V]
+
+        # --- verify: ONE target forward over [cur, d_1..d_gamma] ---
+        verify_in = jnp.concatenate([cur_tok[:, None], draft_toks], axis=1)
+        logits_t, cache_t = fwd_target(params_t, cfg_target, verify_in,
+                                       cache_t)     # [B, gamma+1, V]
+
+        valid = jnp.arange(gamma)[None, :] < n_draft  # [1|B, gamma]
 
         if sampling:
             # min(1, p/q) rejection sampling (the reference's sampling
@@ -126,58 +175,71 @@ def make_spec_round(
 
             p = jax.nn.softmax(filter_logits(
                 logits_t.astype(jnp.float32) / temperature, top_k, top_p),
-                axis=-1)
-            p_tok = jnp.take_along_axis(p, draft_toks[..., None],
+                axis=-1)                            # [B, gamma+1, V]
+            p_tok = jnp.take_along_axis(p[:, :-1], draft_toks[..., None],
                                         axis=-1)[..., 0]     # [B, gamma]
             q_tok = jnp.take_along_axis(draft_q, draft_toks[..., None],
                                         axis=-1)[..., 0]
             key, uk, rk = jax.random.split(key, 3)
             u = jax.random.uniform(uk, p_tok.shape)
-            accepted = u < jnp.minimum(1.0, p_tok / jnp.maximum(q_tok, 1e-20))
-            n_accept = jnp.minimum(
-                jnp.sum(jnp.cumprod(accepted.astype(jnp.int32), axis=1),
-                        axis=1),
-                gamma - 1)                          # [B]
-            # correction at position n: sample from (p - q)+ if n was a
-            # true rejection, else (cap hit) from p directly
+            accepted = (u < jnp.minimum(1.0, p_tok /
+                                        jnp.maximum(q_tok, 1e-20))) & valid
+            n_accept = jnp.sum(
+                jnp.cumprod(accepted.astype(jnp.int32), axis=1), axis=1)
+            # token at position n: residual (p - q)+ on a true rejection
+            # (n < n_draft); the target distribution itself on a full
+            # accept (bonus token)
             p_n = jnp.take_along_axis(
                 p, n_accept[:, None, None], axis=1)[:, 0]    # [B, V]
+            q_pad = jnp.concatenate(
+                [draft_q, jnp.zeros_like(draft_q[:, :1])], axis=1)
             q_n = jnp.take_along_axis(
-                draft_q, n_accept[:, None, None], axis=1)[:, 0]
+                q_pad, n_accept[:, None, None], axis=1)[:, 0]
             resid = jnp.maximum(p_n - q_n, 0.0)
             resid_sum = jnp.sum(resid, axis=-1, keepdims=True)
-            was_rejected = jnp.take_along_axis(
-                ~accepted, n_accept[:, None], axis=1)[:, 0]
-            dist = jnp.where((was_rejected & (resid_sum[:, 0] > 1e-9))[:, None],
-                             resid / jnp.maximum(resid_sum, 1e-20), p_n)
+            true_reject = n_accept < n_draft
+            dist = jnp.where(
+                (true_reject & (resid_sum[:, 0] > 1e-9))[:, None],
+                resid / jnp.maximum(resid_sum, 1e-20), p_n)
             correction = jax.random.categorical(
                 rk, jnp.log(jnp.maximum(dist, 1e-20)), axis=-1
             ).astype(jnp.int32)                     # [B]
-            idx = jnp.arange(gamma)[None, :]
-            out = jnp.where(idx < n_accept[:, None], draft_toks,
-                            correction[:, None])
+            idx = jnp.arange(gamma + 1)[None, :]
+            out = jnp.where(
+                idx < n_accept[:, None],
+                jnp.concatenate([draft_toks, draft_toks[:, -1:]], axis=1),
+                correction[:, None])
         else:
             target_pred = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
-            # --- accept: greedy prefix match, capped at gamma-1 ---
-            matches = (draft_toks == target_pred)   # [B, gamma]
-            n_accept = jnp.minimum(
-                jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1),
-                        axis=1),
-                gamma - 1)                          # [B]
-            # out[i] = d_{i+1} for i < n_accept, target_pred[n_accept] at
-            # i==n, garbage after (host slices by n_accept+1)
-            idx = jnp.arange(gamma)[None, :]
-            out = jnp.where(idx < n_accept[:, None], draft_toks,
-                            jnp.take_along_axis(
-                                target_pred, n_accept[:, None], axis=1))
+            # --- accept: greedy prefix match over the proposed prefix ---
+            matches = (draft_toks == target_pred[:, :-1]) & valid
+            n_accept = jnp.sum(
+                jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1)
+            # out[i] = d_{i+1} for i < n_accept; target's token at
+            # position n_accept (divergence fix OR bonus); garbage after
+            idx = jnp.arange(gamma + 1)[None, :]
+            out = jnp.where(
+                idx < n_accept[:, None],
+                jnp.concatenate([draft_toks, draft_toks[:, -1:]], axis=1),
+                jnp.take_along_axis(target_pred, n_accept[:, None], axis=1))
 
         # --- rollback: pure index bookkeeping ---
         new_pos = pos0 + n_accept[0] + 1            # B=1: scalar pos
         cache_t = KVCache(cache_t.k, cache_t.v, new_pos)
         cache_d = KVCache(cache_d.k, cache_d.v, new_pos)
-        return out, n_accept, cache_t, cache_d, key
+        return out, n_accept, n_draft, cache_t, cache_d, key
 
     return spec_round
+
+
+def _update_threshold(th: float, accept_rate: float,
+                      target: float = 0.9, step: float = 0.02,
+                      lo: float = 0.0, hi: float = 0.95) -> float:
+    """auto_th_stop_draft (reference speculative.py:63-64,81): nudge the
+    stop threshold toward a target per-round accept rate. Low accept rate
+    -> raise the bar (draft fewer, surer tokens); high -> lower it."""
+    return float(np.clip(th + (step if accept_rate < target else -step),
+                         lo, hi))
 
 
 def speculative_generate(
@@ -200,12 +262,16 @@ def speculative_generate(
     max_seq: int = 2048,
     seed: int = 0,
     kv_quantized: bool = False,
+    th_stop_draft: float = 0.8,
+    auto_th_stop_draft: bool = True,
     stats: Optional[SpecStats] = None,
 ) -> np.ndarray:
     """Generate with draft/verify speculation. Returns new tokens [1, <=N].
 
     `family_forward/prefill` serve both models (self-speculation: same
-    architecture, different qtype).
+    architecture, different qtype). `th_stop_draft`/`auto_th_stop_draft`
+    mirror the reference's adaptive draft control (speculative.py:63-64);
+    set th_stop_draft=0.0 to always draft the full gamma.
     """
     ids = np.asarray(input_ids, np.int32)
     if ids.ndim == 1:
@@ -214,9 +280,9 @@ def speculative_generate(
         raise ValueError("speculative decoding supports batch size 1 "
                          "(as the reference does)")
     s = ids.shape[1]
-    if s + max_new_tokens + gamma > max_seq:
+    if s + max_new_tokens + gamma + 1 > max_seq:
         raise ValueError(f"prompt ({s}) + max_new_tokens ({max_new_tokens}) "
-                         f"+ gamma ({gamma}) exceeds max_seq {max_seq}")
+                         f"+ gamma+1 ({gamma + 1}) exceeds max_seq {max_seq}")
 
     cache_t = new_cache(cfg_target, 1, max_seq, kv_quantized)
     cache_d = new_cache(cfg_draft, 1, max_seq, kv_quantized)
@@ -239,18 +305,24 @@ def speculative_generate(
 
     out: List[int] = [cur_host]
     key = jax.random.PRNGKey(seed)
+    th = float(th_stop_draft)
     while len(out) < max_new_tokens:
         if eos_token_id is not None and out and out[-1] == eos_token_id:
             break
         t1 = time.perf_counter()
-        toks_r, n_acc, cache_t, cache_d, key = spec_round(
-            params_target, params_draft, cache_t, cache_d, cur, key)
+        toks_r, n_acc, n_drf, cache_t, cache_d, key = spec_round(
+            params_target, params_draft, cache_t, cache_d, cur, key,
+            jnp.asarray(th, jnp.float32))
         toks_host = np.asarray(toks_r)[0]
         n = int(np.asarray(n_acc)[0])
+        nd = int(np.asarray(n_drf))      # scalar loop counter
         if stats is not None:
             stats.rounds += 1
             stats.accepted.append(n)
+            stats.drafted.append(nd)
             stats.round_s.append(time.perf_counter() - t1)
+        if auto_th_stop_draft and th_stop_draft > 0.0:
+            th = _update_threshold(th, n / max(nd, 1))
         emitted = list(toks_host[: n + 1])
         if eos_token_id is not None and eos_token_id in emitted:
             emitted = emitted[: emitted.index(eos_token_id) + 1]

@@ -82,6 +82,8 @@ class TpuCausalLM:
         seed: int = 0,
         stats: Optional[GenerationStats] = None,
         gamma: int = 4,
+        th_stop_draft: float = 0.8,
+        auto_th_stop_draft: bool = True,
         spec_stats=None,
         visual=None,     # (vidx [B,S], vemb [Nv,D]) — multimodal prefill
         **_ignored,
@@ -119,6 +121,8 @@ class TpuCausalLM:
                 max_seq=self.max_seq,
                 seed=seed,
                 kv_quantized=self.kv_quantized,
+                th_stop_draft=th_stop_draft,
+                auto_th_stop_draft=auto_th_stop_draft,
                 stats=spec_stats,
             )
             return np.concatenate([ids, new], axis=1)
@@ -197,6 +201,9 @@ class TpuQwenVLCausalLM(TpuCausalLM):
         if ids.ndim == 1:
             ids = ids[None]
         vcfg = self.visual_cfg
+        if images is not None and (isinstance(images, str)
+                                   or not hasattr(images, "__len__")):
+            images = [images]        # single path / PIL image
         if images is None and (ids == vcfg.image_start_id).any():
             images = QV.extract_image_paths(ids, vcfg)
             if any(p == "" for p in images):
@@ -351,6 +358,18 @@ class _BaseAutoModelClass:
             imatrix = load_imatrix(imatrix)
 
         cvt_qtype = None if (qtype in FLOAT_QTYPES) else qtype
+        visual_tensors: list = []
+        if "visual" in hf_config and archs[0] == "QWenLMHeadModel":
+            # tee the vision tensors out of the one disk pass — the
+            # decoder conversion skips them, and a second full read of a
+            # multi-GB checkpoint just for the tower would double load IO
+            def _tee(stream, sink):
+                for name, w in stream:
+                    if name.startswith("transformer.visual."):
+                        sink.append((name, np.asarray(w)))
+                    else:
+                        yield name, w
+            tensor_stream = _tee(tensor_stream, visual_tensors)
         params = family.convert_params(
             tensor_stream, cfg, qtype=cvt_qtype,
             modules_to_not_convert=tuple(modules_to_not_convert),
@@ -363,13 +382,13 @@ class _BaseAutoModelClass:
             params["embed_tokens"] = quantize_embedding(
                 params["embed_tokens"], embedding_qtype)
         if "visual" in hf_config and archs[0] == "QWenLMHeadModel":
-            # Qwen-VL: stream the (unquantized) vision tower alongside the
-            # quantized decoder (reference convert.py:696-711)
+            # Qwen-VL: the vision tensors were tee'd out of the one
+            # conversion stream (reference convert.py:696-711)
             from bigdl_tpu.models.qwen_vl import (VisualConfig,
                                                   convert_visual_params)
 
             params["visual"] = convert_visual_params(
-                iter_hf_tensors(path),
+                iter(visual_tensors),
                 VisualConfig.from_hf(hf_config["visual"]))
         model = TpuCausalLM(params, cfg, family, hf_config, qtype,
                             model_path=path, max_seq=max_seq,

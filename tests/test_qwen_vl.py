@@ -251,6 +251,15 @@ def test_generate_with_images(tiny_qwen_vl):
     text_only = m.generate(plain, max_new_tokens=5)
     assert text_only.shape[1] == plain.shape[1] + 5
 
+    # a bare PIL image (no __len__) wraps to a one-element list
+    from PIL import Image
+
+    im = Image.fromarray(
+        (np.abs(pixels[0]).transpose(1, 2, 0) * 60).clip(0, 255).astype(
+            np.uint8))
+    single = m.generate(ids, images=im, max_new_tokens=3)
+    assert single.shape[1] == ids.shape[1] + 3
+
 
 def test_vl_save_load_roundtrip(tiny_qwen_vl, tmp_path):
     from bigdl_tpu.transformers import AutoModelForCausalLM

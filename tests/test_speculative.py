@@ -26,13 +26,16 @@ def greedy_reference(params, prompt, n):
     return np.asarray(out)
 
 
-def spec(params_t, params_d, prompt, n, gamma=4, stats=None):
+def spec(params_t, params_d, prompt, n, gamma=4, stats=None,
+         th_stop_draft=0.0, auto_th_stop_draft=False):
     return speculative_generate(
         params_t, params_d, TINY_LLAMA, TINY_LLAMA, prompt,
         family_forward=llama_mod.forward,
         family_prefill=llama_mod.forward_last_token,
         new_cache=llama_mod.new_cache,
-        max_new_tokens=n, gamma=gamma, max_seq=MAX_SEQ, stats=stats)
+        max_new_tokens=n, gamma=gamma, max_seq=MAX_SEQ, stats=stats,
+        th_stop_draft=th_stop_draft,
+        auto_th_stop_draft=auto_th_stop_draft)
 
 
 @pytest.fixture(scope="module")
@@ -47,8 +50,25 @@ def test_self_draft_matches_greedy(prompt):
     stats = SpecStats()
     out = spec(params, params, prompt, 24, gamma=4, stats=stats)
     np.testing.assert_array_equal(out, ref)
-    # identical draft must accept the gamma-1 cap every round
-    assert stats.mean_accept == 3.0
+    # identical draft: all gamma drafts accepted every full round, PLUS
+    # the bonus token (gamma+1 tokens/round)
+    assert stats.accepted[0] == 4.0
+    assert stats.tokens_per_round > 4.0
+
+
+def test_adaptive_stop_still_exact(prompt):
+    """th_stop_draft early exit may shorten drafting but can never change
+    the decoded text (verification decides)."""
+    params = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=0)
+    ref = greedy_reference(params, prompt, 20)
+    stats = SpecStats()
+    out = spec(params, params, prompt, 20, gamma=4, stats=stats,
+               th_stop_draft=0.8, auto_th_stop_draft=True)
+    np.testing.assert_array_equal(out, ref)
+    # tiny random weights -> flat draft distributions -> the stop
+    # threshold bites and fewer than gamma tokens get drafted
+    assert min(stats.drafted) >= 1
+    assert all(a <= d for a, d in zip(stats.accepted, stats.drafted))
 
 
 def test_different_draft_still_exact(prompt):
@@ -99,14 +119,16 @@ def test_sampling_mode_runs_and_accepts_self_draft(prompt):
         family_prefill=llama_mod.forward_last_token,
         new_cache=llama_mod.new_cache,
         max_new_tokens=24, gamma=4, max_seq=MAX_SEQ,
-        do_sample=True, temperature=0.9, seed=11, stats=stats)
+        do_sample=True, temperature=0.9, seed=11, stats=stats,
+        th_stop_draft=0.0, auto_th_stop_draft=False)
     out2 = speculative_generate(
         params, params, TINY_LLAMA, TINY_LLAMA, prompt,
         family_forward=llama_mod.forward,
         family_prefill=llama_mod.forward_last_token,
         new_cache=llama_mod.new_cache,
         max_new_tokens=24, gamma=4, max_seq=MAX_SEQ,
-        do_sample=True, temperature=0.9, seed=11)
+        do_sample=True, temperature=0.9, seed=11,
+        th_stop_draft=0.0, auto_th_stop_draft=False)
     np.testing.assert_array_equal(out1, out2)
     assert out1.shape[1] <= 24
     assert np.all((out1 >= 0) & (out1 < TINY_LLAMA.vocab_size))
