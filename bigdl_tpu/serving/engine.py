@@ -74,6 +74,12 @@ class EngineConfig:
     max_seq: int = 2048
     prefill_bucket: int = 16       # smallest prefill compile bucket
     kv_quantized: bool = False
+    # chunked prefill: a step() never runs more than this many prompt
+    # tokens of prefill before the batched decode, so a long admission
+    # cannot stall in-flight streams for more than one chunk's latency
+    # (the reference engine runs the whole prefill inline and freezes
+    # every stream, llm_engine.py:543 + scheduler.py:93)
+    prefill_chunk: int = 256
 
 
 class _Slot:
@@ -84,6 +90,17 @@ class _Slot:
         self.generated: List[int] = []
         self.last_token: int = 0
         self.active: bool = False
+
+
+@dataclasses.dataclass
+class _Admission:
+    """A sequence mid-(chunked)-prefill: consumed tokens so far and its
+    private 1-row cache (spliced into the batched cache on completion)."""
+    req: Request
+    slot_idx: int
+    bucket: int
+    consumed: int
+    cache1: KVCache
 
 
 class LLMEngine:
@@ -136,6 +153,12 @@ class LLMEngine:
         # and position into the batched cache at the slot index
         @functools.partial(jax.jit, donate_argnums=(0,))
         def insert(cache: KVCache, k1, v1, slot, plen):
+            # the private cache may be chunk-padded past max_seq; the
+            # tail holds only pad garbage (plen <= max_seq is enforced
+            # at add_request), so clip the splice statically
+            max_s = cache.k.shape[2]
+            k1 = k1[:, :, :max_s]
+            v1 = v1[:, :, :max_s]
             k = jax.lax.dynamic_update_slice(
                 cache.k, k1.astype(cache.k.dtype), (0, slot, 0, 0, 0))
             v = jax.lax.dynamic_update_slice(
@@ -144,7 +167,20 @@ class LLMEngine:
             return KVCache(k, v, pos)
 
         self._insert = insert
-        self._prefills: Dict[int, Callable] = {}
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def prefill_chunk(params, tokens, cache1):
+            # one jitted fn; XLA caches an executable per (chunk width,
+            # cache bucket) shape pair
+            return fwd(params, self.cfg, tokens, cache1)
+
+        self._prefill = prefill_chunk
+        # chunk width must divide the private cache length or the last
+        # chunk's dynamic_update_slice would CLAMP its start index and
+        # silently overwrite earlier positions — normalize to a power of
+        # two and size the cache up to a multiple of it (_admission_step)
+        self._chunk = 1 << (max(1, ce.prefill_chunk).bit_length() - 1)
+        self._admitting: Optional[_Admission] = None
 
     # -- public api ---------------------------------------------------------
 
@@ -167,8 +203,8 @@ class LLMEngine:
         self._abort.add(request_id)
 
     def has_unfinished(self) -> bool:
-        return (not self.waiting.empty()) or any(
-            s.active for s in self.slots)
+        return (not self.waiting.empty() or self._admitting is not None
+                or any(s.active for s in self.slots))
 
     def get_outputs(self, request_id: str) -> List[RequestOutput]:
         with self._lock:
@@ -189,39 +225,80 @@ class LLMEngine:
             b *= 2
         return min(b, self.cfg_engine.max_seq)
 
-    def _prefill_fn(self, bucket: int) -> Callable:
-        fn = self._prefills.get(bucket)
-        if fn is None:
-            fwd = self.family.forward
+    def _admission_step(self) -> None:
+        """Advance chunked admission by AT MOST one chunk (bounds the
+        decode gap a long prompt can cause). Starts a new admission when
+        a slot is free and the queue is non-empty."""
+        a = self._admitting
+        if a is None:
+            free = next((i for i, s in enumerate(self.slots)
+                         if not s.active), None)
+            if free is None:
+                return
+            req = None
+            while req is None and not self.waiting.empty():
+                try:
+                    cand = self.waiting.get_nowait()
+                except queue.Empty:
+                    return
+                if cand.request_id in self._abort:
+                    # aborted while still queued: the client is owed a
+                    # finished output or its poll loop never ends
+                    self._abort.discard(cand.request_id)
+                    with self._lock:
+                        self._outputs.setdefault(
+                            cand.request_id, []).append(RequestOutput(
+                                cand.request_id, [], True, "abort"))
+                    cand = None
+                req = cand
+            if req is None:
+                return
+            # private cache sized to a chunk multiple (>= bucket) so no
+            # chunk write can straddle the end; _insert clips the splice
+            # back down to the batched cache's max_seq
+            bucket = self._bucket(len(req.prompt_token_ids))
+            chunk = min(self._chunk, bucket)
+            alloc = -(-bucket // chunk) * chunk
+            cache1 = init_cache(
+                self.cfg.num_hidden_layers, 1, alloc,
+                self.cfg.num_key_value_heads, self.cfg.hd,
+                quantized=self.cfg_engine.kv_quantized)
+            a = self._admitting = _Admission(req, free, bucket, 0, cache1)
 
-            @jax.jit
-            def prefill(params, tokens):      # [1, bucket]
-                cache1 = init_cache(
-                    self.cfg.num_hidden_layers, 1, bucket,
-                    self.cfg.num_key_value_heads, self.cfg.hd,
-                    quantized=self.cfg_engine.kv_quantized)
-                logits, cache1 = fwd(params, self.cfg, tokens, cache1)
-                return logits, cache1.k, cache1.v
+        if a.req.request_id in self._abort:      # aborted mid-admission
+            self._abort.discard(a.req.request_id)
+            self._finish_admission_abort(a)
+            return
 
-            fn = self._prefills[bucket] = prefill
-        return fn
+        plen = len(a.req.prompt_token_ids)
+        chunk = min(self._chunk, a.bucket)
+        padded = np.zeros((1, chunk), np.int32)
+        part = a.req.prompt_token_ids[a.consumed:a.consumed + chunk]
+        padded[0, :len(part)] = part
+        logits, a.cache1 = self._prefill(
+            self.params, jnp.asarray(padded), a.cache1)
+        start = a.consumed
+        a.consumed += chunk
 
-    def _admit(self, req: Request, slot_idx: int) -> None:
-        s = self.slots[slot_idx]
-        plen = len(req.prompt_token_ids)
-        bucket = self._bucket(plen)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = req.prompt_token_ids
-        logits, k1, v1 = self._prefill_fn(bucket)(
-            self.params, jnp.asarray(padded))
-        self.cache = self._insert(self.cache, k1, v1, slot_idx, plen)
-        first = self._sample_host(
-            np.asarray(logits)[0, plen - 1], req.params)
-        s.req = req
-        s.generated = [int(first)]
-        s.last_token = int(first)
-        s.active = True
-        self._emit(s)
+        if a.consumed >= plen:
+            self.cache = self._insert(self.cache, a.cache1.k, a.cache1.v,
+                                      a.slot_idx, plen)
+            first = self._sample_host(
+                np.asarray(logits)[0, plen - 1 - start], a.req.params)
+            s = self.slots[a.slot_idx]
+            s.req = a.req
+            s.generated = [int(first)]
+            s.last_token = int(first)
+            s.active = True
+            self._emit(s)
+            self._check_done(a.slot_idx)
+            self._admitting = None
+
+    def _finish_admission_abort(self, a: _Admission) -> None:
+        with self._lock:
+            self._outputs.setdefault(a.req.request_id, []).append(
+                RequestOutput(a.req.request_id, [], True, "abort"))
+        self._admitting = None
 
     @staticmethod
     def _sample_host(logits: np.ndarray, p: SamplingParams) -> int:
@@ -285,32 +362,22 @@ class LLMEngine:
         return False
 
     def step(self) -> bool:
-        """One engine iteration (reference LLMEngine.step): admit waiting
-        requests into free slots, then run one batched decode step.
-        Returns True if any work was done."""
+        """One engine iteration (reference LLMEngine.step): advance the
+        (chunked) admission by one chunk, then run one batched decode
+        step. Returns True if any work was done."""
         # aborts
         for i, s in enumerate(self.slots):
             if s.active and s.req.request_id in self._abort:
                 self._abort.discard(s.req.request_id)
                 self._finish(i, "abort")
 
-        # admission
-        for i, s in enumerate(self.slots):
-            if not s.active and not self.waiting.empty():
-                try:
-                    req = self.waiting.get_nowait()
-                except queue.Empty:
-                    break
-                if req.request_id in self._abort:
-                    self._abort.discard(req.request_id)
-                    continue
-                self._admit(req, i)
-                if self._check_done(i):
-                    pass
+        # admission: at most ONE prefill chunk per step — a long prompt
+        # admits across several steps while decodes keep flowing
+        self._admission_step()
 
         active = [i for i, s in enumerate(self.slots) if s.active]
         if not active:
-            return False
+            return self._admitting is not None
 
         tokens = np.zeros((self.cfg_engine.max_batch,), np.int32)
         for i in active:

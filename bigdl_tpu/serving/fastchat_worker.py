@@ -24,7 +24,8 @@ class WorkerCore:
     """Model + engine + tokenizer; yields FastChat-wire-format chunks."""
 
     def __init__(self, model_path: str, low_bit: str = "sym_int4",
-                 max_batch: int = 4, max_seq: int = 2048):
+                 max_batch: int = 4, max_seq: int = 2048,
+                 embedder_path: Optional[str] = None):
         from bigdl_tpu.transformers.model import AutoModelForCausalLM
 
         self.model = AutoModelForCausalLM.from_pretrained(
@@ -39,6 +40,20 @@ class WorkerCore:
         self.engine = LLMEngine(self.model, EngineConfig(
             max_batch=max_batch, max_seq=max_seq))
         self.context_len = max_seq
+        # embeddings endpoint: a BERT-family encoder served next to the
+        # LLM (the reference worker has no embeddings either; ours wires
+        # transformers/embedder.py when a checkpoint is configured)
+        self.embedder = None
+        self.embedder_tokenizer = None
+        if embedder_path is not None:
+            from transformers import AutoTokenizer
+
+            from bigdl_tpu.transformers.embedder import BertEmbedder
+
+            self.embedder = BertEmbedder.from_pretrained(
+                embedder_path, load_in_low_bit=low_bit)
+            self.embedder_tokenizer = AutoTokenizer.from_pretrained(
+                embedder_path)
 
     def generate_stream(self, params: Dict[str, Any]) -> Iterator[Dict]:
         """FastChat generate_stream protocol: yields dicts with
@@ -78,6 +93,33 @@ class WorkerCore:
                     "finish_reason": o.finish_reason if o.finished else None,
                 }
 
+    def get_embeddings(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """FastChat embeddings protocol: {"input": [texts]} ->
+        {"embedding": [[f32]], "token_num": N}. Tokenizes ONCE (with
+        truncation), so token_num counts exactly what was embedded."""
+        if self.embedder is None:
+            raise ValueError(
+                "no embedder configured; start the worker with "
+                "--embedder-path pointing at a BERT-family checkpoint")
+        texts = params["input"]
+        if isinstance(texts, str):
+            texts = [texts]
+        if not texts:
+            return {"embedding": [], "token_num": 0}
+        import numpy as np
+
+        encs = [self.embedder_tokenizer(t)["input_ids"][:512]
+                for t in texts]
+        n = max(len(e) for e in encs)
+        ids = np.zeros((len(encs), n), np.int32)
+        mask = np.zeros((len(encs), n), np.int32)
+        for i, e in enumerate(encs):
+            ids[i, :len(e)] = e
+            mask[i, :len(e)] = 1
+        vecs = self.embedder.embed(ids, mask)
+        return {"embedding": [list(map(float, v)) for v in vecs],
+                "token_num": int(mask.sum())}
+
 
 def _make_fastchat_worker():
     import asyncio
@@ -113,7 +155,14 @@ def _make_fastchat_worker():
             return out
 
         def get_embeddings(self, params):
-            raise NotImplementedError
+            # never raise through the route: fastchat acquires the worker
+            # semaphore before calling and only releases after — an
+            # exception here would leak a permit per failed call
+            try:
+                return self.core.get_embeddings(params)
+            except Exception as e:
+                return {"embedding": [], "token_num": 0,
+                        "error_code": 1, "text": str(e)}
 
     return BigdlTpuWorker, app
 
@@ -129,6 +178,8 @@ def main():
     ap.add_argument("--host", default="localhost")
     ap.add_argument("--port", type=int, default=21002)
     ap.add_argument("--model-names", default=None)
+    ap.add_argument("--embedder-path", default=None,
+                    help="BERT-family checkpoint for /worker_get_embeddings")
     args = ap.parse_args()
 
     try:
@@ -143,7 +194,7 @@ def main():
         args.controller_address, args.worker_address,
         str(uuid.uuid4())[:8], args.model_path,
         (args.model_names or args.model_path).split(","), 5,
-        low_bit=args.low_bit)
+        low_bit=args.low_bit, embedder_path=args.embedder_path)
     uvicorn.run(app, host=args.host, port=args.port, log_level="info")
 
 

@@ -84,6 +84,85 @@ def test_interleaved_admission(model):
     assert got_b == plain_greedy(model.params, [9, 8, 7], 5)
 
 
+def test_chunked_prefill_matches_plain(model):
+    """Chunked admission (tiny chunks) must be numerically identical to
+    one-shot prefill."""
+    eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128,
+                                        prefill_chunk=8))
+    prompts = [list(range(1, 28)), list(range(30, 71))]   # 27 + 41 tokens
+    outs = eng.generate(prompts, SamplingParams(max_tokens=8))
+    for p, got in zip(prompts, outs):
+        assert got == plain_greedy(model.params, p, 8), p
+
+
+def test_long_admission_does_not_starve_decodes(model):
+    """While a long prompt admits chunk-by-chunk, the in-flight stream
+    must keep emitting a token EVERY step (bounded decode gap)."""
+    eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=256,
+                                        prefill_chunk=16))
+    eng.add_request("fast", [1, 2, 3], SamplingParams(max_tokens=64))
+    eng.step()                      # admit + first decode
+    # drain initial outputs
+    sum(len(o.new_token_ids) for o in eng.get_outputs("fast"))
+
+    eng.add_request("slow", list(range(1, 101)),
+                    SamplingParams(max_tokens=4))        # 100-token prompt
+    # 100 tokens / 16-chunk = 7 admission steps; each step must still
+    # decode one token for "fast"
+    for _ in range(7):
+        eng.step()
+        got = sum(len(o.new_token_ids) for o in eng.get_outputs("fast"))
+        assert got == 1, "decode starved during chunked admission"
+    # the long request eventually completes with correct output
+    while eng.has_unfinished():
+        eng.step()
+    got_slow = []
+    for o in eng.get_outputs("slow"):
+        got_slow.extend(o.new_token_ids)
+    assert got_slow == plain_greedy(model.params, list(range(1, 101)), 4)
+
+
+def test_non_power_of_two_chunk_exact(model):
+    """prefill_chunk=12 (normalized to 8) with prompts that straddle
+    bucket boundaries: the last chunk must never clamp its write."""
+    eng = LLMEngine(model, EngineConfig(max_batch=1, max_seq=128,
+                                        prefill_chunk=12))
+    prompts = [list(range(1, 31)), list(range(5, 22))]    # 30, 17 tokens
+    outs = eng.generate(prompts, SamplingParams(max_tokens=6))
+    for p, got in zip(prompts, outs):
+        assert got == plain_greedy(model.params, p, 6), p
+
+
+def test_abort_while_queued(model):
+    """Aborting a request that is still in the waiting queue must still
+    produce a finished output (pollers would hang forever otherwise)."""
+    eng = LLMEngine(model, EngineConfig(max_batch=1, max_seq=128))
+    eng.add_request("busy", [1, 2, 3], SamplingParams(max_tokens=30))
+    eng.step()                       # occupies the only slot
+    eng.add_request("queued", [4, 5, 6], SamplingParams(max_tokens=5))
+    eng.abort_request("queued")
+    for _ in range(40):
+        eng.step()
+        outs = eng.get_outputs("queued")
+        if outs:
+            assert outs[-1].finished and outs[-1].finish_reason == "abort"
+            break
+    else:
+        raise AssertionError("queued abort never produced an output")
+
+
+def test_abort_mid_admission(model):
+    eng = LLMEngine(model, EngineConfig(max_batch=1, max_seq=256,
+                                        prefill_chunk=16))
+    eng.add_request("y", list(range(1, 81)), SamplingParams(max_tokens=5))
+    eng.step()                      # first chunk only (80 > 16)
+    eng.abort_request("y")
+    eng.step()
+    outs = eng.get_outputs("y")
+    assert outs and outs[-1].finished and outs[-1].finish_reason == "abort"
+    assert not eng.has_unfinished()
+
+
 def test_abort(model):
     eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128))
     eng.add_request("x", [1, 2, 3], SamplingParams(max_tokens=50))
@@ -206,6 +285,50 @@ def test_fastchat_worker_core(model, tmp_path, monkeypatch):
     assert chunks[-1]["usage"]["completion_tokens"] == 6
     got = json.loads(chunks[-1]["text"])
     assert got == plain_greedy(model.params, [1, 2, 3, 4], 6)
+
+    # embeddings endpoint: unconfigured -> actionable error
+    with pytest.raises(ValueError, match="embedder-path"):
+        core.get_embeddings({"input": ["hello"]})
+
+
+def test_fastchat_worker_embeddings(tmp_path):
+    """get_embeddings over a real (tiny) BERT checkpoint + tokenizer."""
+    torch = pytest.importorskip("torch")
+    from transformers import BertConfig, BertModel, BertTokenizerFast
+
+    torch.manual_seed(0)
+    d = str(tmp_path / "bert")
+    BertModel(BertConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64)).eval().save_pretrained(d)
+    vocab = str(tmp_path / "vocab.txt")
+    with open(vocab, "w") as f:
+        f.write("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello",
+                           "world"] + [f"tok{i}" for i in range(114)]))
+    BertTokenizerFast(vocab_file=vocab).save_pretrained(d)
+
+    from bigdl_tpu.serving.fastchat_worker import WorkerCore
+
+    class _Core(WorkerCore):       # skip the LLM leg; embedder only
+        def __init__(self, embedder_path):
+            from transformers import AutoTokenizer
+
+            from bigdl_tpu.transformers.embedder import BertEmbedder
+
+            self.embedder = BertEmbedder.from_pretrained(
+                embedder_path, load_in_low_bit="sym_int8")
+            self.embedder_tokenizer = AutoTokenizer.from_pretrained(
+                embedder_path)
+
+    core = _Core(d)
+    out = core.get_embeddings({"input": ["hello world", "hello"]})
+    assert len(out["embedding"]) == 2
+    assert len(out["embedding"][0]) == 32
+    assert out["token_num"] > 0
+    single = core.get_embeddings({"input": "hello world"})
+    np.testing.assert_allclose(single["embedding"][0],
+                               out["embedding"][0], rtol=1e-5)
 
 
 def test_env_check():
