@@ -80,6 +80,15 @@ class EngineConfig:
     # (the reference engine runs the whole prefill inline and freezes
     # every stream, llm_engine.py:543 + scheduler.py:93)
     prefill_chunk: int = 256
+    # prompt-prefix KV reuse (the reference gen-1 pipeline's LlamaCache/
+    # LlamaState, ggml/model/llama/llama.py:63,109-121,1346-1373): after
+    # each admission the prompt's KV snapshot is kept on HOST; a later
+    # prompt sharing a prefix seeds its private cache from the longest
+    # match and prefills only the tail. 0 disables.
+    prefix_cache_entries: int = 2
+    # only the first N prompt tokens are snapshotted — bounds the D2H
+    # transfer and host memory per entry (system prompts live here)
+    prefix_cache_max_tokens: int = 1024
 
 
 class _Slot:
@@ -181,6 +190,9 @@ class LLMEngine:
         # two and size the cache up to a multiple of it (_admission_step)
         self._chunk = 1 << (max(1, ce.prefill_chunk).bit_length() - 1)
         self._admitting: Optional[_Admission] = None
+        # prefix cache: {prompt_tuple: (k_np [L,1,plen,H,D], v_np)} in
+        # insertion (LRU) order — host DRAM, not HBM
+        self._prefix_cache: Dict[Tuple[int, ...], Tuple[Any, Any]] = {}
 
     # -- public api ---------------------------------------------------------
 
@@ -263,7 +275,18 @@ class LLMEngine:
                 self.cfg.num_hidden_layers, 1, alloc,
                 self.cfg.num_key_value_heads, self.cfg.hd,
                 quantized=self.cfg_engine.kv_quantized)
-            a = self._admitting = _Admission(req, free, bucket, 0, cache1)
+            consumed, seed_kv = self._seed_from_prefix_cache(
+                req.prompt_token_ids, chunk)
+            if consumed:
+                k_np, v_np = seed_kv
+                kb = np.zeros(cache1.k.shape, k_np.dtype)
+                vb = np.zeros_like(kb)
+                kb[:, :, :consumed] = k_np[:, :, :consumed]
+                vb[:, :, :consumed] = v_np[:, :, :consumed]
+                cache1 = KVCache(jnp.asarray(kb), jnp.asarray(vb),
+                                 jnp.asarray(consumed, jnp.int32))
+            a = self._admitting = _Admission(req, free, bucket, consumed,
+                                             cache1)
 
         if a.req.request_id in self._abort:      # aborted mid-admission
             self._abort.discard(a.req.request_id)
@@ -281,6 +304,7 @@ class LLMEngine:
         a.consumed += chunk
 
         if a.consumed >= plen:
+            self._remember_prefix(a.req.prompt_token_ids, a.cache1)
             self.cache = self._insert(self.cache, a.cache1.k, a.cache1.v,
                                       a.slot_idx, plen)
             first = self._sample_host(
@@ -293,6 +317,75 @@ class LLMEngine:
             self._emit(s)
             self._check_done(a.slot_idx)
             self._admitting = None
+
+    @staticmethod
+    def _materialize(entry):
+        """Pending device slices -> host numpy (cheap if the async copy
+        already landed). device_get can hand back non-contiguous views on
+        some backends; force contiguity before keeping them around."""
+        k, v = entry
+        if not isinstance(k, np.ndarray):
+            k = np.ascontiguousarray(np.asarray(k))
+            v = np.ascontiguousarray(np.asarray(v))
+        return k, v
+
+    def _seed_from_prefix_cache(self, prompt: List[int], chunk: int):
+        """(consumed, (k, v)) for the longest usable cached prefix —
+        rounded DOWN to a chunk multiple (continuation chunks must stay
+        chunk-aligned) and capped at plen-1 (the final token must run to
+        produce sampling logits). (0, None) on miss."""
+        best = 0
+        best_key = None
+        for stored in self._prefix_cache:
+            n = 0
+            for a, b in zip(stored, prompt):
+                if a != b:
+                    break
+                n += 1
+            if n > best:
+                best, best_key = n, stored
+        best = min(best, len(prompt) - 1)
+        best -= best % chunk
+        if best <= 0:
+            return 0, None
+        entry = self._materialize(self._prefix_cache[best_key])
+        self._prefix_cache[best_key] = entry
+        # snapshots are truncated to prefix_cache_max_tokens; never seed
+        # past what was actually stored
+        best = min(best, entry[0].shape[2])
+        best -= best % chunk
+        if best <= 0:
+            return 0, None
+        return best, entry
+
+    def _remember_prefix(self, prompt: List[int], cache1: KVCache) -> None:
+        """Snapshot the prompt's (truncated) KV for later prefix reuse.
+
+        The snapshot is taken as device slices with an ASYNC host copy
+        started immediately — step() is not stalled by a blocking D2H of
+        the whole prompt KV; materialization happens on the next cache
+        touch, by when the copy has usually landed."""
+        ce = self.cfg_engine
+        if ce.prefix_cache_entries <= 0:
+            return
+        key = tuple(prompt)
+        entry = self._prefix_cache.pop(key, None)
+        if entry is None:
+            keep = min(len(prompt), ce.prefix_cache_max_tokens)
+            k1 = cache1.k[:, :, :keep]
+            v1 = cache1.v[:, :, :keep]
+            try:
+                k1.copy_to_host_async()
+                v1.copy_to_host_async()
+            except Exception:
+                pass                      # backend without async copies
+            entry = (k1, v1)
+        self._prefix_cache[key] = entry          # (re-)insert most-recent
+        while len(self._prefix_cache) > ce.prefix_cache_entries:
+            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+
+    def reset_prefix_cache(self) -> None:
+        self._prefix_cache.clear()
 
     def _finish_admission_abort(self, a: _Admission) -> None:
         with self._lock:

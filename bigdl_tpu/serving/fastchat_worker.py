@@ -106,19 +106,10 @@ class WorkerCore:
             texts = [texts]
         if not texts:
             return {"embedding": [], "token_num": 0}
-        import numpy as np
-
-        encs = [self.embedder_tokenizer(t)["input_ids"][:512]
-                for t in texts]
-        n = max(len(e) for e in encs)
-        ids = np.zeros((len(encs), n), np.int32)
-        mask = np.zeros((len(encs), n), np.int32)
-        for i, e in enumerate(encs):
-            ids[i, :len(e)] = e
-            mask[i, :len(e)] = 1
-        vecs = self.embedder.embed(ids, mask)
+        vecs, token_num = self.embedder.embed_texts(
+            texts, self.embedder_tokenizer, with_counts=True)
         return {"embedding": [list(map(float, v)) for v in vecs],
-                "token_num": int(mask.sum())}
+                "token_num": token_num}
 
 
 def _make_fastchat_worker():

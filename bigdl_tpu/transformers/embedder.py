@@ -51,13 +51,25 @@ class BertEmbedder(_BertTaskModel):
 
     def embed_texts(self, texts: List[str], tokenizer,
                     max_length: int = 512,
-                    pooling: str = "mean") -> np.ndarray:
-        """Tokenize + embed a batch of strings (padded to one bucket)."""
-        encs = [tokenizer(t)["input_ids"][:max_length] for t in texts]
+                    pooling: str = "mean",
+                    with_counts: bool = False):
+        """Tokenize + embed a batch of strings (padded to one bucket).
+
+        Truncation runs through the tokenizer (so the trailing [SEP]
+        survives) and is capped at the checkpoint's position table —
+        beyond it, position lookups would clamp and silently corrupt
+        embeddings. with_counts=True also returns the total number of
+        tokens actually embedded (serving usage accounting)."""
+        limit = min(max_length, self.config.max_position_embeddings)
+        encs = [tokenizer(t, truncation=True,
+                          max_length=limit)["input_ids"] for t in texts]
         n = max(len(e) for e in encs)
         ids = np.zeros((len(encs), n), np.int32)
         mask = np.zeros((len(encs), n), np.int32)
         for i, e in enumerate(encs):
             ids[i, :len(e)] = e
             mask[i, :len(e)] = 1
-        return self.embed(ids, mask, pooling=pooling)
+        vecs = self.embed(ids, mask, pooling=pooling)
+        if with_counts:
+            return vecs, int(mask.sum())
+        return vecs

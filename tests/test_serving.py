@@ -151,6 +151,68 @@ def test_abort_while_queued(model):
         raise AssertionError("queued abort never produced an output")
 
 
+def test_prefix_cache_exact_and_skips_work(model):
+    """Repeated-prefix prompts must decode identically AND admit in
+    fewer steps (seeded from the cached prefix KV)."""
+    eng = LLMEngine(model, EngineConfig(max_batch=1, max_seq=256,
+                                        prefill_chunk=16,
+                                        prefix_cache_entries=2))
+    sys_prompt = list(range(1, 65))                    # 64-token "system"
+    p1 = sys_prompt + [70, 71]
+    p2 = sys_prompt + [80, 81, 82]
+
+    (out1,) = eng.generate([p1], SamplingParams(max_tokens=6))
+    assert out1 == plain_greedy(model.params, p1, 6)
+
+    # second request shares the 64-token prefix: only the tail chunk
+    # (re)runs -> 1 admission step instead of ceil(66/16)=5
+    steps_before = 0
+    eng.add_request("r2", p2, SamplingParams(max_tokens=6))
+    while eng._admitting is None and not eng.slots[0].active:
+        eng.step()
+    # count steps until slot activates (admission done)
+    while not eng.slots[0].active:
+        eng.step()
+        steps_before += 1
+    assert steps_before <= 1, f"prefix not reused: {steps_before} steps"
+    got2 = []
+    while eng.has_unfinished():
+        eng.step()
+    for o in eng.get_outputs("r2"):
+        got2.extend(o.new_token_ids)
+    assert got2 == plain_greedy(model.params, p2, 6)
+
+    # identical full prompt re-admits with a single step too
+    eng2_steps = 0
+    eng.add_request("r3", p1, SamplingParams(max_tokens=6))
+    while not eng.slots[0].active:
+        eng.step()
+        eng2_steps += 1
+    assert eng2_steps <= 1
+    got3 = []
+    while eng.has_unfinished():
+        eng.step()
+    for o in eng.get_outputs("r3"):
+        got3.extend(o.new_token_ids)
+    assert got3 == out1
+
+    eng.reset_prefix_cache()
+    assert eng._prefix_cache == {}
+
+
+def test_prefix_cache_lru_eviction(model):
+    eng = LLMEngine(model, EngineConfig(max_batch=1, max_seq=128,
+                                        prefill_chunk=16,
+                                        prefix_cache_entries=2))
+    for base in (1, 40, 70):
+        eng.generate([[base + i for i in range(20)]],
+                     SamplingParams(max_tokens=2))
+    assert len(eng._prefix_cache) == 2
+    # oldest (base=1) evicted; newest two retained
+    keys = list(eng._prefix_cache)
+    assert keys[0][0] == 40 and keys[1][0] == 70
+
+
 def test_abort_mid_admission(model):
     eng = LLMEngine(model, EngineConfig(max_batch=1, max_seq=256,
                                         prefill_chunk=16))
