@@ -249,8 +249,9 @@ class Generator:
         # logits gather instead when pad > 0.
         if pad > 0:
             # cheap fix: decode path needs logits at position s-1; rerun the
-            # last real token through decode after trimming cache.pos.
-            cache = KVCache(cache.k, cache.v, jnp.asarray(s - 1, jnp.int32))
+            # last real token through decode after trimming cache.pos
+            # (reset_pos keeps non-KVCache cache types' extra state)
+            cache = cache.reset_pos(jnp.asarray(s - 1, jnp.int32))
             logits, cache = self._decode(
                 self.params, self.cfg, jnp.asarray(ids[:, -1:]), cache)
         else:

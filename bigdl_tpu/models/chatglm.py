@@ -1,0 +1,387 @@
+"""ChatGLM v1 (chatglm-6b): the GLM prefix-LM architecture.
+
+TPU-native equivalent of the reference's chatglm v1 support (reference
+transformers/models/chatglm.py:243-308 `chatglm_attention_forward` +
+`attention_fn`, and the native chatglm engine under ggml/model/chatglm/).
+Distinct from chatglm2/3 (which the generalized scan decoder serves via
+config deltas, models/families.py): v1 has
+
+- **2D rotary**: the head dim splits in half; the first half rotates with
+  sequence positions (frozen at the [gMASK] slot once generation starts),
+  the second with "block" positions (0 over the context, 1.. for
+  generated tokens) — reference chatglm.py:272-283.
+- **Prefix-bidirectional attention**: every query sees the whole context
+  (tokens before/at the final [sop]/bos); causality applies only after it
+  (GLM's get_masks).
+- **DeepNorm-style residuals**: `x = ln(x)*alpha + sublayer(ln(x))` with
+  alpha = sqrt(2*num_layers) — the residual carries the NORMED input.
+- **Megatron fused QKV**: query_key_value rows interleave q/k/v PER HEAD;
+  conversion de-interleaves into plain q/k/v (quantized separately).
+
+Context length and mask position are data-dependent VALUES (token
+searches), not shapes — they are computed inside the jitted prefill and
+carried in the cache, so one executable serves every prompt.
+
+The prompt must contain [gMASK] (or [MASK]) and end with bos/[sop], the
+layout every chatglm-6b tokenizer emits; without bos the whole prompt is
+treated as context (fully bidirectional) and generation is causal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.ops.kvcache import KVCache, init_cache, read_layer, \
+    update_layer
+from bigdl_tpu.ops.matmul import linear
+from bigdl_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class ChatGLMConfig:
+    vocab_size: int = 130528
+    hidden_size: int = 4096
+    num_layers: int = 28
+    num_attention_heads: int = 32
+    inner_hidden_size: int = 16384
+    layernorm_epsilon: float = 1e-5
+    max_sequence_length: int = 2048
+    bos_token_id: int = 130004
+    mask_token_id: int = 130000
+    gmask_token_id: int = 130001
+    position_encoding_2d: bool = True
+
+    @property
+    def hd(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def alpha(self) -> float:
+        return (2.0 * self.num_layers) ** 0.5
+
+
+def config_from_hf(hf: Dict[str, Any]) -> ChatGLMConfig:
+    return ChatGLMConfig(
+        vocab_size=hf.get("vocab_size", 130528),
+        hidden_size=hf["hidden_size"],
+        num_layers=hf.get("num_layers", hf.get("num_hidden_layers", 28)),
+        num_attention_heads=hf["num_attention_heads"],
+        inner_hidden_size=hf.get("inner_hidden_size",
+                                 4 * hf["hidden_size"]),
+        layernorm_epsilon=hf.get("layernorm_epsilon", 1e-5),
+        max_sequence_length=hf.get("max_sequence_length", 2048),
+        bos_token_id=hf.get("bos_token_id", 130004),
+        mask_token_id=hf.get("mask_token_id", 130000),
+        gmask_token_id=hf.get("gmask_token_id", 130001),
+        position_encoding_2d=hf.get("position_encoding_2d", True),
+    )
+
+
+def is_v1_config(hf: Dict[str, Any]) -> bool:
+    """chatglm-6b vs chatglm2/3: v1 configs carry position_encoding_2d /
+    inner_hidden_size; v2+ carry ffn_hidden_size/multi_query_attention."""
+    return ("position_encoding_2d" in hf or "inner_hidden_size" in hf) \
+        and "ffn_hidden_size" not in hf
+
+
+# -- cache --------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ChatGLMCache:
+    kv: KVCache
+    ctx_len: jax.Array      # [B] int32: bos index + 1 (bidirectional span)
+    mask_pos: jax.Array     # [B] int32: [gMASK]/[MASK] index
+
+    def tree_flatten(self):
+        return (self.kv, self.ctx_len, self.mask_pos), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    @property
+    def pos(self):
+        return self.kv.pos
+
+    def reset_pos(self, pos) -> "ChatGLMCache":
+        """Generator pad-repair hook: trim validity, keep GLM anchors."""
+        return ChatGLMCache(self.kv.reset_pos(pos), self.ctx_len,
+                            self.mask_pos)
+
+
+def new_cache(cfg: ChatGLMConfig, batch: int, max_seq: int,
+              quantized: bool = False) -> ChatGLMCache:
+    return ChatGLMCache(
+        kv=init_cache(cfg.num_layers, batch, max_seq,
+                      cfg.num_attention_heads, cfg.hd,
+                      quantized=quantized),
+        ctx_len=jnp.zeros((batch,), jnp.int32),
+        mask_pos=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+# -- 2D rotary ----------------------------------------------------------------
+
+
+def _rope_half(x: jax.Array, positions: jax.Array,
+               rot_dim: int) -> jax.Array:
+    """Rotate a [B, S, H, rot_dim] slice by per-token positions using the
+    split-half convention (reference chatglm.py:28-38) — the shared
+    helpers from ops/rope.py with inv_freq over rot_dim."""
+    from bigdl_tpu.ops.rope import apply_rope, rope_cos_sin
+
+    inv_freq = 1.0 / (10000.0 ** (
+        jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim))
+    cos, sin = rope_cos_sin(positions, inv_freq)     # [B, S, rot/2]
+    return apply_rope(x, cos, sin, interleaved=False)
+
+
+def _apply_2d_rope(q, k, pos_seq, pos_block, cfg: ChatGLMConfig):
+    """First half of head dim <- sequence positions; second half <-
+    block positions (reference chatglm.py:272-283)."""
+    hd = cfg.hd
+    half = hd // 2
+    q1 = _rope_half(q[..., :half], pos_seq, half)
+    q2 = _rope_half(q[..., half:], pos_block, half)
+    k1 = _rope_half(k[..., :half], pos_seq, half)
+    k2 = _rope_half(k[..., half:], pos_block, half)
+    return (jnp.concatenate([q1, q2], axis=-1),
+            jnp.concatenate([k1, k2], axis=-1))
+
+
+# -- forward ------------------------------------------------------------------
+
+
+def _glm_attention(q, k, v, q_index, ctx_len, scale):
+    """SDP with the GLM prefix mask: key j visible to query at absolute
+    index i when j < ctx_len (bidirectional context) OR j <= i (causal).
+    q [B,Sq,H,hd]; k/v [B,Skv,H,hd] cache slices; q_index [B,Sq] abs
+    indices; ctx_len [B]."""
+    b, sq, h, hd = q.shape
+    skv = k.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.bfloat16),
+                        k.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32) * scale
+    k_ids = jnp.arange(skv, dtype=jnp.int32)
+    vis = (k_ids[None, None, :] <= q_index[:, :, None]) | \
+        (k_ids[None, None, :] < ctx_len[:, None, None])
+    # the cache tail past the newest write is masked because q_index is
+    # always >= every valid entry EXCEPT the bidirectional clause — cap
+    # that clause by the written region (ctx_len <= pos by construction)
+    scores = jnp.where(vis[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(jnp.bfloat16),
+                     v.astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, sq, h * hd).astype(q.dtype)
+
+
+def _layer(x, lp, cfg: ChatGLMConfig, pos_seq, pos_block, q_index,
+           ctx_len, ck, cv, li, write_pos):
+    """One GLMBlock; returns (x, ck, cv)."""
+    b, sq, d = x.shape
+    h, hd = cfg.num_attention_heads, cfg.hd
+    eps = cfg.layernorm_epsilon
+    alpha = jnp.asarray(cfg.alpha, x.dtype)
+
+    attn_in = layer_norm(x, lp["input_layernorm"],
+                         lp["input_layernorm_bias"], eps)
+    q = linear(attn_in, lp["q_proj"], lp.get("q_proj_bias"))
+    k = linear(attn_in, lp["k_proj"], lp.get("k_proj_bias"))
+    v = linear(attn_in, lp["v_proj"], lp.get("v_proj_bias"))
+    q = q.reshape(b, sq, h, hd)
+    k = k.reshape(b, sq, h, hd)
+    v = v.reshape(b, sq, h, hd)
+    q, k = _apply_2d_rope(q, k, pos_seq, pos_block, cfg)
+
+    ck, cv = update_layer(ck, cv, li, k, v, write_pos)
+    kf, vf = read_layer(ck, cv, li)
+    a = _glm_attention(q, kf, vf, q_index, ctx_len, hd ** -0.5)
+    a = linear(a, lp["o_proj"], lp.get("o_proj_bias"))
+    x = attn_in * alpha + a
+
+    mlp_in = layer_norm(x, lp["post_attention_layernorm"],
+                        lp["post_attention_layernorm_bias"], eps)
+    inner = jax.nn.gelu(linear(mlp_in, lp["fc1"], lp.get("fc1_bias")),
+                        approximate=True)
+    out = linear(inner, lp["fc2"], lp.get("fc2_bias"))
+    return mlp_in * alpha + out, ck, cv
+
+
+def _positions(cfg: ChatGLMConfig, q_index, ctx_len, mask_pos):
+    """GLM 2D positions for absolute indices q_index [B, Sq]:
+    seq row = index (frozen at mask_pos past the context), block row = 0
+    over the context then 1.. (reference get_position_ids)."""
+    in_ctx = q_index < ctx_len[:, None]
+    pos_seq = jnp.where(in_ctx, q_index, mask_pos[:, None])
+    pos_block = jnp.where(in_ctx, 0, q_index - ctx_len[:, None] + 1)
+    return pos_seq, pos_block
+
+
+def forward(
+    params: Dict[str, Any],
+    cfg: ChatGLMConfig,
+    tokens: jax.Array,        # [B, Sq] int32
+    cache: ChatGLMCache,
+    compute_dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, ChatGLMCache]:
+    """Prefill (pos==0: also derives ctx_len/mask_pos from the tokens)
+    and decode in one function; returns (logits [B,Sq,V], cache)."""
+    b, sq = tokens.shape
+    pos = cache.kv.pos               # scalar write offset
+
+    is_prefill = pos == 0
+    has_bos = jnp.any(tokens == cfg.bos_token_id, axis=1)
+    bos_idx = jnp.argmax(tokens == cfg.bos_token_id, axis=1)
+    # prompts may arrive right-padded with zeros (Generator buckets);
+    # the padded tail must NOT land inside the bidirectional span, so
+    # the no-bos fallback uses the real length (last non-zero + 1)
+    nz = tokens != 0
+    real_len = jnp.where(
+        jnp.any(nz, axis=1),
+        sq - jnp.argmax(jnp.flip(nz, axis=1), axis=1), 0)
+    ctx_new = jnp.where(has_bos, bos_idx + 1, real_len).astype(jnp.int32)
+    has_g = jnp.any(tokens == cfg.gmask_token_id, axis=1)
+    g_idx = jnp.argmax(tokens == cfg.gmask_token_id, axis=1)
+    has_m = jnp.any(tokens == cfg.mask_token_id, axis=1)
+    m_idx = jnp.argmax(tokens == cfg.mask_token_id, axis=1)
+    mask_new = jnp.where(has_g, g_idx,
+                         jnp.where(has_m, m_idx,
+                                   jnp.maximum(ctx_new - 1, 0))
+                         ).astype(jnp.int32)
+    ctx_len = jnp.where(is_prefill, ctx_new, cache.ctx_len)
+    mask_pos = jnp.where(is_prefill, mask_new, cache.mask_pos)
+
+    q_index = pos + jnp.arange(sq, dtype=jnp.int32)[None, :] \
+        + jnp.zeros((b, 1), jnp.int32)                    # [B, Sq]
+    pos_seq, pos_block = _positions(cfg, q_index, ctx_len, mask_pos)
+
+    x = params["embed_tokens"][tokens].astype(compute_dtype)
+
+    lidx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+
+    def step(carry, xs):
+        x, ck, cv = carry
+        lp, li = xs
+        x, ck, cv = _layer(x, lp, cfg, pos_seq, pos_block, q_index,
+                           ctx_len, ck, cv, li, pos)
+        return (x, ck, cv), None
+
+    (x, ck, cv), _ = lax.scan(step, (x, cache.kv.k, cache.kv.v),
+                              (params["layers"], lidx))
+
+    x = layer_norm(x, params["final_layernorm"],
+                   params["final_layernorm_bias"], cfg.layernorm_epsilon)
+    logits = linear(x, params["lm_head"]).astype(jnp.float32)
+    return logits, ChatGLMCache(
+        kv=KVCache(ck, cv, pos + sq), ctx_len=ctx_len, mask_pos=mask_pos)
+
+
+def forward_last_token(params, cfg, tokens, cache,
+                       compute_dtype=jnp.bfloat16):
+    logits, cache = forward(params, cfg, tokens, cache,
+                            compute_dtype=compute_dtype)
+    return logits[:, -1:, :], cache
+
+
+def forward_train(params, cfg: ChatGLMConfig, tokens,
+                  compute_dtype=jnp.bfloat16):
+    """Cacheless full-sequence forward (perplexity / lm-eval)."""
+    b, s = tokens.shape
+    cache = new_cache(cfg, b, s)
+    logits, _ = forward(params, cfg, tokens, cache,
+                        compute_dtype=compute_dtype)
+    return logits
+
+
+# -- conversion ---------------------------------------------------------------
+
+
+def convert_hf_params(
+    tensors,
+    cfg: ChatGLMConfig,
+    qtype: Optional[str] = "sym_int4",
+    compute_dtype=jnp.bfloat16,
+    modules_to_not_convert: Tuple[str, ...] = (),
+    imatrix=None,
+) -> Dict[str, Any]:
+    """chatglm-6b tensors -> stacked pytree. query_key_value rows are
+    PER-HEAD interleaved ([H, 3, hd, D]); de-interleaved here so q/k/v
+    quantize as plain linears (the reference keeps the fused tensor and
+    re-splits per forward, chatglm.py:259-270)."""
+    from bigdl_tpu.models.convert_base import Acc
+
+    h, hd = cfg.num_attention_heads, cfg.hd
+    acc = Acc.for_layer_count(cfg.num_layers, qtype, compute_dtype,
+                              modules_to_not_convert, imatrix=imatrix)
+
+    def deinterleave(w):
+        # [3D, D] (or [3D]) rows grouped per head as [q|k|v] blocks
+        shp = w.shape[1:]
+        parts = np.asarray(w).reshape(h, 3, hd, *shp)
+        return (parts[:, 0].reshape(h * hd, *shp),
+                parts[:, 1].reshape(h * hd, *shp),
+                parts[:, 2].reshape(h * hd, *shp))
+
+    for name, w in tensors:
+        if name.endswith("word_embeddings.weight"):
+            acc.top["embed_tokens"] = acc.dense(w)
+        elif name == "lm_head.weight":
+            acc.top["lm_head"] = acc.linear(name, w)
+        elif name.endswith("final_layernorm.weight"):
+            acc.top["final_layernorm"] = acc.dense(w)
+        elif name.endswith("final_layernorm.bias"):
+            acc.top["final_layernorm_bias"] = acc.dense(w)
+        else:
+            pre = "transformer.layers."
+            if not name.startswith(pre):
+                continue
+            idx_s, sub = name[len(pre):].split(".", 1)
+            idx = int(idx_s)
+            if sub == "attention.query_key_value.weight":
+                q, k, v = deinterleave(w)
+                acc.put("q_proj", idx, acc.linear(name + "#q_proj", q))
+                acc.put("k_proj", idx, acc.linear(name + "#k_proj", k))
+                acc.put("v_proj", idx, acc.linear(name + "#v_proj", v))
+            elif sub == "attention.query_key_value.bias":
+                q, k, v = deinterleave(w)
+                acc.put("q_proj_bias", idx, acc.dense(q))
+                acc.put("k_proj_bias", idx, acc.dense(k))
+                acc.put("v_proj_bias", idx, acc.dense(v))
+            else:
+                m = {
+                    "attention.dense.weight": ("o_proj", "linear"),
+                    "attention.dense.bias": ("o_proj_bias", "dense"),
+                    "input_layernorm.weight": ("input_layernorm", "dense"),
+                    "input_layernorm.bias":
+                        ("input_layernorm_bias", "dense"),
+                    "post_attention_layernorm.weight":
+                        ("post_attention_layernorm", "dense"),
+                    "post_attention_layernorm.bias":
+                        ("post_attention_layernorm_bias", "dense"),
+                    "mlp.dense_h_to_4h.weight": ("fc1", "linear"),
+                    "mlp.dense_h_to_4h.bias": ("fc1_bias", "dense"),
+                    "mlp.dense_4h_to_h.weight": ("fc2", "linear"),
+                    "mlp.dense_4h_to_h.bias": ("fc2_bias", "dense"),
+                }.get(sub)
+                if m is None:
+                    continue
+                key, kind = m
+                val = acc.linear(name, w) if kind == "linear" \
+                    else acc.dense(w)
+                acc.put(key, idx, val)
+
+    params = acc.finish(tie=False, lm_head_required=False,
+                        what="chatglm checkpoint")
+    if "lm_head" not in params:          # tied to the embedding
+        params["lm_head"] = jnp.asarray(
+            np.asarray(params["embed_tokens"]).T).astype(compute_dtype)
+    return params

@@ -1060,8 +1060,28 @@ def register_all() -> None:
                     _adapter("starcoder2", _starcoder2_cfg, _starcoder2_map))
     register_family(["BaichuanForCausalLM", "BaiChuanForCausalLM"],
                     _adapter("baichuan", _baichuan_cfg, _baichuan_map))
+    # chatglm arch names are shared across structurally different
+    # versions: v1 (2D rope, prefix-bidirectional, deepnorm — its own
+    # module) vs v2/3 (llama-shaped config delta). Dispatch on config.
+    _chatglm2_adapter = _adapter("chatglm", _chatglm2_cfg, _chatglm2_map)
+
+    def _chatglm_dispatch(hf):
+        from bigdl_tpu.models import chatglm as glm1
+
+        if hf is not None and glm1.is_v1_config(hf):
+            return FamilyAdapter(
+                name="chatglm1",
+                config_from_hf=glm1.config_from_hf,
+                convert_params=glm1.convert_hf_params,
+                forward=glm1.forward,
+                prefill=glm1.forward_last_token,
+                forward_train=glm1.forward_train,
+                new_cache=glm1.new_cache,
+            )
+        return _chatglm2_adapter
+
     register_family(["ChatGLMModel", "ChatGLMForConditionalGeneration"],
-                    _adapter("chatglm", _chatglm2_cfg, _chatglm2_map))
+                    _chatglm_dispatch)
     # HF transformers writes "MptForCausalLM"; community ckpts "MPT..."
     register_family(["MPTForCausalLM", "MptForCausalLM"],
                     _adapter("mpt", _mpt_cfg, _mpt_map))

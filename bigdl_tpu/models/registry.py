@@ -29,21 +29,28 @@ class FamilyAdapter:
     is_recurrent: bool = False
 
 
-_REGISTRY: Dict[str, FamilyAdapter] = {}
+_REGISTRY: Dict[str, Any] = {}
 
 
-def register_family(arch_names, adapter: FamilyAdapter) -> None:
+def register_family(arch_names, adapter) -> None:
+    """adapter: a FamilyAdapter, or a callable dispatcher
+    `(hf_config | None) -> FamilyAdapter` for arch names shared by
+    structurally different versions (chatglm v1 vs v2/3)."""
     for a in arch_names:
         _REGISTRY[a] = adapter
 
 
-def get_family(arch: str) -> FamilyAdapter:
+def get_family(arch: str,
+               hf_config: Optional[Dict[str, Any]] = None) -> FamilyAdapter:
     try:
-        return _REGISTRY[arch]
+        entry = _REGISTRY[arch]
     except KeyError:
         raise ValueError(
             f"unsupported architecture {arch!r}; supported: "
             f"{sorted(_REGISTRY)}") from None
+    if isinstance(entry, FamilyAdapter):
+        return entry
+    return entry(hf_config)
 
 
 def supported_architectures():

@@ -53,6 +53,11 @@ class KVCache:
     def num_layers(self) -> int:
         return self.k.shape[0]
 
+    def reset_pos(self, pos) -> "KVCache":
+        """Same buffers, new validity pointer (generation pad repair /
+        speculative rollback)."""
+        return KVCache(self.k, self.v, pos)
+
 
 def init_cache(
     num_layers: int,

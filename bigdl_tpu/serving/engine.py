@@ -131,6 +131,12 @@ class LLMEngine:
                 f"{self.family.name!r} family carries recurrent state "
                 "whose slots cannot be rewound/packed — serve it through "
                 "model.generate() instead")
+        probe_cache = self.family.new_cache(self.cfg, 1, 8, False)
+        if not isinstance(probe_cache, KVCache):
+            raise ValueError(
+                f"the {self.family.name!r} family uses a custom cache "
+                f"({type(probe_cache).__name__}) the slot engine cannot "
+                "splice — serve it through model.generate() instead")
         self.eos_token_id = None
         hf = getattr(model, "hf_config", None) or {}
         eos = hf.get("eos_token_id")

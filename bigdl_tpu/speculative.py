@@ -223,11 +223,11 @@ def make_spec_round(
                 jnp.concatenate([draft_toks, draft_toks[:, -1:]], axis=1),
                 jnp.take_along_axis(target_pred, n_accept[:, None], axis=1))
 
-        # --- rollback: pure index bookkeeping ---
+        # --- rollback: pure index bookkeeping (reset_pos keeps any
+        # family-specific cache state, e.g. ChatGLMCache anchors) ---
         new_pos = pos0 + n_accept[0] + 1            # B=1: scalar pos
-        cache_t = KVCache(cache_t.k, cache_t.v, new_pos)
-        cache_d = KVCache(cache_d.k, cache_d.v, new_pos)
-        return out, n_accept, n_draft, cache_t, cache_d, key
+        return (out, n_accept, n_draft, cache_t.reset_pos(new_pos),
+                cache_d.reset_pos(new_pos), key)
 
     return spec_round
 

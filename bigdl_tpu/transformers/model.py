@@ -308,7 +308,7 @@ class _BaseAutoModelClass:
 
             params, hf_config, tok_info = load_gguf(path)
             archs = hf_config.get("architectures") or ["?"]
-            family = get_family(archs[0])
+            family = get_family(archs[0], hf_config)
             cfg = family.config_from_hf(hf_config)
             model = TpuCausalLM(params, cfg, family, hf_config,
                                 qtype="gguf",
@@ -324,7 +324,7 @@ class _BaseAutoModelClass:
         qtype = _resolve_qtype(load_in_4bit, load_in_low_bit)
         hf_config = load_hf_config(path)
         archs = hf_config.get("architectures") or ["?"]
-        family = get_family(archs[0])
+        family = get_family(archs[0], hf_config)
         cfg = family.config_from_hf(hf_config)
 
         tensor_stream = iter_hf_tensors(path)
@@ -417,7 +417,7 @@ class _BaseAutoModelClass:
         params, manifest = lowbit_io.load_low_bit(path)
         hf_config = manifest["config"]
         archs = hf_config.get("architectures") or ["?"]
-        family = get_family(archs[0])
+        family = get_family(archs[0], hf_config)
         cfg = family.config_from_hf(hf_config)
         return _attach_qwen_vl(TpuCausalLM(
             params, cfg, family, hf_config,

@@ -215,11 +215,17 @@ def test_bert_embed_quantized(tmp_path):
     assert cls.shape == (2, D)
 
     class FakeTok:
-        def __call__(self, text):
-            return {"input_ids": [2] + [5] * (len(text) % 7 + 1)}
+        def __call__(self, text, truncation=False, max_length=None):
+            ids = [2] + [5] * (len(text) % 7 + 1)
+            if truncation and max_length is not None:
+                ids = ids[:max_length]
+            return {"input_ids": ids}
 
     out = m.embed_texts(["hello world", "tpu"], FakeTok())
     assert out.shape == (2, D)
+    out2, n_tok = m.embed_texts(["hello world"], FakeTok(),
+                                with_counts=True)
+    assert out2.shape == (1, D) and n_tok > 0
 
 
 def test_speculative_rejected_for_yuan(tmp_path):
