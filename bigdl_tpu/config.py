@@ -28,6 +28,12 @@ class RuntimeFlags:
     matmul_backend: str = "auto"
     # decode-attention dispatch, same values (ops/pallas/decode_attention)
     attention_backend: str = "auto"
+    # decode GEMV (M<=16) kernel variant: "auto" (use it), "off" (route
+    # small-M through the generic tiles) — the on-chip A/B switch
+    matmul_gemv: str = "auto"
+    # MoE prefill dispatch: "auto" (sorted ragged kernel on TPU, dense
+    # combine elsewhere), "ragged" (force, incl. interpret), "dense"
+    moe_dispatch: str = "auto"
     # host-side C++ kernels (bigdl_tpu.native); disable to force pure JAX
     disable_native: bool = False
     native_cache_dir: Optional[str] = None
@@ -43,6 +49,8 @@ class RuntimeFlags:
             matmul_backend=os.environ.get("BIGDL_TPU_MATMUL_BACKEND", "auto"),
             attention_backend=os.environ.get(
                 "BIGDL_TPU_ATTENTION_BACKEND", "auto"),
+            matmul_gemv=os.environ.get("BIGDL_TPU_MATMUL_GEMV", "auto"),
+            moe_dispatch=os.environ.get("BIGDL_TPU_MOE_DISPATCH", "auto"),
             disable_native=_env_bool("BIGDL_TPU_DISABLE_NATIVE"),
             native_cache_dir=os.environ.get("BIGDL_TPU_NATIVE_CACHE"),
             quantize_kv_cache=_env_bool("BIGDL_TPU_QUANTIZE_KV_CACHE"),

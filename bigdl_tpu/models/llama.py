@@ -277,6 +277,16 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
     # gather path pays k weight-gathers per token; dense pays E expert
     # matmuls over all N tokens — switch where gathered bytes win
     if n * cfg.num_experts_per_tok <= cfg.num_local_experts:
+        from bigdl_tpu.ops.matmul import vmapped_pallas_ok
+
+        # fused kernels under vmap are gated by a one-time eager probe
+        # PER QTYPE (compile failures degrade to the XLA matmul, never
+        # crash a jit); dense expert stacks never hit pallas
+        gq = (lp["experts_up"].qtype
+              if hasattr(lp["experts_up"], "qtype") else None)
+        gather_backend = (None if gq is not None and vmapped_pallas_ok(gq)
+                          else "xla")
+
         def per_token(x_row, idxs, wts):
             def per_choice(i):
                 gw = (jax.tree.map(lambda a: a[i], lp["experts_gate"])
@@ -285,11 +295,8 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
                 dw = jax.tree.map(lambda a: a[i], lp["experts_down"])
                 ub = lp["experts_up_bias"][i] if biased else None
                 db = lp["experts_down_bias"][i] if biased else None
-                # vmapped pallas_call is not yet validated on this
-                # toolchain; the per-token gather runs the XLA matmul
-                # (the HBM win comes from gathering k of E experts)
                 return one_expert(x_row[None], gw, uw, dw, ub, db,
-                                  backend="xla")[0]
+                                  backend=gather_backend)[0]
 
             outs = jnp.stack([per_choice(idxs[j])
                               for j in range(cfg.num_experts_per_tok)])
@@ -297,6 +304,33 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
 
         y = jax.vmap(per_token)(xf, topi, w)
         return y.reshape(b, t, d)
+
+    # prefill: sorted ragged dispatch runs only the CHOSEN experts'
+    # FLOPs (E/k cut vs the dense combine below); requires the Pallas
+    # kernel, probed per geometry. Quantized-with-bias stacks (none of
+    # the served families) would fall through to dense.
+    from bigdl_tpu.config import flags
+
+    if (not biased and flags().moe_dispatch != "dense"
+            and (jax.default_backend() == "tpu"
+                 or flags().moe_dispatch == "ragged")):
+        from bigdl_tpu.ops.pallas.moe_dispatch import (
+            moe_mlp_ragged, ragged_kernel_compiles)
+
+        interp = jax.default_backend() != "tpu"
+        qtype = (lp["experts_up"].qtype
+                 if hasattr(lp["experts_up"], "qtype") else None)
+        forced = flags().moe_dispatch == "ragged"
+        # forced mode bypasses the probe so compile errors SURFACE
+        # (A/B runs must never silently measure the dense path)
+        if interp or forced or ragged_kernel_compiles(
+                qtype, d, cfg.intermediate_size):
+            y = moe_mlp_ragged(
+                xf, topi, w,
+                lp["experts_gate"] if gated else None,
+                lp["experts_up"], lp["experts_down"], act,
+                cfg.num_local_experts, interpret=interp)
+            return y.reshape(b, t, d)
 
     combine = jnp.sum(
         jax.nn.one_hot(topi, cfg.num_local_experts, dtype=w.dtype)

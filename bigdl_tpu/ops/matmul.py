@@ -78,6 +78,49 @@ def _q_matmul_dispatch(x: jax.Array, w: QTensor, be: str) -> jax.Array:
     raise ValueError(f"unknown matmul backend {be!r}")
 
 
+_VMAPPED_PALLAS: dict = {}
+
+
+def vmapped_pallas_ok(qtype: str = "sym_int4") -> bool:
+    """Eager probe PER QTYPE: does a vmapped, dynamically-indexed
+    q_matmul_pallas compile on this backend for this format? Gates the
+    MoE decode gather path's use of the fused kernel (models/llama.py
+    `_moe_mlp`): pallas_call's batching rule, dynamic expert indexing,
+    and the qtype's dequant branch (sym / zero-point / codebook tree)
+    are exactly what that path runs."""
+    hit = _VMAPPED_PALLAS.get(qtype)
+    if hit is not None:
+        return hit
+    ok = False
+    if _on_tpu(None) and qtype in _PALLAS_QTYPES:
+        try:
+            import numpy as _np
+
+            from bigdl_tpu.ops.pallas.dequant_matmul import q_matmul_pallas
+            from bigdl_tpu.ops.quant import quantize
+
+            one = quantize(jnp.zeros((256, 256), jnp.float32), qtype)
+            stack = jax.tree.map(lambda a: jnp.stack([a, a]), one)
+            x = jnp.zeros((2, 256), jnp.bfloat16)
+
+            def per(i, row):
+                wi = jax.tree.map(lambda a: a[i], stack)
+                return q_matmul_pallas(row[None], wi)[0]
+
+            _np.asarray(jax.jit(jax.vmap(per))(
+                jnp.asarray([0, 1], jnp.int32), x))
+            ok = True
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "vmapped pallas_call unavailable for %s (%s: %s); MoE "
+                "decode gather uses the XLA matmul", qtype,
+                type(e).__name__, e)
+    _VMAPPED_PALLAS[qtype] = ok
+    return ok
+
+
 def _zero_cotangent(leaf):
     # int-packed leaves take float0 cotangents under AD
     import numpy as _np

@@ -84,16 +84,17 @@ def _dequant_tile(codes_blk, scale, zero, kind: str, codebook, bk: int, bn: int)
     return vals.reshape(bk, bn).astype(jnp.bfloat16)
 
 
-def _accumulate(x_ref, w, out_ref, acc_ref, nk):
-    """Shared K-loop accumulate/writeback (grid axis 2 = K, innermost)."""
-    k = pl.program_id(2)
+def _accumulate(x_tile, w, out_ref, acc_ref, nk, k_axis: int = 2):
+    """Shared K-loop zero/accumulate/writeback. `k_axis` is the grid
+    dimension that sweeps K (innermost); x_tile/w are VALUES."""
+    k = pl.program_id(k_axis)
 
     @pl.when(k == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     acc_ref[:] += jax.lax.dot_general(
-        x_ref[:], w, (((1,), (0,)), ((), ())),
+        x_tile, w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
@@ -111,7 +112,7 @@ def _kernel_4bit(x_ref, data_ref, scale_ref, *rest, block, kind, codebook,
         (out_ref, acc_ref), zero = rest, None
     codes = _unpack_tile(data_ref[:], block, bk, bn)
     w = _dequant_tile(codes, scale_ref[:], zero, kind, codebook, bk, bn)
-    _accumulate(x_ref, w, out_ref, acc_ref, nk)
+    _accumulate(x_ref[:], w, out_ref, acc_ref, nk)
 
 
 def _kernel_int8(x_ref, data_ref, scale_ref, out_ref, acc_ref, *,
@@ -119,7 +120,135 @@ def _kernel_int8(x_ref, data_ref, scale_ref, out_ref, acc_ref, *,
     s = scale_ref[:].astype(jnp.float32)[:, None, :]
     vals = data_ref[:].astype(jnp.float32).reshape(bk // block, block, bn) * s
     w = vals.reshape(bk, bn).astype(jnp.bfloat16)
-    _accumulate(x_ref, w, out_ref, acc_ref, nk)
+    _accumulate(x_ref[:], w, out_ref, acc_ref, nk)
+
+
+def _gemv_kernel(x_ref, data_ref, scale_ref, *rest, block, kind, codebook,
+                 bk, bn, nk, bits):
+    """Decode-GEMV body: grid (N/bn, K/bk), K innermost. x and the FULL-K
+    scale (and zero) column block stay resident in VMEM across the K
+    sweep; only the packed data streams."""
+    if kind == "asym":
+        zero_ref, out_ref, acc_ref = rest
+    else:
+        (out_ref, acc_ref), zero_ref = rest, None
+    k = pl.program_id(1)
+    rows = bk // block
+    sl = pl.ds(k * rows, rows)
+    scale = scale_ref[sl]
+    zero = zero_ref[sl] if zero_ref is not None else None
+    if bits == 4:
+        codes = _unpack_tile(data_ref[:], block, bk, bn)
+        w = _dequant_tile(codes, scale, zero, kind, codebook, bk, bn)
+    else:
+        s = scale.astype(jnp.float32)[:, None, :]
+        vals = data_ref[:].astype(jnp.float32).reshape(rows, block, bn) * s
+        w = vals.reshape(bk, bn).astype(jnp.bfloat16)
+
+    _accumulate(x_ref[:, pl.ds(k * bk, bk)], w, out_ref, acc_ref, nk,
+                k_axis=1)
+
+
+def _gemv_tiles(qt, kp: int, n: int):
+    b = qt.block_size
+    bn = _pick_tile(n, [512, 256, 128])
+    bkc = [4096, 2048, 1024, 512, 256, 128, 64, 32]
+    bk = _pick_tile(kp, [c for c in bkc if c % b == 0])
+    if not bk or not bn:
+        return None
+    while bk * bn * 3 > 4 * 1024 * 1024 and bk > b:
+        bk //= 2
+    if bk % b != 0 or kp % bk != 0:
+        return None
+    return bk, bn
+
+
+_gemv_probe_cache: dict = {}
+
+
+def gemv_kernel_compiles(qtype: str, kp: int, n: int) -> bool:
+    """Eager per-geometry probe for the decode-GEMV variant (same
+    contract as ops/attention._kernel_compiles): compiles the REAL tile
+    classes on a stand-in sized (kp, bn) so a Mosaic rejection degrades
+    to the generic tiling instead of crashing a jitted decode."""
+    import numpy as np
+
+    qt = get_qtype(qtype)
+    tiles = _gemv_tiles(qt, kp, n)
+    if tiles is None:
+        return False
+    bk, bn = tiles
+    key = (qtype, kp, bn, bk)
+    hit = _gemv_probe_cache.get(key)
+    if hit is not None:
+        return hit
+    try:
+        from bigdl_tpu.ops.quant import quantize
+
+        wq = quantize(jnp.zeros((kp, bn), jnp.float32), qtype)
+        x = jnp.zeros((1, kp), jnp.bfloat16)
+        np.asarray(_q_gemv_pallas(x, wq, qt, 1, kp, bn, False, x.dtype))
+        ok = True
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "pallas decode-GEMV variant unavailable for (K=%d, N=%d, %s) "
+            "— %s: %s; using the generic tiles", kp, n, qtype,
+            type(e).__name__, e)
+        ok = False
+    _gemv_probe_cache[key] = ok
+    return ok
+
+
+def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
+                   interpret: bool, out_dtype=None):
+    """bs<=16 decode GEMV (the reference's `linear_fp16_esimd` decode
+    GEMV role, low_bit_linear.py:744-745). M pads to one 16-row tile; x
+    [16, K] and the scale column block are VMEM-resident for the whole K
+    sweep, the grid drops the M axis, and bn/bk maximize the streaming
+    tile. FLOP overhead of the pad is irrelevant — decode is HBM-bound."""
+    mp = 16
+    if x2.shape[0] != mp:
+        x2 = jnp.pad(x2, ((0, mp - x2.shape[0]), (0, 0)))
+    b = qt.block_size
+    tiles = _gemv_tiles(qt, kp, n)
+    if tiles is None:
+        raise NotImplementedError(f"shapes not tileable: K={kp} N={n}")
+    bk, bn = tiles
+    nk = kp // bk
+    grid = (n // bn, nk)
+
+    x_spec = pl.BlockSpec((mp, kp), lambda j, k: (0, 0))      # resident
+    scale_spec = pl.BlockSpec((kp // b, bn), lambda j, k: (0, j))
+    out_spec = pl.BlockSpec((mp, bn), lambda j, k: (0, j))
+    out_shape = jax.ShapeDtypeStruct((mp, n), out_dtype or x2.dtype)
+    scratch = [pltpu.VMEM((mp, bn), jnp.float32)]
+
+    codebook = None
+    if qt.kind == "codebook":
+        codebook = [float(v) for v in CODEBOOKS[qt.codebook]]
+    bits = qt.storage_bits
+    data_spec = pl.BlockSpec((bk // 2 if bits == 4 else bk, bn),
+                             lambda j, k: (k, j))
+    kernel = functools.partial(
+        _gemv_kernel, block=b, kind=qt.kind, codebook=codebook,
+        bk=bk, bn=bn, nk=nk, bits=bits)
+    operands = [x2, w.data, w.scale]
+    in_specs = [x_spec, data_spec, scale_spec]
+    if qt.kind == "asym":
+        operands.append(w.zero)
+        in_specs.append(scale_spec)
+    y = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*operands)
+    return y[:m]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -140,6 +269,17 @@ def q_matmul_pallas(x: jax.Array, w: QTensor, *, interpret: bool = False) -> jax
     x2 = x.reshape(m, klog).astype(jnp.bfloat16)
     if kp != klog:
         x2 = jnp.pad(x2, ((0, 0), (0, kp - klog)))
+
+    from bigdl_tpu.config import flags
+
+    if m <= 16 and flags().matmul_gemv != "off" and (
+            interpret or gemv_kernel_compiles(w.qtype, kp, n)):
+        try:
+            y = _q_gemv_pallas(x2, w, qt, m, kp, n, interpret,
+                               out_dtype=x.dtype)
+            return y.reshape(*batch_shape, n)
+        except NotImplementedError:
+            pass      # fall through to the generic tiling
 
     # tile selection; pad M up to a bf16-tileable multiple (min sublane 16)
     bm = _pick_tile(m, [256, 128, 64, 32, 16])

@@ -1,0 +1,135 @@
+"""Ragged MoE dispatch kernel vs the dense combine (interpret on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.ops.pallas.moe_dispatch import (TOKEN_TILE,
+                                               moe_mlp_ragged,
+                                               ragged_expert_matmul)
+from bigdl_tpu.ops.quant import dequantize, quantize
+
+E, D, F = 4, 256, 512
+
+
+def _rand(shape, seed=0, scale=0.1):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape,
+                             jnp.float32) * scale
+
+
+def _stack_q(seed, k, n, qtype):
+    ws = [quantize(_rand((k, n), seed=seed + i), qtype) for i in range(E)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *ws)
+
+
+@pytest.mark.parametrize("qtype", [None, "sym_int4", "asym_int4",
+                                   "sym_int8"])
+def test_ragged_matmul_selects_experts(qtype):
+    t = TOKEN_TILE
+    x = _rand((3 * t, D), seed=1, scale=0.3)
+    if qtype is None:
+        w = jnp.stack([_rand((D, F), seed=5 + i) for i in range(E)])
+        dense = np.asarray(w, np.float32)
+    else:
+        w = _stack_q(5, D, F, qtype)
+        dense = np.stack([
+            np.asarray(dequantize(jax.tree.map(lambda a: a[i], w)),
+                       np.float32) for i in range(E)])
+    tile_e = jnp.asarray([2, 0, 3], jnp.int32)
+    got = ragged_expert_matmul(x, w, tile_e, interpret=True)
+    xs = np.asarray(x, np.float32)
+    want = np.concatenate([
+        xs[i * t:(i + 1) * t] @ dense[int(tile_e[i])] for i in range(3)])
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               rtol=3e-2, atol=3e-2)
+
+
+def _naive_moe(xf, topi, topw, gate, up, down, act):
+    n = xf.shape[0]
+    out = np.zeros_like(np.asarray(xf, np.float32))
+    xs = np.asarray(xf, np.float32)
+    for i in range(n):
+        for j in range(topi.shape[1]):
+            e = int(topi[i, j])
+            g = np.asarray(dequantize(jax.tree.map(lambda a: a[e], gate)),
+                           np.float32) if gate is not None else None
+            u = np.asarray(dequantize(jax.tree.map(lambda a: a[e], up)),
+                           np.float32)
+            d_ = np.asarray(dequantize(jax.tree.map(lambda a: a[e], down)),
+                            np.float32)
+            h = act(xs[i] @ u) if g is None else \
+                act(xs[i] @ g) * (xs[i] @ u)
+            out[i] += float(topw[i, j]) * (h @ d_)
+    return out
+
+
+def test_moe_mlp_ragged_matches_naive():
+    n, k = 96, 2
+    rng = np.random.default_rng(0)
+    xf = jnp.asarray(rng.standard_normal((n, D)).astype(np.float32) * 0.3)
+    topi = jnp.asarray(rng.integers(0, E, size=(n, k)), jnp.int32)
+    topw = jax.nn.softmax(jnp.asarray(
+        rng.standard_normal((n, k)).astype(np.float32)), axis=-1)
+    gate = _stack_q(11, D, F, "sym_int4")
+    up = _stack_q(31, D, F, "sym_int4")
+    down = _stack_q(51, F, D, "sym_int4")
+
+    got = moe_mlp_ragged(xf, topi, topw, gate, up, down, jax.nn.silu,
+                         E, interpret=True)
+    want = _naive_moe(xf, topi, topw, gate, up, down,
+                      lambda a: np.asarray(jax.nn.silu(a)))
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_moe_mlp_skewed_routing():
+    """All tokens on one expert: one region holds everything, the other
+    regions are pure padding tiles."""
+    n, k = 40, 2
+    xf = _rand((n, D), seed=3, scale=0.2)
+    topi = jnp.full((n, k), 1, jnp.int32)
+    topw = jnp.full((n, k), 0.5, jnp.float32)
+    up = _stack_q(7, D, F, "sym_int4")
+    down = _stack_q(9, F, D, "sym_int4")
+    got = moe_mlp_ragged(xf, topi, topw, None, up, down, jax.nn.gelu,
+                         E, interpret=True)
+    want = _naive_moe(xf, topi, topw, None, up, down,
+                      lambda a: np.asarray(jax.nn.gelu(a)))
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_mixtral_ragged_equals_dense():
+    from bigdl_tpu.config import set_flags
+    from bigdl_tpu.models import llama as M
+
+    cfg = M.LlamaConfig(
+        vocab_size=64, hidden_size=D, intermediate_size=F,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+        num_local_experts=E, num_experts_per_tok=2)
+    rng = np.random.default_rng(5)
+    lp = {
+        "router": jnp.asarray(
+            rng.standard_normal((D, E)).astype(np.float32) * 0.1),
+        "experts_gate": _stack_q(61, D, F, "sym_int4"),
+        "experts_up": _stack_q(71, D, F, "sym_int4"),
+        "experts_down": _stack_q(81, F, D, "sym_int4"),
+    }
+    hidden = jnp.asarray(
+        rng.standard_normal((2, 48, D)).astype(np.float32) * 0.2)
+
+    try:
+        set_flags(moe_dispatch="ragged")
+        jax.clear_caches()
+        got = M._moe_mlp(hidden, lp, cfg)
+        set_flags(moe_dispatch="dense")
+        jax.clear_caches()
+        want = M._moe_mlp(hidden, lp, cfg)
+    finally:
+        set_flags(moe_dispatch="auto")
+        jax.clear_caches()
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=5e-2, atol=5e-2)

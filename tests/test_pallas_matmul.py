@@ -60,3 +60,41 @@ def test_pallas_large_k_tiling():
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         rtol=3e-2, atol=3e-2,
     )
+
+
+@pytest.mark.parametrize(
+    "qtype", ["sym_int4", "asym_int4", "nf4", "sym_int8"])
+def test_gemv_variant_matches_generic(qtype):
+    """The decode-GEMV specialization (m<=16) must match the generic
+    tiling bit-for-bit-close across qtypes and multi-tile K."""
+    from bigdl_tpu.config import set_flags
+
+    k, n = 1024, 256
+    x = _rand((1, k), seed=7) * 0.3
+    qt = quantize(_rand((k, n), seed=8) * 0.1, qtype)
+    try:
+        got = q_matmul_pallas(x, qt, interpret=True)       # gemv (auto)
+        set_flags(matmul_gemv="off")
+        jax.clear_caches()       # flags are read at trace time
+        want = q_matmul_pallas(x, qt, interpret=True)      # generic tiles
+    finally:
+        set_flags(matmul_gemv="auto")
+        jax.clear_caches()
+    # different tile sweeps accumulate bf16 products in different orders
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_gemv_padded_k():
+    """K not a block multiple: the padded tail must not disturb GEMV."""
+    k, n = 200, 128           # pads to 224 (block 32)
+    x = _rand((2, k), seed=9) * 0.2
+    qt = quantize(_rand((k, n), seed=10) * 0.1, "sym_int4")
+    got = q_matmul_pallas(x, qt, interpret=True)
+    want = _q_matmul_xla(x, qt)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
