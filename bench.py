@@ -6,6 +6,11 @@ latency + mean next-token latency, 1024-128-style run). Weights are random
 timed as a jitted K-step lax.scan so tunnel/host overhead never pollutes the
 per-token number.
 
+On TPU the run A/Bs the kernel dispatch configurations (Pallas decode
+GEMV / generic Pallas tiles / XLA matmul x Pallas / XLA attention — the
+on-chip A/B VERDICT r1 asked for) and reports the BEST as the headline,
+with every configuration's numbers in the JSON extras.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 `vs_baseline` is speedup vs 30 ms/token, our documented stand-in for the
 reference's Intel Max 1550 Llama2-7B INT4 decode latency (the reference
@@ -42,6 +47,20 @@ PROMPT_LEN = 1024
 DECODE_STEPS = 64
 MAX_SEQ = 2048
 
+# (label, flag overrides) — the dispatch configurations to A/B on TPU
+AB_CONFIGS = [
+    ("pallas+gemv", dict(matmul_backend="auto", attention_backend="auto",
+                         matmul_gemv="auto")),
+    ("pallas", dict(matmul_backend="auto", attention_backend="auto",
+                    matmul_gemv="off")),
+    ("xla-matmul", dict(matmul_backend="xla", attention_backend="auto",
+                        matmul_gemv="off")),
+    ("xla-attn", dict(matmul_backend="auto", attention_backend="xla",
+                      matmul_gemv="auto")),
+    ("xla", dict(matmul_backend="xla", attention_backend="xla",
+                 matmul_gemv="off")),
+]
+
 
 def main() -> None:
     # probe BEFORE importing jax here: a wedged TPU tunnel would hang this
@@ -58,6 +77,7 @@ def main() -> None:
     import jax.numpy as jnp
     from jax import lax
 
+    from bigdl_tpu.config import set_flags
     from bigdl_tpu.models import llama as llama_mod
     from bigdl_tpu.utils.testing import (LLAMA2_7B, TINY_LLAMA,
                                          random_llama_params)
@@ -70,43 +90,72 @@ def main() -> None:
 
     params = random_llama_params(cfg, qtype="sym_int4")
     jax.block_until_ready(params)
-
-    prefill = jax.jit(llama_mod.forward_last_token, static_argnums=1,
-                      donate_argnums=3)
-
-    @functools.partial(jax.jit, donate_argnums=(2,))
-    def decode_steps(params, tok, cache):
-        def step(carry, _):
-            tok, cache = carry
-            logits, cache = llama_mod.forward(params, cfg, tok[:, None], cache)
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-            return (nxt, cache), None
-        (tok, cache), _ = lax.scan(step, (tok, cache), None, length=steps)
-        return tok, cache
-
     tokens = jnp.ones((1, prompt_len), jnp.int32)
 
-    def run():
-        cache = llama_mod.new_cache(cfg, 1, max_seq)
-        t0 = time.perf_counter()
-        logits, cache = prefill(params, cfg, tokens, cache)
-        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-        jax.block_until_ready(tok)
-        first_ms = (time.perf_counter() - t0) * 1e3
-        t1 = time.perf_counter()
-        tok, cache = decode_steps(params, tok, cache)
-        jax.block_until_ready(tok)
-        next_ms = (time.perf_counter() - t1) * 1e3 / steps
-        return first_ms, next_ms
+    def bench_config() -> tuple:
+        """(first_ms, next_ms) best-of-N under the CURRENT flags."""
+        prefill = jax.jit(llama_mod.forward_last_token, static_argnums=1,
+                          donate_argnums=3)
 
-    run()  # warmup: compile prefill + decode
-    firsts, nexts = [], []
-    for _ in range(3):
-        f, n = run()
-        firsts.append(f)
-        nexts.append(n)
-    first_ms = min(firsts)
-    next_ms = min(nexts)
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def decode_steps(params, tok, cache):
+            def step(carry, _):
+                tok, cache = carry
+                logits, cache = llama_mod.forward(params, cfg,
+                                                  tok[:, None], cache)
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(
+                    jnp.int32)
+                return (nxt, cache), None
+            (tok, cache), _ = lax.scan(step, (tok, cache), None,
+                                       length=steps)
+            return tok, cache
+
+        def run():
+            cache = llama_mod.new_cache(cfg, 1, max_seq)
+            t0 = time.perf_counter()
+            logits, cache = prefill(params, cfg, tokens, cache)
+            tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            jax.block_until_ready(tok)
+            first_ms = (time.perf_counter() - t0) * 1e3
+            t1 = time.perf_counter()
+            tok, cache = decode_steps(params, tok, cache)
+            jax.block_until_ready(tok)
+            next_ms = (time.perf_counter() - t1) * 1e3 / steps
+            return first_ms, next_ms
+
+        run()  # warmup: compile prefill + decode
+        firsts, nexts = [], []
+        for _ in range(3):
+            f, n = run()
+            firsts.append(f)
+            nexts.append(n)
+        return min(firsts), min(nexts)
+
+    ab_results = {}
+    if on_tpu:
+        for label, overrides in AB_CONFIGS:
+            try:
+                set_flags(**overrides)
+                jax.clear_caches()
+                f_ms, n_ms = bench_config()
+                ab_results[label] = {"first_token_ms": round(f_ms, 3),
+                                     "next_token_ms": round(n_ms, 3)}
+                print(f"bench[{label}]: first {f_ms:.1f}ms "
+                      f"next {n_ms:.2f}ms", file=sys.stderr)
+            except Exception as e:
+                ab_results[label] = {"error": f"{type(e).__name__}: {e}"}
+                print(f"bench[{label}]: FAILED {e}", file=sys.stderr)
+        set_flags(matmul_backend="auto", attention_backend="auto",
+                  matmul_gemv="auto")
+        ok = {k: v for k, v in ab_results.items() if "next_token_ms" in v}
+        if not ok:
+            raise SystemExit("bench: every dispatch configuration failed")
+        best = min(ok, key=lambda k: ok[k]["next_token_ms"])
+        first_ms = ok[best]["first_token_ms"]
+        next_ms = ok[best]["next_token_ms"]
+    else:
+        best = "cpu-fallback"
+        first_ms, next_ms = bench_config()
 
     print(json.dumps({
         "metric": "llama2_7b_int4_next_token_latency",
@@ -122,6 +171,8 @@ def main() -> None:
         "backend": jax.default_backend(),
         "model": "llama2-7b" if on_tpu else "tiny-llama(cpu-fallback)",
         "qtype": "sym_int4",
+        "best_config": best,
+        "ab": ab_results,
     }))
 
 

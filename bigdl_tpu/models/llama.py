@@ -279,13 +279,16 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
     if n * cfg.num_experts_per_tok <= cfg.num_local_experts:
         from bigdl_tpu.ops.matmul import vmapped_pallas_ok
 
-        # fused kernels under vmap are gated by a one-time eager probe
-        # PER QTYPE (compile failures degrade to the XLA matmul, never
-        # crash a jit); dense expert stacks never hit pallas
+        # fused kernels under vmap are gated by eager probes at BOTH
+        # expert geometries — up/gate [D,F] and down [F,D] — (compile
+        # failures degrade to the XLA matmul, never crash a jit); dense
+        # expert stacks never hit pallas
         gq = (lp["experts_up"].qtype
               if hasattr(lp["experts_up"], "qtype") else None)
-        gather_backend = (None if gq is not None and vmapped_pallas_ok(gq)
-                          else "xla")
+        ff = cfg.intermediate_size
+        gather_backend = (
+            None if gq is not None and vmapped_pallas_ok(gq, d, ff)
+            and vmapped_pallas_ok(gq, ff, d) else "xla")
 
         def per_token(x_row, idxs, wts):
             def per_choice(i):
@@ -321,10 +324,13 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
         qtype = (lp["experts_up"].qtype
                  if hasattr(lp["experts_up"], "qtype") else None)
         forced = flags().moe_dispatch == "ragged"
-        # forced mode bypasses the probe so compile errors SURFACE
-        # (A/B runs must never silently measure the dense path)
-        if interp or forced or ragged_kernel_compiles(
-                qtype, d, cfg.intermediate_size):
+        # forced mode bypasses the probes so compile errors SURFACE
+        # (A/B runs must never silently measure the dense path); auto
+        # probes BOTH geometries — gate/up [D,F] and down [F,D]
+        if interp or forced or (
+                ragged_kernel_compiles(qtype, d, cfg.intermediate_size)
+                and ragged_kernel_compiles(qtype, cfg.intermediate_size,
+                                           d)):
             y = moe_mlp_ragged(
                 xf, topi, w,
                 lp["experts_gate"] if gated else None,

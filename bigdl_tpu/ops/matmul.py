@@ -81,14 +81,16 @@ def _q_matmul_dispatch(x: jax.Array, w: QTensor, be: str) -> jax.Array:
 _VMAPPED_PALLAS: dict = {}
 
 
-def vmapped_pallas_ok(qtype: str = "sym_int4") -> bool:
-    """Eager probe PER QTYPE: does a vmapped, dynamically-indexed
-    q_matmul_pallas compile on this backend for this format? Gates the
-    MoE decode gather path's use of the fused kernel (models/llama.py
-    `_moe_mlp`): pallas_call's batching rule, dynamic expert indexing,
-    and the qtype's dequant branch (sym / zero-point / codebook tree)
-    are exactly what that path runs."""
-    hit = _VMAPPED_PALLAS.get(qtype)
+def vmapped_pallas_ok(qtype: str, k: int = 256, n: int = 256) -> bool:
+    """Eager probe PER (qtype, K, N): does a vmapped, dynamically-indexed
+    q_matmul_pallas compile on this backend for this format at this
+    geometry? Gates the MoE decode gather path's use of the fused kernel
+    (models/llama.py `_moe_mlp`): pallas_call's batching rule, dynamic
+    expert indexing, the qtype's dequant branch, and the REAL tile
+    classes are exactly what that path runs (Mosaic rejections are
+    geometry-dependent, so a stand-in geometry would under-probe)."""
+    key = (qtype, k, n)
+    hit = _VMAPPED_PALLAS.get(key)
     if hit is not None:
         return hit
     ok = False
@@ -99,9 +101,9 @@ def vmapped_pallas_ok(qtype: str = "sym_int4") -> bool:
             from bigdl_tpu.ops.pallas.dequant_matmul import q_matmul_pallas
             from bigdl_tpu.ops.quant import quantize
 
-            one = quantize(jnp.zeros((256, 256), jnp.float32), qtype)
+            one = quantize(jnp.zeros((k, n), jnp.float32), qtype)
             stack = jax.tree.map(lambda a: jnp.stack([a, a]), one)
-            x = jnp.zeros((2, 256), jnp.bfloat16)
+            x = jnp.zeros((2, k), jnp.bfloat16)
 
             def per(i, row):
                 wi = jax.tree.map(lambda a: a[i], stack)
@@ -114,10 +116,10 @@ def vmapped_pallas_ok(qtype: str = "sym_int4") -> bool:
             import logging
 
             logging.getLogger(__name__).warning(
-                "vmapped pallas_call unavailable for %s (%s: %s); MoE "
-                "decode gather uses the XLA matmul", qtype,
-                type(e).__name__, e)
-    _VMAPPED_PALLAS[qtype] = ok
+                "vmapped pallas_call unavailable for %s at (K=%d, N=%d) "
+                "(%s: %s); MoE decode gather uses the XLA matmul", qtype,
+                k, n, type(e).__name__, e)
+    _VMAPPED_PALLAS[key] = ok
     return ok
 
 

@@ -42,6 +42,27 @@ from bigdl_tpu.ops.pallas.dequant_matmul import (_accumulate, _dequant_tile,
 TOKEN_TILE = 128
 
 
+def _ragged_tiles(qtype, kp: int, n: int):
+    """Tile classes the kernel would pick; None when untileable."""
+    b = 1
+    if qtype is not None:
+        qt = get_qtype(qtype)
+        b = qt.block_size
+        kp = -(-kp // b) * b
+    bkc = [2048, 1024, 512, 256, 128, 64, 32]
+    bk = _pick_tile(kp, [c for c in bkc if c % b == 0])
+    bn = _pick_tile(n, [512, 256, 128])
+    if not bk or not bn:
+        return None
+    while bk * bn * 3 > 4 * 1024 * 1024 and bk > max(b, 32):
+        bk //= 2
+    if kp % bk or (qtype is not None and bk % b):
+        return None
+    return bk, bn
+
+
+
+
 def _ragged_kernel_q(e_ref, x_ref, data_ref, scale_ref, *rest, block,
                      kind, codebook, bk, bn, nk, bits):
     if kind == "asym":
@@ -94,15 +115,10 @@ def ragged_expert_matmul(x: jax.Array,          # [Np, K] (tile-padded)
     if kp != klog:
         x2 = jnp.pad(x2, ((0, 0), (0, kp - klog)))
 
-    bkc = [2048, 1024, 512, 256, 128, 64, 32]
-    bk = _pick_tile(kp, [c for c in bkc if c % b == 0])
-    bn = _pick_tile(n, [512, 256, 128])
-    if not bk or not bn:
+    tiles = _ragged_tiles(w.qtype if quantized else None, kp, n)
+    if tiles is None:
         raise NotImplementedError(f"shapes not tileable: K={kp} N={n}")
-    while bk * bn * 3 > 4 * 1024 * 1024 and bk > max(b, 32):
-        bk //= 2
-    if kp % bk or (quantized and bk % b):
-        raise NotImplementedError(f"K tiling failed: K={kp}")
+    bk, bn = tiles
     nk = kp // bk
     grid = (np_ // t, n // bn, nk)
 
@@ -149,25 +165,6 @@ def ragged_expert_matmul(x: jax.Array,          # [Np, K] (tile-padded)
 
 
 _probe_cache: dict = {}
-
-
-def _ragged_tiles(qtype, kp: int, n: int):
-    """Tile classes the kernel would pick; None when untileable."""
-    b = 1
-    if qtype is not None:
-        qt = get_qtype(qtype)
-        b = qt.block_size
-        kp = -(-kp // b) * b
-    bkc = [2048, 1024, 512, 256, 128, 64, 32]
-    bk = _pick_tile(kp, [c for c in bkc if c % b == 0])
-    bn = _pick_tile(n, [512, 256, 128])
-    if not bk or not bn:
-        return None
-    while bk * bn * 3 > 4 * 1024 * 1024 and bk > max(b, 32):
-        bk //= 2
-    if kp % bk or (qtype is not None and bk % b):
-        return None
-    return bk, bn
 
 
 def ragged_kernel_compiles(qtype: Optional[str], k: int, n: int) -> bool:
