@@ -133,6 +133,11 @@ def main() -> None:
 
     ab_results = {}
     if on_tpu:
+        import dataclasses
+
+        from bigdl_tpu.config import flags
+
+        ambient = dataclasses.asdict(flags())   # restore after the loop
         for label, overrides in AB_CONFIGS:
             try:
                 set_flags(**overrides)
@@ -145,8 +150,7 @@ def main() -> None:
             except Exception as e:
                 ab_results[label] = {"error": f"{type(e).__name__}: {e}"}
                 print(f"bench[{label}]: FAILED {e}", file=sys.stderr)
-        set_flags(matmul_backend="auto", attention_backend="auto",
-                  matmul_gemv="auto")
+        set_flags(**ambient)       # keep user env flags authoritative
         ok = {k: v for k, v in ab_results.items() if "next_token_ms" in v}
         if not ok:
             raise SystemExit("bench: every dispatch configuration failed")

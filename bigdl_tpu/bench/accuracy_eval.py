@@ -241,10 +241,15 @@ def main(argv=None):
           f"{held.size} heldout)")
 
     cfg = model_config()
+    steps = args.steps
     if args.ckpt_dir and os.path.exists(
             os.path.join(args.ckpt_dir, "model.safetensors")):
         ckpt = args.ckpt_dir
+        meta_p = os.path.join(ckpt, "train_meta.json")
         loss = float("nan")
+        if os.path.exists(meta_p):
+            m = json.load(open(meta_p))
+            loss, steps = m.get("loss", loss), m.get("steps", steps)
         print(f"reusing checkpoint {ckpt}")
     else:
         print(f"training {args.steps} steps ...")
@@ -252,6 +257,8 @@ def main(argv=None):
                              args.seq)
         ckpt = args.ckpt_dir or tempfile.mkdtemp(prefix="acc_eval_")
         export_hf(params, cfg, ckpt)
+        json.dump({"loss": loss, "steps": args.steps},
+                  open(os.path.join(ckpt, "train_meta.json"), "w"))
         print(f"exported checkpoint to {ckpt}")
 
     # imatrix from a slice of TRAIN data (calibration must not touch
@@ -272,7 +279,7 @@ def main(argv=None):
 
     n_params = sum(int(np.prod(p.shape)) for p in
                    jax.tree.leaves(m_f.params) if hasattr(p, "shape"))
-    meta = dict(steps=args.steps, loss=loss,
+    meta = dict(steps=steps, loss=loss,
                 params=f"{n_params / 1e6:.1f}M", train_tokens=split,
                 window=256, stride=128, max_windows=args.max_windows,
                 heldout=held.size)

@@ -279,16 +279,21 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
     if n * cfg.num_experts_per_tok <= cfg.num_local_experts:
         from bigdl_tpu.ops.matmul import vmapped_pallas_ok
 
-        # fused kernels under vmap are gated by eager probes at BOTH
-        # expert geometries — up/gate [D,F] and down [F,D] — (compile
+        # fused kernels under vmap are gated by eager probes covering
+        # EVERY (qtype, geometry) the gather actually runs — mixed_*
+        # policies can land different qtypes per projection — (compile
         # failures degrade to the XLA matmul, never crash a jit); dense
         # expert stacks never hit pallas
-        gq = (lp["experts_up"].qtype
-              if hasattr(lp["experts_up"], "qtype") else None)
         ff = cfg.intermediate_size
+        probes = []
+        for key, kk, nn in (("experts_gate", d, ff), ("experts_up", d, ff),
+                            ("experts_down", ff, d)):
+            leaf = lp.get(key)
+            if leaf is not None and hasattr(leaf, "qtype"):
+                probes.append((leaf.qtype, kk, nn))
         gather_backend = (
-            None if gq is not None and vmapped_pallas_ok(gq, d, ff)
-            and vmapped_pallas_ok(gq, ff, d) else "xla")
+            None if probes and all(vmapped_pallas_ok(*p) for p in probes)
+            else "xla")
 
         def per_token(x_row, idxs, wts):
             def per_choice(i):
@@ -321,16 +326,20 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
             moe_mlp_ragged, ragged_kernel_compiles)
 
         interp = jax.default_backend() != "tpu"
-        qtype = (lp["experts_up"].qtype
-                 if hasattr(lp["experts_up"], "qtype") else None)
         forced = flags().moe_dispatch == "ragged"
         # forced mode bypasses the probes so compile errors SURFACE
         # (A/B runs must never silently measure the dense path); auto
-        # probes BOTH geometries — gate/up [D,F] and down [F,D]
-        if interp or forced or (
-                ragged_kernel_compiles(qtype, d, cfg.intermediate_size)
-                and ragged_kernel_compiles(qtype, cfg.intermediate_size,
-                                           d)):
+        # probes every (qtype, geometry) pair the dispatch runs
+        ff = cfg.intermediate_size
+        pairs = []
+        for key, kk, nn in (("experts_gate", d, ff), ("experts_up", d, ff),
+                            ("experts_down", ff, d)):
+            leaf = lp.get(key)
+            if leaf is not None:
+                pairs.append((leaf.qtype if hasattr(leaf, "qtype")
+                              else None, kk, nn))
+        if interp or forced or all(
+                ragged_kernel_compiles(*p) for p in pairs):
             y = moe_mlp_ragged(
                 xf, topi, w,
                 lp["experts_gate"] if gated else None,

@@ -82,43 +82,50 @@ _VMAPPED_PALLAS: dict = {}
 
 
 def vmapped_pallas_ok(qtype: str, k: int = 256, n: int = 256) -> bool:
-    """Eager probe PER (qtype, K, N): does a vmapped, dynamically-indexed
-    q_matmul_pallas compile on this backend for this format at this
-    geometry? Gates the MoE decode gather path's use of the fused kernel
-    (models/llama.py `_moe_mlp`): pallas_call's batching rule, dynamic
-    expert indexing, the qtype's dequant branch, and the REAL tile
-    classes are exactly what that path runs (Mosaic rejections are
-    geometry-dependent, so a stand-in geometry would under-probe)."""
+    """Eager probe PER (qtype, K, N-tile): does a vmapped, dynamically-
+    indexed q_matmul_pallas compile on this backend for this format at
+    this geometry? Gates the MoE decode gather path's use of the fused
+    kernel (models/llama.py `_moe_mlp`): pallas_call's batching rule,
+    dynamic expert indexing, the qtype's dequant branch, and the REAL
+    tile classes are what that path runs (Mosaic rejections are
+    geometry-dependent). The stand-in keeps the full K (the GEMV x/scale
+    residency depends on it) but only ONE N tile — probing the full
+    [K, N] would allocate hundreds of MB next to a resident model."""
+    if not (_on_tpu(None) and qtype in _PALLAS_QTYPES):
+        return False
+    from bigdl_tpu.ops.pallas.dequant_matmul import (_gemv_tiles,
+                                                     q_matmul_pallas)
+    from bigdl_tpu.ops.quant import get_qtype, quantize
+
+    tiles = _gemv_tiles(get_qtype(qtype), k, n)
+    if tiles is not None:
+        n = tiles[1]
     key = (qtype, k, n)
     hit = _VMAPPED_PALLAS.get(key)
     if hit is not None:
         return hit
-    ok = False
-    if _on_tpu(None) and qtype in _PALLAS_QTYPES:
-        try:
-            import numpy as _np
+    try:
+        import numpy as _np
 
-            from bigdl_tpu.ops.pallas.dequant_matmul import q_matmul_pallas
-            from bigdl_tpu.ops.quant import quantize
+        one = quantize(jnp.zeros((k, n), jnp.float32), qtype)
+        stack = jax.tree.map(lambda a: jnp.stack([a, a]), one)
+        x = jnp.zeros((2, k), jnp.bfloat16)
 
-            one = quantize(jnp.zeros((k, n), jnp.float32), qtype)
-            stack = jax.tree.map(lambda a: jnp.stack([a, a]), one)
-            x = jnp.zeros((2, k), jnp.bfloat16)
+        def per(i, row):
+            wi = jax.tree.map(lambda a: a[i], stack)
+            return q_matmul_pallas(row[None], wi)[0]
 
-            def per(i, row):
-                wi = jax.tree.map(lambda a: a[i], stack)
-                return q_matmul_pallas(row[None], wi)[0]
+        _np.asarray(jax.jit(jax.vmap(per))(
+            jnp.asarray([0, 1], jnp.int32), x))
+        ok = True
+    except Exception as e:
+        import logging
 
-            _np.asarray(jax.jit(jax.vmap(per))(
-                jnp.asarray([0, 1], jnp.int32), x))
-            ok = True
-        except Exception as e:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "vmapped pallas_call unavailable for %s at (K=%d, N=%d) "
-                "(%s: %s); MoE decode gather uses the XLA matmul", qtype,
-                k, n, type(e).__name__, e)
+        logging.getLogger(__name__).warning(
+            "vmapped pallas_call unavailable for %s at (K=%d, N=%d) "
+            "(%s: %s); MoE decode gather uses the XLA matmul", qtype,
+            k, n, type(e).__name__, e)
+        ok = False
     _VMAPPED_PALLAS[key] = ok
     return ok
 
