@@ -47,7 +47,11 @@ def main() -> None:
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = LLAMA2_7B if on_tpu else TINY_LLAMA
-    batch, seq = (2, 512) if on_tpu else (1, 64)
+    # TPU config mirrors the reference alpaca-qlora recipe behind the
+    # 21-min number (qlora_finetune_llama2_7b_pvc_1550_4_card.sh:
+    # micro_batch_size 8; alpaca_qlora_finetuning.py: cutoff_len 256)
+    # so the projection below compares like-for-like
+    batch, seq = (8, 256) if on_tpu else (1, 64)
 
     params = random_llama_params(cfg, qtype="sym_int4")
     params = attach_lora(params, LoraConfig(r=16, training_mode="qlora"))
@@ -73,7 +77,7 @@ def main() -> None:
     per_step_ms = (time.perf_counter() - t0) / steps * 1e3
 
     tokens_per_s = batch * seq / (per_step_ms / 1e3)
-    print(json.dumps({
+    out = {
         "metric": "llama2_7b_qlora_step_time",
         "value": round(per_step_ms, 2),
         "unit": "ms",
@@ -84,7 +88,20 @@ def main() -> None:
         "backend": jax.default_backend(),
         "model": "llama2-7b" if on_tpu else "tiny-llama(cpu-fallback)",
         "loss": float(loss),
-    }))
+    }
+    if on_tpu:
+        # BASELINE.md target: Alpaca QLoRA in < 21 min on 8 chips.
+        # Sample count and epochs come from the reference recipe the
+        # number was published for (alpaca_qlora_finetuning.py:
+        # num_epochs=3 default over the 52,002-sample Stanford-Alpaca
+        # set). Projection: this chip's recipe-config step time on a
+        # dp=8 mesh (per-chip batch unchanged; adapter-only optimizer
+        # state makes dp near-linear).
+        steps_total = -(-(52002 * 3) // (batch * 8))
+        out["projected_alpaca_3ep_minutes_8chip"] = round(
+            steps_total * per_step_ms / 1e3 / 60, 1)
+        out["alpaca_target_minutes"] = 21.0
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
