@@ -555,6 +555,40 @@ def forward_last_token(
                    last_only=True, visual=visual)
 
 
+def ext_attn_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn):
+    """One transformer block with an EXTERNAL attention function —
+    THE shared layer body of every parallel attention scheme
+    (forward_train's ring-attention branch, parallel/cp.py's context-
+    parallel prefill/decode). attn_fn(q, k, v) -> attention output;
+    returns (x_out, (k, v)) so callers that keep a KV cache can collect
+    the projections. Families outside the standard residual path are
+    rejected by the callers' guards."""
+    b, s, _ = x.shape
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    hidden = _norm(x, lp["input_layernorm"],
+                   lp.get("input_layernorm_bias"), cfg)
+    q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias")).reshape(
+        b, s, h, hd)
+    k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias")).reshape(
+        b, s, hkv, hd)
+    v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias")).reshape(
+        b, s, hkv, hd)
+    if cfg.use_rope:
+        q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
+        k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
+    attn_out = linear(attn_fn(q, k, v).reshape(b, s, h * hd),
+                      lp["o_proj"], lp.get("o_proj_bias"))
+    if cfg.parallel_residual:
+        mlp_in = hidden if cfg.shared_input_norm else _norm(
+            x, lp["post_attention_layernorm"],
+            lp.get("post_attention_layernorm_bias"), cfg)
+        return x + attn_out + _mlp(mlp_in, lp, cfg), (k, v)
+    x2 = x + attn_out
+    hidden2 = _norm(x2, lp["post_attention_layernorm"],
+                    lp.get("post_attention_layernorm_bias"), cfg)
+    return x2 + _mlp(hidden2, lp, cfg), (k, v)
+
+
 def forward_train(
     params: Dict[str, Any],
     cfg: LlamaConfig,
@@ -599,28 +633,8 @@ def forward_train(
 
         @jax.checkpoint
         def layer(x, lp):
-            hidden = _norm(x, lp["input_layernorm"],
-                           lp.get("input_layernorm_bias"), cfg)
-            q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias"))
-            k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias"))
-            v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias"))
-            q = q.reshape(b, s, h, hd)
-            k = k.reshape(b, s, hkv, hd)
-            v = v.reshape(b, s, hkv, hd)
-            if cfg.use_rope:
-                q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
-                k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
-            attn_out = linear(ext_attn(q, k, v).reshape(b, s, h * hd),
-                              lp["o_proj"], lp.get("o_proj_bias"))
-            if cfg.parallel_residual:
-                mlp_in = hidden if cfg.shared_input_norm else _norm(
-                    x, lp["post_attention_layernorm"],
-                    lp.get("post_attention_layernorm_bias"), cfg)
-                return x + attn_out + _mlp(mlp_in, lp, cfg)
-            x2 = x + attn_out
-            hidden2 = _norm(x2, lp["post_attention_layernorm"],
-                            lp.get("post_attention_layernorm_bias"), cfg)
-            return x2 + _mlp(hidden2, lp, cfg)
+            out, _ = ext_attn_layer(x, lp, cfg, cos, sin, ext_attn)
+            return out
     else:
         @jax.checkpoint
         def layer(x, lp, lidx):

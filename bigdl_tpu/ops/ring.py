@@ -48,23 +48,37 @@ def ring_attention(
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Exact causal attention with K/V rotating around `axis_name`.
 
-    Sequence is laid out contiguously across the axis: device i holds
-    global positions [i*Sq_loc, (i+1)*Sq_loc). Returns [B, Sq_loc, H, D].
+    Sequence layouts across the axis (n devices, chunk length C):
+    - "contiguous": device i holds global positions [i*C, (i+1)*C) —
+      the training sp layout.
+    - "cyclic": device i holds positions i, i+n, i+2n, ... — the
+      context-parallel INFERENCE layout (parallel/cp.py), where decode
+      tokens keep landing on rotating owners so the sharded KV cache
+      stays balanced at any prompt length.
+    Returns [B, Sq_loc, H, D].
     """
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     g = h // hkv
     if scale is None:
         scale = d ** -0.5
+    if layout not in ("contiguous", "cyclic"):
+        raise ValueError(f"unknown ring layout {layout!r}")
 
     p = lax.axis_index(axis_name)
     n = lax.psum(1, axis_name)
 
+    def global_ids(dev, length):
+        if layout == "contiguous":
+            return dev * length + jnp.arange(length, dtype=jnp.int32)
+        return dev + jnp.arange(length, dtype=jnp.int32) * n
+
     qf = q.reshape(b, sq, hkv, g, d).astype(jnp.bfloat16)
-    q_ids = p * sq + jnp.arange(sq, dtype=jnp.int32)        # global q pos
+    q_ids = global_ids(p, sq)                               # global q pos
 
     o0 = jnp.zeros((b, hkv, g, sq, d), jnp.float32)
     m0 = jnp.full((b, hkv, g, sq), -jnp.inf, jnp.float32)
@@ -79,7 +93,7 @@ def ring_attention(
     def body(i, carry):
         o, m, l, k_cur, v_cur = carry
         src = (p - i) % n                                   # chunk we hold
-        k_ids = src * sk + jnp.arange(sk, dtype=jnp.int32)
+        k_ids = global_ids(src, sk)
         s = _chunk_scores(qf, k_cur.astype(jnp.bfloat16), scale,
                           logits_soft_cap)                  # [B,Hkv,G,Sq,Sk]
         mask = k_ids[None, :] <= q_ids[:, None]             # [Sq, Sk]

@@ -1,0 +1,105 @@
+"""Context-parallel inference: sharded prefill+decode == single device.
+
+Runs on the 8-device virtual CPU mesh (conftest). The invariant mirrors
+the serving tests: parallelism must never change the decoded text.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from bigdl_tpu.generation import generate_on_device
+from bigdl_tpu.models import llama as llama_mod
+from bigdl_tpu.models.llama import LlamaConfig
+from bigdl_tpu.parallel.cp import cp_decode_step, cp_generate, cp_prefill
+from bigdl_tpu.utils.testing import random_llama_params
+
+GQA_CFG = LlamaConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
+    max_position_embeddings=512)
+
+
+def mesh(n=4):
+    return Mesh(np.array(jax.devices()[:n]), ("sp",))
+
+
+def plain_greedy(params, cfg, prompt, n_new, max_seq=256):
+    cache = llama_mod.new_cache(cfg, prompt.shape[0], max_seq)
+    out, _ = generate_on_device(
+        params, cfg, llama_mod.forward, jnp.asarray(prompt), cache,
+        max_new_tokens=n_new)
+    return np.asarray(out)
+
+
+@pytest.mark.parametrize("qtype", [None, "sym_int4"])
+def test_cp_generate_matches_single_device(qtype):
+    cfg = GQA_CFG
+    params = random_llama_params(cfg, qtype=qtype, seed=0)
+    prompt = (np.arange(1, 33, dtype=np.int32)[None] % cfg.vocab_size)
+
+    want = plain_greedy(params, cfg, prompt, 10)
+    got = cp_generate(params, cfg, prompt, mesh(4), max_new_tokens=10,
+                      max_seq=256)
+    np.testing.assert_array_equal(got[:, prompt.shape[1]:], want)
+
+
+def test_cp_prefill_logits_match():
+    cfg = GQA_CFG
+    params = random_llama_params(cfg, qtype=None, seed=1)
+    prompt = (np.arange(3, 27, dtype=np.int32)[None] % cfg.vocab_size)
+
+    cache = llama_mod.new_cache(cfg, 1, 64)
+    lg_ref, _ = llama_mod.forward(params, cfg, jnp.asarray(prompt), cache)
+    want = np.asarray(lg_ref[:, -1], np.float32)
+
+    lg, _ = cp_prefill(params, cfg, jnp.asarray(prompt), mesh(4),
+                       max_seq=64)
+    np.testing.assert_allclose(np.asarray(lg, np.float32), want,
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_cp_cache_layout_round_trips_through_decode():
+    """Hand-driven prefill + several decode steps track the plain path
+    step for step (positions cross device-ownership boundaries)."""
+    cfg = GQA_CFG
+    params = random_llama_params(cfg, qtype=None, seed=2)
+    prompt = (np.arange(5, 21, dtype=np.int32)[None] % cfg.vocab_size)
+    m = mesh(4)
+
+    want = plain_greedy(params, cfg, prompt, 6)
+
+    lg, cache = cp_prefill(params, cfg, jnp.asarray(prompt), m,
+                           max_seq=64)
+    toks = [int(np.argmax(np.asarray(lg)[0]))]
+    for t in range(5):
+        lg, cache = cp_decode_step(
+            params, cfg, jnp.asarray([toks[-1]], jnp.int32), cache,
+            prompt.shape[1] + t, m)
+        toks.append(int(np.argmax(np.asarray(lg)[0])))
+    np.testing.assert_array_equal(np.asarray(toks), want[0])
+
+
+def test_cp_guards():
+    cfg = GQA_CFG
+    params = random_llama_params(cfg, qtype=None, seed=0)
+    with pytest.raises(ValueError, match="not divisible"):
+        cp_prefill(params, cfg, jnp.ones((1, 30), jnp.int32), mesh(4))
+    import dataclasses
+
+    bad = dataclasses.replace(cfg, sliding_window=16)
+    with pytest.raises(NotImplementedError, match="single-device"):
+        cp_prefill(params, bad, jnp.ones((1, 32), jnp.int32), mesh(4))
+
+    # decoding past the sharded capacity must refuse, not clamp
+    m = mesh(4)
+    prompt = jnp.ones((1, 16), jnp.int32)
+    _, cache = cp_prefill(params, cfg, prompt, m, max_seq=16)
+    with pytest.raises(ValueError, match="capacity"):
+        cp_decode_step(params, cfg, jnp.ones((1,), jnp.int32), cache,
+                       16, m)
+    with pytest.raises(ValueError, match="cannot hold"):
+        cp_generate(params, cfg, np.asarray(prompt), m,
+                    max_new_tokens=8, max_seq=16)
