@@ -49,6 +49,7 @@ GGML_Q4_0, GGML_Q4_1 = 2, 3
 GGML_Q5_0, GGML_Q5_1 = 6, 7
 GGML_Q8_0 = 8
 GGML_Q2_K = 10
+GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 11, 12, 13, 14
 GGML_BF16 = 30
 
 # (block size in values, bytes per block)
@@ -58,6 +59,10 @@ _BLOCK = {
     GGML_Q5_0: (32, 22), GGML_Q5_1: (32, 24),
     GGML_Q8_0: (32, 34),
     GGML_Q2_K: (256, 84),
+    # k-quant superblocks (dequantize-on-load; the de-facto standard
+    # community formats q3_K..q6_K — block_q*_K in ggml-quants.h)
+    GGML_Q3_K: (256, 110), GGML_Q4_K: (256, 144),
+    GGML_Q5_K: (256, 176), GGML_Q6_K: (256, 210),
 }
 
 _GGML_TO_QTYPE = {
@@ -65,6 +70,121 @@ _GGML_TO_QTYPE = {
     GGML_Q5_0: "sym_int5", GGML_Q5_1: "asym_int5",
     GGML_Q8_0: "sym_int8", GGML_Q2_K: "q2_k",
 }
+
+
+def _scale_min_k4(scales: np.ndarray):
+    """ggml get_scale_min_k4: 12 packed bytes -> 8 (6-bit sc, 6-bit m)
+    pairs per superblock. scales [nblk, 12] -> (sc, m) each [nblk, 8]."""
+    s = scales.astype(np.uint8)
+    sc = np.empty((s.shape[0], 8), np.float32)
+    m = np.empty((s.shape[0], 8), np.float32)
+    sc[:, :4] = (s[:, :4] & 63)
+    m[:, :4] = (s[:, 4:8] & 63)
+    sc[:, 4:] = (s[:, 8:12] & 0x0F) | ((s[:, :4] >> 6) << 4)
+    m[:, 4:] = (s[:, 8:12] >> 4) | ((s[:, 4:8] >> 6) << 4)
+    return sc, m
+
+
+def _decode_q4k(blk: np.ndarray) -> np.ndarray:
+    """block_q4_K {d, dmin, scales[12], qs[128]} -> [nblk, 256] f32
+    (dequantize_row_q4_K: per 64-value chunk, low nibbles then high)."""
+    d = blk[:, 0:2].copy().view(np.float16).astype(np.float32)[:, 0]
+    dmin = blk[:, 2:4].copy().view(np.float16).astype(np.float32)[:, 0]
+    sc, mn = _scale_min_k4(blk[:, 4:16])
+    qs = blk[:, 16:144].reshape(-1, 4, 32)            # [nblk, chunk, 32]
+    lo = (qs & 0x0F).astype(np.float32)
+    hi = (qs >> 4).astype(np.float32)
+    out = np.empty((blk.shape[0], 4, 2, 32), np.float32)
+    for c in range(4):
+        out[:, c, 0] = (d[:, None] * sc[:, 2 * c, None] * lo[:, c]
+                        - dmin[:, None] * mn[:, 2 * c, None])
+        out[:, c, 1] = (d[:, None] * sc[:, 2 * c + 1, None] * hi[:, c]
+                        - dmin[:, None] * mn[:, 2 * c + 1, None])
+    return out.reshape(-1, 256)
+
+
+def _decode_q5k(blk: np.ndarray) -> np.ndarray:
+    """block_q5_K {d, dmin, scales[12], qh[32], qs[128]} (dequantize_
+    row_q5_K: qh bit pairs (u1, u2) shift left 2 per 64-value chunk)."""
+    d = blk[:, 0:2].copy().view(np.float16).astype(np.float32)[:, 0]
+    dmin = blk[:, 2:4].copy().view(np.float16).astype(np.float32)[:, 0]
+    sc, mn = _scale_min_k4(blk[:, 4:16])
+    qh = blk[:, 16:48]                                # [nblk, 32]
+    qs = blk[:, 48:176].reshape(-1, 4, 32)
+    out = np.empty((blk.shape[0], 4, 2, 32), np.float32)
+    for c in range(4):
+        hi_lo = ((qh >> (2 * c)) & 1).astype(np.float32) * 16.0
+        hi_hi = ((qh >> (2 * c + 1)) & 1).astype(np.float32) * 16.0
+        lo = (qs[:, c] & 0x0F).astype(np.float32) + hi_lo
+        hi = (qs[:, c] >> 4).astype(np.float32) + hi_hi
+        out[:, c, 0] = (d[:, None] * sc[:, 2 * c, None] * lo
+                        - dmin[:, None] * mn[:, 2 * c, None])
+        out[:, c, 1] = (d[:, None] * sc[:, 2 * c + 1, None] * hi
+                        - dmin[:, None] * mn[:, 2 * c + 1, None])
+    return out.reshape(-1, 256)
+
+
+def _decode_q6k(blk: np.ndarray) -> np.ndarray:
+    """block_q6_K {ql[128], qh[64], int8 scales[16], d} (dequantize_
+    row_q6_K: two 128-value halves of four 32-value strips each)."""
+    ql = blk[:, :128]
+    qh = blk[:, 128:192]
+    sc = blk[:, 192:208].view(np.int8).astype(np.float32)
+    d = blk[:, 208:210].copy().view(np.float16).astype(np.float32)[:, 0]
+    out = np.empty((blk.shape[0], 2, 4, 32), np.float32)
+    for half in range(2):
+        qlh = ql[:, 64 * half:64 * (half + 1)]
+        qhh = qh[:, 32 * half:32 * (half + 1)]
+        strips = [
+            (qlh[:, :32] & 0x0F) | (((qhh >> 0) & 3) << 4),
+            (qlh[:, 32:] & 0x0F) | (((qhh >> 2) & 3) << 4),
+            (qlh[:, :32] >> 4) | (((qhh >> 4) & 3) << 4),
+            (qlh[:, 32:] >> 4) | (((qhh >> 6) & 3) << 4),
+        ]
+        for s_i, strip in enumerate(strips):
+            q = strip.astype(np.float32) - 32.0
+            # scale index: 16-value granularity -> two scales per strip
+            isc = 8 * half + 2 * s_i
+            out[:, half, s_i, :16] = d[:, None] * sc[:, isc, None] \
+                * q[:, :16]
+            out[:, half, s_i, 16:] = d[:, None] * sc[:, isc + 1, None] \
+                * q[:, 16:]
+    return out.reshape(-1, 256)
+
+
+def _decode_q3k(blk: np.ndarray) -> np.ndarray:
+    """block_q3_K {hmask[32], qs[64], scales[12], d} (dequantize_
+    row_q3_K: kmask scale unpack; 2-bit quants with a SUBTRACTED-when-
+    clear high mask bit)."""
+    hmask = blk[:, :32]
+    qs = blk[:, 32:96]
+    s = blk[:, 96:108].astype(np.uint16)
+    d = blk[:, 108:110].copy().view(np.float16).astype(np.float32)[:, 0]
+    # scale unpack (aux/kmask form, rewritten per byte): scales i<8 take
+    # low 4 bits of byte i; i>=8 take high 4 bits of byte i-8; the top 2
+    # bits come from byte 8..11 in 2-bit lanes
+    sc = np.empty((blk.shape[0], 16), np.int16)
+    for i in range(16):
+        if i < 8:
+            low = s[:, i] & 0x0F
+        else:
+            low = s[:, i - 8] >> 4
+        hi2 = (s[:, 8 + (i % 4)] >> (2 * (i // 4))) & 3
+        sc[:, i] = ((hi2 << 4) | low).astype(np.int16) - 32
+    out = np.empty((blk.shape[0], 2, 4, 32), np.float32)
+    for half in range(2):
+        qsh = qs[:, 32 * half:32 * (half + 1)]
+        for j in range(4):
+            two = ((qsh >> (2 * j)) & 3).astype(np.float32)
+            mbit = 1 << (4 * half + j)
+            high = ((hmask & mbit) == 0).astype(np.float32) * 4.0
+            q = two - high
+            isc = 8 * half + 2 * j
+            out[:, half, j, :16] = d[:, None] * sc[:, isc, None] \
+                * q[:, :16]
+            out[:, half, j, 16:] = d[:, None] * sc[:, isc + 1, None] \
+                * q[:, 16:]
+    return out.reshape(-1, 256)
 
 
 def _decode_q2k(blk: np.ndarray):
@@ -250,6 +370,14 @@ class GGUFFile:
             vals = (d[:, None] * sc_r * codes.astype(np.float32)
                     - dmin[:, None] * m_r)
             return vals.reshape(shape).astype(dtype)
+        if gt == GGML_Q3_K:
+            return _decode_q3k(blk).reshape(shape).astype(dtype)
+        if gt == GGML_Q4_K:
+            return _decode_q4k(blk).reshape(shape).astype(dtype)
+        if gt == GGML_Q5_K:
+            return _decode_q5k(blk).reshape(shape).astype(dtype)
+        if gt == GGML_Q6_K:
+            return _decode_q6k(blk).reshape(shape).astype(dtype)
         if gt in (GGML_Q5_0, GGML_Q5_1):
             hdr = 2 if gt == GGML_Q5_0 else 4
             qh = blk[:, hdr:hdr + 4].copy().view(np.uint32)[:, 0]
@@ -559,27 +687,47 @@ def _quantize_block_np(w: np.ndarray, gt: int) -> np.ndarray:
 def write_gguf(
     path: str,
     kv: Dict[str, Any],
-    tensors: Dict[str, Tuple[np.ndarray, int]],   # name -> (f32 [out,in], ggml dtype)
+    tensors: Dict[str, tuple],   # name -> (f32 [out,in], ggml dtype)
+                                 #      or (raw_u8, ggml dtype, shape)
     alignment: int = 32,
 ) -> None:
     """Write a GGUF v3 file. Tensors are given dense f32 and encoded to the
-    requested ggml dtype (F32/F16/Q4_0/Q4_1/Q5_0/Q5_1/Q8_0)."""
+    requested ggml dtype (F32/F16/Q4_0/Q4_1/Q5_0/Q5_1/Q8_0). A
+    3-tuple entry (raw_uint8, ggml_dtype, logical_shape) passes an
+    ALREADY-PACKED payload through untouched (k-quants and other formats
+    the encoder does not produce)."""
     payloads: List[bytes] = []
     infos: List[Tuple[str, Tuple[int, ...], int, int]] = []
     offset = 0
-    for name, (arr, gt) in tensors.items():
-        arr = np.asarray(arr, np.float32)
-        if gt == GGML_F32:
-            data = arr.astype(np.float32).tobytes()
-        elif gt == GGML_F16:
-            data = arr.astype(np.float16).tobytes()
-        elif gt in (GGML_Q4_0, GGML_Q4_1, GGML_Q5_0, GGML_Q5_1,
-                    GGML_Q8_0):
-            data = _quantize_block_np(
-                arr.reshape(arr.shape[0], -1), gt).tobytes()
+    for name, spec in tensors.items():
+        if len(spec) == 3:
+            raw, gt, shape = spec
+            raw = np.asarray(raw, np.uint8)
+            block, bpb = _BLOCK[gt]
+            nvals = int(np.prod(shape))
+            if nvals % block or raw.size * block != nvals * bpb:
+                raise ValueError(
+                    f"{name}: raw payload {raw.size}B does not match "
+                    f"shape {shape} for ggml dtype {gt} "
+                    f"(block {block}, {bpb}B/block)")
+            data = raw.tobytes()
+            shape = tuple(shape)
         else:
-            raise ValueError(f"writer does not support ggml dtype {gt}")
-        infos.append((name, arr.shape, gt, offset))
+            arr, gt = spec
+            arr = np.asarray(arr, np.float32)
+            shape = arr.shape
+            if gt == GGML_F32:
+                data = arr.astype(np.float32).tobytes()
+            elif gt == GGML_F16:
+                data = arr.astype(np.float16).tobytes()
+            elif gt in (GGML_Q4_0, GGML_Q4_1, GGML_Q5_0, GGML_Q5_1,
+                        GGML_Q8_0):
+                data = _quantize_block_np(
+                    arr.reshape(arr.shape[0], -1), gt).tobytes()
+            else:
+                raise ValueError(
+                    f"writer does not support ggml dtype {gt}")
+        infos.append((name, shape, gt, offset))
         payloads.append(data)
         offset += len(data)
         pad = (-offset) % alignment
