@@ -42,6 +42,13 @@ class RuntimeFlags:
     quantize_kv_cache: bool = False
     # default max sequence length for loaded models
     default_max_seq: int = 2048
+    # AOT cross-compilation target: set to "tpu" while LOWERING a program
+    # for a TPU topology from a CPU host (tests/test_aot_tpu.py) so kernel
+    # dispatch routes to Pallas even though jax.default_backend() is cpu.
+    # Compile probes are skipped (they cannot execute on an abstract
+    # topology) — Mosaic rejections surface at .compile(), which is the
+    # point of the AOT suite.
+    aot_target: Optional[str] = None
 
     @classmethod
     def from_env(cls) -> "RuntimeFlags":
@@ -55,6 +62,8 @@ class RuntimeFlags:
             native_cache_dir=os.environ.get("BIGDL_TPU_NATIVE_CACHE"),
             quantize_kv_cache=_env_bool("BIGDL_TPU_QUANTIZE_KV_CACHE"),
             default_max_seq=int(os.environ.get("BIGDL_TPU_MAX_SEQ", "2048")),
+            aot_target=(os.environ.get("BIGDL_TPU_AOT_TARGET") or "").strip()
+            .lower() or None,
         )
 
 
@@ -66,6 +75,23 @@ def flags() -> RuntimeFlags:
     if _flags is None:
         _flags = RuntimeFlags.from_env()
     return _flags
+
+
+def target_is_tpu() -> bool:
+    """True when code will EXECUTE on TPU: the live backend is TPU, or we
+    are AOT-lowering for a TPU topology (flags().aot_target == 'tpu').
+    Kernel dispatch consults this instead of jax.default_backend()."""
+    t = flags().aot_target
+    if t is not None and t != "tpu":
+        raise ValueError(f"unknown aot_target {t!r}; only 'tpu' is supported")
+    if t == "tpu":
+        return True
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
 
 
 def set_flags(**kwargs) -> RuntimeFlags:

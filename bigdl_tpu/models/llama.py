@@ -317,15 +317,15 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
     # FLOPs (E/k cut vs the dense combine below); requires the Pallas
     # kernel, probed per geometry. Quantized-with-bias stacks (none of
     # the served families) would fall through to dense.
-    from bigdl_tpu.config import flags
+    from bigdl_tpu.config import flags, target_is_tpu
 
     if (not biased and flags().moe_dispatch != "dense"
-            and (jax.default_backend() == "tpu"
+            and (target_is_tpu()
                  or flags().moe_dispatch == "ragged")):
         from bigdl_tpu.ops.pallas.moe_dispatch import (
             moe_mlp_ragged, ragged_kernel_compiles)
 
-        interp = jax.default_backend() != "tpu"
+        interp = not target_is_tpu()
         forced = flags().moe_dispatch == "ragged"
         # forced mode bypasses the probes so compile errors SURFACE
         # (A/B runs must never silently measure the dense path); auto

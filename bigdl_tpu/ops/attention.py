@@ -61,6 +61,12 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
     probe compile). Genuine compile failures pin the geometry to XLA;
     transient backend failures are retried (reset_probe_cache() clears
     everything)."""
+    from bigdl_tpu.config import flags as _flags
+
+    if _flags().aot_target == "tpu":
+        # AOT lowering for a topology: nothing can execute — trust the
+        # dispatch and let Mosaic rejections surface at .compile()
+        return True
     key = (kind, h, hkv, hd, sq, skv, kv_dtype_name)
     hit = _probe_cache.get(key)
     if hit is not None:
@@ -75,11 +81,17 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
             from bigdl_tpu.ops.pallas.prefill_attention import (
                 prefill_attention_pallas as kernel)
 
-        kdt = jnp.dtype(kv_dtype_name)
-        q = jnp.zeros((1, sq, h, hd), jnp.bfloat16)
-        kv = jnp.zeros((1, skv, hkv, hd), kdt)
-        out = kernel(q, kv, kv, jnp.asarray(0, jnp.int32), hd ** -0.5)
-        _np.asarray(out)
+        # The probe is usually reached while TRACING a model's outer jit;
+        # ensure_compile_time_eval escapes the trace so the tiny compile
+        # actually executes here (otherwise jnp ops become trace constants
+        # and _np.asarray raises TracerArrayConversionError, which would
+        # pin the geometry to the XLA path after the retry budget).
+        with jax.ensure_compile_time_eval():
+            kdt = jnp.dtype(kv_dtype_name)
+            q = jnp.zeros((1, sq, h, hd), jnp.bfloat16)
+            kv = jnp.zeros((1, skv, hkv, hd), kdt)
+            out = kernel(q, kv, kv, jnp.asarray(0, jnp.int32), hd ** -0.5)
+            _np.asarray(out)
         _probe_cache[key] = True
         return True
     except Exception as e:
@@ -131,7 +143,7 @@ def sdp_attention(
     if scale is None:
         scale = d ** -0.5
 
-    from bigdl_tpu.config import flags
+    from bigdl_tpu.config import flags, target_is_tpu
 
     be = backend or flags().attention_backend
     if be in ("auto", "pallas"):
@@ -141,7 +153,7 @@ def sdp_attention(
         supported = decode_attention_supported(
             q, k, v, q_pos, scale, logits_soft_cap, sliding_window,
             alibi_slopes)
-        on_tpu = jax.default_backend() == "tpu"
+        on_tpu = target_is_tpu()
         if supported and be == "pallas":
             return decode_attention_pallas(q, k, v, q_pos, float(scale),
                                            interpret=not on_tpu)

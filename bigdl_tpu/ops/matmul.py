@@ -46,11 +46,6 @@ def _backend() -> str:
     return flags().matmul_backend
 
 
-def _on_tpu(x: jax.Array) -> bool:
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
 
 
 def _q_matmul_xla(x: jax.Array, w: QTensor) -> jax.Array:
@@ -65,7 +60,9 @@ def _q_matmul_dispatch(x: jax.Array, w: QTensor, be: str) -> jax.Array:
     if be == "xla":
         return _q_matmul_xla(x, w)
     if be in ("auto", "pallas"):
-        use_pallas = w.qtype in _PALLAS_QTYPES and _on_tpu(x)
+        from bigdl_tpu.config import target_is_tpu
+
+        use_pallas = w.qtype in _PALLAS_QTYPES and target_is_tpu()
         if be == "pallas" or use_pallas:
             try:
                 from bigdl_tpu.ops.pallas.dequant_matmul import q_matmul_pallas
@@ -91,12 +88,16 @@ def vmapped_pallas_ok(qtype: str, k: int = 256, n: int = 256) -> bool:
     geometry-dependent). The stand-in keeps the full K (the GEMV x/scale
     residency depends on it) but only ONE N tile — probing the full
     [K, N] would allocate hundreds of MB next to a resident model."""
-    if not (_on_tpu(None) and qtype in _PALLAS_QTYPES):
+    from bigdl_tpu.config import flags as _flags, target_is_tpu
+
+    if not (target_is_tpu() and qtype in _PALLAS_QTYPES):
         return False
     from bigdl_tpu.ops.pallas.dequant_matmul import (_gemv_tiles,
                                                      q_matmul_pallas)
     from bigdl_tpu.ops.quant import get_qtype, quantize
 
+    if _flags().aot_target == "tpu":   # AOT lowering: trust the dispatch
+        return True
     tiles = _gemv_tiles(get_qtype(qtype), k, n)
     if tiles is not None:
         n = tiles[1]
@@ -107,16 +108,18 @@ def vmapped_pallas_ok(qtype: str, k: int = 256, n: int = 256) -> bool:
     try:
         import numpy as _np
 
-        one = quantize(jnp.zeros((k, n), jnp.float32), qtype)
-        stack = jax.tree.map(lambda a: jnp.stack([a, a]), one)
-        x = jnp.zeros((2, k), jnp.bfloat16)
+        # escape the caller's jit trace (see ops/attention._kernel_compiles)
+        with jax.ensure_compile_time_eval():
+            one = quantize(jnp.zeros((k, n), jnp.float32), qtype)
+            stack = jax.tree.map(lambda a: jnp.stack([a, a]), one)
+            x = jnp.zeros((2, k), jnp.bfloat16)
 
-        def per(i, row):
-            wi = jax.tree.map(lambda a: a[i], stack)
-            return q_matmul_pallas(row[None], wi)[0]
+            def per(i, row):
+                wi = jax.tree.map(lambda a: a[i], stack)
+                return q_matmul_pallas(row[None], wi)[0]
 
-        _np.asarray(jax.jit(jax.vmap(per))(
-            jnp.asarray([0, 1], jnp.int32), x))
+            _np.asarray(jax.jit(jax.vmap(per))(
+                jnp.asarray([0, 1], jnp.int32), x))
         ok = True
     except Exception as e:
         import logging

@@ -33,8 +33,11 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, *, scale, s, gp):
     pos = pos_ref[b]
 
     q = q_ref[0, 0].astype(jnp.bfloat16)              # [Gp, hd]
-    k = k_ref[0, :, 0, :].astype(jnp.bfloat16)        # [S, hd]
-    v = v_ref[0, :, 0, :].astype(jnp.bfloat16)        # [S, hd]
+    # K/V arrive as [B, S, Hkv*hd] views blocked (1, S, hd) per kv head —
+    # Mosaic requires the last two BLOCK dims be (8,128)-tileable, which a
+    # [.., S, 1, hd] per-head block is not (the 1 sits second-to-last)
+    k = k_ref[0].astype(jnp.bfloat16)                 # [S, hd]
+    v = v_ref[0].astype(jnp.bfloat16)                 # [S, hd]
 
     scores = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
@@ -71,6 +74,11 @@ def decode_attention_pallas(
     qr = q.reshape(b, hkv, g, hd)
     if gp != g:
         qr = jnp.pad(qr, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    # flatten heads into the lane axis so the per-head block is
+    # (1, S, hd) — see the kernel comment; the reshape is free on the
+    # contiguous [B, S, Hkv, hd] cache layout
+    k2 = k.reshape(b, s, hkv * hd)
+    v2 = v.reshape(b, s, hkv * hd)
 
     pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
 
@@ -79,8 +87,8 @@ def decode_attention_pallas(
         grid=(b, hkv),
         in_specs=[
             pl.BlockSpec((1, 1, gp, hd), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, s, 1, hd), lambda bi, hi, pos_ref: (bi, 0, hi, 0)),
-            pl.BlockSpec((1, s, 1, hd), lambda bi, hi, pos_ref: (bi, 0, hi, 0)),
+            pl.BlockSpec((1, s, hd), lambda bi, hi, pos_ref: (bi, 0, hi)),
+            pl.BlockSpec((1, s, hd), lambda bi, hi, pos_ref: (bi, 0, hi)),
         ],
         out_specs=pl.BlockSpec((1, 1, gp, hd),
                                lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
@@ -90,7 +98,7 @@ def decode_attention_pallas(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, gp, hd), q.dtype),
         interpret=interpret,
-    )(pos, qr, k, v)
+    )(pos, qr, k2, v2)
 
     return out[:, :, :g, :].reshape(b, 1, h, hd)
 

@@ -63,22 +63,19 @@ def _dequant_tile(codes_blk, scale, zero, kind: str, codebook, bk: int, bn: int)
         z = zero.astype(jnp.float32)[:, None, :]
         vals = codes_f * s + z
     elif kind == "codebook":
-        # LUT via binary select tree (avoids gather, which Mosaic lowers
-        # poorly). Codes are stored in 4-bit nibbles; tables smaller than 16
-        # (nf3 has 8 entries) are zero-padded — those codes never occur.
+        # LUT via a sequential compare/select chain (avoids gather, which
+        # Mosaic lowers poorly). A binary select TREE is fewer selects but
+        # keeps ~15 full-tile f32 temps live at once — 48MB of scoped VMEM
+        # at generic tiles, a real Mosaic OOM (caught by tests/test_aot_
+        # tpu.py); the chain keeps the live set at 2 buffers. Tables
+        # smaller than 16 (nf3 has 8 entries) are zero-padded — those
+        # codes never occur.
         c = codes_blk
         tbl = list(codebook) + [0.0] * (16 - len(codebook))
-        def sel(bit, lo_v, hi_v):
-            return jnp.where(bit, hi_v, lo_v)
-        b0 = (c & 1).astype(jnp.bool_)
-        b1 = ((c >> 1) & 1).astype(jnp.bool_)
-        b2 = ((c >> 2) & 1).astype(jnp.bool_)
-        b3 = ((c >> 3) & 1).astype(jnp.bool_)
-        # level 0: pairs, pattern matches bit ordering lsb->msb
-        l0 = [sel(b0, tbl[i], tbl[i + 1]) for i in range(0, 16, 2)]
-        l1 = [sel(b1, l0[i], l0[i + 1]) for i in range(0, 8, 2)]
-        l2 = [sel(b2, l1[i], l1[i + 1]) for i in range(0, 4, 2)]
-        vals = sel(b3, l2[0], l2[1]) * s
+        vals = jnp.full(c.shape, tbl[0], jnp.float32)
+        for i in range(1, 16):
+            vals = jnp.where(c == i, tbl[i], vals)
+        vals = vals * s
     else:
         raise NotImplementedError(kind)
     return vals.reshape(bk, bn).astype(jnp.bfloat16)
@@ -177,6 +174,10 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int) -> bool:
     tiles = _gemv_tiles(qt, kp, n)
     if tiles is None:
         return False
+    from bigdl_tpu.config import flags as _flags
+
+    if _flags().aot_target == "tpu":   # AOT lowering: trust the dispatch
+        return True
     bk, bn = tiles
     key = (qtype, kp, bn, bk)
     hit = _gemv_probe_cache.get(key)
@@ -185,9 +186,14 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int) -> bool:
     try:
         from bigdl_tpu.ops.quant import quantize
 
-        wq = quantize(jnp.zeros((kp, bn), jnp.float32), qtype)
-        x = jnp.zeros((1, kp), jnp.bfloat16)
-        np.asarray(_q_gemv_pallas(x, wq, qt, 1, kp, bn, False, x.dtype))
+        # escape the caller's jit trace (see ops/attention._kernel_compiles);
+        # jit the call — eager pallas_call has no eval rule for program_id
+        with jax.ensure_compile_time_eval():
+            wq = quantize(jnp.zeros((kp, bn), jnp.float32), qtype)
+            x = jnp.zeros((1, kp), jnp.bfloat16)
+            np.asarray(jax.jit(
+                lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, kp, bn, False,
+                                              xx.dtype))(x, wq))
         ok = True
     except Exception as e:
         import logging

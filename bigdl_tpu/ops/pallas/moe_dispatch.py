@@ -177,6 +177,10 @@ def ragged_kernel_compiles(qtype: Optional[str], k: int, n: int) -> bool:
     tiles = _ragged_tiles(qtype, k, n)
     if tiles is None:
         return False
+    from bigdl_tpu.config import flags as _flags
+
+    if _flags().aot_target == "tpu":   # AOT lowering: trust the dispatch
+        return True
     bk, bn = tiles
     key = (qtype, bk, bn)
     hit = _probe_cache.get(key)
@@ -187,17 +191,19 @@ def ragged_kernel_compiles(qtype: Optional[str], k: int, n: int) -> bool:
 
         from bigdl_tpu.ops.quant import quantize
 
-        t = TOKEN_TILE
-        kd = min(2 * bk, k if qtype is None else -(-k // bk) * bk)
-        kd = kd - kd % bk or bk
-        if qtype is None:
-            w = jnp.zeros((2, kd, bn), jnp.bfloat16)
-        else:
-            one = quantize(jnp.zeros((kd, bn), jnp.float32), qtype)
-            w = jax.tree.map(lambda a: jnp.stack([a, a]), one)
-        x = jnp.zeros((t, kd), jnp.bfloat16)
-        te = jnp.zeros((1,), jnp.int32)
-        np.asarray(ragged_expert_matmul(x, w, te))
+        # escape the caller's jit trace (see ops/attention._kernel_compiles)
+        with jax.ensure_compile_time_eval():
+            t = TOKEN_TILE
+            kd = min(2 * bk, k if qtype is None else -(-k // bk) * bk)
+            kd = kd - kd % bk or bk
+            if qtype is None:
+                w = jnp.zeros((2, kd, bn), jnp.bfloat16)
+            else:
+                one = quantize(jnp.zeros((kd, bn), jnp.float32), qtype)
+                w = jax.tree.map(lambda a: jnp.stack([a, a]), one)
+            x = jnp.zeros((t, kd), jnp.bfloat16)
+            te = jnp.zeros((1,), jnp.int32)
+            np.asarray(ragged_expert_matmul(x, w, te))
         ok = True
     except Exception as e:
         import logging

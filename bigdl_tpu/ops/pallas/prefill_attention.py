@@ -45,8 +45,11 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     q = q_ref[0].astype(jnp.bfloat16)                  # [bq, hd]
-    k = k_ref[0, :, 0, :].astype(jnp.bfloat16)         # [bk, hd]
-    v = v_ref[0, :, 0, :].astype(jnp.bfloat16)
+    # K/V arrive as [B, S_max, Hkv*hd] views blocked (1, bk, hd) per kv
+    # head (a [.., bk, 1, hd] per-head block violates Mosaic's (8,128)
+    # block-tiling rule — the 1 sits second-to-last)
+    k = k_ref[0].astype(jnp.bfloat16)                  # [bk, hd]
+    v = v_ref[0].astype(jnp.bfloat16)
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -147,6 +150,9 @@ def _pfa_impl(
     nq, nk = s // bq, smax // bk
 
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, hd)
+    # flatten kv heads into the lane axis (see kernel comment)
+    k2 = k.reshape(b, smax, hkv * hd)
+    v2 = v.reshape(b, smax, hkv * hd)
     pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
     # per-(b*h) pos lookup: repeat to [B*H]
     pos_bh = jnp.repeat(pos, h)
@@ -157,12 +163,12 @@ def _pfa_impl(
         in_specs=[
             pl.BlockSpec((1, bq, hd),
                          lambda bh, qi, kj, pos_ref: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, 1, hd),
+            pl.BlockSpec((1, bk, hd),
                          lambda bh, qi, kj, pos_ref:
-                         (bh // h, kj, (bh % h) // g, 0)),
-            pl.BlockSpec((1, bk, 1, hd),
+                         (bh // h, kj, (bh % h) // g)),
+            pl.BlockSpec((1, bk, hd),
                          lambda bh, qi, kj, pos_ref:
-                         (bh // h, kj, (bh % h) // g, 0)),
+                         (bh // h, kj, (bh % h) // g)),
         ],
         out_specs=pl.BlockSpec((1, bq, hd),
                                lambda bh, qi, kj, pos_ref: (bh, qi, 0)),
@@ -177,7 +183,7 @@ def _pfa_impl(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, s, hd), q.dtype),
         interpret=interpret,
-    )(pos_bh, qr, k, v)
+    )(pos_bh, qr, k2, v2)
 
     return out.reshape(b, h, s, hd).transpose(0, 2, 1, 3)
 

@@ -1,0 +1,266 @@
+"""AOT compilation of every Pallas kernel + full model programs for v5e.
+
+VERDICT r2 #1: the kernels were interpret-verified only — nothing had ever
+been through a real Mosaic lowering. This suite compiles them for an
+OFFLINE v5e topology (jax.experimental.topologies + local libtpu; no chip
+needed), so "should work on TPU" becomes "compiles for TPU" in CI.
+
+`flags().aot_target = 'tpu'` routes kernel dispatch to Pallas during
+lowering even though the host backend is CPU (probes cannot execute on an
+abstract topology; Mosaic rejections surface at .compile(), which is what
+this suite is for). The whole-model tests additionally assert the compiled
+HLO actually CONTAINS Mosaic custom-calls — guarding against the silent
+100%-XLA-fallback failure mode.
+
+Compiled-memory figures are recorded in PARITY.md.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import SingleDeviceSharding
+
+from bigdl_tpu.config import set_flags
+
+pytestmark = pytest.mark.aot
+
+
+@pytest.fixture(scope="module")
+def v5e():
+    try:
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x2")
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"offline v5e topology unavailable: {e}")
+    return topo
+
+
+@pytest.fixture()
+def aot_flags():
+    set_flags(aot_target="tpu")
+    yield
+    set_flags(aot_target=None)
+
+
+def _sds(tree, dev):
+    s = SingleDeviceSharding(dev)
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s), tree)
+
+
+def _compile(fn, *abstract_args):
+    return jax.jit(fn).lower(*abstract_args).compile()
+
+
+def _has_mosaic_call(compiled) -> bool:
+    txt = compiled.as_text()
+    return "tpu_custom_call" in txt or "custom-call" in txt and "Mosaic" in txt
+
+
+# ------------------------------------------------------------ kernels
+
+GEMM_QTYPES = ["sym_int4", "asym_int4", "nf4", "fp4", "nf3", "sym_int8"]
+
+
+@pytest.mark.parametrize("qtype", GEMM_QTYPES)
+def test_dequant_matmul_generic_compiles(v5e, aot_flags, qtype):
+    from bigdl_tpu.ops.pallas.dequant_matmul import q_matmul_pallas
+    from bigdl_tpu.ops.quant import quantize
+
+    dev = v5e.devices[0]
+    wq = jax.eval_shape(
+        lambda: quantize(jnp.zeros((4096, 4096), jnp.float32), qtype))
+    x = jax.ShapeDtypeStruct((512, 4096), jnp.bfloat16)
+    comp = _compile(lambda xx, ww: q_matmul_pallas(xx, ww),
+                    _sds(x, dev), _sds(wq, dev))
+    assert _has_mosaic_call(comp), "kernel lowered to XLA, not Mosaic"
+
+
+@pytest.mark.parametrize("qtype,n", [("sym_int4", 4096), ("sym_int4", 11008),
+                                     ("sym_int8", 4096), ("nf4", 4096),
+                                     ("fp4", 4096), ("asym_int4", 4096)])
+def test_dequant_gemv_compiles(v5e, aot_flags, qtype, n):
+    """The decode-GEMV variant (M<=16, x/scales VMEM-resident) at
+    llama-7B decode geometries — called directly, bypassing the probe."""
+    from bigdl_tpu.ops.pallas.dequant_matmul import _q_gemv_pallas
+    from bigdl_tpu.ops.quant import get_qtype, quantize
+
+    dev = v5e.devices[0]
+    k = 4096
+    qt = get_qtype(qtype)
+    wq = jax.eval_shape(
+        lambda: quantize(jnp.zeros((k, n), jnp.float32), qtype))
+    x = jax.ShapeDtypeStruct((1, k), jnp.bfloat16)
+    comp = _compile(
+        lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, k, n, False, xx.dtype),
+        _sds(x, dev), _sds(wq, dev))
+    assert _has_mosaic_call(comp)
+
+
+@pytest.mark.parametrize("b,s,h,hkv,hd,kvdt", [
+    (1, 1024, 32, 32, 128, "bfloat16"),     # llama2-7B MHA
+    (1, 2048, 32, 8, 128, "bfloat16"),      # GQA (mistral/llama3)
+    (1, 2048, 32, 8, 128, "float8_e5m2"),   # fp8 KV cache
+    (8, 1024, 32, 8, 128, "bfloat16"),      # batched serving decode
+    (1, 4096, 40, 40, 128, "bfloat16"),     # 13B-class long cache
+])
+def test_decode_attention_compiles(v5e, aot_flags, b, s, h, hkv, hd, kvdt):
+    from bigdl_tpu.ops.pallas.decode_attention import decode_attention_pallas
+
+    dev = v5e.devices[0]
+    kdt = jnp.dtype(kvdt)
+    q = jax.ShapeDtypeStruct((b, 1, h, hd), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((b, s, hkv, hd), kdt)
+    pos = jax.ShapeDtypeStruct((), jnp.int32)
+    comp = _compile(
+        lambda qq, kk, vv, pp: decode_attention_pallas(
+            qq, kk, vv, pp, hd ** -0.5),
+        _sds(q, dev), _sds(kv, dev), _sds(kv, dev), _sds(pos, dev))
+    assert _has_mosaic_call(comp)
+
+
+@pytest.mark.parametrize("b,sq,s,h,hkv,hd,kvdt", [
+    (1, 512, 1024, 32, 32, 128, "bfloat16"),
+    (1, 1024, 2048, 32, 8, 128, "bfloat16"),
+    (1, 1024, 2048, 32, 8, 128, "float8_e5m2"),
+])
+def test_prefill_attention_compiles(v5e, aot_flags, b, sq, s, h, hkv, hd,
+                                    kvdt):
+    from bigdl_tpu.ops.pallas.prefill_attention import (
+        prefill_attention_pallas)
+
+    dev = v5e.devices[0]
+    kdt = jnp.dtype(kvdt)
+    q = jax.ShapeDtypeStruct((b, sq, h, hd), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((b, s, hkv, hd), kdt)
+    pos = jax.ShapeDtypeStruct((), jnp.int32)
+    comp = _compile(
+        lambda qq, kk, vv, pp: prefill_attention_pallas(
+            qq, kk, vv, pp, hd ** -0.5),
+        _sds(q, dev), _sds(kv, dev), _sds(kv, dev), _sds(pos, dev))
+    assert _has_mosaic_call(comp)
+
+
+def test_prefill_attention_vjp_compiles(v5e, aot_flags):
+    """Training path: grad through the Pallas forward (custom VJP runs the
+    XLA reference backward — both must lower in one program)."""
+    from bigdl_tpu.ops.pallas.prefill_attention import (
+        prefill_attention_pallas)
+
+    dev = v5e.devices[0]
+    q = jax.ShapeDtypeStruct((1, 512, 32, 128), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((1, 512, 32, 128), jnp.bfloat16)
+    pos = jax.ShapeDtypeStruct((), jnp.int32)
+
+    def loss(qq, kk, vv, pp):
+        return jnp.sum(prefill_attention_pallas(
+            qq, kk, vv, pp, 128 ** -0.5).astype(jnp.float32))
+
+    comp = _compile(jax.grad(loss), _sds(q, dev), _sds(kv, dev),
+                    _sds(kv, dev), _sds(pos, dev))
+    assert comp is not None
+
+
+@pytest.mark.parametrize("qtype", [None, "sym_int4"])
+def test_moe_ragged_compiles(v5e, aot_flags, qtype):
+    from bigdl_tpu.ops.pallas.moe_dispatch import ragged_expert_matmul
+    from bigdl_tpu.ops.quant import quantize
+
+    dev = v5e.devices[0]
+    e, k, n, toks = 8, 1024, 2816, 256
+    if qtype is None:
+        w = jax.ShapeDtypeStruct((e, k, n), jnp.bfloat16)
+    else:
+        one = jax.eval_shape(
+            lambda: quantize(jnp.zeros((k, n), jnp.float32), qtype))
+        w = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct((e,) + a.shape, a.dtype), one)
+    x = jax.ShapeDtypeStruct((toks, k), jnp.bfloat16)
+    te = jax.ShapeDtypeStruct((toks // 16,), jnp.int32)
+    comp = _compile(lambda xx, ww, tt: ragged_expert_matmul(xx, ww, tt),
+                    _sds(x, dev), _sds(w, dev), _sds(te, dev))
+    assert _has_mosaic_call(comp)
+
+
+# ------------------------------------------------------- whole model
+
+def _llama7b_abstract(dev, qtype="sym_int4", batch=1, max_seq=2048,
+                      quantized_cache=False):
+    from bigdl_tpu.models import llama as M
+    from bigdl_tpu.utils.testing import LLAMA2_7B, random_llama_params
+
+    cfg = LLAMA2_7B
+    params = _sds(jax.eval_shape(
+        lambda: random_llama_params(cfg, qtype)), dev)
+    cache = _sds(jax.eval_shape(
+        lambda: M.new_cache(cfg, batch, max_seq,
+                            quantized=quantized_cache)), dev)
+    return cfg, params, cache
+
+
+RECORDED = {}
+
+
+def test_llama7b_decode_compiles(v5e, aot_flags):
+    from bigdl_tpu.models import llama as M
+
+    dev = v5e.devices[0]
+    cfg, params, cache = _llama7b_abstract(dev)
+    ids = _sds(jax.ShapeDtypeStruct((1, 1), jnp.int32), dev)
+    comp = _compile(lambda p, i, c: M.forward(p, cfg, i, c),
+                    params, ids, cache)
+    assert _has_mosaic_call(comp), (
+        "7B decode compiled WITHOUT any Mosaic kernel — silent XLA fallback")
+    ma = comp.memory_analysis()
+    RECORDED["decode"] = ma
+    # whole-model INT4: weights ~3.5GB + bf16 KV cache; must fit v5e 16G
+    assert ma.argument_size_in_bytes < 8e9
+
+
+def test_llama7b_prefill_compiles(v5e, aot_flags):
+    from bigdl_tpu.models import llama as M
+
+    dev = v5e.devices[0]
+    cfg, params, cache = _llama7b_abstract(dev)
+    ids = _sds(jax.ShapeDtypeStruct((1, 512), jnp.int32), dev)
+    comp = _compile(
+        lambda p, i, c: M.forward(p, cfg, i, c, last_only=True),
+        params, ids, cache)
+    assert _has_mosaic_call(comp)
+    RECORDED["prefill"] = comp.memory_analysis()
+
+
+def test_llama7b_decode_fp8_cache_compiles(v5e, aot_flags):
+    from bigdl_tpu.models import llama as M
+
+    dev = v5e.devices[0]
+    cfg, params, cache = _llama7b_abstract(dev, quantized_cache=True)
+    ids = _sds(jax.ShapeDtypeStruct((1, 1), jnp.int32), dev)
+    comp = _compile(lambda p, i, c: M.forward(p, cfg, i, c),
+                    params, ids, cache)
+    assert _has_mosaic_call(comp)
+
+
+def test_mixtral_prefill_compiles(v5e, aot_flags):
+    """MoE model: ragged dispatch + router on the prefill path at a
+    mixtral-like (downscaled-experts) geometry."""
+    from bigdl_tpu.models import llama as M
+    from bigdl_tpu.models.llama import LlamaConfig
+    from bigdl_tpu.utils.testing import random_mixtral_params
+
+    dev = v5e.devices[0]
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=4, num_attention_heads=32, num_key_value_heads=8,
+        num_local_experts=8, num_experts_per_tok=2)
+    params = _sds(jax.eval_shape(
+        lambda: random_mixtral_params(cfg, "sym_int4")), dev)
+    cache = _sds(jax.eval_shape(lambda: M.new_cache(cfg, 1, 1024)), dev)
+    ids = _sds(jax.ShapeDtypeStruct((1, 256), jnp.int32), dev)
+    comp = _compile(
+        lambda p, i, c: M.forward(p, cfg, i, c, last_only=True),
+        params, ids, cache)
+    assert _has_mosaic_call(comp)

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Opportunistic on-chip runner: probe the axon TPU tunnel every 5 min;
+# when it answers, run the on-chip kernel validation + bench and record
+# artifacts, then keep watching (the tunnel flaps — grab numbers while
+# it's up). Results land in tpu_runs/ with timestamps.
+cd /root/repo
+mkdir -p tpu_runs
+while true; do
+  ts=$(date +%Y%m%d_%H%M%S)
+  if timeout 90 python -u -c "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
+    echo "$ts tunnel ALIVE — running on-chip suite" >> tpu_runs/watch.log
+    timeout 1800 python -u tools/tpu_onchip.py > "tpu_runs/onchip_$ts.log" 2>&1
+    echo "$ts onchip exit=$?" >> tpu_runs/watch.log
+    timeout 1800 python -u bench.py > "tpu_runs/bench_$ts.json" 2> "tpu_runs/bench_$ts.log"
+    echo "$ts bench exit=$?" >> tpu_runs/watch.log
+    sleep 60
+  else
+    echo "$ts tunnel dead" >> tpu_runs/watch.log
+    sleep 240
+  fi
+done
