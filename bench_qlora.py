@@ -78,9 +78,12 @@ def main() -> None:
 
     tokens_per_s = batch * seq / (per_step_ms / 1e3)
     out = {
-        "metric": "llama2_7b_qlora_step_time",
+        # a CPU fallback must not carry the 7B-on-TPU metric name
+        "metric": ("llama2_7b_qlora_step_time" if on_tpu
+                   else "cpu_fallback_smoke_qlora_step_time"),
         "value": round(per_step_ms, 2),
         "unit": "ms",
+        "valid": bool(on_tpu),
         "tokens_per_s": round(tokens_per_s, 1),
         "batch": batch,
         "seq_len": seq,

@@ -52,7 +52,7 @@ def main(argv=None) -> int:
     exact = {
         "fp32": G.GGML_F32, "f32": G.GGML_F32,
         "fp16": G.GGML_F16, "f16": G.GGML_F16,
-        "bf16": G.GGML_F16,    # writer encodes halves as IEEE f16
+        "bf16": G.GGML_BF16,
         "sym_int4": G.GGML_Q4_0, "int4": G.GGML_Q4_0, "q4_0": G.GGML_Q4_0,
         "sym_int8": G.GGML_Q8_0, "int8": G.GGML_Q8_0, "q8_0": G.GGML_Q8_0,
         "fp8": G.GGML_Q8_0, "fp8_e4m3": G.GGML_Q8_0,

@@ -692,7 +692,7 @@ def write_gguf(
     alignment: int = 32,
 ) -> None:
     """Write a GGUF v3 file. Tensors are given dense f32 and encoded to the
-    requested ggml dtype (F32/F16/Q4_0/Q4_1/Q5_0/Q5_1/Q8_0). A
+    requested ggml dtype (F32/F16/BF16/Q4_0/Q4_1/Q5_0/Q5_1/Q8_0). A
     3-tuple entry (raw_uint8, ggml_dtype, logical_shape) passes an
     ALREADY-PACKED payload through untouched (k-quants and other formats
     the encoder does not produce)."""
@@ -720,6 +720,14 @@ def write_gguf(
                 data = arr.astype(np.float32).tobytes()
             elif gt == GGML_F16:
                 data = arr.astype(np.float16).tobytes()
+            elif gt == GGML_BF16:
+                f = arr.astype(np.float32)
+                u = f.view(np.uint32)
+                # round-to-nearest-even into the top 16 bits; NaN must not
+                # round into the Inf encoding (0x7F80) — emit canonical qNaN
+                r = ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype(np.uint16)
+                r = np.where(np.isnan(f), np.uint16(0x7FC0), r)
+                data = r.tobytes()
             elif gt in (GGML_Q4_0, GGML_Q4_1, GGML_Q5_0, GGML_Q5_1,
                         GGML_Q8_0):
                 data = _quantize_block_np(

@@ -97,7 +97,8 @@ def is_v1_config(hf: Dict[str, Any]) -> bool:
 @dataclasses.dataclass
 class ChatGLMCache:
     kv: KVCache
-    ctx_len: jax.Array      # [B] int32: bos index + 1 (bidirectional span)
+    ctx_len: jax.Array      # [B] int32: bos index (bidirectional span is
+                            # tokens [0, ctx_len); bos itself is causal)
     mask_pos: jax.Array     # [B] int32: [gMASK]/[MASK] index
 
     def tree_flatten(self):
@@ -248,7 +249,11 @@ def forward(
     real_len = jnp.where(
         jnp.any(nz, axis=1),
         sq - jnp.argmax(jnp.flip(nz, axis=1), axis=1), 0)
-    ctx_new = jnp.where(has_bos, bos_idx + 1, real_len).astype(jnp.int32)
+    # upstream chatglm-6b: context_length = seq.index(bos_token_id) — the
+    # bos token itself falls in the GENERATION span (seq row frozen at
+    # mask_pos, block row starting at 1, causally masked), not the
+    # bidirectional prefix
+    ctx_new = jnp.where(has_bos, bos_idx, real_len).astype(jnp.int32)
     has_g = jnp.any(tokens == cfg.gmask_token_id, axis=1)
     g_idx = jnp.argmax(tokens == cfg.gmask_token_id, axis=1)
     has_m = jnp.any(tokens == cfg.mask_token_id, axis=1)

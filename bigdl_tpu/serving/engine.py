@@ -84,8 +84,11 @@ class EngineConfig:
     # LlamaState, ggml/model/llama/llama.py:63,109-121,1346-1373): after
     # each admission the prompt's KV snapshot is kept on HOST; a later
     # prompt sharing a prefix seeds its private cache from the longest
-    # match and prefills only the tail. 0 disables.
-    prefix_cache_entries: int = 2
+    # match and prefills only the tail. 0 (the default) disables: for a
+    # 7B-class model each entry holds on the order of 100-500 MB of host
+    # DRAM (2*L*prefix_cache_max_tokens*Hkv*hd values) and its device
+    # slices pin HBM until the next cache touch — opt in per deployment.
+    prefix_cache_entries: int = 0
     # only the first N prompt tokens are snapshotted — bounds the D2H
     # transfer and host memory per entry (system prompts live here)
     prefix_cache_max_tokens: int = 1024

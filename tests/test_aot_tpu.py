@@ -165,7 +165,8 @@ def test_prefill_attention_vjp_compiles(v5e, aot_flags):
 
 @pytest.mark.parametrize("qtype", [None, "sym_int4"])
 def test_moe_ragged_compiles(v5e, aot_flags, qtype):
-    from bigdl_tpu.ops.pallas.moe_dispatch import ragged_expert_matmul
+    from bigdl_tpu.ops.pallas.moe_dispatch import (TOKEN_TILE,
+                                                   ragged_expert_matmul)
     from bigdl_tpu.ops.quant import quantize
 
     dev = v5e.devices[0]
@@ -178,7 +179,7 @@ def test_moe_ragged_compiles(v5e, aot_flags, qtype):
         w = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct((e,) + a.shape, a.dtype), one)
     x = jax.ShapeDtypeStruct((toks, k), jnp.bfloat16)
-    te = jax.ShapeDtypeStruct((toks // 16,), jnp.int32)
+    te = jax.ShapeDtypeStruct((toks // TOKEN_TILE,), jnp.int32)
     comp = _compile(lambda xx, ww, tt: ragged_expert_matmul(xx, ww, tt),
                     _sds(x, dev), _sds(w, dev), _sds(te, dev))
     assert _has_mosaic_call(comp)

@@ -69,9 +69,11 @@ def checkpoint_tensors(rng):
 
 
 def glm_positions(tokens_row):
-    """(seq_row, block_row) per the published get_position_ids."""
+    """(seq_row, block_row) per the published get_position_ids:
+    context_length = seq.index(bos_token_id) — bos itself sits in the
+    generation span (block row 1, causal)."""
     toks = list(tokens_row)
-    ctx = toks.index(BOS) + 1 if BOS in toks else len(toks)
+    ctx = toks.index(BOS) if BOS in toks else len(toks)
     mask_pos = (toks.index(GMASK) if GMASK in toks
                 else (toks.index(MASK) if MASK in toks else ctx - 1))
     seq_row = [j if j < ctx else mask_pos for j in range(len(toks))]
@@ -156,7 +158,7 @@ def test_prefill_matches_torch():
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-2,
                                atol=2e-3)
     # prefill derived the GLM anchors from the tokens
-    assert int(cache2.ctx_len[0]) == 6        # bos index 5 + 1
+    assert int(cache2.ctx_len[0]) == 5        # bos index (upstream conv.)
     assert int(cache2.mask_pos[0]) == 3       # gmask position
 
 

@@ -21,6 +21,14 @@ import time
 
 STEP_TIMEOUT = int(os.environ.get("ONCHIP_STEP_TIMEOUT", "600"))
 
+if os.environ.get("ONCHIP_FORCE_CPU"):
+    # smoke-testing the suite itself without a chip: the ambient axon
+    # plugin prepends itself to jax_platforms regardless of JAX_PLATFORMS,
+    # so only the config API reliably forces CPU
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 # ---------------------------------------------------------------- steps
 
 
@@ -255,24 +263,25 @@ def step_moe():
 
 
 def step_model_forward():
-    # tiny llama end-to-end on-chip: prefill + decode step latency
+    # tiny llama end-to-end on-chip: prefill + one decode step
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from bigdl_tpu.models import llama as llama_mod
-    from bigdl_tpu.models.families import llama_config
-    from bigdl_tpu.utils.testing import tiny_llama_params
+    from bigdl_tpu.utils.testing import TINY_LLAMA, random_llama_params
 
-    cfg, params = tiny_llama_params(qtype="sym_int4")
+    cfg = TINY_LLAMA
+    params = random_llama_params(cfg, qtype="sym_int4")
     ids = jnp.ones((1, 128), jnp.int32)
-    cache = llama_mod.init_cache(cfg, batch=1, max_seq=512)
-    fwd = jax.jit(lambda p, i, c: llama_mod.forward(cfg, p, i, c, 0))
+    cache = llama_mod.new_cache(cfg, 1, 256)
+    fwd = jax.jit(lambda p, i, c: llama_mod.forward(p, cfg, i, c))
     logits, cache = fwd(params, ids, cache)
-    np.asarray(logits)
-    return {"prefill_ok": True,
-            "logits_finite": bool(np.isfinite(np.asarray(
-                logits, np.float32)).all())}
+    pre_ok = bool(np.isfinite(np.asarray(logits, np.float32)).all())
+    tok = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+    logits2, cache = fwd(params, tok, cache)
+    dec_ok = bool(np.isfinite(np.asarray(logits2, np.float32)).all())
+    return {"prefill_logits_finite": pre_ok, "decode_logits_finite": dec_ok}
 
 
 STEPS = {
@@ -283,6 +292,7 @@ STEPS = {
     "decode_attention": step_decode_attention,
     "prefill_attention": step_prefill_attention,
     "moe": step_moe,
+    "model_forward": step_model_forward,
 }
 
 

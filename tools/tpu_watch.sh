@@ -3,7 +3,7 @@
 # when it answers, run the on-chip kernel validation + bench and record
 # artifacts, then keep watching (the tunnel flaps — grab numbers while
 # it's up). Results land in tpu_runs/ with timestamps.
-cd /root/repo
+cd "$(dirname "$0")/.." || exit 1
 mkdir -p tpu_runs
 while true; do
   ts=$(date +%Y%m%d_%H%M%S)
