@@ -32,6 +32,63 @@ class GenerationConfig:
     do_sample: bool = False
     eos_token_id: Optional[int] = None
     seed: int = 0
+    # llama.cpp-style repetition penalty (reference native sampler,
+    # ggml/model/llama/llama.py:566-620): logits of already-seen tokens
+    # divide (if >0) / multiply (if <0) by this. 1.0 = off.
+    repetition_penalty: float = 1.0
+    # OpenAI-style count penalties (reference vllm/sampling_params.py):
+    # logit -= count * frequency_penalty + (count > 0) * presence_penalty
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+
+    @property
+    def needs_token_counts(self) -> bool:
+        return (self.repetition_penalty != 1.0
+                or self.presence_penalty != 0.0
+                or self.frequency_penalty != 0.0)
+
+
+def token_counts(tokens: jax.Array, vocab_size: int,
+                 length: Optional[jax.Array] = None) -> jax.Array:
+    """Per-row token occurrence counts [B, V] int32 for tokens [B, S].
+
+    `length` ([B] or scalar) masks right padding: positions >= length do
+    not count. The counts tensor is the jit-compatible stand-in for the
+    reference sampler's `last_n_tokens` python list scan
+    (ggml/model/llama/llama.py:566-620) — static shape, scatter-add
+    updates, lives in the decode carry.
+    """
+    b, s = tokens.shape
+    if length is None:
+        add = jnp.ones((b, s), jnp.int32)
+    else:
+        idx = jnp.arange(s, dtype=jnp.int32)
+        add = (idx[None, :] < jnp.broadcast_to(
+            jnp.asarray(length, jnp.int32).reshape(-1, 1),
+            (b, 1))).astype(jnp.int32)
+    rows = jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[:, None], (b, s))
+    return jnp.zeros((b, vocab_size), jnp.int32).at[rows, tokens].add(add)
+
+
+def apply_penalties(
+    logits: jax.Array,            # [B, V] f32
+    counts: jax.Array,            # [B, V] int32 occurrence counts
+    repetition_penalty: float = 1.0,
+    presence_penalty: float = 0.0,
+    frequency_penalty: float = 0.0,
+) -> jax.Array:
+    """Repetition (llama.cpp form) + presence/frequency (OpenAI form)
+    penalties, pure gather-free tensor ops — safe inside jit/scan."""
+    if repetition_penalty != 1.0:
+        seen = counts > 0
+        penalized = jnp.where(logits > 0, logits / repetition_penalty,
+                              logits * repetition_penalty)
+        logits = jnp.where(seen, penalized, logits)
+    if presence_penalty != 0.0 or frequency_penalty != 0.0:
+        logits = (logits
+                  - counts.astype(logits.dtype) * frequency_penalty
+                  - (counts > 0).astype(logits.dtype) * presence_penalty)
+    return logits
 
 
 def filter_logits(logits: jax.Array, top_k: int = 0,
@@ -89,6 +146,9 @@ def generate_on_device(
     top_p: float = 1.0,
     eos_token_id: Optional[int] = None,
     seed: int = 0,
+    repetition_penalty: float = 1.0,
+    presence_penalty: float = 0.0,
+    frequency_penalty: float = 0.0,
 ) -> Tuple[jax.Array, KVCache]:
     """Whole-generation-on-device loop: prefill + `lax.scan` over decode
     steps inside ONE jittable function. No host sync per token — the
@@ -104,31 +164,48 @@ def generate_on_device(
             f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"cache max_seq {cache.max_seq}")
 
+    penal = (repetition_penalty != 1.0 or presence_penalty != 0.0
+             or frequency_penalty != 0.0)
+
     logits, cache = forward_fn(params, cfg, input_ids, cache)
     last = logits[:, -1, :]
     key = jax.random.PRNGKey(seed)
+    counts0 = (token_counts(input_ids, last.shape[-1]) if penal
+               else jnp.zeros((b, 1), jnp.int32))   # dummy when off
 
-    def pick(lg, k):
+    def pick(lg, k, counts):
+        if penal:
+            lg = apply_penalties(lg, counts, repetition_penalty,
+                                 presence_penalty, frequency_penalty)
         return sample_token(lg, k, temperature=temperature, top_k=top_k,
                             top_p=top_p)
 
+    def bump(counts, tok, done):
+        if not penal:
+            return counts
+        rows = jnp.arange(counts.shape[0], dtype=jnp.int32)
+        return counts.at[rows, tok].add((~done).astype(jnp.int32))
+
     key, sk = jax.random.split(key)
-    tok0 = pick(last, sk)
+    tok0 = pick(last, sk, counts0)
     done0 = (jnp.zeros((b,), jnp.bool_) if eos_token_id is None
              else tok0 == eos_token_id)
+    counts0 = bump(counts0, tok0, jnp.zeros((b,), jnp.bool_))
 
     def step(carry, _):
-        tok, done, cache, key = carry
+        tok, done, cache, key, counts = carry
         lg, cache = forward_fn(params, cfg, tok[:, None], cache)
         key, sk = jax.random.split(key)
-        nxt = pick(lg[:, -1, :], sk)
+        nxt = pick(lg[:, -1, :], sk, counts)
         nxt = jnp.where(done, 0, nxt)
+        counts = bump(counts, nxt, done)
         if eos_token_id is not None:
             done = done | (nxt == eos_token_id)
-        return (nxt, done, cache, key), nxt
+        return (nxt, done, cache, key, counts), nxt
 
-    (_, _, cache, _), rest = lax.scan(
-        step, (tok0, done0, cache, key), None, length=max_new_tokens - 1)
+    (_, _, cache, _, _), rest = lax.scan(
+        step, (tok0, done0, cache, key, counts0), None,
+        length=max_new_tokens - 1)
     out = jnp.concatenate([tok0[:, None], rest.T], axis=1)
     return out, cache
 
@@ -167,6 +244,20 @@ class Generator:
         self._prefill_vis = None
         self._sample = jax.jit(
             sample_token, static_argnames=("temperature", "top_k", "top_p"))
+
+        def sample_pen(lg, k, counts, *, temperature, top_k, top_p,
+                       rep, pres, freq):
+            lg = apply_penalties(lg, counts, rep, pres, freq)
+            tok = sample_token(lg, k, temperature=temperature, top_k=top_k,
+                               top_p=top_p)
+            rows = jnp.arange(counts.shape[0], dtype=jnp.int32)
+            counts = counts.at[rows, tok].add(1)
+            return tok, counts
+
+        self._sample_pen = jax.jit(
+            sample_pen, static_argnames=("temperature", "top_k", "top_p",
+                                         "rep", "pres", "freq"))
+        self._counts = jax.jit(token_counts, static_argnums=(1,))
 
     def _bucket(self, n: int) -> int:
         """Round prompt length up to a power-of-two bucket to bound the
@@ -259,9 +350,24 @@ class Generator:
 
         temp = gen.temperature if gen.do_sample else 0.0
 
+        penal = gen.needs_token_counts
+        if penal:
+            counts = self._counts(jnp.asarray(padded), logits.shape[-1],
+                                  jnp.full((b,), s, jnp.int32))
+
+        def sample(lg, k):
+            nonlocal counts
+            if penal:
+                t, counts = self._sample_pen(
+                    lg, k, counts, temperature=temp, top_k=gen.top_k,
+                    top_p=gen.top_p, rep=gen.repetition_penalty,
+                    pres=gen.presence_penalty, freq=gen.frequency_penalty)
+                return t
+            return self._sample(lg, k, temperature=temp, top_k=gen.top_k,
+                                top_p=gen.top_p)
+
         key, sk = jax.random.split(key)
-        tok = self._sample(logits[:, -1, :], sk, temperature=temp,
-                           top_k=gen.top_k, top_p=gen.top_p)
+        tok = sample(logits[:, -1, :], sk)
         tok_host = np.asarray(tok)
         if stats is not None:
             stats.first_token_s = time.perf_counter() - t0
@@ -280,8 +386,7 @@ class Generator:
             logits, cache = self._decode(
                 self.params, self.cfg, tok[:, None], cache)
             key, sk = jax.random.split(key)
-            tok = self._sample(logits[:, -1, :], sk, temperature=temp,
-                               top_k=gen.top_k, top_p=gen.top_p)
+            tok = sample(logits[:, -1, :], sk)
             if gen.eos_token_id is not None:
                 # post-EOS rows emit pad (0): parity with generate_on_device.
                 # Mask and track EOS on device; nothing is uploaded per step.

@@ -1,6 +1,7 @@
 from bigdl_tpu.serving.engine import (  # noqa: F401
     EngineConfig,
     LLMEngine,
+    LogprobEntry,
     Request,
     RequestOutput,
     SamplingParams,

@@ -99,29 +99,48 @@ class OpenAIServer:
         return self.tokenizer.decode(ids, skip_special_tokens=True)
 
     def _params(self, body: dict) -> SamplingParams:
+        lp = body.get("logprobs")
+        if lp is True:                      # chat-style boolean form
+            lp = int(body.get("top_logprobs", 0))
         return SamplingParams(
             max_tokens=int(body.get("max_tokens", 128)),
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
+            repetition_penalty=float(body.get("repetition_penalty", 1.0)),
+            presence_penalty=float(body.get("presence_penalty", 0.0)),
+            frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+            n=int(body.get("n", 1)),
+            best_of=(int(body["best_of"]) if body.get("best_of")
+                     else None),
+            logprobs=(int(lp) if lp is not None and lp is not False
+                      else None),
+            seed=(int(body["seed"]) if body.get("seed") is not None
+                  else None),
         )
 
     def _run_request(self, token_ids, params, stream_cb=None):
+        """Returns (rid, {index: ids}, {index: logprob entries},
+        {index: finish_reason}). stream_cb(new_ids, index) when set."""
         rid = f"cmpl-{uuid.uuid4().hex[:16]}"
         self.engine.add_request(rid, token_ids, params)
         self.loop.notify()
-        out_ids: List[int] = []
-        finish_reason = None
-        while finish_reason is None:
+        out_ids: dict = {}
+        out_lps: dict = {}
+        reasons: dict = {}
+        done = False
+        while not done:
             outs = self.engine.get_outputs(rid)
             if not outs:
                 time.sleep(0.002)
                 continue
             for o in outs:
-                out_ids.extend(o.new_token_ids)
+                out_ids.setdefault(o.index, []).extend(o.new_token_ids)
+                if o.logprobs:
+                    out_lps.setdefault(o.index, []).extend(o.logprobs)
                 if stream_cb is not None and o.new_token_ids:
                     try:
-                        stream_cb(o.new_token_ids)
+                        stream_cb(o.new_token_ids, o.index)
                     except OSError:
                         # client went away: free the slot, then keep
                         # draining until the engine emits the abort-finish
@@ -129,9 +148,19 @@ class OpenAIServer:
                         self.engine.abort_request(rid)
                         self.loop.notify()
                         stream_cb = None
+                if o.finish_reason is not None:
+                    reasons[o.index] = o.finish_reason
                 if o.finished:
-                    finish_reason = o.finish_reason or "stop"
-        return rid, out_ids, finish_reason
+                    reasons.setdefault(o.index, o.finish_reason or "stop")
+                    done = True
+        n_choices = max(params.n, 1)
+        for i in range(n_choices):
+            out_ids.setdefault(i, [])
+            reasons.setdefault(i, reasons.get(0, "stop"))
+        # the synthetic fan-out closer carries no tokens under its own
+        # index; drop any empty phantom choice beyond n
+        out_ids = {i: v for i, v in out_ids.items() if i < n_choices}
+        return rid, out_ids, out_lps, reasons
 
     # -- http ---------------------------------------------------------------
 
@@ -188,7 +217,7 @@ class OpenAIServer:
                     self.send_header("Cache-Control", "no-cache")
                     self.end_headers()
 
-                    def cb(new_ids):
+                    def cb(new_ids, index):
                         text = server._decode_text(new_ids)
                         delta = ({"role": "assistant", "content": text}
                                  if chat else None)
@@ -198,7 +227,7 @@ class OpenAIServer:
                                  else "text_completion"),
                             "created": created, "model": server.model_name,
                             "choices": [{
-                                "index": 0,
+                                "index": index,
                                 **({"delta": delta} if chat
                                    else {"text": text}),
                                 "finish_reason": None}],
@@ -207,30 +236,51 @@ class OpenAIServer:
                             b"data: " + json.dumps(chunk).encode() + b"\n\n")
                         self.wfile.flush()
 
-                    rid, out_ids, reason = server._run_request(
+                    rid, out_ids, out_lps, reasons = server._run_request(
                         ids, params, stream_cb=cb)
                     self.wfile.write(b"data: [DONE]\n\n")
                     self.wfile.flush()
                     return
 
-                rid, out_ids, reason = server._run_request(ids, params)
-                text = server._decode_text(out_ids)
-                choice = ({"index": 0, "message":
-                           {"role": "assistant", "content": text},
-                           "finish_reason": reason}
-                          if chat else
-                          {"index": 0, "text": text,
-                           "finish_reason": reason})
+                rid, out_ids, out_lps, reasons = server._run_request(
+                    ids, params)
+                choices = []
+                total_completion = 0
+                for idx in sorted(out_ids):
+                    toks = out_ids[idx]
+                    total_completion += len(toks)
+                    text = server._decode_text(toks)
+                    choice = ({"index": idx, "message":
+                               {"role": "assistant", "content": text},
+                               "finish_reason": reasons.get(idx, "stop")}
+                              if chat else
+                              {"index": idx, "text": text,
+                               "finish_reason": reasons.get(idx, "stop")})
+                    lps = out_lps.get(idx)
+                    if lps is not None and params.logprobs is not None:
+                        # OpenAI completions logprobs block (token-id keyed
+                        # when no tokenizer is attached)
+                        def tname(t):
+                            return (server._decode_text([t])
+                                    if server.tokenizer else str(t))
+                        choice["logprobs"] = {
+                            "tokens": [tname(e.token_id) for e in lps],
+                            "token_logprobs": [e.logprob for e in lps],
+                            "top_logprobs": [
+                                {tname(t): lp for t, lp in e.top}
+                                for e in lps],
+                        }
+                    choices.append(choice)
                 self._json(200, {
                     "id": rid,
                     "object": "chat.completion" if chat else "text_completion",
                     "created": created,
                     "model": server.model_name,
-                    "choices": [choice],
+                    "choices": choices,
                     "usage": {
                         "prompt_tokens": len(ids),
-                        "completion_tokens": len(out_ids),
-                        "total_tokens": len(ids) + len(out_ids)},
+                        "completion_tokens": total_completion,
+                        "total_tokens": len(ids) + total_completion},
                 })
 
         return Handler

@@ -27,9 +27,9 @@ fully static:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
-import queue
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -43,13 +43,34 @@ from bigdl_tpu.ops.kvcache import KVCache, init_cache
 
 @dataclasses.dataclass
 class SamplingParams:
-    """Per-request sampling (reference vllm/sampling_params.py surface)."""
+    """Per-request sampling (reference vllm/sampling_params.py surface:
+    temperature/top_k/top_p/penalties/n/best_of/logprobs/stop)."""
     max_tokens: int = 128
     temperature: float = 0.0       # 0 = greedy
     top_k: int = 0
     top_p: float = 1.0
     stop_token_ids: Tuple[int, ...] = ()
     ignore_eos: bool = False
+    # llama.cpp-form repetition penalty + OpenAI-form count penalties
+    # (see bigdl_tpu.generation.apply_penalties). 1.0 / 0.0 = off.
+    repetition_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    # parallel sampling: generate best_of sequences, return the n best by
+    # mean logprob (best_of defaults to n). n>1 streams with choice
+    # indices; best_of>n buffers until all candidates finish.
+    n: int = 1
+    best_of: Optional[int] = None
+    # per-token logprobs: 0 = chosen token only, k>0 = also top-k
+    # alternatives per step. None = off.
+    logprobs: Optional[int] = None
+    seed: Optional[int] = None
+
+    @property
+    def needs_counts(self) -> bool:
+        return (self.repetition_penalty != 1.0
+                or self.presence_penalty != 0.0
+                or self.frequency_penalty != 0.0)
 
 
 @dataclasses.dataclass
@@ -58,6 +79,19 @@ class Request:
     prompt_token_ids: List[int]
     params: SamplingParams
     arrival: float = dataclasses.field(default_factory=time.time)
+    # preempt-resume: tokens already generated (and streamed) before this
+    # (re-)admission; they are part of prompt_token_ids now and must count
+    # against max_tokens without being re-emitted
+    generated_offset: int = 0
+    resumed_cum_logprob: float = 0.0
+
+
+@dataclasses.dataclass
+class LogprobEntry:
+    """One emitted token's logprob record."""
+    token_id: int
+    logprob: float
+    top: List[Tuple[int, float]] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -66,6 +100,8 @@ class RequestOutput:
     new_token_ids: List[int]
     finished: bool
     finish_reason: Optional[str] = None
+    index: int = 0                    # choice index (n>1 fan-out)
+    logprobs: Optional[List[LogprobEntry]] = None
 
 
 @dataclasses.dataclass
@@ -80,6 +116,14 @@ class EngineConfig:
     # (the reference engine runs the whole prefill inline and freezes
     # every stream, llm_engine.py:543 + scheduler.py:93)
     prefill_chunk: int = 256
+    # starvation guard (the reference scheduler's preemption-by-recompute,
+    # vllm/core/scheduler.py:52-66): when requests have been waiting this
+    # many consecutive steps with every slot busy, the LATEST-arrived
+    # running sequence is evicted to the BACK of the queue — its tokens
+    # so far become prompt, recomputed on readmission (the prompt-prefix
+    # cache makes that cheap when enabled), while the starved requests
+    # admit into the freed slot first. 0 disables.
+    preempt_after_steps: int = 64
     # prompt-prefix KV reuse (the reference gen-1 pipeline's LlamaCache/
     # LlamaState, ggml/model/llama/llama.py:63,109-121,1346-1373): after
     # each admission the prompt's KV snapshot is kept on HOST; a later
@@ -95,13 +139,36 @@ class EngineConfig:
 
 
 class _Slot:
-    __slots__ = ("req", "generated", "last_token", "active")
+    __slots__ = ("req", "generated", "last_token", "active", "counts",
+                 "rng", "cum_logprob", "n_logprobs")
 
     def __init__(self):
         self.req: Optional[Request] = None
         self.generated: List[int] = []
         self.last_token: int = 0
         self.active: bool = False
+        self.counts: Optional[np.ndarray] = None   # [V] int32 (penalties)
+        self.rng: Optional[np.random.Generator] = None
+        self.cum_logprob: float = 0.0              # over generated tokens
+        self.n_logprobs: int = 0
+
+
+@dataclasses.dataclass
+class _Fanout:
+    """Parent bookkeeping for n/best_of parallel sampling: child requests
+    `rid#i` run as independent sequences; outputs route back under the
+    parent id with choice indices (the reference scheduler forks
+    SequenceGroups for the same purpose)."""
+    parent_id: str
+    n: int
+    best_of: int
+    # best_of > n: buffer each child's stream until all finish, then emit
+    # the n best (by mean logprob); n == best_of streams through directly
+    buffered: Dict[int, List["RequestOutput"]] = dataclasses.field(
+        default_factory=dict)
+    scores: Dict[int, float] = dataclasses.field(default_factory=dict)
+    lengths: Dict[int, int] = dataclasses.field(default_factory=dict)
+    done: int = 0
 
 
 @dataclasses.dataclass
@@ -153,10 +220,15 @@ class LLMEngine:
             quantized=ce.kv_quantized, per_slot_pos=True)
 
         self.slots = [_Slot() for _ in range(B)]
-        self.waiting: "queue.Queue[Request]" = queue.Queue()
+        # deque (admission pops the front; preemption appends the back)
+        self.waiting: "collections.deque[Request]" = collections.deque()
         self._outputs: Dict[str, List[RequestOutput]] = {}
         self._abort: set = set()
         self._lock = threading.Lock()
+        # n/best_of fan-out: child request id -> (parent id, choice index)
+        self._children: Dict[str, Tuple[str, int]] = {}
+        self._fanouts: Dict[str, _Fanout] = {}
+        self._stall_steps = 0       # consecutive steps with starved queue
 
         fwd = self.family.forward
 
@@ -214,17 +286,38 @@ class LLMEngine:
                 f"{self.cfg_engine.max_seq}")
         if not ids:
             raise ValueError("empty prompt")
-        self.waiting.put(Request(request_id, ids, params))
+        best_of = params.best_of or params.n
+        if best_of < params.n:
+            raise ValueError(f"best_of ({best_of}) < n ({params.n})")
         with self._lock:
             self._outputs[request_id] = []
+        if best_of > 1:
+            # fan out into independent child sequences; ranking needs
+            # per-token logprobs, so force their computation on children
+            self._fanouts[request_id] = _Fanout(request_id, params.n,
+                                                best_of)
+            for i in range(best_of):
+                cid = f"{request_id}#{i}"
+                cparams = dataclasses.replace(
+                    params, n=1, best_of=None,
+                    seed=None if params.seed is None else params.seed + i)
+                self._children[cid] = (request_id, i)
+                self.waiting.append(Request(cid, list(ids), cparams))
+            return
+        self.waiting.append(Request(request_id, ids, params))
 
     def abort_request(self, request_id: str) -> None:
         """Reference api_server behavior on client disconnect
         (vllm/entrypoints/openai/api_server.py:371)."""
+        fo = self._fanouts.get(request_id)
+        if fo is not None:
+            for i in range(fo.best_of):
+                self._abort.add(f"{request_id}#{i}")
+            return
         self._abort.add(request_id)
 
     def has_unfinished(self) -> bool:
-        return (not self.waiting.empty() or self._admitting is not None
+        return (len(self.waiting) > 0 or self._admitting is not None
                 or any(s.active for s in self.slots))
 
     def get_outputs(self, request_id: str) -> List[RequestOutput]:
@@ -257,19 +350,17 @@ class LLMEngine:
             if free is None:
                 return
             req = None
-            while req is None and not self.waiting.empty():
+            while req is None and self.waiting:
                 try:
-                    cand = self.waiting.get_nowait()
-                except queue.Empty:
+                    cand = self.waiting.popleft()
+                except IndexError:
                     return
                 if cand.request_id in self._abort:
                     # aborted while still queued: the client is owed a
                     # finished output or its poll loop never ends
                     self._abort.discard(cand.request_id)
-                    with self._lock:
-                        self._outputs.setdefault(
-                            cand.request_id, []).append(RequestOutput(
-                                cand.request_id, [], True, "abort"))
+                    self._push_output(cand.request_id, RequestOutput(
+                        cand.request_id, [], True, "abort"))
                     cand = None
                 req = cand
             if req is None:
@@ -316,14 +407,15 @@ class LLMEngine:
             self._remember_prefix(a.req.prompt_token_ids, a.cache1)
             self.cache = self._insert(self.cache, a.cache1.k, a.cache1.v,
                                       a.slot_idx, plen)
-            first = self._sample_host(
-                np.asarray(logits)[0, plen - 1 - start], a.req.params)
             s = self.slots[a.slot_idx]
             s.req = a.req
+            self._setup_slot_sampler(s)
+            first, lp = self._sample_host(
+                np.asarray(logits)[0, plen - 1 - start], s)
             s.generated = [int(first)]
             s.last_token = int(first)
             s.active = True
-            self._emit(s)
+            self._emit(s, lp)
             self._check_done(a.slot_idx)
             self._admitting = None
 
@@ -397,51 +489,178 @@ class LLMEngine:
         self._prefix_cache.clear()
 
     def _finish_admission_abort(self, a: _Admission) -> None:
-        with self._lock:
-            self._outputs.setdefault(a.req.request_id, []).append(
-                RequestOutput(a.req.request_id, [], True, "abort"))
+        self._push_output(a.req.request_id, RequestOutput(
+            a.req.request_id, [], True, "abort"))
         self._admitting = None
 
-    @staticmethod
-    def _sample_host(logits: np.ndarray, p: SamplingParams) -> int:
+    def _setup_slot_sampler(self, s: _Slot) -> None:
+        """Per-request sampler state at admission: penalty counts over the
+        prompt, a seeded generator, and whether logprobs are tracked
+        (explicitly requested, or needed to rank best_of candidates)."""
+        p = s.req.params
+        # unseeded: one persistent stream. Seeded: the stream is re-derived
+        # PER TOKEN from (seed, absolute position) in _sample_host, so a
+        # preempt-resume replays identically to an uninterrupted run.
+        s.rng = np.random.default_rng() if p.seed is None else None
+        s.cum_logprob = s.req.resumed_cum_logprob
+        # rank scores are only consumed when best_of oversamples (> n);
+        # don't pay the per-token host log-softmax otherwise
+        link = self._children.get(s.req.request_id)
+        need_rank = False
+        if link is not None:
+            fo = self._fanouts.get(link[0])
+            need_rank = fo is not None and fo.best_of > fo.n
+        s.n_logprobs = (-1 if p.logprobs is None and not need_rank
+                        else (p.logprobs or 0))
+        if p.needs_counts:
+            s.counts = np.zeros((self.cfg.vocab_size,), np.int32)
+            np.add.at(s.counts, np.asarray(s.req.prompt_token_ids,
+                                           np.int64), 1)
+        else:
+            s.counts = None
+
+    def _sample_host(self, logits: np.ndarray, s: _Slot
+                     ) -> Tuple[int, Optional[LogprobEntry]]:
+        """Sample one token for a slot: penalties -> (logprobs) ->
+        temperature/top-k/top-p (the reference's BigDLSampler role plus the
+        native sampler's repeat-penalty, ggml/model/llama/llama.py:566-620).
+        """
+        p = s.req.params
+        lg = logits.astype(np.float64)
+        if s.counts is not None:
+            seen = s.counts > 0
+            if p.repetition_penalty != 1.0:
+                pen = np.where(lg > 0, lg / p.repetition_penalty,
+                               lg * p.repetition_penalty)
+                lg = np.where(seen, pen, lg)
+            if p.frequency_penalty != 0.0 or p.presence_penalty != 0.0:
+                lg = (lg - s.counts * p.frequency_penalty
+                      - seen * p.presence_penalty)
+
+        entry = None
+        if s.n_logprobs >= 0:
+            # distribution AFTER penalties, BEFORE temperature (the
+            # model's adjusted distribution; also the best_of rank score)
+            ls = lg - (np.max(lg) + np.log(
+                np.sum(np.exp(lg - np.max(lg)))))
         if p.temperature <= 0.0:
-            return int(np.argmax(logits))
-        lg = logits.astype(np.float64) / p.temperature
-        if p.top_k > 0:
-            kth = np.sort(lg)[-p.top_k]
-            lg = np.where(lg < kth, -np.inf, lg)
-        if p.top_p < 1.0:
-            order = np.argsort(lg)[::-1]
-            probs = np.exp(lg[order] - np.max(lg))
+            tok = int(np.argmax(lg))
+        else:
+            t = lg / p.temperature
+            if p.top_k > 0:
+                kth = np.sort(t)[-p.top_k]
+                t = np.where(t < kth, -np.inf, t)
+            if p.top_p < 1.0:
+                order = np.argsort(t)[::-1]
+                probs = np.exp(t[order] - np.max(t))
+                probs /= probs.sum()
+                cum = np.cumsum(probs)
+                cut = int(np.searchsorted(cum, p.top_p)) + 1
+                mask = np.full_like(t, -np.inf)
+                mask[order[:cut]] = t[order[:cut]]
+                t = mask
+            probs = np.exp(t - np.max(t[np.isfinite(t)]))
+            probs = np.where(np.isfinite(t), probs, 0.0)
             probs /= probs.sum()
-            cum = np.cumsum(probs)
-            cut = int(np.searchsorted(cum, p.top_p)) + 1
-            mask = np.full_like(lg, -np.inf)
-            mask[order[:cut]] = lg[order[:cut]]
-            lg = mask
-        probs = np.exp(lg - np.max(lg[np.isfinite(lg)]))
-        probs = np.where(np.isfinite(lg), probs, 0.0)
-        probs /= probs.sum()
-        return int(np.random.choice(len(probs), p=probs))
+            if s.rng is not None:
+                rng = s.rng
+            else:
+                # stateless seeded draw keyed by absolute token position
+                pos = s.req.generated_offset + len(s.generated)
+                rng = np.random.default_rng((p.seed, pos))
+            tok = int(rng.choice(len(probs), p=probs))
+
+        if s.n_logprobs >= 0:
+            s.cum_logprob += float(ls[tok])
+            top: List[Tuple[int, float]] = []
+            if s.n_logprobs > 0:
+                idx = np.argpartition(ls, -s.n_logprobs)[-s.n_logprobs:]
+                idx = idx[np.argsort(ls[idx])[::-1]]
+                top = [(int(i), float(ls[i])) for i in idx]
+            entry = LogprobEntry(tok, float(ls[tok]), top)
+        if s.counts is not None:
+            s.counts[tok] += 1
+        return tok, entry
+
+    def _push_output(self, rid: str, out: RequestOutput,
+                     score: Optional[float] = None,
+                     length: int = 0) -> None:
+        """Deliver an output, routing n/best_of children to their parent.
+
+        Streaming children (best_of == n) pass through with their choice
+        index; their per-choice finishes are demoted to finished=False (a
+        choice ending is not the request ending) and ONE synthetic
+        finished output closes the parent when the last child lands.
+        Oversampled children (best_of > n) buffer until all candidates
+        finish, then the n best by mean logprob are re-emitted as choices
+        0..n-1."""
+        link = self._children.get(rid)
+        if link is None:
+            with self._lock:
+                self._outputs.setdefault(rid, []).append(out)
+            return
+        pid, idx = link
+        fo = self._fanouts[pid]
+        out = dataclasses.replace(out, request_id=pid, index=idx)
+        stream = fo.best_of == fo.n
+        if out.finished:
+            fo.done += 1
+            fo.scores[idx] = score if score is not None else -np.inf
+            fo.lengths[idx] = length
+            if stream:
+                out = dataclasses.replace(out, finished=False)
+        if stream:
+            with self._lock:
+                self._outputs.setdefault(pid, []).append(out)
+        else:
+            fo.buffered.setdefault(idx, []).append(out)
+        if fo.done == fo.best_of:
+            self._finish_fanout(fo)
+
+    def _finish_fanout(self, fo: _Fanout) -> None:
+        outs: List[RequestOutput] = []
+        if fo.best_of > fo.n:
+            mean = {i: fo.scores[i] / max(fo.lengths.get(i, 1), 1)
+                    for i in fo.scores}
+            ranked = sorted(mean, key=lambda i: mean[i], reverse=True)
+            for new_idx, child_idx in enumerate(ranked[:fo.n]):
+                for o in fo.buffered.get(child_idx, []):
+                    # only the synthetic closer below finishes the parent
+                    outs.append(dataclasses.replace(
+                        o, index=new_idx, finished=False))
+        # the closer carries NO finish_reason: choice-level reasons were
+        # already delivered (demoted finishes), and a reason here would
+        # clobber choice 0's real one in aggregating clients
+        outs.append(RequestOutput(fo.parent_id, [], True, None))
+        with self._lock:
+            self._outputs.setdefault(fo.parent_id, []).extend(outs)
+        for i in range(fo.best_of):
+            self._children.pop(f"{fo.parent_id}#{i}", None)
+        self._fanouts.pop(fo.parent_id, None)
 
     def _finish(self, idx: int, reason: str) -> None:
         s = self.slots[idx]
         if s.req is None:
             return
-        with self._lock:
-            self._outputs.setdefault(s.req.request_id, []).append(
-                RequestOutput(s.req.request_id, [], True, reason))
+        gen_len = s.req.generated_offset + len(s.generated)
+        self._push_output(
+            s.req.request_id,
+            RequestOutput(s.req.request_id, [], True, reason),
+            score=s.cum_logprob, length=gen_len)
         s.req = None
         s.active = False
         s.generated = []
+        s.counts = None
         # reset the slot's position so the idle row stops deepening
         self.cache = KVCache(self.cache.k, self.cache.v,
                              self.cache.pos.at[idx].set(0))
 
-    def _emit(self, s: _Slot) -> None:
-        with self._lock:
-            self._outputs.setdefault(s.req.request_id, []).append(
-                RequestOutput(s.req.request_id, [s.last_token], False))
+    def _emit(self, s: _Slot, lp: Optional[LogprobEntry] = None) -> None:
+        want_lp = s.req.params.logprobs is not None and lp is not None
+        self._push_output(
+            s.req.request_id,
+            RequestOutput(s.req.request_id, [s.last_token], False,
+                          logprobs=[lp] if want_lp else None))
 
     def _check_done(self, idx: int) -> bool:
         s = self.slots[idx]
@@ -454,7 +673,7 @@ class LLMEngine:
         if tok in p.stop_token_ids:
             self._finish(idx, "stop")
             return True
-        if len(s.generated) >= p.max_tokens:
+        if s.req.generated_offset + len(s.generated) >= p.max_tokens:
             self._finish(idx, "length")
             return True
         plen = len(s.req.prompt_token_ids)
@@ -462,6 +681,33 @@ class LLMEngine:
             self._finish(idx, "length")
             return True
         return False
+
+    def _preempt(self) -> None:
+        """Starvation relief: evict the LATEST-arrived running sequence by
+        recompute (reference scheduler's PreemptionMode.RECOMPUTE,
+        vllm/core/scheduler.py:52-66). Its tokens so far become the prompt
+        of a resumed request appended at the BACK of the queue — starved
+        requests admit into the freed slot first (round-robin under
+        pressure), and the prompt-prefix cache (when enabled) makes the
+        recompute prefill cheap. Nothing already streamed is re-emitted."""
+        victim = max((i for i, s in enumerate(self.slots) if s.active),
+                     key=lambda i: self.slots[i].req.arrival, default=None)
+        if victim is None:
+            return
+        s = self.slots[victim]
+        req = s.req
+        resumed = dataclasses.replace(
+            req,
+            prompt_token_ids=list(req.prompt_token_ids) + list(s.generated),
+            generated_offset=req.generated_offset + len(s.generated),
+            resumed_cum_logprob=s.cum_logprob)
+        s.req = None
+        s.active = False
+        s.generated = []
+        s.counts = None
+        self.cache = KVCache(self.cache.k, self.cache.v,
+                             self.cache.pos.at[victim].set(0))
+        self.waiting.append(resumed)
 
     def step(self) -> bool:
         """One engine iteration (reference LLMEngine.step): advance the
@@ -472,6 +718,19 @@ class LLMEngine:
             if s.active and s.req.request_id in self._abort:
                 self._abort.discard(s.req.request_id)
                 self._finish(i, "abort")
+
+        # starvation guard: requests queued while every slot grinds a
+        # long generation eventually preempt the newest running sequence
+        ce = self.cfg_engine
+        if (ce.preempt_after_steps > 0 and self.waiting
+                and self._admitting is None
+                and all(s.active for s in self.slots)):
+            self._stall_steps += 1
+            if self._stall_steps >= ce.preempt_after_steps:
+                self._preempt()
+                self._stall_steps = 0
+        else:
+            self._stall_steps = 0
 
         # admission: at most ONE prefill chunk per step — a long prompt
         # admits across several steps while decodes keep flowing
@@ -490,10 +749,10 @@ class LLMEngine:
 
         for i in active:
             s = self.slots[i]
-            tok = self._sample_host(logits[i], s.req.params)
+            tok, lp = self._sample_host(logits[i], s)
             s.last_token = tok
             s.generated.append(tok)
-            self._emit(s)
+            self._emit(s, lp)
             self._check_done(i)
         return True
 
