@@ -224,6 +224,14 @@ def write_report(rows, out_path: str, meta: Dict) -> None:
 
 
 def main(argv=None):
+    # a CPU request in the env must be authoritative: the ambient TPU
+    # plugin prepends itself to jax_platforms regardless of the env var,
+    # and a wedged tunnel then hangs backend init (same guard as
+    # __graft_entry__.py)
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=800)
     ap.add_argument("--batch", type=int, default=8)

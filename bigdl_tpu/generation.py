@@ -72,22 +72,26 @@ def token_counts(tokens: jax.Array, vocab_size: int,
 
 def apply_penalties(
     logits: jax.Array,            # [B, V] f32
-    counts: jax.Array,            # [B, V] int32 occurrence counts
+    rep_counts: jax.Array,        # [B, V] int32: prompt + output counts
+    out_counts: jax.Array,        # [B, V] int32: OUTPUT-only counts
     repetition_penalty: float = 1.0,
     presence_penalty: float = 0.0,
     frequency_penalty: float = 0.0,
 ) -> jax.Array:
-    """Repetition (llama.cpp form) + presence/frequency (OpenAI form)
-    penalties, pure gather-free tensor ops — safe inside jit/scan."""
+    """Repetition (llama.cpp form, over prompt + output) + presence/
+    frequency (OpenAI/vllm form, over OUTPUT tokens only — vllm applies
+    count penalties to generated tokens, not the prompt), pure
+    gather-free tensor ops — safe inside jit/scan."""
     if repetition_penalty != 1.0:
-        seen = counts > 0
+        seen = rep_counts > 0
         penalized = jnp.where(logits > 0, logits / repetition_penalty,
                               logits * repetition_penalty)
         logits = jnp.where(seen, penalized, logits)
     if presence_penalty != 0.0 or frequency_penalty != 0.0:
         logits = (logits
-                  - counts.astype(logits.dtype) * frequency_penalty
-                  - (counts > 0).astype(logits.dtype) * presence_penalty)
+                  - out_counts.astype(logits.dtype) * frequency_penalty
+                  - (out_counts > 0).astype(logits.dtype)
+                  * presence_penalty)
     return logits
 
 
@@ -170,12 +174,17 @@ def generate_on_device(
     logits, cache = forward_fn(params, cfg, input_ids, cache)
     last = logits[:, -1, :]
     key = jax.random.PRNGKey(seed)
-    counts0 = (token_counts(input_ids, last.shape[-1]) if penal
-               else jnp.zeros((b, 1), jnp.int32))   # dummy when off
+    v = last.shape[-1]
+    # rep counts include the prompt; out counts are generation-only
+    # (vllm count-penalty semantics)
+    rep0 = (token_counts(input_ids, v) if penal
+            else jnp.zeros((b, 1), jnp.int32))      # dummy when off
+    out0 = (jnp.zeros((b, v), jnp.int32) if penal
+            else jnp.zeros((b, 1), jnp.int32))
 
-    def pick(lg, k, counts):
+    def pick(lg, k, rep, outc):
         if penal:
-            lg = apply_penalties(lg, counts, repetition_penalty,
+            lg = apply_penalties(lg, rep, outc, repetition_penalty,
                                  presence_penalty, frequency_penalty)
         return sample_token(lg, k, temperature=temperature, top_k=top_k,
                             top_p=top_p)
@@ -187,24 +196,27 @@ def generate_on_device(
         return counts.at[rows, tok].add((~done).astype(jnp.int32))
 
     key, sk = jax.random.split(key)
-    tok0 = pick(last, sk, counts0)
+    tok0 = pick(last, sk, rep0, out0)
     done0 = (jnp.zeros((b,), jnp.bool_) if eos_token_id is None
              else tok0 == eos_token_id)
-    counts0 = bump(counts0, tok0, jnp.zeros((b,), jnp.bool_))
+    never = jnp.zeros((b,), jnp.bool_)
+    rep0 = bump(rep0, tok0, never)
+    out0 = bump(out0, tok0, never)
 
     def step(carry, _):
-        tok, done, cache, key, counts = carry
+        tok, done, cache, key, rep, outc = carry
         lg, cache = forward_fn(params, cfg, tok[:, None], cache)
         key, sk = jax.random.split(key)
-        nxt = pick(lg[:, -1, :], sk, counts)
+        nxt = pick(lg[:, -1, :], sk, rep, outc)
         nxt = jnp.where(done, 0, nxt)
-        counts = bump(counts, nxt, done)
+        rep = bump(rep, nxt, done)
+        outc = bump(outc, nxt, done)
         if eos_token_id is not None:
             done = done | (nxt == eos_token_id)
-        return (nxt, done, cache, key, counts), nxt
+        return (nxt, done, cache, key, rep, outc), nxt
 
-    (_, _, cache, _, _), rest = lax.scan(
-        step, (tok0, done0, cache, key, counts0), None,
+    (_, _, cache, _, _, _), rest = lax.scan(
+        step, (tok0, done0, cache, key, rep0, out0), None,
         length=max_new_tokens - 1)
     out = jnp.concatenate([tok0[:, None], rest.T], axis=1)
     return out, cache
@@ -245,14 +257,16 @@ class Generator:
         self._sample = jax.jit(
             sample_token, static_argnames=("temperature", "top_k", "top_p"))
 
-        def sample_pen(lg, k, counts, *, temperature, top_k, top_p,
-                       rep, pres, freq):
-            lg = apply_penalties(lg, counts, rep, pres, freq)
+        def sample_pen(lg, k, rep_counts, out_counts, *, temperature,
+                       top_k, top_p, rep, pres, freq):
+            lg = apply_penalties(lg, rep_counts, out_counts, rep, pres,
+                                 freq)
             tok = sample_token(lg, k, temperature=temperature, top_k=top_k,
                                top_p=top_p)
-            rows = jnp.arange(counts.shape[0], dtype=jnp.int32)
-            counts = counts.at[rows, tok].add(1)
-            return tok, counts
+            rows = jnp.arange(rep_counts.shape[0], dtype=jnp.int32)
+            rep_counts = rep_counts.at[rows, tok].add(1)
+            out_counts = out_counts.at[rows, tok].add(1)
+            return tok, rep_counts, out_counts
 
         self._sample_pen = jax.jit(
             sample_pen, static_argnames=("temperature", "top_k", "top_p",
@@ -352,15 +366,18 @@ class Generator:
 
         penal = gen.needs_token_counts
         if penal:
-            counts = self._counts(jnp.asarray(padded), logits.shape[-1],
+            v = logits.shape[-1]
+            counts = self._counts(jnp.asarray(padded), v,
                                   jnp.full((b,), s, jnp.int32))
+            out_counts = jnp.zeros((b, v), jnp.int32)
 
         def sample(lg, k):
-            nonlocal counts
+            nonlocal counts, out_counts
             if penal:
-                t, counts = self._sample_pen(
-                    lg, k, counts, temperature=temp, top_k=gen.top_k,
-                    top_p=gen.top_p, rep=gen.repetition_penalty,
+                t, counts, out_counts = self._sample_pen(
+                    lg, k, counts, out_counts, temperature=temp,
+                    top_k=gen.top_k, top_p=gen.top_p,
+                    rep=gen.repetition_penalty,
                     pres=gen.presence_penalty, freq=gen.frequency_penalty)
                 return t
             return self._sample(lg, k, temperature=temp, top_k=gen.top_k,

@@ -50,6 +50,8 @@ GGML_Q5_0, GGML_Q5_1 = 6, 7
 GGML_Q8_0 = 8
 GGML_Q2_K = 10
 GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 11, 12, 13, 14
+GGML_IQ2_XXS, GGML_IQ2_XS = 16, 17
+GGML_IQ1_S = 19
 GGML_BF16 = 30
 
 # (block size in values, bytes per block)
@@ -63,6 +65,10 @@ _BLOCK = {
     # community formats q3_K..q6_K — block_q*_K in ggml-quants.h)
     GGML_Q3_K: (256, 110), GGML_Q4_K: (256, 144),
     GGML_Q5_K: (256, 176), GGML_Q6_K: (256, 210),
+    # ultra-low-bit iq formats (dequantize-on-load; grid tables are
+    # pluggable constants — bigdl_tpu/ops/iq_grids.py)
+    GGML_IQ2_XXS: (256, 66), GGML_IQ2_XS: (256, 74),
+    GGML_IQ1_S: (256, 50),
 }
 
 _GGML_TO_QTYPE = {
@@ -199,6 +205,75 @@ def _decode_q2k(blk: np.ndarray):
     d = np.ascontiguousarray(blk[:, 80:82]).view(np.float16)[:, 0]
     dmin = np.ascontiguousarray(blk[:, 82:84]).view(np.float16)[:, 0]
     return codes, scales, d.astype(np.float32), dmin.astype(np.float32)
+
+
+def _decode_iq2_xxs(blk: np.ndarray) -> np.ndarray:
+    """block_iq2_xxs {d fp16, qs u16[32]} -> [nblk, 256] f32.
+
+    dequantize_row_iq2_xxs: per 32-value group, 4 bytes of grid indices
+    (qs[0..1]) + one u32 (qs[2..3]) holding 4x 7-bit sign indices and a
+    4-bit scale; db = d * (0.5 + scale) * 0.25. Grid table is the
+    pluggable iq2xxs_grid constant (ops/iq_grids.py)."""
+    from bigdl_tpu.ops.iq_grids import require_grid, signs_from_index
+
+    grid = require_grid("iq2xxs_grid")                     # [256, 8]
+    d = blk[:, 0:2].copy().view(np.float16).astype(np.float32)[:, 0]
+    q2 = np.ascontiguousarray(blk[:, 2:]).view(np.uint16).reshape(-1, 8, 4)
+    aux8 = np.ascontiguousarray(q2[:, :, :2]).view(np.uint8) \
+        .reshape(-1, 8, 4)                                 # grid indices
+    aux32 = (q2[:, :, 2].astype(np.uint32)
+             | (q2[:, :, 3].astype(np.uint32) << 16))      # [nblk, 8]
+    db = d[:, None] * (0.5 + (aux32 >> 28).astype(np.float32)) * 0.25
+    shifts = (np.arange(4, dtype=np.uint32) * 7)[None, None, :]
+    sidx = (aux32[:, :, None] >> shifts) & 127             # [nblk, 8, 4]
+    signs = signs_from_index(sidx)                         # [nblk, 8, 4, 8]
+    mags = grid[aux8]                                      # [nblk, 8, 4, 8]
+    vals = db[:, :, None, None] * mags * signs
+    return vals.reshape(-1, 256)
+
+
+def _decode_iq2_xs(blk: np.ndarray) -> np.ndarray:
+    """block_iq2_xs {d fp16, qs u16[32], scales u8[8]} -> [nblk, 256].
+
+    dequantize_row_iq2_xs: qs entry = 9-bit grid index | 7-bit sign
+    index << 9; scales nibble per 16 values, db = d*(0.5+s)*0.25."""
+    from bigdl_tpu.ops.iq_grids import require_grid, signs_from_index
+
+    grid = require_grid("iq2xs_grid")                      # [512, 8]
+    d = blk[:, 0:2].copy().view(np.float16).astype(np.float32)[:, 0]
+    qs = np.ascontiguousarray(blk[:, 2:66]).view(np.uint16) \
+        .reshape(-1, 8, 4)
+    scales = blk[:, 66:74]                                 # [nblk, 8]
+    db_lo = d[:, None] * (0.5 + (scales & 0x0F).astype(np.float32)) * 0.25
+    db_hi = d[:, None] * (0.5 + (scales >> 4).astype(np.float32)) * 0.25
+    # l = 0,1 use the low nibble scale; l = 2,3 the high one
+    db = np.stack([db_lo, db_lo, db_hi, db_hi], axis=2)    # [nblk, 8, 4]
+    mags = grid[qs & 511]                                  # [nblk, 8, 4, 8]
+    signs = signs_from_index(qs >> 9)
+    vals = db[..., None] * mags * signs
+    return vals.reshape(-1, 256)
+
+
+def _decode_iq1_s(blk: np.ndarray) -> np.ndarray:
+    """block_iq1_s {d fp16, qs u8[32], qh u16[8]} -> [nblk, 256].
+
+    dequantize_row_iq1_s: 11-bit grid index = qs[l] | ((qh >> 3l) & 7)
+    << 8 into the ternary iq1s_grid; dl = d * (2*((qh>>12)&7) + 1);
+    every value shifted by +-IQ1S_DELTA = 0.125 per qh bit 15."""
+    from bigdl_tpu.ops.iq_grids import require_grid
+
+    grid = require_grid("iq1s_grid")                       # [2048, 8]
+    d = blk[:, 0:2].copy().view(np.float16).astype(np.float32)[:, 0]
+    qs = blk[:, 2:34].reshape(-1, 8, 4)                    # [nblk, 8, 4]
+    qh = np.ascontiguousarray(blk[:, 34:50]).view(np.uint16)  # [nblk, 8]
+    dl = d[:, None] * (2.0 * ((qh >> 12) & 7).astype(np.float32) + 1.0)
+    delta = np.where((qh & 0x8000) != 0, -0.125, 0.125).astype(np.float32)
+    shifts = (np.arange(4, dtype=np.uint16) * 3)[None, None, :]
+    hi3 = ((qh[:, :, None] >> shifts) & 7).astype(np.int32)
+    idx = qs.astype(np.int32) | (hi3 << 8)                 # [nblk, 8, 4]
+    g = grid[idx]                                          # [nblk, 8, 4, 8]
+    vals = dl[:, :, None, None] * (g + delta[:, :, None, None])
+    return vals.reshape(-1, 256)
 
 
 def _read_str(f: BinaryIO) -> str:
@@ -378,6 +453,12 @@ class GGUFFile:
             return _decode_q5k(blk).reshape(shape).astype(dtype)
         if gt == GGML_Q6_K:
             return _decode_q6k(blk).reshape(shape).astype(dtype)
+        if gt == GGML_IQ2_XXS:
+            return _decode_iq2_xxs(blk).reshape(shape).astype(dtype)
+        if gt == GGML_IQ2_XS:
+            return _decode_iq2_xs(blk).reshape(shape).astype(dtype)
+        if gt == GGML_IQ1_S:
+            return _decode_iq1_s(blk).reshape(shape).astype(dtype)
         if gt in (GGML_Q5_0, GGML_Q5_1):
             hdr = 2 if gt == GGML_Q5_0 else 4
             qh = blk[:, hdr:hdr + 4].copy().view(np.uint32)[:, 0]

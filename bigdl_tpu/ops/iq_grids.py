@@ -1,0 +1,212 @@
+"""ggml IQ-format constant grids: loading, validation, and what's derivable.
+
+The reference accepts community GGUF checkpoints in ggml's IQ2_XXS /
+IQ2_XS / IQ1_S formats (qtype names at /root/reference/python/llm/src/
+ipex_llm/ggml/quantize.py:43-47; the kernels live in prebuilt binaries).
+Those formats quantize groups of 8 weights to an entry of a fixed
+magnitude grid plus signs. Everything about the formats EXCEPT the grids
+is closed-form and implemented bit-exactly in bigdl_tpu.gguf:
+
+- block layouts (66 / 74 / 50 bytes per 256 values),
+- the sign table: ksigns[i] = i | (parity(i) << 7) — the 8th sign bit is
+  the parity of the 7 stored ones (derived, tested),
+- scale packing: d * (0.5 + nibble) * 0.25 (iq2), d * (2*s+1) (iq1_s),
+- the IQ1_S delta (+-0.125 shift applied to every value in a group).
+
+The grids themselves — iq2xxs_grid[256], iq2xs_grid[512] (uint64, one
+byte per element, magnitudes in {8, 25, 43, 62}) and iq1s_grid[2048]
+(signed ternary) — are NOT derivable: they are the output of an offline
+clustering run over calibration data in upstream llama.cpp. The E8
+lattice constrains the CANDIDATE set (for iq2: 8 odd-half-integer
+coordinates with even k-sum -> 4^8/2 = 32768 valid patterns; see
+`e8_candidate_count`), but which 256/512/2048 of those made the table is
+calibration output, not mathematics. Full analysis in PARITY.md.
+
+So the grids are pluggable: point BIGDL_TPU_IQ_GRID_SOURCE at
+ - a llama.cpp checkout (or its `ggml-common.h`): the tables are parsed
+   straight out of the source, or
+ - an .npz with arrays iq2xxs_grid/iq2xs_grid/iq1s_grid.
+`save_grids_npz` re-exports parsed tables for dependency-free reuse.
+Without a source, importing an IQ GGUF raises with these instructions
+(a wrong grid would silently decode a different model — refusing is the
+only honest default).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from functools import lru_cache
+from typing import Dict, Optional
+
+import numpy as np
+
+ENV_VAR = "BIGDL_TPU_IQ_GRID_SOURCE"
+
+# expected table sizes (entries of 8 grouped values each)
+GRID_SPECS = {
+    "iq2xxs_grid": 256,
+    "iq2xs_grid": 512,
+    "iq1s_grid": 2048,
+}
+
+# iq2 grid bytes take one of these four magnitudes
+IQ2_MAGNITUDES = frozenset({8, 25, 43, 62})
+
+
+def ksigns() -> np.ndarray:
+    """ggml's ksigns_iq2xs[128], derived: low 7 bits = index, bit 7 =
+    parity of those bits (total sign popcount is always even)."""
+    i = np.arange(128, dtype=np.uint16)
+    par = i.copy()
+    par ^= par >> 4
+    par ^= par >> 2
+    par ^= par >> 1
+    return (i | ((par & 1) << 7)).astype(np.uint8)
+
+
+def signs_from_index(idx: np.ndarray) -> np.ndarray:
+    """[..., 8] array of +-1 from 7-bit sign indices (8th bit = parity)."""
+    full = ksigns()[np.asarray(idx, np.int64)]
+    bits = (full[..., None] >> np.arange(8, dtype=np.uint8)) & 1
+    return np.where(bits.astype(bool), -1.0, 1.0).astype(np.float32)
+
+
+def e8_candidate_count() -> int:
+    """Size of the E8-constrained candidate set the iq2 grids were chosen
+    from: 8 coordinates, each an odd half-integer (2k+1)/2 with k in
+    0..3, restricted to even sum(k) (the all-half-integer E8 coset).
+    4^8 / 2 — documented evidence the table is a strict, data-chosen
+    subset, not the whole lattice shell."""
+    return 4 ** 8 // 2
+
+
+# ------------------------------------------------------------------ loading
+
+# legacy form: `static const uint64_t iq2xxs_grid[256] = { ... };`
+_C_TABLE = re.compile(
+    r"(iq2xxs_grid|iq2xs_grid|iq1s_grid)\s*\[\s*\d*\s*\]\s*=\s*\{(.*?)\}",
+    re.DOTALL)
+# modern ggml-common.h form:
+# `GGML_TABLE_BEGIN(uint64_t, iq2xxs_grid, 256) ... GGML_TABLE_END()`
+_C_TABLE_MACRO = re.compile(
+    r"GGML_TABLE_BEGIN\s*\(\s*\w+\s*,\s*"
+    r"(iq2xxs_grid|iq2xs_grid|iq1s_grid)\s*,\s*\d+\s*\)"
+    r"(.*?)GGML_TABLE_END\s*\(\s*\)",
+    re.DOTALL)
+_HEX = re.compile(r"0x[0-9a-fA-F]+|\d+")
+
+
+def parse_c_tables(text: str) -> Dict[str, np.ndarray]:
+    """Extract the grid tables from llama.cpp C source (ggml-common.h,
+    both the GGML_TABLE_BEGIN macro form and the legacy `= { ... }`
+    form). Returns {name: uint64 [N]} for each table found with the
+    full expected entry count."""
+    out: Dict[str, np.ndarray] = {}
+    for pat in (_C_TABLE, _C_TABLE_MACRO):
+        for m in pat.finditer(text):
+            name, body = m.group(1), m.group(2)
+            vals = [int(tok, 0) for tok in _HEX.findall(body)]
+            if len(vals) == GRID_SPECS[name]:
+                out[name] = np.asarray(vals, np.uint64)
+    return out
+
+
+def _find_source_file(path: str) -> Optional[str]:
+    if os.path.isfile(path):
+        return path
+    if os.path.isdir(path):
+        for root, _dirs, files in os.walk(path):
+            for f in ("ggml-common.h", "ggml-quants.c"):
+                if f in files:
+                    return os.path.join(root, f)
+    return None
+
+
+def unpack_iq2_grid(packed: np.ndarray) -> np.ndarray:
+    """uint64 [N] -> float32 [N, 8] magnitudes (little-endian bytes)."""
+    b = np.asarray(packed, np.uint64)[:, None] >> (
+        np.arange(8, dtype=np.uint64) * np.uint64(8))
+    return (b & np.uint64(0xFF)).astype(np.float32)
+
+
+def unpack_iq1_grid(packed: np.ndarray) -> np.ndarray:
+    """uint64 [N] -> float32 [N, 8] in {-1, 0, +1}.
+
+    ggml packs iq1s_grid entries as 8 bytes of {0x00, 0x01, 0xff}
+    (int8 -1/0/+1)."""
+    b = np.asarray(packed, np.uint64)[:, None] >> (
+        np.arange(8, dtype=np.uint64) * np.uint64(8))
+    raw = (b & np.uint64(0xFF)).astype(np.uint8).astype(np.int8)
+    return raw.astype(np.float32)
+
+
+def validate_grids(grids: Dict[str, np.ndarray]) -> None:
+    for name, packed in grids.items():
+        n = GRID_SPECS[name]
+        if packed.shape != (n,):
+            raise ValueError(f"{name}: expected [{n}] uint64, "
+                             f"got {packed.shape}")
+        if name.startswith("iq2"):
+            mags = unpack_iq2_grid(packed)
+            bad = set(np.unique(mags).astype(int)) - set(IQ2_MAGNITUDES)
+            if bad:
+                raise ValueError(
+                    f"{name}: magnitudes {sorted(bad)} outside the ggml "
+                    f"set {sorted(IQ2_MAGNITUDES)} — not a ggml iq2 grid")
+        else:
+            vals = unpack_iq1_grid(packed)
+            bad = set(np.unique(vals).astype(int)) - {-1, 0, 1}
+            if bad:
+                raise ValueError(
+                    f"{name}: values {sorted(bad)} not ternary — not a "
+                    "ggml iq1s grid")
+
+
+@lru_cache(maxsize=1)
+def load_grids() -> Optional[Dict[str, np.ndarray]]:
+    """The ggml IQ grids from BIGDL_TPU_IQ_GRID_SOURCE, or None.
+
+    Accepts a .npz (arrays named per GRID_SPECS), a C source file, or a
+    directory to search (e.g. a llama.cpp checkout)."""
+    src = os.environ.get(ENV_VAR)
+    if not src:
+        return None
+    if src.endswith(".npz"):
+        with np.load(src) as z:
+            grids = {k: np.asarray(z[k], np.uint64) for k in z.files
+                     if k in GRID_SPECS}
+    else:
+        f = _find_source_file(src)
+        if f is None:
+            raise FileNotFoundError(
+                f"{ENV_VAR}={src!r}: no ggml-common.h/ggml-quants.c found")
+        with open(f, errors="replace") as fh:
+            grids = parse_c_tables(fh.read())
+    if not grids:
+        raise ValueError(f"{ENV_VAR}={src!r}: no IQ grid tables found")
+    validate_grids(grids)
+    return grids
+
+
+def save_grids_npz(path: str) -> None:
+    grids = load_grids()
+    if grids is None:
+        raise RuntimeError(f"set {ENV_VAR} first")
+    np.savez(path, **grids)
+
+
+def require_grid(name: str) -> np.ndarray:
+    """[N, 8] float32 decode table for one grid, or a clear error."""
+    grids = load_grids()
+    if grids is None or name not in grids:
+        raise RuntimeError(
+            f"importing this GGUF needs ggml's {name} constant table, "
+            "which is calibration output that cannot be derived offline "
+            f"(see bigdl_tpu/ops/iq_grids.py). Set {ENV_VAR} to a "
+            "llama.cpp checkout, its ggml-common.h, or an .npz dump; "
+            "save_grids_npz() can re-export it for reuse.")
+    packed = grids[name]
+    if name.startswith("iq2"):
+        return unpack_iq2_grid(packed)
+    return unpack_iq1_grid(packed)

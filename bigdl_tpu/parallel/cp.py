@@ -243,6 +243,117 @@ def _decode_fn(cfg, mesh, axis, compute_dtype):
         donate_argnums=(2, 3))
 
 
+def cp_empty_cache(cfg, batch: int, max_seq: int, mesh: Mesh,
+                   axis: str = "sp", compute_dtype=jnp.bfloat16):
+    """Zero sequence-sharded (ck, cv) caches for incremental CP prefill
+    (cp_prefill_chunk); max_seq % mesh size == 0."""
+    n = mesh.shape[axis]
+    if max_seq % n:
+        raise ValueError(f"max_seq {max_seq} not divisible by {n}")
+    shape = (cfg.num_hidden_layers, batch, max_seq,
+             cfg.num_key_value_heads, cfg.hd)
+    sh = NamedSharding(mesh, P(None, None, axis))
+    ck = jax.device_put(jnp.zeros(shape, compute_dtype), sh)
+    return ck, jax.device_put(jnp.zeros(shape, compute_dtype), sh)
+
+
+def cp_prefill_chunk(
+    params: Dict[str, Any],
+    cfg,
+    tokens: jax.Array,        # [B, C] int32 (pad tail with anything)
+    cache: Tuple[jax.Array, jax.Array],
+    p0: int,                  # global position of tokens[:, 0]
+    sel_pos: int,             # global position whose logits to return
+    mesh: Mesh,
+    axis: str = "sp",
+    compute_dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """Append one CONTIGUOUS chunk of prompt tokens to the sequence-
+    sharded cache in a single dispatch — the incremental form of
+    cp_prefill that a serving engine can interleave with decode steps
+    (chunked admission; one chunk per engine step). Each device writes
+    the chunk rows it owns (cyclic layout; out-of-capacity pad writes
+    drop), then C queries flash-merge over every local cache slice.
+    Returns (logits [B, V] replicated for `sel_pos`, updated cache)."""
+    _check_cfg(cfg)
+    fn = _extend_fn(cfg, mesh, axis, int(tokens.shape[1]), compute_dtype)
+    lg, ck, cv = fn(params, tokens, cache[0], cache[1],
+                    jnp.asarray(int(p0), jnp.int32),
+                    jnp.asarray(int(sel_pos), jnp.int32))
+    return lg, (ck, cv)
+
+
+@functools.lru_cache(maxsize=32)
+def _extend_fn(cfg, mesh, axis, c, compute_dtype):
+    n = mesh.shape[axis]
+    inv_freq, rope_mscale = M.model_rope_freqs(cfg)
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    g = h // hkv
+
+    def local(params, tok, ck, cv, p0, sel_pos):
+        p = lax.axis_index(axis)
+        cap = ck.shape[2]
+        positions = p0 + jnp.arange(c, dtype=jnp.int32)       # [C]
+        x = M.embed_prologue(params, cfg, tok, positions, compute_dtype)
+        cos, sin = rope_cos_sin(positions[None, :], inv_freq)
+        if rope_mscale != 1.0:
+            cos, sin = cos * rope_mscale, sin * rope_mscale
+
+        mine = (positions % n) == p
+        # out-of-range index -> scatter drops the write (pad tail rows
+        # past capacity, and rows owned by other devices)
+        lrow = jnp.where(mine, positions // n, cap)
+        gid = p + jnp.arange(cap, dtype=jnp.int32) * n
+
+        def step(carry, xs):
+            x = carry
+            lp, ck_l, cv_l = xs
+            stored = {}
+
+            def attn_fn(q, k, v):
+                k_new = ck_l.at[:, lrow].set(
+                    k.astype(ck_l.dtype), mode="drop")
+                v_new = cv_l.at[:, lrow].set(
+                    v.astype(cv_l.dtype), mode="drop")
+                stored["kv"] = (k_new, v_new)
+                qf = q.reshape(-1, c, hkv, g, hd).astype(jnp.bfloat16)
+                s_ = jnp.einsum("bqhgd,bkhd->bhgqk", qf,
+                                k_new.astype(jnp.bfloat16),
+                                preferred_element_type=jnp.float32) \
+                    * (hd ** -0.5)
+                valid = gid[None, :] <= positions[:, None]    # [C, cap]
+                s_ = jnp.where(valid[None, None, None], s_, -jnp.inf)
+                m_loc = jnp.max(s_, axis=-1)
+                m_g = lax.pmax(m_loc, axis)
+                pexp = jnp.where(jnp.isfinite(s_),
+                                 jnp.exp(s_ - m_g[..., None]), 0.0)
+                l_g = lax.psum(jnp.sum(pexp, axis=-1), axis)
+                o = jnp.einsum("bhgqk,bkhd->bhgqd",
+                               pexp.astype(jnp.bfloat16),
+                               v_new.astype(jnp.bfloat16),
+                               preferred_element_type=jnp.float32)
+                o = lax.psum(o, axis) / jnp.maximum(l_g, 1e-30)[..., None]
+                return jnp.moveaxis(o, 3, 1).reshape(
+                    q.shape[0], c, h * hd).astype(q.dtype)
+
+            out, _ = M.ext_attn_layer(x, lp, cfg, cos, sin, attn_fn)
+            return out, stored["kv"]
+
+        x, (ck2, cv2) = lax.scan(step, x, (params["layers"], ck, cv))
+        x = M._norm(x, params["norm"], params.get("norm_bias"), cfg)
+        row = jnp.clip(sel_pos - p0, 0, c - 1)
+        lg = M._lm_head(
+            lax.dynamic_slice_in_dim(x, row, 1, axis=1), params, cfg)[:, 0]
+        return lg, ck2, cv2
+
+    spec_cache = P(None, None, axis)
+    return jax.jit(_shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(), spec_cache, spec_cache, P(), P()),
+        out_specs=(P(), spec_cache, spec_cache), **_REP_KW),
+        donate_argnums=(2, 3))
+
+
 def cp_generate(
     params: Dict[str, Any],
     cfg,

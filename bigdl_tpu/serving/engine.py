@@ -124,6 +124,13 @@ class EngineConfig:
     # cache makes that cheap when enabled), while the starved requests
     # admit into the freed slot first. 0 disables.
     preempt_after_steps: int = 64
+    # context-parallel overflow lane: with a mesh passed to LLMEngine,
+    # prompts longer than max_seq are admitted anyway — their KV shards
+    # over the mesh (parallel/cp.py ring prefill + sequence-sharded
+    # decode) up to this many tokens (multiple of the mesh size). One CP
+    # request runs at a time, advancing one token per engine step
+    # alongside the batched slots. None disables.
+    cp_max_seq: Optional[int] = None
     # prompt-prefix KV reuse (the reference gen-1 pipeline's LlamaCache/
     # LlamaState, ggml/model/llama/llama.py:63,109-121,1346-1373): after
     # each admission the prompt's KV snapshot is kept on HOST; a later
@@ -140,17 +147,41 @@ class EngineConfig:
 
 class _Slot:
     __slots__ = ("req", "generated", "last_token", "active", "counts",
-                 "rng", "cum_logprob", "n_logprobs")
+                 "counts_out", "rng", "cum_logprob", "n_logprobs")
 
     def __init__(self):
         self.req: Optional[Request] = None
         self.generated: List[int] = []
         self.last_token: int = 0
         self.active: bool = False
-        self.counts: Optional[np.ndarray] = None   # [V] int32 (penalties)
+        # [V] int32 penalty counts: `counts` over prompt + output
+        # (repetition penalty), `counts_out` over output only
+        # (presence/frequency — vllm semantics)
+        self.counts: Optional[np.ndarray] = None
+        self.counts_out: Optional[np.ndarray] = None
         self.rng: Optional[np.random.Generator] = None
         self.cum_logprob: float = 0.0              # over generated tokens
         self.n_logprobs: int = 0
+
+
+@dataclasses.dataclass
+class _CPActive:
+    """The in-flight context-parallel request: a pseudo-slot carries its
+    sampler state; the KV cache lives sequence-sharded on the mesh."""
+    slot: _Slot
+    cache: Tuple[Any, Any]
+    pos: int                 # global position of the NEXT cache write
+    alloc: int               # sharded cache capacity (tokens)
+
+
+@dataclasses.dataclass
+class _CPAdmitting:
+    """A long prompt mid-chunked-CP-prefill: like _Admission, the engine
+    advances it ONE chunk per step so batched decodes keep flowing."""
+    req: Request
+    cache: Tuple[Any, Any]
+    consumed: int
+    alloc: int
 
 
 @dataclasses.dataclass
@@ -190,7 +221,8 @@ class LLMEngine:
     spirit to the reference engine loop (llm_engine.py:543).
     """
 
-    def __init__(self, model: Any, config: Optional[EngineConfig] = None):
+    def __init__(self, model: Any, config: Optional[EngineConfig] = None,
+                 cp_mesh: Any = None):
         self.cfg_engine = config or EngineConfig()
         self.params = model.params
         self.cfg = model.config
@@ -229,6 +261,23 @@ class LLMEngine:
         self._children: Dict[str, Tuple[str, int]] = {}
         self._fanouts: Dict[str, _Fanout] = {}
         self._stall_steps = 0       # consecutive steps with starved queue
+
+        # context-parallel overflow lane (long prompts)
+        self._cp_mesh = cp_mesh
+        self._cp_axis = cp_mesh.axis_names[0] if cp_mesh is not None \
+            else None
+        self._cp_waiting: "collections.deque[Request]" = collections.deque()
+        self._cp_active: Optional[_CPActive] = None
+        self._cp_admitting: Optional[_CPAdmitting] = None
+        if cp_mesh is not None and ce.cp_max_seq:
+            n_cp = cp_mesh.shape[self._cp_axis]
+            if ce.cp_max_seq % n_cp:
+                raise ValueError(f"cp_max_seq {ce.cp_max_seq} must be a "
+                                 f"multiple of the mesh size {n_cp}")
+            if "q_proj" not in (self.params.get("layers") or {}):
+                raise ValueError(
+                    "context-parallel serving needs the generalized "
+                    "llama-family parameter layout (layers/q_proj ...)")
 
         fwd = self.family.forward
 
@@ -280,17 +329,37 @@ class LLMEngine:
     def add_request(self, request_id: str, prompt_token_ids, params=None):
         params = params or SamplingParams()
         ids = list(prompt_token_ids)
-        if len(ids) + 1 > self.cfg_engine.max_seq:
+        long = len(ids) + 1 > self.cfg_engine.max_seq
+        cp_cap = (self.cfg_engine.cp_max_seq
+                  if self._cp_mesh is not None else None)
+        if long and (cp_cap is None or len(ids) + 1 > cp_cap):
             raise ValueError(
                 f"prompt length {len(ids)} exceeds engine max_seq "
-                f"{self.cfg_engine.max_seq}")
+                f"{self.cfg_engine.max_seq}"
+                + ("" if cp_cap is None else
+                   f" and cp_max_seq {cp_cap}"))
         if not ids:
             raise ValueError("empty prompt")
+        # validate CLIENT input here (HTTP clients send raw token ids):
+        # a bad id crashing inside step() would wedge the admission lane
+        # for every future request
+        v = self.cfg.vocab_size
+        if any(not isinstance(t, (int, np.integer)) or t < 0 or t >= v
+               for t in ids):
+            raise ValueError(f"prompt token ids must be ints in [0, {v})")
+        if params.logprobs is not None and not (
+                0 <= params.logprobs < v):
+            raise ValueError(f"logprobs must be in [0, {v})")
+        if params.n < 1:
+            raise ValueError("n must be >= 1")
+        if params.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
         best_of = params.best_of or params.n
         if best_of < params.n:
             raise ValueError(f"best_of ({best_of}) < n ({params.n})")
         with self._lock:
             self._outputs[request_id] = []
+        target = self._cp_waiting if long else self.waiting
         if best_of > 1:
             # fan out into independent child sequences; ranking needs
             # per-token logprobs, so force their computation on children
@@ -302,9 +371,9 @@ class LLMEngine:
                     params, n=1, best_of=None,
                     seed=None if params.seed is None else params.seed + i)
                 self._children[cid] = (request_id, i)
-                self.waiting.append(Request(cid, list(ids), cparams))
+                target.append(Request(cid, list(ids), cparams))
             return
-        self.waiting.append(Request(request_id, ids, params))
+        target.append(Request(request_id, ids, params))
 
     def abort_request(self, request_id: str) -> None:
         """Reference api_server behavior on client disconnect
@@ -312,13 +381,16 @@ class LLMEngine:
         fo = self._fanouts.get(request_id)
         if fo is not None:
             for i in range(fo.best_of):
-                self._abort.add(f"{request_id}#{i}")
+                if i not in fo.scores:       # skip finished children
+                    self._abort.add(f"{request_id}#{i}")
             return
         self._abort.add(request_id)
 
     def has_unfinished(self) -> bool:
         return (len(self.waiting) > 0 or self._admitting is not None
-                or any(s.active for s in self.slots))
+                or any(s.active for s in self.slots)
+                or len(self._cp_waiting) > 0 or self._cp_active is not None
+                or self._cp_admitting is not None)
 
     def get_outputs(self, request_id: str) -> List[RequestOutput]:
         with self._lock:
@@ -513,11 +585,19 @@ class LLMEngine:
         s.n_logprobs = (-1 if p.logprobs is None and not need_rank
                         else (p.logprobs or 0))
         if p.needs_counts:
-            s.counts = np.zeros((self.cfg.vocab_size,), np.int32)
+            v = self.cfg.vocab_size
+            s.counts = np.zeros((v,), np.int32)
             np.add.at(s.counts, np.asarray(s.req.prompt_token_ids,
                                            np.int64), 1)
+            s.counts_out = np.zeros((v,), np.int32)
+            if s.req.generated_offset:
+                # preempt-resume: the prompt tail IS earlier output
+                np.add.at(s.counts_out, np.asarray(
+                    s.req.prompt_token_ids[-s.req.generated_offset:],
+                    np.int64), 1)
         else:
             s.counts = None
+            s.counts_out = None
 
     def _sample_host(self, logits: np.ndarray, s: _Slot
                      ) -> Tuple[int, Optional[LogprobEntry]]:
@@ -528,14 +608,14 @@ class LLMEngine:
         p = s.req.params
         lg = logits.astype(np.float64)
         if s.counts is not None:
-            seen = s.counts > 0
             if p.repetition_penalty != 1.0:
                 pen = np.where(lg > 0, lg / p.repetition_penalty,
                                lg * p.repetition_penalty)
-                lg = np.where(seen, pen, lg)
+                lg = np.where(s.counts > 0, pen, lg)
             if p.frequency_penalty != 0.0 or p.presence_penalty != 0.0:
-                lg = (lg - s.counts * p.frequency_penalty
-                      - seen * p.presence_penalty)
+                # output-token counts only (vllm count-penalty semantics)
+                lg = (lg - s.counts_out * p.frequency_penalty
+                      - (s.counts_out > 0) * p.presence_penalty)
 
         entry = None
         if s.n_logprobs >= 0:
@@ -580,6 +660,7 @@ class LLMEngine:
             entry = LogprobEntry(tok, float(ls[tok]), top)
         if s.counts is not None:
             s.counts[tok] += 1
+            s.counts_out[tok] += 1
         return tok, entry
 
     def _push_output(self, rid: str, out: RequestOutput,
@@ -636,6 +717,7 @@ class LLMEngine:
             self._outputs.setdefault(fo.parent_id, []).extend(outs)
         for i in range(fo.best_of):
             self._children.pop(f"{fo.parent_id}#{i}", None)
+            self._abort.discard(f"{fo.parent_id}#{i}")   # no leaks
         self._fanouts.pop(fo.parent_id, None)
 
     def _finish(self, idx: int, reason: str) -> None:
@@ -651,6 +733,7 @@ class LLMEngine:
         s.active = False
         s.generated = []
         s.counts = None
+        s.counts_out = None
         # reset the slot's position so the idle row stops deepening
         self.cache = KVCache(self.cache.k, self.cache.v,
                              self.cache.pos.at[idx].set(0))
@@ -682,6 +765,115 @@ class LLMEngine:
             return True
         return False
 
+    # -- context-parallel overflow lane -------------------------------------
+
+    def _cp_finish(self, reason: str) -> None:
+        a = self._cp_active
+        s = a.slot
+        self._push_output(
+            s.req.request_id,
+            RequestOutput(s.req.request_id, [], True, reason),
+            score=s.cum_logprob,
+            length=s.req.generated_offset + len(s.generated))
+        self._cp_active = None
+
+    def _cp_check_done(self) -> None:
+        a = self._cp_active
+        s = a.slot
+        p = s.req.params
+        tok = s.last_token
+        if (not p.ignore_eos and self.eos_token_id is not None
+                and tok == self.eos_token_id):
+            return self._cp_finish("stop")
+        if tok in p.stop_token_ids:
+            return self._cp_finish("stop")
+        if s.req.generated_offset + len(s.generated) >= p.max_tokens:
+            return self._cp_finish("length")
+        if a.pos >= a.alloc:      # next token has no cache row left
+            return self._cp_finish("length")
+
+    def _cp_step(self) -> bool:
+        """Advance the context-parallel lane by at most one unit of work
+        per engine step — ONE prefill chunk (so a cp_max_seq-scale
+        admission never stalls the batched streams for more than a
+        chunk, the same contract as the slot lane's chunked admission)
+        or ONE decode token. Returns True if any CP work was done."""
+        import jax.numpy as jnp
+
+        from bigdl_tpu.parallel.cp import (cp_decode_step, cp_empty_cache,
+                                           cp_prefill_chunk)
+
+        a = self._cp_active
+        adm = self._cp_admitting
+        if a is None and adm is None:
+            while self._cp_waiting:
+                req = self._cp_waiting.popleft()
+                if req.request_id in self._abort:
+                    self._abort.discard(req.request_id)
+                    self._push_output(req.request_id, RequestOutput(
+                        req.request_id, [], True, "abort"))
+                    continue
+                break
+            else:
+                return False
+            n = self._cp_mesh.shape[self._cp_axis]
+            ids = req.prompt_token_ids
+            want = len(ids) + req.params.max_tokens + 1
+            alloc = min(-(-want // n) * n, self.cfg_engine.cp_max_seq)
+            cache = cp_empty_cache(self.cfg, 1, alloc, self._cp_mesh,
+                                   self._cp_axis)
+            adm = self._cp_admitting = _CPAdmitting(req, cache, 0, alloc)
+
+        if adm is not None:
+            if adm.req.request_id in self._abort:
+                self._abort.discard(adm.req.request_id)
+                self._push_output(adm.req.request_id, RequestOutput(
+                    adm.req.request_id, [], True, "abort"))
+                self._cp_admitting = None
+                return True
+            ids = adm.req.prompt_token_ids
+            plen = len(ids)
+            c = self._chunk
+            part = ids[adm.consumed:adm.consumed + c]
+            padded = np.zeros((1, c), np.int32)
+            padded[0, :len(part)] = part
+            lg, adm.cache = cp_prefill_chunk(
+                self.params, self.cfg, jnp.asarray(padded), adm.cache,
+                adm.consumed, min(plen - 1, adm.consumed + c - 1),
+                self._cp_mesh, self._cp_axis)
+            adm.consumed += len(part)
+            if adm.consumed < plen:
+                return True
+            slot = _Slot()
+            slot.req = adm.req
+            self._setup_slot_sampler(slot)
+            tok, lp = self._sample_host(np.asarray(lg)[0], slot)
+            slot.generated = [int(tok)]
+            slot.last_token = int(tok)
+            slot.active = True
+            self._cp_active = _CPActive(slot, adm.cache, plen, adm.alloc)
+            self._cp_admitting = None
+            self._emit(slot, lp)
+            self._cp_check_done()
+            return True
+
+        s = a.slot
+        if s.req.request_id in self._abort:
+            self._abort.discard(s.req.request_id)
+            self._cp_finish("abort")
+            return True
+        lg, a.cache = cp_decode_step(
+            self.params, self.cfg,
+            jnp.asarray([s.last_token], jnp.int32), a.cache, a.pos,
+            self._cp_mesh, self._cp_axis)
+        a.pos += 1
+        tok, lp = self._sample_host(np.asarray(lg)[0], s)
+        s.last_token = int(tok)
+        s.generated.append(int(tok))
+        self._emit(s, lp)
+        self._cp_check_done()
+        return True
+
     def _preempt(self) -> None:
         """Starvation relief: evict the LATEST-arrived running sequence by
         recompute (reference scheduler's PreemptionMode.RECOMPUTE,
@@ -705,6 +897,7 @@ class LLMEngine:
         s.active = False
         s.generated = []
         s.counts = None
+        s.counts_out = None
         self.cache = KVCache(self.cache.k, self.cache.v,
                              self.cache.pos.at[victim].set(0))
         self.waiting.append(resumed)
@@ -732,13 +925,18 @@ class LLMEngine:
         else:
             self._stall_steps = 0
 
+        # context-parallel lane: one token (or one admission) per step
+        cp_did = False
+        if self._cp_mesh is not None:
+            cp_did = self._cp_step()
+
         # admission: at most ONE prefill chunk per step — a long prompt
         # admits across several steps while decodes keep flowing
         self._admission_step()
 
         active = [i for i, s in enumerate(self.slots) if s.active]
         if not active:
-            return self._admitting is not None
+            return cp_did or self._admitting is not None
 
         tokens = np.zeros((self.cfg_engine.max_batch,), np.int32)
         for i in active:

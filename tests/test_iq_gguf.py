@@ -1,0 +1,206 @@
+"""ggml IQ2_XXS / IQ2_XS / IQ1_S GGUF import.
+
+The magnitude grids are calibration constants from upstream llama.cpp
+that cannot be derived offline (see bigdl_tpu/ops/iq_grids.py and
+PARITY.md); everything else about the formats is closed-form. These
+tests validate the closed-form parts exactly (ksigns by brute force),
+the grid plumbing (C-source parsing, npz round-trip, validation), and
+the block decoders against an independent straight-loop transcription
+of ggml's dequantize_row_iq2_xxs/iq2_xs/iq1_s — on synthetic grids with
+the real value set, since the true tables are not redistributable here.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import gguf as G
+from bigdl_tpu.ops import iq_grids as IQ
+
+
+def make_fake_grids(seed=0):
+    """Random-but-VALID grid tables (ggml magnitude/ternary value sets)."""
+    rng = np.random.default_rng(seed)
+
+    def pack(bytes_2d):
+        b = bytes_2d.astype(np.uint64)
+        return sum(b[:, j] << np.uint64(8 * j) for j in range(8))
+
+    mags = np.array([8, 25, 43, 62], np.uint64)
+    g2xxs = pack(mags[rng.integers(0, 4, (256, 8))])
+    g2xs = pack(mags[rng.integers(0, 4, (512, 8))])
+    tern = np.array([0x00, 0x01, 0xFF], np.uint64)   # 0, +1, -1 as int8
+    g1s = pack(tern[rng.integers(0, 3, (2048, 8))])
+    return {"iq2xxs_grid": g2xxs, "iq2xs_grid": g2xs, "iq1s_grid": g1s}
+
+
+@pytest.fixture()
+def fake_grid_env(tmp_path, monkeypatch):
+    grids = make_fake_grids()
+    path = tmp_path / "grids.npz"
+    np.savez(path, **grids)
+    monkeypatch.setenv(IQ.ENV_VAR, str(path))
+    IQ.load_grids.cache_clear()
+    yield grids
+    IQ.load_grids.cache_clear()
+
+
+def test_ksigns_matches_bruteforce():
+    """ksigns[i] = i with bit 7 = parity(i): total popcount always even."""
+    ks = IQ.ksigns()
+    for i in range(128):
+        assert ks[i] & 127 == i
+        assert bin(int(ks[i])).count("1") % 2 == 0
+
+
+def test_signs_from_index_values():
+    s = IQ.signs_from_index(np.asarray([0, 1, 127]))
+    assert s.shape == (3, 8)
+    np.testing.assert_array_equal(s[0], np.ones(8))       # no bits set
+    # index 1: bit0 set -> first value negative; parity bit -> 8th negative
+    assert s[1, 0] == -1.0 and s[1, 7] == -1.0
+    assert np.prod(s[2]) == 1.0                           # even # of -1s
+
+
+def test_parse_c_tables_and_validate(tmp_path):
+    grids = make_fake_grids(1)
+    # legacy `= { ... }` form AND the modern GGML_TABLE_BEGIN macro form
+    # (ggml-common.h since early 2024) in one file
+    c = "static const uint64_t iq2xxs_grid[256] = {\n"
+    c += ",\n".join(f"0x{v:016x}" for v in grids["iq2xxs_grid"]) + ",\n};\n"
+    c += "GGML_TABLE_BEGIN(uint64_t, iq1s_grid, 2048)\n    "
+    c += ", ".join(str(int(v)) for v in grids["iq1s_grid"])
+    c += ",\nGGML_TABLE_END()\n"
+    src = tmp_path / "ggml-common.h"
+    src.write_text(c)
+    parsed = IQ.parse_c_tables(src.read_text())
+    assert set(parsed) == {"iq2xxs_grid", "iq1s_grid"}
+    np.testing.assert_array_equal(parsed["iq1s_grid"], grids["iq1s_grid"])
+    np.testing.assert_array_equal(parsed["iq2xxs_grid"],
+                                  grids["iq2xxs_grid"])
+    IQ.validate_grids(parsed)
+
+    bad = {"iq2xxs_grid": np.full(256, 0x0707070707070707, np.uint64)}
+    with pytest.raises(ValueError, match="magnitudes"):
+        IQ.validate_grids(bad)
+
+
+def test_require_grid_without_source_errors(monkeypatch):
+    monkeypatch.delenv(IQ.ENV_VAR, raising=False)
+    IQ.load_grids.cache_clear()
+    with pytest.raises(RuntimeError, match="BIGDL_TPU_IQ_GRID_SOURCE"):
+        IQ.require_grid("iq2xxs_grid")
+    IQ.load_grids.cache_clear()
+
+
+# ------------------------------------------------------ loop references
+
+def ref_iq2_xxs(blk_bytes, grid_u64):
+    """Straight transcription of ggml dequantize_row_iq2_xxs."""
+    ks = IQ.ksigns()
+    d = np.frombuffer(blk_bytes[:2].tobytes(), np.float16)[0]
+    qs = np.frombuffer(blk_bytes[2:66].tobytes(), np.uint16)
+    y = np.zeros(256, np.float32)
+    for ib in range(8):
+        q2 = qs[4 * ib:4 * ib + 4]
+        aux8 = np.frombuffer(q2[:2].tobytes(), np.uint8)
+        aux32 = int(q2[2]) | (int(q2[3]) << 16)
+        db = float(d) * (0.5 + (aux32 >> 28)) * 0.25
+        for l in range(4):
+            gb = [(int(grid_u64[aux8[l]]) >> (8 * j)) & 0xFF
+                  for j in range(8)]
+            signs = int(ks[(aux32 >> (7 * l)) & 127])
+            for j in range(8):
+                sign = -1.0 if (signs >> j) & 1 else 1.0
+                y[32 * ib + 8 * l + j] = db * gb[j] * sign
+    return y
+
+
+def ref_iq2_xs(blk_bytes, grid_u64):
+    ks = IQ.ksigns()
+    d = np.frombuffer(blk_bytes[:2].tobytes(), np.float16)[0]
+    qs = np.frombuffer(blk_bytes[2:66].tobytes(), np.uint16)
+    scales = blk_bytes[66:74]
+    y = np.zeros(256, np.float32)
+    for ib in range(8):
+        db1 = float(d) * (0.5 + (scales[ib] & 0x0F)) * 0.25
+        db2 = float(d) * (0.5 + (scales[ib] >> 4)) * 0.25
+        for l in range(4):
+            e = int(qs[4 * ib + l])
+            gb = [(int(grid_u64[e & 511]) >> (8 * j)) & 0xFF
+                  for j in range(8)]
+            signs = int(ks[e >> 9])
+            db = db1 if l < 2 else db2
+            for j in range(8):
+                sign = -1.0 if (signs >> j) & 1 else 1.0
+                y[32 * ib + 8 * l + j] = db * gb[j] * sign
+    return y
+
+
+def ref_iq1_s(blk_bytes, grid_u64):
+    d = np.frombuffer(blk_bytes[:2].tobytes(), np.float16)[0]
+    qs = blk_bytes[2:34]
+    qh = np.frombuffer(blk_bytes[34:50].tobytes(), np.uint16)
+    y = np.zeros(256, np.float32)
+    for ib in range(8):
+        dl = float(d) * (2 * ((int(qh[ib]) >> 12) & 7) + 1)
+        delta = -0.125 if (int(qh[ib]) & 0x8000) else 0.125
+        for l in range(4):
+            idx = int(qs[4 * ib + l]) | (((int(qh[ib]) >> (3 * l)) & 7) << 8)
+            for j in range(8):
+                gv = (int(grid_u64[idx]) >> (8 * j)) & 0xFF
+                gv = gv - 256 if gv >= 128 else gv        # int8 view
+                y[32 * ib + 8 * l + j] = dl * (float(gv) + delta)
+    return y
+
+
+def rand_blocks(nblk, bpb, seed):
+    rng = np.random.default_rng(seed)
+    blk = rng.integers(0, 256, (nblk, bpb), dtype=np.uint8)
+    # sane fp16 d: overwrite first two bytes with a finite small half
+    d = np.float16(rng.uniform(0.01, 0.2, nblk)).view(np.uint8).reshape(
+        nblk, 2)
+    blk[:, :2] = d
+    return blk
+
+
+@pytest.mark.parametrize("name,gt,bpb,ref", [
+    ("iq2xxs_grid", G.GGML_IQ2_XXS, 66, ref_iq2_xxs),
+    ("iq2xs_grid", G.GGML_IQ2_XS, 74, ref_iq2_xs),
+    ("iq1s_grid", G.GGML_IQ1_S, 50, ref_iq1_s),
+])
+def test_decoder_matches_loop_reference(fake_grid_env, name, gt, bpb, ref):
+    blk = rand_blocks(5, bpb, seed=gt)
+    dec = {G.GGML_IQ2_XXS: G._decode_iq2_xxs,
+           G.GGML_IQ2_XS: G._decode_iq2_xs,
+           G.GGML_IQ1_S: G._decode_iq1_s}[gt]
+    got = dec(blk)
+    grid = fake_grid_env[name]
+    want = np.stack([ref(blk[i], grid) for i in range(blk.shape[0])])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_iq2_xxs_through_gguf_file(fake_grid_env, tmp_path):
+    """End-to-end: raw iq2_xxs payload in a GGUF -> load_dense."""
+    blk = rand_blocks(4, 66, seed=7)          # 2 rows x 2 blocks = [2, 512]
+    path = str(tmp_path / "iq.gguf")
+    G.write_gguf(path, {"general.architecture": "llama"},
+                 {"w": (blk.reshape(-1), G.GGML_IQ2_XXS, (2, 512))})
+    f = G.GGUFFile(path)
+    got = f.load_dense("w")
+    want = np.stack([ref_iq2_xxs(blk[i], fake_grid_env["iq2xxs_grid"])
+                     for i in range(4)]).reshape(2, 512)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_iq_gguf_without_grids_raises_clear_error(tmp_path, monkeypatch):
+    blk = rand_blocks(2, 66, seed=9)
+    path = str(tmp_path / "iq2.gguf")
+    G.write_gguf(path, {"general.architecture": "llama"},
+                 {"w": (blk.reshape(-1), G.GGML_IQ2_XXS, (1, 512))})
+    monkeypatch.delenv(IQ.ENV_VAR, raising=False)
+    IQ.load_grids.cache_clear()
+    with pytest.raises(RuntimeError, match="llama.cpp"):
+        G.GGUFFile(path).load_dense("w")
+    IQ.load_grids.cache_clear()

@@ -19,14 +19,18 @@ from bigdl_tpu.utils.testing import TINY_LLAMA, random_llama_params
 
 def test_apply_penalties_math():
     logits = jnp.asarray([[2.0, -2.0, 1.0, 0.5]])
-    counts = jnp.asarray([[1, 2, 0, 0]], jnp.int32)
-    # repetition: seen positive /2, seen negative *2, unseen unchanged
-    out = np.asarray(apply_penalties(logits, counts, repetition_penalty=2.0))
+    rep_counts = jnp.asarray([[1, 2, 0, 0]], jnp.int32)   # prompt+output
+    out_counts = jnp.asarray([[0, 2, 1, 0]], jnp.int32)   # output only
+    # repetition (prompt+output): seen positive /2, seen negative *2
+    out = np.asarray(apply_penalties(logits, rep_counts, out_counts,
+                                     repetition_penalty=2.0))
     np.testing.assert_allclose(out, [[1.0, -4.0, 1.0, 0.5]])
-    # frequency/presence: -= count*freq + seen*pres
-    out = np.asarray(apply_penalties(logits, counts, presence_penalty=0.5,
+    # frequency/presence use OUTPUT counts only (vllm semantics):
+    # token 0 seen in prompt but never generated -> untouched
+    out = np.asarray(apply_penalties(logits, rep_counts, out_counts,
+                                     presence_penalty=0.5,
                                      frequency_penalty=0.25))
-    np.testing.assert_allclose(out, [[2.0 - 0.75, -2.0 - 1.0, 1.0, 0.5]])
+    np.testing.assert_allclose(out, [[2.0, -2.0 - 1.0, 1.0 - 0.75, 0.5]])
 
 
 def test_token_counts_masks_padding():

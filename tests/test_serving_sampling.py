@@ -205,6 +205,25 @@ def test_oversubscription_all_complete_no_starvation(model):
         assert len(got[rid]) == 6, (rid, got[rid])
 
 
+def test_malformed_requests_rejected_at_add(model):
+    """Client input is validated at add_request (HTTP 400), never inside
+    step() — a bad token id there would wedge the admission lane."""
+    eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128))
+    V = TINY_LLAMA.vocab_size
+    with pytest.raises(ValueError, match="token ids"):
+        eng.add_request("bad1", [1, 2, V], SamplingParams(
+            repetition_penalty=1.5))
+    with pytest.raises(ValueError, match="token ids"):
+        eng.add_request("bad2", [1, -3], SamplingParams())
+    with pytest.raises(ValueError, match="logprobs"):
+        eng.add_request("bad3", [1, 2], SamplingParams(logprobs=V + 5))
+    with pytest.raises(ValueError, match="max_tokens"):
+        eng.add_request("bad4", [1, 2], SamplingParams(max_tokens=0))
+    # engine still serves fine afterwards
+    toks, _ = run_one(eng, "ok", [1, 2, 3], SamplingParams(max_tokens=3))
+    assert len(toks[0]) == 3
+
+
 def test_openai_endpoint_penalties_n_logprobs(model):
     """HTTP surface: penalties accepted, n=2 -> two choices, logprobs
     block present (token-id keyed, no tokenizer)."""
