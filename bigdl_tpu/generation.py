@@ -11,6 +11,7 @@ runs on device; only the emitted token returns to host each step.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -220,6 +221,153 @@ def generate_on_device(
         length=max_new_tokens - 1)
     out = jnp.concatenate([tok0[:, None], rest.T], axis=1)
     return out, cache
+
+
+def beam_search(
+    params: Dict[str, Any],
+    cfg,
+    forward_fn,
+    input_ids,                # [B, S] or [S] ints
+    new_cache_fn,
+    num_beams: int = 4,
+    max_new_tokens: int = 32,
+    max_seq: int = 2048,
+    length_penalty: float = 1.0,
+    eos_token_id: Optional[int] = None,
+) -> np.ndarray:
+    """Greedy beam search -> best sequences [B, max_new_tokens].
+
+    The HF-generate parity piece the reference gets for free from
+    transformers (its native pipeline has no beams). Static-shape,
+    TPU-first formulation: the batch expands to B*W rows sharing ONE
+    compiled decode executable; each step is one jitted function that
+    scores W*V continuations, selects the top W, and GATHERS the KV
+    cache rows of the surviving parents (index bookkeeping — no
+    reallocation). EOS beams freeze (their only continuation is pad at
+    frozen score); the best beam by length-penalized score wins.
+    Matches HF beam_search with early_stopping for the common cases;
+    it does not keep a per-batch heap of >W finished hypotheses.
+    """
+    ids = np.asarray(input_ids, np.int32)
+    if ids.ndim == 1:
+        ids = ids[None]
+    b, s = ids.shape
+    w = num_beams
+    if s + max_new_tokens > max_seq:
+        raise ValueError("prompt + max_new_tokens exceeds max_seq")
+
+    prefill_j, expand_j, select_j, reorder_decode_j = _beam_fns(
+        cfg, forward_fn, b, w, eos_token_id)
+
+    # prefill at batch B, then REPEAT the cache rows per beam — all W
+    # beams share the prompt KV, so prefilling B*W rows would waste
+    # (W-1)/W of the dominant long-prompt cost
+    cache1 = new_cache_fn(cfg, b, max_seq)
+    lp_b, cache1 = prefill_j(params, jnp.asarray(ids), cache1)
+    cache, gathered = _beam_expand_cache(cache1, expand_j, b, w)
+    if not gathered:
+        raise NotImplementedError(
+            "beam search requires a cache with [.., batch, ..] leaves at "
+            f"axis 1 (got {type(cache1).__name__} with none)")
+    lp0 = jnp.repeat(lp_b, w, axis=0)                         # [B*W, V]
+    v = lp0.shape[-1]
+
+    # all beams identical after prefill: only beam 0 may seed candidates
+    init_bias = jnp.full((w,), -jnp.inf).at[0].set(0.0)
+    scores = jnp.tile(init_bias, (b,)).reshape(b, w)          # [B, W]
+    done = jnp.zeros((b, w), jnp.bool_)
+    toks = jnp.zeros((b, w, max_new_tokens), jnp.int32)
+    lengths = jnp.zeros((b, w), jnp.int32)
+
+    tok_flat, scores, done, lengths, toks, parent_flat = select_j(
+        lp0, scores, done, lengths, toks, 0)
+    for t in range(1, max_new_tokens):
+        if bool(jnp.all(done)):
+            break
+        lp, cache = reorder_decode_j(params, parent_flat, cache, tok_flat)
+        tok_flat, scores, done, lengths, toks, parent_flat = select_j(
+            lp, scores, done, lengths, toks, t)
+
+    final = scores / jnp.maximum(
+        lengths.astype(jnp.float32), 1.0) ** length_penalty
+    best = jnp.argmax(final, axis=1)                          # [B]
+    out = jnp.take_along_axis(
+        toks, best[:, None, None], axis=1)[:, 0]
+    return np.asarray(out)
+
+
+def _beam_expand_cache(cache1, expand_j, b: int, w: int):
+    """Repeat batch-axis-1 cache leaves per beam. Returns (cache, n
+    leaves expanded). Batch-axis CONTRACT: beam state must live on axis
+    1 of >=2-D leaves (true of KVCache and every family cache built on
+    it); other leaves must be beam-invariant (e.g. scalar positions,
+    per-prompt anchors) — they are left untouched."""
+    n_hit = 0
+
+    def rep(x):
+        nonlocal n_hit
+        if getattr(x, "ndim", 0) >= 2 and x.shape[1] == b:
+            n_hit += 1
+            return expand_j(x)
+        return x
+
+    return jax.tree.map(rep, cache1), n_hit
+
+
+@functools.lru_cache(maxsize=32)
+def _beam_fns(cfg, forward_fn, b: int, w: int, eos_token_id):
+    """Jitted beam-search step functions, cached per geometry so repeated
+    beam_search calls reuse the compiled executables (the free-function
+    analog of Generator's cached prefill/decode)."""
+
+    prefill = jax.jit(lambda p, i, c: forward_fn(p, cfg, i, c))
+
+    def prefill_lp(p, i, c):
+        lg, c = prefill(p, i, c)
+        return jax.nn.log_softmax(
+            lg[:, -1, :].astype(jnp.float32), -1), c
+
+    expand = jax.jit(lambda x: jnp.repeat(x, w, axis=1))
+
+    @jax.jit
+    def select(lp, scores, done, lengths, toks, t):
+        """lp [B*W, V] log-probs -> (next_tok [B*W], new state)."""
+        v = lp.shape[-1]
+        lp = lp.reshape(b, w, v)
+        # finished beams: only pad continues, at unchanged score
+        pad_only = jnp.full((v,), -jnp.inf).at[0].set(0.0)
+        lp = jnp.where(done[..., None], pad_only[None, None, :], lp)
+        cand = scores[..., None] + lp                         # [B, W, V]
+        flat = cand.reshape(b, w * v)
+        top_sc, top_ix = jax.lax.top_k(flat, w)               # [B, W]
+        parent = top_ix // v
+        tok = (top_ix % v).astype(jnp.int32)
+        # reorder per-beam state to the surviving parents
+        gather = lambda x: jnp.take_along_axis(               # noqa: E731
+            x, parent.reshape(b, w, *([1] * (x.ndim - 2))), axis=1)
+        done_n = gather(done[..., None])[..., 0]
+        lengths_n = gather(lengths[..., None])[..., 0]
+        toks_n = gather(toks)
+        toks_n = toks_n.at[:, :, t].set(jnp.where(done_n, 0, tok))
+        lengths_n = jnp.where(done_n, lengths_n, lengths_n + 1)
+        if eos_token_id is not None:
+            done_n = done_n | (tok == eos_token_id)
+        flat_parent = (jnp.arange(b, dtype=jnp.int32)[:, None] * w
+                       + parent).reshape(-1)                  # [B*W]
+        return (tok.reshape(-1), top_sc, done_n, lengths_n, toks_n,
+                flat_parent)
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def reorder_decode(params, parent_flat, cache, tok_flat):
+        cache = jax.tree.map(
+            lambda x: jnp.take(x, parent_flat, axis=1)
+            if getattr(x, "ndim", 0) >= 2 and x.shape[1] == b * w else x,
+            cache)
+        lg, cache = forward_fn(params, cfg, tok_flat[:, None], cache)
+        return jax.nn.log_softmax(
+            lg[:, -1, :].astype(jnp.float32), -1), cache
+
+    return prefill_lp, expand, select, reorder_decode
 
 
 class Generator:
