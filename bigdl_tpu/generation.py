@@ -234,6 +234,7 @@ def beam_search(
     max_seq: int = 2048,
     length_penalty: float = 1.0,
     eos_token_id: Optional[int] = None,
+    prefill_fn=None,          # last-token-logits prefill variant, if any
 ) -> np.ndarray:
     """Greedy beam search -> best sequences [B, max_new_tokens].
 
@@ -257,11 +258,14 @@ def beam_search(
         raise ValueError("prompt + max_new_tokens exceeds max_seq")
 
     prefill_j, expand_j, select_j, reorder_decode_j = _beam_fns(
-        cfg, forward_fn, b, w, eos_token_id)
+        cfg, forward_fn, prefill_fn, b, w, eos_token_id)
 
     # prefill at batch B, then REPEAT the cache rows per beam — all W
     # beams share the prompt KV, so prefilling B*W rows would waste
-    # (W-1)/W of the dominant long-prompt cost
+    # (W-1)/W of the dominant long-prompt cost; with a last-token
+    # prefill_fn the [B, S, V] logits tensor is never materialized
+    # either. (One executable per prompt LENGTH — warm common lengths
+    # or go through Generator for bucketing.)
     cache1 = new_cache_fn(cfg, b, max_seq)
     lp_b, cache1 = prefill_j(params, jnp.asarray(ids), cache1)
     cache, gathered = _beam_expand_cache(cache1, expand_j, b, w)
@@ -270,7 +274,6 @@ def beam_search(
             "beam search requires a cache with [.., batch, ..] leaves at "
             f"axis 1 (got {type(cache1).__name__} with none)")
     lp0 = jnp.repeat(lp_b, w, axis=0)                         # [B*W, V]
-    v = lp0.shape[-1]
 
     # all beams identical after prefill: only beam 0 may seed candidates
     init_bias = jnp.full((w,), -jnp.inf).at[0].set(0.0)
@@ -315,12 +318,13 @@ def _beam_expand_cache(cache1, expand_j, b: int, w: int):
 
 
 @functools.lru_cache(maxsize=32)
-def _beam_fns(cfg, forward_fn, b: int, w: int, eos_token_id):
+def _beam_fns(cfg, forward_fn, prefill_fn, b: int, w: int, eos_token_id):
     """Jitted beam-search step functions, cached per geometry so repeated
     beam_search calls reuse the compiled executables (the free-function
     analog of Generator's cached prefill/decode)."""
 
-    prefill = jax.jit(lambda p, i, c: forward_fn(p, cfg, i, c))
+    pre = prefill_fn or forward_fn
+    prefill = jax.jit(lambda p, i, c: pre(p, cfg, i, c))
 
     def prefill_lp(p, i, c):
         lg, c = prefill(p, i, c)
@@ -437,6 +441,19 @@ class Generator:
         visual: Optional[Tuple[Any, Any]] = None,  # (vidx [B,S], vemb [Nv,D])
     ) -> np.ndarray:
         """Returns generated ids [B, <=max_new_tokens] (prompt excluded)."""
+        return np.stack(list(self.stream(input_ids, gen, stats, visual)),
+                        axis=1)
+
+    def stream(
+        self,
+        input_ids,
+        gen: Optional[GenerationConfig] = None,
+        stats: Optional[GenerationStats] = None,
+        visual: Optional[Tuple[Any, Any]] = None,
+    ):
+        """Token-by-token generation: yields [B] int32 per step — the
+        streaming-callback surface the reference gets from FastChat's
+        TextIteratorStreamer (serving/fastchat/ipex_llm_worker.py)."""
         gen = gen or GenerationConfig()
         ids = np.asarray(input_ids, np.int32)
         if ids.ndim == 1:
@@ -537,7 +554,7 @@ class Generator:
         if stats is not None:
             stats.first_token_s = time.perf_counter() - t0
 
-        out = [tok_host]
+        yield tok_host
         finished = np.zeros((b,), bool)
         finished_dev = jnp.zeros((b,), jnp.bool_)
         if gen.eos_token_id is not None:
@@ -560,8 +577,6 @@ class Generator:
             tok_host = np.asarray(tok)
             if stats is not None:
                 stats.rest_token_s.append(time.perf_counter() - t1)
-            out.append(tok_host)
+            yield tok_host
             if gen.eos_token_id is not None:
                 finished |= tok_host == gen.eos_token_id
-
-        return np.stack(out, axis=1)

@@ -24,9 +24,17 @@ class TpuLLMCore:
         self.model = AutoModelForCausalLM.from_pretrained(
             model_path, load_in_low_bit=low_bit, max_seq=max_seq,
             **model_kwargs)
-        from transformers import AutoTokenizer
+        try:
+            from transformers import AutoTokenizer
 
-        self.tokenizer = AutoTokenizer.from_pretrained(model_path)
+            self.tokenizer = AutoTokenizer.from_pretrained(model_path)
+        except Exception:
+            tok_info = getattr(self.model, "gguf_tokenizer_info", None)
+            if not tok_info:
+                raise
+            from bigdl_tpu.gguf_tokenizer import GGUFTokenizer
+
+            self.tokenizer = GGUFTokenizer.from_tokenizer_info(tok_info)
 
     def complete(self, prompt: str, max_new_tokens: int = 256,
                  temperature: float = 0.0, stop: Optional[List[str]] = None
@@ -43,16 +51,80 @@ class TpuLLMCore:
                 text = text[:idx]
         return text
 
+    def stream(self, prompt: str, max_new_tokens: int = 256,
+               temperature: float = 0.0,
+               stop: Optional[List[str]] = None):
+        """Yield text DELTAS as tokens decode (incremental-prefix
+        decoding handles multi-byte/multi-token glyphs). Stops early on
+        any stop string; the streaming-callback surface the reference
+        exposes via FastChat's TextIteratorStreamer."""
+        ids = list(self.tokenizer(prompt)["input_ids"])
+        stops = list(stop or [])
+        new_ids: List[int] = []
+        emitted = ""
+        text = ""
+
+        def holdback(t: str) -> int:
+            """Longest tail of `t` that is a proper PREFIX of a stop
+            string — withheld so a stop spanning token boundaries is
+            never partially emitted."""
+            h = 0
+            for s_ in stops:
+                for k in range(1, len(s_)):
+                    if t.endswith(s_[:k]):
+                        h = max(h, k)
+            return h
+
+        for t in self.model.generate_stream(
+                ids, max_new_tokens=max_new_tokens,
+                do_sample=temperature > 0, temperature=temperature):
+            new_ids.append(t)
+            text = self.tokenizer.decode(new_ids,
+                                         skip_special_tokens=True)
+            if text.endswith("�"):     # partial multi-byte glyph
+                continue
+            cut = None
+            for s_ in stops:
+                idx = text.find(s_)
+                if idx >= 0:
+                    cut = idx if cut is None else min(cut, idx)
+            if cut is not None:
+                if cut > len(emitted):
+                    yield text[len(emitted):cut]
+                return
+            safe = text[:len(text) - holdback(text)]
+            if len(safe) > len(emitted):
+                yield safe[len(emitted):]
+                emitted = safe
+        # flush anything withheld once generation ends without a stop
+        if len(text) > len(emitted):
+            yield text[len(emitted):]
+
     def embed(self, texts: List[str]) -> List[List[float]]:
-        """Mean-pooled token embeddings: hidden_size-dimensional vectors
-        from the model's embedding table (the reference's transformers
-        embeddings similarly pool model representations)."""
+        """Sentence embeddings by mean-pooling the model's FINAL hidden
+        states (the reference's TransformersEmbeddings pools model
+        outputs, langchain/embeddings/bigdlllm.py) — contextual vectors,
+        not a static table lookup."""
+        import inspect
+
+        import jax.numpy as jnp
+
         m = self.model
-        table = np.asarray(m.params["embed_tokens"], np.float32)
+        fwd = getattr(m.family, "forward_train", None)
+        # capability probe, not exception-swallowing: only forwards that
+        # EXPOSE a hidden-state tap take the contextual path
+        contextual = (fwd is not None and "return_hidden"
+                      in inspect.signature(fwd).parameters)
         outs = []
         for t in texts:
             ids = np.asarray(self.tokenizer(t)["input_ids"], np.int32)
-            vec = table[ids].mean(axis=0)
+            if contextual:
+                hid = fwd(m.params, m.config, jnp.asarray(ids[None]),
+                          return_hidden=True)
+                vec = np.asarray(hid[0], np.float32).mean(axis=0)
+            else:   # families without the tap: embedding-table pooling
+                table = np.asarray(m.params["embed_tokens"], np.float32)
+                vec = table[ids].mean(axis=0)
             outs.append(vec.astype(np.float32).tolist())
         return outs
 
@@ -60,6 +132,7 @@ class TpuLLMCore:
 def _make_langchain_classes():
     from langchain_core.embeddings import Embeddings
     from langchain_core.language_models.llms import LLM
+    from langchain_core.outputs import GenerationChunk
 
     class TransformersLLM(LLM):
         """LangChain LLM over bigdl_tpu (reference TransformersLLM)."""
@@ -76,6 +149,14 @@ def _make_langchain_classes():
 
         def _call(self, prompt: str, stop=None, run_manager=None, **kw):
             return self.core.complete(prompt, stop=stop, **kw)
+
+        def _stream(self, prompt: str, stop=None, run_manager=None,
+                    **kw):
+            for delta in self.core.stream(prompt, stop=stop, **kw):
+                chunk = GenerationChunk(text=delta)
+                if run_manager is not None:
+                    run_manager.on_llm_new_token(delta, chunk=chunk)
+                yield chunk
 
     class TransformersEmbeddings(Embeddings):
         def __init__(self, core: TpuLLMCore):

@@ -41,13 +41,14 @@ def _make_llamaindex_class():
 
         @llm_completion_callback()
         def stream_complete(self, prompt: str, **kw) -> CompletionResponseGen:
-            text = self.core.complete(prompt, max_new_tokens=self.num_output)
-
             def gen():
+                # REAL incremental decoding (TpuLLMCore.stream), not a
+                # post-hoc character replay of a finished completion
                 acc = ""
-                for ch in text:
-                    acc += ch
-                    yield CompletionResponse(text=acc, delta=ch)
+                for delta in self.core.stream(
+                        prompt, max_new_tokens=self.num_output):
+                    acc += delta
+                    yield CompletionResponse(text=acc, delta=delta)
 
             return gen()
 

@@ -596,8 +596,12 @@ def forward_train(
     compute_dtype=jnp.bfloat16,
     attn_fn=None,            # (q, k, v) -> out; default causal sdp
     pos_offset=0,            # global position of tokens[:, 0] (seq parallel)
+    return_hidden: bool = False,   # post-norm hidden states instead of logits
 ) -> jax.Array:
-    """Cacheless causal forward for training: returns logits [B, S, V].
+    """Cacheless causal forward for training: returns logits [B, S, V]
+    (or the post-final-norm hidden states [B, S, D] with
+    `return_hidden=True` — the embeddings path, reference
+    langchain/embeddings pooled model outputs).
 
     The finetuning path (QLoRA stack, reference transformers/qlora.py) runs
     through this; no KV cache is materialized, attention is causal over the
@@ -650,6 +654,8 @@ def forward_train(
         x, _ = lax.scan(lambda c, xs: (layer(c, xs[0], xs[1]), None), x,
                         (params["layers"], lids))
     x = _norm(x, params["norm"], params.get("norm_bias"), cfg)
+    if return_hidden:
+        return x
     return _lm_head(x, params, cfg)
 
 

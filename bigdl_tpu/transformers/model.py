@@ -86,6 +86,8 @@ class TpuCausalLM:
         auto_th_stop_draft: bool = True,
         spec_stats=None,
         visual=None,     # (vidx [B,S], vemb [Nv,D]) — multimodal prefill
+        num_beams: int = 1,
+        length_penalty: float = 1.0,
         **_ignored,
     ) -> np.ndarray:
         """HF-style generate: returns [B, prompt+new] (prompt included).
@@ -126,12 +128,60 @@ class TpuCausalLM:
                 stats=spec_stats,
             )
             return np.concatenate([ids, new], axis=1)
+        if num_beams > 1:
+            if visual is not None or do_sample:
+                raise NotImplementedError(
+                    "num_beams > 1 is greedy beam search (no sampling, "
+                    "no multimodal prefill yet)")
+            from bigdl_tpu.generation import beam_search
+
+            new = beam_search(
+                self.params, self.config, self.family.forward, ids,
+                self.family.new_cache, num_beams=num_beams,
+                max_new_tokens=max_new_tokens, max_seq=self.max_seq,
+                length_penalty=length_penalty, eos_token_id=eos_token_id,
+                prefill_fn=self.family.prefill)
+            return np.concatenate([ids, new], axis=1)
         gen = GenerationConfig(
             max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, do_sample=do_sample,
             eos_token_id=eos_token_id, seed=seed)
         new = self.generator.generate(ids, gen, stats=stats, visual=visual)
         return np.concatenate([ids, new], axis=1)
+
+    def generate_stream(
+        self,
+        input_ids,
+        max_new_tokens: int = 32,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+        **_ignored,
+    ):
+        """Streaming generate: yields ONE new token id (int, batch 1) per
+        step — the TextIteratorStreamer-equivalent surface the langchain/
+        llamaindex/FastChat integrations build their callbacks on."""
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        if ids.shape[0] != 1:
+            raise ValueError("generate_stream is a batch-1 surface")
+        if eos_token_id is None:
+            eos_token_id = self.hf_config.get("eos_token_id")
+            if isinstance(eos_token_id, list):
+                eos_token_id = eos_token_id[0]
+        gen = GenerationConfig(
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, do_sample=do_sample,
+            eos_token_id=eos_token_id, seed=seed)
+        for tok in self.generator.stream(ids, gen):
+            t = int(tok[0])
+            yield t
+            if eos_token_id is not None and t == eos_token_id:
+                return
 
     # -- persistence --------------------------------------------------------
     def save_low_bit(self, path: str) -> None:

@@ -74,3 +74,67 @@ def test_lm_eval_adapter_gated():
     from bigdl_tpu.bench import lm_eval_adapter
 
     assert hasattr(lm_eval_adapter, "sequence_loglikelihood")
+
+
+def test_generate_stream_matches_generate(gguf_model):
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(gguf_model, max_seq=64)
+    p = np.arange(1, 8, dtype=np.int32)
+    full = m.generate(p, max_new_tokens=6)[0, len(p):]
+    streamed = list(m.generate_stream(p, max_new_tokens=6))
+    np.testing.assert_array_equal(streamed, full)
+
+
+def test_core_stream_matches_complete(gguf_model):
+    from bigdl_tpu.integrations.langchain import TpuLLMCore
+
+    core = TpuLLMCore(gguf_model, max_seq=64)
+    text = core.complete("t1 t2 t3", max_new_tokens=6)
+    deltas = list(core.stream("t1 t2 t3", max_new_tokens=6))
+    assert deltas and "".join(deltas) == text
+
+
+def test_core_stream_stop_spanning_tokens(gguf_model):
+    """A stop string that spans token boundaries must never leak a
+    partial prefix into the stream: joined stream == complete(stop=..)."""
+    from bigdl_tpu.integrations.langchain import TpuLLMCore
+
+    core = TpuLLMCore(gguf_model, max_seq=64)
+    full = core.complete("t1 t2 t3", max_new_tokens=8)
+    assert len(full) > 7
+    # pick a stop crossing a token boundary (tokens decode to >=2 chars)
+    stop = full[3:7]
+    want = core.complete("t1 t2 t3", max_new_tokens=8, stop=[stop])
+    got = "".join(core.stream("t1 t2 t3", max_new_tokens=8, stop=[stop]))
+    assert got == want, (got, want)
+    assert stop not in got
+
+
+def test_generate_num_beams_public_api(gguf_model):
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(gguf_model, max_seq=64)
+    p = np.arange(1, 8, dtype=np.int32)
+    out = m.generate(p, max_new_tokens=6, num_beams=3)
+    assert out.shape == (1, len(p) + 6)
+    g1 = m.generate(p, max_new_tokens=6, num_beams=1)
+    np.testing.assert_array_equal(
+        g1, m.generate(p, max_new_tokens=6))   # beams=1 == greedy path
+
+
+def test_core_embed_contextual(gguf_model):
+    """Embeddings pool the FINAL hidden states: the same token in
+    different contexts embeds differently (a static table cannot)."""
+    from bigdl_tpu.integrations.langchain import TpuLLMCore
+
+    core = TpuLLMCore(gguf_model, max_seq=64)
+    a, b = core.embed(["t1 t2", "t9 t2"])
+    a2 = core.embed(["t1 t2"])[0]
+    assert len(a) == TINY_LLAMA.hidden_size
+    np.testing.assert_allclose(a, a2)
+    assert not np.allclose(a, b)
+    # contextuality: identical last token, different prefix -> the
+    # pooled vectors differ even when the shared token dominates
+    c, d = core.embed(["t1 t1 t5", "t2 t2 t5"])
+    assert not np.allclose(c, d)
