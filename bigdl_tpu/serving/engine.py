@@ -287,6 +287,13 @@ class LLMEngine:
             return logits[:, -1, :], cache
 
         self._decode = decode
+        # greedy fast path: when every active slot samples greedily with
+        # no penalties/logprobs, argmax on DEVICE and transfer [B] ints
+        # instead of the [B, V] logits (V=32k at batch 8 is ~1MB of D2H
+        # per token on a tunneled chip; this is the reference's
+        # BigDLSampler cost knocked off the hot path)
+        self._argmax = jax.jit(
+            lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32))
 
         # prefill one sequence on a private 1-row cache, then splice its K/V
         # and position into the batched cache at the slot index
@@ -941,10 +948,24 @@ class LLMEngine:
         tokens = np.zeros((self.cfg_engine.max_batch,), np.int32)
         for i in active:
             tokens[i] = self.slots[i].last_token
-        logits, self.cache = self._decode(
+        logits_dev, self.cache = self._decode(
             self.params, jnp.asarray(tokens), self.cache)
-        logits = np.asarray(logits)
 
+        def simple(s: _Slot) -> bool:
+            return (s.req.params.temperature <= 0.0 and s.counts is None
+                    and s.n_logprobs < 0)
+
+        if all(simple(self.slots[i]) for i in active):
+            toks = np.asarray(self._argmax(logits_dev))
+            for i in active:
+                s = self.slots[i]
+                s.last_token = int(toks[i])
+                s.generated.append(int(toks[i]))
+                self._emit(s)
+                self._check_done(i)
+            return True
+
+        logits = np.asarray(logits_dev)
         for i in active:
             s = self.slots[i]
             tok, lp = self._sample_host(logits[i], s)
