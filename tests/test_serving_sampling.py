@@ -205,6 +205,40 @@ def test_oversubscription_all_complete_no_starvation(model):
         assert len(got[rid]) == 6, (rid, got[rid])
 
 
+def test_wide_batch_all_slots_correct(model):
+    """16 slots decoding concurrently (beyond the reference-scale
+    max_batch 8): every request matches its single-request output —
+    the device-argmax fast path and per-slot bookkeeping scale."""
+    from bigdl_tpu.generation import generate_on_device
+    from bigdl_tpu.models import llama as llama_mod
+    import jax.numpy as jnp
+
+    eng = LLMEngine(model, EngineConfig(max_batch=16, max_seq=64))
+    prompts = {f"w{i}": [(i * 5 + j) % TINY_LLAMA.vocab_size or 1
+                         for j in range(1, 5)] for i in range(16)}
+    for rid, p in prompts.items():
+        eng.add_request(rid, p, SamplingParams(max_tokens=5))
+    got = {r: [] for r in prompts}
+    finished = set()
+    for _ in range(600):
+        eng.step()
+        for r in prompts:
+            for o in eng.get_outputs(r):
+                got[r].extend(o.new_token_ids)
+                if o.finished:
+                    finished.add(r)
+        if len(finished) == 16:
+            break
+    assert len(finished) == 16
+    for rid, p in prompts.items():
+        cache = llama_mod.new_cache(TINY_LLAMA, 1, 64)
+        want, _ = generate_on_device(
+            model.params, TINY_LLAMA, llama_mod.forward,
+            jnp.asarray(np.asarray(p, np.int32)[None]), cache,
+            max_new_tokens=5)
+        assert got[rid] == list(np.asarray(want)[0]), rid
+
+
 def test_malformed_requests_rejected_at_add(model):
     """Client input is validated at add_request (HTTP 400), never inside
     step() — a bad token id there would wedge the admission lane."""
