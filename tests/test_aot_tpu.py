@@ -244,6 +244,32 @@ def test_llama7b_decode_fp8_cache_compiles(v5e, aot_flags):
     assert _has_mosaic_call(comp)
 
 
+def test_vmapped_gemv_compiles(v5e, aot_flags):
+    """MoE decode gathers per-token expert weights and runs the matmul
+    under vmap with dynamic indexing — pallas_call's batching rule must
+    lower for v5e too (the vmapped_pallas_ok probe's real path)."""
+    from bigdl_tpu.ops.pallas.dequant_matmul import q_matmul_pallas
+    from bigdl_tpu.ops.quant import quantize
+
+    dev = v5e.devices[0]
+    e, k, n = 4, 1024, 2816
+    one = jax.eval_shape(
+        lambda: quantize(jnp.zeros((k, n), jnp.float32), "sym_int4"))
+    stack = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct((e,) + a.shape, a.dtype), one)
+    x = jax.ShapeDtypeStruct((8, k), jnp.bfloat16)
+    idx = jax.ShapeDtypeStruct((8,), jnp.int32)
+
+    def per(i, row, ws):
+        wi = jax.tree.map(lambda a: a[i], ws)
+        return q_matmul_pallas(row[None], wi)[0]
+
+    comp = _compile(
+        lambda ii, xx, ws: jax.vmap(per, in_axes=(0, 0, None))(ii, xx, ws),
+        _sds(idx, dev), _sds(x, dev), _sds(stack, dev))
+    assert _has_mosaic_call(comp)
+
+
 def test_mixtral_prefill_compiles(v5e, aot_flags):
     """MoE model: ragged dispatch + router on the prefill path at a
     mixtral-like (downscaled-experts) geometry."""
