@@ -36,6 +36,20 @@ class TpuLLMCore:
 
             self.tokenizer = GGUFTokenizer.from_tokenizer_info(tok_info)
 
+        # contextual-embedding forward: probe once, jit once (compiles
+        # per padded power-of-two length bucket, reused across calls)
+        import inspect
+
+        fwd = getattr(self.model.family, "forward_train", None)
+        self._embed_fwd = None
+        if fwd is not None and "return_hidden" in \
+                inspect.signature(fwd).parameters:
+            import jax
+
+            cfg = self.model.config
+            self._embed_fwd = jax.jit(
+                lambda p, t: fwd(p, cfg, t, return_hidden=True))
+
     def complete(self, prompt: str, max_new_tokens: int = 256,
                  temperature: float = 0.0, stop: Optional[List[str]] = None
                  ) -> str:
@@ -96,32 +110,38 @@ class TpuLLMCore:
             if len(safe) > len(emitted):
                 yield safe[len(emitted):]
                 emitted = safe
-        # flush anything withheld once generation ends without a stop
-        if len(text) > len(emitted):
-            yield text[len(emitted):]
+        # flush anything withheld at end-of-generation — re-applying the
+        # stop scan (the last token may both complete a stop string and
+        # end mid-glyph, in which case the loop never scanned it)
+        cut = len(text)
+        for s_ in stops:
+            idx = text.find(s_)
+            if idx >= 0:
+                cut = min(cut, idx)
+        if cut > len(emitted):
+            yield text[len(emitted):cut]
 
     def embed(self, texts: List[str]) -> List[List[float]]:
         """Sentence embeddings by mean-pooling the model's FINAL hidden
         states (the reference's TransformersEmbeddings pools model
         outputs, langchain/embeddings/bigdlllm.py) — contextual vectors,
         not a static table lookup."""
-        import inspect
-
         import jax.numpy as jnp
 
         m = self.model
-        fwd = getattr(m.family, "forward_train", None)
-        # capability probe, not exception-swallowing: only forwards that
-        # EXPOSE a hidden-state tap take the contextual path
-        contextual = (fwd is not None and "return_hidden"
-                      in inspect.signature(fwd).parameters)
         outs = []
         for t in texts:
             ids = np.asarray(self.tokenizer(t)["input_ids"], np.int32)
-            if contextual:
-                hid = fwd(m.params, m.config, jnp.asarray(ids[None]),
-                          return_hidden=True)
-                vec = np.asarray(hid[0], np.float32).mean(axis=0)
+            if self._embed_fwd is not None:
+                # pad right to a power-of-two bucket: causal attention
+                # means pad positions cannot affect the real prefix, and
+                # bucketed lengths reuse one compiled executable
+                n = len(ids)
+                bucket = max(16, 1 << (n - 1).bit_length())
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :n] = ids
+                hid = self._embed_fwd(m.params, jnp.asarray(padded))
+                vec = np.asarray(hid[0, :n], np.float32).mean(axis=0)
             else:   # families without the tap: embedding-table pooling
                 table = np.asarray(m.params["embed_tokens"], np.float32)
                 vec = table[ids].mean(axis=0)

@@ -957,18 +957,18 @@ class LLMEngine:
 
         if all(simple(self.slots[i]) for i in active):
             toks = np.asarray(self._argmax(logits_dev))
-            for i in active:
-                s = self.slots[i]
-                s.last_token = int(toks[i])
-                s.generated.append(int(toks[i]))
-                self._emit(s)
-                self._check_done(i)
-            return True
 
-        logits = np.asarray(logits_dev)
+            def pick(i):
+                return int(toks[i]), None
+        else:
+            logits = np.asarray(logits_dev)
+
+            def pick(i):
+                return self._sample_host(logits[i], self.slots[i])
+
         for i in active:
             s = self.slots[i]
-            tok, lp = self._sample_host(logits[i], s)
+            tok, lp = pick(i)
             s.last_token = tok
             s.generated.append(tok)
             self._emit(s, lp)

@@ -103,8 +103,10 @@ class TpuCausalLM:
             eos_token_id = self.hf_config.get("eos_token_id")
             if isinstance(eos_token_id, list):
                 eos_token_id = eos_token_id[0]
+        # beam search preempts speculation: beams change WHICH sequence
+        # is returned (semantics), speculation only changes latency
         if (self.draft_params is not None and ids.shape[0] == 1
-                and visual is None):
+                and visual is None and num_beams <= 1):
             from bigdl_tpu.speculative import speculative_generate
 
             new = speculative_generate(
