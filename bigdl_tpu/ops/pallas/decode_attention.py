@@ -28,6 +28,55 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+# above this cache length the whole-S tiles exceed VMEM (k+v bf16 at
+# 8k x 128 is 4MB; 16MB/core) — switch to the S-blocked online-softmax
+# sweep (same state machine as the prefill flash kernel, one query row)
+_RESIDENT_MAX = 4096
+_NEG_INF = -1e30
+
+
+def _kernel_blocked(pos_ref, q_ref, k_ref, v_ref, out_ref,
+                    m_ref, l_ref, acc_ref, *, scale, sb, ns, gp):
+    b = pl.program_id(0)
+    sj = pl.program_id(2)
+    pos = pos_ref[b]
+
+    @pl.when(sj == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.bfloat16)              # [Gp, hd]
+    k = k_ref[0].astype(jnp.bfloat16)                 # [sb, hd]
+    v = v_ref[0].astype(jnp.bfloat16)
+
+    s_ = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale    # [Gp, sb]
+    ids = sj * sb + jax.lax.broadcasted_iota(jnp.int32, (gp, sb), 1)
+    s_ = jnp.where(ids <= pos, s_, _NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    m_cur = jnp.max(s_, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s_ - m_new)
+    l_ref[:] = jnp.broadcast_to(
+        l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
+        l_ref.shape)
+    pv = jax.lax.dot_general(
+        p.astype(jnp.bfloat16), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_ref[:] = acc_ref[:] * corr + pv
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(sj == ns - 1)
+    def _():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        out_ref[0, 0] = (acc_ref[:] / l).astype(out_ref.dtype)
+
+
 def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, *, scale, s, gp):
     b = pl.program_id(0)
     pos = pos_ref[b]
@@ -82,19 +131,46 @@ def decode_attention_pallas(
 
     pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(b, hkv),
-        in_specs=[
-            pl.BlockSpec((1, 1, gp, hd), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, s, hd), lambda bi, hi, pos_ref: (bi, 0, hi)),
-            pl.BlockSpec((1, s, hd), lambda bi, hi, pos_ref: (bi, 0, hi)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, gp, hd),
-                               lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
-    )
+    q_spec = pl.BlockSpec((1, 1, gp, hd),
+                          lambda bi, hi, *r: (bi, hi, 0, 0))
+    if s > _RESIDENT_MAX:
+        sb = 512 if s % 512 == 0 else 128
+        ns = s // sb
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hkv, ns),
+            in_specs=[
+                q_spec,
+                pl.BlockSpec((1, sb, hd),
+                             lambda bi, hi, sj, pos_ref: (bi, sj, hi)),
+                pl.BlockSpec((1, sb, hd),
+                             lambda bi, hi, sj, pos_ref: (bi, sj, hi)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, gp, hd), lambda bi, hi, sj, pos_ref: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((gp, hd), jnp.float32),
+            ],
+        )
+        kernel = functools.partial(_kernel_blocked, scale=scale, sb=sb,
+                                   ns=ns, gp=gp)
+    else:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hkv),
+            in_specs=[
+                q_spec,
+                pl.BlockSpec((1, s, hd), lambda bi, hi, pos_ref: (bi, 0, hi)),
+                pl.BlockSpec((1, s, hd), lambda bi, hi, pos_ref: (bi, 0, hi)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, gp, hd),
+                                   lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+        )
+        kernel = functools.partial(_kernel, scale=scale, s=s, gp=gp)
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, s=s, gp=gp),
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, gp, hd), q.dtype),
         interpret=interpret,

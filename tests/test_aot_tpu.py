@@ -105,6 +105,8 @@ def test_dequant_gemv_compiles(v5e, aot_flags, qtype, n):
     (1, 2048, 32, 8, 128, "float8_e5m2"),   # fp8 KV cache
     (8, 1024, 32, 8, 128, "bfloat16"),      # batched serving decode
     (1, 4096, 40, 40, 128, "bfloat16"),     # 13B-class long cache
+    (1, 16384, 32, 8, 128, "bfloat16"),     # 16k: S-blocked flash sweep
+    (1, 32768, 32, 8, 128, "float8_e5m2"),  # 32k fp8 KV, blocked
 ])
 def test_decode_attention_compiles(v5e, aot_flags, b, s, h, hkv, hd, kvdt):
     from bigdl_tpu.ops.pallas.decode_attention import decode_attention_pallas

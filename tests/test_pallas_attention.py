@@ -95,3 +95,43 @@ def test_supported_gate():
                                           None)
     assert not decode_attention_supported(q, k, v, pos, 0.125, None, None,
                                           jnp.ones((4,)))
+
+
+def test_blocked_long_cache_matches_xla(monkeypatch):
+    """Caches past the VMEM-resident bound take the S-blocked
+    online-softmax sweep; outputs must match the XLA reference
+    (threshold lowered so interpret mode stays fast)."""
+    from bigdl_tpu.ops.pallas import decode_attention as DA
+
+    monkeypatch.setattr(DA, "_RESIDENT_MAX", 256)
+    q, k, v = _mk(2, 1024, 4, 2, 64, seed=3)
+    for pos_v in (999, 300, 0):
+        pos = jnp.asarray(pos_v, jnp.int32)
+        try:
+            set_flags(attention_backend="xla")
+            ref = sdp_attention(q, k, v, pos)
+        finally:
+            set_flags(attention_backend="auto")
+        got = DA.decode_attention_pallas(q, k, v, pos, 64 ** -0.5,
+                                         interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2, err_msg=f"pos={pos_v}")
+
+
+def test_blocked_per_slot_positions(monkeypatch):
+    from bigdl_tpu.ops.pallas import decode_attention as DA
+
+    monkeypatch.setattr(DA, "_RESIDENT_MAX", 256)
+    q, k, v = _mk(3, 512, 4, 4, 64, seed=4)
+    pos = jnp.asarray([5, 300, 511], jnp.int32)
+    try:
+        set_flags(attention_backend="xla")
+        ref = sdp_attention(q, k, v, pos)
+    finally:
+        set_flags(attention_backend="auto")
+    got = DA.decode_attention_pallas(q, k, v, pos, 64 ** -0.5,
+                                     interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
