@@ -377,6 +377,14 @@ class GGUFFile:
             cfg["num_local_experts"] = int(self._arch_kv("expert_count"))
             cfg["num_experts_per_tok"] = int(
                 self._arch_kv("expert_used_count", 2))
+            # llama.cpp writes mixtral under arch "llama" with
+            # llama.expert_count set — dispatch by the MoE marker. ONLY
+            # for the llama-shaped archs: qwen2moe/deepseek2/dbrx-style
+            # MoE GGUFs carry shared-expert tensors and different
+            # routing the mixtral family would silently drop.
+            if arch in ("llama", "mistral", "mixtral"):
+                cfg["architectures"] = ["MixtralForCausalLM"]
+                cfg["model_type"] = "mixtral"
         return cfg
 
     def tokenizer_info(self) -> Dict[str, Any]:
@@ -581,15 +589,23 @@ def load_gguf(path: str, compute_dtype=None):
         compute_dtype = jnp.bfloat16
     gf = GGUFFile(path)
     hf_config = gf.hf_config()
-    if gf._arch_kv("expert_count"):
-        raise NotImplementedError(
-            f"GGUF arch {gf.architecture!r} uses MoE expert tensors "
-            "(ffn_*_exps), which the GGUF importer does not map yet; load "
-            "the original HF checkpoint instead")
     L = hf_config["num_hidden_layers"]
+    n_exp = int(gf._arch_kv("expert_count") or 0)
+    moe = n_exp > 0
+    if moe and gf.architecture not in ("llama", "mistral", "mixtral"):
+        raise NotImplementedError(
+            f"GGUF arch {gf.architecture!r} is an MoE family with "
+            "shared-expert/routing tensors this importer does not map "
+            "(only mixtral-style llama-arch MoE is supported); load the "
+            "original HF checkpoint instead")
 
     params: Dict[str, Any] = {}
     layer_acc: Dict[str, list] = {}
+    # MoE expert accumulators: key -> [L][E] entries (old-style
+    # per-expert 2D tensors, repacked bit-faithfully)
+    expert_acc: Dict[str, list] = {}
+    _EXP_MAP = {"ffn_gate": "experts_gate", "ffn_up": "experts_up",
+                "ffn_down": "experts_down"}
 
     def cvt(name: str, want_linear: bool):
         _, gt, _ = (gf.tensors[name][0], gf.tensors[name][1],
@@ -613,7 +629,34 @@ def load_gguf(path: str, compute_dtype=None):
         elif name.startswith("blk."):
             parts = name.split(".")
             idx = int(parts[1])
-            base, leaf = parts[2], parts[3]
+            base = parts[2]
+            if moe and base == "ffn_gate_inp":
+                # router [E, D] -> contraction-major [D, E], full precision
+                layer_acc.setdefault("router", [None] * L)[idx] = \
+                    jnp.asarray(gf.load_dense(name, np.float32).T
+                                ).astype(compute_dtype)
+                continue
+            if moe and base.endswith("_exps"):
+                # fused 3D expert stack [E, out, in] (modern llama.cpp);
+                # dequantize-on-load, per-expert transpose to [E, in, out]
+                key = _EXP_MAP.get(base[:-5])
+                if key is None:
+                    continue
+                dense = gf.load_dense(name, np.float32)
+                layer_acc.setdefault(key, [None] * L)[idx] = jnp.asarray(
+                    np.ascontiguousarray(dense.transpose(0, 2, 1))
+                ).astype(compute_dtype)
+                continue
+            if moe and base in _EXP_MAP and len(parts) == 5 \
+                    and parts[3].isdigit():
+                # old-style per-expert tensors blk.N.ffn_gate.E.weight
+                key = _EXP_MAP[base]
+                eidx = int(parts[3])
+                row = expert_acc.setdefault(key, [
+                    [None] * n_exp for _ in range(L)])
+                row[idx][eidx] = cvt(name, True)
+                continue
+            leaf = parts[3]
             if base not in _LLAMA_MAP:
                 continue
             key = _LLAMA_MAP[base]
@@ -628,9 +671,24 @@ def load_gguf(path: str, compute_dtype=None):
                 val = cvt(name, True)
             layer_acc.setdefault(key, [None] * L)[idx] = val
 
-    required = {"q_proj", "k_proj", "v_proj", "o_proj",
-                "gate_proj", "up_proj", "down_proj",
-                "input_layernorm", "post_attention_layernorm"}
+    # stack old-style per-expert entries into [E, ...] trees per layer
+    for key, rows in expert_acc.items():
+        stacked = []
+        for li, row in enumerate(rows):
+            if any(x is None for x in row):
+                raise ValueError(
+                    f"GGUF layer {li}: missing expert tensors for {key}")
+            stacked.append(jax.tree.map(lambda *xs: jnp.stack(xs), *row))
+        layer_acc[key] = stacked
+
+    if moe:
+        required = {"q_proj", "k_proj", "v_proj", "o_proj", "router",
+                    "experts_gate", "experts_up", "experts_down",
+                    "input_layernorm", "post_attention_layernorm"}
+    else:
+        required = {"q_proj", "k_proj", "v_proj", "o_proj",
+                    "gate_proj", "up_proj", "down_proj",
+                    "input_layernorm", "post_attention_layernorm"}
     missing = sorted(
         (required - set(layer_acc))
         | {k for k, v in layer_acc.items() if any(x is None for x in v)})

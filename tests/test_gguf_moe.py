@@ -1,0 +1,200 @@
+"""Mixtral (MoE) GGUF import: llama.cpp writes mixtral under arch
+"llama" with llama.expert_count set, expert weights either as old-style
+per-expert 2D tensors (blk.N.ffn_gate.E.weight — what the reference's
+gguf mixtral loader reads) or as fused 3D stacks (blk.N.ffn_gate_exps).
+Both forms must load and match the HF-checkpoint conversion exactly."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu import gguf as G
+from bigdl_tpu.models import mixtral as mx
+from tests.test_mixtral import TINY_MIXTRAL
+
+CFG = TINY_MIXTRAL
+
+
+def _arrays(seed=0):
+    rng = np.random.default_rng(seed)
+    d, ff, v = CFG.hidden_size, CFG.intermediate_size, CFG.vocab_size
+    hd = CFG.hd
+    E, L = CFG.num_local_experts, CFG.num_hidden_layers
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    a = {"embed": t(v, d), "norm": np.ones((d,), np.float32),
+         "lm_head": t(v, d), "layers": []}
+    for _ in range(L):
+        a["layers"].append({
+            "q": t(CFG.num_attention_heads * hd, d),
+            "k": t(CFG.num_key_value_heads * hd, d),
+            "v": t(CFG.num_key_value_heads * hd, d),
+            "o": t(d, CFG.num_attention_heads * hd),
+            "router": t(E, d),
+            "w1": [t(ff, d) for _ in range(E)],     # gate
+            "w2": [t(d, ff) for _ in range(E)],     # down
+            "w3": [t(ff, d) for _ in range(E)],     # up
+        })
+    return a
+
+
+def _base_kv():
+    d, ff = CFG.hidden_size, CFG.intermediate_size
+    return {
+        "general.architecture": "llama",
+        "llama.block_count": CFG.num_hidden_layers,
+        "llama.embedding_length": d,
+        "llama.feed_forward_length": ff,
+        "llama.attention.head_count": CFG.num_attention_heads,
+        "llama.attention.head_count_kv": CFG.num_key_value_heads,
+        "llama.attention.layer_norm_rms_epsilon": CFG.rms_norm_eps,
+        "llama.rope.freq_base": CFG.rope_theta,
+        "llama.context_length": CFG.max_position_embeddings,
+        "llama.expert_count": CFG.num_local_experts,
+        "llama.expert_used_count": CFG.num_experts_per_tok,
+        "tokenizer.ggml.tokens": [f"t{i}" for i in range(CFG.vocab_size)],
+        "tokenizer.ggml.bos_token_id": 1,
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+
+
+def _write(path, a, fused: bool, expert_gt=None):
+    d = CFG.hidden_size
+    expert_gt = expert_gt or G.GGML_F32
+    tensors = {
+        "token_embd.weight": (a["embed"], G.GGML_F32),
+        "output_norm.weight": (a["norm"], G.GGML_F32),
+        "output.weight": (a["lm_head"], G.GGML_F32),
+    }
+    for i, ly in enumerate(a["layers"]):
+        p = f"blk.{i}."
+        tensors.update({
+            p + "attn_q.weight": (ly["q"], G.GGML_F32),
+            p + "attn_k.weight": (ly["k"], G.GGML_F32),
+            p + "attn_v.weight": (ly["v"], G.GGML_F32),
+            p + "attn_output.weight": (ly["o"], G.GGML_F32),
+            p + "attn_norm.weight": (np.ones((d,), np.float32),
+                                     G.GGML_F32),
+            p + "ffn_norm.weight": (np.ones((d,), np.float32),
+                                    G.GGML_F32),
+            p + "ffn_gate_inp.weight": (ly["router"], G.GGML_F32),
+        })
+        if fused:
+            tensors.update({
+                p + "ffn_gate_exps.weight": (np.stack(ly["w1"]),
+                                             G.GGML_F32),
+                p + "ffn_down_exps.weight": (np.stack(ly["w2"]),
+                                             G.GGML_F32),
+                p + "ffn_up_exps.weight": (np.stack(ly["w3"]),
+                                           G.GGML_F32),
+            })
+        else:
+            for e in range(CFG.num_local_experts):
+                tensors.update({
+                    p + f"ffn_gate.{e}.weight": (ly["w1"][e], expert_gt),
+                    p + f"ffn_down.{e}.weight": (ly["w2"][e], expert_gt),
+                    p + f"ffn_up.{e}.weight": (ly["w3"][e], expert_gt),
+                })
+    G.write_gguf(path, _base_kv(), tensors)
+
+
+def _hf_reference_params(a):
+    tensors = [("model.embed_tokens.weight", a["embed"]),
+               ("model.norm.weight", a["norm"]),
+               ("lm_head.weight", a["lm_head"])]
+    for i, ly in enumerate(a["layers"]):
+        p = f"model.layers.{i}."
+        tensors += [
+            (p + "self_attn.q_proj.weight", ly["q"]),
+            (p + "self_attn.k_proj.weight", ly["k"]),
+            (p + "self_attn.v_proj.weight", ly["v"]),
+            (p + "self_attn.o_proj.weight", ly["o"]),
+            (p + "input_layernorm.weight",
+             np.ones((CFG.hidden_size,), np.float32)),
+            (p + "post_attention_layernorm.weight",
+             np.ones((CFG.hidden_size,), np.float32)),
+            (p + "block_sparse_moe.gate.weight", ly["router"]),
+        ]
+        for e in range(CFG.num_local_experts):
+            ep = p + f"block_sparse_moe.experts.{e}."
+            tensors += [(ep + "w1.weight", ly["w1"][e]),
+                        (ep + "w2.weight", ly["w2"][e]),
+                        (ep + "w3.weight", ly["w3"][e])]
+    return mx.convert_hf_params(iter(tensors), CFG, qtype=None)
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_mixtral_gguf_matches_hf_conversion(tmp_path, fused):
+    a = _arrays()
+    path = str(tmp_path / f"mx_{fused}.gguf")
+    _write(path, a, fused)
+    params, hf_config, _tok = G.load_gguf(path)
+
+    assert hf_config["architectures"] == ["MixtralForCausalLM"]
+    assert hf_config["num_local_experts"] == CFG.num_local_experts
+    cfg = mx.MixtralConfig.from_hf(hf_config)
+    assert cfg.num_experts_per_tok == CFG.num_experts_per_tok
+
+    ref = _hf_reference_params(a)
+    toks = jnp.asarray(np.arange(1, 9, dtype=np.int32)[None])
+    got = np.asarray(mx.forward_train(params, cfg, toks))
+    want = np.asarray(mx.forward_train(ref, CFG, toks))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_mixtral_gguf_quantized_experts(tmp_path):
+    """Per-expert q8_0 tensors: the bit-faithful QTensor repack +
+    expert-wise pytree stacking path (forward within quant tolerance
+    of the f32 reference)."""
+    a = _arrays(2)
+    path = str(tmp_path / "mx_q8.gguf")
+    _write(path, a, fused=False, expert_gt=G.GGML_Q8_0)
+    params, hf_config, _ = G.load_gguf(path)
+    ly = params["layers"]
+    assert ly["experts_gate"].qtype == "sym_int8"
+    assert ly["experts_gate"].data.shape[:2] == (
+        CFG.num_hidden_layers, CFG.num_local_experts)
+    cfg = mx.MixtralConfig.from_hf(hf_config)
+    ref = _hf_reference_params(a)
+    toks = jnp.asarray(np.arange(1, 9, dtype=np.int32)[None])
+    got = np.asarray(mx.forward_train(params, cfg, toks), np.float32)
+    want = np.asarray(mx.forward_train(ref, CFG, toks), np.float32)
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.15)
+
+
+def test_non_mixtral_moe_arch_rejected(tmp_path):
+    """qwen2moe-style MoE GGUFs carry shared-expert tensors the mixtral
+    family cannot represent — refuse instead of decoding garbage."""
+    a = _arrays(3)
+    path = str(tmp_path / "qmoe.gguf")
+    _write(path, a, fused=True)
+    import struct
+
+    raw = open(path, "rb").read()
+    # rewrite arch metadata: same-length replacement keeps offsets valid
+    raw = raw.replace(b"llama.expert_count", b"qmoe0.expert_count")
+    raw = raw.replace(
+        struct.pack("<Q", 5) + b"llama",
+        struct.pack("<Q", 5) + b"qmoe0", 1)
+    open(path, "wb").write(raw)
+    gf = G.GGUFFile(path)
+    if gf.architecture != "qmoe0":
+        pytest.skip("arch rewrite did not take")
+    with pytest.raises(NotImplementedError, match="MoE"):
+        G.load_gguf(path)
+
+
+def test_mixtral_gguf_public_from_pretrained(tmp_path):
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    a = _arrays(1)
+    path = str(tmp_path / "mx.gguf")
+    _write(path, a, fused=False)
+    m = AutoModelForCausalLM.from_pretrained(path, max_seq=64)
+    assert m.family.name == "mixtral"
+    out = m.generate(np.arange(1, 7, dtype=np.int32), max_new_tokens=5)
+    assert out.shape == (1, 11)
+    assert np.all((out >= 0) & (out < CFG.vocab_size))
