@@ -94,6 +94,37 @@ def target_is_tpu() -> bool:
         return False
 
 
+def under_spmd(*arrays) -> bool:
+    """True when any array is (being traced as) sharded over a
+    multi-device mesh. Pallas kernels cannot be auto-partitioned by
+    GSPMD — dispatching one inside a sharded program is a hard compile
+    error ("Mosaic kernels cannot be automatically partitioned") — so
+    kernel dispatch consults this and falls back to XLA ops, which
+    partition cleanly. Explicitly shard_mapped kernel calls (parallel/
+    sp.py, cp.py) see LOCAL per-device shapes and are unaffected."""
+    for a in arrays:
+        sh = getattr(getattr(a, "aval", None), "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is None or getattr(mesh, "size", 0) <= 1:
+            continue
+        # Manual axes = inside a shard_map body (per-device local view;
+        # kernels are legal there) — only Auto/Explicit axes mean GSPMD
+        # will partition this op
+        try:
+            from jax.sharding import AxisType
+
+            sizes = dict(zip(mesh.axis_names, mesh.axis_sizes))
+            auto = 1
+            for name, t in zip(mesh.axis_names, mesh.axis_types):
+                if t != AxisType.Manual:
+                    auto *= sizes[name]
+            if auto > 1:
+                return True
+        except Exception:
+            return True     # unknown mesh shape info: be conservative
+    return False
+
+
 def set_flags(**kwargs) -> RuntimeFlags:
     """Override flags in code (tests, notebooks). Returns the new flags."""
     global _flags

@@ -317,9 +317,11 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
     # FLOPs (E/k cut vs the dense combine below); requires the Pallas
     # kernel, probed per geometry. Quantized-with-bias stacks (none of
     # the served families) would fall through to dense.
-    from bigdl_tpu.config import flags, target_is_tpu
+    from bigdl_tpu.config import flags, target_is_tpu, under_spmd
 
     if (not biased and flags().moe_dispatch != "dense"
+            and not under_spmd(xf, *jax.tree_util.tree_leaves(
+                lp["experts_up"]))
             and (target_is_tpu()
                  or flags().moe_dispatch == "ragged")):
         from bigdl_tpu.ops.pallas.moe_dispatch import (

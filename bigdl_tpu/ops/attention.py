@@ -143,9 +143,15 @@ def sdp_attention(
     if scale is None:
         scale = d ** -0.5
 
-    from bigdl_tpu.config import flags, target_is_tpu
+    from bigdl_tpu.config import flags, target_is_tpu, under_spmd
 
     be = backend or flags().attention_backend
+    if be in ("auto", "pallas") and under_spmd(q, k, v):
+        # GSPMD cannot auto-partition Mosaic kernels (hard compile
+        # error); sharded programs take the XLA ops, which partition
+        # cleanly — explicitly shard_mapped paths (parallel/sp, cp)
+        # still reach the kernels with local shapes
+        be = "xla" if be == "auto" else be
     if be in ("auto", "pallas"):
         from bigdl_tpu.ops.pallas.decode_attention import (
             decode_attention_pallas, decode_attention_supported)

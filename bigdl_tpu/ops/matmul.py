@@ -60,9 +60,10 @@ def _q_matmul_dispatch(x: jax.Array, w: QTensor, be: str) -> jax.Array:
     if be == "xla":
         return _q_matmul_xla(x, w)
     if be in ("auto", "pallas"):
-        from bigdl_tpu.config import target_is_tpu
+        from bigdl_tpu.config import target_is_tpu, under_spmd
 
-        use_pallas = w.qtype in _PALLAS_QTYPES and target_is_tpu()
+        use_pallas = (w.qtype in _PALLAS_QTYPES and target_is_tpu()
+                      and not under_spmd(x, *jax.tree_util.tree_leaves(w)))
         if be == "pallas" or use_pallas:
             try:
                 from bigdl_tpu.ops.pallas.dequant_matmul import q_matmul_pallas
