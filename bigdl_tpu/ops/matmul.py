@@ -27,7 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.ops.quant import QTensor, dequantize
+from bigdl_tpu.ops.quant import QTensor, dequantize_impl as dequantize
 
 # Kernel backend selection:
 #   "auto"   — Pallas on TPU when supported, else XLA fallback
@@ -66,9 +66,10 @@ def _q_matmul_dispatch(x: jax.Array, w: QTensor, be: str) -> jax.Array:
                       and not under_spmd(x, *jax.tree_util.tree_leaves(w)))
         if be == "pallas" or use_pallas:
             try:
-                from bigdl_tpu.ops.pallas.dequant_matmul import q_matmul_pallas
+                from bigdl_tpu.ops.pallas.dequant_matmul import (
+                    q_matmul_pallas_impl)
 
-                return q_matmul_pallas(x, w)
+                return q_matmul_pallas_impl(x, w)
             except NotImplementedError:
                 if be == "pallas":
                     raise

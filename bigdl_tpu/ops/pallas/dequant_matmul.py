@@ -122,18 +122,20 @@ def _kernel_int8(x_ref, data_ref, scale_ref, out_ref, acc_ref, *,
 
 def _gemv_kernel(x_ref, data_ref, scale_ref, *rest, block, kind, codebook,
                  bk, bn, nk, bits):
-    """Decode-GEMV body: grid (N/bn, K/bk), K innermost. x and the FULL-K
-    scale (and zero) column block stay resident in VMEM across the K
-    sweep; only the packed data streams."""
+    """Decode-GEMV body: grid (N/bn, K/bk), K innermost. x stays
+    resident in VMEM across the K sweep; the packed data AND the
+    per-step scale (zero) blocks stream via their BlockSpecs — an
+    in-kernel dynamic slice of a resident scale buffer needs sublane-
+    aligned offsets Mosaic cannot prove for K/block % 16 != 0 (caught
+    by the AOT suite at down-proj-shaped K)."""
     if kind == "asym":
         zero_ref, out_ref, acc_ref = rest
     else:
         (out_ref, acc_ref), zero_ref = rest, None
     k = pl.program_id(1)
     rows = bk // block
-    sl = pl.ds(k * rows, rows)
-    scale = scale_ref[sl]
-    zero = zero_ref[sl] if zero_ref is not None else None
+    scale = scale_ref[:]
+    zero = zero_ref[:] if zero_ref is not None else None
     if bits == 4:
         codes = _unpack_tile(data_ref[:], block, bk, bn)
         w = _dequant_tile(codes, scale, zero, kind, codebook, bk, bn)
@@ -146,16 +148,27 @@ def _gemv_kernel(x_ref, data_ref, scale_ref, *rest, block, kind, codebook,
                 k_axis=1)
 
 
+def _scale_rows_ok(bk: int, b: int, kp: int) -> bool:
+    """The streamed scale block [bk//b, bn] must satisfy Mosaic's block
+    tiling: second-to-last dim divisible by 8, or equal to the full
+    array dim (kp//b). Violating K values (e.g. tensor-parallel local
+    shards of 11008) fall back to the XLA matmul."""
+    rows = bk // b
+    return rows % 8 == 0 or bk == kp
+
+
 def _gemv_tiles(qt, kp: int, n: int):
     b = qt.block_size
     bn = _pick_tile(n, [512, 256, 128])
-    bkc = [4096, 2048, 1024, 512, 256, 128, 64, 32]
-    bk = _pick_tile(kp, [c for c in bkc if c % b == 0])
+    # kp itself is always legal (block dims == array dims), VMEM permitting
+    bkc = [4096, 2048, 1024, 512, 256, 128, 64, 32, kp]
+    bk = _pick_tile(kp, [c for c in bkc
+                         if c % b == 0 and _scale_rows_ok(c, b, kp)])
     if not bk or not bn:
         return None
     while bk * bn * 3 > 4 * 1024 * 1024 and bk > b:
         bk //= 2
-    if bk % b != 0 or kp % bk != 0:
+    if bk % b != 0 or kp % bk != 0 or not _scale_rows_ok(bk, b, kp):
         return None
     return bk, bn
 
@@ -216,7 +229,8 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
     tile. FLOP overhead of the pad is irrelevant — decode is HBM-bound."""
     mp = 16
     if x2.shape[0] != mp:
-        x2 = jnp.pad(x2, ((0, mp - x2.shape[0]), (0, 0)))
+        x2 = jax.lax.pad(x2, jnp.zeros((), x2.dtype),
+                         ((0, mp - x2.shape[0], 0), (0, 0, 0)))
     b = qt.block_size
     tiles = _gemv_tiles(qt, kp, n)
     if tiles is None:
@@ -226,7 +240,7 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
     grid = (n // bn, nk)
 
     x_spec = pl.BlockSpec((mp, kp), lambda j, k: (0, 0))      # resident
-    scale_spec = pl.BlockSpec((kp // b, bn), lambda j, k: (0, j))
+    scale_spec = pl.BlockSpec((bk // b, bn), lambda j, k: (k, j))
     out_spec = pl.BlockSpec((mp, bn), lambda j, k: (0, j))
     out_shape = jax.ShapeDtypeStruct((mp, n), out_dtype or x2.dtype)
     scratch = [pltpu.VMEM((mp, bn), jnp.float32)]
@@ -257,9 +271,12 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
     return y[:m]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def q_matmul_pallas(x: jax.Array, w: QTensor, *, interpret: bool = False) -> jax.Array:
-    """x [..., K] @ quantized W [K, N] -> [..., N] via a fused Pallas kernel."""
+def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
+                         interpret: bool = False) -> jax.Array:
+    """x [..., K] @ quantized W [K, N] -> [..., N] via a fused Pallas
+    kernel. Unjitted body: model forwards call this inside their own
+    jit (a nested jit's closed_call fails to lower inside shard_map's
+    Manual-mesh trace — caught by the explicit-TP AOT test)."""
     qt = get_qtype(w.qtype)
     if qt.kind not in ("sym", "asym", "codebook") or qt.storage_bits not in (4, 8):
         raise NotImplementedError(f"pallas kernel does not support {w.qtype}")
@@ -274,7 +291,8 @@ def q_matmul_pallas(x: jax.Array, w: QTensor, *, interpret: bool = False) -> jax
         m *= d
     x2 = x.reshape(m, klog).astype(jnp.bfloat16)
     if kp != klog:
-        x2 = jnp.pad(x2, ((0, 0), (0, kp - klog)))
+        x2 = jax.lax.pad(x2, jnp.zeros((), x2.dtype),
+                         ((0, 0, 0), (0, kp - klog, 0)))
 
     from bigdl_tpu.config import flags
 
@@ -293,17 +311,20 @@ def q_matmul_pallas(x: jax.Array, w: QTensor, *, interpret: bool = False) -> jax
         mp = m
     else:
         mp = m + ((-m) % 16)
-        x2 = jnp.pad(x2, ((0, mp - m), (0, 0)))
+        x2 = jax.lax.pad(x2, jnp.zeros((), x2.dtype),
+                         ((0, mp - m, 0), (0, 0, 0)))
         bm = _pick_tile(mp, [256, 128, 64, 32, 16]) or mp
-    bkc = [2048, 1024, 512, 256, 128, 64, 32]
-    bk = _pick_tile(kp, [c for c in bkc if c % qt.block_size == 0])
+    bkc = [2048, 1024, 512, 256, 128, 64, 32, kp]
+    bk = _pick_tile(kp, [c for c in bkc if c % qt.block_size == 0
+                         and _scale_rows_ok(c, qt.block_size, kp)])
     bn = _pick_tile(n, [512, 256, 128])
     if not bk or not bn:
         raise NotImplementedError(f"shapes not tileable: K={kp} N={n}")
     # keep the working set in VMEM: data tile + unpacked w tile + x tile
     while bk * bn * 3 > 4 * 1024 * 1024 and bk > qt.block_size:
         bk //= 2
-    if bk % qt.block_size != 0 or kp % bk != 0:
+    if bk % qt.block_size != 0 or kp % bk != 0 or not _scale_rows_ok(
+            bk, qt.block_size, kp):
         raise NotImplementedError(f"K tiling failed: K={kp} block={qt.block_size}")
 
     nk = kp // bk
@@ -363,3 +384,9 @@ def q_matmul_pallas(x: jax.Array, w: QTensor, *, interpret: bool = False) -> jax
     if mp != m:
         y = y[:m]
     return y.reshape(*batch_shape, n)
+
+
+# public jitted entry (standalone callers, probes, benchmarks); model
+# dispatch uses the unjitted impl — see its docstring
+q_matmul_pallas = functools.partial(
+    jax.jit, static_argnames=("interpret",))(q_matmul_pallas_impl)

@@ -733,9 +733,14 @@ def _expand_scale(scale: jax.Array, block: int, kp: int) -> jax.Array:
     ).reshape(kp, n)
 
 
-@functools.partial(jax.jit, static_argnames=("dtype",))
-def dequantize(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
-    """QTensor -> dense [K, N] array of `dtype` (XLA reference path)."""
+def dequantize_impl(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
+    """QTensor -> dense [K, N] array of `dtype` (XLA reference path).
+
+    Unjitted body: model forwards reach this inside their own jit, and a
+    nested jit's closed_call fails to lower inside shard_map's Manual-
+    mesh AOT trace (see ops/pallas/dequant_matmul.q_matmul_pallas_impl).
+    The jitted public alias `dequantize` is defined below for eager
+    callers (conversion, tests)."""
     t = qt.qt
     k, n = qt.shape
     b = t.block_size
@@ -858,3 +863,8 @@ def quantize_linear(w_out_in: jax.Array, qtype: str,
 def dequantize_linear(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
     """QTensor [in, out] -> HF-layout dense weight [out, in]."""
     return dequantize(qt, dtype=dtype).T
+
+
+# public jitted alias (eager callers: conversion utilities, tests)
+dequantize = functools.partial(
+    jax.jit, static_argnames=("dtype",))(dequantize_impl)

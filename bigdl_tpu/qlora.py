@@ -34,7 +34,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from bigdl_tpu.ops.matmul import linear, q_matmul
-from bigdl_tpu.ops.quant import QTensor, dequantize, quantize
+from bigdl_tpu.ops.quant import QTensor, dequantize_impl as dequantize, quantize
 
 # Default adapter targets: every linear in a llama-family block (the
 # reference's alpaca recipes target the same set).

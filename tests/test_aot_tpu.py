@@ -356,6 +356,52 @@ def test_sharded_train_step_compiles_v5e_mesh(v5e, aot_flags):
     assert "all-reduce" in comp.as_text()
 
 
+def test_explicit_tp_kernels_compile_v5e_mesh(v5e, aot_flags):
+    """The explicit-shard_map TP path (parallel/tp.py) is the
+    kernel-capable multi-chip route: the partitioned program must
+    contain Mosaic custom-calls (kernels on LOCAL shards) AND the
+    row-parallel all-reduce."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+
+    from bigdl_tpu.models import llama as M
+    from bigdl_tpu.models.llama import LlamaConfig
+    from bigdl_tpu.ops.kvcache import KVCache
+    from bigdl_tpu.parallel import tp as TP
+    from bigdl_tpu.utils.testing import random_llama_params
+
+    mesh = Mesh(np.array(v5e.devices), ("tp",))
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=2, num_attention_heads=32,
+        num_key_value_heads=32)
+    pshape = jax.eval_shape(lambda: random_llama_params(cfg, "sym_int4"))
+    specs = TP.tp_param_specs(pshape, mesh)
+    p_s = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
+        pshape, specs)
+    cshape = jax.eval_shape(lambda: M.new_cache(cfg, 1, 2048))
+    csh = NamedSharding(mesh, TP.tp_cache_specs())
+    cache_s = KVCache(
+        jax.ShapeDtypeStruct(cshape.k.shape, cshape.k.dtype, sharding=csh),
+        jax.ShapeDtypeStruct(cshape.v.shape, cshape.v.dtype, sharding=csh),
+        jax.ShapeDtypeStruct((), jnp.int32,
+                             sharding=NamedSharding(
+                                 mesh, jax.sharding.PartitionSpec())))
+    ids = jax.ShapeDtypeStruct(
+        (1, 1), jnp.int32,
+        sharding=NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    fn = TP._tp_fn(cfg, mesh, "tp")
+    with mesh:
+        comp = fn.lower(p_s, ids, cache_s).compile()
+    txt = comp.as_text()
+    assert _has_mosaic_call(comp), (
+        "explicit TP compiled without Mosaic kernels — the whole point "
+        "of the shard_map path")
+    assert "all-reduce" in txt
+
+
 def test_mixtral_prefill_compiles(v5e, aot_flags):
     """MoE model: ragged dispatch + router on the prefill path at a
     mixtral-like (downscaled-experts) geometry."""
