@@ -231,10 +231,10 @@ def _efficiency(cfg, params, prompt_len: int, steps: int, max_seq: int,
     decode_mfu = (flops_tok + attn_tok) / (next_ms / 1e3) / (
         peak_tflops * 1e12)
     return {
-        "decode_hbm_roofline_util": round(ideal_decode_ms / next_ms, 3),
-        "decode_ideal_ms": round(ideal_decode_ms, 3),
-        "decode_mfu": round(decode_mfu, 4),
-        "prefill_mfu": round(prefill_mfu, 3),
+        "decode_hbm_roofline_util": round(ideal_decode_ms / next_ms, 4),
+        "decode_ideal_ms": round(ideal_decode_ms, 6),
+        "decode_mfu": round(decode_mfu, 5),
+        "prefill_mfu": round(prefill_mfu, 4),
         "weight_bytes": int(weight_bytes),
         "peak_bf16_tflops": peak_tflops,
         "peak_hbm_gbps": peak_gbps,

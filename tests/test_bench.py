@@ -201,3 +201,28 @@ def test_report_csv_html_and_diff(tmp_path):
     write_html(d, str(htmlp))
     body = htmlp.read_text()
     assert "<table>" in body and "rest_token_ms_ratio" in body
+
+
+def test_bench_efficiency_formulas():
+    """bench._efficiency only runs on-chip — verify its math off-chip so
+    a live round-end bench cannot die on it. Formula-level checks (the
+    tiny model keeps magnitudes small but the ratios must hold)."""
+    import sys
+
+    sys.path.insert(0, ".")
+    import jax
+
+    from bench import _efficiency
+    from bigdl_tpu.utils.testing import TINY_LLAMA, random_llama_params
+
+    params = random_llama_params(TINY_LLAMA, qtype="sym_int4")
+    out = _efficiency(TINY_LLAMA, params, 32, 8, 256, 100.0, 5.0)
+    wb = sum(a.nbytes for a in jax.tree_util.tree_leaves(params))
+    assert out["weight_bytes"] == wb
+    cfg = TINY_LLAMA
+    s_mid = 32 + 4
+    kv = 2 * cfg.num_hidden_layers * s_mid * cfg.num_key_value_heads \
+        * cfg.hd * 2
+    ideal = (wb + kv) / (out["peak_hbm_gbps"] * 1e9) * 1e3
+    assert abs(out["decode_ideal_ms"] - ideal) <= 1e-6 + ideal * 0.01
+    assert out["decode_mfu"] >= 0 and out["prefill_mfu"] >= 0
