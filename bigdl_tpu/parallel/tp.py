@@ -227,15 +227,12 @@ def _tp_fn(cfg, mesh, axis):
     cfg_l = _tp_cfg(cfg, n)
     fwd = _local_forward(cfg_l, axis)
 
-    def local(p, tokens, ck, cv, pos):
-        return fwd(p, tokens, ck, cv, pos)
-
     # param specs must match how shard_params_tp laid them out; the spec
     # pytree uses the PARAM SHAPE tree, built lazily at first call
     def run(params, tokens, cache):
         pspecs = tp_param_specs(params, mesh, axis=axis)
         f = _shard_map(
-            local, mesh=mesh,
+            fwd, mesh=mesh,
             in_specs=(pspecs, P(), tp_cache_specs(axis),
                       tp_cache_specs(axis),
                       P()),

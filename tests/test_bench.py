@@ -207,9 +207,11 @@ def test_bench_efficiency_formulas():
     """bench._efficiency only runs on-chip — verify its math off-chip so
     a live round-end bench cannot die on it. Formula-level checks (the
     tiny model keeps magnitudes small but the ratios must hold)."""
+    import os
     import sys
 
-    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     import jax
 
     from bench import _efficiency
