@@ -78,6 +78,22 @@ def test_tp_generate_matches_greedy(mesh):
                                   np.asarray(ref))
 
 
+def test_tp_fp8_kv_cache_matches(mesh):
+    """fp8-quantized KV under explicit TP: head-sharded e5m2 cache,
+    logits identical to the single-device fp8 path."""
+    params = random_llama_params(CFG, qtype="sym_int4", seed=3)
+    prompt = jnp.asarray(np.arange(1, 13, dtype=np.int32)[None])
+    c1 = M.new_cache(CFG, 1, 64, quantized=True)
+    ref, _ = M.forward(params, CFG, prompt, c1)
+    with mesh:
+        p_s = shard_params_tp(params, mesh)
+        cache = new_cache_tp(CFG, 1, 64, mesh, quantized=True)
+        lg, _ = tp_forward_step(p_s, CFG, prompt, cache, mesh)
+    np.testing.assert_allclose(np.asarray(lg),
+                               np.asarray(ref[:, -1, :]),
+                               rtol=2e-2, atol=2e-2)
+
+
 def test_tp_custom_axis_name():
     """The axis= parameter must thread through specs/cache/forward."""
     if len(jax.devices()) < 2:
