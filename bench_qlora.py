@@ -77,13 +77,27 @@ def main() -> None:
     per_step_ms = (time.perf_counter() - t0) / steps * 1e3
 
     tokens_per_s = batch * seq / (per_step_ms / 1e3)
+
+    # physics floor (poisoned-buffer guard, same rationale as bench.py):
+    # fwd+bwd >= 2x forward matmul FLOPs; timings below what the MXU
+    # could do at 100% utilization mean the runtime did not execute
+    from bench import chip_peaks, model_flops_per_token
+
+    flops_tok = model_flops_per_token(cfg)
+    peak_tflops = chip_peaks()[0]
+    floor_ms = 2 * batch * seq * flops_tok / (peak_tflops * 1e12) * 1e3 * 0.5
+    import math
+
+    poisoned = on_tpu and (per_step_ms < floor_ms
+                           or not math.isfinite(float(loss)))
+
     out = {
         # a CPU fallback must not carry the 7B-on-TPU metric name
         "metric": ("llama2_7b_qlora_step_time" if on_tpu
                    else "cpu_fallback_smoke_qlora_step_time"),
         "value": round(per_step_ms, 2),
         "unit": "ms",
-        "valid": bool(on_tpu),
+        "valid": bool(on_tpu) and not poisoned,
         "tokens_per_s": round(tokens_per_s, 1),
         "batch": batch,
         "seq_len": seq,
@@ -92,7 +106,11 @@ def main() -> None:
         "model": "llama2-7b" if on_tpu else "tiny-llama(cpu-fallback)",
         "loss": float(loss),
     }
-    if on_tpu:
+    if poisoned:
+        out["note"] = (f"step time beat the physics floor "
+                       f"({floor_ms:.0f}ms) or loss not finite — "
+                       f"runtime did not execute (poisoned buffers)")
+    if on_tpu and not poisoned:
         # BASELINE.md target: Alpaca QLoRA in < 21 min on 8 chips.
         # Sample count and epochs come from the reference recipe the
         # number was published for (alpaca_qlora_finetuning.py:

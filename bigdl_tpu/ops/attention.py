@@ -72,26 +72,26 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
     if hit is not None:
         return hit
     try:
-        import numpy as _np
-
         if kind == "decode":
             from bigdl_tpu.ops.pallas.decode_attention import (
                 decode_attention_pallas as kernel)
         else:
             from bigdl_tpu.ops.pallas.prefill_attention import (
                 prefill_attention_pallas as kernel)
+        from bigdl_tpu.ops.probing import probe_compile
 
         # The probe is usually reached while TRACING a model's outer jit;
-        # ensure_compile_time_eval escapes the trace so the tiny compile
-        # actually executes here (otherwise jnp ops become trace constants
-        # and _np.asarray raises TracerArrayConversionError, which would
-        # pin the geometry to the XLA path after the retry budget).
-        with jax.ensure_compile_time_eval():
-            kdt = jnp.dtype(kv_dtype_name)
-            q = jnp.zeros((1, sq, h, hd), jnp.bfloat16)
-            kv = jnp.zeros((1, skv, hkv, hd), kdt)
-            out = kernel(q, kv, kv, jnp.asarray(0, jnp.int32), hd ** -0.5)
-            _np.asarray(out)
+        # compile-only AOT probing (see ops/probing.py) never executes,
+        # never allocates device buffers, and never touches the ambient
+        # trace — a concrete call here used to die on live TPUs with
+        # "Evaluation rule for 'program_id' not implemented".
+        kdt = jnp.dtype(kv_dtype_name)
+        probe_compile(
+            lambda qq, kk, vv, pp: kernel(qq, kk, vv, pp, hd ** -0.5),
+            jax.ShapeDtypeStruct((1, sq, h, hd), jnp.bfloat16),
+            jax.ShapeDtypeStruct((1, skv, hkv, hd), kdt),
+            jax.ShapeDtypeStruct((1, skv, hkv, hd), kdt),
+            jax.ShapeDtypeStruct((), jnp.int32))
         _probe_cache[key] = True
         return True
     except Exception as e:

@@ -108,20 +108,23 @@ def vmapped_pallas_ok(qtype: str, k: int = 256, n: int = 256) -> bool:
     if hit is not None:
         return hit
     try:
-        import numpy as _np
+        from bigdl_tpu.ops.probing import (probe_compile, quant_struct,
+                                           stacked_struct)
 
-        # escape the caller's jit trace (see ops/attention._kernel_compiles)
-        with jax.ensure_compile_time_eval():
-            one = quantize(jnp.zeros((k, n), jnp.float32), qtype)
-            stack = jax.tree.map(lambda a: jnp.stack([a, a]), one)
-            x = jnp.zeros((2, k), jnp.bfloat16)
+        # compile-only AOT probe (see ops/probing.py) — safe inside the
+        # caller's jit trace, allocates nothing on device
+        stack = stacked_struct(quant_struct(k, n, qtype), 2)
 
+        def probe_fn(idx, x, ws):
             def per(i, row):
-                wi = jax.tree.map(lambda a: a[i], stack)
+                wi = jax.tree.map(lambda a: a[i], ws)
                 return q_matmul_pallas(row[None], wi)[0]
 
-            _np.asarray(jax.jit(jax.vmap(per))(
-                jnp.asarray([0, 1], jnp.int32), x))
+            return jax.vmap(per)(idx, x)
+
+        probe_compile(probe_fn,
+                      jax.ShapeDtypeStruct((2,), jnp.int32),
+                      jax.ShapeDtypeStruct((2, k), jnp.bfloat16), stack)
         ok = True
     except Exception as e:
         import logging

@@ -181,8 +181,6 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int) -> bool:
     contract as ops/attention._kernel_compiles): compiles the REAL tile
     classes on a stand-in sized (kp, bn) so a Mosaic rejection degrades
     to the generic tiling instead of crashing a jitted decode."""
-    import numpy as np
-
     qt = get_qtype(qtype)
     tiles = _gemv_tiles(qt, kp, n)
     if tiles is None:
@@ -197,16 +195,15 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int) -> bool:
     if hit is not None:
         return hit
     try:
-        from bigdl_tpu.ops.quant import quantize
+        from bigdl_tpu.ops.probing import probe_compile, quant_struct
 
-        # escape the caller's jit trace (see ops/attention._kernel_compiles);
-        # jit the call — eager pallas_call has no eval rule for program_id
-        with jax.ensure_compile_time_eval():
-            wq = quantize(jnp.zeros((kp, bn), jnp.float32), qtype)
-            x = jnp.zeros((1, kp), jnp.bfloat16)
-            np.asarray(jax.jit(
-                lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, kp, bn, False,
-                                              xx.dtype))(x, wq))
+        # compile-only AOT probe (see ops/probing.py) — safe inside the
+        # caller's jit trace, allocates nothing on device
+        probe_compile(
+            lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, kp, bn, False,
+                                          jnp.bfloat16),
+            jax.ShapeDtypeStruct((1, kp), jnp.bfloat16),
+            quant_struct(kp, bn, qtype))
         ok = True
     except Exception as e:
         import logging

@@ -187,23 +187,21 @@ def ragged_kernel_compiles(qtype: Optional[str], k: int, n: int) -> bool:
     if hit is not None:
         return hit
     try:
-        import numpy as np
+        from bigdl_tpu.ops.probing import (probe_compile, quant_struct,
+                                           stacked_struct)
 
-        from bigdl_tpu.ops.quant import quantize
-
-        # escape the caller's jit trace (see ops/attention._kernel_compiles)
-        with jax.ensure_compile_time_eval():
-            t = TOKEN_TILE
-            kd = min(2 * bk, k if qtype is None else -(-k // bk) * bk)
-            kd = kd - kd % bk or bk
-            if qtype is None:
-                w = jnp.zeros((2, kd, bn), jnp.bfloat16)
-            else:
-                one = quantize(jnp.zeros((kd, bn), jnp.float32), qtype)
-                w = jax.tree.map(lambda a: jnp.stack([a, a]), one)
-            x = jnp.zeros((t, kd), jnp.bfloat16)
-            te = jnp.zeros((1,), jnp.int32)
-            np.asarray(ragged_expert_matmul(x, w, te))
+        # compile-only AOT probe (see ops/probing.py) — safe inside the
+        # caller's jit trace, allocates nothing on device
+        t = TOKEN_TILE
+        kd = min(2 * bk, k if qtype is None else -(-k // bk) * bk)
+        kd = kd - kd % bk or bk
+        if qtype is None:
+            w = jax.ShapeDtypeStruct((2, kd, bn), jnp.bfloat16)
+        else:
+            w = stacked_struct(quant_struct(kd, bn, qtype), 2)
+        probe_compile(ragged_expert_matmul,
+                      jax.ShapeDtypeStruct((t, kd), jnp.bfloat16), w,
+                      jax.ShapeDtypeStruct((1,), jnp.int32))
         ok = True
     except Exception as e:
         import logging

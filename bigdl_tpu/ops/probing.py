@@ -1,0 +1,56 @@
+"""Compile-only kernel probes.
+
+Per-geometry dispatch probes (ops/attention._kernel_compiles,
+ops/pallas/dequant_matmul.gemv_kernel_compiles, ops/matmul.
+vmapped_pallas_ok, ops/pallas/moe_dispatch.ragged_kernel_compiles) must
+answer "does Mosaic accept this kernel at this geometry?" from INSIDE a
+model's outer jit trace, without crashing it.
+
+The round-2 probes executed a tiny concrete call under
+`jax.ensure_compile_time_eval()`. On a live TPU that shortcut routes the
+pallas kernel-body trace into the eager evaluator, where grid primitives
+have no eval rule — every probe died with "Evaluation rule for
+'program_id' not implemented" and silently pinned every geometry to XLA
+(caught on-chip, round 3: the first real-hardware bench ran 0 of 4
+kernel families).
+
+AOT lower+compile from abstract `ShapeDtypeStruct`s fixes it and is
+strictly better: nothing executes, no device buffers are allocated next
+to a resident multi-GB model, and the fresh `jax.jit(...).lower()`
+trace is independent of any ambient trace, so no tracer ever leaks in
+or out.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def probe_compile(fn, *arg_structs) -> None:
+    """AOT-compile `fn` for the ambient backend from abstract shapes.
+
+    Raises whatever the lowering/compilation raises (the caller's
+    probe classifies it permanent vs transient). Safe while tracing an
+    outer jit: only ShapeDtypeStructs cross the boundary.
+    """
+    jax.jit(fn).lower(*arg_structs).compile()
+
+
+def stacked_struct(tree, n: int):
+    """ShapeDtypeStruct pytree of `tree` with a leading axis of `n`
+    prepended to every leaf (QTensor-safe) — abstract analog of
+    `jax.tree.map(lambda a: jnp.stack([a] * n), tree)`."""
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct((n,) + s.shape, s.dtype), tree)
+
+
+def quant_struct(k: int, n: int, qtype: str):
+    """Abstract QTensor [k, n] for `qtype` — the shapes/dtypes quantize()
+    would produce, computed without materializing anything (eval_shape
+    stays fully abstract for the jnp-only sym/asym/codebook encoders the
+    Pallas kernels support)."""
+    from bigdl_tpu.ops.quant import quantize
+
+    return jax.eval_shape(
+        lambda: quantize(jnp.zeros((k, n), jnp.float32), qtype))

@@ -218,8 +218,8 @@ def test_bench_efficiency_formulas():
     from bigdl_tpu.utils.testing import TINY_LLAMA, random_llama_params
 
     params = random_llama_params(TINY_LLAMA, qtype="sym_int4")
-    out = _efficiency(TINY_LLAMA, params, 32, 8, 256, 100.0, 5.0)
     wb = sum(a.nbytes for a in jax.tree_util.tree_leaves(params))
+    out = _efficiency(TINY_LLAMA, wb, 32, 8, 100.0, 5.0)
     assert out["weight_bytes"] == wb
     cfg = TINY_LLAMA
     s_mid = 32 + 4
@@ -228,3 +228,26 @@ def test_bench_efficiency_formulas():
     ideal = (wb + kv) / (out["peak_hbm_gbps"] * 1e9) * 1e3
     assert abs(out["decode_ideal_ms"] - ideal) <= 1e-6 + ideal * 0.01
     assert out["decode_mfu"] >= 0 and out["prefill_mfu"] >= 0
+
+
+def test_bench_physics_floors(monkeypatch):
+    """Floors reject timings no hardware could produce (poisoned-buffer
+    detection added after the first live-chip session, where a crashed
+    runtime returned sub-ms '7B decode' timings)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _floors
+    from bigdl_tpu.utils.testing import LLAMA2_7B
+
+    # the assertions below encode the v5e datasheet peaks
+    monkeypatch.delenv("BIGDL_TPU_PEAK_BF16_TFLOPS", raising=False)
+    monkeypatch.delenv("BIGDL_TPU_PEAK_HBM_GBPS", raising=False)
+    dfloor, pfloor = _floors(LLAMA2_7B, 3_979_157_504, 1024)
+    assert 3.0 < dfloor < 5.0     # ~3.9ms: 3.97GB @ 819GB/s x 0.8
+    assert 30.0 < pfloor < 60.0   # ~34ms: 13.2 GFLOP/tok x 1024 @ peak x 0.5
+    # real round-3 numbers pass, poisoned ones fail
+    assert 30.25 > dfloor and 267.2 > pfloor
+    assert 0.0 < dfloor and 0.9 < pfloor
