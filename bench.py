@@ -62,10 +62,16 @@ DECODE_STEPS = 64
 MAX_SEQ = 2048
 CONFIG_TIMEOUT_S = int(os.environ.get("BENCH_CONFIG_TIMEOUT_S", "1500"))
 
-# (label, flag overrides) — the dispatch configurations to A/B on TPU
+# (label, flag overrides) — the dispatch configurations to A/B on TPU.
+# "pallas+gemv" is the shipped default: Pallas kernels at decode-class M,
+# XLA matmul above matmul_pallas_max_m (prefill). "pallas-all-m" forces
+# the dequant kernel at every M to re-check that threshold on chip.
 AB_CONFIGS = [
     ("pallas+gemv", dict(matmul_backend="auto", attention_backend="auto",
                          matmul_gemv="auto")),
+    ("pallas-all-m", dict(matmul_backend="auto", attention_backend="auto",
+                          matmul_gemv="auto",
+                          matmul_pallas_max_m=1 << 30)),
     ("pallas", dict(matmul_backend="auto", attention_backend="auto",
                     matmul_gemv="off")),
     ("xla-matmul", dict(matmul_backend="xla", attention_backend="auto",

@@ -31,6 +31,14 @@ class RuntimeFlags:
     # decode GEMV (M<=16) kernel variant: "auto" (use it), "off" (route
     # small-M through the generic tiles) — the on-chip A/B switch
     matmul_gemv: str = "auto"
+    # In "auto" matmul dispatch, batch rows above this go to the XLA
+    # matmul instead of the Pallas dequant kernel. First on-chip A/B
+    # (v5e, llama2-7B INT4): XLA wins prefill-class M (197.9 vs 267.2ms
+    # first token at M=1024) while Pallas wins decode-class M (30.2 vs
+    # 74.1ms/token) — the dequant is VPU-bound, so at MXU-bound M the
+    # dequantize-then-matmul XLA plan is faster. Forced "pallas" mode
+    # ignores this.
+    matmul_pallas_max_m: int = 128
     # MoE prefill dispatch: "auto" (sorted ragged kernel on TPU, dense
     # combine elsewhere), "ragged" (force, incl. interpret), "dense"
     moe_dispatch: str = "auto"
@@ -57,6 +65,8 @@ class RuntimeFlags:
             attention_backend=os.environ.get(
                 "BIGDL_TPU_ATTENTION_BACKEND", "auto"),
             matmul_gemv=os.environ.get("BIGDL_TPU_MATMUL_GEMV", "auto"),
+            matmul_pallas_max_m=int(os.environ.get(
+                "BIGDL_TPU_MATMUL_PALLAS_MAX_M", "128")),
             moe_dispatch=os.environ.get("BIGDL_TPU_MOE_DISPATCH", "auto"),
             disable_native=_env_bool("BIGDL_TPU_DISABLE_NATIVE"),
             native_cache_dir=os.environ.get("BIGDL_TPU_NATIVE_CACHE"),

@@ -60,10 +60,18 @@ def _q_matmul_dispatch(x: jax.Array, w: QTensor, be: str) -> jax.Array:
     if be == "xla":
         return _q_matmul_xla(x, w)
     if be in ("auto", "pallas"):
-        from bigdl_tpu.config import target_is_tpu, under_spmd
+        from bigdl_tpu.config import flags, target_is_tpu, under_spmd
 
         use_pallas = (w.qtype in _PALLAS_QTYPES and target_is_tpu()
                       and not under_spmd(x, *jax.tree_util.tree_leaves(w)))
+        if be == "auto" and use_pallas:
+            # prefill-class M: the dequant kernel is VPU-bound while the
+            # XLA dequantize-then-matmul plan rides the MXU (on-chip A/B
+            # in RuntimeFlags.matmul_pallas_max_m's docstring)
+            m = 1
+            for dim in x.shape[:-1]:
+                m *= dim
+            use_pallas = m <= flags().matmul_pallas_max_m
         if be == "pallas" or use_pallas:
             try:
                 from bigdl_tpu.ops.pallas.dequant_matmul import (

@@ -66,3 +66,30 @@ def test_q_matmul_under_jit():
 
     y = f(x, qt)
     assert y.shape == (4, n)
+
+
+def test_auto_dispatch_m_threshold(monkeypatch):
+    """Auto dispatch sends decode-class M to the Pallas dequant kernel and
+    prefill-class M to the XLA matmul (matmul_pallas_max_m; thresholds
+    from the first on-chip A/B — see RuntimeFlags docstring)."""
+    import bigdl_tpu.ops.pallas.dequant_matmul as dq
+    from bigdl_tpu.config import set_flags
+    from bigdl_tpu.ops.matmul import _q_matmul_xla
+
+    w = quantize(_rand((64, 64)) * 0.05, "sym_int4")
+    seen = []
+
+    def fake_impl(x, wq, **kw):
+        seen.append(int(x.shape[0]))
+        return _q_matmul_xla(x, wq)
+
+    monkeypatch.setattr(dq, "q_matmul_pallas_impl", fake_impl)
+    set_flags(aot_target="tpu", matmul_pallas_max_m=128)
+    try:
+        q_matmul(jnp.ones((8, 64), jnp.bfloat16), w)     # decode-class
+        q_matmul(jnp.ones((512, 64), jnp.bfloat16), w)   # prefill-class
+        # forced pallas ignores the threshold
+        q_matmul(jnp.ones((512, 64), jnp.bfloat16), w, backend="pallas")
+    finally:
+        set_flags(aot_target=None, matmul_pallas_max_m=128)
+    assert seen == [8, 512]
