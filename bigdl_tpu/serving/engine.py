@@ -20,9 +20,11 @@ fully static:
 - Prefill is compiled per prompt-length bucket and writes K/V straight
   into the batched cache at the slot index.
 - Scheduling is FCFS admission (the reference's FixedWindowScheduler
-  semantics) driven from `step()`; sampling runs on host per-slot so every
-  request can carry its own temperature/top-k/top-p (the reference's
-  BigDLSampler is also host-side).
+  semantics) driven from `step()`. Sampling: per-slot temperature/top-k/
+  top-p/seed runs batched ON DEVICE (gumbel-max; only [B] ints cross the
+  tunnel); slots needing penalty counts or logprobs fall back to the
+  host sampler (the reference's BigDLSampler role, which is host-side
+  for every request).
 """
 
 from __future__ import annotations
@@ -147,7 +149,8 @@ class EngineConfig:
 
 class _Slot:
     __slots__ = ("req", "generated", "last_token", "active", "counts",
-                 "counts_out", "rng", "cum_logprob", "n_logprobs")
+                 "counts_out", "rng", "cum_logprob", "n_logprobs",
+                 "dev_seed")
 
     def __init__(self):
         self.req: Optional[Request] = None
@@ -162,6 +165,9 @@ class _Slot:
         self.rng: Optional[np.random.Generator] = None
         self.cum_logprob: float = 0.0              # over generated tokens
         self.n_logprobs: int = 0
+        # 31-bit seed for the DEVICE sampler stream (SamplingParams.seed
+        # folded down, or a per-admission nonce when unseeded)
+        self.dev_seed: int = 0
 
 
 @dataclasses.dataclass
@@ -287,13 +293,51 @@ class LLMEngine:
             return logits[:, -1, :], cache
 
         self._decode = decode
-        # greedy fast path: when every active slot samples greedily with
-        # no penalties/logprobs, argmax on DEVICE and transfer [B] ints
-        # instead of the [B, V] logits (V=32k at batch 8 is ~1MB of D2H
-        # per token on a tunneled chip; this is the reference's
-        # BigDLSampler cost knocked off the hot path)
+        # greedy fast path: one fused argmax, [B] ints across the tunnel
         self._argmax = jax.jit(
             lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32))
+        # batched DEVICE sampler: temperature / top-k / top-p via
+        # gumbel-max, one seeded stream per slot. Serves every slot that
+        # needs no penalty counts and no logprobs — the [B, V] logits
+        # never leave the chip for such batches, extending the greedy
+        # fast path to sampled traffic (host _sample_host remains the
+        # full-featured path). Seeded slots derive their key from
+        # (seed, absolute position), so a preempt-resume — or a change
+        # in WHICH other requests share the batch — replays identically.
+        @jax.jit
+        def sample_device(lg, temps, top_ks, top_ps, seeds, poss):
+            lg = lg.astype(jnp.float32)                      # [B, V]
+            v = lg.shape[-1]
+            greedy = temps <= 0.0
+            t = lg / jnp.maximum(temps, 1e-6)[:, None]
+            # top-k: per-row threshold from the sorted copy (k=0 -> all;
+            # greedy rows keep all, their argmax ignores masking anyway)
+            k = jnp.where(greedy | (top_ks <= 0), v, top_ks)
+            sd = -jnp.sort(-t, axis=-1)
+            kth = jnp.take_along_axis(
+                sd, jnp.clip(k - 1, 0, v - 1)[:, None], axis=-1)
+            t = jnp.where(t < kth, -jnp.inf, t)
+            # top-p (nucleus) on the post-top-k distribution: keep the
+            # smallest sorted prefix whose mass reaches p (first always)
+            p = jnp.where(greedy, 1.0, top_ps)[:, None]
+            sd = -jnp.sort(-t, axis=-1)
+            probs = jax.nn.softmax(sd, axis=-1)
+            keep = (jnp.cumsum(probs, axis=-1) - probs) < p
+            # the top token survives even top_p=0.0 (OpenAI clients send
+            # it to mean greedy; all-False keep would mask every token)
+            keep = keep | (jnp.arange(v)[None, :] == 0)
+            cutoff = jnp.min(jnp.where(keep, sd, jnp.inf), axis=-1)
+            t = jnp.where(t < cutoff[:, None], -jnp.inf, t)
+
+            def row(row_t, row_lg, g, seed, pos):
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+                gum = jax.random.gumbel(key, row_t.shape, row_t.dtype)
+                z = jnp.where(g, row_lg, row_t + gum)
+                return jnp.argmax(z).astype(jnp.int32)
+
+            return jax.vmap(row)(t, lg, greedy, seeds, poss)
+
+        self._sample_device = sample_device
 
         # prefill one sequence on a private 1-row cache, then splice its K/V
         # and position into the batched cache at the slot index
@@ -489,8 +533,8 @@ class LLMEngine:
             s = self.slots[a.slot_idx]
             s.req = a.req
             self._setup_slot_sampler(s)
-            first, lp = self._sample_host(
-                np.asarray(logits)[0, plen - 1 - start], s)
+            first, lp = self._sample_admission(
+                logits[:, plen - 1 - start], s)
             s.generated = [int(first)]
             s.last_token = int(first)
             s.active = True
@@ -581,6 +625,10 @@ class LLMEngine:
         # PER TOKEN from (seed, absolute position) in _sample_host, so a
         # preempt-resume replays identically to an uninterrupted run.
         s.rng = np.random.default_rng() if p.seed is None else None
+        # device-sampler stream: user seed folded to 31 bits, or a fresh
+        # nonce per admission (unseeded requests promise no replay)
+        s.dev_seed = (int(p.seed) & 0x7FFFFFFF if p.seed is not None
+                      else int(np.random.default_rng().integers(1 << 31)))
         s.cum_logprob = s.req.resumed_cum_logprob
         # rank scores are only consumed when best_of oversamples (> n);
         # don't pay the per-token host log-softmax otherwise
@@ -605,6 +653,26 @@ class LLMEngine:
         else:
             s.counts = None
             s.counts_out = None
+
+    def _sample_admission(self, lg_dev, s: _Slot
+                          ) -> Tuple[int, Optional[LogprobEntry]]:
+        """First token after an (re)admission prefill (lg_dev: [1, V] on
+        device). Simple slots draw from the SAME device stream as decode
+        steps — without this, a seeded request's resume-recompute token
+        came from the host stream and diverged from an uninterrupted
+        run (caught by test_seeded_sampling_survives_preemption)."""
+        p = s.req.params
+        if s.counts is None and s.n_logprobs < 0:
+            pos = s.req.generated_offset     # position 0 of this resume
+            tok = int(np.asarray(self._sample_device(
+                lg_dev,
+                jnp.asarray([p.temperature], jnp.float32),
+                jnp.asarray([p.top_k], jnp.int32),
+                jnp.asarray([p.top_p], jnp.float32),
+                jnp.asarray([s.dev_seed], jnp.int32),
+                jnp.asarray([pos], jnp.int32)))[0])
+            return tok, None
+        return self._sample_host(np.asarray(lg_dev)[0], s)
 
     def _sample_host(self, logits: np.ndarray, s: _Slot
                      ) -> Tuple[int, Optional[LogprobEntry]]:
@@ -952,19 +1020,48 @@ class LLMEngine:
             self.params, jnp.asarray(tokens), self.cache)
 
         def simple(s: _Slot) -> bool:
-            return (s.req.params.temperature <= 0.0 and s.counts is None
-                    and s.n_logprobs < 0)
+            # no penalty counts, no logprobs: the device sampler covers
+            # it (any temperature / top-k / top-p / seed)
+            return s.counts is None and s.n_logprobs < 0
 
-        if all(simple(self.slots[i]) for i in active):
+        simple_rows = [i for i in active if simple(self.slots[i])]
+        complex_rows = [i for i in active if not simple(self.slots[i])]
+        toks = None
+        if simple_rows and all(
+                self.slots[i].req.params.temperature <= 0.0
+                for i in simple_rows):
+            # all-greedy fast path: one fused argmax, no sampling-param
+            # transfers (the default-traffic hot path)
             toks = np.asarray(self._argmax(logits_dev))
+        elif simple_rows:
+            b = self.cfg_engine.max_batch
+            temps = np.zeros((b,), np.float32)
+            top_ks = np.zeros((b,), np.int32)
+            top_ps = np.ones((b,), np.float32)
+            seeds = np.zeros((b,), np.int32)
+            poss = np.zeros((b,), np.int32)
+            for i in simple_rows:
+                s = self.slots[i]
+                p = s.req.params
+                temps[i] = p.temperature
+                top_ks[i] = p.top_k
+                top_ps[i] = p.top_p
+                seeds[i] = s.dev_seed
+                poss[i] = s.req.generated_offset + len(s.generated)
+            # runs for EVERY batch containing a simple slot (not only
+            # all-simple ones): a seeded request must sample from the
+            # same stream whether or not a penalties/logprobs request
+            # happens to share the batch
+            toks = np.asarray(self._sample_device(
+                logits_dev, jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), jnp.asarray(seeds),
+                jnp.asarray(poss)))
+        logits = np.asarray(logits_dev) if complex_rows else None
 
-            def pick(i):
+        def pick(i):
+            if simple(self.slots[i]):
                 return int(toks[i]), None
-        else:
-            logits = np.asarray(logits_dev)
-
-            def pick(i):
-                return self._sample_host(logits[i], self.slots[i])
+            return self._sample_host(logits[i], self.slots[i])
 
         for i in active:
             s = self.slots[i]

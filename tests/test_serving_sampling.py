@@ -289,3 +289,49 @@ def test_openai_endpoint_penalties_n_logprobs(model):
         assert out2["usage"]["completion_tokens"] == 8
     finally:
         server.shutdown()
+
+
+def test_topk1_any_temperature_is_greedy(model):
+    """top_k=1 pins the device sampler to argmax regardless of
+    temperature (gumbel noise cannot reorder a single candidate)."""
+    eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128))
+    g, _ = run_one(eng, "g", [2, 4, 6], SamplingParams(max_tokens=10))
+    k1, _ = run_one(eng, "k", [2, 4, 6], SamplingParams(
+        max_tokens=10, temperature=3.0, top_k=1))
+    assert k1[0] == g[0]
+
+
+def test_top_p_epsilon_is_greedy(model):
+    """A vanishing nucleus keeps only the most-probable token."""
+    eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128))
+    g, _ = run_one(eng, "g", [2, 4, 6], SamplingParams(max_tokens=10))
+    p_, _ = run_one(eng, "p", [2, 4, 6], SamplingParams(
+        max_tokens=10, temperature=2.0, top_p=1e-6))
+    assert p_[0] == g[0]
+
+
+def test_seeded_output_independent_of_batch_composition(model):
+    """A seeded request samples from the same device stream whether it
+    runs alone or co-batched with a host-sampled (penalties) request —
+    the device sampler serves simple rows in mixed batches too."""
+    p = SamplingParams(max_tokens=12, temperature=0.9, top_k=8, seed=7)
+    eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128))
+    alone, _ = run_one(eng, "a", [5, 6, 7], p)
+
+    eng2 = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128))
+    eng2.add_request("noise", [3, 9, 3, 9], SamplingParams(
+        max_tokens=60, repetition_penalty=1.3))
+    for _ in range(3):
+        eng2.step()                    # noise decoding on the host path
+    mixed, _ = run_one(eng2, "b", [5, 6, 7], p)
+    assert mixed[0] == alone[0]
+
+
+def test_top_p_zero_is_greedy(model):
+    """OpenAI clients send top_p=0 to mean greedy; the device sampler
+    must keep the top token rather than masking everything to -inf."""
+    eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128))
+    g, _ = run_one(eng, "g", [2, 4, 6], SamplingParams(max_tokens=10))
+    z, _ = run_one(eng, "z", [2, 4, 6], SamplingParams(
+        max_tokens=10, temperature=1.0, top_p=0.0))
+    assert z[0] == g[0]

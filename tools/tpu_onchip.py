@@ -21,6 +21,25 @@ import time
 
 STEP_TIMEOUT = int(os.environ.get("ONCHIP_STEP_TIMEOUT", "600"))
 
+
+def _backend_alive(timeout_s: int = 60) -> bool:
+    """Quick out-of-process probe: does a fresh process still get a TPU?
+    Compares the printed backend name — a dead tunnel can make JAX fall
+    back to CPU, which exits 0 but means the chip is gone."""
+    if os.environ.get("ONCHIP_FORCE_CPU"):
+        return True              # smoke-testing the harness without a chip
+    code = ("import jax, jax.numpy as jnp;"
+            "jnp.ones((2,2)).block_until_ready();"
+            "print(jax.default_backend())")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           timeout=timeout_s, capture_output=True,
+                           text=True)
+        out = r.stdout.strip().splitlines()
+        return r.returncode == 0 and bool(out) and out[-1] == "tpu"
+    except subprocess.TimeoutExpired:
+        return False
+
 if os.environ.get("ONCHIP_FORCE_CPU"):
     # smoke-testing the suite itself without a chip: the ambient axon
     # plugin prepends itself to jax_platforms regardless of JAX_PLATFORMS,
@@ -336,6 +355,18 @@ def main():
         results.append(rec)
         with open("tpu_runs/onchip_results.jsonl", "a") as f:
             f.write(json.dumps(rec) + "\n")
+        if not rec["ok"] and not _backend_alive():
+            # a kernel fault can wedge the tunnel server-side; record it
+            # and stop instead of timing out every remaining step
+            rec2 = {"step": "_abort", "ok": False,
+                    "error": "backend stopped answering after "
+                             f"'{name}' failed; remaining steps skipped",
+                    "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+            print(json.dumps(rec2), flush=True)
+            results.append(rec2)
+            with open("tpu_runs/onchip_results.jsonl", "a") as f:
+                f.write(json.dumps(rec2) + "\n")
+            break
     n_ok = sum(r["ok"] for r in results)
     print(json.dumps({"summary": f"{n_ok}/{len(results)} steps ok"}))
 

@@ -11,8 +11,9 @@ while true; do
     echo "$ts tunnel ALIVE — running on-chip suite" >> tpu_runs/watch.log
     timeout 1800 python -u tools/tpu_onchip.py > "tpu_runs/onchip_$ts.log" 2>&1
     echo "$ts onchip exit=$?" >> tpu_runs/watch.log
-      # budget: one BENCH_CONFIG_TIMEOUT_S (default 1500s) per A/B config
-    bt=${BENCH_CONFIG_TIMEOUT_S:-1500}
+      # budget: one BENCH_CONFIG_TIMEOUT_S per A/B config (default read
+    # from bench.py so the two never drift)
+    bt=${BENCH_CONFIG_TIMEOUT_S:-$(python -c "import bench; print(bench.CONFIG_TIMEOUT_S)" 2>/dev/null || echo 900)}
     ncfg=$(python -c "import bench; print(len(bench.AB_CONFIGS))" 2>/dev/null || echo 8)
     timeout $((ncfg * bt + 1500)) python -u bench.py > "tpu_runs/bench_$ts.json" 2> "tpu_runs/bench_$ts.log"
     echo "$ts bench exit=$?" >> tpu_runs/watch.log
