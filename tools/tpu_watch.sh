@@ -17,6 +17,10 @@ while true; do
     ncfg=$(python -c "import bench; print(len(bench.AB_CONFIGS))" 2>/dev/null || echo 8)
     timeout $((ncfg * bt + 1500)) python -u bench.py > "tpu_runs/bench_$ts.json" 2> "tpu_runs/bench_$ts.log"
     echo "$ts bench exit=$?" >> tpu_runs/watch.log
+    timeout 1800 python -u bench_qlora.py > "tpu_runs/qlora_$ts.json" 2> "tpu_runs/qlora_$ts.log"
+    echo "$ts bench_qlora exit=$?" >> tpu_runs/watch.log
+    timeout 2400 python -u bench_serving.py > "tpu_runs/serving_$ts.json" 2> "tpu_runs/serving_$ts.log"
+    echo "$ts bench_serving exit=$?" >> tpu_runs/watch.log
     sleep 60
   else
     echo "$ts tunnel dead" >> tpu_runs/watch.log
