@@ -69,6 +69,8 @@ CONFIG_TIMEOUT_S = int(os.environ.get("BENCH_CONFIG_TIMEOUT_S", "900"))
 AB_CONFIGS = [
     ("pallas+gemv", dict(matmul_backend="auto", attention_backend="auto",
                          matmul_gemv="auto")),
+    ("gemv-fold", dict(matmul_backend="auto", attention_backend="auto",
+                       matmul_gemv="fold")),
     ("pallas-all-m", dict(matmul_backend="auto", attention_backend="auto",
                           matmul_gemv="auto",
                           matmul_pallas_max_m=1 << 30)),

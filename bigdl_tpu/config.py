@@ -28,8 +28,11 @@ class RuntimeFlags:
     matmul_backend: str = "auto"
     # decode-attention dispatch, same values (ops/pallas/decode_attention)
     attention_backend: str = "auto"
-    # decode GEMV (M<=16) kernel variant: "auto" (use it), "off" (route
-    # small-M through the generic tiles) — the on-chip A/B switch
+    # decode GEMV (M<=16) kernel variant: "auto" (use it), "fold"
+    # (scale-folded body: raw codes on the MXU, scales applied to the
+    # per-block partials — fewer VPU ops per weight on the HBM/VPU-bound
+    # decode path), "off" (route small-M through the generic tiles) —
+    # the on-chip A/B switch
     matmul_gemv: str = "auto"
     # In "auto" matmul dispatch, batch rows above this go to the XLA
     # matmul instead of the Pallas dequant kernel. First on-chip A/B

@@ -148,6 +148,60 @@ def _gemv_kernel(x_ref, data_ref, scale_ref, *rest, block, kind, codebook,
                 k_axis=1)
 
 
+def _gemv_kernel_fold(x3_ref, data_ref, scale_ref, out_ref, acc_ref, *,
+                      block, kind, codebook, bk, bn, nk, bits):
+    """Scale-FOLDED decode-GEMV body (sym/codebook formats).
+
+    The standard kernel multiplies every weight by its block scale before
+    the matmul — a per-weight VPU multiply plus a bf16 rounding of each
+    dequantized weight. Scales factor out of the contraction:
+
+        y[m, n] = sum_r scale[r, n] * sum_{k in block r} x[m, k] c[k, n]
+
+    so this variant feeds the MXU the RAW (shifted/LUT) codes as one
+    batched-over-blocks dot_general and applies scales to the [rows, M,
+    bn] partials in f32 — per-weight work drops to unpack+shift+convert,
+    and the scale multiply touches M/block as many elements. Numerics
+    are slightly better than the standard path (scale applied once in
+    f32, codes exact in bf16). Asym formats keep the standard kernel
+    (the zero-point adds a rank-1 correction term not worth the fuss).
+
+    x arrives PRE-SPLIT as [M, K/block, block] (host-side reshape):
+    splitting x's lane dimension inside the kernel is a Mosaic
+    "unsupported shape cast" (caught by the AOT suite)."""
+    k = pl.program_id(1)
+    rows = bk // block
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    if bits == 4:
+        codes = _unpack_tile(data_ref[:], block, bk, bn)  # [rows, B, bn]
+        if kind == "codebook":
+            c = codes
+            tbl = list(codebook) + [0.0] * (16 - len(codebook))
+            vals = jnp.full(c.shape, tbl[0], jnp.float32)
+            for i in range(1, 16):
+                vals = jnp.where(c == i, tbl[i], vals)
+            cb = vals.astype(jnp.bfloat16)
+        else:                                    # sym int4
+            cb = (codes.astype(jnp.float32) - 8.0).astype(jnp.bfloat16)
+    else:                                        # sym int8
+        cb = data_ref[:].reshape(rows, block, bn).astype(jnp.bfloat16)
+
+    # batched over scale blocks: [M, rows, B] x [rows, B, bn]
+    part = jax.lax.dot_general(
+        x3_ref[:], cb, (((2,), (1,)), ((1,), (0,))),
+        preferred_element_type=jnp.float32)      # [rows, M, bn]
+    s = scale_ref[:].astype(jnp.float32)         # [rows, bn]
+    acc_ref[:] += jnp.sum(part * s[:, None, :], axis=0)
+
+    @pl.when(k == nk - 1)
+    def _():
+        out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+
 def _scale_rows_ok(bk: int, b: int, kp: int) -> bool:
     """The streamed scale block [bk//b, bn] must satisfy Mosaic's block
     tiling: second-to-last dim divisible by 8, or equal to the full
@@ -176,7 +230,8 @@ def _gemv_tiles(qt, kp: int, n: int):
 _gemv_probe_cache: dict = {}
 
 
-def gemv_kernel_compiles(qtype: str, kp: int, n: int) -> bool:
+def gemv_kernel_compiles(qtype: str, kp: int, n: int,
+                         fold: bool = False) -> bool:
     """Eager per-geometry probe for the decode-GEMV variant (same
     contract as ops/attention._kernel_compiles): compiles the REAL tile
     classes on a stand-in sized (kp, bn) so a Mosaic rejection degrades
@@ -190,7 +245,7 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int) -> bool:
     if _flags().aot_target == "tpu":   # AOT lowering: trust the dispatch
         return True
     bk, bn = tiles
-    key = (qtype, kp, bn, bk)
+    key = (qtype, kp, bn, bk, fold)
     hit = _gemv_probe_cache.get(key)
     if hit is not None:
         return hit
@@ -201,7 +256,7 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int) -> bool:
         # caller's jit trace, allocates nothing on device
         probe_compile(
             lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, kp, bn, False,
-                                          jnp.bfloat16),
+                                          jnp.bfloat16, fold=fold),
             jax.ShapeDtypeStruct((1, kp), jnp.bfloat16),
             quant_struct(kp, bn, qtype))
         ok = True
@@ -209,16 +264,16 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int) -> bool:
         import logging
 
         logging.getLogger(__name__).warning(
-            "pallas decode-GEMV variant unavailable for (K=%d, N=%d, %s) "
-            "— %s: %s; using the generic tiles", kp, n, qtype,
-            type(e).__name__, e)
+            "pallas decode-GEMV variant unavailable for (K=%d, N=%d, %s"
+            "%s) — %s: %s; using the generic tiles", kp, n, qtype,
+            ", fold" if fold else "", type(e).__name__, e)
         ok = False
     _gemv_probe_cache[key] = ok
     return ok
 
 
 def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
-                   interpret: bool, out_dtype=None):
+                   interpret: bool, out_dtype=None, fold: bool = False):
     """bs<=16 decode GEMV (the reference's `linear_fp16_esimd` decode
     GEMV role, low_bit_linear.py:744-745). M pads to one 16-row tile; x
     [16, K] and the scale column block are VMEM-resident for the whole K
@@ -248,10 +303,20 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
     bits = qt.storage_bits
     data_spec = pl.BlockSpec((bk // 2 if bits == 4 else bk, bn),
                              lambda j, k: (k, j))
-    kernel = functools.partial(
-        _gemv_kernel, block=b, kind=qt.kind, codebook=codebook,
-        bk=bk, bn=bn, nk=nk, bits=bits)
-    operands = [x2, w.data, w.scale]
+    if fold and qt.kind != "asym":
+        kernel = functools.partial(
+            _gemv_kernel_fold, block=b, kind=qt.kind, codebook=codebook,
+            bk=bk, bn=bn, nk=nk, bits=bits)
+        # x pre-split per scale block OUTSIDE the kernel (lane-dim
+        # reshapes inside are a Mosaic unsupported shape cast)
+        operands0 = x2.reshape(mp, kp // b, b)
+        x_spec = pl.BlockSpec((mp, bk // b, b), lambda j, k: (0, k, 0))
+    else:
+        kernel = functools.partial(
+            _gemv_kernel, block=b, kind=qt.kind, codebook=codebook,
+            bk=bk, bn=bn, nk=nk, bits=bits)
+        operands0 = x2
+    operands = [operands0, w.data, w.scale]
     in_specs = [x_spec, data_spec, scale_spec]
     if qt.kind == "asym":
         operands.append(w.zero)
@@ -293,11 +358,12 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
 
     from bigdl_tpu.config import flags
 
+    fold = flags().matmul_gemv == "fold" and qt.kind != "asym"
     if m <= 16 and flags().matmul_gemv != "off" and (
-            interpret or gemv_kernel_compiles(w.qtype, kp, n)):
+            interpret or gemv_kernel_compiles(w.qtype, kp, n, fold=fold)):
         try:
             y = _q_gemv_pallas(x2, w, qt, m, kp, n, interpret,
-                               out_dtype=x.dtype)
+                               out_dtype=x.dtype, fold=fold)
             return y.reshape(*batch_shape, n)
         except NotImplementedError:
             pass      # fall through to the generic tiling

@@ -97,6 +97,13 @@ def test_dequant_gemv_compiles(v5e, aot_flags, qtype, n):
         lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, k, n, False, xx.dtype),
         _sds(x, dev), _sds(wq, dev))
     assert _has_mosaic_call(comp)
+    # scale-folded body (raw codes on the MXU, scales on the partials)
+    if qt.kind != "asym":
+        comp = _compile(
+            lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, k, n, False,
+                                          xx.dtype, fold=True),
+            _sds(x, dev), _sds(wq, dev))
+        assert _has_mosaic_call(comp)
 
 
 @pytest.mark.parametrize("b,s,h,hkv,hd,kvdt", [

@@ -98,3 +98,28 @@ def test_gemv_padded_k():
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         rtol=3e-2, atol=3e-2,
     )
+
+
+@pytest.mark.parametrize(
+    "qtype", ["sym_int4", "nf4", "sym_int8", "asym_int4"])
+def test_gemv_fold_variant_matches_reference(qtype):
+    """The scale-folded GEMV body (raw codes on the MXU, scales applied
+    to per-block partials) must match the dequant reference; asym
+    formats silently keep the standard body under matmul_gemv=fold."""
+    from bigdl_tpu.config import set_flags
+
+    k, n = 1024, 256
+    x = _rand((1, k), seed=11) * 0.3
+    qt = quantize(_rand((k, n), seed=12) * 0.1, qtype)
+    try:
+        set_flags(matmul_gemv="fold")
+        jax.clear_caches()       # flags are read at trace time
+        got = q_matmul_pallas(x, qt, interpret=True)
+    finally:
+        set_flags(matmul_gemv="auto")
+        jax.clear_caches()
+    want = _q_matmul_xla(x, qt)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
