@@ -89,6 +89,9 @@ def main() -> None:
     eng.generate(prompts[:batch],
                  SamplingParams(max_tokens=4, temperature=0.8, top_k=32,
                                 seed=0))
+    # ...and the all-greedy argmax fast path: when a wave tail drains to
+    # only greedy slots mid-window, that compile must already be cached
+    eng.generate(prompts[:2], SamplingParams(max_tokens=4))
 
     t0 = time.perf_counter()
     for i, (p, sp) in enumerate(zip(prompts, params_of)):
@@ -108,14 +111,17 @@ def main() -> None:
 
     peak_tflops, peak_gbps = chip_peaks()
     ceiling = batch / (weight_bytes / (peak_gbps * 1e9))
-    poisoned = on_tpu and (done < n_requests or tput > ceiling / 0.8)
+    # two distinct failure modes (ADVICE r3): a deadline expiry is a
+    # real-but-slow run (or a wedged tunnel), NOT poisoned buffers
+    timed_out = on_tpu and done < n_requests
+    poisoned = on_tpu and tput > ceiling / 0.8
 
     out = {
         "metric": ("llama2_7b_int4_serving_tokens_per_s" if on_tpu
                    else "cpu_fallback_smoke_serving_tokens_per_s"),
         "value": round(tput, 1),
         "unit": "tokens/s",
-        "valid": bool(on_tpu) and not poisoned,
+        "valid": bool(on_tpu) and not poisoned and not timed_out,
         "batch": batch,
         "n_requests": n_requests,
         "prompt_len": prompt_len,
@@ -129,8 +135,12 @@ def main() -> None:
         "qtype": "sym_int4",
     }
     if poisoned:
-        out["note"] = ("throughput beat the HBM ceiling or requests "
-                       "never finished — runtime did not execute")
+        out["note"] = ("throughput beat the HBM ceiling — runtime did "
+                       "not execute (poisoned buffers)")
+    elif timed_out:
+        out["note"] = (f"deadline expired with {done}/{n_requests} "
+                       "requests complete — run was real but too slow "
+                       "(or the tunnel wedged mid-run)")
     print(json.dumps(out))
 
 

@@ -110,7 +110,104 @@ def tp_param_specs(params: Any, mesh: Mesh, axis: str = "tp") -> Any:
     return specs
 
 
+def _ff_padded(ff: int, n: int) -> int:
+    """Global intermediate size padded so each tp shard's ff slice is a
+    128-lane multiple. An unaligned shard (e.g. 11008/4 = 2752, which is
+    21.5 x 128) can never satisfy the Pallas matmul's bn tiling, so the
+    whole MLP would decode on the slow XLA dequant path (VERDICT r3 #4).
+    Zero-padding is EXACT: padded gate/up columns carry zero scales, so
+    they dequantize to 0, the activation is act(0)*0 = 0, and the padded
+    down-proj rows are zero too. Tiny test models stay untouched."""
+    if ff < 2048 or n <= 1:
+        return ff
+    per = -(-ff // n)
+    per = -(-per // 128) * 128
+    return per * n
+
+
+def _pad_axis(a, axis: int, new: int):
+    pad = new - a.shape[axis]
+    if pad <= 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    if isinstance(a, jax.core.Tracer) or not hasattr(a, "shape"):
+        return jnp.pad(a, widths)
+    # concrete values pad on HOST: jnp.pad would materialize each full
+    # padded weight on device 0 before the sharded device_put, a
+    # transient whole-model-on-one-chip HBM spike at load time
+    return np.pad(np.asarray(a), widths)
+
+
+def _pad_ff_leaf(w, ff_new: int, axis_kind: str):
+    """Zero-pad one (possibly layer-stacked) weight along its ff dim.
+    axis_kind "n": gate/up (+biases) — last axis. "k": down-proj — the
+    K axis; every QTensor plane's row count scales proportionally."""
+    import dataclasses as dc
+
+    from bigdl_tpu.ops.quant import QTensor
+
+    if w is None:
+        return None
+    if isinstance(w, QTensor):
+        if axis_kind == "n":
+            if w.data.shape[-1] >= ff_new:
+                return w
+            rep = {f: _pad_axis(getattr(w, f), -1, ff_new)
+                   for f in ("data", "scale", "zero", "aux")
+                   if getattr(w, f) is not None}
+            return dc.replace(w, shape=(w.shape[0], ff_new), **rep)
+        kp = w.scale.shape[-2] * w.qt.block_size
+        if kp >= ff_new:
+            return w
+        rep = {}
+        for f in ("data", "scale", "zero", "aux"):
+            p = getattr(w, f)
+            if p is None:
+                continue
+            rep[f] = _pad_axis(p, -2, p.shape[-2] * ff_new // kp)
+        return dc.replace(w, shape=(ff_new, w.shape[1]), **rep)
+    return _pad_axis(w, -1 if axis_kind == "n" else -2, ff_new)
+
+
+def pad_ff_for_tp(params: Any, n: int) -> Any:
+    """Pad the per-layer MLP weights (ff dim) and the untied lm_head
+    (vocab dim) so their tp shards are lane-aligned (no-op when already
+    aligned). Exact — see `_ff_padded`; padded lm_head columns carry
+    zero scales and the local forward slices the gathered logits back
+    to the true vocab."""
+    from bigdl_tpu.ops.quant import QTensor
+
+    layers = params.get("layers")
+    new_params = params
+    if isinstance(layers, dict) and "down_proj" in layers:
+        gate = layers.get("gate_proj", layers.get("up_proj"))
+        if gate is not None:
+            ff = gate.shape[1] if isinstance(gate, QTensor) \
+                else gate.shape[-1]
+            ff_new = _ff_padded(ff, n)
+            if ff_new != ff:
+                new_layers = dict(layers)
+                for name in ("gate_proj", "up_proj",
+                             "gate_proj_bias", "up_proj_bias"):
+                    if layers.get(name) is not None:
+                        new_layers[name] = _pad_ff_leaf(
+                            layers[name], ff_new, "n")
+                new_layers["down_proj"] = _pad_ff_leaf(
+                    layers["down_proj"], ff_new, "k")
+                new_params = {**new_params, "layers": new_layers}
+    head = params.get("lm_head")
+    if head is not None:
+        v = head.shape[1] if isinstance(head, QTensor) else head.shape[-1]
+        v_new = _ff_padded(v, n)
+        if v_new != v:
+            new_params = {**new_params,
+                          "lm_head": _pad_ff_leaf(head, v_new, "n")}
+    return new_params
+
+
 def shard_params_tp(params: Any, mesh: Mesh, axis: str = "tp") -> Any:
+    params = pad_ff_for_tp(params, mesh.shape[axis])
     specs = tp_param_specs(params, mesh, axis=axis)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
@@ -215,6 +312,9 @@ def _local_forward(cfg_l, axis: str):
         lg = M._lm_head(x[:, -1:], p, cfg_l)[:, 0]
         if "lm_head" in p:      # col-sharded head: [B, V/n] -> [B, V]
             lg = lax.all_gather(lg, axis, axis=1, tiled=True)
+            # pad_ff_for_tp may have lane-padded the vocab; drop the
+            # zero-scale pad logits before they can win an argmax
+            lg = lg[:, :cfg_l.vocab_size]
         # tied embeddings are replicated: lg is already full-vocab
         return lg, ck2, cv2
 

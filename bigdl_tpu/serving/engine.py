@@ -322,7 +322,11 @@ class LLMEngine:
             p = jnp.where(greedy, 1.0, top_ps)[:, None]
             sd = -jnp.sort(-t, axis=-1)
             probs = jax.nn.softmax(sd, axis=-1)
-            keep = (jnp.cumsum(probs, axis=-1) - probs) < p
+            # p >= 1.0 keeps ALL tokens (matching _sample_host's
+            # `top_p < 1.0` gate): without it, f32 cumsum rounding can
+            # push the pre-token mass to 1.0 and mask real tail tokens
+            # on temperature-only requests
+            keep = ((jnp.cumsum(probs, axis=-1) - probs) < p) | (p >= 1.0)
             # the top token survives even top_p=0.0 (OpenAI clients send
             # it to mean greedy; all-False keep would mask every token)
             keep = keep | (jnp.arange(v)[None, :] == 0)

@@ -106,6 +106,33 @@ def test_dequant_gemv_compiles(v5e, aot_flags, qtype, n):
         assert _has_mosaic_call(comp)
 
 
+@pytest.mark.parametrize("k,n", [
+    (4096, 1024),    # q/k/v column shard (also o-proj local K)
+    (1024, 4096),    # o-proj row shard
+    (4096, 2816),    # gate/up column shard (ff 11008 lane-padded 11264)
+    (2816, 4096),    # down-proj row shard
+])
+def test_dequant_gemv_compiles_tp4_shards(v5e, aot_flags, k, n):
+    """VERDICT r3 #4: ALL FOUR llama2-7B matmul shapes at tp=4 must
+    dispatch to the decode-GEMV kernel (with pad_ff_for_tp's ff
+    lane-padding, 11008 -> 11264). Before the joint (bk, bn) tile
+    search, the down-proj shard (K=2752) fell off the kernel entirely."""
+    from bigdl_tpu.ops.pallas.dequant_matmul import (_gemv_tiles,
+                                                     _q_gemv_pallas)
+    from bigdl_tpu.ops.quant import get_qtype, quantize
+
+    dev = v5e.devices[0]
+    qt = get_qtype("sym_int4")
+    assert _gemv_tiles(qt, k, n) is not None, "shape not kernel-eligible"
+    wq = jax.eval_shape(
+        lambda: quantize(jnp.zeros((k, n), jnp.float32), "sym_int4"))
+    x = jax.ShapeDtypeStruct((1, k), jnp.bfloat16)
+    comp = _compile(
+        lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, k, n, False, xx.dtype),
+        _sds(x, dev), _sds(wq, dev))
+    assert _has_mosaic_call(comp)
+
+
 @pytest.mark.parametrize("b,s,h,hkv,hd,kvdt", [
     (1, 1024, 32, 32, 128, "bfloat16"),     # llama2-7B MHA
     (1, 2048, 32, 8, 128, "bfloat16"),      # GQA (mistral/llama3)
@@ -382,7 +409,11 @@ def test_explicit_tp_kernels_compile_v5e_mesh(v5e, aot_flags):
         vocab_size=32000, hidden_size=4096, intermediate_size=11008,
         num_hidden_layers=2, num_attention_heads=32,
         num_key_value_heads=32)
-    pshape = jax.eval_shape(lambda: random_llama_params(cfg, "sym_int4"))
+    # pad_ff_for_tp: gate/up/down shards lane-align (11008 -> 11264),
+    # lm_head vocab shards too (32000 -> 32256) — the same transform
+    # shard_params_tp applies on real arrays
+    pshape = jax.eval_shape(lambda: TP.pad_ff_for_tp(
+        random_llama_params(cfg, "sym_int4"), mesh.shape["tp"]))
     specs = TP.tp_param_specs(pshape, mesh)
     p_s = jax.tree.map(
         lambda a, s: jax.ShapeDtypeStruct(

@@ -137,3 +137,60 @@ def test_tp_rejects_indivisible_heads(mesh):
             tp_generate(shard_params_tp(params, mesh), bad,
                         np.arange(1, 5, dtype=np.int32)[None], mesh,
                         max_new_tokens=2, max_seq=32)
+
+
+def test_pad_ff_exact_zero_extension():
+    """pad_ff_for_tp must be numerically invisible: padded gate/up
+    columns and down rows dequantize to exactly zero, real entries
+    unchanged (VERDICT r3 #4 — lane-aligning tp shards of ff=11008)."""
+    from bigdl_tpu.ops.quant import dequantize
+    from bigdl_tpu.parallel.tp import pad_ff_for_tp
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=128, intermediate_size=2752,
+        num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=4, max_position_embeddings=128)
+    params = random_llama_params(cfg, qtype="sym_int4", seed=0)
+    padded = pad_ff_for_tp(params, 4)     # 2752 -> 4 x 768 = 3072
+
+    def layer0(tree, name):
+        return jax.tree.map(lambda a: a[0], tree["layers"][name])
+
+    for name in ("gate_proj", "up_proj"):
+        w0, w1 = layer0(params, name), layer0(padded, name)
+        assert w1.shape == (128, 3072)
+        d0 = np.asarray(dequantize(w0), np.float32)
+        d1 = np.asarray(dequantize(w1), np.float32)
+        np.testing.assert_array_equal(d1[:, :2752], d0)
+        np.testing.assert_array_equal(d1[:, 2752:], 0.0)
+    w0, w1 = layer0(params, "down_proj"), layer0(padded, "down_proj")
+    assert w1.shape == (3072, 128)
+    d0 = np.asarray(dequantize(w0), np.float32)
+    d1 = np.asarray(dequantize(w1), np.float32)
+    np.testing.assert_array_equal(d1[:2752, :], d0)
+    np.testing.assert_array_equal(d1[2752:, :], 0.0)
+
+
+def test_tp_ff_padding_logits_match(mesh):
+    """End-to-end explicit TP over an ff whose tp=4 shard is NOT
+    lane-aligned (2752/4 = 688): shard_params_tp pads to 3072 and the
+    logits still match the single-device forward exactly."""
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=256, intermediate_size=2752,
+        num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=4, max_position_embeddings=128)
+    params = random_llama_params(cfg, qtype="sym_int4", seed=4)
+    prompt = jnp.asarray(np.arange(1, 13, dtype=np.int32)[None])
+
+    cache1 = M.new_cache(cfg, 1, 64)
+    ref_lg, _ = M.forward(params, cfg, prompt, cache1)
+
+    with mesh:
+        p_s = shard_params_tp(params, mesh)
+        gate = p_s["layers"]["gate_proj"]
+        assert gate.shape[1] == 3072, "ff padding did not engage"
+        cache = new_cache_tp(cfg, 1, 64, mesh)
+        lg, _ = tp_forward_step(p_s, cfg, prompt, cache, mesh)
+    np.testing.assert_allclose(
+        np.asarray(lg), np.asarray(ref_lg[:, -1, :]), rtol=2e-2,
+        atol=2e-2)
