@@ -74,6 +74,8 @@ AB_CONFIGS = [
     ("pallas-all-m", dict(matmul_backend="auto", attention_backend="auto",
                           matmul_gemv="auto",
                           matmul_pallas_max_m=1 << 30)),
+    ("no-merge", dict(matmul_backend="auto", attention_backend="auto",
+                      matmul_gemv="auto", _merged=False)),
     ("pallas", dict(matmul_backend="auto", attention_backend="auto",
                     matmul_gemv="off")),
     ("xla-matmul", dict(matmul_backend="xla", attention_backend="auto",
@@ -95,7 +97,8 @@ AB_CONFIGS = [
 ]
 
 
-def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False) -> dict:
+def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
+                 merged: bool = True) -> dict:
     """Time prefill + decode under the AMBIENT flags; returns raw numbers.
 
     Runs on whatever jax.default_backend() answers. The final token is
@@ -118,6 +121,9 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False) -> dict:
     steps = DECODE_STEPS if on_tpu else 8
 
     params = random_llama_params(cfg, qtype=qtype)
+    if merged:
+        # merged QKV + gate/up — the shipped from_pretrained default
+        params = llama_mod.merge_projections(params, cfg)
     jax.block_until_ready(params)
     tokens = jnp.ones((1, prompt_len), jnp.int32)
 
@@ -235,10 +241,12 @@ def _one_config(label: str) -> None:
     overrides = dict(dict(AB_CONFIGS)[label])
     qtype = overrides.pop("_qtype", "sym_int4")
     kv_quantized = overrides.pop("_kv_quantized", False)
+    merged = overrides.pop("_merged", True)
     from bigdl_tpu.config import set_flags
 
     set_flags(**overrides)
-    print(json.dumps(bench_config(qtype=qtype, kv_quantized=kv_quantized)))
+    print(json.dumps(bench_config(qtype=qtype, kv_quantized=kv_quantized,
+                                  merged=merged)))
 
 
 def _latest_valid_onchip_record() -> dict | None:

@@ -55,7 +55,9 @@ def main() -> None:
 
     class _Model:
         def __init__(self):
-            self.params = random_llama_params(cfg, qtype="sym_int4")
+            # merged projections: the shipped from_pretrained default
+            self.params = llama_mod.merge_projections(
+                random_llama_params(cfg, qtype="sym_int4"), cfg)
             self.config = cfg
             self.hf_config = {"eos_token_id": None}
 

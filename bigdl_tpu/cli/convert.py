@@ -82,7 +82,11 @@ def main(argv=None) -> int:
             arr = arr[idx]
         return arr.T
 
-    p = model.params
+    from bigdl_tpu.models.llama import unmerge_projections
+
+    # from_pretrained merges qkv/gate-up by default; GGUF tensor names
+    # are per-projection, so restore the split layout (exact slicing)
+    p = unmerge_projections(model.params, cfg)
     tensors = {"token_embd.weight":
                (np.asarray(p["embed_tokens"], np.float32), G.GGML_F16),
                "output_norm.weight":

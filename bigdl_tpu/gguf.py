@@ -52,6 +52,7 @@ GGML_Q2_K = 10
 GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 11, 12, 13, 14
 GGML_IQ2_XXS, GGML_IQ2_XS = 16, 17
 GGML_IQ1_S = 19
+GGML_IQ1_M = 29
 GGML_BF16 = 30
 
 # (block size in values, bytes per block)
@@ -68,7 +69,7 @@ _BLOCK = {
     # ultra-low-bit iq formats (dequantize-on-load; grid tables are
     # pluggable constants — bigdl_tpu/ops/iq_grids.py)
     GGML_IQ2_XXS: (256, 66), GGML_IQ2_XS: (256, 74),
-    GGML_IQ1_S: (256, 50),
+    GGML_IQ1_S: (256, 50), GGML_IQ1_M: (256, 56),
 }
 
 _GGML_TO_QTYPE = {
@@ -276,6 +277,49 @@ def _decode_iq1_s(blk: np.ndarray) -> np.ndarray:
     return vals.reshape(-1, 256)
 
 
+IQ1M_DELTA = 0.0625
+
+
+def _decode_iq1_m(blk: np.ndarray) -> np.ndarray:
+    """block_iq1_m {qs u8[32], qh u8[16], scales u8[8]} -> [nblk, 256].
+
+    dequantize_row_iq1_m: the fp16 super-scale hides in the top nibbles
+    of the four scale uint16s; per 32-value sub-block two 3-bit scales
+    (dl = d * (2*s+1)) cover 16 values each; the 11-bit grid index is
+    qs[l] | low/high qh nibble bits 0-2 << 8, with nibble bit 3 choosing
+    the +-IQ1M_DELTA shift per group of 8."""
+    from bigdl_tpu.ops.iq_grids import require_grid
+
+    grid = require_grid("iq1s_grid")                       # [2048, 8]
+    qs = blk[:, 0:32].reshape(-1, 8, 4)                    # [nblk, 8, 4]
+    qh = blk[:, 32:48].reshape(-1, 8, 2)                   # [nblk, 8, 2]
+    sc = np.ascontiguousarray(blk[:, 48:56]).view(np.uint16)  # [nblk, 4]
+    d16 = ((sc[:, 0] >> 12)
+           | ((sc[:, 1] >> 8) & 0x00F0)
+           | ((sc[:, 2] >> 4) & 0x0F00)
+           | (sc[:, 3] & 0xF000)).astype(np.uint16)
+    d = d16.view(np.float16).astype(np.float32)            # [nblk]
+
+    ib = np.arange(8)
+    swords = sc[:, ib // 2]                                # [nblk, 8]
+    shift = 6 * (ib % 2)
+    dl1 = d[:, None] * (2.0 * ((swords >> shift) & 7) + 1.0)
+    dl2 = d[:, None] * (2.0 * ((swords >> (shift + 3)) & 7) + 1.0)
+    dl = np.stack([dl1, dl1, dl2, dl2], axis=2)            # [nblk, 8, 4]
+
+    # per-group high bits + delta bit ride the qh nibbles: l=0/2 the low
+    # nibble, l=1/3 the high one
+    nib = np.stack([qh[:, :, 0] & 0x0F, qh[:, :, 0] >> 4,
+                    qh[:, :, 1] & 0x0F, qh[:, :, 1] >> 4],
+                   axis=2).astype(np.int32)                # [nblk, 8, 4]
+    idx = qs.astype(np.int32) | ((nib & 7) << 8)
+    delta = np.where((nib & 8) != 0, -IQ1M_DELTA,
+                     IQ1M_DELTA).astype(np.float32)
+    g = grid[idx]                                          # [nblk, 8, 4, 8]
+    vals = dl[..., None] * (g + delta[..., None])
+    return vals.reshape(-1, 256)
+
+
 def _read_str(f: BinaryIO) -> str:
     (n,) = struct.unpack("<Q", f.read(8))
     return f.read(n).decode("utf-8", errors="replace")
@@ -467,6 +511,8 @@ class GGUFFile:
             return _decode_iq2_xs(blk).reshape(shape).astype(dtype)
         if gt == GGML_IQ1_S:
             return _decode_iq1_s(blk).reshape(shape).astype(dtype)
+        if gt == GGML_IQ1_M:
+            return _decode_iq1_m(blk).reshape(shape).astype(dtype)
         if gt in (GGML_Q5_0, GGML_Q5_1):
             hdr = 2 if gt == GGML_Q5_0 else 4
             qh = blk[:, hdr:hdr + 4].copy().view(np.uint32)[:, 0]

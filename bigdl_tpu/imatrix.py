@@ -36,8 +36,8 @@ import numpy as np
 # qtypes low enough that sensitive tensors get bumped (reference
 # transformers/utils.py: IQ2/Q2_K loads rewrite embedding/lm_head/
 # attn_v qtypes)
-ULTRA_LOW_QTYPES = ("iq2_xxs", "gguf_iq2_xxs", "iq1_s", "gguf_iq1_s",
-                    "q2_k")
+ULTRA_LOW_QTYPES = ("iq2_xxs", "gguf_iq2_xxs", "iq2_xs", "gguf_iq2_xs",
+                    "iq1_s", "gguf_iq1_s", "iq1_m", "gguf_iq1_m", "q2_k")
 
 
 # -- llama.cpp name translation ---------------------------------------------
@@ -181,6 +181,11 @@ def collect_imatrix(params: Dict[str, Any], cfg, tokens,
     from bigdl_tpu.models import llama as M
     from bigdl_tpu.ops.rope import rope_cos_sin
 
+    # stats are keyed by the SPLIT projection names (they feed
+    # quantize_linear at conversion time, which sees HF tensors);
+    # models loaded with the default merged layout replay unmerged —
+    # exact, and the per-projection activations are identical
+    params = M.unmerge_projections(params, cfg)
     tokens = jnp.asarray(np.asarray(tokens, np.int32))
     if tokens.ndim == 1:
         tokens = tokens[None]

@@ -174,6 +174,125 @@ class LlamaConfig:
 # }
 
 
+def merge_projections(params: Dict[str, Any], cfg: "LlamaConfig"
+                      ) -> Dict[str, Any]:
+    """Fuse q/k/v into one [D, (H+2Hkv)*hd] weight and gate/up into one
+    [D, 2F] — the reference's `_optimize_pre` weight surgery + fused
+    `forward_qkv`/`mlp_forward_xpu` kernels (reference transformers/
+    convert.py:529-640, models/llama.py:362-373, 162-166), done here as
+    a pure param transform: one matmul instead of three (two) per block
+    raises prefill MFU and cuts decode kernel dispatches; block
+    quantization is per-column so the merge is BIT-exact.
+
+    Skips (returns inputs unchanged) whenever the merge would not be
+    exact or the layout does not apply: mixed qtypes across the
+    projections, partial biases, MoE layers, non-gated MLPs. The layer
+    body (`_attn_block`/`_mlp`) accepts both layouts; use
+    `unmerge_projections` to restore the split layout (adapters and
+    explicit TP sharding need it)."""
+    from bigdl_tpu.ops.quant import QTensor, concat_qtensors_n
+
+    layers = params.get("layers")
+    if not isinstance(layers, dict):
+        return params
+
+    def bundle(names):
+        ws = [layers.get(nm) for nm in names]
+        if any(w is None for w in ws):
+            return None, None
+        if all(isinstance(w, QTensor) for w in ws):
+            if len({w.qtype for w in ws}) != 1 \
+                    or len({w.shape[0] for w in ws}) != 1:
+                return None, None
+        elif any(isinstance(w, QTensor) for w in ws):
+            return None, None
+        elif len({w.dtype for w in ws}) != 1 \
+                or len({w.shape[-2] for w in ws}) != 1:
+            return None, None
+        bs = [layers.get(f"{nm}_bias") for nm in names]
+        if any(b is not None for b in bs) and not all(
+                b is not None for b in bs):
+            return None, None            # partial biases: keep split
+        return ws, (bs if bs[0] is not None else None)
+
+    def concat(ws):
+        if isinstance(ws[0], QTensor):
+            return concat_qtensors_n(ws)
+        return jnp.concatenate(ws, axis=-1)
+
+    new = dict(layers)
+    changed = False
+    qkv, qkv_b = bundle(("q_proj", "k_proj", "v_proj"))
+    if qkv is not None:
+        new["qkv_proj"] = concat(qkv)
+        if qkv_b is not None:
+            new["qkv_proj_bias"] = jnp.concatenate(qkv_b, axis=-1)
+        for nm in ("q_proj", "k_proj", "v_proj"):
+            new.pop(nm)
+            new.pop(f"{nm}_bias", None)
+        changed = True
+    gu, gu_b = bundle(("gate_proj", "up_proj"))
+    if gu is not None:
+        new["gate_up_proj"] = concat(gu)
+        if gu_b is not None:
+            new["gate_up_proj_bias"] = jnp.concatenate(gu_b, axis=-1)
+        for nm in ("gate_proj", "up_proj"):
+            new.pop(nm)
+            new.pop(f"{nm}_bias", None)
+        changed = True
+    if not changed:
+        return params
+    return {**params, "layers": new}
+
+
+def unmerge_projections(params: Dict[str, Any], cfg: "LlamaConfig"
+                        ) -> Dict[str, Any]:
+    """Inverse of `merge_projections` (exact slicing)."""
+    from bigdl_tpu.ops.quant import QTensor, split_qtensor_n
+
+    layers = params.get("layers")
+    if not isinstance(layers, dict):
+        return params
+
+    def split(w, sizes):
+        if isinstance(w, QTensor):
+            return split_qtensor_n(w, sizes)
+        off, outs = 0, []
+        for s in sizes:
+            outs.append(w[..., off:off + s])
+            off += s
+        return outs
+
+    new = dict(layers)
+    changed = False
+    if "qkv_proj" in new:
+        h, hkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.hd)
+        sizes = (h * hd, hkv * hd, hkv * hd)
+        for nm, w in zip(("q_proj", "k_proj", "v_proj"),
+                         split(new.pop("qkv_proj"), sizes)):
+            new[nm] = w
+        if "qkv_proj_bias" in new:
+            for nm, b in zip(("q_proj", "k_proj", "v_proj"),
+                             split(new.pop("qkv_proj_bias"), sizes)):
+                new[f"{nm}_bias"] = b
+        changed = True
+    if "gate_up_proj" in new:
+        gu = new.pop("gate_up_proj")
+        f = (gu.shape[1] if isinstance(gu, QTensor)
+             else gu.shape[-1]) // 2
+        for nm, w in zip(("gate_proj", "up_proj"), split(gu, (f, f))):
+            new[nm] = w
+        if "gate_up_proj_bias" in new:
+            for nm, b in zip(("gate_proj", "up_proj"),
+                             split(new.pop("gate_up_proj_bias"), (f, f))):
+                new[f"{nm}_bias"] = b
+        changed = True
+    if not changed:
+        return params
+    return {**params, "layers": new}
+
+
 def model_rope_freqs(cfg: "LlamaConfig"):
     """(inv_freq, attention_factor) honoring cfg.rope_scaling."""
     if cfg.rope_scaling is not None:
@@ -381,6 +500,15 @@ def _mlp(hidden, lp, cfg: LlamaConfig, record=None):
                 "projections would be the only weighted tensors)")
         return _moe_mlp(hidden, lp, cfg)
     act = _ACTS[cfg.hidden_act]
+    if "gate_up_proj" in lp:
+        if record is not None:
+            record("gate_up_proj", hidden)
+        gu = linear(hidden, lp["gate_up_proj"], lp.get("gate_up_proj_bias"))
+        f = gu.shape[-1] // 2
+        inner = act(gu[..., :f]) * gu[..., f:]
+        if record is not None:
+            record("down_proj", inner)
+        return linear(inner, lp["down_proj"], lp.get("down_proj_bias"))
     if record is not None:
         record("gate_proj" if cfg.mlp_gated else "up_proj", hidden)
         if cfg.mlp_gated:
@@ -396,6 +524,14 @@ def _mlp(hidden, lp, cfg: LlamaConfig, record=None):
     return linear(inner, lp["down_proj"], lp.get("down_proj_bias"))
 
 
+def _split_qkv(qkv, b, sq, h, hkv, hd):
+    """Merged-projection output [B, Sq, (H+2Hkv)*hd] -> q/k/v heads."""
+    q = qkv[..., :h * hd].reshape(b, sq, h, hd)
+    k = qkv[..., h * hd:(h + hkv) * hd].reshape(b, sq, hkv, hd)
+    v = qkv[..., (h + hkv) * hd:].reshape(b, sq, hkv, hd)
+    return q, k, v
+
+
 def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
                 cache_ctx=None, lidx=None, record=None):
     """QKV + rope + (cached) attention + output projection."""
@@ -407,16 +543,23 @@ def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
     if cfg.alt_sliding_window and sw is not None and lidx is not None:
         # gemma2: sliding attention on even layers, global on odd
         sw = jnp.where(lidx % 2 == 0, sw, jnp.int32(1 << 30))
-    if record is not None:
-        record("q_proj", hidden)
-        record("k_proj", hidden)
-        record("v_proj", hidden)
-    q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias")).reshape(
-        b, sq, h, hd)
-    k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias")).reshape(
-        b, sq, hkv, hd)
-    v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias")).reshape(
-        b, sq, hkv, hd)
+    if "qkv_proj" in lp:
+        if record is not None:
+            record("qkv_proj", hidden)
+        q, k, v = _split_qkv(
+            linear(hidden, lp["qkv_proj"], lp.get("qkv_proj_bias")),
+            b, sq, h, hkv, hd)
+    else:
+        if record is not None:
+            record("q_proj", hidden)
+            record("k_proj", hidden)
+            record("v_proj", hidden)
+        q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias")).reshape(
+            b, sq, h, hd)
+        k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias")).reshape(
+            b, sq, hkv, hd)
+        v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias")).reshape(
+            b, sq, hkv, hd)
     if cfg.use_rope:
         q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
         k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
@@ -569,12 +712,17 @@ def ext_attn_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn):
     h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
     hidden = _norm(x, lp["input_layernorm"],
                    lp.get("input_layernorm_bias"), cfg)
-    q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias")).reshape(
-        b, s, h, hd)
-    k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias")).reshape(
-        b, s, hkv, hd)
-    v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias")).reshape(
-        b, s, hkv, hd)
+    if "qkv_proj" in lp:
+        q, k, v = _split_qkv(
+            linear(hidden, lp["qkv_proj"], lp.get("qkv_proj_bias")),
+            b, s, h, hkv, hd)
+    else:
+        q = linear(hidden, lp["q_proj"], lp.get("q_proj_bias")).reshape(
+            b, s, h, hd)
+        k = linear(hidden, lp["k_proj"], lp.get("k_proj_bias")).reshape(
+            b, s, hkv, hd)
+        v = linear(hidden, lp["v_proj"], lp.get("v_proj_bias")).reshape(
+            b, s, hkv, hd)
     if cfg.use_rope:
         q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
         k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)

@@ -102,6 +102,13 @@ def group_codebook(name: str) -> np.ndarray:
         return _top_patterns(
             [1.0, 3.0, 5.0, 7.0],
             np.log([0.55, 0.25, 0.13, 0.07]), 256)
+    if name == "iq2_xs":
+        # same magnitude alphabet, twice the patterns: the 9-bit index +
+        # 7-bit parity-sign packing frees the extra bit (ggml's XXS->XS
+        # move, reference ggml/quantize.py:43-47) at identical storage
+        return _top_patterns(
+            [1.0, 3.0, 5.0, 7.0],
+            np.log([0.55, 0.25, 0.13, 0.07]), 512)
     if name == "iq1_s":
         return _top_patterns(
             [0.0, 1.0, -1.0],

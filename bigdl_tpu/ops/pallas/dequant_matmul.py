@@ -33,6 +33,12 @@ from bigdl_tpu.ops.quant import QTensor, get_qtype
 from bigdl_tpu.ops.codebooks import CODEBOOKS
 
 
+# generic grid is (M/bm, N/bn, K/bk): M and N tiles are independent,
+# only the K sweep carries the accumulator
+_GENERIC_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
 def _pick_tile(dim: int, candidates) -> int:
     for c in candidates:
         if dim % c == 0:
@@ -346,6 +352,11 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
         out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
+        # N tiles are independent; only the K sweep carries the
+        # accumulator — telling Mosaic lets it software-pipeline the
+        # packed-data stream across j boundaries
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
     )(*operands)
     return y[:m]
 
@@ -428,6 +439,7 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
                 out_shape=out_shape,
                 scratch_shapes=scratch,
                 interpret=interpret,
+                compiler_params=_GENERIC_SEMANTICS,
             )(x2, w.data, w.scale, w.zero)
         else:
             kernel = functools.partial(
@@ -441,6 +453,7 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
                 out_shape=out_shape,
                 scratch_shapes=scratch,
                 interpret=interpret,
+                compiler_params=_GENERIC_SEMANTICS,
             )(x2, w.data, w.scale)
     else:  # int8 sym
         data_spec = pl.BlockSpec((bk, bn), lambda i, j, k: (k, j))
@@ -453,6 +466,7 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
             out_shape=out_shape,
             scratch_shapes=scratch,
             interpret=interpret,
+            compiler_params=_GENERIC_SEMANTICS,
         )(x2, w.data, w.scale)
 
     if mp != m:

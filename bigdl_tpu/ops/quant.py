@@ -90,9 +90,15 @@ QTYPES = {
     # one entry of a deterministic codebook (ops/codebooks.py
     # group_codebook) + per-32 4-bit sub-scales + per-256 bf16 scales.
     # iq2_xxs: 8-bit magnitude-pattern index + 8 sign bits = 2.19 bpw.
+    # iq2_xs: 9-bit index + 7-bit parity-constrained signs in the SAME
+    #   16 bits (double codebook at identical storage; ggml's XXS->XS).
     # iq1_s: 8-bit signed-ternary index = 1.19 bpw.
+    # iq1_m: iq1_s + per-16 sub-scales + a per-group +-1/8 delta
+    #   (1.44 bpw; the role of ggml's IQ1_M refinement).
     "iq2_xxs": _q("iq2_xxs", 2, 256, "iqx", codebook="iq2_xxs"),
+    "iq2_xs": _q("iq2_xs", 2, 256, "iqx", codebook="iq2_xs"),
     "iq1_s": _q("iq1_s", 1, 256, "iqx", codebook="iq1_s"),
+    "iq1_m": _q("iq1_m", 1, 256, "iqx", codebook="iq1_s"),
 }
 # Aliases used throughout the reference API surface.
 QTYPES["int4"] = QTYPES["sym_int4"]
@@ -105,7 +111,9 @@ QTYPES["q8_0"] = QTYPES["sym_int8"]
 QTYPES["fp8"] = QTYPES["fp8_e5m2"]
 # the reference's user-facing names for the iq formats (load_in_low_bit=...)
 QTYPES["gguf_iq2_xxs"] = QTYPES["iq2_xxs"]
+QTYPES["gguf_iq2_xs"] = QTYPES["iq2_xs"]
 QTYPES["gguf_iq1_s"] = QTYPES["iq1_s"]
+QTYPES["gguf_iq1_m"] = QTYPES["iq1_m"]
 
 # float passthrough "qtypes" accepted by the convert API (no QTensor made).
 FLOAT_QTYPES = ("fp16", "bf16", "fp32")
@@ -582,17 +590,25 @@ _IQ_CHUNK = 1024          # encode N columns at a time (bounds the [G,256,Nc]
                           # score tensor to ~0.5 GB f32 for K=4096)
 
 
-def _iq_scales(xc: jax.Array, gmax: float):
-    """Per-32 sub-scale (4-bit) under per-256 bf16 superscale.
+def _iq_scales(xc: jax.Array, gmax: float, sub: int = 32):
+    """Per-`sub` sub-scale (4-bit) under per-256 bf16 superscale.
 
-    Returns (d [K/256, Nc], s4 [K/32, Nc] uint8, effk [K, Nc])."""
+    Returns (d [K/256, Nc], s4 [K/sub, Nc] uint8, effk [K, Nc])."""
     kp, nc = xc.shape
-    s = jnp.max(jnp.abs(xc.reshape(kp // 32, 32, nc)), axis=1) / gmax
-    d = jnp.max(s.reshape(kp // 256, 8, nc), axis=1) / 15.0
-    drep = jnp.repeat(d, 8, axis=0)
+    per = 256 // sub
+    s = jnp.max(jnp.abs(xc.reshape(kp // sub, sub, nc)), axis=1) / gmax
+    d = jnp.max(s.reshape(kp // 256, per, nc), axis=1) / 15.0
+    drep = jnp.repeat(d, per, axis=0)
     s4 = jnp.clip(jnp.round(s * _safe_inv(drep)), 0, 15).astype(jnp.uint8)
     eff = drep * s4.astype(jnp.float32)
-    return d, s4, jnp.repeat(eff, 32, axis=0)
+    return d, s4, jnp.repeat(eff, sub, axis=0)
+
+
+# Native iq1_m per-group shift magnitude. DELIBERATELY 1/8 (not ggml's
+# IQ1M_DELTA = 0.0625, which gguf.py uses to decode real ggml files):
+# this native format pairs the delta with per-16 sub-scales, and 1/8
+# measured lower RMSE here. The two formats are independent layouts.
+_IQ_DELTA = 0.125
 
 
 @functools.partial(jax.jit, static_argnames=("qtype", "iters"))
@@ -601,68 +617,128 @@ def _iqx_encode_chunk(xc: jax.Array, wv: jax.Array, qtype: str,
     """Encode one [K, Nc] chunk. wv: [K, 1] importance (ones if no imatrix).
 
     Codebook match maximizes sum(w * y * c) - 0.5 * sum(w * c^2) per group
-    (equivalent to weighted-MSE argmin), computed as one [G, 256, Nc]
+    (equivalent to weighted-MSE argmin), computed as one [G, J, Nc]
     einsum — MXU work, not a loop.
 
     Coordinate descent (`iters` extra rounds): the amax-derived initial
     scale is far from optimal for coarse codebooks — for ternary iq1_s it
     pins the group max to +-1, which rounds most of a Gaussian group to
     zero, and no imatrix weighting can rescue a bad scale (the r2 ppl
-    numbers showed exactly that). Each round re-fits every 32-value
-    sub-scale by weighted least squares against the CHOSEN patterns
+    numbers showed exactly that). Each round re-fits every sub-scale by
+    weighted least squares against the CHOSEN patterns
     (eff* = sum(w x c) / sum(w c^2) — exact given the assignment, the
     same scale-search idea as ggml's iq quantizers), then re-assigns
     patterns under the new scale. Monotone in weighted MSE modulo the
-    4-bit scale rounding."""
+    4-bit scale rounding.
+
+    Format variants:
+    - iq2_xxs: unsigned cb[256], free 8-bit signs.
+    - iq2_xs: unsigned cb[512]; signs parity-constrained to 7 stored
+      bits (the lowest-|w x c| sign flips when the parity is odd), code
+      packed as uint16 = idx | sign7 << 9 in two uint8 rows.
+    - iq1_s: signed ternary cb[256].
+    - iq1_m: iq1_s + per-16 sub-scales + per-group delta in
+      {-1/8, +1/8}: values decode as eff * (c + delta). The (pattern,
+      delta) pair is chosen jointly — score(c, d) separates as
+      [s1 - s2/2] + d*(Sy - Swc) with the d^2 term constant.
+
+    Returns (data, d, aux, extra): `extra` is the packed per-group delta
+    bits for iq1_m, else None."""
     from bigdl_tpu.ops.codebooks import group_codebook
 
     qt = get_qtype(qtype)
-    cb = jnp.asarray(group_codebook(qt.codebook))             # [256, 8]
-    signed_cb = qt.name == "iq1_s"
+    cb = jnp.asarray(group_codebook(qt.codebook))             # [J, 8]
+    name = qt.name
+    signed_cb = name in ("iq1_s", "iq1_m")
+    with_delta = name == "iq1_m"
+    xs_signs = name == "iq2_xs"
+    sub = 16 if with_delta else 32
     gmax = float(np.max(np.abs(group_codebook(qt.codebook))))
     kp, nc = xc.shape
     g = kp // 8
+    per = 256 // sub
 
-    d, s4, effk = _iq_scales(xc, gmax)
+    d, s4, effk = _iq_scales(xc, gmax, sub=sub)
     w = wv.reshape(g, 8, 1)
-    drep = jnp.repeat(d, 8, axis=0)                           # [K/32, Nc]
+    drep = jnp.repeat(d, per, axis=0)                         # [K/sub, Nc]
     s2 = jnp.einsum("gk,jk->gj", w[..., 0], cb * cb)
+    if with_delta:
+        swc = jnp.einsum("gk,jk->gj", w[..., 0], cb)          # [g, J]
 
     def assign(effk):
         y = xc * _safe_inv(effk)                              # [K, Nc]
         a = (y if signed_cb else jnp.abs(y)).reshape(g, 8, nc)
         s1 = jnp.einsum("gkn,jk->gjn", a * w, cb)
-        return jnp.argmax(s1 - 0.5 * s2[:, :, None], axis=1)  # [g, Nc]
+        base = s1 - 0.5 * s2[:, :, None]                      # [g, J, Nc]
+        if not with_delta:
+            return jnp.argmax(base, axis=1), None
+        sy = jnp.sum((a * w), axis=1)                         # [g, Nc]
+        dterm = _IQ_DELTA * (sy[:, None, :] - swc[:, :, None])
+        plus, minus = base + dterm, base - dterm
+        jp, jm = jnp.argmax(plus, axis=1), jnp.argmax(minus, axis=1)
+        bp = jnp.take_along_axis(plus, jp[:, None, :], axis=1)[:, 0]
+        bm = jnp.take_along_axis(minus, jm[:, None, :], axis=1)[:, 0]
+        take_p = bp >= bm
+        return jnp.where(take_p, jp, jm), take_p              # [g, Nc] x2
 
-    idx = assign(effk)
-    for _ in range(iters):
-        # decoded patterns at unit scale, signs folded in
+    def decoded_units(idx, dpos):
+        """Chosen patterns at unit scale, signs + delta folded."""
         c = cb[idx].transpose(0, 2, 1).reshape(kp, nc)        # [K, Nc]
         if not signed_cb:
             # stored sign bit is (x < 0): x == 0 decodes as +c
             c = c * jnp.where(xc < 0, -1.0, 1.0)
+        if with_delta:
+            delta = jnp.where(dpos, _IQ_DELTA, -_IQ_DELTA)    # [g, Nc]
+            c = c + jnp.repeat(delta, 8, axis=0)
+        return c
+
+    idx, dpos = assign(effk)
+    for _ in range(iters):
+        c = decoded_units(idx, dpos)
         wk = wv                                               # [K, 1]
-        num = jnp.sum((wk * xc * c).reshape(kp // 32, 32, nc), axis=1)
-        den = jnp.sum((wk * c * c).reshape(kp // 32, 32, nc), axis=1)
-        eff32 = num * _safe_inv(den)                          # [K/32, Nc]
-        s4 = jnp.clip(jnp.round(eff32 * _safe_inv(drep)),
+        num = jnp.sum((wk * xc * c).reshape(kp // sub, sub, nc), axis=1)
+        den = jnp.sum((wk * c * c).reshape(kp // sub, sub, nc), axis=1)
+        eff = num * _safe_inv(den)                            # [K/sub, Nc]
+        s4 = jnp.clip(jnp.round(eff * _safe_inv(drep)),
                       0, 15).astype(jnp.uint8)
-        effk = jnp.repeat(drep * s4.astype(jnp.float32), 32, axis=0)
-        idx = assign(effk)
-    idx = idx.astype(jnp.uint8)
+        effk = jnp.repeat(drep * s4.astype(jnp.float32), sub, axis=0)
+        idx, dpos = assign(effk)
 
     # pack sub-scales: 2 nibbles per byte along K
-    s4p = s4.reshape(kp // 64, 2, nc)
+    s4p = s4.reshape(kp // (2 * sub), 2, nc)
     aux = (s4p[:, 0] | (s4p[:, 1] << 4)).astype(jnp.uint8)
 
+    extra = None
+    if with_delta:
+        bits = dpos.astype(jnp.int32).reshape(g // 8, 8, nc)
+        shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
+        extra = jnp.sum(bits << shifts, axis=1).astype(jnp.uint8)
+
     if signed_cb:
-        data = idx                                             # [K/8, Nc]
+        data = idx.astype(jnp.uint8)                          # [K/8, Nc]
+    elif xs_signs:
+        neg = (xc < 0).astype(jnp.int32).reshape(g, 8, nc)
+        # representable sign vectors have EVEN popcount (bit 7 is the
+        # parity of bits 0-6); when the desired signs are odd, flip the
+        # cheapest position — the one with the least |w x c| at stake
+        pattern = cb[idx].transpose(0, 2, 1)                  # [g, 8, Nc]
+        cost = jnp.abs(xc.reshape(g, 8, nc)) * pattern * w
+        odd = (jnp.sum(neg, axis=1) & 1) == 1                 # [g, Nc]
+        flip_at = jnp.argmin(cost, axis=1)                    # [g, Nc]
+        onehot = (jnp.arange(8)[None, :, None] == flip_at[:, None, :])
+        neg = jnp.where(odd[:, None, :] & onehot, 1 - neg, neg)
+        shifts = jnp.arange(7, dtype=jnp.int32).reshape(1, 7, 1)
+        sign7 = jnp.sum(neg[:, :7] << shifts, axis=1)         # [g, Nc]
+        code = idx.astype(jnp.int32) | (sign7 << 9)           # 16 bits
+        data = jnp.stack([code & 0xFF, code >> 8],
+                         axis=1).reshape(2 * g, nc).astype(jnp.uint8)
     else:
         neg = (xc < 0).astype(jnp.int32).reshape(g, 8, nc)
         shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
         signs = jnp.sum(neg << shifts, axis=1).astype(jnp.uint8)
-        data = jnp.stack([idx, signs], axis=1).reshape(2 * g, nc)
-    return data, d.astype(jnp.bfloat16), aux
+        data = jnp.stack([idx.astype(jnp.uint8), signs],
+                         axis=1).reshape(2 * g, nc)
+    return data, d.astype(jnp.bfloat16), aux, extra
 
 
 def _quantize_iqx(x: jax.Array, qtype: str,
@@ -678,16 +754,21 @@ def _quantize_iqx(x: jax.Array, qtype: str,
         wv = _pad_k(jnp.asarray(qw, jnp.float32).reshape(-1, 1), 256)
         wv = jnp.maximum(wv, 1e-12)
 
-    datas, ds, auxs = [], [], []
+    datas, ds, auxs, extras = [], [], [], []
     for c0 in range(0, n, _IQ_CHUNK):
         xc = x[:, c0:c0 + _IQ_CHUNK]
-        data, d, aux = _iqx_encode_chunk(xc, wv, qtype)
+        data, d, aux, extra = _iqx_encode_chunk(xc, wv, qtype)
         datas.append(data)
         ds.append(d)
         auxs.append(aux)
+        if extra is not None:
+            extras.append(extra)
     return QTensor(jnp.concatenate(datas, axis=1),
                    jnp.concatenate(ds, axis=1),
-                   None, get_qtype(qtype).name, (k, n),
+                   # iq1_m: packed per-group delta bits ride the (otherwise
+                   # unused) zero plane
+                   jnp.concatenate(extras, axis=1) if extras else None,
+                   get_qtype(qtype).name, (k, n),
                    aux=jnp.concatenate(auxs, axis=1))
 
 
@@ -696,14 +777,37 @@ def _dequantize_iqx(qt_t: QTensor, dtype) -> jax.Array:
 
     t = qt_t.qt
     k, n = qt_t.shape
-    cb = jnp.asarray(group_codebook(t.codebook))               # [256, 8]
-    signed_cb = t.name == "iq1_s"
+    cb = jnp.asarray(group_codebook(t.codebook))               # [J, 8]
+    name = t.name
+    signed_cb = name in ("iq1_s", "iq1_m")
+    sub = 16 if name == "iq1_m" else 32
 
     if signed_cb:
         idx = qt_t.data                                        # [Kp/8, N]
         g = idx.shape[0]
         vals = cb[idx]                                         # [g, N, 8]
         vals = vals.transpose(0, 2, 1)                         # [g, 8, N]
+        if name == "iq1_m":
+            shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
+            bits = (qt_t.zero.astype(jnp.int32)[:, None, :] >> shifts) & 1
+            delta = jnp.where(bits.astype(bool), _IQ_DELTA, -_IQ_DELTA)
+            vals = vals + delta.reshape(g, 1, n)
+    elif name == "iq2_xs":
+        gi = qt_t.data.reshape(-1, 2, qt_t.data.shape[1])
+        code = (gi[:, 0].astype(jnp.int32)
+                | (gi[:, 1].astype(jnp.int32) << 8))           # [g, N]
+        idx, sign7 = code & 0x1FF, code >> 9
+        g = idx.shape[0]
+        vals = cb[idx].transpose(0, 2, 1)                      # [g, 8, N]
+        # bit 7 of the sign byte is the parity of bits 0-6 (the derived
+        # ksigns rule, ops/iq_grids.ksigns)
+        par = sign7 ^ (sign7 >> 4)
+        par = par ^ (par >> 2)
+        par = par ^ (par >> 1)
+        full = sign7 | ((par & 1) << 7)
+        shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
+        neg = (full[:, None, :] >> shifts) & 1
+        vals = vals * (1.0 - 2.0 * neg.astype(jnp.float32))
     else:
         gi = qt_t.data.reshape(-1, 2, qt_t.data.shape[1])
         idx, signs = gi[:, 0], gi[:, 1]
@@ -717,9 +821,10 @@ def _dequantize_iqx(qt_t: QTensor, dtype) -> jax.Array:
     s4p = qt_t.aux
     lo = (s4p & jnp.uint8(0xF)).astype(jnp.float32)
     hi = (s4p >> 4).astype(jnp.float32)
-    s4 = jnp.stack([lo, hi], axis=1).reshape(kp // 32, n)
-    drep = jnp.repeat(qt_t.scale.astype(jnp.float32), 8, axis=0)
-    effk = jnp.repeat(drep * s4, 32, axis=0)                   # [Kp, N]
+    s4 = jnp.stack([lo, hi], axis=1).reshape(kp // sub, n)
+    per = 256 // sub
+    drep = jnp.repeat(qt_t.scale.astype(jnp.float32), per, axis=0)
+    effk = jnp.repeat(drep * s4, sub, axis=0)                  # [Kp, N]
 
     out = vals.reshape(kp, n) * effk
     return out[:k].astype(dtype)
@@ -863,6 +968,52 @@ def quantize_linear(w_out_in: jax.Array, qtype: str,
 def dequantize_linear(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
     """QTensor [in, out] -> HF-layout dense weight [out, in]."""
     return dequantize(qt, dtype=dtype).T
+
+
+def concat_qtensors_n(ws) -> QTensor:
+    """Concatenate QTensors along N (the output dim).
+
+    Because blocks run along K and every column quantizes independently,
+    the result is BIT-IDENTICAL to quantizing the concatenated dense
+    weight — the basis for merged-QKV / merged-gate-up projections (the
+    reference does the same surgery on dense weights in `_optimize_pre`,
+    transformers/convert.py:529-640). Works on layer-stacked planes
+    (leading L dims) since every plane is N-last."""
+    import dataclasses as dc
+
+    w0 = ws[0]
+    if len({w.qtype for w in ws}) != 1:
+        raise ValueError("cannot concat mixed qtypes: "
+                         f"{[w.qtype for w in ws]}")
+    if len({w.shape[0] for w in ws}) != 1:
+        raise ValueError("cannot concat differing K: "
+                         f"{[w.shape for w in ws]}")
+    rep = {}
+    for f in ("data", "scale", "zero", "aux"):
+        planes = [getattr(w, f) for w in ws]
+        if any(p is None for p in planes):
+            if any(p is not None for p in planes):
+                raise ValueError(f"inconsistent {f} planes across operands")
+            continue
+        rep[f] = jnp.concatenate(planes, axis=-1)
+    n_total = sum(w.shape[1] for w in ws)
+    return dc.replace(w0, shape=(w0.shape[0], n_total), **rep)
+
+
+def split_qtensor_n(w: QTensor, sizes) -> list:
+    """Inverse of `concat_qtensors_n`: slice along N at the given sizes."""
+    import dataclasses as dc
+
+    if sum(sizes) != w.shape[1]:
+        raise ValueError(f"split sizes {sizes} != N={w.shape[1]}")
+    outs, off = [], 0
+    for s in sizes:
+        rep = {f: getattr(w, f)[..., off:off + s]
+               for f in ("data", "scale", "zero", "aux")
+               if getattr(w, f) is not None}
+        outs.append(dc.replace(w, shape=(w.shape[0], s), **rep))
+        off += s
+    return outs
 
 
 # public jitted alias (eager callers: conversion utilities, tests)

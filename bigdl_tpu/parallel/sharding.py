@@ -39,6 +39,14 @@ LLAMA_RULES: Dict[str, str] = {
     "v_proj_bias": "col",
     "gate_proj_bias": "col",
     "up_proj_bias": "col",
+    # merged layouts (the from_pretrained default): still column-parallel
+    # under GSPMD — the q/k/v (gate/up) output slices cross shard
+    # boundaries, which the partitioner reshard-handles; without these
+    # entries the LARGEST weights would silently replicate
+    "qkv_proj": "col",
+    "gate_up_proj": "col",
+    "qkv_proj_bias": "col",
+    "gate_up_proj_bias": "col",
     "lm_head": "col",
     # replicated: norms, o/down biases (added post-reduce)
 }

@@ -207,6 +207,15 @@ def pad_ff_for_tp(params: Any, n: int) -> Any:
 
 
 def shard_params_tp(params: Any, mesh: Mesh, axis: str = "tp") -> Any:
+    layers = params.get("layers", {})
+    if isinstance(layers, dict) and (
+            "qkv_proj" in layers or "gate_up_proj" in layers):
+        # a contiguous N-shard of a merged weight interleaves q/k/v
+        # (gate/up) across devices — wrong math, so refuse loudly
+        raise ValueError(
+            "explicit TP shards the SPLIT projection layout; load the "
+            "model with merge_projections=False (or run models.llama."
+            "unmerge_projections) before shard_params_tp")
     params = pad_ff_for_tp(params, mesh.shape[axis])
     specs = tp_param_specs(params, mesh, axis=axis)
     return jax.tree.map(

@@ -195,6 +195,15 @@ def attach_lora(
 
     out = dict(params)
     layers = dict(params["layers"])
+    merged = {"qkv_proj", "gate_up_proj"} & set(layers)
+    if merged and any(t not in layers for t in config.target_modules):
+        # silently skipping q/k/v would train an adapter-less attention;
+        # fail loudly with the fix
+        raise ValueError(
+            f"params carry merged projections {sorted(merged)} but "
+            "target_modules name the split layout; load the model with "
+            "merge_projections=False (or run models.llama."
+            "unmerge_projections) before attach_lora")
     for name in config.target_modules:
         if name not in layers:
             continue

@@ -280,10 +280,12 @@ class LLMEngine:
             if ce.cp_max_seq % n_cp:
                 raise ValueError(f"cp_max_seq {ce.cp_max_seq} must be a "
                                  f"multiple of the mesh size {n_cp}")
-            if "q_proj" not in (self.params.get("layers") or {}):
+            layer_keys = set(self.params.get("layers") or {})
+            if not ({"q_proj", "qkv_proj"} & layer_keys):
                 raise ValueError(
                     "context-parallel serving needs the generalized "
-                    "llama-family parameter layout (layers/q_proj ...)")
+                    "llama-family parameter layout (layers/q_proj or "
+                    "the merged layers/qkv_proj)")
 
         fwd = self.family.forward
 

@@ -30,6 +30,23 @@ _TOKENIZER_FILES = (
 )
 
 
+def _maybe_merge(params: Any, cfg: Any, family: FamilyAdapter,
+                 enable: bool) -> Any:
+    """Apply merged-QKV / merged-gate-up weight surgery (the reference's
+    `_optimize_pre`, transformers/convert.py:529-640) for generalized-
+    decoder families. Exact (block quant is per-column); families with
+    custom forwards (rwkv/chatglm-v1/yuan/encoder-decoders) keep their
+    own layouts. Load with merge_projections=False for the split layout
+    (adapter training targets / explicit-TP sharding need it)."""
+    if not enable:
+        return params
+    from bigdl_tpu.models import llama as llama_mod
+
+    if family.forward is not llama_mod.forward:
+        return params
+    return llama_mod.merge_projections(params, cfg)
+
+
 class TpuCausalLM:
     """A loaded (possibly quantized) causal LM + compiled generation."""
 
@@ -324,6 +341,7 @@ class _BaseAutoModelClass:
         speculative: bool = False,
         embedding_qtype: Optional[str] = None,
         imatrix: Optional[Any] = None,
+        merge_projections: bool = True,
         **_ignored,
     ) -> TpuCausalLM:
         from bigdl_tpu.config import flags
@@ -344,7 +362,8 @@ class _BaseAutoModelClass:
                     "from the original checkpoint with the imatrix")
             # max_seq=None lets the manifest's saved value win
             return cls.load_low_bit(path, max_seq=max_seq,
-                                    quantize_kv_cache=quantize_kv_cache)
+                                    quantize_kv_cache=quantize_kv_cache,
+                                    merge_projections=merge_projections)
         if os.path.isfile(path) and path.endswith(".gguf"):
             if speculative:
                 raise ValueError(
@@ -362,6 +381,7 @@ class _BaseAutoModelClass:
             archs = hf_config.get("architectures") or ["?"]
             family = get_family(archs[0], hf_config)
             cfg = family.config_from_hf(hf_config)
+            params = _maybe_merge(params, cfg, family, merge_projections)
             model = TpuCausalLM(params, cfg, family, hf_config,
                                 qtype="gguf",
                                 model_path=os.path.dirname(path),
@@ -442,6 +462,7 @@ class _BaseAutoModelClass:
             params["visual"] = convert_visual_params(
                 iter(visual_tensors),
                 VisualConfig.from_hf(hf_config["visual"]))
+        params = _maybe_merge(params, cfg, family, merge_projections)
         model = TpuCausalLM(params, cfg, family, hf_config, qtype,
                             model_path=path, max_seq=max_seq,
                             kv_quantized=quantize_kv_cache)
@@ -457,20 +478,25 @@ class _BaseAutoModelClass:
             if cvt_qtype == "sym_int4":
                 model.draft_params = params      # already low-bit: share
             else:
-                model.draft_params = family.convert_params(
-                    iter_hf_tensors(path), cfg, qtype="sym_int4",
-                    modules_to_not_convert=tuple(modules_to_not_convert))
+                model.draft_params = _maybe_merge(
+                    family.convert_params(
+                        iter_hf_tensors(path), cfg, qtype="sym_int4",
+                        modules_to_not_convert=tuple(
+                            modules_to_not_convert)),
+                    cfg, family, merge_projections)
         return model
 
     @classmethod
     def load_low_bit(cls, path: str, max_seq: Optional[int] = None,
                      quantize_kv_cache: bool = False,
+                     merge_projections: bool = True,
                      **_ignored) -> TpuCausalLM:
         params, manifest = lowbit_io.load_low_bit(path)
         hf_config = manifest["config"]
         archs = hf_config.get("architectures") or ["?"]
         family = get_family(archs[0], hf_config)
         cfg = family.config_from_hf(hf_config)
+        params = _maybe_merge(params, cfg, family, merge_projections)
         return _attach_qwen_vl(TpuCausalLM(
             params, cfg, family, hf_config,
             qtype=manifest.get(lowbit_io.MARKER),

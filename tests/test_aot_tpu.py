@@ -256,6 +256,27 @@ def test_llama7b_decode_compiles(v5e, aot_flags):
     assert ma.argument_size_in_bytes < 8e9
 
 
+@pytest.mark.parametrize("sq", [1, 1024])
+def test_llama7b_merged_projections_compile(v5e, aot_flags, sq):
+    """Merged-QKV + merged-gate-up layout (the from_pretrained default):
+    decode must still dispatch Mosaic kernels at the fused shapes
+    (N=12288 qkv, N=22016 gate_up), prefill must compile clean."""
+    from bigdl_tpu.models import llama as M
+    from bigdl_tpu.utils.testing import LLAMA2_7B, random_llama_params
+
+    dev = v5e.devices[0]
+    cfg = LLAMA2_7B
+    params = _sds(jax.eval_shape(
+        lambda: M.merge_projections(
+            random_llama_params(cfg, "sym_int4"), cfg)), dev)
+    cache = _sds(jax.eval_shape(lambda: M.new_cache(cfg, 1, 2048)), dev)
+    ids = _sds(jax.ShapeDtypeStruct((1, sq), jnp.int32), dev)
+    comp = _compile(
+        lambda p, i, c: M.forward(p, cfg, i, c, last_only=(sq > 1)),
+        params, ids, cache)
+    assert _has_mosaic_call(comp)
+
+
 def test_llama7b_prefill_compiles(v5e, aot_flags):
     from bigdl_tpu.models import llama as M
 

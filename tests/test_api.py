@@ -84,7 +84,8 @@ def test_optimize_model_matches_direct_quantized_load(tiny_hf_dir):
     opt = optimize_model(dense, low_bit="sym_int4")
 
     from bigdl_tpu.ops.quant import QTensor
-    assert isinstance(opt.params["layers"]["q_proj"], QTensor)
+    # merged-projection layout is the from_pretrained default
+    assert isinstance(opt.params["layers"]["qkv_proj"], QTensor)
     assert isinstance(opt.params["lm_head"], QTensor)
     assert not isinstance(opt.params["embed_tokens"], QTensor)
 

@@ -144,7 +144,8 @@ def test_facade_loads_gptq_checkpoint(tmp_path):
     }, open(os.path.join(mdir, "config.json"), "w"))
 
     model = AutoModelForCausalLM.from_pretrained(mdir, max_seq=64)
-    assert model.params["layers"]["q_proj"].qtype == "asym_int4"
+    # merged-projection layout is the from_pretrained default
+    assert model.params["layers"]["qkv_proj"].qtype == "asym_int4"
     assert model.params["lm_head"].qtype == "asym_int4"  # dense -> asym
     out = model.generate(np.arange(1, 8, dtype=np.int32), max_new_tokens=5)
     assert out.shape == (1, 12)

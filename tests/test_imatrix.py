@@ -21,7 +21,8 @@ def _rand(k, n, seed=0):
 
 
 @pytest.mark.parametrize("qtype", ["sym_int4", "asym_int4", "nf4",
-                                   "q2_k", "iq2_xxs", "iq1_s"])
+                                   "q2_k", "iq2_xxs", "iq2_xs",
+                                   "iq1_s", "iq1_m"])
 def test_weighted_beats_unweighted(qtype):
     """quantize(qw=...) must reduce the IMPORTANCE-WEIGHTED error."""
     x = _rand(512, 64)
@@ -35,7 +36,8 @@ def test_weighted_beats_unweighted(qtype):
 
 
 @pytest.mark.parametrize("qtype,min_corr,max_bpw", [
-    ("iq2_xxs", 0.90, 2.3), ("iq1_s", 0.70, 1.3)])
+    ("iq2_xxs", 0.90, 2.3), ("iq2_xs", 0.90, 2.3),
+    ("iq1_s", 0.70, 1.3), ("iq1_m", 0.72, 1.5)])
 def test_iq_roundtrip(qtype, min_corr, max_bpw):
     x = _rand(512, 96)
     q = quantize(jnp.asarray(x), qtype)
@@ -241,3 +243,33 @@ def test_imatrix_rejected_for_prequantized_inputs(tmp_path):
               str(gp / "model.safetensors"))
     with pytest.raises(ValueError, match="quantization time"):
         AutoModelForCausalLM.from_pretrained(str(gp), imatrix={"x": [1.0]})
+
+
+def test_iq_refinement_strictly_improves():
+    """At equal (iq2_xs) or modestly higher (iq1_m) storage, the refined
+    formats must beat their base formats on RMSE — the reason ggml added
+    XS and 1_M (reference ggml/quantize.py:28-47)."""
+    x = _rand(512, 128, seed=5)
+    errs = {}
+    for qt in ("iq2_xxs", "iq2_xs", "iq1_s", "iq1_m"):
+        d = np.asarray(dequantize(quantize(jnp.asarray(x), qt),
+                                  jnp.float32))
+        errs[qt] = float(np.sqrt(np.mean((x - d) ** 2)))
+    assert errs["iq2_xs"] < errs["iq2_xxs"], errs
+    assert errs["iq1_m"] < errs["iq1_s"], errs
+
+
+def test_iq2_xs_sign_parity_invariant():
+    """Every stored iq2_xs sign index decodes through the 7-bit parity
+    rule; a round trip must reproduce dequantize exactly through the
+    pytree (concat/slice) path too."""
+    from bigdl_tpu.ops.quant import concat_qtensors_n, split_qtensor_n
+
+    x = _rand(256, 64, seed=6)
+    q = quantize(jnp.asarray(x), "iq2_xs")
+    d0 = np.asarray(dequantize(q, jnp.float32))
+    a, b = split_qtensor_n(concat_qtensors_n([q, q]), (64, 64))
+    np.testing.assert_array_equal(
+        np.asarray(dequantize(a, jnp.float32)), d0)
+    np.testing.assert_array_equal(
+        np.asarray(dequantize(b, jnp.float32)), d0)

@@ -204,3 +204,58 @@ def test_iq_gguf_without_grids_raises_clear_error(tmp_path, monkeypatch):
     with pytest.raises(RuntimeError, match="llama.cpp"):
         G.GGUFFile(path).load_dense("w")
     IQ.load_grids.cache_clear()
+
+
+def ref_iq1_m(blk_bytes, grid_u64):
+    """Straight transcription of ggml dequantize_row_iq1_m."""
+    qs = blk_bytes[0:32]
+    qh = blk_bytes[32:48]
+    sc = np.frombuffer(blk_bytes[48:56].tobytes(), np.uint16)
+    d16 = ((int(sc[0]) >> 12) | ((int(sc[1]) >> 8) & 0x00F0)
+           | ((int(sc[2]) >> 4) & 0x0F00) | (int(sc[3]) & 0xF000))
+    d = float(np.uint16(d16).view(np.float16))
+    y = np.zeros(256, np.float32)
+    for ib in range(8):
+        shift = 6 * (ib % 2)
+        dl1 = d * (2 * ((int(sc[ib // 2]) >> shift) & 7) + 1)
+        dl2 = d * (2 * ((int(sc[ib // 2]) >> (shift + 3)) & 7) + 1)
+        for l in range(4):
+            nib = (int(qh[2 * ib + l // 2]) >> (4 * (l % 2))) & 0x0F
+            idx = int(qs[4 * ib + l]) | ((nib & 7) << 8)
+            delta = -G.IQ1M_DELTA if (nib & 8) else G.IQ1M_DELTA
+            dl = dl1 if l < 2 else dl2
+            for j in range(8):
+                gv = (int(grid_u64[idx]) >> (8 * j)) & 0xFF
+                gv = gv - 256 if gv >= 128 else gv        # int8 view
+                y[32 * ib + 8 * l + j] = dl * (float(gv) + delta)
+    return y
+
+
+def test_iq1_m_decoder_matches_loop_reference(fake_grid_env):
+    rng = np.random.default_rng(29)
+    blk = rng.integers(0, 256, (5, 56), dtype=np.uint8)
+    # force a finite packed fp16 super-scale: nibble i of d16 rides the
+    # top nibble of scale uint16 i (0.05 ~ 0x2A66 -> nibbles 6,6,A,2)
+    for i, nib in enumerate((0x6, 0x6, 0xA, 0x2)):
+        blk[:, 49 + 2 * i] = ((blk[:, 49 + 2 * i] & 0x0F)
+                              | (nib << 4)).astype(np.uint8)
+    got = G._decode_iq1_m(blk)
+    grid = fake_grid_env["iq1s_grid"]
+    want = np.stack([ref_iq1_m(blk[i], grid) for i in range(5)])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_iq1_m_through_gguf_file(fake_grid_env, tmp_path):
+    rng = np.random.default_rng(31)
+    blk = rng.integers(0, 256, (4, 56), dtype=np.uint8)
+    for i, nib in enumerate((0x6, 0x6, 0xA, 0x2)):
+        blk[:, 49 + 2 * i] = ((blk[:, 49 + 2 * i] & 0x0F)
+                              | (nib << 4)).astype(np.uint8)
+    path = str(tmp_path / "iq1m.gguf")
+    G.write_gguf(path, {"general.architecture": "llama"},
+                 {"w": (blk.reshape(-1), G.GGML_IQ1_M, (2, 512))})
+    f = G.GGUFFile(path)
+    got = f.load_dense("w")
+    want = np.stack([ref_iq1_m(blk[i], fake_grid_env["iq1s_grid"])
+                     for i in range(4)]).reshape(2, 512)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)

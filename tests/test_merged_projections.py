@@ -1,0 +1,153 @@
+"""Merged QKV / gate-up projections (models/llama.py merge_projections).
+
+The reference fuses q/k/v and gate/up at conversion time (`_optimize_pre`
+weight surgery, reference transformers/convert.py:529-640) and ships fused
+kernels (`forward_qkv`/`mlp_forward_xpu`, models/llama.py:362-373,
+162-166). Here the fusion is a pure param transform over the quantized
+pytree — because block quantization is per-column it must be BIT-exact,
+which these tests pin down.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.models import llama as M
+from bigdl_tpu.models.llama import (LlamaConfig, merge_projections,
+                                    unmerge_projections)
+from bigdl_tpu.utils.testing import random_llama_params
+
+CFG = LlamaConfig(
+    vocab_size=128,
+    hidden_size=128,
+    intermediate_size=256,
+    num_hidden_layers=2,
+    num_attention_heads=8,
+    num_key_value_heads=4,
+    max_position_embeddings=128,
+)
+
+
+def _forward_logits(params, cfg, prompt_len=12, decode_steps=3):
+    prompt = jnp.asarray(np.arange(1, prompt_len + 1, dtype=np.int32)[None])
+    cache = M.new_cache(cfg, 1, 64)
+    lg, cache = M.forward(params, cfg, prompt, cache)
+    outs = [np.asarray(lg, np.float32)]
+    tok = jnp.argmax(lg[:, -1:, :], axis=-1).astype(jnp.int32)
+    for _ in range(decode_steps):
+        lg, cache = M.forward(params, cfg, tok, cache)
+        outs.append(np.asarray(lg, np.float32))
+        tok = jnp.argmax(lg[:, -1:, :], axis=-1).astype(jnp.int32)
+    return outs
+
+
+@pytest.mark.parametrize("qtype", ["sym_int4", "nf4", None])
+def test_merged_logits_bitwise_match(qtype):
+    params = random_llama_params(CFG, qtype=qtype, seed=0)
+    merged = merge_projections(params, CFG)
+    assert "qkv_proj" in merged["layers"]
+    assert "gate_up_proj" in merged["layers"]
+    assert "q_proj" not in merged["layers"]
+    ref = _forward_logits(params, CFG)
+    got = _forward_logits(merged, CFG)
+    for a, b in zip(ref, got):
+        # same K, same per-column blocks, independent f32 accumulators:
+        # nothing may differ
+        np.testing.assert_array_equal(a, b)
+
+
+def test_merged_with_biases():
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, attention_bias=True, mlp_bias=True)
+    params = random_llama_params(cfg, qtype="sym_int4", seed=1)
+    # random_llama_params never emits biases; add them by hand
+    layers = dict(params["layers"])
+    key = jax.random.PRNGKey(42)
+    h, hkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd)
+    for name, n in (("q_proj", h * hd), ("k_proj", hkv * hd),
+                    ("v_proj", hkv * hd),
+                    ("gate_proj", cfg.intermediate_size),
+                    ("up_proj", cfg.intermediate_size),
+                    ("down_proj", cfg.hidden_size)):
+        key, sub = jax.random.split(key)
+        layers[f"{name}_bias"] = (
+            jax.random.normal(sub, (cfg.num_hidden_layers, n),
+                              jnp.float32) * 0.02).astype(jnp.bfloat16)
+    params = {**params, "layers": layers}
+    merged = merge_projections(params, cfg)
+    assert "qkv_proj_bias" in merged["layers"]
+    assert "gate_up_proj_bias" in merged["layers"]
+    for a, b in zip(_forward_logits(params, cfg),
+                    _forward_logits(merged, cfg)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_unmerge_round_trip_exact():
+    from bigdl_tpu.ops.quant import QTensor
+
+    params = random_llama_params(CFG, qtype="sym_int4", seed=2)
+    back = unmerge_projections(merge_projections(params, CFG), CFG)
+    for name in ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"):
+        w0, w1 = params["layers"][name], back["layers"][name]
+        assert isinstance(w1, QTensor) and w1.shape == w0.shape
+        np.testing.assert_array_equal(np.asarray(w0.data),
+                                      np.asarray(w1.data))
+        np.testing.assert_array_equal(np.asarray(w0.scale),
+                                      np.asarray(w1.scale))
+
+
+def test_merge_skips_mixed_qtypes():
+    import dataclasses as dc
+
+    from bigdl_tpu.ops.quant import dequantize, quantize
+
+    params = random_llama_params(CFG, qtype="sym_int4", seed=3)
+    layers = dict(params["layers"])
+    # re-quantize v_proj to a different format (mixed policy)
+    v = layers["v_proj"]
+    lead = v.scale.shape[0]
+    dense = np.stack([np.asarray(dequantize(
+        jax.tree.map(lambda a: a[i], v)), np.float32)
+        for i in range(lead)])
+    qs = [quantize(jnp.asarray(dense[i]), "sym_int8") for i in range(lead)]
+    layers["v_proj"] = jax.tree.map(lambda *xs: jnp.stack(xs), *qs)
+    mixed = {**params, "layers": layers}
+    merged = merge_projections(mixed, CFG)
+    assert "qkv_proj" not in merged["layers"]      # refused, kept split
+    assert "gate_up_proj" in merged["layers"]      # mlp still merges
+
+
+def test_attach_lora_refuses_merged():
+    from bigdl_tpu.qlora import LoraConfig, attach_lora
+
+    merged = merge_projections(
+        random_llama_params(CFG, qtype="sym_int4", seed=4), CFG)
+    with pytest.raises(ValueError, match="merge_projections=False"):
+        attach_lora(merged, LoraConfig(r=2))
+
+
+def test_shard_params_tp_refuses_merged():
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.parallel.tp import shard_params_tp
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    merged = merge_projections(
+        random_llama_params(CFG, qtype="sym_int4", seed=5), CFG)
+    with pytest.raises(ValueError, match="merge_projections=False"):
+        shard_params_tp(merged, mesh)
+
+
+def test_training_forward_merged_matches():
+    """forward_train (the cacheless path through ext_attn_layer's
+    sibling) must accept merged layouts too."""
+    params = random_llama_params(CFG, qtype=None, seed=6)
+    merged = merge_projections(params, CFG)
+    toks = jnp.asarray(np.arange(1, 17, dtype=np.int32)[None])
+    a = np.asarray(M.forward_train(params, CFG, toks), np.float32)
+    b = np.asarray(M.forward_train(merged, CFG, toks), np.float32)
+    np.testing.assert_array_equal(a, b)

@@ -134,3 +134,23 @@ def test_dense_bf16_params_shard_too(setup):
             sharded, cfg, tokens, cache)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-2, atol=2e-2)
+
+
+def test_merged_layout_shards_and_matches(setup):
+    """The merged qkv/gate-up layout (the from_pretrained default) must
+    column-shard under GSPMD — not silently replicate — and match the
+    single-device logits."""
+    cfg, params, tokens, logits_ref = setup
+    merged = llama_mod.merge_projections(params, cfg)
+    mesh = make_mesh(tp=8)
+    specs = llama_param_specs(merged, mesh)
+    qspec = specs["layers"]["qkv_proj"]
+    assert qspec.data == P(None, None, "tp"), "merged qkv not col-sharded"
+    assert specs["layers"]["gate_up_proj"].data == P(None, None, "tp")
+    with mesh:
+        sharded = shard_params(merged, mesh)
+        cache = llama_mod.new_cache(cfg, 1, 64)
+        logits, _ = jax.jit(llama_mod.forward, static_argnums=1)(
+            sharded, cfg, tokens, cache)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(logits_ref), rtol=2e-2, atol=2e-2)
