@@ -78,8 +78,10 @@ def main(argv=None) -> int:
     from bigdl_tpu.training import make_lora_train_step, partition, combine
     from bigdl_tpu.transformers.model import AutoModelForCausalLM
 
+    # split projection layout: the LoRA targets name q_proj/k_proj/...
     model = AutoModelForCausalLM.from_pretrained(
-        args.base_model, load_in_low_bit=args.low_bit)
+        args.base_model, load_in_low_bit=args.low_bit,
+        merge_projections=False)
     from transformers import AutoTokenizer
 
     tok = AutoTokenizer.from_pretrained(args.base_model)

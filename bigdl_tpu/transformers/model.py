@@ -38,12 +38,16 @@ def _maybe_merge(params: Any, cfg: Any, family: FamilyAdapter,
     custom forwards (rwkv/chatglm-v1/yuan/encoder-decoders) keep their
     own layouts. Load with merge_projections=False for the split layout
     (adapter training targets / explicit-TP sharding need it)."""
-    if not enable:
-        return params
     from bigdl_tpu.models import llama as llama_mod
 
     if family.forward is not llama_mod.forward:
         return params
+    if not enable:
+        # a low-bit dir saved from a default (merged) load carries the
+        # merged layout — merge_projections=False must UNDO it, not just
+        # skip merging, or the split-layout consumers (attach_lora,
+        # shard_params_tp) dead-end on their own advice
+        return llama_mod.unmerge_projections(params, cfg)
     return llama_mod.merge_projections(params, cfg)
 
 

@@ -461,6 +461,53 @@ def test_explicit_tp_kernels_compile_v5e_mesh(v5e, aot_flags):
     assert "all-reduce" in txt
 
 
+def test_explicit_tp_parallel_residual_compiles_v5e_mesh(v5e, aot_flags):
+    """VERDICT r3 #6: a falcon-style (parallel-residual, shared input
+    norm, non-gated gelu MLP) family must compile for the real v5e
+    topology under explicit TP with Mosaic kernels AND the all-reduce —
+    these families previously could never use Pallas kernels
+    multi-chip."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+
+    from bigdl_tpu.models import llama as M
+    from bigdl_tpu.models.llama import LlamaConfig
+    from bigdl_tpu.ops.kvcache import KVCache
+    from bigdl_tpu.parallel import tp as TP
+    from bigdl_tpu.utils.testing import random_llama_params
+
+    mesh = Mesh(np.array(v5e.devices), ("tp",))
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=16384,
+        num_hidden_layers=2, num_attention_heads=32,
+        num_key_value_heads=8, parallel_residual=True,
+        shared_input_norm=True, mlp_gated=False, hidden_act="gelu")
+    pshape = jax.eval_shape(lambda: TP.pad_ff_for_tp(
+        random_llama_params(cfg, "sym_int4"), mesh.shape["tp"]))
+    specs = TP.tp_param_specs(pshape, mesh)
+    p_s = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
+        pshape, specs)
+    cshape = jax.eval_shape(lambda: M.new_cache(cfg, 1, 2048))
+    csh = NamedSharding(mesh, TP.tp_cache_specs())
+    cache_s = KVCache(
+        jax.ShapeDtypeStruct(cshape.k.shape, cshape.k.dtype, sharding=csh),
+        jax.ShapeDtypeStruct(cshape.v.shape, cshape.v.dtype, sharding=csh),
+        jax.ShapeDtypeStruct((), jnp.int32,
+                             sharding=NamedSharding(
+                                 mesh, jax.sharding.PartitionSpec())))
+    ids = jax.ShapeDtypeStruct(
+        (1, 1), jnp.int32,
+        sharding=NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    fn = TP._tp_fn(cfg, mesh, "tp")
+    with mesh:
+        comp = fn.lower(p_s, ids, cache_s).compile()
+    txt = comp.as_text()
+    assert _has_mosaic_call(comp)
+    assert "all-reduce" in txt
+
+
 def test_mixtral_prefill_compiles(v5e, aot_flags):
     """MoE model: ragged dispatch + router on the prefill path at a
     mixtral-like (downscaled-experts) geometry."""
