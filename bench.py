@@ -67,21 +67,23 @@ CONFIG_TIMEOUT_S = int(os.environ.get("BENCH_CONFIG_TIMEOUT_S", "900"))
 # XLA matmul above matmul_pallas_max_m (prefill). "pallas-all-m" forces
 # the dequant kernel at every M to re-check that threshold on chip.
 AB_CONFIGS = [
+    # ordered most-informative-first: the tunnel can die mid-run, and
+    # every completed config is persisted to tpu_runs/ immediately
     ("pallas+gemv", dict(matmul_backend="auto", attention_backend="auto",
                          matmul_gemv="auto")),
     ("gemv-fold", dict(matmul_backend="auto", attention_backend="auto",
                        matmul_gemv="fold")),
+    ("xla-matmul", dict(matmul_backend="xla", attention_backend="auto",
+                        matmul_gemv="off")),
+    ("no-merge", dict(matmul_backend="auto", attention_backend="auto",
+                      matmul_gemv="auto", _merged=False)),
+    ("xla-attn", dict(matmul_backend="auto", attention_backend="xla",
+                      matmul_gemv="auto")),
+    ("pallas", dict(matmul_backend="auto", attention_backend="auto",
+                    matmul_gemv="off")),
     ("pallas-all-m", dict(matmul_backend="auto", attention_backend="auto",
                           matmul_gemv="auto",
                           matmul_pallas_max_m=1 << 30)),
-    ("no-merge", dict(matmul_backend="auto", attention_backend="auto",
-                      matmul_gemv="auto", _merged=False)),
-    ("pallas", dict(matmul_backend="auto", attention_backend="auto",
-                    matmul_gemv="off")),
-    ("xla-matmul", dict(matmul_backend="xla", attention_backend="auto",
-                        matmul_gemv="off")),
-    ("xla-attn", dict(matmul_backend="auto", attention_backend="xla",
-                      matmul_gemv="auto")),
     ("xla", dict(matmul_backend="xla", attention_backend="xla",
                  matmul_gemv="off")),
     # experiments beyond the dispatch matrix (keys starting with "_" are
@@ -345,6 +347,13 @@ def main() -> None:
 
     from bigdl_tpu.utils.testing import LLAMA2_7B
 
+    # persist every completed config immediately: a tunnel death mid-A/B
+    # must not cost the results already measured
+    partial_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tpu_runs",
+        time.strftime("bench_partial_%Y%m%d_%H%M%S.jsonl"))
+    os.makedirs(os.path.dirname(partial_path), exist_ok=True)
+
     ab_results = {}
     for label, _ in AB_CONFIGS:
         t0 = time.time()
@@ -394,6 +403,12 @@ def main() -> None:
         except Exception as e:
             ab_results[label] = {"error": f"{type(e).__name__}: {e}"}
             print(f"bench[{label}]: FAILED {e}", file=sys.stderr)
+        try:
+            with open(partial_path, "a") as pf:
+                pf.write(json.dumps({"config": label,
+                                     **ab_results[label]}) + "\n")
+        except OSError:
+            pass
         if "error" in ab_results[label] and _probe_backend(60) != "tpu":
             # a kernel fault can take the whole tunnel down server-side;
             # don't burn the window timing out every remaining config
