@@ -140,12 +140,14 @@ def _bench_gspmd_tp(model, prompt, out_len, num_trials, warm_up):
 
 
 def run_one(model_path: str, low_bit: str, in_len: int, out_len: int,
-            api: str, num_trials: int, warm_up: int) -> Dict[str, Any]:
+            api: str, num_trials: int, warm_up: int,
+            model=None) -> Dict[str, Any]:
     if api not in TEST_APIS:
         raise ValueError(f"unknown test_api {api!r}; choose from "
                          f"{TEST_APIS}")
-    max_seq = 1 << (in_len + out_len + 8 - 1).bit_length()
-    model = _load(model_path, low_bit, max_seq, api)
+    if model is None:
+        max_seq = 1 << (in_len + out_len + 8 - 1).bit_length()
+        model = _load(model_path, low_bit, max_seq, api)
     vocab = model.config.vocab_size
     prompt = (np.arange(1, in_len + 1, dtype=np.int32) * 977) % vocab
 
@@ -170,15 +172,21 @@ def run(config: Dict[str, Any]) -> List[Dict[str, Any]]:
     low_bits = config.get("low_bit", "sym_int4")
     if isinstance(low_bits, str):
         low_bits = [low_bits]
+    pairs = [tuple(int(x) for x in p.split("-"))
+             for p in config.get("in_out_pairs", ["32-32"])]
+    # one load per (model, api, low_bit) cell: in_out pairs reuse the
+    # model (a 7B re-quantize per pair would double tunnel-window cost)
+    max_seq = 1 << (max(i + o for i, o in pairs) + 8 - 1).bit_length()
     for model_path in config["model_paths"]:
         for api in apis:
             for low_bit in low_bits:
-                for pair in config.get("in_out_pairs", ["32-32"]):
-                    in_len, out_len = (int(x) for x in pair.split("-"))
+                model = _load(model_path, low_bit, max_seq, api)
+                for in_len, out_len in pairs:
                     row = run_one(
                         model_path, low_bit, in_len, out_len, api,
                         int(config.get("num_trials", 3)),
                         int(config.get("warm_up", 1)),
+                        model=model,
                     )
                     print(json.dumps(row))
                     rows.append(row)

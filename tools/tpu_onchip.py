@@ -127,7 +127,9 @@ def step_qmatmul_prefill():
 
 def step_gemv():
     # decode-GEMV variant, called directly (bypasses the probe) at
-    # llama-7B decode geometry
+    # llama-7B decode geometries: split, MERGED (qkv N=12288 /
+    # gate_up N=22016 — the shipped default), tp=4 shards, and the
+    # scale-FOLDED body (raw codes on the MXU) for each
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -137,24 +139,49 @@ def step_gemv():
     from bigdl_tpu.ops.quant import dequantize, get_qtype, quantize
 
     out = []
-    for qt_name, k, n in [("sym_int4", 4096, 4096),
-                          ("sym_int4", 4096, 11008),
-                          ("sym_int8", 4096, 4096),
-                          ("nf4", 4096, 4096)]:
+    for qt_name, k, n, fold in [
+            ("sym_int4", 4096, 4096, False),
+            ("sym_int4", 4096, 4096, True),
+            ("sym_int4", 4096, 12288, False),    # merged qkv
+            ("sym_int4", 4096, 12288, True),
+            ("sym_int4", 4096, 22016, False),    # merged gate_up
+            ("sym_int4", 4096, 22016, True),
+            ("sym_int4", 11008, 4096, False),    # down proj
+            ("sym_int4", 11008, 4096, True),
+            ("sym_int4", 2816, 4096, False),     # tp=4 down shard (padded)
+            ("sym_int8", 4096, 4096, False),
+            ("nf4", 4096, 4096, False),
+            ("nf4", 4096, 4096, True)]:
         qt = get_qtype(qt_name)
+        interp = bool(os.environ.get("ONCHIP_FORCE_CPU"))
         w = jax.random.normal(jax.random.PRNGKey(0), (k, n), jnp.float32)
         wq = quantize(w, qt_name)
         x = jax.random.normal(jax.random.PRNGKey(1), (1, k), jnp.bfloat16)
         y = np.asarray(
-            _q_gemv_pallas(x, wq, qt, 1, k, n, False, x.dtype), np.float32)
-        ref = np.asarray(
+            _q_gemv_pallas(x, wq, qt, 1, k, n, interp, x.dtype, fold=fold),
+            np.float32)
+        # two references: bf16-dequant (the XLA fallback's contract —
+        # the STANDARD kernel matches it) and exact-f32 dequant (the
+        # FOLD kernel applies scales in f32 and lands much closer to
+        # this one; its larger bf16-ref deviation is the reference's
+        # own weight rounding, not kernel error)
+        ref16 = np.asarray(
             x.astype(jnp.float32) @ dequantize(wq).astype(jnp.float32))
-        rel = float(np.max(np.abs(y - ref) / np.maximum(np.abs(ref), 1.0)))
+        ref32 = np.asarray(
+            x.astype(jnp.float32) @ dequantize(wq, dtype=jnp.float32))
+
+        def _rel(ref):
+            return float(np.max(np.abs(y - ref)
+                                / np.maximum(np.abs(ref), 1.0)))
+
         t = _bench(jax.jit(
-            lambda xx: _q_gemv_pallas(xx, wq, qt, 1, k, n, False, xx.dtype)),
+            lambda xx: _q_gemv_pallas(xx, wq, qt, 1, k, n, interp, xx.dtype,
+                                      fold=fold)),
             x)
-        probe = gemv_kernel_compiles(qt_name, k, n)
-        out.append({"qtype": qt_name, "k": k, "n": n, "max_rel_err": rel,
+        probe = gemv_kernel_compiles(qt_name, k, n, fold=fold)
+        out.append({"qtype": qt_name, "k": k, "n": n, "fold": fold,
+                    "max_rel_err_bf16ref": _rel(ref16),
+                    "max_rel_err_f32ref": _rel(ref32),
                     "gemv_ms": t * 1e3, "probe_ok": probe})
     return {"cases": out}
 
