@@ -172,6 +172,11 @@ def run(config: Dict[str, Any]) -> List[Dict[str, Any]]:
     low_bits = config.get("low_bit", "sym_int4")
     if isinstance(low_bits, str):
         low_bits = [low_bits]
+    bad = [a for a in apis if a not in TEST_APIS]
+    if bad:
+        # fail BEFORE any model load: a typo'd api must not cost a 7B
+        # quantize inside a scarce tunnel window
+        raise ValueError(f"unknown test_api {bad}; choose from {TEST_APIS}")
     pairs = [tuple(int(x) for x in p.split("-"))
              for p in config.get("in_out_pairs", ["32-32"])]
     # one load per (model, api, low_bit) cell: in_out pairs reuse the

@@ -95,7 +95,10 @@ class OpenAIServer:
 
     def _decode_text(self, ids: List[int]) -> str:
         if self.tokenizer is None:
-            return json.dumps(ids)
+            # space-joined, not JSON: streaming diffs the ACCUMULATED
+            # decode, so the fallback text must be append-only as ids
+            # grow (a JSON list rewrites its closing bracket)
+            return " ".join(str(i) for i in ids)
         return self.tokenizer.decode(ids, skip_special_tokens=True)
 
     def _params(self, body: dict) -> SamplingParams:
@@ -119,15 +122,58 @@ class OpenAIServer:
                   else None),
         )
 
-    def _run_request(self, token_ids, params, stream_cb=None):
+    def _run_request(self, token_ids, params, stream_cb=None,
+                     stop_strs=()):
         """Returns (rid, {index: ids}, {index: logprob entries},
-        {index: finish_reason}). stream_cb(new_ids, index) when set."""
+        {index: finish_reason}, {index: final text}).
+
+        stream_cb(text_delta, index) when set — deltas come from the
+        ACCUMULATED decode (robust to multi-token characters), with a
+        holdback of len(longest stop)-1 chars so a stop string never
+        leaks into the stream. `stop_strs` are the OpenAI `stop`
+        sequences (reference vllm SamplingParams.stop): output truncates
+        at the first match; a single-choice request aborts early."""
         rid = f"cmpl-{uuid.uuid4().hex[:16]}"
         self.engine.add_request(rid, token_ids, params)
         self.loop.notify()
         out_ids: dict = {}
         out_lps: dict = {}
         reasons: dict = {}
+        texts: dict = {}      # index -> full decoded (possibly cut) text
+        emitted: dict = {}    # index -> chars already streamed
+        scanned: dict = {}    # index -> chars already stop-scanned
+        stopped: set = set()
+        hold = max((len(s) for s in stop_strs), default=0)
+        n_choices = max(params.n, 1)
+        # only streaming or stop matching needs live detokenization;
+        # plain requests decode once at the end as before
+        live_decode = stream_cb is not None or bool(stop_strs)
+
+        def emit(idx, upto):
+            nonlocal stream_cb
+            if stream_cb is None:
+                return
+            full = texts[idx]
+            # never emit a trailing replacement char: an incomplete
+            # multi-token UTF-8 sequence decodes to U+FFFD now but to
+            # the real character once the next token lands — holding it
+            # back keeps the accumulated-diff stream append-only
+            while upto > emitted.get(idx, 0) and upto <= len(full) \
+                    and full[upto - 1] == "�":
+                upto -= 1
+            start = emitted.get(idx, 0)
+            if upto > start:
+                try:
+                    stream_cb(full[start:upto], idx)
+                    emitted[idx] = upto
+                except OSError:
+                    # client went away: free the slot, then keep
+                    # draining until the engine emits the abort-finish
+                    # (reference api_server.py:371 disconnect -> abort)
+                    self.engine.abort_request(rid)
+                    self.loop.notify()
+                    stream_cb = None
+
         done = False
         while not done:
             outs = self.engine.get_outputs(rid)
@@ -135,32 +181,53 @@ class OpenAIServer:
                 time.sleep(0.002)
                 continue
             for o in outs:
-                out_ids.setdefault(o.index, []).extend(o.new_token_ids)
-                if o.logprobs:
-                    out_lps.setdefault(o.index, []).extend(o.logprobs)
-                if stream_cb is not None and o.new_token_ids:
-                    try:
-                        stream_cb(o.new_token_ids, o.index)
-                    except OSError:
-                        # client went away: free the slot, then keep
-                        # draining until the engine emits the abort-finish
-                        # (reference api_server.py:371 disconnect -> abort)
-                        self.engine.abort_request(rid)
-                        self.loop.notify()
-                        stream_cb = None
+                idx = o.index
+                if idx not in stopped:
+                    # stopped choices freeze: ids/logprobs past the stop
+                    # would inflate usage and desync from the cut text
+                    out_ids.setdefault(idx, []).extend(o.new_token_ids)
+                    if o.logprobs:
+                        out_lps.setdefault(idx, []).extend(o.logprobs)
+                if live_decode and o.new_token_ids and idx not in stopped:
+                    full = self._decode_text(out_ids[idx])
+                    # scan only the unseen tail (minus a stop-length
+                    # overlap) — not the whole text every batch
+                    scan0 = max(0, scanned.get(idx, 0) - max(hold - 1, 0))
+                    cut = -1
+                    for s in stop_strs:
+                        p = full.find(s, scan0)
+                        if p != -1 and (cut == -1 or p < cut):
+                            cut = p
+                    scanned[idx] = len(full)
+                    if cut != -1:
+                        texts[idx] = full[:cut]
+                        stopped.add(idx)
+                        reasons[idx] = "stop"
+                        emit(idx, cut)
+                        if stopped >= set(range(n_choices)):
+                            # every choice done: stop generating
+                            self.engine.abort_request(rid)
+                            self.loop.notify()
+                    else:
+                        texts[idx] = full
+                        emit(idx, len(full) - hold + 1
+                             if hold else len(full))
                 if o.finish_reason is not None:
-                    reasons[o.index] = o.finish_reason
+                    reasons.setdefault(idx, o.finish_reason)
                 if o.finished:
-                    reasons.setdefault(o.index, o.finish_reason or "stop")
+                    reasons.setdefault(idx, o.finish_reason or "stop")
                     done = True
-        n_choices = max(params.n, 1)
+        for idx in list(texts):
+            emit(idx, len(texts[idx]))       # flush the holdback
         for i in range(n_choices):
             out_ids.setdefault(i, [])
+            texts.setdefault(i, self._decode_text(out_ids[i]))
             reasons.setdefault(i, reasons.get(0, "stop"))
         # the synthetic fan-out closer carries no tokens under its own
         # index; drop any empty phantom choice beyond n
         out_ids = {i: v for i, v in out_ids.items() if i < n_choices}
-        return rid, out_ids, out_lps, reasons
+        texts = {i: v for i, v in texts.items() if i < n_choices}
+        return rid, out_ids, out_lps, reasons, texts
 
     # -- http ---------------------------------------------------------------
 
@@ -209,6 +276,10 @@ class OpenAIServer:
                     prompt = body.get("prompt", "")
                 ids = server._encode(prompt)
                 params = server._params(body)
+                stops = body.get("stop") or ()
+                if isinstance(stops, str):
+                    stops = (stops,)
+                stops = tuple(s for s in stops if s)
                 created = int(time.time())
 
                 if body.get("stream"):
@@ -217,8 +288,7 @@ class OpenAIServer:
                     self.send_header("Cache-Control", "no-cache")
                     self.end_headers()
 
-                    def cb(new_ids, index):
-                        text = server._decode_text(new_ids)
+                    def cb(text, index):
                         delta = ({"role": "assistant", "content": text}
                                  if chat else None)
                         chunk = {
@@ -236,20 +306,21 @@ class OpenAIServer:
                             b"data: " + json.dumps(chunk).encode() + b"\n\n")
                         self.wfile.flush()
 
-                    rid, out_ids, out_lps, reasons = server._run_request(
-                        ids, params, stream_cb=cb)
+                    rid, out_ids, out_lps, reasons, _ = \
+                        server._run_request(ids, params, stream_cb=cb,
+                                            stop_strs=stops)
                     self.wfile.write(b"data: [DONE]\n\n")
                     self.wfile.flush()
                     return
 
-                rid, out_ids, out_lps, reasons = server._run_request(
-                    ids, params)
+                rid, out_ids, out_lps, reasons, texts = \
+                    server._run_request(ids, params, stop_strs=stops)
                 choices = []
                 total_completion = 0
                 for idx in sorted(out_ids):
                     toks = out_ids[idx]
                     total_completion += len(toks)
-                    text = server._decode_text(toks)
+                    text = texts.get(idx, server._decode_text(toks))
                     choice = ({"index": idx, "message":
                                {"role": "assistant", "content": text},
                                "finish_reason": reasons.get(idx, "stop")}

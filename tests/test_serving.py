@@ -258,7 +258,7 @@ def test_openai_server(model):
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=120) as r:
             data = json.loads(r.read())
-        got = json.loads(data["choices"][0]["text"])
+        got = [int(x) for x in data["choices"][0]["text"].split()]
         assert got == plain_greedy(model.params, [1, 2, 3, 4], 6)
         assert data["usage"]["completion_tokens"] == 6
 
@@ -273,10 +273,9 @@ def test_openai_server(model):
         assert payload.strip().endswith("data: [DONE]")
         chunks = [json.loads(line[6:]) for line in payload.splitlines()
                   if line.startswith("data: ") and "[DONE]" not in line]
-        streamed = []
-        for c in chunks:
-            streamed.extend(json.loads(c["choices"][0]["text"]))
-        assert streamed == plain_greedy(model.params, [5, 6, 7], 4)
+        streamed = "".join(c["choices"][0]["text"] for c in chunks)
+        assert ([int(x) for x in streamed.split()]
+                == plain_greedy(model.params, [5, 6, 7], 4))
     finally:
         server.shutdown()
 
@@ -417,3 +416,50 @@ def test_engine_rejects_recurrent_families():
         hf_config={})
     with _pytest.raises(ValueError, match="recurrent"):
         LLMEngine(fake)
+
+
+def test_openai_server_stop_strings(model):
+    """OpenAI `stop` sequences (reference vllm SamplingParams.stop):
+    output truncates at the first match, finish_reason is 'stop', and
+    the streamed text never leaks the stop string."""
+    from bigdl_tpu.serving.api_server import OpenAIServer
+
+    eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128))
+    server = OpenAIServer(eng)
+    httpd = server.serve(port=0, background=True)
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        full = plain_greedy(model.params, [1, 2, 3, 4], 8)
+        # tokenizer-less server: text is the JSON id list; stop on the
+        # rendering of the 4th generated token
+        full_text = " ".join(str(i) for i in full)
+        stop = f" {full[3]}"
+        assert stop in full_text
+        want = full_text[:full_text.index(stop)]
+
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": [1, 2, 3, 4], "max_tokens": 8,
+                             "stop": stop}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            data = json.loads(r.read())
+        assert data["choices"][0]["text"] == want
+        assert data["choices"][0]["finish_reason"] == "stop"
+
+        # streaming: concatenated deltas equal the truncated text
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": [1, 2, 3, 4], "max_tokens": 8,
+                             "stop": [stop], "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            payload = r.read().decode()
+        chunks = [json.loads(line[6:]) for line in payload.splitlines()
+                  if line.startswith("data: ") and "[DONE]" not in line]
+        streamed = "".join(c["choices"][0]["text"] for c in chunks)
+        assert streamed == want
+        assert stop not in streamed
+    finally:
+        server.shutdown()
