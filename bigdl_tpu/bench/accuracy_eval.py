@@ -72,10 +72,51 @@ def model_config(size: str = "small"):
         rope_theta=10000.0, tie_word_embeddings=False, hidden_act="silu")
 
 
+def load_hf_params(ckpt: str, cfg):
+    """Inverse of `export_hf`: HF safetensors -> f32 training pytree
+    (checkpoint-resume for longer training runs)."""
+    import jax.numpy as jnp
+    from safetensors.numpy import load_file
+
+    t = load_file(os.path.join(ckpt, "model.safetensors"))
+
+    def get(name, transpose=False):
+        a = t[name]
+        return jnp.asarray(a.T if transpose else a, jnp.float32)
+
+    per = {"q_proj": "self_attn.q_proj.weight",
+           "k_proj": "self_attn.k_proj.weight",
+           "v_proj": "self_attn.v_proj.weight",
+           "o_proj": "self_attn.o_proj.weight",
+           "gate_proj": "mlp.gate_proj.weight",
+           "up_proj": "mlp.up_proj.weight",
+           "down_proj": "mlp.down_proj.weight"}
+    layers = {}
+    for key, hf in per.items():
+        layers[key] = jnp.stack([
+            get(f"model.layers.{i}.{hf}", transpose=True)
+            for i in range(cfg.num_hidden_layers)])
+    for key, hf in (("input_layernorm", "input_layernorm.weight"),
+                    ("post_attention_layernorm",
+                     "post_attention_layernorm.weight")):
+        layers[key] = jnp.stack([get(f"model.layers.{i}.{hf}")
+                                 for i in range(cfg.num_hidden_layers)])
+    return {"embed_tokens": get("model.embed_tokens.weight"),
+            "layers": layers,
+            "norm": get("model.norm.weight"),
+            "lm_head": get("lm_head.weight", transpose=True)}
+
+
 def train(cfg, tokens: np.ndarray, steps: int, batch: int = 8,
           seq: int = 256, lr: float = 3e-3, seed: int = 0,
-          log_every: int = 100):
-    """Train from random init with the in-repo stack (training.py)."""
+          log_every: int = 100, init_params=None,
+          lr_offset_steps: int = 0):
+    """Train with the in-repo stack (training.py), from random init or
+    a resumed checkpoint pytree. On resume pass `lr_offset_steps` (the
+    steps already taken) so the cosine schedule CONTINUES from where the
+    original run left off instead of re-peaking on converged weights;
+    the data RNG must also be re-seeded by the caller so the new steps
+    draw fresh batches, not a replay."""
     import jax.numpy as jnp
     import optax
 
@@ -83,9 +124,15 @@ def train(cfg, tokens: np.ndarray, steps: int, batch: int = 8,
     from bigdl_tpu.training import make_train_step
     from bigdl_tpu.utils.testing import random_llama_params
 
-    params = random_llama_params(cfg, qtype=None, seed=seed,
-                                 compute_dtype=jnp.float32)
-    sched = optax.cosine_decay_schedule(lr, steps, alpha=0.1)
+    params = init_params if init_params is not None else \
+        random_llama_params(cfg, qtype=None, seed=seed,
+                            compute_dtype=jnp.float32)
+    base_sched = optax.cosine_decay_schedule(
+        lr, lr_offset_steps + steps, alpha=0.1)
+
+    def sched(count):
+        return base_sched(count + lr_offset_steps)
+
     opt = optax.adamw(sched, weight_decay=0.01)
     step = make_train_step(
         lambda p, c, t: M.forward_train(p, c, t,
@@ -258,6 +305,10 @@ def main(argv=None):
                     "second moments at ultra-low bpw)")
     ap.add_argument("--ckpt-dir", default=None,
                     help="reuse a previously trained checkpoint dir")
+    ap.add_argument("--train-more", type=int, default=0,
+                    help="resume from --ckpt-dir and train this many "
+                    "extra steps before evaluating (exports to a new "
+                    "dir; requires --ckpt-dir)")
     args = ap.parse_args(argv)
 
     corpus = build_corpus()
@@ -268,7 +319,31 @@ def main(argv=None):
 
     cfg = model_config(args.size)
     steps = args.steps
-    if args.ckpt_dir and os.path.exists(
+    if args.train_more:
+        if not (args.ckpt_dir and os.path.exists(
+                os.path.join(args.ckpt_dir, "model.safetensors"))):
+            raise ValueError(
+                "--train-more needs an existing --ckpt-dir checkpoint "
+                f"(got {args.ckpt_dir!r}) — refusing to silently train "
+                "from scratch")
+        meta_p = os.path.join(args.ckpt_dir, "train_meta.json")
+        prev = json.load(open(meta_p)) if os.path.exists(meta_p) else {}
+        prev_steps = prev.get("steps", 0)
+        print(f"resuming {args.ckpt_dir} "
+              f"(+{args.train_more} steps after {prev_steps}) ...")
+        params, loss = train(
+            cfg, train_tok, args.train_more, args.batch, args.seq,
+            # fresh data draws + continued LR schedule, not a replay
+            seed=prev_steps + 1,
+            lr_offset_steps=prev_steps,
+            init_params=load_hf_params(args.ckpt_dir, cfg))
+        steps = prev_steps + args.train_more
+        ckpt = tempfile.mkdtemp(prefix="acc_eval_")
+        export_hf(params, cfg, ckpt)
+        json.dump({"loss": loss, "steps": steps},
+                  open(os.path.join(ckpt, "train_meta.json"), "w"))
+        print(f"exported checkpoint to {ckpt}")
+    elif args.ckpt_dir and os.path.exists(
             os.path.join(args.ckpt_dir, "model.safetensors")):
         ckpt = args.ckpt_dir
         meta_p = os.path.join(ckpt, "train_meta.json")

@@ -145,9 +145,15 @@ class OpenAIServer:
         stopped: set = set()
         hold = max((len(s) for s in stop_strs), default=0)
         n_choices = max(params.n, 1)
-        # only streaming or stop matching needs live detokenization;
-        # plain requests decode once at the end as before
-        live_decode = stream_cb is not None or bool(stop_strs)
+        # only stop matching needs the ACCUMULATED decode (a stop string
+        # can span chunk boundaries); stop-free streams with a REAL
+        # tokenizer decode each chunk independently — O(n) total, the
+        # pre-stop behavior — and plain requests decode once at the
+        # end. The tokenizer-less fallback must stay accumulated: its
+        # space separators live BETWEEN chunks, and it is append-only
+        # by construction so the diff is exact.
+        live_decode = bool(stop_strs) or (
+            stream_cb is not None and self.tokenizer is None)
 
         def emit(idx, upto):
             nonlocal stream_cb
@@ -188,6 +194,16 @@ class OpenAIServer:
                     out_ids.setdefault(idx, []).extend(o.new_token_ids)
                     if o.logprobs:
                         out_lps.setdefault(idx, []).extend(o.logprobs)
+                    if not live_decode and stream_cb is not None \
+                            and o.new_token_ids:
+                        # stop-free stream: independent per-chunk decode
+                        try:
+                            stream_cb(self._decode_text(o.new_token_ids),
+                                      idx)
+                        except OSError:
+                            self.engine.abort_request(rid)
+                            self.loop.notify()
+                            stream_cb = None
                 if live_decode and o.new_token_ids and idx not in stopped:
                     full = self._decode_text(out_ids[idx])
                     # scan only the unseen tail (minus a stop-length
@@ -204,6 +220,22 @@ class OpenAIServer:
                         stopped.add(idx)
                         reasons[idx] = "stop"
                         emit(idx, cut)
+                        # drop the tokens whose text fell past the cut
+                        # (usage must bill the VISIBLE completion): walk
+                        # back this batch's tokens while the stop still
+                        # matches without them
+                        ids = out_ids[idx]
+                        keep = len(ids)
+                        lo = len(ids) - len(o.new_token_ids)
+                        while keep > lo:
+                            shorter = self._decode_text(ids[:keep - 1])
+                            if any(s in shorter for s in stop_strs):
+                                keep -= 1
+                            else:
+                                break
+                        del ids[keep:]
+                        if idx in out_lps:
+                            del out_lps[idx][keep:]
                         if stopped >= set(range(n_choices)):
                             # every choice done: stop generating
                             self.engine.abort_request(rid)
