@@ -202,3 +202,22 @@ def test_profiling_helpers(tmp_path):
     s = t.summary()
     assert s["step"]["count"] == 1 and s["step"]["mean_ms"] > 0
     assert "region" in s
+
+
+def test_from_pretrained_speculative_merged(tiny_hf_dir):
+    """speculative=True must work with the merged-projection default:
+    target and draft share the merged layout, and self-speculative
+    greedy output equals the plain greedy output (speculative decoding
+    is lossless for greedy)."""
+    from bigdl_tpu.transformers import AutoModelForCausalLM
+
+    spec = AutoModelForCausalLM.from_pretrained(
+        tiny_hf_dir, load_in_low_bit="bf16", speculative=True, max_seq=64)
+    assert spec.draft_params is not None
+    assert "qkv_proj" in spec.params["layers"]
+    assert "qkv_proj" in spec.draft_params["layers"]
+    plain = AutoModelForCausalLM.from_pretrained(
+        tiny_hf_dir, load_in_low_bit="bf16", max_seq=64)
+    out_s = spec.generate([3, 1, 4, 1, 5], max_new_tokens=8)
+    out_p = plain.generate([3, 1, 4, 1, 5], max_new_tokens=8)
+    np.testing.assert_array_equal(out_s, out_p)
