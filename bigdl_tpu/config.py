@@ -28,11 +28,12 @@ class RuntimeFlags:
     matmul_backend: str = "auto"
     # decode-attention dispatch, same values (ops/pallas/decode_attention)
     attention_backend: str = "auto"
-    # decode GEMV (M<=16) kernel variant: "auto" (use it), "fold"
-    # (scale-folded body: raw codes on the MXU, scales applied to the
-    # per-block partials — fewer VPU ops per weight on the HBM/VPU-bound
-    # decode path), "off" (route small-M through the generic tiles) —
-    # the on-chip A/B switch
+    # decode GEMV (M<=16) kernel variant: "auto" (MXU body when the
+    # weights carry the int4-dtype layout, else the standard body),
+    # "fold" (scale-folded body over the canonical packing), "mxu8"
+    # (q8 activations against int4/int8 weights on the MXU's int8 path
+    # — 2x bf16 throughput, q8 rounding on activations), "off" (route
+    # small-M through the generic tiles) — the on-chip A/B switch
     matmul_gemv: str = "auto"
     # In "auto" matmul dispatch, batch rows above this go to the XLA
     # matmul instead of the Pallas dequant kernel. First on-chip A/B
@@ -45,6 +46,10 @@ class RuntimeFlags:
     # MoE prefill dispatch: "auto" (sorted ragged kernel on TPU, dense
     # combine elsewhere), "ragged" (force, incl. interpret), "dense"
     moe_dispatch: str = "auto"
+    # sym_int4 weight storage at model load: "auto" (int4-dtype MXU
+    # layout on TPU — native Mosaic int4 loads instead of the VPU
+    # nibble-unpack chain; canonical split-block elsewhere), "on", "off"
+    mxu_layout: str = "auto"
     # host-side C++ kernels (bigdl_tpu.native); disable to force pure JAX
     disable_native: bool = False
     native_cache_dir: Optional[str] = None
@@ -71,6 +76,7 @@ class RuntimeFlags:
             matmul_pallas_max_m=int(os.environ.get(
                 "BIGDL_TPU_MATMUL_PALLAS_MAX_M", "128")),
             moe_dispatch=os.environ.get("BIGDL_TPU_MOE_DISPATCH", "auto"),
+            mxu_layout=os.environ.get("BIGDL_TPU_MXU_LAYOUT", "auto"),
             disable_native=_env_bool("BIGDL_TPU_DISABLE_NATIVE"),
             native_cache_dir=os.environ.get("BIGDL_TPU_NATIVE_CACHE"),
             quantize_kv_cache=_env_bool("BIGDL_TPU_QUANTIZE_KV_CACHE"),

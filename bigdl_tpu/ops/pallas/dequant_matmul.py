@@ -126,6 +126,17 @@ def _kernel_int8(x_ref, data_ref, scale_ref, out_ref, acc_ref, *,
     _accumulate(x_ref[:], w, out_ref, acc_ref, nk)
 
 
+def _kernel_i4(x_ref, data_ref, scale_ref, out_ref, acc_ref, *,
+               block, bk, bn, nk):
+    """Generic-tile body for the MXU (int4-dtype) layout: native int4
+    load, one convert, per-weight scale — no nibble unpack chain."""
+    s = scale_ref[:].astype(jnp.float32)[:, None, :]
+    codes = data_ref[:].astype(jnp.int8).astype(jnp.float32)
+    w = (codes.reshape(bk // block, block, bn) * s) \
+        .reshape(bk, bn).astype(jnp.bfloat16)
+    _accumulate(x_ref[:], w, out_ref, acc_ref, nk)
+
+
 def _gemv_kernel(x_ref, data_ref, scale_ref, *rest, block, kind, codebook,
                  bk, bn, nk, bits):
     """Decode-GEMV body: grid (N/bn, K/bk), K innermost. x stays
@@ -211,6 +222,65 @@ def _gemv_kernel_fold(x3_ref, data_ref, scale_ref, out_ref, acc_ref, *,
         out_ref[:] = acc_ref[:].astype(out_ref.dtype)
 
 
+def _gemv_kernel_mxu(x3_ref, data_ref, scale_ref, out_ref, acc_ref, *,
+                     block, bk, bn, nk):
+    """MXU-layout decode GEMV (int4/int8-dtype weights, scale-folded).
+
+    The canonical split-block layout costs ~6 i32 VPU ops per weight to
+    unpack (widen/mask/shift/concat) — at 7B decode that chain, not HBM,
+    set the 30 ms/token floor (BENCH_r04: 18% of roofline). jnp.int4
+    arrays are bit-packed by XLA (same HBM bytes) and loaded natively by
+    Mosaic, so per-weight work drops to ONE convert feeding the batched
+    dot; scales fold onto the [rows, M, bn] partials exactly like
+    `_gemv_kernel_fold` (same numerics class: integer codes exact in
+    bf16, scale applied once in f32)."""
+    k = pl.program_id(1)
+    rows = bk // block
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    cb = data_ref[:].astype(jnp.bfloat16).reshape(rows, block, bn)
+    part = jax.lax.dot_general(
+        x3_ref[:], cb, (((2,), (1,)), ((1,), (0,))),
+        preferred_element_type=jnp.float32)          # [rows, M, bn]
+    s = scale_ref[:].astype(jnp.float32)             # [rows, bn]
+    acc_ref[:] += jnp.sum(part * s[:, None, :], axis=0)
+
+    @pl.when(k == nk - 1)
+    def _():
+        out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+
+def _gemv_kernel_mxu8(x3_ref, sxt_ref, data_ref, scale_ref, out_ref,
+                      acc_ref, *, block, bk, bn, nk):
+    """int8-activation variant: per-block q8 activations against the
+    int4/int8 weights on the MXU's int8 path (2x the bf16 throughput),
+    llama.cpp's q4_0 x q8_0 structure on TPU. The int32 block partials
+    are exact; both scales (weight s[r, n], activation sx[m, r]) apply
+    in f32 on the partials."""
+    k = pl.program_id(1)
+    rows = bk // block
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    cb = data_ref[:].astype(jnp.int8).reshape(rows, block, bn)
+    part = jax.lax.dot_general(
+        x3_ref[:], cb, (((2,), (1,)), ((1,), (0,))),
+        preferred_element_type=jnp.int32)            # [rows, M, bn]
+    s = scale_ref[:].astype(jnp.float32)             # [rows, bn]
+    sxt = sxt_ref[:].astype(jnp.float32)             # [rows, M]
+    scaled = part.astype(jnp.float32) * s[:, None, :]
+    acc_ref[:] += jnp.sum(scaled * sxt[:, :, None], axis=0)
+
+    @pl.when(k == nk - 1)
+    def _():
+        out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+
 def _scale_rows_ok(bk: int, b: int, kp: int) -> bool:
     """The streamed scale block [bk//b, bn] must satisfy Mosaic's block
     tiling: second-to-last dim divisible by 8, or equal to the full
@@ -257,11 +327,12 @@ _gemv_probe_cache: dict = {}
 
 
 def gemv_kernel_compiles(qtype: str, kp: int, n: int,
-                         fold: bool = False) -> bool:
+                         variant: str = "std") -> bool:
     """Eager per-geometry probe for the decode-GEMV variant (same
     contract as ops/attention._kernel_compiles): compiles the REAL tile
     classes on a stand-in sized (kp, bn) so a Mosaic rejection degrades
-    to the generic tiling instead of crashing a jitted decode."""
+    to the generic tiling instead of crashing a jitted decode.
+    `variant`: "std" | "fold" | "mxu" | "mxu8" (see the kernel bodies)."""
     qt = get_qtype(qtype)
     tiles = _gemv_tiles(qt, kp, n)
     if tiles is None:
@@ -271,40 +342,44 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int,
     if _flags().aot_target == "tpu":   # AOT lowering: trust the dispatch
         return True
     bk, bn = tiles
-    key = (qtype, kp, bn, bk, fold)
+    key = (qtype, kp, bn, bk, variant)
     hit = _gemv_probe_cache.get(key)
     if hit is not None:
         return hit
     try:
         from bigdl_tpu.ops.probing import probe_compile, quant_struct
 
+        mxu = variant in ("mxu", "mxu8")
         # compile-only AOT probe (see ops/probing.py) — safe inside the
         # caller's jit trace, allocates nothing on device
         probe_compile(
             lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, kp, bn, False,
-                                          jnp.bfloat16, fold=fold),
+                                          jnp.bfloat16, variant=variant),
             jax.ShapeDtypeStruct((1, kp), jnp.bfloat16),
-            quant_struct(kp, bn, qtype))
+            quant_struct(kp, bn, qtype, mxu=mxu))
         ok = True
     except Exception as e:
         import logging
 
         logging.getLogger(__name__).warning(
-            "pallas decode-GEMV variant unavailable for (K=%d, N=%d, %s"
-            "%s) — %s: %s; using the generic tiles", kp, n, qtype,
-            ", fold" if fold else "", type(e).__name__, e)
+            "pallas decode-GEMV variant %s unavailable for (K=%d, N=%d, "
+            "%s) — %s: %s; using the generic tiles", variant, kp, n,
+            qtype, type(e).__name__, e)
         ok = False
     _gemv_probe_cache[key] = ok
     return ok
 
 
 def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
-                   interpret: bool, out_dtype=None, fold: bool = False):
+                   interpret: bool, out_dtype=None, variant: str = "std"):
     """bs<=16 decode GEMV (the reference's `linear_fp16_esimd` decode
     GEMV role, low_bit_linear.py:744-745). M pads to one 16-row tile; x
     [16, K] and the scale column block are VMEM-resident for the whole K
     sweep, the grid drops the M axis, and bn/bk maximize the streaming
-    tile. FLOP overhead of the pad is irrelevant — decode is HBM-bound."""
+    tile. FLOP overhead of the pad is irrelevant — decode is HBM-bound.
+    `variant`: "std" (unpack + per-weight scale), "fold" (scale-folded
+    batched dot over the packed layout), "mxu"/"mxu8" (int4-dtype
+    weights; see `_gemv_kernel_mxu`/`_gemv_kernel_mxu8`)."""
     mp = 16
     if x2.shape[0] != mp:
         x2 = jax.lax.pad(x2, jnp.zeros((), x2.dtype),
@@ -327,26 +402,57 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
     if qt.kind == "codebook":
         codebook = [float(v) for v in CODEBOOKS[qt.codebook]]
     bits = qt.storage_bits
-    data_spec = pl.BlockSpec((bk // 2 if bits == 4 else bk, bn),
-                             lambda j, k: (k, j))
-    if fold and qt.kind != "asym":
+
+    if variant in ("mxu", "mxu8"):
+        if w.data.dtype not in (jnp.int4, jnp.int8):
+            raise NotImplementedError(
+                f"{variant} GEMV needs int4/int8-dtype weights "
+                f"(got {w.data.dtype}); apply quant.to_mxu_layout")
+        data_spec = pl.BlockSpec((bk, bn), lambda j, k: (k, j))
+        # x pre-split per scale block OUTSIDE the kernel (lane-dim
+        # reshapes inside are a Mosaic unsupported shape cast)
+        x3 = x2.reshape(mp, kp // b, b)
+        x3_spec = pl.BlockSpec((mp, bk // b, b), lambda j, k: (0, k, 0))
+        if variant == "mxu":
+            kernel = functools.partial(
+                _gemv_kernel_mxu, block=b, bk=bk, bn=bn, nk=nk)
+            operands = [x3, w.data, w.scale]
+            in_specs = [x3_spec, data_spec, scale_spec]
+        else:
+            # per-block q8 activation quantization (VPU work over just
+            # M x K elements, fused into the surrounding jit by XLA)
+            xf = x3.astype(jnp.float32)
+            amax = jnp.max(jnp.abs(xf), axis=-1)              # [mp, K/b]
+            sx = amax * (1.0 / 127.0)
+            inv = jnp.where(sx == 0, 0.0,
+                            1.0 / jnp.where(sx == 0, 1.0, sx))
+            xq = jnp.round(xf * inv[..., None]).astype(jnp.int8)
+            sxt = sx.T                                        # [K/b, mp]
+            sxt_spec = pl.BlockSpec((bk // b, mp), lambda j, k: (k, 0))
+            kernel = functools.partial(
+                _gemv_kernel_mxu8, block=b, bk=bk, bn=bn, nk=nk)
+            operands = [xq, sxt, w.data, w.scale]
+            in_specs = [x3_spec, sxt_spec, data_spec, scale_spec]
+    elif variant == "fold" and qt.kind != "asym":
         kernel = functools.partial(
             _gemv_kernel_fold, block=b, kind=qt.kind, codebook=codebook,
             bk=bk, bn=bn, nk=nk, bits=bits)
-        # x pre-split per scale block OUTSIDE the kernel (lane-dim
-        # reshapes inside are a Mosaic unsupported shape cast)
-        operands0 = x2.reshape(mp, kp // b, b)
-        x_spec = pl.BlockSpec((mp, bk // b, b), lambda j, k: (0, k, 0))
+        data_spec = pl.BlockSpec((bk // 2 if bits == 4 else bk, bn),
+                                 lambda j, k: (k, j))
+        operands = [x2.reshape(mp, kp // b, b), w.data, w.scale]
+        in_specs = [pl.BlockSpec((mp, bk // b, b), lambda j, k: (0, k, 0)),
+                    data_spec, scale_spec]
     else:
         kernel = functools.partial(
             _gemv_kernel, block=b, kind=qt.kind, codebook=codebook,
             bk=bk, bn=bn, nk=nk, bits=bits)
-        operands0 = x2
-    operands = [operands0, w.data, w.scale]
-    in_specs = [x_spec, data_spec, scale_spec]
-    if qt.kind == "asym":
-        operands.append(w.zero)
-        in_specs.append(scale_spec)
+        data_spec = pl.BlockSpec((bk // 2 if bits == 4 else bk, bn),
+                                 lambda j, k: (k, j))
+        operands = [x2, w.data, w.scale]
+        in_specs = [x_spec, data_spec, scale_spec]
+        if qt.kind == "asym":
+            operands.append(w.zero)
+            in_specs.append(scale_spec)
     y = pl.pallas_call(
         kernel,
         grid=grid,
@@ -389,12 +495,22 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
 
     from bigdl_tpu.config import flags
 
-    fold = flags().matmul_gemv == "fold" and qt.kind != "asym"
-    if m <= 16 and flags().matmul_gemv != "off" and (
-            interpret or gemv_kernel_compiles(w.qtype, kp, n, fold=fold)):
+    gv = flags().matmul_gemv
+    if gv == "mxu8" and w.data.dtype in (jnp.int4, jnp.int8) \
+            and qt.kind == "sym":
+        variant = "mxu8"
+    elif gv in ("auto", "mxu", "fold") and w.data.dtype == jnp.int4:
+        variant = "mxu"          # int4-dtype layout: always the MXU body
+    elif gv == "fold" and qt.kind != "asym":
+        variant = "fold"
+    else:
+        variant = "std"
+    if m <= 16 and gv != "off" and (
+            interpret or gemv_kernel_compiles(w.qtype, kp, n,
+                                              variant=variant)):
         try:
             y = _q_gemv_pallas(x2, w, qt, m, kp, n, interpret,
-                               out_dtype=x.dtype, fold=fold)
+                               out_dtype=x.dtype, variant=variant)
             return y.reshape(*batch_shape, n)
         except NotImplementedError:
             pass      # fall through to the generic tiling
@@ -425,7 +541,20 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
     out_shape = jax.ShapeDtypeStruct((mp, n), x.dtype)
     scratch = [pltpu.VMEM((bm, bn), jnp.float32)]
 
-    if qt.storage_bits == 4:
+    if w.data.dtype == jnp.int4:
+        data_spec = pl.BlockSpec((bk, bn), lambda i, j, k: (k, j))
+        kernel = functools.partial(_kernel_i4, block=b, bk=bk, bn=bn, nk=nk)
+        y = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[x_spec, data_spec, scale_spec],
+            out_specs=out_spec,
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            interpret=interpret,
+            compiler_params=_GENERIC_SEMANTICS,
+        )(x2, w.data, w.scale)
+    elif qt.storage_bits == 4:
         data_spec = pl.BlockSpec((bk // 2, bn), lambda i, j, k: (k, j))
         codebook = None
         if qt.kind == "codebook":

@@ -45,12 +45,16 @@ def stacked_struct(tree, n: int):
         lambda s: jax.ShapeDtypeStruct((n,) + s.shape, s.dtype), tree)
 
 
-def quant_struct(k: int, n: int, qtype: str):
+def quant_struct(k: int, n: int, qtype: str, mxu: bool = False):
     """Abstract QTensor [k, n] for `qtype` — the shapes/dtypes quantize()
     would produce, computed without materializing anything (eval_shape
     stays fully abstract for the jnp-only sym/asym/codebook encoders the
-    Pallas kernels support)."""
-    from bigdl_tpu.ops.quant import quantize
+    Pallas kernels support). `mxu` applies the int4-dtype MXU layout
+    (quant.to_mxu_layout) to the abstract result."""
+    from bigdl_tpu.ops.quant import quantize, to_mxu_layout
 
-    return jax.eval_shape(
-        lambda: quantize(jnp.zeros((k, n), jnp.float32), qtype))
+    def build():
+        qt = quantize(jnp.zeros((k, n), jnp.float32), qtype)
+        return to_mxu_layout(qt) if mxu else qt
+
+    return jax.eval_shape(build)

@@ -192,7 +192,10 @@ class QTensor:
 
     @property
     def nbytes(self) -> int:
-        tot = self.data.size * self.data.dtype.itemsize
+        if self.data.dtype == jnp.int4:    # XLA packs int4 2-per-byte
+            tot = -(-self.data.size // 2)
+        else:
+            tot = self.data.size * self.data.dtype.itemsize
         tot += self.scale.size * self.scale.dtype.itemsize
         if self.zero is not None:
             tot += self.zero.size * self.zero.dtype.itemsize
@@ -681,12 +684,30 @@ def _iqx_encode_chunk(xc: jax.Array, wv: jax.Array, qtype: str,
         take_p = bp >= bm
         return jnp.where(take_p, jp, jm), take_p              # [g, Nc] x2
 
+    def stored_neg(idx):
+        """Sign bits as they will be STORED: for iq2_xs the 7-bit parity
+        constraint flips the cheapest position of every odd-parity
+        group, so the decode differs from the raw (x < 0) signs — the
+        scale refit must see the corrected signs or it optimizes for a
+        decode that never happens (r4 advice)."""
+        neg = (xc < 0).astype(jnp.int32).reshape(g, 8, nc)
+        if xs_signs:
+            pattern = cb[idx].transpose(0, 2, 1)              # [g, 8, Nc]
+            cost = jnp.abs(xc.reshape(g, 8, nc)) * pattern * w
+            odd = (jnp.sum(neg, axis=1) & 1) == 1             # [g, Nc]
+            flip_at = jnp.argmin(cost, axis=1)                # [g, Nc]
+            onehot = (jnp.arange(8)[None, :, None]
+                      == flip_at[:, None, :])
+            neg = jnp.where(odd[:, None, :] & onehot, 1 - neg, neg)
+        return neg
+
     def decoded_units(idx, dpos):
         """Chosen patterns at unit scale, signs + delta folded."""
         c = cb[idx].transpose(0, 2, 1).reshape(kp, nc)        # [K, Nc]
         if not signed_cb:
             # stored sign bit is (x < 0): x == 0 decodes as +c
-            c = c * jnp.where(xc < 0, -1.0, 1.0)
+            sgn = 1.0 - 2.0 * stored_neg(idx).astype(jnp.float32)
+            c = c * sgn.reshape(kp, nc)
         if with_delta:
             delta = jnp.where(dpos, _IQ_DELTA, -_IQ_DELTA)    # [g, Nc]
             c = c + jnp.repeat(delta, 8, axis=0)
@@ -717,23 +738,17 @@ def _iqx_encode_chunk(xc: jax.Array, wv: jax.Array, qtype: str,
     if signed_cb:
         data = idx.astype(jnp.uint8)                          # [K/8, Nc]
     elif xs_signs:
-        neg = (xc < 0).astype(jnp.int32).reshape(g, 8, nc)
         # representable sign vectors have EVEN popcount (bit 7 is the
         # parity of bits 0-6); when the desired signs are odd, flip the
         # cheapest position — the one with the least |w x c| at stake
-        pattern = cb[idx].transpose(0, 2, 1)                  # [g, 8, Nc]
-        cost = jnp.abs(xc.reshape(g, 8, nc)) * pattern * w
-        odd = (jnp.sum(neg, axis=1) & 1) == 1                 # [g, Nc]
-        flip_at = jnp.argmin(cost, axis=1)                    # [g, Nc]
-        onehot = (jnp.arange(8)[None, :, None] == flip_at[:, None, :])
-        neg = jnp.where(odd[:, None, :] & onehot, 1 - neg, neg)
+        neg = stored_neg(idx)
         shifts = jnp.arange(7, dtype=jnp.int32).reshape(1, 7, 1)
         sign7 = jnp.sum(neg[:, :7] << shifts, axis=1)         # [g, Nc]
         code = idx.astype(jnp.int32) | (sign7 << 9)           # 16 bits
         data = jnp.stack([code & 0xFF, code >> 8],
                          axis=1).reshape(2 * g, nc).astype(jnp.uint8)
     else:
-        neg = (xc < 0).astype(jnp.int32).reshape(g, 8, nc)
+        neg = stored_neg(idx)
         shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
         signs = jnp.sum(neg << shifts, axis=1).astype(jnp.uint8)
         data = jnp.stack([idx.astype(jnp.uint8), signs],
@@ -874,9 +889,13 @@ def dequantize_impl(qt: QTensor, dtype=jnp.bfloat16) -> jax.Array:
         return out[:k].astype(dtype)
 
     if t.kind == "sym" and t.bits == 4:
-        codes = _unpack4(qt.data, b)
-        kp = codes.shape[0]
-        vals = codes.astype(jnp.float32) - 8.0
+        if qt.data.dtype == jnp.int4:      # MXU layout: signed, unpacked
+            kp = qt.data.shape[0]
+            vals = qt.data.astype(jnp.float32)
+        else:
+            codes = _unpack4(qt.data, b)
+            kp = codes.shape[0]
+            vals = codes.astype(jnp.float32) - 8.0
         out = vals * _expand_scale(qt.scale, b, kp)
         return out[:k].astype(dtype)
 
@@ -1019,3 +1038,66 @@ def split_qtensor_n(w: QTensor, sizes) -> list:
 # public jitted alias (eager callers: conversion utilities, tests)
 dequantize = functools.partial(
     jax.jit, static_argnames=("dtype",))(dequantize_impl)
+
+
+# ---------------------------------------------------------------------------
+# MXU (int4-dtype) weight layout
+# ---------------------------------------------------------------------------
+
+
+def to_mxu_layout(qt: QTensor) -> QTensor:
+    """sym_int4 canonical (split-block packed uint8) -> int4-dtype data.
+
+    The decode GEMV's bottleneck is the VPU nibble unpack (~6 i32 vector
+    ops per weight over ~4 GB of weights every token — BENCH_r04 put the
+    kernel at 18% of the HBM roofline). XLA stores jnp.int4 arrays bit-
+    packed (same HBM bytes) and Mosaic loads them natively, so the
+    in-kernel per-weight work drops to ONE int4->int8/bf16 convert. The
+    transform is applied once at load time (transformers/model.py); the
+    canonical layout remains the on-disk / GGUF interchange format
+    (`from_mxu_layout` restores it bit-exactly — codes are just shifted
+    by 8). sym_int8 is already MXU-ready; other qtypes pass through."""
+    if qt.qtype not in ("sym_int4",) or qt.data.dtype == jnp.int4:
+        return qt
+    if qt.data.ndim >= 4:
+        # [L, E, K//2, N] MoE expert stacks: the ragged MoE prefill
+        # kernel (ops/pallas/moe_dispatch.py) and the vmapped decode
+        # gather probe read the canonical packing — converting them
+        # would feed int4-dtype data to kernels that bit-unpack uint8
+        # (code-review r5). Expert matmuls stay on the proven path.
+        return qt
+    # layer-stacked params carry leading dims: [..., K//2, N]
+    packed = qt.data
+    *lead, k2, n = packed.shape
+    b2 = qt.qt.block_size // 2
+    blk = packed.reshape(*lead, k2 // b2, b2, n)
+    codes = jnp.concatenate([blk & jnp.uint8(0x0F), blk >> 4], axis=-2)
+    data = (codes.astype(jnp.int8) - 8).astype(jnp.int4) \
+        .reshape(*lead, k2 * 2, n)
+    return dataclasses.replace(qt, data=data)
+
+
+def from_mxu_layout(qt: QTensor) -> QTensor:
+    """Inverse of `to_mxu_layout` (for save_low_bit / GGUF export)."""
+    if getattr(qt.data, "dtype", None) != jnp.int4:
+        return qt
+    codes = (qt.data.astype(jnp.int8) + 8).astype(jnp.uint8)
+    *lead, k, n = codes.shape
+    b = qt.qt.block_size
+    blk = codes.reshape(*lead, k // b, b, n)
+    packed = (blk[..., :b // 2, :] | (blk[..., b // 2:, :] << 4)) \
+        .astype(jnp.uint8).reshape(*lead, k // 2, n)
+    return dataclasses.replace(qt, data=packed)
+
+
+def tree_to_mxu_layout(tree):
+    """Apply `to_mxu_layout` to every sym_int4 QTensor in a pytree."""
+    return jax.tree_util.tree_map(
+        lambda x: to_mxu_layout(x) if isinstance(x, QTensor) else x,
+        tree, is_leaf=lambda x: isinstance(x, QTensor))
+
+
+def tree_from_mxu_layout(tree):
+    return jax.tree_util.tree_map(
+        lambda x: from_mxu_layout(x) if isinstance(x, QTensor) else x,
+        tree, is_leaf=lambda x: isinstance(x, QTensor))

@@ -124,18 +124,23 @@ def tp_param_specs(params: Any, mesh: Mesh, axis: str = "tp") -> Any:
     return specs
 
 
-def _ff_padded(ff: int, n: int) -> int:
+def _ff_padded(ff: int, n: int, block: int = 128) -> int:
     """Global intermediate size padded so each tp shard's ff slice is a
-    128-lane multiple. An unaligned shard (e.g. 11008/4 = 2752, which is
-    21.5 x 128) can never satisfy the Pallas matmul's bn tiling, so the
-    whole MLP would decode on the slow XLA dequant path (VERDICT r3 #4).
-    Zero-padding is EXACT: padded gate/up columns carry zero scales, so
-    they dequantize to 0, the activation is act(0)*0 = 0, and the padded
+    128-lane multiple AND a quant-block multiple. An unaligned shard
+    (e.g. 11008/4 = 2752, which is 21.5 x 128) can never satisfy the
+    Pallas matmul's bn tiling, so the whole MLP would decode on the slow
+    XLA dequant path (VERDICT r3 #4); and block-256 qtypes (k-quants,
+    iqx) additionally need the down-proj's per-shard K to be a 256
+    multiple, or the plane-row scaling in `_pad_ff_leaf` produces
+    inconsistent shapes for odd shard counts (r4 advice). Zero-padding
+    is EXACT: padded gate/up columns carry zero scales, so they
+    dequantize to 0, the activation is act(0)*0 = 0, and the padded
     down-proj rows are zero too. Tiny test models stay untouched."""
     if ff < 2048 or n <= 1:
         return ff
+    align = max(128, block)
     per = -(-ff // n)
-    per = -(-per // 128) * 128
+    per = -(-per // align) * align
     return per * n
 
 
@@ -174,6 +179,8 @@ def _pad_ff_leaf(w, ff_new: int, axis_kind: str):
         kp = w.scale.shape[-2] * w.qt.block_size
         if kp >= ff_new:
             return w
+        assert ff_new % w.qt.block_size == 0, \
+            f"ff pad {ff_new} breaks block {w.qt.block_size} alignment"
         rep = {}
         for f in ("data", "scale", "zero", "aux"):
             p = getattr(w, f)
@@ -199,7 +206,9 @@ def pad_ff_for_tp(params: Any, n: int) -> Any:
         if gate is not None:
             ff = gate.shape[1] if isinstance(gate, QTensor) \
                 else gate.shape[-1]
-            ff_new = _ff_padded(ff, n)
+            down = layers["down_proj"]
+            blk = down.qt.block_size if isinstance(down, QTensor) else 128
+            ff_new = _ff_padded(ff, n, blk)
             if ff_new != ff:
                 new_layers = dict(layers)
                 for name in ("gate_proj", "up_proj",

@@ -74,6 +74,49 @@ def _chat_to_prompt(messages: List[dict], tokenizer) -> Any:
     return text
 
 
+class _IncrementalDetok:
+    """vllm-style incremental detokenization (reference: vllm's
+    Detokenizer; replaces the accumulated-decode diff flagged in r4
+    advice). Each delta is computed from a sliding token window
+    (`decode(ids[prefix:])` minus `decode(ids[prefix:read])`), so the
+    stream is append-only BY CONSTRUCTION even when a full re-decode
+    would retroactively rewrite earlier text (sentencepiece boundary
+    cleanup, clean_up_tokenization_spaces), and total work is O(n) in
+    generation length rather than O(n^2)."""
+
+    def __init__(self, decode_fn):
+        self._decode = decode_fn
+        self.ids: list = []
+        self.text = ""       # stable decoded text (what stop-scan sees)
+        self._prefix = 0     # window start (token index)
+        self._read = 0       # tokens already folded into .text
+
+    def push(self, new_ids) -> str:
+        self.ids.extend(new_ids)
+        prefix_text = self._decode(self.ids[self._prefix:self._read])
+        new_text = self._decode(self.ids[self._prefix:])
+        if new_text.endswith("�"):
+            return ""        # incomplete multi-byte char: hold the tail
+        if len(new_text) <= len(prefix_text):
+            return ""        # window shrank (cleanup): wait for more
+        delta = new_text[len(prefix_text):]
+        self._prefix = self._read
+        self._read = len(self.ids)
+        self.text += delta
+        return delta
+
+    def flush(self) -> str:
+        """Final drain: emit the held-back tail even if it ends in
+        U+FFFD — a completion may genuinely end mid-sequence, and the
+        streamed text must equal the non-streaming response."""
+        prefix_text = self._decode(self.ids[self._prefix:self._read])
+        new_text = self._decode(self.ids[self._prefix:])
+        delta = new_text[len(prefix_text):]
+        self._prefix = self._read = len(self.ids)
+        self.text += delta
+        return delta
+
+
 class OpenAIServer:
     def __init__(self, engine: LLMEngine, tokenizer=None,
                  model_name: str = "bigdl-tpu-model"):
@@ -142,32 +185,22 @@ class OpenAIServer:
         texts: dict = {}      # index -> full decoded (possibly cut) text
         emitted: dict = {}    # index -> chars already streamed
         scanned: dict = {}    # index -> chars already stop-scanned
+        detoks: dict = {}     # index -> _IncrementalDetok
         stopped: set = set()
         hold = max((len(s) for s in stop_strs), default=0)
         n_choices = max(params.n, 1)
-        # only stop matching needs the ACCUMULATED decode (a stop string
-        # can span chunk boundaries); stop-free streams with a REAL
-        # tokenizer decode each chunk independently — O(n) total, the
-        # pre-stop behavior — and plain requests decode once at the
-        # end. The tokenizer-less fallback must stay accumulated: its
-        # space separators live BETWEEN chunks, and it is append-only
-        # by construction so the diff is exact.
-        live_decode = bool(stop_strs) or (
-            stream_cb is not None and self.tokenizer is None)
+        # streaming and stop-scanning share one incremental detokenizer
+        # per choice (O(n) total, append-only deltas); plain stop-free
+        # requests decode once at the end
+        live_decode = bool(stop_strs) or stream_cb is not None
 
         def emit(idx, upto):
             nonlocal stream_cb
             if stream_cb is None:
                 return
             full = texts[idx]
-            # never emit a trailing replacement char: an incomplete
-            # multi-token UTF-8 sequence decodes to U+FFFD now but to
-            # the real character once the next token lands — holding it
-            # back keeps the accumulated-diff stream append-only
-            while upto > emitted.get(idx, 0) and upto <= len(full) \
-                    and full[upto - 1] == "�":
-                upto -= 1
             start = emitted.get(idx, 0)
+            upto = min(upto, len(full))
             if upto > start:
                 try:
                     stream_cb(full[start:upto], idx)
@@ -179,6 +212,44 @@ class OpenAIServer:
                     self.engine.abort_request(rid)
                     self.loop.notify()
                     stream_cb = None
+
+        def scan_stop(idx):
+            """Scan the unseen tail of the stable text for the earliest
+            stop string; returns the cut position or -1."""
+            full = texts[idx]
+            scan0 = max(0, scanned.get(idx, 0) - max(hold - 1, 0))
+            cut = -1
+            for s in stop_strs:
+                p = full.find(s, scan0)
+                if p != -1 and (cut == -1 or p < cut):
+                    cut = p
+            scanned[idx] = len(full)
+            return cut
+
+        def apply_stop(idx, cut, batch_len):
+            texts[idx] = texts[idx][:cut]
+            stopped.add(idx)
+            reasons[idx] = "stop"
+            emit(idx, cut)
+            # drop the tokens whose text fell past the cut (usage must
+            # bill the VISIBLE completion): walk back this batch's
+            # tokens while the stop still matches without them
+            ids = out_ids[idx]
+            keep = len(ids)
+            lo = keep - batch_len
+            while keep > lo:
+                shorter = self._decode_text(ids[:keep - 1])
+                if any(s in shorter for s in stop_strs):
+                    keep -= 1
+                else:
+                    break
+            del ids[keep:]
+            if idx in out_lps:
+                del out_lps[idx][keep:]
+            if stopped >= set(range(n_choices)):
+                # every choice done: stop generating
+                self.engine.abort_request(rid)
+                self.loop.notify()
 
         done = False
         while not done:
@@ -194,61 +265,32 @@ class OpenAIServer:
                     out_ids.setdefault(idx, []).extend(o.new_token_ids)
                     if o.logprobs:
                         out_lps.setdefault(idx, []).extend(o.logprobs)
-                    if not live_decode and stream_cb is not None \
-                            and o.new_token_ids:
-                        # stop-free stream: independent per-chunk decode
-                        try:
-                            stream_cb(self._decode_text(o.new_token_ids),
-                                      idx)
-                        except OSError:
-                            self.engine.abort_request(rid)
-                            self.loop.notify()
-                            stream_cb = None
                 if live_decode and o.new_token_ids and idx not in stopped:
-                    full = self._decode_text(out_ids[idx])
-                    # scan only the unseen tail (minus a stop-length
-                    # overlap) — not the whole text every batch
-                    scan0 = max(0, scanned.get(idx, 0) - max(hold - 1, 0))
-                    cut = -1
-                    for s in stop_strs:
-                        p = full.find(s, scan0)
-                        if p != -1 and (cut == -1 or p < cut):
-                            cut = p
-                    scanned[idx] = len(full)
+                    det = detoks.get(idx)
+                    if det is None:
+                        det = detoks[idx] = _IncrementalDetok(
+                            self._decode_text)
+                    det.push(o.new_token_ids)
+                    texts[idx] = det.text
+                    cut = scan_stop(idx) if stop_strs else -1
                     if cut != -1:
-                        texts[idx] = full[:cut]
-                        stopped.add(idx)
-                        reasons[idx] = "stop"
-                        emit(idx, cut)
-                        # drop the tokens whose text fell past the cut
-                        # (usage must bill the VISIBLE completion): walk
-                        # back this batch's tokens while the stop still
-                        # matches without them
-                        ids = out_ids[idx]
-                        keep = len(ids)
-                        lo = len(ids) - len(o.new_token_ids)
-                        while keep > lo:
-                            shorter = self._decode_text(ids[:keep - 1])
-                            if any(s in shorter for s in stop_strs):
-                                keep -= 1
-                            else:
-                                break
-                        del ids[keep:]
-                        if idx in out_lps:
-                            del out_lps[idx][keep:]
-                        if stopped >= set(range(n_choices)):
-                            # every choice done: stop generating
-                            self.engine.abort_request(rid)
-                            self.loop.notify()
+                        apply_stop(idx, cut, len(o.new_token_ids))
                     else:
-                        texts[idx] = full
-                        emit(idx, len(full) - hold + 1
-                             if hold else len(full))
+                        emit(idx, len(det.text) - hold + 1
+                             if hold else len(det.text))
                 if o.finish_reason is not None:
                     reasons.setdefault(idx, o.finish_reason)
                 if o.finished:
                     reasons.setdefault(idx, o.finish_reason or "stop")
                     done = True
+        for idx, det in detoks.items():
+            if idx in stopped:
+                continue
+            det.flush()                      # drain the held-back tail
+            texts[idx] = det.text
+            cut = scan_stop(idx) if stop_strs else -1
+            if cut != -1:
+                apply_stop(idx, cut, len(det.ids))
         for idx in list(texts):
             emit(idx, len(texts[idx]))       # flush the holdback
         for i in range(n_choices):

@@ -30,6 +30,21 @@ _TOKENIZER_FILES = (
 )
 
 
+def _maybe_mxu_layout(params: Any) -> Any:
+    """Re-layout sym_int4 weights to the int4-dtype MXU form when the
+    compute target is TPU (flags().mxu_layout: auto/on/off). One cheap
+    pass at load time; the decode GEMV then loads int4 natively instead
+    of burning the VPU on nibble unpacking (see ops/pallas/dequant_
+    matmul._gemv_kernel_mxu). save_low_bit repacks to canonical."""
+    from bigdl_tpu.config import flags, target_is_tpu
+    from bigdl_tpu.ops.quant import tree_to_mxu_layout
+
+    mode = flags().mxu_layout
+    if mode == "off" or (mode == "auto" and not target_is_tpu()):
+        return params
+    return tree_to_mxu_layout(params)
+
+
 def _maybe_merge(params: Any, cfg: Any, family: FamilyAdapter,
                  enable: bool) -> Any:
     """Apply merged-QKV / merged-gate-up weight surgery (the reference's
@@ -65,7 +80,7 @@ class TpuCausalLM:
         max_seq: int = 2048,
         kv_quantized: bool = False,
     ):
-        self.params = params
+        self.params = _maybe_mxu_layout(params)
         self.config = cfg
         self.family = family
         self.hf_config = hf_config
@@ -208,9 +223,13 @@ class TpuCausalLM:
 
     # -- persistence --------------------------------------------------------
     def save_low_bit(self, path: str) -> None:
-        """Persist quantized weights + config (+tokenizer files if known)."""
+        """Persist quantized weights + config (+tokenizer files if known).
+        The canonical split-block packing is the interchange format —
+        int4-dtype (MXU layout) weights repack before writing."""
+        from bigdl_tpu.ops.quant import tree_from_mxu_layout
+
         lowbit_io.save_low_bit(
-            self.params, path,
+            tree_from_mxu_layout(self.params), path,
             config=self.hf_config,
             family=self.family.name,
             qtype=self.qtype,

@@ -101,9 +101,50 @@ def test_dequant_gemv_compiles(v5e, aot_flags, qtype, n):
     if qt.kind != "asym":
         comp = _compile(
             lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, k, n, False,
-                                          xx.dtype, fold=True),
+                                          xx.dtype, variant="fold"),
             _sds(x, dev), _sds(wq, dev))
         assert _has_mosaic_call(comp)
+
+
+@pytest.mark.parametrize("variant", ["mxu", "mxu8"])
+@pytest.mark.parametrize("k,n", [
+    (4096, 12288),   # merged QKV (7B, fused q+k+v)
+    (4096, 22016),   # merged gate-up
+    (11008, 4096),   # down-proj
+    (4096, 4096),    # o-proj
+])
+def test_dequant_gemv_mxu_compiles(v5e, aot_flags, variant, k, n):
+    """r5: the MXU-layout GEMV (int4-dtype weights, native Mosaic int4
+    load — no VPU nibble unpack) at all four 7B merged decode shapes,
+    both the bf16 body and the int8-activation body."""
+    from bigdl_tpu.ops.pallas.dequant_matmul import _q_gemv_pallas
+    from bigdl_tpu.ops.probing import quant_struct
+    from bigdl_tpu.ops.quant import get_qtype
+
+    dev = v5e.devices[0]
+    qt = get_qtype("sym_int4")
+    wq = quant_struct(k, n, "sym_int4", mxu=True)
+    assert wq.data.dtype == jnp.int4
+    x = jax.ShapeDtypeStruct((1, k), jnp.bfloat16)
+    comp = _compile(
+        lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, k, n, False,
+                                      xx.dtype, variant=variant),
+        _sds(x, dev), _sds(wq, dev))
+    assert _has_mosaic_call(comp)
+
+
+def test_dequant_generic_i4_compiles(v5e, aot_flags):
+    """Generic-tile body for the int4-dtype layout (prefill-class M
+    under forced-pallas dispatch)."""
+    from bigdl_tpu.ops.pallas.dequant_matmul import q_matmul_pallas
+    from bigdl_tpu.ops.probing import quant_struct
+
+    dev = v5e.devices[0]
+    wq = quant_struct(4096, 4096, "sym_int4", mxu=True)
+    x = jax.ShapeDtypeStruct((512, 4096), jnp.bfloat16)
+    comp = _compile(lambda xx, ww: q_matmul_pallas(xx, ww),
+                    _sds(x, dev), _sds(wq, dev))
+    assert _has_mosaic_call(comp)
 
 
 @pytest.mark.parametrize("k,n", [

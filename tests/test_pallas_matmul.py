@@ -100,6 +100,88 @@ def test_gemv_padded_k():
     )
 
 
+@pytest.mark.parametrize("gv", ["auto", "mxu8"])
+def test_gemv_mxu_layout_matches_reference(gv):
+    """r5 MXU layout: int4-dtype weights through the native-load GEMV
+    bodies (bf16 fold under 'auto', int8-activation under 'mxu8') must
+    match the dequant reference. mxu8 quantizes activations to q8 per
+    block, so its tolerance is the q8 rounding band, not exactness."""
+    from bigdl_tpu.config import set_flags
+    from bigdl_tpu.ops.quant import to_mxu_layout, from_mxu_layout
+
+    k, n = 1024, 256
+    x = _rand((1, k), seed=13) * 0.3
+    qt = quantize(_rand((k, n), seed=14) * 0.1, "sym_int4")
+    qm = to_mxu_layout(qt)
+    assert qm.data.dtype == jnp.int4
+    # round trip is bit-exact
+    np.testing.assert_array_equal(
+        np.asarray(from_mxu_layout(qm).data), np.asarray(qt.data))
+    try:
+        set_flags(matmul_gemv=gv)
+        jax.clear_caches()       # flags are read at trace time
+        got = q_matmul_pallas(x, qm, interpret=True)
+    finally:
+        set_flags(matmul_gemv="auto")
+        jax.clear_caches()
+    want = _q_matmul_xla(x, qt)
+    tol = 3e-2 if gv == "auto" else 6e-2
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=tol, atol=tol,
+    )
+
+
+def test_generic_tiles_mxu_layout_matches_reference():
+    """Generic-tile (prefill-class M) path with int4-dtype weights."""
+    from bigdl_tpu.ops.quant import to_mxu_layout
+
+    k, n = 1024, 256
+    x = _rand((64, k), seed=15) * 0.2
+    qt = quantize(_rand((k, n), seed=16) * 0.1, "sym_int4")
+    qm = to_mxu_layout(qt)
+    got = q_matmul_pallas(x, qm, interpret=True)
+    want = _q_matmul_xla(x, qt)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_mxu_layout_dequantize_exact():
+    """dequantize(to_mxu_layout(qt)) == dequantize(qt) bit-exactly."""
+    from bigdl_tpu.ops.quant import to_mxu_layout, dequantize
+
+    qt = quantize(_rand((224, 128), seed=17) * 0.1, "sym_int4")
+    np.testing.assert_array_equal(
+        np.asarray(dequantize(to_mxu_layout(qt)), np.float32),
+        np.asarray(dequantize(qt), np.float32))
+
+
+def test_mxu_layout_layer_stacked():
+    """Model params stack per-layer QTensors with a leading L axis; the
+    layout transform must round-trip them (caught by verify r5)."""
+    import dataclasses as dc
+
+    from bigdl_tpu.ops.quant import to_mxu_layout, from_mxu_layout
+
+    qt = quantize(_rand((256, 128), seed=18) * 0.1, "sym_int4")
+    stacked = dc.replace(
+        qt, data=jnp.stack([qt.data] * 3),
+        scale=jnp.stack([qt.scale] * 3))
+    qm = to_mxu_layout(stacked)
+    assert qm.data.dtype == jnp.int4 and qm.data.shape == (3, 256, 128)
+    back = from_mxu_layout(qm)
+    np.testing.assert_array_equal(
+        np.asarray(back.data), np.asarray(stacked.data))
+    # [L, E, K//2, N] MoE expert stacks must pass through untouched —
+    # the ragged MoE kernel reads the canonical packing
+    experts = dc.replace(
+        qt, data=jnp.stack([jnp.stack([qt.data] * 2)] * 3),
+        scale=jnp.stack([jnp.stack([qt.scale] * 2)] * 3))
+    assert to_mxu_layout(experts) is experts
+
+
 @pytest.mark.parametrize(
     "qtype", ["sym_int4", "nf4", "sym_int8", "asym_int4"])
 def test_gemv_fold_variant_matches_reference(qtype):

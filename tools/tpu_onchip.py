@@ -126,44 +126,56 @@ def step_qmatmul_prefill():
 
 
 def step_gemv():
-    # decode-GEMV variant, called directly (bypasses the probe) at
+    # decode-GEMV variants, called directly (bypasses the probe) at
     # llama-7B decode geometries: split, MERGED (qkv N=12288 /
-    # gate_up N=22016 — the shipped default), tp=4 shards, and the
-    # scale-FOLDED body (raw codes on the MXU) for each
+    # gate_up N=22016 — the shipped default), tp=4 shards; bodies:
+    # "std" (unpack chain), "fold" (scale-folded), "mxu" (int4-dtype
+    # native load — the r5 shipped default), "mxu8" (int8 MXU path).
+    # Per-case GB/s lets the parent see roofline utilization directly.
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from bigdl_tpu.ops.pallas.dequant_matmul import (_q_gemv_pallas,
                                                      gemv_kernel_compiles)
-    from bigdl_tpu.ops.quant import dequantize, get_qtype, quantize
+    from bigdl_tpu.ops.quant import (dequantize, get_qtype, quantize,
+                                     to_mxu_layout)
 
     out = []
-    for qt_name, k, n, fold in [
-            ("sym_int4", 4096, 4096, False),
-            ("sym_int4", 4096, 4096, True),
-            ("sym_int4", 4096, 12288, False),    # merged qkv
-            ("sym_int4", 4096, 12288, True),
-            ("sym_int4", 4096, 22016, False),    # merged gate_up
-            ("sym_int4", 4096, 22016, True),
-            ("sym_int4", 11008, 4096, False),    # down proj
-            ("sym_int4", 11008, 4096, True),
-            ("sym_int4", 2816, 4096, False),     # tp=4 down shard (padded)
-            ("sym_int8", 4096, 4096, False),
-            ("nf4", 4096, 4096, False),
-            ("nf4", 4096, 4096, True)]:
+    for qt_name, k, n, variant in [
+            ("sym_int4", 4096, 4096, "std"),
+            ("sym_int4", 4096, 4096, "fold"),
+            ("sym_int4", 4096, 4096, "mxu"),
+            ("sym_int4", 4096, 4096, "mxu8"),
+            ("sym_int4", 4096, 12288, "mxu"),    # merged qkv
+            ("sym_int4", 4096, 12288, "mxu8"),
+            ("sym_int4", 4096, 22016, "mxu"),    # merged gate_up
+            ("sym_int4", 4096, 22016, "mxu8"),
+            ("sym_int4", 11008, 4096, "mxu"),    # down proj
+            ("sym_int4", 11008, 4096, "mxu8"),
+            ("sym_int4", 4096, 12288, "std"),
+            ("sym_int4", 4096, 22016, "fold"),
+            ("sym_int4", 11008, 4096, "fold"),
+            ("sym_int4", 2816, 4096, "mxu"),     # tp=4 down shard (padded)
+            ("sym_int8", 4096, 4096, "std"),
+            ("sym_int8", 4096, 4096, "mxu8"),
+            ("nf4", 4096, 4096, "std"),
+            ("nf4", 4096, 4096, "fold")]:
         qt = get_qtype(qt_name)
         interp = bool(os.environ.get("ONCHIP_FORCE_CPU"))
         w = jax.random.normal(jax.random.PRNGKey(0), (k, n), jnp.float32)
         wq = quantize(w, qt_name)
+        if variant in ("mxu", "mxu8"):
+            wq = to_mxu_layout(wq)
         x = jax.random.normal(jax.random.PRNGKey(1), (1, k), jnp.bfloat16)
         y = np.asarray(
-            _q_gemv_pallas(x, wq, qt, 1, k, n, interp, x.dtype, fold=fold),
+            _q_gemv_pallas(x, wq, qt, 1, k, n, interp, x.dtype,
+                           variant=variant),
             np.float32)
         # two references: bf16-dequant (the XLA fallback's contract —
         # the STANDARD kernel matches it) and exact-f32 dequant (the
-        # FOLD kernel applies scales in f32 and lands much closer to
-        # this one; its larger bf16-ref deviation is the reference's
+        # FOLD/MXU kernels apply scales in f32 and land much closer to
+        # this one; their larger bf16-ref deviation is the reference's
         # own weight rounding, not kernel error)
         ref16 = np.asarray(
             x.astype(jnp.float32) @ dequantize(wq).astype(jnp.float32))
@@ -176,13 +188,16 @@ def step_gemv():
 
         t = _bench(jax.jit(
             lambda xx: _q_gemv_pallas(xx, wq, qt, 1, k, n, interp, xx.dtype,
-                                      fold=fold)),
+                                      variant=variant)),
             x)
-        probe = gemv_kernel_compiles(qt_name, k, n, fold=fold)
-        out.append({"qtype": qt_name, "k": k, "n": n, "fold": fold,
+        probe = gemv_kernel_compiles(qt_name, k, n, variant=variant)
+        bytes_moved = wq.nbytes
+        out.append({"qtype": qt_name, "k": k, "n": n, "variant": variant,
                     "max_rel_err_bf16ref": _rel(ref16),
                     "max_rel_err_f32ref": _rel(ref32),
-                    "gemv_ms": t * 1e3, "probe_ok": probe})
+                    "gemv_ms": t * 1e3,
+                    "gbps": bytes_moved / max(t, 1e-9) / 1e9,
+                    "probe_ok": probe})
     return {"cases": out}
 
 
