@@ -130,14 +130,12 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     if merged:
         # merged QKV + gate/up — the shipped from_pretrained default
         params = llama_mod.merge_projections(params, cfg)
-    from bigdl_tpu.config import flags as _flags
+    # the shipped from_pretrained load-time re-layout (int4-dtype MXU
+    # weights) — ONE implementation so bench measures exactly what the
+    # loader does
+    from bigdl_tpu.transformers.model import _maybe_mxu_layout
 
-    if on_tpu and _flags().mxu_layout != "off":
-        # mirror from_pretrained's load-time re-layout (the shipped
-        # default): sym_int4 weights to int4-dtype for the MXU GEMV
-        from bigdl_tpu.ops.quant import tree_to_mxu_layout
-
-        params = tree_to_mxu_layout(params)
+    params = _maybe_mxu_layout(params)
     jax.block_until_ready(params)
     tokens = jnp.ones((1, prompt_len), jnp.int32)
 

@@ -209,6 +209,10 @@ def export_hf(params, cfg, outdir: str) -> None:
 FORMATS = [
     ("bf16", False), ("sym_int8", False), ("fp8_e4m3", False),
     ("sym_int4", False), ("asym_int4", False), ("nf4", False),
+    ("fp4", False),
+    # mixed policies (per-tensor MSE pick) next to their base formats
+    # so the pick's value is visible (VERDICT r4 weak #6)
+    ("mixed_fp4", False), ("mixed_fp8", False),
     ("q2_k", False), ("q2_k", True),
     ("iq2_xxs", False), ("iq2_xxs", True),
     ("iq2_xs", False), ("iq2_xs", True),
@@ -264,7 +268,8 @@ def write_report(rows, out_path: str, meta: Dict) -> None:
         "|---|---|---|---|",
     ]
     bpw = {"bf16": 16, "sym_int8": 8.5, "fp8_e4m3": 8.5, "sym_int4": 4.5,
-           "asym_int4": 5.0, "nf4": 4.5, "q2_k": 2.625,
+           "asym_int4": 5.0, "nf4": 4.5, "fp4": 4.5, "mixed_fp4": 4.5,
+           "mixed_fp8": 8.5, "q2_k": 2.625,
            "iq2_xxs": 2.19, "iq2_xs": 2.19, "iq1_s": 1.19, "iq1_m": 1.44}
     for label, ppl in rows:
         fmt = label.split("+")[0]

@@ -393,10 +393,13 @@ class GGUFFile:
         vocab = len(self.kv.get("tokenizer.ggml.tokens", ())) or None
         if vocab is None and "token_embd.weight" in self.tensors:
             vocab = self.tensors["token_embd.weight"][0][0]
+        if arch in ("bloom", "falcon", "mpt"):
+            return self._hf_config_nonllama(arch, heads, int(vocab or 0))
         arch_map = {"llama": "LlamaForCausalLM",
                     "mistral": "MistralForCausalLM",
                     "qwen2": "Qwen2ForCausalLM",
-                    "mixtral": "MixtralForCausalLM"}
+                    "mixtral": "MixtralForCausalLM",
+                    "baichuan": "BaichuanForCausalLM"}
         cfg = {
             "architectures": [arch_map.get(arch, "LlamaForCausalLM")],
             "model_type": arch,
@@ -430,6 +433,58 @@ class GGUFFile:
                 cfg["architectures"] = ["MixtralForCausalLM"]
                 cfg["model_type"] = "mixtral"
         return cfg
+
+    def _hf_config_nonllama(self, arch: str, heads: int,
+                            vocab: int) -> Dict[str, Any]:
+        """HF-style config for the non-llama GGUF archs the reference
+        also maps (reference transformers/gguf/api.py:31-70 dispatching
+        to gguf/models/{bloom,falcon,mpt}.py). Keys match what each
+        family's config_from_hf reads (models/families.py), so one
+        synthesis feeds the existing converter configs."""
+        d = int(self._arch_kv("embedding_length", 4096))
+        L = int(self._arch_kv("block_count", 24))
+        ff = int(self._arch_kv("feed_forward_length", 4 * d))
+        eps = float(self._arch_kv("attention.layer_norm_epsilon", 1e-5))
+        hkv = int(self._arch_kv("attention.head_count_kv", heads) or heads)
+        tie = "output.weight" not in self.tensors
+        common = {
+            "model_type": arch,
+            "bos_token_id": self.kv.get("tokenizer.ggml.bos_token_id"),
+            "eos_token_id": self.kv.get("tokenizer.ggml.eos_token_id"),
+        }
+        if arch == "bloom":
+            return {**common,
+                    "architectures": ["BloomForCausalLM"],
+                    "vocab_size": vocab, "hidden_size": d,
+                    "n_head": heads, "n_layer": L,
+                    "layer_norm_epsilon": eps}
+        if arch == "falcon":
+            return {**common,
+                    "architectures": ["FalconForCausalLM"],
+                    "vocab_size": vocab, "hidden_size": d,
+                    "num_attention_heads": heads,
+                    "num_hidden_layers": L,
+                    "layer_norm_epsilon": eps,
+                    "multi_query": hkv == 1,
+                    # 40b/180b new_decoder_architecture: grouped KV +
+                    # attn_norm_2 — the family converter rejects it
+                    # loudly, same as the HF path
+                    "new_decoder_architecture": 1 < hkv < heads,
+                    "parallel_attn": True,
+                    "bias": any(t.endswith("attn_qkv.bias")
+                                for t in self.tensors),
+                    "rope_theta": float(
+                        self._arch_kv("rope.freq_base", 10000.0)),
+                    "max_position_embeddings": int(
+                        self._arch_kv("context_length", 2048)),
+                    "tie_word_embeddings": tie}
+        # mpt
+        return {**common,
+                "architectures": ["MPTForCausalLM"],
+                "vocab_size": vocab, "d_model": d,
+                "n_heads": heads, "n_layers": L,
+                "expansion_ratio": max(ff // d, 1),
+                "max_seq_len": int(self._arch_kv("context_length", 2048))}
 
     def tokenizer_info(self) -> Dict[str, Any]:
         """Raw vocab for tokenizer reconstruction."""
@@ -611,7 +666,10 @@ class GGUFFile:
 # Model import: GGUF -> family parameter pytree
 # ---------------------------------------------------------------------------
 
-# llama-arch GGUF tensor names -> our llama pytree keys
+# GGUF blk.* tensor names -> our generalized-decoder pytree keys
+# (shared by llama-shaped archs AND the non-llama archs the reference
+# maps: baichuan writes llama-style attn_q/k/v; bloom/falcon/mpt write
+# a fused attn_qkv handled separately in load_gguf)
 _LLAMA_MAP = {
     "attn_q": "q_proj", "attn_k": "k_proj", "attn_v": "v_proj",
     "attn_output": "o_proj", "ffn_gate": "gate_proj", "ffn_up": "up_proj",
@@ -631,11 +689,16 @@ def load_gguf(path: str, compute_dtype=None):
     import jax
     import jax.numpy as jnp
 
+    from bigdl_tpu.ops.quant import QTensor, split_qtensor_n
+
     if compute_dtype is None:
         compute_dtype = jnp.bfloat16
     gf = GGUFFile(path)
     hf_config = gf.hf_config()
-    L = hf_config["num_hidden_layers"]
+    # layer count straight from the GGUF metadata — the synthesized
+    # config spells it per-arch (n_layer / n_layers / num_hidden_layers)
+    L = int(gf._arch_kv("block_count",
+                        hf_config.get("num_hidden_layers", 32)))
     n_exp = int(gf._arch_kv("expert_count") or 0)
     moe = n_exp > 0
     if moe and gf.architecture not in ("llama", "mistral", "mixtral"):
@@ -663,12 +726,23 @@ def load_gguf(path: str, compute_dtype=None):
             dense = dense.T    # [out, in] -> contraction-major [in, out]
         return jnp.asarray(dense).astype(compute_dtype)
 
+    heads = int(gf._arch_kv("attention.head_count", 32))
+    hkv = int(gf._arch_kv("attention.head_count_kv", heads) or heads)
+    hd = int(gf._arch_kv("embedding_length", 4096)) // heads
+    qkv_sizes = [heads * hd, hkv * hd, hkv * hd]
+
+    _TOP = {  # exact-name top-level tensors -> pytree keys
+        "output_norm.weight": "norm", "output_norm.bias": "norm_bias",
+        "token_embd_norm.weight": "embed_norm",
+        "token_embd_norm.bias": "embed_norm_bias",
+    }
+
     for name in gf.tensors:
         if name == "token_embd.weight":
             params["embed_tokens"] = jnp.asarray(
                 gf.load_dense(name, np.float32)).astype(compute_dtype)
-        elif name == "output_norm.weight":
-            params["norm"] = jnp.asarray(
+        elif name in _TOP:
+            params[_TOP[name]] = jnp.asarray(
                 gf.load_dense(name, np.float32)).astype(compute_dtype)
         elif name == "output.weight":
             params["lm_head"] = cvt(name, True)
@@ -676,6 +750,34 @@ def load_gguf(path: str, compute_dtype=None):
             parts = name.split(".")
             idx = int(parts[1])
             base = parts[2]
+            if base == "attn_qkv":
+                # bloom/falcon/mpt fused QKV: llama.cpp's converters
+                # write CONTIGUOUS [Q; K; V] output rows (e.g. bloom's
+                # per-head interleave is reordered at convert time), so
+                # a row split is exact; quantized tensors split along N
+                # of the contraction-major QTensor (block-safe).
+                leaf = parts[3]
+                if leaf == "bias":
+                    b = gf.load_dense(name, np.float32)
+                    off = 0
+                    for key, sz in zip(("q_proj_bias", "k_proj_bias",
+                                        "v_proj_bias"), qkv_sizes):
+                        layer_acc.setdefault(key, [None] * L)[idx] = \
+                            jnp.asarray(b[off:off + sz]).astype(
+                                compute_dtype)
+                        off += sz
+                else:
+                    val = cvt(name, True)
+                    if isinstance(val, QTensor):
+                        qs = split_qtensor_n(val, qkv_sizes)
+                    else:
+                        qs, off = [], 0
+                        for sz in qkv_sizes:
+                            qs.append(val[:, off:off + sz])
+                            off += sz
+                    for key, v in zip(("q_proj", "k_proj", "v_proj"), qs):
+                        layer_acc.setdefault(key, [None] * L)[idx] = v
+                continue
             if moe and base == "ffn_gate_inp":
                 # router [E, D] -> contraction-major [D, E], full precision
                 layer_acc.setdefault("router", [None] * L)[idx] = \
@@ -733,8 +835,23 @@ def load_gguf(path: str, compute_dtype=None):
                     "input_layernorm", "post_attention_layernorm"}
     else:
         required = {"q_proj", "k_proj", "v_proj", "o_proj",
-                    "gate_proj", "up_proj", "down_proj",
-                    "input_layernorm", "post_attention_layernorm"}
+                    "up_proj", "down_proj", "input_layernorm"}
+        # family shape decides the rest: non-gated archs (bloom/
+        # falcon/mpt) have no ffn_gate; falcon's single shared norm
+        # has no ffn_norm
+        try:
+            from bigdl_tpu.models.registry import get_family
+
+            fam_cfg = get_family(hf_config["architectures"][0],
+                                 hf_config).config_from_hf(hf_config)
+            if getattr(fam_cfg, "mlp_gated", True):
+                required.add("gate_proj")
+            if not getattr(fam_cfg, "shared_input_norm", False):
+                required.add("post_attention_layernorm")
+        except NotImplementedError:
+            raise
+        except Exception:
+            required |= {"gate_proj", "post_attention_layernorm"}
     missing = sorted(
         (required - set(layer_acc))
         | {k for k, v in layer_acc.items() if any(x is None for x in v)})

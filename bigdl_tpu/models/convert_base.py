@@ -32,6 +32,13 @@ class Acc:
         self._quantize_linear = quantize_linear
         self.layers: Dict[str, list] = {}
         self.top: Dict[str, Any] = {}
+        # mixed_* policies: the scan-stacked layer layout needs ONE
+        # concrete qtype per logical key (stacking heterogeneous
+        # QTensors is a pytree-structure mismatch), so the per-tensor
+        # MSE pick (reference low_bit_linear.py:302-335 picks per
+        # module) is made on the first layer seen and reused for the
+        # rest of that key
+        self._mixed_picks: Dict[str, str] = {}
 
     def linear(self, name: str, w: np.ndarray):
         """HF [out, in] -> contraction-major leaf (QTensor or dense).
@@ -54,6 +61,14 @@ class Acc:
             from bigdl_tpu.ops.quant import QTensor
 
             qtype = low_bit_policy(self.qtype, name)
+            from bigdl_tpu.ops.quant import MIXED_QTYPES
+
+            mixed_key = None
+            if qtype in MIXED_QTYPES:
+                import re as _re
+
+                mixed_key = _re.sub(r"\.\d+\.", ".N.", name)
+                qtype = self._mixed_picks.get(mixed_key, qtype)
             qw = imatrix_lookup(self.imatrix, name)
             if qw is not None and len(qw) != np.asarray(w).shape[1]:
                 qw = None     # wrong orientation (e.g. embedding row)
@@ -65,8 +80,11 @@ class Acc:
                     return QTensor(jnp.asarray(data),
                                    jnp.asarray(scale).astype(jnp.bfloat16),
                                    None, qtype, wt.shape)
-            return self._quantize_linear(jnp.asarray(np.asarray(w)),
-                                         qtype, qw=qw)
+            out = self._quantize_linear(jnp.asarray(np.asarray(w)),
+                                        qtype, qw=qw)
+            if mixed_key is not None and mixed_key not in self._mixed_picks:
+                self._mixed_picks[mixed_key] = out.qtype
+            return out
         return jnp.asarray(np.asarray(w)).T.astype(self.compute_dtype)
 
     def dense(self, w) -> jax.Array:

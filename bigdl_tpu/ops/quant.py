@@ -1066,14 +1066,29 @@ def to_mxu_layout(qt: QTensor) -> QTensor:
         # would feed int4-dtype data to kernels that bit-unpack uint8
         # (code-review r5). Expert matmuls stay on the proven path.
         return qt
-    # layer-stacked params carry leading dims: [..., K//2, N]
     packed = qt.data
     *lead, k2, n = packed.shape
     b2 = qt.qt.block_size // 2
-    blk = packed.reshape(*lead, k2 // b2, b2, n)
-    codes = jnp.concatenate([blk & jnp.uint8(0x0F), blk >> 4], axis=-2)
-    data = (codes.astype(jnp.int8) - 8).astype(jnp.int4) \
-        .reshape(*lead, k2 * 2, n)
+
+    def unpack(blk, xp, i8, i4):
+        codes = xp.concatenate([blk & 0x0F, blk >> 4], axis=-2)
+        return (codes.astype(i8) - i8(8)).astype(i4) \
+            .reshape(*lead, k2 * 2, n)
+
+    if isinstance(packed, jax.core.Tracer):
+        blk = packed.reshape(*lead, k2 // b2, b2, n)
+        return dataclasses.replace(
+            qt, data=unpack(blk, jnp, jnp.int8, jnp.int4))
+    # concrete weights convert on HOST: the device expansion would
+    # materialize ~4x the packed bytes (uint8 codes + int8) as a
+    # transient next to the resident model — a multi-GB load-time HBM
+    # spike for 7B stacked leaves (same rationale as parallel/tp.py
+    # _pad_axis). numpy's ml_dtypes int4 transfers straight to a
+    # bit-packed device array.
+    import ml_dtypes
+
+    host = np.asarray(packed).reshape(*lead, k2 // b2, b2, n)
+    data = jnp.asarray(unpack(host, np, np.int8, ml_dtypes.int4))
     return dataclasses.replace(qt, data=data)
 
 

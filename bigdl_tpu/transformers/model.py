@@ -499,14 +499,16 @@ class _BaseAutoModelClass:
                     "(RWKV-style) families: verification rollback rewinds "
                     "a KV cache, and recurrent state cannot be rewound")
             if cvt_qtype == "sym_int4":
-                model.draft_params = params      # already low-bit: share
+                # already low-bit: share the (possibly MXU-relayouted)
+                # tree — the draft decode is the latency-critical loop
+                model.draft_params = model.params
             else:
-                model.draft_params = _maybe_merge(
+                model.draft_params = _maybe_mxu_layout(_maybe_merge(
                     family.convert_params(
                         iter_hf_tensors(path), cfg, qtype="sym_int4",
                         modules_to_not_convert=tuple(
                             modules_to_not_convert)),
-                    cfg, family, merge_projections)
+                    cfg, family, merge_projections))
         return model
 
     @classmethod
