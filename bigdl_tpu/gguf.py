@@ -838,20 +838,23 @@ def load_gguf(path: str, compute_dtype=None):
                     "up_proj", "down_proj", "input_layernorm"}
         # family shape decides the rest: non-gated archs (bloom/
         # falcon/mpt) have no ffn_gate; falcon's single shared norm
-        # has no ffn_norm
-        try:
-            from bigdl_tpu.models.registry import get_family
+        # has no ffn_norm. Unknown archs fall back to the llama shape;
+        # a config-synthesis failure for a KNOWN family must surface
+        # as itself, not as a bogus missing-tensor report.
+        from bigdl_tpu.models.registry import get_family
 
-            fam_cfg = get_family(hf_config["architectures"][0],
-                                 hf_config).config_from_hf(hf_config)
+        try:
+            fam = get_family(hf_config["architectures"][0], hf_config)
+        except ValueError:          # unsupported architecture
+            fam = None
+        if fam is None:
+            required |= {"gate_proj", "post_attention_layernorm"}
+        else:
+            fam_cfg = fam.config_from_hf(hf_config)
             if getattr(fam_cfg, "mlp_gated", True):
                 required.add("gate_proj")
             if not getattr(fam_cfg, "shared_input_norm", False):
                 required.add("post_attention_layernorm")
-        except NotImplementedError:
-            raise
-        except Exception:
-            required |= {"gate_proj", "post_attention_layernorm"}
     missing = sorted(
         (required - set(layer_acc))
         | {k for k, v in layer_acc.items() if any(x is None for x in v)})

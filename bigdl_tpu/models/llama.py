@@ -383,6 +383,13 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
 
     gated = cfg.mlp_gated
     biased = (not gated) and ("experts_up_bias" in lp)
+    # explicit TP wraps experts_down in a collective-injecting wrapper
+    # (parallel/tp.AllReduceLinear); paths that consume the raw stack
+    # (qtype probes, the ragged kernel) unwrap it and apply the reduce
+    # to their partial output themselves
+    dleaf = lp["experts_down"]
+    post_reduce = getattr(dleaf, "post_reduce", None)
+    dstack = dleaf.base if post_reduce is not None else dleaf
 
     def one_expert(x_row, gw, uw, dw, ub, db, backend=None):
         """x [1, D] through ONE expert's projections."""
@@ -405,9 +412,9 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
         # expert stacks never hit pallas
         ff = cfg.intermediate_size
         probes = []
-        for key, kk, nn in (("experts_gate", d, ff), ("experts_up", d, ff),
-                            ("experts_down", ff, d)):
-            leaf = lp.get(key)
+        for leaf, kk, nn in ((lp.get("experts_gate"), d, ff),
+                             (lp.get("experts_up"), d, ff),
+                             (dstack, ff, d)):
             if leaf is not None and hasattr(leaf, "qtype"):
                 probes.append((leaf.qtype, kk, nn))
         gather_backend = (
@@ -453,9 +460,9 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
         # probes every (qtype, geometry) pair the dispatch runs
         ff = cfg.intermediate_size
         pairs = []
-        for key, kk, nn in (("experts_gate", d, ff), ("experts_up", d, ff),
-                            ("experts_down", ff, d)):
-            leaf = lp.get(key)
+        for leaf, kk, nn in ((lp.get("experts_gate"), d, ff),
+                             (lp.get("experts_up"), d, ff),
+                             (dstack, ff, d)):
             if leaf is not None:
                 pairs.append((leaf.qtype if hasattr(leaf, "qtype")
                               else None, kk, nn))
@@ -464,8 +471,11 @@ def _moe_mlp(hidden, lp, cfg: LlamaConfig):
             y = moe_mlp_ragged(
                 xf, topi, w,
                 lp["experts_gate"] if gated else None,
-                lp["experts_up"], lp["experts_down"], act,
+                lp["experts_up"], dstack, act,
                 cfg.num_local_experts, interpret=interp)
+            if post_reduce is not None:
+                # ragged ran on the local ff shard: reduce the partial
+                y = post_reduce(y)
             return y.reshape(b, t, d)
 
     combine = jnp.sum(

@@ -48,7 +48,15 @@ LLAMA_RULES: Dict[str, str] = {
     "qkv_proj_bias": "col",
     "gate_up_proj_bias": "col",
     "lm_head": "col",
-    # replicated: norms, o/down biases (added post-reduce)
+    # MoE expert stacks [L, E, K, N]: each expert's ff dim splits
+    # across tp (Megatron-style expert TP) — gate/up column-parallel,
+    # down row-parallel; the router stays replicated (no rule)
+    "experts_gate": "col",
+    "experts_up": "col",
+    "experts_down": "row",
+    "experts_up_bias": "col",
+    # replicated: norms, router, o/down/experts_down biases (added
+    # post-reduce)
 }
 
 

@@ -55,11 +55,15 @@ def _tp_cfg(cfg, n: int):
     # collective-injecting weight wrappers), so every family knob it
     # supports — parallel residual, shared input norm, non-gated MLP,
     # layernorm biases, partial rotary, sliding windows, soft caps —
-    # works under explicit TP too. Two exclusions remain:
-    if getattr(cfg, "num_local_experts", 0):
-        raise NotImplementedError(
-            "explicit TP does not cover MoE expert stacks; shard experts "
-            "over an ep axis instead (models/mixtral.py)")
+    # and (r5) MoE expert stacks work under explicit TP. One exclusion
+    # remains:
+    if getattr(cfg, "num_local_experts", 0) \
+            and cfg.intermediate_size % n:
+        raise ValueError(
+            f"MoE expert ff {cfg.intermediate_size} not divisible by "
+            f"tp={n}: expert stacks are not lane-padded (pad_ff_for_tp "
+            "covers dense MLPs only); use a dividing tp, the ep axis "
+            "(models/mixtral.py), or the GSPMD path")
     if cfg.use_alibi:
         raise NotImplementedError(
             "alibi families need per-shard slope slices (head-sharded "
@@ -305,6 +309,12 @@ class AllReduceLinear:
             y = y + bias.astype(y.dtype)
         return y
 
+    def post_reduce(self, y):
+        """The reduce alone — for paths that consume `.base` directly
+        (the ragged MoE kernel takes the raw expert stack) and reduce
+        the partial output themselves."""
+        return lax.psum(y, self.axis)
+
     def tree_flatten(self):
         return (self.base,), (self.axis,)
 
@@ -345,7 +355,7 @@ def _wrap_collectives(p, axis: str, true_vocab: int):
     """Inject the TP collectives into the param pytree: row-parallel
     projections all-reduce, the col-sharded lm_head all-gathers."""
     layers = dict(p["layers"])
-    for name in ("o_proj", "down_proj"):
+    for name in ("o_proj", "down_proj", "experts_down"):
         if name in layers:
             layers[name] = AllReduceLinear(layers[name], axis)
     out = {**p, "layers": layers}

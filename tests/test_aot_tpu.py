@@ -502,6 +502,53 @@ def test_explicit_tp_kernels_compile_v5e_mesh(v5e, aot_flags):
     assert "all-reduce" in txt
 
 
+def test_explicit_tp_moe_compiles_v5e_mesh(v5e, aot_flags):
+    """VERDICT r4 #8: mixtral-geometry MoE under explicit TP must
+    compile for the real v5e topology with Mosaic kernels AND the
+    all-reduce — expert ff sharded across tp, psum on the partial
+    expert outputs (8x7B geometry at 2 layers to bound compile time)."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+
+    from bigdl_tpu.models import llama as M
+    from bigdl_tpu.models.mixtral import MixtralConfig
+    from bigdl_tpu.ops.kvcache import KVCache
+    from bigdl_tpu.parallel import tp as TP
+    from bigdl_tpu.utils.testing import random_mixtral_params
+
+    mesh = Mesh(np.array(v5e.devices), ("tp",))
+    cfg = MixtralConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=2, num_attention_heads=32,
+        num_key_value_heads=8, num_local_experts=8,
+        num_experts_per_tok=2)
+    pshape = jax.eval_shape(
+        lambda: random_mixtral_params(cfg, "sym_int4"))
+    specs = TP.tp_param_specs(pshape, mesh)
+    p_s = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
+        pshape, specs)
+    cshape = jax.eval_shape(lambda: M.new_cache(cfg, 1, 2048))
+    csh = NamedSharding(mesh, TP.tp_cache_specs())
+    cache_s = KVCache(
+        jax.ShapeDtypeStruct(cshape.k.shape, cshape.k.dtype, sharding=csh),
+        jax.ShapeDtypeStruct(cshape.v.shape, cshape.v.dtype, sharding=csh),
+        jax.ShapeDtypeStruct((), jnp.int32,
+                             sharding=NamedSharding(
+                                 mesh, jax.sharding.PartitionSpec())))
+    ids = jax.ShapeDtypeStruct(
+        (1, 1), jnp.int32,
+        sharding=NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    fn = TP._tp_fn(cfg, mesh, "tp")
+    with mesh:
+        comp = fn.lower(p_s, ids, cache_s).compile()
+    txt = comp.as_text()
+    assert _has_mosaic_call(comp), (
+        "explicit-TP MoE compiled without Mosaic kernels")
+    assert "all-reduce" in txt
+
+
 def test_explicit_tp_parallel_residual_compiles_v5e_mesh(v5e, aot_flags):
     """VERDICT r3 #6: a falcon-style (parallel-residual, shared input
     norm, non-gated gelu MLP) family must compile for the real v5e

@@ -86,7 +86,7 @@ def _build_bloom(rng):
             w = _t(rng, *shape)
             bias = _t(rng, shape[0])
             hf += [(p + hf_n + ".weight", w), (p + hf_n + ".bias", bias)]
-            gg[gg_n and b + gg_n + ".weight"] = (w, G.GGML_F32)
+            gg[b + gg_n + ".weight"] = (w, G.GGML_F32)
             gg[b + gg_n + ".bias"] = (bias, G.GGML_F32)
         for hf_n, gg_n in [("input_layernorm", "attn_norm"),
                            ("post_attention_layernorm", "ffn_norm")]:
@@ -97,6 +97,7 @@ def _build_bloom(rng):
     kv = _common_kv("bloom", {
         "bloom.attention.layer_norm_epsilon": 1e-5,
         "bloom.attention.head_count_kv": H,
+        "bloom.feed_forward_length": 4 * D,
     })
     hf_cfg = {"architectures": ["BloomForCausalLM"], "model_type": "bloom",
               "vocab_size": V, "hidden_size": D, "n_head": H, "n_layer": L,
@@ -137,6 +138,7 @@ def _build_falcon(rng):
         "falcon.attention.layer_norm_epsilon": 1e-5,
         "falcon.attention.head_count_kv": 1,
         "falcon.rope.freq_base": 10000.0,
+        "falcon.feed_forward_length": 4 * D,
     })
     hf_cfg = {"architectures": ["FalconForCausalLM"],
               "model_type": "falcon", "vocab_size": V, "hidden_size": D,
@@ -172,7 +174,8 @@ def _build_mpt(rng):
             w, _ = _norm(rng, D)
             hf.append((p + hf_n + ".weight", w))
             gg[b + gg_n + ".weight"] = (w, G.GGML_F32)
-    kv = _common_kv("mpt", {"mpt.attention.head_count_kv": H})
+    kv = _common_kv("mpt", {"mpt.attention.head_count_kv": H,
+                            "mpt.feed_forward_length": 4 * D})
     hf_cfg = {"architectures": ["MPTForCausalLM"], "model_type": "mpt",
               "vocab_size": V, "d_model": D, "n_heads": H, "n_layers": L,
               "expansion_ratio": 4, "max_seq_len": 128}
@@ -249,7 +252,8 @@ def test_gguf_matches_hf_conversion(arch, tmp_path):
     assert cfg_gg["architectures"] == hf_cfg["architectures"]
     fam2 = get_family(cfg_gg["architectures"][0], cfg_gg)
     cfg2 = fam2.config_from_hf(cfg_gg)
-    for field in ("hidden_size", "num_attention_heads", "mlp_gated",
+    for field in ("hidden_size", "intermediate_size",
+                  "num_attention_heads", "mlp_gated",
                   "use_alibi", "use_rope", "norm_type",
                   "parallel_residual", "shared_input_norm"):
         assert getattr(cfg2, field) == getattr(cfg, field), field

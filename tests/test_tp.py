@@ -181,6 +181,61 @@ def test_tp_parallel_residual_families_match(mesh, cfg):
         atol=2e-2)
 
 
+def test_tp_moe_logits_match_single_device(mesh):
+    """VERDICT r4 #8: explicit TP must cover MoE expert stacks — each
+    expert's ff dim splits across tp (gate/up column-, down row-
+    parallel with an in-body psum on the partial expert outputs);
+    logits equal the single-device forward, prefill AND decode (the
+    decode step exercises the per-token expert-gather path under the
+    collective wrapper)."""
+    from bigdl_tpu.models.mixtral import MixtralConfig
+    from bigdl_tpu.utils.testing import random_mixtral_params
+
+    cfg = MixtralConfig(
+        vocab_size=128, hidden_size=256, intermediate_size=512,
+        num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=4, max_position_embeddings=128,
+        num_local_experts=4, num_experts_per_tok=2)
+    params = random_mixtral_params(cfg, qtype="sym_int4", seed=9)
+    prompt = jnp.asarray(np.arange(1, 13, dtype=np.int32)[None])
+
+    ref_lg, ref_cache = M.forward(params, cfg, prompt,
+                                  M.new_cache(cfg, 1, 64))
+    with mesh:
+        p_s = shard_params_tp(params, mesh)
+        cache = new_cache_tp(cfg, 1, 64, mesh)
+        lg, cache2 = tp_forward_step(p_s, cfg, prompt, cache, mesh)
+    np.testing.assert_allclose(
+        np.asarray(lg), np.asarray(ref_lg[:, -1, :]), rtol=2e-2,
+        atol=2e-2)
+
+    tok = jnp.argmax(ref_lg[:, -1:, :], axis=-1).astype(jnp.int32)
+    ref_lg2, _ = M.forward(params, cfg, tok, ref_cache)
+    with mesh:
+        lg2, _ = tp_forward_step(p_s, cfg, tok, cache2, mesh)
+    np.testing.assert_allclose(
+        np.asarray(lg2), np.asarray(ref_lg2[:, -1, :]), rtol=2e-2,
+        atol=2e-2)
+
+
+def test_tp_moe_indivisible_ff_rejected(mesh):
+    """MoE ff that doesn't divide by tp must fail with a named error
+    (expert stacks are not lane-padded)."""
+    from bigdl_tpu.models.mixtral import MixtralConfig
+    from bigdl_tpu.utils.testing import random_mixtral_params
+
+    cfg = MixtralConfig(
+        vocab_size=64, hidden_size=256, intermediate_size=2051,
+        num_hidden_layers=1, num_attention_heads=8,
+        num_key_value_heads=4, max_position_embeddings=64,
+        num_local_experts=2, num_experts_per_tok=2)
+    params = random_mixtral_params(cfg, qtype=None, seed=0)
+    with pytest.raises(ValueError, match="expert ff"):
+        with mesh:
+            tp_generate(params, cfg, np.arange(1, 5)[None], mesh,
+                        max_new_tokens=2, max_seq=32)
+
+
 def test_tp_rejects_indivisible_heads(mesh):
     bad = LlamaConfig(vocab_size=64, hidden_size=48, intermediate_size=96,
                       num_hidden_layers=1, num_attention_heads=6,
