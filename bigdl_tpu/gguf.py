@@ -456,12 +456,14 @@ class GGUFFile:
             return {**common,
                     "architectures": ["BloomForCausalLM"],
                     "vocab_size": vocab, "hidden_size": d,
+                    "intermediate_size": ff,
                     "n_head": heads, "n_layer": L,
                     "layer_norm_epsilon": eps}
         if arch == "falcon":
             return {**common,
                     "architectures": ["FalconForCausalLM"],
                     "vocab_size": vocab, "hidden_size": d,
+                    "intermediate_size": ff,
                     "num_attention_heads": heads,
                     "num_hidden_layers": L,
                     "layer_norm_epsilon": eps,

@@ -220,7 +220,9 @@ def _bloom_cfg(hf: Dict[str, Any]) -> LlamaConfig:
     return LlamaConfig(
         vocab_size=hf["vocab_size"],
         hidden_size=d,
-        intermediate_size=4 * d,
+        # HF bloom is always 4d; GGUF metadata may spell it explicitly
+        intermediate_size=hf.get("intermediate_size",
+                                 hf.get("n_inner") or 4 * d),
         num_hidden_layers=hf.get("n_layer", hf.get("num_hidden_layers")),
         num_attention_heads=h,
         num_key_value_heads=h,
@@ -307,7 +309,8 @@ def _falcon_cfg(hf: Dict[str, Any]) -> LlamaConfig:
     return LlamaConfig(
         vocab_size=hf["vocab_size"],
         hidden_size=d,
-        intermediate_size=4 * d,
+        intermediate_size=hf.get("intermediate_size",
+                                 hf.get("ffn_hidden_size") or 4 * d),
         num_hidden_layers=hf.get("num_hidden_layers", hf.get("n_layer")),
         num_attention_heads=h,
         num_key_value_heads=hkv,

@@ -318,6 +318,11 @@ def quantize(x: jax.Array, qtype: str,
             f"quantize expects a 2-D [K, N] array, got shape {x.shape}; "
             "reshape/flatten leading dims first"
         )
+    if qw is not None and np.shape(qw) != (x.shape[0],):
+        raise ValueError(
+            f"imatrix length {np.shape(qw)} does not match the "
+            f"contraction dim K={x.shape[0]} (importance is per INPUT "
+            "feature)")
     qt = get_qtype(qtype)
     if qt.kind == "iqx":
         return _quantize_iqx(x, qt.name, qw)
@@ -662,21 +667,30 @@ def _iqx_encode_chunk(xc: jax.Array, wv: jax.Array, qtype: str,
     per = 256 // sub
 
     d, s4, effk = _iq_scales(xc, gmax, sub=sub)
-    w = wv.reshape(g, 8, 1)
+    # wv: [K, 1] (uniform across columns) or [K, Nc] (magnitude-
+    # modulated imatrix weights — per-column by construction)
+    w = wv.reshape(g, 8, -1)
+    percol = w.shape[-1] != 1
     drep = jnp.repeat(d, per, axis=0)                         # [K/sub, Nc]
-    s2 = jnp.einsum("gk,jk->gj", w[..., 0], cb * cb)
+    if percol:
+        s2 = jnp.einsum("gkn,jk->gjn", w, cb * cb)            # [g, J, Nc]
+    else:
+        s2 = jnp.einsum("gk,jk->gj", w[..., 0], cb * cb)[:, :, None]
     if with_delta:
-        swc = jnp.einsum("gk,jk->gj", w[..., 0], cb)          # [g, J]
+        if percol:
+            swc = jnp.einsum("gkn,jk->gjn", w, cb)
+        else:
+            swc = jnp.einsum("gk,jk->gj", w[..., 0], cb)[:, :, None]
 
     def assign(effk):
         y = xc * _safe_inv(effk)                              # [K, Nc]
         a = (y if signed_cb else jnp.abs(y)).reshape(g, 8, nc)
         s1 = jnp.einsum("gkn,jk->gjn", a * w, cb)
-        base = s1 - 0.5 * s2[:, :, None]                      # [g, J, Nc]
+        base = s1 - 0.5 * s2                                  # [g, J, Nc]
         if not with_delta:
             return jnp.argmax(base, axis=1), None
         sy = jnp.sum((a * w), axis=1)                         # [g, Nc]
-        dterm = _IQ_DELTA * (sy[:, None, :] - swc[:, :, None])
+        dterm = _IQ_DELTA * (sy[:, None, :] - swc)
         plus, minus = base + dterm, base - dterm
         jp, jm = jnp.argmax(plus, axis=1), jnp.argmax(minus, axis=1)
         bp = jnp.take_along_axis(plus, jp[:, None, :], axis=1)[:, 0]
@@ -772,7 +786,23 @@ def _quantize_iqx(x: jax.Array, qtype: str,
     datas, ds, auxs, extras = [], [], [], []
     for c0 in range(0, n, _IQ_CHUNK):
         xc = x[:, c0:c0 + _IQ_CHUNK]
-        data, d, aux, extra = _iqx_encode_chunk(xc, wv, qtype)
+        if qw is None:
+            wc = wv
+        else:
+            # llama.cpp's iq quantizers don't use the raw imatrix as
+            # the MSE weight — they modulate it by weight magnitude,
+            # w = qw * sqrt(sigma2 + x^2), sigma2 = 2*mean(x^2) per
+            # superblock (quantize_row_iq2_xxs_impl and friends). The
+            # raw-qw objective over-protects high-importance but
+            # small-magnitude coordinates and measurably HURT iq ppl
+            # on the in-repo testbeds (the r4 imatrix anomaly).
+            x2 = xc * xc
+            sigma2 = 2.0 * jnp.mean(
+                x2.reshape(kp // 256, 256, -1), axis=1, keepdims=True)
+            wc = wv * jnp.sqrt(
+                (sigma2 + x2.reshape(kp // 256, 256, -1))
+            ).reshape(kp, -1)
+        data, d, aux, extra = _iqx_encode_chunk(xc, wc, qtype)
         datas.append(data)
         ds.append(d)
         auxs.append(aux)
