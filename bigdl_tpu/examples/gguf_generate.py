@@ -1,0 +1,34 @@
+"""Generate straight from a GGUF file (the reference's
+example/GPU/HF-Transformers-AutoModels/Advanced-Quantizations/GGUF
+load_gguf pattern): the quantized weights load bit-faithfully into the
+TPU runtime — no HF checkpoint needed.
+
+    python -m bigdl_tpu.examples.gguf_generate --gguf model.q4_0.gguf \
+        --prompt "Once upon a time" --n-predict 64
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gguf", required=True, help="path to a .gguf file")
+    ap.add_argument("--prompt", default="Once upon a time")
+    ap.add_argument("--n-predict", type=int, default=64)
+    args = ap.parse_args()
+
+    from bigdl_tpu.gguf_tokenizer import GGUFTokenizer
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(args.gguf)
+    tok = GGUFTokenizer.from_tokenizer_info(model.gguf_tokenizer_info)
+    ids = tok.encode(args.prompt)
+    out = model.generate(ids, max_new_tokens=args.n_predict)
+    print(tok.decode(list(out[0])))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

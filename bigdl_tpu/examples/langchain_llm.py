@@ -1,0 +1,43 @@
+"""LangChain integration example (the reference's example/LangChain
+role): the TpuLLM wrapper plugs a quantized model into a chain; the
+dependency-free core answers directly when langchain isn't installed.
+
+    python -m bigdl_tpu.examples.langchain_llm \
+        --repo-id-or-model-path PATH --question "What is a TPU?"
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repo-id-or-model-path", required=True)
+    ap.add_argument("--low-bit", default="sym_int4")
+    ap.add_argument("--question", default="What is a TPU?")
+    ap.add_argument("--n-predict", type=int, default=64)
+    args = ap.parse_args()
+
+    from bigdl_tpu.integrations.langchain import TpuLLMCore
+
+    core = TpuLLMCore(args.repo_id_or_model_path, low_bit=args.low_bit)
+    template = "Question: {q}\n\nAnswer:"
+    try:
+        from langchain_core.prompts import PromptTemplate
+
+        from bigdl_tpu.integrations.langchain import TransformersLLM
+
+        llm = TransformersLLM(core=core)
+        chain = PromptTemplate.from_template(
+            template.replace("{q}", "{question}")) | llm
+        print(chain.invoke({"question": args.question}))
+    except ImportError:
+        print("(langchain not installed; using the dependency-free core)")
+        print(core.complete(template.format(q=args.question),
+                            max_new_tokens=args.n_predict))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,62 @@
+"""Pipeline-parallel inference (the reference's
+Pipeline-Parallel-Inference example role): layers split across a pp
+mesh axis with a microbatched GPipe schedule.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+        python -m bigdl_tpu.examples.pipeline_parallel \
+        --repo-id-or-model-path PATH --pp 2
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repo-id-or-model-path", required=True)
+    ap.add_argument("--low-bit", default=None,
+                    help="pp scoring runs dense by default")
+    ap.add_argument("--pp", type=int, default=2)
+    ap.add_argument("--prompt", default="Once upon a time")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.parallel import make_mesh
+    from bigdl_tpu.parallel.pp import (pp_generate_forward,
+                                       shard_params_pp)
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        args.repo_id_or_model_path,
+        load_in_low_bit=args.low_bit or "bf16")
+    if model.config.num_hidden_layers % args.pp:
+        raise SystemExit(
+            f"layers ({model.config.num_hidden_layers}) must divide "
+            f"by pp={args.pp}")
+    mesh = make_mesh(devices=jax.devices()[:args.pp], pp=args.pp, tp=1)
+    params = shard_params_pp(model.params, mesh)
+
+    try:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(args.repo_id_or_model_path)
+        ids = np.asarray(tok(args.prompt)["input_ids"], np.int32)[None]
+    except Exception:
+        ids = np.arange(1, 9, dtype=np.int32)[None]
+
+    # per-token scores for the prompt across the pipeline
+    logits = pp_generate_forward(params, model.config,
+                                 jax.numpy.asarray(ids), mesh)
+    nll = -np.take_along_axis(
+        np.asarray(jax.nn.log_softmax(logits[:, :-1], axis=-1)),
+        np.asarray(ids)[:, 1:, None], axis=2)
+    print(f"pipeline over pp={args.pp}: mean NLL "
+          f"{float(nll.mean()):.3f} over {ids.shape[1] - 1} tokens")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,51 @@
+"""Serve a quantized model over the OpenAI HTTP API (the reference's
+vLLM-Serving example role): continuous-batching engine + /v1/completions
+and /v1/chat/completions with SSE streaming.
+
+    python -m bigdl_tpu.examples.serving_openai \
+        --repo-id-or-model-path PATH [--port 8000] [--max-batch 8]
+
+Then:  curl http://localhost:8000/v1/completions -d \
+       '{"prompt": "Hello", "max_tokens": 32}'
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repo-id-or-model-path", required=True)
+    ap.add_argument("--low-bit", default="sym_int4")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=2048)
+    args = ap.parse_args()
+
+    from bigdl_tpu.serving import EngineConfig, LLMEngine
+    from bigdl_tpu.serving.api_server import OpenAIServer
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        args.repo_id_or_model_path, load_in_low_bit=args.low_bit,
+        max_seq=args.max_seq)
+    tokenizer = None
+    try:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(
+            args.repo_id_or_model_path)
+    except Exception:
+        print("no tokenizer found: requests must pass token-id prompts")
+    engine = LLMEngine(model, EngineConfig(max_batch=args.max_batch,
+                                           max_seq=args.max_seq))
+    server = OpenAIServer(engine, tokenizer=tokenizer)
+    print(f"serving on http://0.0.0.0:{args.port}/v1 "
+          f"(max_batch={args.max_batch})")
+    server.serve(host="0.0.0.0", port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

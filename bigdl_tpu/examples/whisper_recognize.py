@@ -1,0 +1,61 @@
+"""Speech recognition with a quantized Whisper (the reference's
+example/GPU/HF-Transformers-AutoModels/Model/whisper recognize.py):
+load_in_4bit the seq2seq model, transcribe one audio file.
+
+    python -m bigdl_tpu.examples.whisper_recognize \
+        --repo-id-or-model-path openai/whisper-tiny --audio sample.wav
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repo-id-or-model-path", required=True)
+    ap.add_argument("--audio", required=True,
+                    help=".wav file, or .npy of [n_mels, T] log-mel")
+    ap.add_argument("--low-bit", default="sym_int4")
+    ap.add_argument("--n-predict", type=int, default=128)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from bigdl_tpu.transformers import AutoModelForSpeechSeq2Seq
+
+    model = AutoModelForSpeechSeq2Seq.from_pretrained(
+        args.repo_id_or_model_path, load_in_low_bit=args.low_bit)
+
+    if args.audio.endswith(".npy"):
+        feats = np.load(args.audio)
+    else:
+        from transformers import WhisperProcessor
+
+        try:
+            import soundfile as sf
+
+            audio, sr = sf.read(args.audio)
+        except ImportError as e:
+            raise SystemExit(
+                "reading .wav needs the `soundfile` package; precompute "
+                "log-mel features to .npy instead") from e
+        proc = WhisperProcessor.from_pretrained(
+            args.repo_id_or_model_path)
+        feats = proc(audio, sampling_rate=sr,
+                     return_tensors="np").input_features[0]
+
+    ids = model.generate(feats[None], max_new_tokens=args.n_predict)[0]
+    try:
+        from transformers import WhisperProcessor
+
+        tok = WhisperProcessor.from_pretrained(
+            args.repo_id_or_model_path).tokenizer
+        print(tok.decode(ids, skip_special_tokens=True))
+    except Exception:
+        print(list(ids))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

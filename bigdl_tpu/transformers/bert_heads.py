@@ -64,12 +64,14 @@ class _BertTaskModel:
         load_in_4bit: bool = False,
         load_in_low_bit: Optional[str] = None,
         modules_to_not_convert=(),
+        model_hub: str = "huggingface",
         **_ignored,
     ):
         from bigdl_tpu.transformers import lowbit_io
-        from bigdl_tpu.transformers.model import _resolve_qtype
+        from bigdl_tpu.transformers.model import (_resolve_hub_path,
+                                                  _resolve_qtype)
 
-        path = pretrained_model_name_or_path
+        path = _resolve_hub_path(pretrained_model_name_or_path, model_hub)
         if lowbit_io.is_low_bit_dir(path):
             # shared REQUIRED_KEYS can't distinguish classifier-style
             # heads (seq/token/choice); the saved architecture can

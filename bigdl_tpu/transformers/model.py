@@ -30,6 +30,26 @@ _TOKENIZER_FILES = (
 )
 
 
+def _resolve_hub_path(path: str, model_hub: str) -> str:
+    """`model_hub="modelscope"` resolves a repo id through ModelScope's
+    snapshot_download (reference model.py:139-150); "huggingface" (the
+    default) passes the path through — HF repo ids resolve inside
+    utils/hf.py. Local paths bypass the hub either way."""
+    if model_hub not in ("huggingface", "modelscope"):
+        raise ValueError(
+            "model_hub must be 'huggingface' or 'modelscope', got "
+            f"{model_hub!r}")
+    if model_hub == "modelscope" and not os.path.exists(path):
+        try:
+            from modelscope.hub.snapshot_download import snapshot_download
+        except ImportError as e:
+            raise ImportError(
+                "model_hub='modelscope' needs the `modelscope` package "
+                "(pip install modelscope), or pass a local path") from e
+        return snapshot_download(path)
+    return path
+
+
 def _maybe_mxu_layout(params: Any) -> Any:
     """Re-layout sym_int4 weights to the int4-dtype MXU form when the
     compute target is TPU (flags().mxu_layout: auto/on/off). One cheap
@@ -365,13 +385,14 @@ class _BaseAutoModelClass:
         embedding_qtype: Optional[str] = None,
         imatrix: Optional[Any] = None,
         merge_projections: bool = True,
+        model_hub: str = "huggingface",
         **_ignored,
     ) -> TpuCausalLM:
         from bigdl_tpu.config import flags
 
         if quantize_kv_cache is None:
             quantize_kv_cache = flags().quantize_kv_cache
-        path = pretrained_model_name_or_path
+        path = _resolve_hub_path(pretrained_model_name_or_path, model_hub)
         if lowbit_io.is_low_bit_dir(path):
             if speculative:
                 raise ValueError(

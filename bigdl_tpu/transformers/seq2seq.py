@@ -187,11 +187,16 @@ class AutoModelForSeq2SeqLM:
         load_in_low_bit: Optional[str] = None,
         modules_to_not_convert=(),
         imatrix=None,
+        model_hub: str = "huggingface",
         **_ignored,
     ) -> TpuSeq2SeqLM:
         from bigdl_tpu.models import bart as Bt
         from bigdl_tpu.transformers import lowbit_io
-        from bigdl_tpu.transformers.model import _resolve_qtype
+        from bigdl_tpu.transformers.model import (_resolve_hub_path,
+                                                  _resolve_qtype)
+
+        pretrained_model_name_or_path = _resolve_hub_path(
+            pretrained_model_name_or_path, model_hub)
 
         path = pretrained_model_name_or_path
         if lowbit_io.is_low_bit_dir(path):
@@ -231,12 +236,14 @@ class AutoModelForSpeechSeq2Seq:
         load_in_low_bit: Optional[str] = None,
         modules_to_not_convert=(),
         imatrix=None,
+        model_hub: str = "huggingface",
         **_ignored,
     ) -> TpuSpeechSeq2Seq:
         from bigdl_tpu.transformers import lowbit_io
-        from bigdl_tpu.transformers.model import _resolve_qtype
+        from bigdl_tpu.transformers.model import (_resolve_hub_path,
+                                                  _resolve_qtype)
 
-        path = pretrained_model_name_or_path
+        path = _resolve_hub_path(pretrained_model_name_or_path, model_hub)
         if lowbit_io.is_low_bit_dir(path):
             params, _, hf_config, qt = lowbit_io.load_low_bit_checked(
                 path, ("WhisperForConditionalGeneration",),

@@ -221,3 +221,24 @@ def test_from_pretrained_speculative_merged(tiny_hf_dir):
     out_s = spec.generate([3, 1, 4, 1, 5], max_new_tokens=8)
     out_p = plain.generate([3, 1, 4, 1, 5], max_new_tokens=8)
     np.testing.assert_array_equal(out_s, out_p)
+
+
+def test_model_hub_kwarg(tmp_path):
+    """model_hub validation (reference model.py:147-150): bad values
+    rejected; 'modelscope' without the package errors actionably;
+    local paths bypass the hub."""
+    import pytest
+
+    from bigdl_tpu.transformers.model import _resolve_hub_path
+
+    with pytest.raises(ValueError, match="model_hub"):
+        _resolve_hub_path("x", "wrong")
+    assert _resolve_hub_path(str(tmp_path), "modelscope") == str(tmp_path)
+    try:
+        import modelscope  # noqa: F401
+        has_ms = True
+    except ImportError:
+        has_ms = False
+    if not has_ms:
+        with pytest.raises(ImportError, match="modelscope"):
+            _resolve_hub_path("org/nonexistent-repo", "modelscope")

@@ -1,0 +1,100 @@
+"""Example scripts must actually run end-to-end against tiny local
+checkpoints (the reference ships dozens of runnable examples; ours are
+fewer but CI-proven)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils.testing import TINY_LLAMA
+
+from tests.test_gguf import _tiny_llama_gguf
+
+torch = pytest.importorskip("torch")
+import transformers  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny_gguf(tmp_path_factory):
+    p = tmp_path_factory.mktemp("eg") / "tiny.gguf"
+    _tiny_llama_gguf(str(p), TINY_LLAMA)
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_llama(tmp_path_factory):
+    """Tiny random HF llama checkpoint sized so every quantized plane
+    splits under tp=4 (same constraints as tests/test_tp.py)."""
+    torch.manual_seed(0)
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=256, intermediate_size=512,
+        num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=4, max_position_embeddings=128)
+    m = transformers.LlamaForCausalLM(cfg).eval()
+    path = tmp_path_factory.mktemp("eg_hf") / "tiny_llama"
+    m.save_pretrained(path)
+    return str(path)
+
+
+def test_gguf_generate_example(tiny_gguf, capsys):
+    from bigdl_tpu.examples import gguf_generate
+
+    import sys
+    old = sys.argv
+    sys.argv = ["x", "--gguf", tiny_gguf, "--prompt", "t1 t2",
+                "--n-predict", "4"]
+    try:
+        assert gguf_generate.main() == 0
+    finally:
+        sys.argv = old
+    assert capsys.readouterr().out.strip()
+
+
+def test_save_load_low_bit_example(tiny_hf_llama, tmp_path, capsys):
+    from bigdl_tpu.examples import save_load_low_bit
+
+    import sys
+    old = sys.argv
+    sys.argv = ["x", "--repo-id-or-model-path", tiny_hf_llama,
+                "--save-path", str(tmp_path / "lb"), "--n-predict", "4"]
+    try:
+        assert save_load_low_bit.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "saved low-bit model" in out and "load_low_bit" in out
+
+
+def test_tensor_parallel_example(tiny_hf_llama, capsys):
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from bigdl_tpu.examples import tensor_parallel
+
+    import sys
+    old = sys.argv
+    sys.argv = ["x", "--repo-id-or-model-path", tiny_hf_llama,
+                "--tp", "4", "--n-predict", "4", "--max-seq", "64"]
+    try:
+        assert tensor_parallel.main() == 0
+    finally:
+        sys.argv = old
+    assert capsys.readouterr().out.strip()
+
+
+def test_pipeline_parallel_example(tiny_hf_llama, capsys):
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    from bigdl_tpu.examples import pipeline_parallel
+
+    import sys
+    old = sys.argv
+    sys.argv = ["x", "--repo-id-or-model-path", tiny_hf_llama,
+                "--pp", "2"]
+    try:
+        assert pipeline_parallel.main() == 0
+    finally:
+        sys.argv = old
+    assert "mean NLL" in capsys.readouterr().out

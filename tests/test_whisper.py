@@ -172,3 +172,44 @@ def test_save_load_low_bit_roundtrip(tiny_whisper):
 
     with pytest.raises(ValueError, match="saved from"):
         AutoModelForSeq2SeqLM.from_pretrained(d)
+
+
+# ---------------------------------------------------------------- WER harness
+
+
+def test_wer_metric():
+    from bigdl_tpu.bench.whisper_wer import wer
+
+    assert wer(["the cat sat"], ["the cat sat"]) == 0.0
+    # 1 substitution / 3 ref words
+    assert abs(wer(["the cat sat"], ["the dog sat"]) - 1 / 3) < 1e-9
+    # deletion + insertion
+    assert abs(wer(["a b c d"], ["a c d e"]) - 2 / 4) < 1e-9
+    # normalization: case + punctuation
+    assert wer(["Hello, world!"], ["hello world"]) == 0.0
+    # corpus-level pooling (edits sum over samples, / total ref words)
+    assert abs(wer(["a b", "c d"], ["a x", "c d"]) - 1 / 4) < 1e-9
+    assert wer([], []) == 0.0
+
+
+def test_wer_harness_end_to_end(tiny_whisper, tmp_path):
+    """dir-dataset -> transcribe -> WER + latency + CSV, through the
+    public from_pretrained surface (reference run_whisper.py flow)."""
+    from bigdl_tpu.bench import whisper_wer as W
+
+    path, _ = tiny_whisper
+    # two precomputed "log-mel" files + transcripts
+    for i in range(2):
+        np.save(tmp_path / f"s{i}.npy", _mel(t=64, seed=i)[0])
+        (tmp_path / f"s{i}.txt").write_text(f"sample transcript {i}")
+    res = W.main(["--model_path", path, "--load_in_low_bit", "sym_int4",
+                  "--dataset", f"dir:{tmp_path}", "--max_new_tokens", "4",
+                  "--save_result",
+                  "--out_csv", str(tmp_path / "out.csv")])
+    assert res["n"] == 2
+    # a random model emits garbage; insertions can push WER above 1.0 —
+    # only sanity-bound it
+    assert 0.0 <= res["wer"] < 10.0
+    assert res["mean_latency_ms"] > 0
+    rows = (tmp_path / "out.csv").read_text().strip().splitlines()
+    assert len(rows) == 2 and rows[0].startswith("model,")
