@@ -1,0 +1,139 @@
+"""Self-speculative decoding benchmark: spec-vs-plain on one chip.
+
+The reference claims ~30% latency reduction from self-speculation
+(reference README.md:18, "as fast as 33.7 ms/token with Self-Speculative
+Decoding" vs ~48 ms fp16 plain); this measures the analog: llama2-7B,
+sym_int8 target + sym_int4 draft (the self-speculation pairing closest
+to the reference's fp16+int4 that fits one v5e), plain greedy vs
+speculative wall-clock over the same decode budget.
+
+Caveat carried in the record: on RANDOM weights the draft and target
+(two quantizations of the same tensor) agree almost always, so the
+MEASURED acceptance is an upper bound; the record therefore also
+reports the per-round mechanics (draft step time, verify time) and a
+projected speedup at a realistic 80% acceptance, computed from the
+measured round timings.
+
+Run: python bench_speculative.py  — prints ONE JSON line like bench.py.
+(Not driver-run: bench.py stays the headline; this is the VERDICT r4 #9
+on-chip evidence.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench import _probe_backend, chip_peaks
+
+    backend = _probe_backend()
+    if backend is None:
+        print("bench_speculative: backend unresponsive; falling back to "
+              "CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        backend = "cpu"
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.generation import generate_on_device
+    from bigdl_tpu.models import llama as llama_mod
+    from bigdl_tpu.speculative import SpecStats, speculative_generate
+    from bigdl_tpu.utils.testing import (LLAMA2_7B, TINY_LLAMA,
+                                         random_llama_params)
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = LLAMA2_7B if on_tpu else TINY_LLAMA
+    prompt_len, new_tokens, max_seq = (256, 128, 1024) if on_tpu \
+        else (16, 16, 64)
+    gamma = 4
+
+    target = random_llama_params(cfg, qtype="sym_int8", seed=0)
+    draft = random_llama_params(cfg, qtype="sym_int4", seed=0)
+    jax.block_until_ready(jax.tree_util.tree_leaves(target)[0])
+    prompt = jnp.ones((1, prompt_len), jnp.int32)
+
+    def plain_run():
+        cache = llama_mod.new_cache(cfg, 1, max_seq)
+        t0 = time.perf_counter()
+        out, _ = generate_on_device(
+            target, cfg, llama_mod.forward, prompt, cache,
+            max_new_tokens=new_tokens)
+        np.asarray(out)
+        return time.perf_counter() - t0
+
+    def spec_run():
+        stats = SpecStats()
+        t0 = time.perf_counter()
+        out = speculative_generate(
+            target, draft, cfg, cfg, prompt,
+            family_forward=llama_mod.forward,
+            family_prefill=llama_mod.forward_last_token,
+            new_cache=llama_mod.new_cache,
+            max_new_tokens=new_tokens, gamma=gamma, max_seq=max_seq,
+            th_stop_draft=0.0, stats=stats)
+        np.asarray(out)
+        return time.perf_counter() - t0, stats
+
+    plain_run()                       # compile
+    spec_run()                        # compile
+    plain_s = min(plain_run() for _ in range(3))
+    best = None
+    for _ in range(3):
+        s, st = spec_run()
+        if best is None or s < best[0]:
+            best = (s, st)
+    spec_s, stats = best
+
+    plain_ms = plain_s / new_tokens * 1e3
+    spec_ms = spec_s / new_tokens * 1e3
+    accept = stats.accept_rate
+    tokens_per_round = stats.mean_accept + 1.0
+    round_ms = spec_s / max(len(stats.accepted), 1) * 1e3
+    # projected: tokens/round at acceptance a = a*gamma + 1 (geometric
+    # prefix accept approximated linearly, the standard projection)
+    proj_ms_80 = round_ms / (0.8 * gamma + 1.0)
+
+    speedup = plain_ms / spec_ms if spec_ms > 0 else 0.0
+    # physics floor: a verify step reads the int8 weights once -> no
+    # per-round time below weight_bytes/BW is real
+    wb = sum(getattr(l, "nbytes", l.nbytes)
+             for l in jax.tree_util.tree_leaves(target))
+    _, peak_gbps = chip_peaks()
+    floor_round_ms = wb / (peak_gbps * 1e9) * 1e3 * 0.8
+    valid = bool(on_tpu and round_ms > floor_round_ms and spec_s > 0)
+
+    rec = {
+        "metric": "llama2_7b_selfspec_decode_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup / 1.3, 3),   # reference ~30% claim
+        "valid": valid,
+        "backend": "tpu" if on_tpu else "cpu",
+        "plain_ms_per_token": round(plain_ms, 3),
+        "spec_ms_per_token": round(spec_ms, 3),
+        "gamma": gamma,
+        "accept_rate": round(accept, 4),
+        "tokens_per_round": round(tokens_per_round, 3),
+        "round_ms": round(round_ms, 3),
+        "projected_ms_per_token_at_80pct_accept": round(proj_ms_80, 3),
+        "note": ("random-weight acceptance is an upper bound; "
+                 "projected_* uses measured round mechanics at 80% "
+                 "acceptance"),
+        "prompt_len": prompt_len,
+        "decode_steps": new_tokens,
+        "model": "llama2-7b" if on_tpu else "tiny-llama(cpu-fallback)",
+    }
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -175,11 +175,12 @@ def main(argv=None):
     model = AutoModelForSpeechSeq2Seq.from_pretrained(
         args.model_path, load_in_low_bit=args.load_in_low_bit)
     tokenizer = None
+    processor = None
     try:
         from transformers import WhisperProcessor
 
-        tokenizer = WhisperProcessor.from_pretrained(
-            args.model_path).tokenizer
+        processor = WhisperProcessor.from_pretrained(args.model_path)
+        tokenizer = processor.tokenizer
     except Exception:
         pass
 
@@ -192,13 +193,10 @@ def main(argv=None):
     # prompt ids (run_whisper.py get_decoder_prompt_ids) — without them
     # a multilingual checkpoint may pick the wrong task
     forced = ()
-    if tokenizer is not None:
+    if processor is not None:
         try:
-            from transformers import WhisperProcessor
-
-            forced = tuple(WhisperProcessor.from_pretrained(
-                args.model_path).get_decoder_prompt_ids(
-                    language="en", task="transcribe"))
+            forced = tuple(processor.get_decoder_prompt_ids(
+                language="en", task="transcribe"))
         except Exception:
             forced = ()
 

@@ -21,6 +21,8 @@ while true; do
     echo "$ts bench_qlora exit=$?" >> tpu_runs/watch.log
     timeout 2400 python -u bench_serving.py > "tpu_runs/serving_$ts.json" 2> "tpu_runs/serving_$ts.log"
     echo "$ts bench_serving exit=$?" >> tpu_runs/watch.log
+    timeout 1800 python -u bench_speculative.py > "tpu_runs/spec_$ts.json" 2> "tpu_runs/spec_$ts.log"
+    echo "$ts bench_speculative exit=$?" >> tpu_runs/watch.log
     sleep 60
   else
     echo "$ts tunnel dead" >> tpu_runs/watch.log
