@@ -71,6 +71,8 @@ AB_CONFIGS = [
     # every completed config is persisted to tpu_runs/ immediately
     ("pallas+gemv", dict(matmul_backend="auto", attention_backend="auto",
                          matmul_gemv="auto")),
+    ("gemv-mxuflat", dict(matmul_backend="auto", attention_backend="auto",
+                          matmul_gemv="mxuflat")),
     ("gemv-mxu8", dict(matmul_backend="auto", attention_backend="auto",
                        matmul_gemv="mxu8")),
     ("no-mxu-layout", dict(matmul_backend="auto", attention_backend="auto",

@@ -30,10 +30,12 @@ class RuntimeFlags:
     attention_backend: str = "auto"
     # decode GEMV (M<=16) kernel variant: "auto" (MXU body when the
     # weights carry the int4-dtype layout, else the standard body),
-    # "fold" (scale-folded body over the canonical packing), "mxu8"
-    # (q8 activations against int4/int8 weights on the MXU's int8 path
-    # — 2x bf16 throughput, q8 rounding on activations), "off" (route
-    # small-M through the generic tiles) — the on-chip A/B switch
+    # "fold" (scale-folded body over the canonical packing), "mxuflat"
+    # (int4-dtype load + per-weight scale + one flat full-K MXU dot),
+    # "mxu8" (q8 activations against int4/int8 weights on the MXU's
+    # int8 path — 2x bf16 throughput, q8 rounding on activations),
+    # "off" (route small-M through the generic tiles) — the on-chip
+    # A/B switch
     matmul_gemv: str = "auto"
     # In "auto" matmul dispatch, batch rows above this go to the XLA
     # matmul instead of the Pallas dequant kernel. First on-chip A/B

@@ -253,6 +253,25 @@ def _gemv_kernel_mxu(x3_ref, data_ref, scale_ref, out_ref, acc_ref, *,
         out_ref[:] = acc_ref[:].astype(out_ref.dtype)
 
 
+def _gemv_kernel_mxuflat(x_ref, data_ref, scale_ref, out_ref, acc_ref, *,
+                         block, bk, bn, nk):
+    """Flat-dot MXU-layout body: int4 native load, per-weight scale
+    (2-3 VPU ops/weight vs the canonical unpack chain's ~8), then ONE
+    [mp, bk] x [bk, bn] bf16 dot at full K contraction — maximum MXU
+    shape efficiency. The A/B discriminator vs `_gemv_kernel_mxu`:
+    r4 on-chip numbers showed fold (batched dot, fewer VPU ops) TYING
+    std (flat dot, more VPU ops) at 30 ms, so which resource binds —
+    VPU convert throughput or the batched-dot's short-K MXU passes —
+    is an open question only silicon can answer."""
+    k = pl.program_id(1)
+    s = scale_ref[:].astype(jnp.float32)[:, None, :]
+    codes = data_ref[:].astype(jnp.int8).astype(jnp.float32)
+    w = (codes.reshape(bk // block, block, bn) * s) \
+        .reshape(bk, bn).astype(jnp.bfloat16)
+    _accumulate(x_ref[:, pl.ds(k * bk, bk)], w, out_ref, acc_ref, nk,
+                k_axis=1)
+
+
 def _gemv_kernel_mxu8(x3_ref, sxt_ref, data_ref, scale_ref, out_ref,
                       acc_ref, *, block, bk, bn, nk):
     """int8-activation variant: per-block q8 activations against the
@@ -403,7 +422,7 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
         codebook = [float(v) for v in CODEBOOKS[qt.codebook]]
     bits = qt.storage_bits
 
-    if variant in ("mxu", "mxu8"):
+    if variant in ("mxu", "mxuflat", "mxu8"):
         if w.data.dtype not in (jnp.int4, jnp.int8):
             raise NotImplementedError(
                 f"{variant} GEMV needs int4/int8-dtype weights "
@@ -413,7 +432,12 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
         # reshapes inside are a Mosaic unsupported shape cast)
         x3 = x2.reshape(mp, kp // b, b)
         x3_spec = pl.BlockSpec((mp, bk // b, b), lambda j, k: (0, k, 0))
-        if variant == "mxu":
+        if variant == "mxuflat":
+            kernel = functools.partial(
+                _gemv_kernel_mxuflat, block=b, bk=bk, bn=bn, nk=nk)
+            operands = [x2, w.data, w.scale]
+            in_specs = [x_spec, data_spec, scale_spec]
+        elif variant == "mxu":
             kernel = functools.partial(
                 _gemv_kernel_mxu, block=b, bk=bk, bn=bn, nk=nk)
             operands = [x3, w.data, w.scale]
@@ -499,6 +523,8 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
     if gv == "mxu8" and w.data.dtype in (jnp.int4, jnp.int8) \
             and qt.kind == "sym":
         variant = "mxu8"
+    elif gv == "mxuflat" and w.data.dtype == jnp.int4:
+        variant = "mxuflat"
     elif gv in ("auto", "mxu", "fold") and w.data.dtype == jnp.int4:
         variant = "mxu"          # int4-dtype layout: always the MXU body
     elif gv == "fold" and qt.kind != "asym":

@@ -106,7 +106,7 @@ def test_dequant_gemv_compiles(v5e, aot_flags, qtype, n):
         assert _has_mosaic_call(comp)
 
 
-@pytest.mark.parametrize("variant", ["mxu", "mxu8"])
+@pytest.mark.parametrize("variant", ["mxu", "mxuflat", "mxu8"])
 @pytest.mark.parametrize("k,n", [
     (4096, 12288),   # merged QKV (7B, fused q+k+v)
     (4096, 22016),   # merged gate-up

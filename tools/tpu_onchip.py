@@ -146,12 +146,16 @@ def step_gemv():
             ("sym_int4", 4096, 4096, "std"),
             ("sym_int4", 4096, 4096, "fold"),
             ("sym_int4", 4096, 4096, "mxu"),
+            ("sym_int4", 4096, 4096, "mxuflat"),
             ("sym_int4", 4096, 4096, "mxu8"),
             ("sym_int4", 4096, 12288, "mxu"),    # merged qkv
+            ("sym_int4", 4096, 12288, "mxuflat"),
             ("sym_int4", 4096, 12288, "mxu8"),
             ("sym_int4", 4096, 22016, "mxu"),    # merged gate_up
+            ("sym_int4", 4096, 22016, "mxuflat"),
             ("sym_int4", 4096, 22016, "mxu8"),
             ("sym_int4", 11008, 4096, "mxu"),    # down proj
+            ("sym_int4", 11008, 4096, "mxuflat"),
             ("sym_int4", 11008, 4096, "mxu8"),
             ("sym_int4", 4096, 12288, "std"),
             ("sym_int4", 4096, 22016, "fold"),
@@ -165,7 +169,7 @@ def step_gemv():
         interp = bool(os.environ.get("ONCHIP_FORCE_CPU"))
         w = jax.random.normal(jax.random.PRNGKey(0), (k, n), jnp.float32)
         wq = quantize(w, qt_name)
-        if variant in ("mxu", "mxu8"):
+        if variant in ("mxu", "mxuflat", "mxu8"):
             wq = to_mxu_layout(wq)
         x = jax.random.normal(jax.random.PRNGKey(1), (1, k), jnp.bfloat16)
         y = np.asarray(
