@@ -53,7 +53,9 @@ def main() -> None:
     # so the projection below compares like-for-like
     batch, seq = (8, 256) if on_tpu else (1, 64)
 
-    params = random_llama_params(cfg, qtype="sym_int4")
+    from bigdl_tpu.transformers.model import _maybe_mxu_layout
+
+    params = _maybe_mxu_layout(random_llama_params(cfg, qtype="sym_int4"))
     params = attach_lora(params, LoraConfig(r=16, training_mode="qlora"))
     jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
 

@@ -55,9 +55,12 @@ def main() -> None:
 
     class _Model:
         def __init__(self):
-            # merged projections: the shipped from_pretrained default
-            self.params = llama_mod.merge_projections(
-                random_llama_params(cfg, qtype="sym_int4"), cfg)
+            # merged projections + MXU int4 layout: the shipped
+            # from_pretrained defaults
+            from bigdl_tpu.transformers.model import _maybe_mxu_layout
+
+            self.params = _maybe_mxu_layout(llama_mod.merge_projections(
+                random_llama_params(cfg, qtype="sym_int4"), cfg))
             self.config = cfg
             self.hf_config = {"eos_token_id": None}
 
@@ -69,8 +72,11 @@ def main() -> None:
             self.family = Fam()
 
     model = _Model()
-    weight_bytes = sum(a.nbytes
-                       for a in jax.tree_util.tree_leaves(model.params))
+    from bigdl_tpu.ops.quant import QTensor
+
+    weight_bytes = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(
+            model.params, is_leaf=lambda x: isinstance(x, QTensor)))
     eng = LLMEngine(model, EngineConfig(
         max_batch=batch, max_seq=max_seq,
         prefix_cache_entries=0))        # no reuse between identical runs

@@ -235,3 +235,30 @@ def test_adapter_dtype_roundtrip(tmp_path):
     restored = load_adapter(base, str(d))
     assert restored["layers"]["q_proj"].a.dtype == jnp.bfloat16
     assert restored["layers"]["q_proj"].b.dtype == jnp.bfloat16
+
+
+def test_qlora_step_matches_on_mxu_layout():
+    """The int4-dtype MXU layout (the shipped TPU load default) must be
+    training-transparent: identical loss through attach_lora + the
+    frozen-base custom VJP."""
+    import optax
+
+    from bigdl_tpu.models import llama as M
+    from bigdl_tpu.ops.quant import tree_to_mxu_layout
+    from bigdl_tpu.qlora import LoraConfig, attach_lora, lora_trainable_mask
+    from bigdl_tpu.training import make_lora_train_step, partition
+    from bigdl_tpu.utils.testing import TINY_LLAMA, random_llama_params
+
+    batch = {"input_ids": jnp.ones((1, 32), jnp.int32),
+             "attention_mask": jnp.ones((1, 32), jnp.int32)}
+    opt = optax.adamw(1e-4)
+
+    def run(params):
+        p = attach_lora(params, LoraConfig(r=4, training_mode="qlora"))
+        train, frozen = partition(p, lora_trainable_mask(p))
+        step = make_lora_train_step(M.forward_train, TINY_LLAMA, opt)
+        _, _, loss = step(train, opt.init(train), frozen, batch)
+        return float(loss)
+
+    base = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=0)
+    assert abs(run(base) - run(tree_to_mxu_layout(base))) < 1e-5
