@@ -463,3 +463,20 @@ def test_openai_server_stop_strings(model):
         assert stop not in streamed
     finally:
         server.shutdown()
+
+
+def test_engine_matches_plain_generate_mxu_layout(model):
+    """The shipped TPU layout (int4-dtype weights) must be
+    engine-transparent: same outputs as the canonical packing."""
+    from bigdl_tpu.ops.quant import tree_to_mxu_layout
+
+    m2 = FakeModel(tree_to_mxu_layout(model.params), TINY_LLAMA)
+    eng = LLMEngine(m2, EngineConfig(max_batch=2, max_seq=128))
+    prompt = [1, 5, 9, 13]
+    eng.add_request("r", prompt, SamplingParams(max_tokens=12))
+    out = []
+    while not out or not out[-1].finished:
+        eng.step()
+        out.extend(eng.get_outputs("r"))
+    got = [t for o in out for t in o.new_token_ids]
+    assert got == plain_greedy(model.params, prompt, 12)
