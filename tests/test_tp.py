@@ -304,3 +304,22 @@ def test_tp_ff_padding_logits_match(mesh):
     np.testing.assert_allclose(
         np.asarray(lg), np.asarray(ref_lg[:, -1, :]), rtol=2e-2,
         atol=2e-2)
+
+
+def test_tp_logits_match_on_mxu_layout(mesh):
+    """Explicit TP over int4-dtype (MXU layout) weights — the shipped
+    TPU load default — must shard (incl. host-side ff padding of int4
+    planes) and match single-device logits."""
+    from bigdl_tpu.ops.quant import tree_to_mxu_layout
+
+    params = tree_to_mxu_layout(random_llama_params(CFG, qtype="sym_int4",
+                                                    seed=0))
+    prompt = jnp.asarray(np.arange(1, 13, dtype=np.int32)[None])
+    ref_lg, _ = M.forward(params, CFG, prompt, M.new_cache(CFG, 1, 64))
+    with mesh:
+        p_s = shard_params_tp(params, mesh)
+        cache = new_cache_tp(CFG, 1, 64, mesh)
+        lg, _ = tp_forward_step(p_s, CFG, prompt, cache, mesh)
+    np.testing.assert_allclose(
+        np.asarray(lg), np.asarray(ref_lg[:, -1, :]), rtol=2e-2,
+        atol=2e-2)

@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-shot: wait for the 2500-step training process (old in-memory quant
+# code) to export its checkpoint, then kill it before its eval phase and
+# run the eval with the CURRENT (fixed-imatrix-objective) code instead.
+cd "$(dirname "$0")/.." || exit 1
+PID=$1
+while true; do
+  if [ -f acc_ckpt_medium/train_meta.json ] \
+      && grep -q '"steps": 2500' acc_ckpt_medium/train_meta.json 2>/dev/null \
+      && [ -f acc_ckpt_medium/model.safetensors ]; then
+    kill "$PID" 2>/dev/null
+    sleep 3
+    echo "$(date +%H:%M:%S) checkpoint exported; running fixed-objective eval" \
+      >> tpu_runs/acc_handoff.log
+    JAX_PLATFORMS=cpu nohup python -u -m bigdl_tpu.bench.accuracy_eval \
+      --size medium --ckpt-dir acc_ckpt_medium --out ACCURACY_MEDIUM.md \
+      >> tpu_runs/acc_medium_r5_eval.log 2>&1
+    echo "$(date +%H:%M:%S) eval exit=$?" >> tpu_runs/acc_handoff.log
+    exit 0
+  fi
+  if ! kill -0 "$PID" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) training pid $PID gone before export" \
+      >> tpu_runs/acc_handoff.log
+    exit 1
+  fi
+  sleep 60
+done
