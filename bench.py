@@ -452,7 +452,12 @@ def main() -> None:
                           "rejected by the physics floors")
         print(json.dumps(record))
         raise SystemExit(1)
-    best = min(ok, key=lambda k: ok[k]["next_token_ms"])
+    # the HEADLINE is the SHIPPED DEFAULT config when it is valid
+    # (VERDICT r4 #3: no per-phase/per-config best-of as the record);
+    # a faster non-default config is surfaced separately as the signal
+    # to change the default
+    fastest = min(ok, key=lambda k: ok[k]["next_token_ms"])
+    best = "pallas+gemv" if "pallas+gemv" in ok else fastest
     first_ms = ok[best]["first_token_ms"]
     next_ms = ok[best]["next_token_ms"]
 
@@ -463,6 +468,10 @@ def main() -> None:
         first_token_ms=round(first_ms, 3),
         best_config=best,
     )
+    if fastest != best:
+        record["fastest_config"] = fastest
+        record["fastest_next_token_ms"] = round(
+            ok[fastest]["next_token_ms"], 3)
     record.update(_efficiency(LLAMA2_7B, ok[best]["weight_bytes"],
                               PROMPT_LEN, DECODE_STEPS, first_ms, next_ms))
     print(json.dumps(record))

@@ -13,7 +13,7 @@ while true; do
     echo "$(date +%H:%M:%S) checkpoint exported; running fixed-objective eval" \
       >> tpu_runs/acc_handoff.log
     JAX_PLATFORMS=cpu nohup python -u -m bigdl_tpu.bench.accuracy_eval \
-      --size medium --ckpt-dir acc_ckpt_medium --out ACCURACY_MEDIUM.md \
+      --size medium --ckpt-dir acc_ckpt_medium --max-windows 24 --out ACCURACY_MEDIUM.md \
       >> tpu_runs/acc_medium_r5_eval.log 2>&1
     echo "$(date +%H:%M:%S) eval exit=$?" >> tpu_runs/acc_handoff.log
     exit 0
