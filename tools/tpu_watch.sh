@@ -9,7 +9,7 @@ while true; do
   ts=$(date +%Y%m%d_%H%M%S)
   if timeout 90 python -u -c "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
     echo "$ts tunnel ALIVE — running on-chip suite" >> tpu_runs/watch.log
-    timeout 1800 python -u tools/tpu_onchip.py > "tpu_runs/onchip_$ts.log" 2>&1
+    ONCHIP_STEP_TIMEOUT=${ONCHIP_STEP_TIMEOUT:-300} timeout 1500 python -u tools/tpu_onchip.py > "tpu_runs/onchip_$ts.log" 2>&1
     echo "$ts onchip exit=$?" >> tpu_runs/watch.log
       # budget: one BENCH_CONFIG_TIMEOUT_S per A/B config (default read
     # from bench.py so the two never drift)
