@@ -273,3 +273,25 @@ def test_iq2_xs_sign_parity_invariant():
         np.asarray(dequantize(a, jnp.float32)), d0)
     np.testing.assert_array_equal(
         np.asarray(dequantize(b, jnp.float32)), d0)
+
+
+def test_iq_imatrix_objective_scale_invariant():
+    """The magnitude-modulated objective (r5, llama.cpp-matching:
+    w = qw * sqrt(sigma2 + x^2)) must be invariant to the imatrix's
+    overall scale (only RELATIVE importance matters), and must differ
+    from the unweighted encode (the modulation is real)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.ops.quant import quantize
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(512, 64)).astype(np.float32) * 0.1)
+    qw = jnp.asarray(np.abs(rng.normal(size=512)).astype(np.float32) + 0.1)
+    for fmt in ("iq2_xxs", "iq1_s"):
+        a = quantize(x, fmt, qw=qw)
+        b = quantize(x, fmt, qw=qw * 1000.0)
+        np.testing.assert_array_equal(np.asarray(a.data),
+                                      np.asarray(b.data))
+        c = quantize(x, fmt)
+        assert not np.array_equal(np.asarray(a.data), np.asarray(c.data))
