@@ -29,6 +29,25 @@ class Acc:
         self.qtype = qtype
         self.skip = modules_to_not_convert
         self.imatrix = imatrix
+        if imatrix is not None and qtype in (
+                "iq2_xxs", "iq2_xs", "iq1_s",
+                "gguf_iq2_xxs", "gguf_iq2_xs", "gguf_iq1_s"):
+            import logging
+
+            # never SILENTLY degrade (r5): on both in-repo testbeds,
+            # imatrix-weighted encodes of these formats measured WORSE
+            # held-out ppl than unweighted — even after matching
+            # llama.cpp's magnitude-modulated objective (ACCURACY_
+            # MEDIUM.md "imatrix investigation"). Real-model evidence
+            # in the llama.cpp ecosystem says the opposite, so the
+            # imatrix is still applied — but validate with
+            # bench/perplexity.py rather than assuming it helps.
+            logging.getLogger(__name__).warning(
+                "imatrix-weighted %s quantization measured WORSE "
+                "held-out perplexity than unweighted on the in-repo "
+                "testbeds (see ACCURACY_MEDIUM.md); applying it anyway "
+                "(reference behavior) — validate with "
+                "bigdl_tpu.bench.perplexity on your model", qtype)
         self._quantize_linear = quantize_linear
         self.layers: Dict[str, list] = {}
         self.top: Dict[str, Any] = {}
