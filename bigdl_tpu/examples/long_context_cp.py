@@ -1,0 +1,86 @@
+"""Long-context generation with context parallelism (beyond reference).
+
+The reference's long-context story is single-device: FP8 KV cache plus
+32k-tuned model variants (SURVEY.md §5). Here a prompt longer than one
+chip's KV budget shards over an `sp` mesh axis: ring-attention prefill
+(KV chunks ride the ICI ring, peak memory O(S/n) per chip) and the cache
+STAYS sequence-sharded for decode (parallel/cp.py).
+
+    python -m bigdl_tpu.examples.long_context_cp \
+        --repo-id-or-model-path PATH --sp 4 --prompt-file book.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repo-id-or-model-path", required=True)
+    ap.add_argument("--prompt", default=None)
+    ap.add_argument("--prompt-file", default=None,
+                    help="read the (long) prompt from a file")
+    ap.add_argument("--n-predict", type=int, default=64)
+    ap.add_argument("--low-bit", default="sym_int4")
+    ap.add_argument("--sp", type=int, default=4,
+                    help="sequence-parallel ways over the device mesh")
+    args = ap.parse_args(argv)
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.parallel.cp import cp_generate
+    from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+    # CP runs the split-projection decoder body on each shard
+    model = AutoModelForCausalLM.from_pretrained(
+        args.repo_id_or_model_path, load_in_low_bit=args.low_bit,
+        merge_projections=False)
+
+    text = args.prompt
+    if args.prompt_file:
+        text = open(args.prompt_file).read()
+    if text is None:
+        text = "Once upon a time, " * 200   # a long-ish default prompt
+
+    try:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(
+            args.repo_id_or_model_path)
+        ids = tokenizer(text)["input_ids"]
+    except Exception:
+        tokenizer = None
+        ids = list(np.arange(1, 41))   # tokenizer-less checkpoint
+    n = args.sp
+    if len(jax.devices()) < n:
+        raise SystemExit(f"--sp {n} needs {n} devices, have "
+                         f"{len(jax.devices())}")
+    if len(ids) % n:
+        # S must divide over sp: left-pad with BOS/first token rather
+        # than dropping the (most recent) prompt tail
+        pad = [ids[0]] * (n - len(ids) % n)
+        ids = pad + list(ids)
+    mesh = Mesh(np.array(jax.devices()[:n]), ("sp",))
+
+    t0 = time.perf_counter()
+    out = cp_generate(model.params, model.config, ids, mesh,
+                      max_new_tokens=args.n_predict,
+                      eos_token_id=(tokenizer.eos_token_id
+                                    if tokenizer else None))
+    wall = time.perf_counter() - t0
+    new = out[0, len(ids):]
+    print("-" * 20, "Output", "-" * 20)
+    print(tokenizer.decode(new, skip_special_tokens=True)
+          if tokenizer else new.tolist())
+    print("-" * 48)
+    print(f"prompt {len(ids)} tokens sharded over sp={n} | "
+          f"{len(new)} new tokens in {wall:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -148,3 +148,18 @@ def shard_batch(batch: Any, mesh: Mesh, axis: str = "dp") -> Any:
             return jax.device_put(x, NamedSharding(mesh, P()))
         return jax.device_put(x, NamedSharding(mesh, P(axis)))
     return jax.tree.map(put, batch)
+
+
+def shard_moe_params(params: Any, mesh: Mesh, axis: str = "ep") -> Any:
+    """Expert parallelism: shard the expert axis of MoE stacks [L, E, ..]
+    over `axis`, replicating everything else (the reference has no
+    cross-device MoE at all — models/mixtral.py:79-138 loops experts on
+    one device). Every `experts_*` leaf (and its QTensor planes, which
+    keep the [L, E, ...] leading axes) splits on dim 1."""
+    def put(path, x):
+        names = [getattr(p, "name", getattr(p, "key", None)) for p in path]
+        is_exp = any(isinstance(n, str) and n.startswith("experts_")
+                     for n in names)
+        spec = P(None, axis) if is_exp else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map_with_path(put, params)

@@ -98,3 +98,116 @@ def test_pipeline_parallel_example(tiny_hf_llama, capsys):
     finally:
         sys.argv = old
     assert "mean NLL" in capsys.readouterr().out
+
+
+def _run_example(mod, argv):
+    return mod.main(argv)
+
+
+def test_speculative_decode_example(tiny_hf_llama, capsys):
+    from bigdl_tpu.examples import speculative_decode
+
+    assert _run_example(speculative_decode,
+                        ["--repo-id-or-model-path", tiny_hf_llama,
+                         "--n-predict", "8", "--gamma", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "mean accepted/round" in out
+
+
+def test_long_context_cp_example(tiny_hf_llama, capsys):
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from bigdl_tpu.examples import long_context_cp
+
+    assert _run_example(long_context_cp,
+                        ["--repo-id-or-model-path", tiny_hf_llama,
+                         "--sp", "4", "--n-predict", "4"]) == 0
+    assert "sharded over sp=4" in capsys.readouterr().out
+
+
+def test_moe_generate_example(tmp_path_factory, capsys):
+    import jax
+
+    if not hasattr(transformers, "MixtralForCausalLM"):
+        pytest.skip("MixtralForCausalLM not in this transformers build")
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    torch.manual_seed(0)
+    cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=4, num_local_experts=2,
+        num_experts_per_tok=2, max_position_embeddings=128)
+    m = transformers.MixtralForCausalLM(cfg).eval()
+    path = tmp_path_factory.mktemp("eg_moe") / "tiny_mixtral"
+    m.save_pretrained(path)
+
+    from bigdl_tpu.examples import moe_generate
+
+    assert _run_example(moe_generate,
+                        ["--repo-id-or-model-path", str(path),
+                         "--ep", "2", "--n-predict", "4"]) == 0
+    assert capsys.readouterr().out.strip()
+
+
+def test_awq_generate_example(tmp_path, capsys):
+    """Tiny AWQ llama checkpoint -> awq_generate example end to end."""
+    import json
+    import os
+
+    import safetensors.numpy as stnp
+
+    from bigdl_tpu.utils.testing import TINY_LLAMA
+    from tests.test_gptq_awq import make_awq_module
+
+    cfg = TINY_LLAMA
+    rng = np.random.default_rng(5)
+    d, ff, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    hd, h, hkv = cfg.hd, cfg.num_attention_heads, cfg.num_key_value_heads
+    group = 32
+
+    tensors = {
+        "model.embed_tokens.weight":
+            (rng.standard_normal((v, d)) * .02).astype(np.float32),
+        "model.norm.weight": np.ones((d,), np.float32),
+        "lm_head.weight":
+            (rng.standard_normal((v, d)) * .02).astype(np.float32),
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        for nm, (out_d, in_d) in [("self_attn.q_proj", (h * hd, d)),
+                                  ("self_attn.k_proj", (hkv * hd, d)),
+                                  ("self_attn.v_proj", (hkv * hd, d)),
+                                  ("self_attn.o_proj", (d, h * hd)),
+                                  ("mlp.gate_proj", (ff, d)),
+                                  ("mlp.up_proj", (ff, d)),
+                                  ("mlp.down_proj", (d, ff))]:
+            qw, qz, sc, _ = make_awq_module(rng, in_d, out_d, group)
+            tensors[p + nm + ".qweight"] = qw
+            tensors[p + nm + ".qzeros"] = qz
+            tensors[p + nm + ".scales"] = sc
+        tensors[p + "input_layernorm.weight"] = np.ones((d,), np.float32)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(
+            (d,), np.float32)
+
+    mdir = str(tmp_path / "awq")
+    os.makedirs(mdir)
+    stnp.save_file(tensors, os.path.join(mdir, "model.safetensors"))
+    json.dump({
+        "architectures": ["LlamaForCausalLM"], "vocab_size": v,
+        "hidden_size": d, "intermediate_size": ff,
+        "num_hidden_layers": cfg.num_hidden_layers,
+        "num_attention_heads": h, "num_key_value_heads": hkv,
+        "rms_norm_eps": 1e-5, "max_position_embeddings": 256,
+        "quantization_config": {"quant_method": "awq", "bits": 4,
+                                "group_size": group},
+    }, open(os.path.join(mdir, "config.json"), "w"))
+
+    from bigdl_tpu.examples import awq_generate
+
+    assert _run_example(awq_generate,
+                        ["--repo-id-or-model-path", mdir,
+                         "--n-predict", "4"]) == 0
+    assert capsys.readouterr().out.strip()

@@ -19,6 +19,12 @@ import subprocess
 import sys
 import time
 
+# running this file by path puts tools/ (not the repo root) on sys.path,
+# so the package would be unimportable in the per-step subprocesses
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
 STEP_TIMEOUT = int(os.environ.get("ONCHIP_STEP_TIMEOUT", "600"))
 
 
