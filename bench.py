@@ -118,9 +118,19 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     import numpy as np
     from jax import lax
 
+    from bigdl_tpu.config import enable_compilation_cache
     from bigdl_tpu.models import llama as llama_mod
     from bigdl_tpu.utils.testing import (LLAMA2_7B, TINY_LLAMA,
                                          random_llama_params)
+
+    # compiled 7B programs persist across subprocesses AND tunnel windows
+    enable_compilation_cache()
+
+    def phase(msg: str) -> None:
+        # progress breadcrumbs on stderr: a config timeout must say WHERE
+        # it wedged (compile vs first execution vs steady-state timing)
+        print(f"bench-phase[{time.strftime('%H:%M:%S')}]: {msg}",
+              file=sys.stderr, flush=True)
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = LLAMA2_7B if on_tpu else TINY_LLAMA
@@ -128,6 +138,27 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     prompt_len = PROMPT_LEN if on_tpu else 32
     steps = DECODE_STEPS if on_tpu else 8
 
+    from bigdl_tpu.transformers.model import _maybe_mxu_layout
+
+    if on_tpu and os.environ.get("BENCH_CANARY", "1") != "0":
+        # tiny-geometry run under the SAME dispatch flags: if the 7B run
+        # wedges but this passes, the fault is geometry-dependent — the
+        # single most useful bit for off-chip debugging (r4's runtime
+        # death was only ever seen at 7B shapes)
+        phase("canary: tiny-geometry forward under ambient flags")
+        tp = random_llama_params(TINY_LLAMA, qtype=qtype)
+        if merged:
+            tp = llama_mod.merge_projections(tp, TINY_LLAMA)
+        tp = _maybe_mxu_layout(tp)
+        tcache = llama_mod.new_cache(TINY_LLAMA, 1, 64,
+                                     quantized=kv_quantized)
+        tlg, tcache = jax.jit(llama_mod.forward, static_argnums=1)(
+            tp, TINY_LLAMA, jnp.ones((1, 8), jnp.int32), tcache)
+        np.asarray(tlg)
+        phase("canary ok")
+        del tp, tcache, tlg
+
+    phase(f"generating {qtype} params")
     params = random_llama_params(cfg, qtype=qtype)
     if merged:
         # merged QKV + gate/up — the shipped from_pretrained default
@@ -135,10 +166,9 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     # the shipped from_pretrained load-time re-layout (int4-dtype MXU
     # weights) — ONE implementation so bench measures exactly what the
     # loader does
-    from bigdl_tpu.transformers.model import _maybe_mxu_layout
-
     params = _maybe_mxu_layout(params)
     jax.block_until_ready(params)
+    phase("params ready on device")
     tokens = jnp.ones((1, prompt_len), jnp.int32)
 
     prefill = jax.jit(llama_mod.forward_last_token, static_argnums=1,
@@ -169,7 +199,7 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     short, long_ = max(steps // 4, 1), steps
     dec_short, dec_long = make_decode(short), make_decode(long_)
 
-    def run(decode_fn):
+    def run(decode_fn, tag=None):
         cache = llama_mod.new_cache(cfg, 1, max_seq,
                                     quantized=kv_quantized)
         t0 = time.perf_counter()
@@ -177,22 +207,27 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
         tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
         np.asarray(tok)                          # forced readback
         first_ms = (time.perf_counter() - t0) * 1e3
+        if tag:
+            phase(f"{tag}: prefill done ({first_ms:.0f}ms)")
         t1 = time.perf_counter()
         tok, cache = decode_fn(params, tok, cache)
         final = int(np.asarray(tok)[0])          # forced readback
         dec_ms = (time.perf_counter() - t1) * 1e3
+        if tag:
+            phase(f"{tag}: decode done ({dec_ms:.0f}ms)")
         return first_ms, dec_ms, final
 
-    run(dec_short)                   # warmup: compile prefill + short
-    run(dec_long)                    # warmup: compile long
+    run(dec_short, tag="warmup-short")   # warmup: compile prefill + short
+    run(dec_long, tag="warmup-long")     # warmup: compile long
     firsts, shorts, longs, final = [], [], [], 0
-    for _ in range(3):
+    for it in range(3):
         f, dm, final = run(dec_short)
         firsts.append(f)
         shorts.append(dm)
         f, dm, final = run(dec_long)
         firsts.append(f)
         longs.append(dm)
+        phase(f"timing iter {it + 1}/3 done")
     next_ms = (min(longs) - min(shorts)) / (long_ - short)
     if next_ms <= 0:
         # differencing lost to dispatch noise (tiny CPU-fallback model);

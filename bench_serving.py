@@ -39,6 +39,10 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
+    from bigdl_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()   # reuse compiles across windows
+
     import numpy as np
 
     from bigdl_tpu.models import llama as llama_mod

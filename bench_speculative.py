@@ -41,6 +41,10 @@ def main() -> None:
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+
+    from bigdl_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()   # reuse compiles across windows
     import jax.numpy as jnp
     import numpy as np
 

@@ -152,3 +152,30 @@ def set_flags(**kwargs) -> RuntimeFlags:
     f = dataclasses.replace(flags(), **kwargs)
     _flags = f
     return f
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> bool:
+    """Persistent XLA compilation cache (best effort).
+
+    The TPU tunnel gives short live windows; first-compiles of the 7B
+    programs cost 20-40s+ each and were burned anew by every bench
+    subprocess. With the cache on disk, every window after the first
+    skips straight to execution. Returns True when enabled."""
+    import jax
+
+    path = path or os.environ.get(
+        "BIGDL_TPU_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tpu_runs", "xla_cache"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              0)
+        except Exception:
+            pass            # knob renamed across jax versions
+        return True
+    except Exception:
+        return False        # experimental backends may not support it

@@ -355,6 +355,53 @@ def step_model_forward():
     return {"prefill_logits_finite": pre_ok, "decode_logits_finite": dec_ok}
 
 
+def step_model_forward_7b():
+    # THE runtime-death reproducer: full shipped-default llama2-7B program
+    # (merged projections + int4-MXU layout + auto kernel dispatch), short
+    # prefill + 4 decode steps, phase prints between stages so a wedge is
+    # attributable. Gated behind ONCHIP_7B=1 — the watcher runs it AFTER
+    # the benches (a wedge here must not cost the window's other numbers).
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.models import llama as llama_mod
+    from bigdl_tpu.transformers.model import _maybe_mxu_layout
+    from bigdl_tpu.utils.testing import LLAMA2_7B, random_llama_params
+
+    def ph(m):
+        print(f"7b-phase[{_t.strftime('%H:%M:%S')}]: {m}",
+              file=sys.stderr, flush=True)
+
+    cfg = LLAMA2_7B
+    ph("generating params")
+    params = random_llama_params(cfg, qtype="sym_int4")
+    params = llama_mod.merge_projections(params, cfg)
+    params = _maybe_mxu_layout(params)
+    jax.block_until_ready(params)
+    ph("params ready")
+    ids = jnp.ones((1, 128), jnp.int32)
+    cache = llama_mod.new_cache(cfg, 1, 256)
+    fwd = jax.jit(llama_mod.forward, static_argnums=1)
+    logits, cache = fwd(params, cfg, ids, cache)
+    pre_ok = bool(np.isfinite(np.asarray(logits[:, -1], np.float32)).all())
+    ph(f"prefill done (finite={pre_ok})")
+    tok = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+    times = []
+    for i in range(4):
+        t0 = _t.perf_counter()
+        logits, cache = fwd(params, cfg, tok, cache)
+        tok = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+        final = int(np.asarray(tok)[0, 0])
+        times.append((_t.perf_counter() - t0) * 1e3)
+        ph(f"decode step {i} done ({times[-1]:.0f}ms, tok={final})")
+    dec_ok = bool(np.isfinite(np.asarray(logits[:, -1], np.float32)).all())
+    return {"prefill_logits_finite": pre_ok, "decode_logits_finite": dec_ok,
+            "decode_step_ms": [round(t, 1) for t in times]}
+
+
 STEPS = {
     "sanity": step_sanity,
     "qmatmul_decode": step_qmatmul_decode,
@@ -365,6 +412,8 @@ STEPS = {
     "moe": step_moe,
     "model_forward": step_model_forward,
 }
+if os.environ.get("ONCHIP_7B", "").lower() not in ("", "0", "false", "off"):
+    STEPS["model_forward_7b"] = step_model_forward_7b
 
 
 def main():
@@ -372,6 +421,9 @@ def main():
         name = sys.argv[2]
         t0 = time.time()
         try:
+            from bigdl_tpu.config import enable_compilation_cache
+
+            enable_compilation_cache()   # reuse compiles across windows
             result = STEPS[name]()
             print(json.dumps({"step": name, "ok": True,
                               "elapsed_s": round(time.time() - t0, 2),
@@ -383,8 +435,17 @@ def main():
         return
 
     os.makedirs("tpu_runs", exist_ok=True)
+    only = [s for s in os.environ.get("ONCHIP_ONLY", "").split(",") if s]
+    unknown = [s for s in only if s not in STEPS]
+    if unknown:
+        print(json.dumps({"step": "_config", "ok": False,
+                          "error": f"ONCHIP_ONLY names not registered: "
+                                   f"{unknown} (known: {list(STEPS)})"}))
+        sys.exit(2)
     results = []
     for name in STEPS:
+        if only and name not in only:
+            continue
         t0 = time.time()
         try:
             proc = subprocess.run(

@@ -23,6 +23,12 @@ while true; do
     echo "$ts bench_serving exit=$?" >> tpu_runs/watch.log
     timeout 1800 python -u bench_speculative.py > "tpu_runs/spec_$ts.json" 2> "tpu_runs/spec_$ts.log"
     echo "$ts bench_speculative exit=$?" >> tpu_runs/watch.log
+    # LAST: the 7B runtime-death reproducer — isolated, phase-printing;
+    # a wedge here costs nothing (every other number is already on disk)
+    ONCHIP_7B=1 ONCHIP_ONLY=model_forward_7b ONCHIP_STEP_TIMEOUT=900 \
+      timeout 1000 python -u tools/tpu_onchip.py \
+      > "tpu_runs/onchip7b_$ts.log" 2>&1
+    echo "$ts onchip7b exit=$?" >> tpu_runs/watch.log
     sleep 60
   else
     echo "$ts tunnel dead" >> tpu_runs/watch.log
