@@ -335,6 +335,37 @@ def _latest_valid_onchip_record() -> dict | None:
     return best_rec
 
 
+def _ordered_configs(run_dir: str) -> list:
+    """AB_CONFIGS, with configs that timed out / errored in the most
+    recent partial record demoted to the END of the order.
+
+    The 08:03 window lesson: a wedge-prone config at the front of the
+    order costs the whole window (the tunnel dies with it). Demotion
+    self-heals the ordering across windows — a repeat offender still
+    runs, but only after every healthy config has its number on disk."""
+    import glob
+
+    parts = sorted(glob.glob(os.path.join(run_dir, "bench_partial_*.jsonl")))
+    bad: set = set()
+    if parts:
+        try:
+            with open(parts[-1]) as f:
+                for ln in f:
+                    rec = json.loads(ln)
+                    if "error" in rec:
+                        bad.add(rec.get("config"))
+        except (OSError, json.JSONDecodeError):
+            pass
+    if not bad:
+        return list(AB_CONFIGS)
+    healthy = [c for c in AB_CONFIGS if c[0] not in bad]
+    demoted = [c for c in AB_CONFIGS if c[0] in bad]
+    print(f"bench: demoting {[c[0] for c in demoted]} (failed last "
+          f"window) behind {len(healthy)} healthy configs",
+          file=sys.stderr)
+    return healthy + demoted
+
+
 def main() -> None:
     # probe BEFORE importing jax here: a wedged TPU tunnel would hang this
     # process with no recourse (import-time probing would tax every
@@ -402,13 +433,14 @@ def main() -> None:
 
     # persist every completed config immediately: a tunnel death mid-A/B
     # must not cost the results already measured
+    run_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tpu_runs")
     partial_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "tpu_runs",
-        time.strftime("bench_partial_%Y%m%d_%H%M%S.jsonl"))
-    os.makedirs(os.path.dirname(partial_path), exist_ok=True)
+        run_dir, time.strftime("bench_partial_%Y%m%d_%H%M%S.jsonl"))
+    os.makedirs(run_dir, exist_ok=True)
 
     ab_results = {}
-    for label, _ in AB_CONFIGS:
+    for label, _ in _ordered_configs(run_dir):
         t0 = time.time()
         try:
             proc = subprocess.run(

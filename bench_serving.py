@@ -54,7 +54,6 @@ def main() -> None:
     cfg = LLAMA2_7B if on_tpu else TINY_LLAMA
     batch = 8
     prompt_len, new_tokens = (128, 64) if on_tpu else (16, 8)
-    n_requests = 3 * batch
     max_seq = 512 if on_tpu else 64
 
     class _Model:
@@ -81,45 +80,51 @@ def main() -> None:
     weight_bytes = sum(
         leaf.nbytes for leaf in jax.tree_util.tree_leaves(
             model.params, is_leaf=lambda x: isinstance(x, QTensor)))
-    eng = LLMEngine(model, EngineConfig(
-        max_batch=batch, max_seq=max_seq,
-        prefix_cache_entries=0))        # no reuse between identical runs
-    rng = np.random.default_rng(0)
-    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
-               for _ in range(n_requests)]
-    # mixed real-world traffic: half greedy, half sampled (device path)
-    params_of = [
-        SamplingParams(max_tokens=new_tokens) if i % 2 == 0 else
-        SamplingParams(max_tokens=new_tokens, temperature=0.8, top_k=32,
-                       seed=i)
-        for i in range(n_requests)]
+    def run_wave(b: int) -> tuple:
+        """(tokens/s, done, generated, wall_s, n_req) at max_batch=b."""
+        n_req = 3 * b
+        eng = LLMEngine(model, EngineConfig(
+            max_batch=b, max_seq=max_seq,
+            prefix_cache_entries=0))    # no reuse between identical runs
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+                   for _ in range(n_req)]
+        # mixed real-world traffic: half greedy, half sampled (device)
+        params_of = [
+            SamplingParams(max_tokens=new_tokens) if i % 2 == 0 else
+            SamplingParams(max_tokens=new_tokens, temperature=0.8,
+                           top_k=32, seed=i)
+            for i in range(n_req)]
 
-    # warmup wave compiles prefill buckets, decode, the batched device
-    # sampler ([B, V] shape — needs one sampled request in the wave;
-    # all-greedy would take the argmax fast path and leave the gumbel
-    # kernel to compile inside the timed window), and the host sampler
-    eng.generate(prompts[:batch],
-                 SamplingParams(max_tokens=4, temperature=0.8, top_k=32,
-                                seed=0))
-    # ...and the all-greedy argmax fast path: when a wave tail drains to
-    # only greedy slots mid-window, that compile must already be cached
-    eng.generate(prompts[:2], SamplingParams(max_tokens=4))
+        # warmup wave compiles prefill buckets, decode, the batched
+        # device sampler ([B, V] shape — needs one sampled request in
+        # the wave; all-greedy would take the argmax fast path and leave
+        # the gumbel kernel to compile inside the timed window)
+        eng.generate(prompts[:b],
+                     SamplingParams(max_tokens=4, temperature=0.8,
+                                    top_k=32, seed=0))
+        # ...and the all-greedy argmax fast path: when a wave tail
+        # drains to only greedy slots mid-window, that compile must
+        # already be cached
+        eng.generate(prompts[:2], SamplingParams(max_tokens=4))
 
-    t0 = time.perf_counter()
-    for i, (p, sp) in enumerate(zip(prompts, params_of)):
-        eng.add_request(f"r{i}", p, sp)
-    done = 0
-    generated = 0
-    deadline = time.perf_counter() + 1800
-    while done < n_requests and time.perf_counter() < deadline:
-        if not eng.step():
-            time.sleep(0.001)
-        for i in range(n_requests):
-            for out in eng.get_outputs(f"r{i}"):
-                generated += len(out.new_token_ids)
-                done += out.finished
-    wall = time.perf_counter() - t0
-    tput = generated / wall
+        t0 = time.perf_counter()
+        for i, (p, sp) in enumerate(zip(prompts, params_of)):
+            eng.add_request(f"r{i}", p, sp)
+        done = 0
+        generated = 0
+        deadline = time.perf_counter() + 1200
+        while done < n_req and time.perf_counter() < deadline:
+            if not eng.step():
+                time.sleep(0.001)
+            for i in range(n_req):
+                for out in eng.get_outputs(f"r{i}"):
+                    generated += len(out.new_token_ids)
+                    done += out.finished
+        wall = time.perf_counter() - t0
+        return generated / wall, done, generated, wall, n_req
+
+    tput, done, generated, wall, n_requests = run_wave(batch)
 
     peak_tflops, peak_gbps = chip_peaks()
     ceiling = batch / (weight_bytes / (peak_gbps * 1e9))
@@ -153,6 +158,26 @@ def main() -> None:
         out["note"] = (f"deadline expired with {done}/{n_requests} "
                        "requests complete — run was real but too slow "
                        "(or the tunnel wedged mid-run)")
+    if poisoned or timed_out or not on_tpu:
+        print(json.dumps(out))
+        return
+
+    # the batch-8 record is already measured — put it on disk BEFORE the
+    # batch-16 wave (a tunnel wedge mid-wave must not cost it); consumers
+    # read the LAST line, so the combined record below supersedes this
+    print(json.dumps(out), flush=True)
+
+    # batch-16 wave (VERDICT r4 #4 asks 8 AND 16): decode still reads
+    # the weights once per step, so throughput should climb toward 2x —
+    # KV at 16 x 512 x 0.5 MB/tok = 4 GB still fits
+    t16, d16, g16, w16, n16 = run_wave(16)
+    c16 = ceiling / batch * 16
+    out["batch16"] = {
+        "tokens_per_s": round(t16, 1), "completed": int(d16),
+        "generated_tokens": int(g16), "wall_s": round(w16, 2),
+        "n_requests": n16, "tokens_per_s_ceiling": round(c16, 1),
+        "valid": bool(d16 == n16 and t16 <= c16 / 0.8),
+    }
     print(json.dumps(out))
 
 

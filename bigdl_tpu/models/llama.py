@@ -98,6 +98,13 @@ class LlamaConfig:
     parallel_residual: bool = False     # x + attn(n1(x)) + mlp(n2(x))
     shared_input_norm: bool = False     # phi/falcon-7b: mlp reuses n1(x)
     use_alibi: bool = False             # bloom/baichuan-13b
+    # explicit TP (parallel/tp.py) traces the decoder with LOCAL head
+    # counts; ALiBi slopes are a function of the FULL head count, so the
+    # local trace slices alibi_slopes(alibi_total_heads) at
+    # axis_index(tp_axis) * local_heads instead of regenerating a
+    # (different) schedule for the local count
+    alibi_total_heads: Optional[int] = None
+    tp_axis: str = "tp"
     embed_scale: float = 1.0            # gemma: sqrt(hidden_size)
     embed_norm: bool = False            # bloom: LN right after embedding
     logits_soft_cap: Optional[float] = None   # gemma2 final logits
@@ -316,6 +323,23 @@ def alibi_slopes(n_heads: int) -> np.ndarray:
     base = pow2_slopes(closest)
     extra = pow2_slopes(2 * closest)[0::2][: n_heads - closest]
     return np.concatenate([base, extra]).astype(np.float32)
+
+
+def _model_slopes(cfg: "LlamaConfig") -> Optional[jax.Array]:
+    """Per-head ALiBi slopes for THIS trace's head count.
+
+    Single device: the full schedule. Under explicit TP (parallel/tp.py)
+    cfg carries local head counts but slopes are a function of the FULL
+    count — slice the full schedule at this device's head offset."""
+    if not cfg.use_alibi:
+        return None
+    total = cfg.alibi_total_heads or cfg.num_attention_heads
+    full = jnp.asarray(alibi_slopes(total))
+    if total == cfg.num_attention_heads:
+        return full
+    idx = lax.axis_index(cfg.tp_axis)
+    return lax.dynamic_slice(full, (idx * cfg.num_attention_heads,),
+                             (cfg.num_attention_heads,))
 
 
 def _norm(x, w, b, cfg: LlamaConfig):
@@ -680,8 +704,7 @@ def forward(
                       vemb[jnp.clip(vidx - 1, 0)].astype(x.dtype), x)
     if rope_mscale != 1.0:             # yarn attention temperature
         cos, sin = cos * rope_mscale, sin * rope_mscale
-    slopes = (jnp.asarray(alibi_slopes(cfg.num_attention_heads))
-              if cfg.use_alibi else None)
+    slopes = _model_slopes(cfg)
 
     lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
     (x, ck, cv, _, _, _), _ = lax.scan(
@@ -782,8 +805,7 @@ def forward_train(
 
     h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
 
-    slopes = (jnp.asarray(alibi_slopes(cfg.num_attention_heads))
-              if cfg.use_alibi else None)
+    slopes = _model_slopes(cfg)
 
     if attn_fn is not None:
         if (cfg.use_alibi or cfg.attn_soft_cap is not None

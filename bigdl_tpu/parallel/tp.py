@@ -20,9 +20,10 @@ DeepSpeed-AutoTP's explicit sharding (reference transformers/convert.py:
 Families: everything the generalized decoder serves (r4 — the local
 body IS `M.forward` with collective-injecting weight wrappers, so
 parallel-residual, shared-input-norm, non-gated-MLP, sliding-window and
-soft-cap families all work), except MoE expert stacks (shard over ep
-instead) and ALiBi (per-shard slope slices not implemented). Embeddings
-and norms are replicated (as in the reference's AutoTP).
+soft-cap families all work) including (r5) MoE expert stacks and ALiBi
+families (each device slices the full-model slope schedule at its head
+offset). Embeddings and norms are replicated (as in the reference's
+AutoTP).
 """
 
 from __future__ import annotations
@@ -50,7 +51,7 @@ except ImportError:                        # older jax
     _REP_KW = {"check_rep": False}
 
 
-def _tp_cfg(cfg, n: int):
+def _tp_cfg(cfg, n: int, axis: str = "tp"):
     # r4: the local body is the REAL generalized decoder (M.forward with
     # collective-injecting weight wrappers), so every family knob it
     # supports — parallel residual, shared input norm, non-gated MLP,
@@ -64,11 +65,6 @@ def _tp_cfg(cfg, n: int):
             f"tp={n}: expert stacks are not lane-padded (pad_ff_for_tp "
             "covers dense MLPs only); use a dividing tp, the ep axis "
             "(models/mixtral.py), or the GSPMD path")
-    if cfg.use_alibi:
-        raise NotImplementedError(
-            "alibi families need per-shard slope slices (head-sharded "
-            "slopes are not the slopes of the local head count); use the "
-            "GSPMD path (parallel/sharding.py)")
     if cfg.num_attention_heads % n or cfg.num_key_value_heads % n:
         raise ValueError(
             f"heads ({cfg.num_attention_heads}/{cfg.num_key_value_heads}) "
@@ -89,7 +85,13 @@ def _tp_cfg(cfg, n: int):
         # the weights, this field is only a bookkeeping hint
         intermediate_size=cfg.intermediate_size // n
         if cfg.intermediate_size % n == 0 else cfg.intermediate_size,
-        head_dim=cfg.hd)   # pin: hd otherwise derives from FULL heads
+        head_dim=cfg.hd,   # pin: hd otherwise derives from FULL heads
+        # ALiBi slopes are a function of the FULL head count; the local
+        # trace slices the full schedule at its axis_index (llama.py
+        # _model_slopes)
+        alibi_total_heads=(cfg.num_attention_heads
+                           if cfg.use_alibi else None),
+        tp_axis=axis)
 
 
 def tp_param_specs(params: Any, mesh: Mesh, axis: str = "tp") -> Any:
@@ -257,7 +259,7 @@ def tp_cache_specs(axis: str = "tp") -> P:
 
 def new_cache_tp(cfg, batch: int, max_seq: int, mesh: Mesh,
                  quantized: bool = False, axis: str = "tp") -> KVCache:
-    _tp_cfg(cfg, mesh.shape[axis])      # fail fast with a clear message
+    _tp_cfg(cfg, mesh.shape[axis], axis)  # fail fast, clear message
     cache = M.new_cache(cfg, batch, max_seq, quantized=quantized)
     sh = NamedSharding(mesh, tp_cache_specs(axis))
     return KVCache(jax.device_put(cache.k, sh),
@@ -381,7 +383,7 @@ def _local_forward(cfg_l, axis: str, true_vocab: int):
 @functools.lru_cache(maxsize=32)
 def _tp_fn(cfg, mesh, axis):
     n = mesh.shape[axis]
-    cfg_l = _tp_cfg(cfg, n)
+    cfg_l = _tp_cfg(cfg, n, axis)
     fwd = _local_forward(cfg_l, axis, cfg.vocab_size)
 
     # param specs must match how shard_params_tp laid them out; the spec
