@@ -21,6 +21,8 @@ def main() -> int:
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=2048)
+    ap.add_argument("--embedder", default=None,
+                    help="BERT checkpoint for /v1/embeddings")
     args = ap.parse_args()
 
     from bigdl_tpu.serving import EngineConfig, LLMEngine
@@ -40,7 +42,17 @@ def main() -> int:
         print("no tokenizer found: requests must pass token-id prompts")
     engine = LLMEngine(model, EngineConfig(max_batch=args.max_batch,
                                            max_seq=args.max_seq))
-    server = OpenAIServer(engine, tokenizer=tokenizer)
+    embedder = embedder_tok = None
+    if args.embedder:
+        from transformers import AutoTokenizer
+
+        from bigdl_tpu.transformers.embedder import BertEmbedder
+
+        embedder = BertEmbedder.from_pretrained(args.embedder)
+        embedder_tok = AutoTokenizer.from_pretrained(args.embedder)
+    server = OpenAIServer(engine, tokenizer=tokenizer,
+                          embedder=embedder,
+                          embedder_tokenizer=embedder_tok)
     print(f"serving on http://0.0.0.0:{args.port}/v1 "
           f"(max_batch={args.max_batch})")
     server.serve(host="0.0.0.0", port=args.port)

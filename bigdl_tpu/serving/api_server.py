@@ -8,7 +8,8 @@ the stdlib ThreadingHTTPServer so it runs with zero extra dependencies
 agnostic regardless).
 
 Endpoints: GET /v1/models, POST /v1/completions, POST /v1/chat/completions
-(stream=true -> text/event-stream chunks, OpenAI wire format).
+(stream=true -> text/event-stream chunks, OpenAI wire format), and
+POST /v1/embeddings when constructed with an embedder (BertEmbedder).
 
 Tokenization: pass a HF tokenizer (transformers.AutoTokenizer) at
 construction; prompts may also be raw token-id lists, in which case
@@ -119,10 +120,17 @@ class _IncrementalDetok:
 
 class OpenAIServer:
     def __init__(self, engine: LLMEngine, tokenizer=None,
-                 model_name: str = "bigdl-tpu-model"):
+                 model_name: str = "bigdl-tpu-model",
+                 embedder=None, embedder_tokenizer=None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
+        # optional /v1/embeddings backend: a BertEmbedder (transformers/
+        # embedder.py) served next to the LLM — the reference serves
+        # embeddings through its langchain wrapper and FastChat worker;
+        # here they ride the same OpenAI-compatible server
+        self.embedder = embedder
+        self.embedder_tokenizer = embedder_tokenizer
         self.loop = _EngineLoop(engine)
         self._httpd: Optional[ThreadingHTTPServer] = None
 
@@ -338,9 +346,40 @@ class OpenAIServer:
                         return self._completions(body, chat=False)
                     if self.path == "/v1/chat/completions":
                         return self._completions(body, chat=True)
+                    if self.path == "/v1/embeddings":
+                        return self._embeddings(body)
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
                 self._json(404, {"error": "not found"})
+
+            def _embeddings(self, body: dict):
+                if server.embedder is None or \
+                        server.embedder_tokenizer is None:
+                    return self._json(
+                        400, {"error": "no embedding model configured "
+                              "(construct OpenAIServer with embedder= "
+                              "and embedder_tokenizer=)"})
+                inputs = body.get("input")
+                if isinstance(inputs, str):
+                    inputs = [inputs]
+                if not isinstance(inputs, list) or not inputs or \
+                        not all(isinstance(t, str) for t in inputs):
+                    return self._json(
+                        400, {"error": "'input' must be a string or a "
+                              "non-empty list of strings"})
+                vecs, n_tok = server.embedder.embed_texts(
+                    inputs, server.embedder_tokenizer,
+                    with_counts=True)
+                self._json(200, {
+                    "object": "list",
+                    "model": body.get("model", server.model_name),
+                    "data": [
+                        {"object": "embedding", "index": i,
+                         "embedding": [float(x) for x in v]}
+                        for i, v in enumerate(vecs)],
+                    "usage": {"prompt_tokens": int(n_tok),
+                              "total_tokens": int(n_tok)},
+                })
 
             def _completions(self, body: dict, chat: bool):
                 if chat:
@@ -460,6 +499,8 @@ def main():
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=2048)
+    ap.add_argument("--embedder", default=None,
+                    help="BERT checkpoint for /v1/embeddings")
     args = ap.parse_args()
 
     model = AutoModelForCausalLM.from_pretrained(
@@ -477,7 +518,16 @@ def main():
 
     engine = LLMEngine(model, EngineConfig(max_batch=args.max_batch,
                                            max_seq=args.max_seq))
-    server = OpenAIServer(engine, tokenizer)
+    embedder = embedder_tok = None
+    if args.embedder:
+        from transformers import AutoTokenizer
+
+        from bigdl_tpu.transformers.embedder import BertEmbedder
+
+        embedder = BertEmbedder.from_pretrained(args.embedder)
+        embedder_tok = AutoTokenizer.from_pretrained(args.embedder)
+    server = OpenAIServer(engine, tokenizer, embedder=embedder,
+                          embedder_tokenizer=embedder_tok)
     print(f"serving on http://{args.host}:{args.port}/v1")
     server.serve(args.host, args.port)
 

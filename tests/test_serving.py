@@ -5,6 +5,7 @@ server end-to-end over a real socket."""
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import jax.numpy as jnp
@@ -480,3 +481,89 @@ def test_engine_matches_plain_generate_mxu_layout(model):
         out.extend(eng.get_outputs("r"))
     got = [t for o in out for t in o.new_token_ids]
     assert got == plain_greedy(model.params, prompt, 12)
+
+
+def test_openai_server_embeddings(model, tmp_path):
+    """POST /v1/embeddings over a real (tiny) BERT next to the LLM."""
+    torch = pytest.importorskip("torch")
+    from transformers import (AutoTokenizer, BertConfig, BertModel,
+                              BertTokenizerFast)
+
+    torch.manual_seed(0)
+    d = str(tmp_path / "bert")
+    BertModel(BertConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64)).eval().save_pretrained(d)
+    vocab = str(tmp_path / "vocab.txt")
+    with open(vocab, "w") as f:
+        f.write("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello",
+                           "world"] + [f"tok{i}" for i in range(114)]))
+    BertTokenizerFast(vocab_file=vocab).save_pretrained(d)
+
+    from bigdl_tpu.serving.api_server import OpenAIServer
+    from bigdl_tpu.transformers.embedder import BertEmbedder
+
+    eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128))
+    server = OpenAIServer(
+        eng, embedder=BertEmbedder.from_pretrained(d),
+        embedder_tokenizer=AutoTokenizer.from_pretrained(d))
+    httpd = server.serve(port=0, background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            f"{base}/v1/embeddings",
+            data=json.dumps({"input": ["hello world", "hello"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            data = json.loads(r.read())
+        assert data["object"] == "list" and len(data["data"]) == 2
+        assert len(data["data"][0]["embedding"]) == 32
+        assert data["usage"]["total_tokens"] > 0
+
+        # single-string input returns the same vector as the batch
+        req = urllib.request.Request(
+            f"{base}/v1/embeddings",
+            data=json.dumps({"input": "hello world"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            one = json.loads(r.read())
+        np.testing.assert_allclose(one["data"][0]["embedding"],
+                                   data["data"][0]["embedding"],
+                                   rtol=1e-5)
+
+        # bad input shape -> 400
+        req = urllib.request.Request(
+            f"{base}/v1/embeddings",
+            data=json.dumps({"input": []}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.shutdown()
+
+
+def test_openai_server_embeddings_unconfigured(model):
+    """Without an embedder the endpoint must 400 with a clear message."""
+    from bigdl_tpu.serving.api_server import OpenAIServer
+
+    eng = LLMEngine(model, EngineConfig(max_batch=2, max_seq=128))
+    server = OpenAIServer(eng)
+    httpd = server.serve(port=0, background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            f"{base}/v1/embeddings",
+            data=json.dumps({"input": "x"}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "embedding model" in json.loads(e.read())["error"]
+    finally:
+        server.shutdown()
