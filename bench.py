@@ -61,6 +61,8 @@ PROMPT_LEN = 1024
 DECODE_STEPS = 64
 MAX_SEQ = 2048
 CONFIG_TIMEOUT_S = int(os.environ.get("BENCH_CONFIG_TIMEOUT_S", "900"))
+RUN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "tpu_runs")
 
 # (label, flag overrides) — the dispatch configurations to A/B on TPU.
 # "pallas+gemv" is the shipped default: Pallas kernels at decode-class M,
@@ -304,7 +306,7 @@ def _one_config(label: str) -> None:
                                   merged=merged)))
 
 
-def _latest_valid_onchip_record() -> dict | None:
+def _latest_valid_onchip_record(run_dir: str | None = None) -> dict | None:
     """Newest tpu_runs/bench_*.json whose record says valid:true.
 
     VERDICT r3 #8: when the tunnel is down at round end, BENCH_r*.json
@@ -313,10 +315,10 @@ def _latest_valid_onchip_record() -> dict | None:
     benchmark output always carries the last real silicon evidence."""
     import glob
 
+    if run_dir is None:
+        run_dir = RUN_DIR
     best_name, best_rec = None, None
-    for path in sorted(glob.glob(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "tpu_runs", "bench_*.json"))):
+    for path in sorted(glob.glob(os.path.join(run_dir, "bench_*.json"))):
         try:
             with open(path) as f:
                 rec = json.loads(f.read().strip().splitlines()[-1])
@@ -438,8 +440,7 @@ def main() -> None:
 
     # persist every completed config immediately: a tunnel death mid-A/B
     # must not cost the results already measured
-    run_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "tpu_runs")
+    run_dir = RUN_DIR
     partial_path = os.path.join(
         run_dir, time.strftime("bench_partial_%Y%m%d_%H%M%S.jsonl"))
     os.makedirs(run_dir, exist_ok=True)

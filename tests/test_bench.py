@@ -299,3 +299,65 @@ def test_run_matrix_rejects_unknown_api(tmp_path):
 
     with pytest.raises(ValueError, match="unknown test_api"):
         run_one("x", "sym_int4", 8, 4, "cuda_fp16", 1, 0)
+
+
+def test_adaptive_config_ordering(tmp_path):
+    """Configs that failed in the most recent window run LAST; healthy
+    orderings are untouched; cached records never win the cache scan."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    run_dir = str(tmp_path)
+    # no partials: canonical order
+    assert bench._ordered_configs(run_dir) == list(bench.AB_CONFIGS)
+
+    # newest partial says the first config timed out -> demoted to last
+    first = bench.AB_CONFIGS[0][0]
+    with open(os.path.join(run_dir, "bench_partial_20990101_000000.jsonl"),
+              "w") as f:
+        f.write(json.dumps({"config": first, "error": "timeout 900s"})
+                + "\n")
+        f.write(json.dumps({"config": bench.AB_CONFIGS[1][0],
+                            "next_token_ms": 12.0}) + "\n")
+    order = bench._ordered_configs(run_dir)
+    assert order[-1][0] == first
+    assert [c[0] for c in order[:-1]] == [
+        c[0] for c in bench.AB_CONFIGS if c[0] != first]
+
+    # an OLDER partial with different failures is ignored (newest wins)
+    with open(os.path.join(run_dir, "bench_partial_19990101_000000.jsonl"),
+              "w") as f:
+        f.write(json.dumps({"config": bench.AB_CONFIGS[2][0],
+                            "error": "x"}) + "\n")
+    assert bench._ordered_configs(run_dir)[-1][0] == first
+
+
+def test_cached_record_scan_skips_re_emissions(tmp_path):
+    """A cached re-emission written back into tpu_runs/ must not become
+    'the newest valid record' (provenance would chain through copies)."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    rec = {"metric": "llama2_7b_int4_next_token_latency", "value": 30.0,
+           "unit": "ms", "valid": True, "backend": "tpu"}
+    run_dir = tmp_path / "tpu_runs"
+    run_dir.mkdir()
+    with open(run_dir / "bench_20250101_000000.json", "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    # a LATER file that is itself a cached emission
+    with open(run_dir / "bench_20260101_000000.json", "w") as f:
+        f.write(json.dumps({**rec, "value": 99.0, "cached": True,
+                            "cached_from": "x"}) + "\n")
+    got = bench._latest_valid_onchip_record(str(run_dir))
+    assert got["value"] == 30.0
+    assert got["cached_from"] == "bench_20250101_000000.json"

@@ -118,17 +118,35 @@ def _qmat_case(qtype: str, m: int, k: int, n: int):
             "speedup": t_xla / t_pal}
 
 
+def _persist_case(step: str, case: dict) -> None:
+    """Append one completed case to tpu_runs/ immediately: a multi-case
+    step killed by the step timeout (or a tunnel wedge) must not cost
+    the cases already measured."""
+    try:
+        os.makedirs("tpu_runs", exist_ok=True)
+        with open(f"tpu_runs/onchip_cases_{step}.jsonl", "a") as f:
+            f.write(json.dumps(
+                {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), **case}) + "\n")
+    except OSError:
+        pass
+
+
 def step_qmatmul_decode():
     out = []
     for qt in ["sym_int4", "asym_int4", "nf4", "fp4", "sym_int8"]:
         out.append(_qmat_case(qt, 1, 4096, 4096))
+        _persist_case("qmatmul_decode", out[-1])
     return {"cases": out}
 
 
 def step_qmatmul_prefill():
-    return {"cases": [_qmat_case("sym_int4", 512, 4096, 4096),
-                      _qmat_case("sym_int4", 512, 4096, 11008),
-                      _qmat_case("nf4", 512, 4096, 4096)]}
+    out = []
+    for qt, m, k, n in [("sym_int4", 512, 4096, 4096),
+                        ("sym_int4", 512, 4096, 11008),
+                        ("nf4", 512, 4096, 4096)]:
+        out.append(_qmat_case(qt, m, k, n))
+        _persist_case("qmatmul_prefill", out[-1])
+    return {"cases": out}
 
 
 def step_gemv():
@@ -208,6 +226,7 @@ def step_gemv():
                     "gemv_ms": t * 1e3,
                     "gbps": bytes_moved / max(t, 1e-9) / 1e9,
                     "probe_ok": probe})
+        _persist_case("gemv", out[-1])
     return {"cases": out}
 
 
@@ -249,6 +268,7 @@ def step_decode_attention():
                     "kv_dtype": kvdt, "max_abs_err": err,
                     "pallas_ms": t_pal * 1e3, "xla_ms": t_xla * 1e3,
                     "speedup": t_xla / t_pal})
+        _persist_case("decode_attention", out[-1])
     return {"cases": out}
 
 
@@ -292,6 +312,7 @@ def step_prefill_attention():
                     "max_abs_err": err, "grad_finite": grad_finite,
                     "pallas_ms": t_pal * 1e3, "xla_ms": t_xla * 1e3,
                     "speedup": t_xla / t_pal})
+        _persist_case("prefill_attention", out[-1])
     return {"cases": out}
 
 
