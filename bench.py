@@ -446,9 +446,12 @@ def main() -> None:
     os.makedirs(run_dir, exist_ok=True)
 
     # total wall budget: the driver runs bench.py once at round end with
-    # finite patience — when the budget runs out, emit the record from
-    # what's measured rather than risk producing nothing
-    budget_s = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "4500"))
+    # finite patience (unknown, plausibly ~1h) — when the budget runs
+    # out, emit the record from what's measured rather than risk being
+    # killed mid-config with no final line. 3000s leaves 10 min of
+    # margin inside a 1-hour cap; the watcher overrides it upward for
+    # its own unsupervised runs.
+    budget_s = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "3000"))
     t_start = time.time()
 
     ab_results = {}

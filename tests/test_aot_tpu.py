@@ -297,25 +297,43 @@ def test_llama7b_decode_compiles(v5e, aot_flags):
     assert ma.argument_size_in_bytes < 8e9
 
 
+@pytest.mark.parametrize("mxu", [False, True], ids=["canonical", "mxu"])
 @pytest.mark.parametrize("sq", [1, 1024])
-def test_llama7b_merged_projections_compile(v5e, aot_flags, sq):
-    """Merged-QKV + merged-gate-up layout (the from_pretrained default):
-    decode must still dispatch Mosaic kernels at the fused shapes
-    (N=12288 qkv, N=22016 gate_up), prefill must compile clean."""
+def test_llama7b_merged_projections_compile(v5e, aot_flags, sq, mxu):
+    """Merged-QKV + merged-gate-up layout, canonical AND int4-dtype MXU
+    weight re-layout (the full from_pretrained default): decode must
+    still dispatch Mosaic kernels at the fused shapes (N=12288 qkv,
+    N=22016 gate_up), prefill must compile clean. The mxu case is the
+    whole-model superset of test_dequant_gemv_mxu_compiles — int4
+    arrays through the lax.scan layer stack and the M-routed dispatch —
+    i.e. the exact program the 08:03 live window timed out on."""
     from bigdl_tpu.models import llama as M
+    from bigdl_tpu.transformers.model import _maybe_mxu_layout
     from bigdl_tpu.utils.testing import LLAMA2_7B, random_llama_params
 
     dev = v5e.devices[0]
     cfg = LLAMA2_7B
-    params = _sds(jax.eval_shape(
-        lambda: M.merge_projections(
-            random_llama_params(cfg, "sym_int4"), cfg)), dev)
+    set_flags(mxu_layout="on" if mxu else "off")   # pin: no ambient env
+    try:
+        params = _sds(jax.eval_shape(
+            lambda: _maybe_mxu_layout(M.merge_projections(
+                random_llama_params(cfg, "sym_int4"), cfg))), dev)
+    finally:
+        set_flags(mxu_layout="auto")
+    flat = jax.tree_util.tree_leaves(params)
+    has_int4 = any(a.dtype == jnp.int4 for a in flat)
+    assert has_int4 == mxu, \
+        f"mxu_layout={'on' if mxu else 'off'} but int4 planes={has_int4}"
     cache = _sds(jax.eval_shape(lambda: M.new_cache(cfg, 1, 2048)), dev)
     ids = _sds(jax.ShapeDtypeStruct((1, sq), jnp.int32), dev)
     comp = _compile(
         lambda p, i, c: M.forward(p, cfg, i, c, last_only=(sq > 1)),
         params, ids, cache)
     assert _has_mosaic_call(comp)
+    if mxu:
+        ma = comp.memory_analysis()
+        RECORDED[f"mxu_layout_sq{sq}"] = ma
+        assert ma.argument_size_in_bytes < 8e9
 
 
 def test_llama7b_prefill_compiles(v5e, aot_flags):

@@ -15,7 +15,11 @@ while true; do
     # from bench.py so the two never drift)
     bt=${BENCH_CONFIG_TIMEOUT_S:-$(python -c "import bench; print(bench.CONFIG_TIMEOUT_S)" 2>/dev/null || echo 900)}
     ncfg=$(python -c "import bench; print(len(bench.AB_CONFIGS))" 2>/dev/null || echo 8)
-    timeout $((ncfg * bt + 1500)) python -u bench.py > "tpu_runs/bench_$ts.json" 2> "tpu_runs/bench_$ts.log"
+    # unsupervised watcher runs get the full per-config budget plus
+    # startup/overhead headroom (the driver-facing default inside
+    # bench.py is tighter)
+    BENCH_TOTAL_BUDGET_S=$((ncfg * bt + 1200)) \
+      timeout $((ncfg * bt + 1500)) python -u bench.py > "tpu_runs/bench_$ts.json" 2> "tpu_runs/bench_$ts.log"
     echo "$ts bench exit=$?" >> tpu_runs/watch.log
     timeout 1800 python -u bench_qlora.py > "tpu_runs/qlora_$ts.json" 2> "tpu_runs/qlora_$ts.log"
     echo "$ts bench_qlora exit=$?" >> tpu_runs/watch.log
