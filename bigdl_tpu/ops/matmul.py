@@ -48,7 +48,81 @@ def _backend() -> str:
 
 
 
+# formats whose XLA dequant materializes several full-size f32
+# intermediates (codebook gathers, sign planes, sub-scale expansions):
+# left unchunked, ONE 7B-class weight costs gigabytes of temp — a
+# 32-layer mixtral-8x7B in iq2_xxs compiled to 9 GB of temp and OOM'd a
+# 16 GB v5e despite only 12.8 GB of packed weights
+_HEAVY_DECODE_QTYPES = frozenset(
+    ("q2_k", "iq2_xxs", "iq2_xs", "iq1_s", "iq1_m"))
+
+
+def _chunk_count(n: int, target_cols: int = 1024) -> int:
+    """Smallest chunk count >= n/target that divides n (<= 64); when N is
+    so large that every such count exceeds 64 (huge vocab heads), the
+    LARGEST divisor <= 64 — giving up entirely would leave exactly the
+    worst weights on the unchunked OOM path. 0 only when n is prime."""
+    lo = max(1, -(-n // target_cols))
+    for c in range(lo, 65):
+        if n % c == 0:
+            return c
+    for c in range(64, 1, -1):
+        if n % c == 0:
+            return c
+    return 0
+
+
+def _chunk_planes(w: QTensor, min_elems: int, target_cols: int):
+    """Shared chunk prep for the forward and backward chunked paths:
+    (chunk_count, stacked planes tuple, per-chunk shape), or None when
+    chunking is not applicable/worthwhile."""
+    from bigdl_tpu.ops.quant import split_qtensor_n
+
+    k, n = w.shape
+    if k * n < min_elems:          # small weights: temp is already small
+        return None
+    c = _chunk_count(n, target_cols)
+    if c <= 1:
+        return None
+    chunks = split_qtensor_n(w, [n // c] * c)
+    stacked = []
+    for f in ("data", "scale", "zero", "aux"):
+        planes = [getattr(ch, f) for ch in chunks]
+        stacked.append(None if planes[0] is None else jnp.stack(planes))
+    return c, tuple(stacked), chunks[0].shape
+
+
+def _q_matmul_xla_chunked(x: jax.Array, w: QTensor,
+                          min_elems: int = 1 << 24,
+                          target_cols: int = 1024):
+    """Dequantize+dot in N-chunks under lax.map so XLA reuses one
+    chunk's decode buffers instead of materializing them all at once.
+    Returns None when chunking is not applicable/worthwhile."""
+    prep = _chunk_planes(w, min_elems, target_cols)
+    if prep is None:
+        return None
+    _, stacked, cshape = prep
+    n = w.shape[1]
+    xb = x.astype(jnp.bfloat16)
+
+    def one(planes):
+        d, s, z, a = planes
+        wq = QTensor(d, s, z, w.qtype, cshape, a)
+        return jnp.dot(xb, dequantize(wq, dtype=jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+
+    ys = jax.lax.map(one, stacked)                            # [C, M, n/C]
+    # downcast BEFORE the transpose: the cast commutes with moveaxis and
+    # halves the transpose buffer (the whole point here is bounding temp)
+    y = jnp.moveaxis(ys.astype(x.dtype), 0, -2)
+    return y.reshape(*x.shape[:-1], n)
+
+
 def _q_matmul_xla(x: jax.Array, w: QTensor) -> jax.Array:
+    if w.qtype in _HEAVY_DECODE_QTYPES:
+        y = _q_matmul_xla_chunked(x, w)
+        if y is not None:
+            return y
     dense = dequantize(w, dtype=jnp.bfloat16)
     y = jnp.dot(
         x.astype(jnp.bfloat16), dense, preferred_element_type=jnp.float32
@@ -169,11 +243,43 @@ def _q_matmul_bwd(be, w, dy):
     # dx = dy @ dequantize(W)^T; the quantized weight is never trainable, so
     # its cotangent is zero. This also makes the non-differentiable Pallas
     # forward transparently trainable-through.
+    dw = jax.tree.map(_zero_cotangent, w)
+    if w.qtype in _HEAVY_DECODE_QTYPES:
+        dx = _q_matmul_bwd_chunked(dy, w)
+        if dx is not None:
+            return dx.astype(dy.dtype), dw
     wd = dequantize(w, dtype=jnp.bfloat16)
     dx = jnp.dot(dy.astype(jnp.bfloat16), wd.T,
                  preferred_element_type=jnp.float32)
-    dw = jax.tree.map(_zero_cotangent, w)
     return dx.astype(dy.dtype), dw
+
+
+def _q_matmul_bwd_chunked(dy: jax.Array, w: QTensor,
+                          min_elems: int = 1 << 24,
+                          target_cols: int = 1024):
+    """dx = dy @ W^T accumulated over the same N-chunks as the forward,
+    so heavy-decode formats keep their bounded-temp guarantee under AD
+    (QLoRA over iq/k-quant bases). Returns None when not applicable."""
+    prep = _chunk_planes(w, min_elems, target_cols)
+    if prep is None:
+        return None
+    c, stacked, cshape = prep
+    k, n = w.shape
+    nc = n // c
+    dyb = dy.astype(jnp.bfloat16).reshape(-1, n)
+
+    def step(acc, xs):
+        i, planes = xs
+        d, s, z, a = planes
+        wq = QTensor(d, s, z, w.qtype, cshape, a)
+        dy_c = jax.lax.dynamic_slice_in_dim(dyb, i * nc, nc, axis=1)
+        return acc + jnp.dot(dy_c,
+                             dequantize(wq, dtype=jnp.bfloat16).T,
+                             preferred_element_type=jnp.float32), None
+
+    acc0 = jnp.zeros((dyb.shape[0], k), jnp.float32)
+    dx, _ = jax.lax.scan(step, acc0, (jnp.arange(c), stacked))
+    return dx.reshape(*dy.shape[:-1], k)
 
 
 _q_matmul_vjp.defvjp(_q_matmul_fwd, _q_matmul_bwd)
