@@ -80,9 +80,32 @@ def ring_attention(
     qf = q.reshape(b, sq, hkv, g, d).astype(jnp.bfloat16)
     q_ids = global_ids(p, sq)                               # global q pos
 
-    o0 = jnp.zeros((b, hkv, g, sq, d), jnp.float32)
-    m0 = jnp.full((b, hkv, g, sq), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, hkv, g, sq), jnp.float32)
+    # Q-blocking inside each ring step: the per-step scores are
+    # [B, Hkv, G, bq, Sk] — unblocked (bq = Sq) a 32k/sp=4 llama-7B
+    # prefill materialized an 8.6 GB f32 score tensor per step and blew
+    # past one v5e's HBM. Long local chunks process Q in sub-blocks
+    # under lax.map (sequential; buffers reuse), bounding the working
+    # set at ~bq x Sk while keeping the math identical (each q row's
+    # online-softmax state is independent of other rows). The carry and
+    # loop-invariant q blocks live in block-major layout for the whole
+    # ring loop — ONE transpose in, one out.
+    bq = sq
+    if sq > 1024:
+        # largest divisor of sq <= 1024 (not just powers of two: a
+        # non-128-multiple local chunk must still block, or the OOM
+        # this exists to prevent comes back for exactly those shapes)
+        for cand in range(1024, 1, -1):
+            if sq % cand == 0:
+                bq = cand
+                break
+    nb = sq // bq
+
+    # block-major: [nb, B, ...(bq)...]
+    qf_bk = jnp.moveaxis(qf.reshape(b, nb, bq, hkv, g, d), 1, 0)
+    ids_bk = q_ids.reshape(nb, bq)
+    o0 = jnp.zeros((nb, b, hkv, g, bq, d), jnp.float32)
+    m0 = jnp.full((nb, b, hkv, g, bq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((nb, b, hkv, g, bq), jnp.float32)
     # the loop body makes these device-varying (they depend on axis_index);
     # mark the initial values accordingly for shard_map's vma tracking
     o0, m0, l0 = (lax.pcast(x, (axis_name,), to="varying")
@@ -94,29 +117,42 @@ def ring_attention(
         o, m, l, k_cur, v_cur = carry
         src = (p - i) % n                                   # chunk we hold
         k_ids = global_ids(src, sk)
-        s = _chunk_scores(qf, k_cur.astype(jnp.bfloat16), scale,
-                          logits_soft_cap)                  # [B,Hkv,G,Sq,Sk]
-        mask = k_ids[None, :] <= q_ids[:, None]             # [Sq, Sk]
-        if sliding_window is not None:
-            mask &= k_ids[None, :] > q_ids[:, None] - sliding_window
-        s = jnp.where(mask[None, None, None], s, -jnp.inf)
+        kb = k_cur.astype(jnp.bfloat16)
+        vb = v_cur.astype(jnp.bfloat16)
 
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        # fully-masked rows keep m == -inf; guard the exp against NaN
-        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
-        pexp = jnp.exp(s - m_new[..., None])
-        pexp = jnp.where(jnp.isfinite(s), pexp, 0.0)
-        l = l * alpha + jnp.sum(pexp, axis=-1)
-        o = o * alpha[..., None] + jnp.einsum(
-            "bhgqk,bkhd->bhgqd", pexp.astype(jnp.bfloat16),
-            v_cur.astype(jnp.bfloat16), preferred_element_type=jnp.float32)
+        def one_block(xs):
+            qf_b, o_b, m_b, l_b, qid_b = xs
+            s = _chunk_scores(qf_b, kb, scale,
+                              logits_soft_cap)          # [B,Hkv,G,bq,Sk]
+            mask = k_ids[None, :] <= qid_b[:, None]     # [bq, Sk]
+            if sliding_window is not None:
+                mask &= k_ids[None, :] > qid_b[:, None] - sliding_window
+            s = jnp.where(mask[None, None, None], s, -jnp.inf)
+
+            m_new = jnp.maximum(m_b, jnp.max(s, axis=-1))
+            # fully-masked rows keep m == -inf; guard exp against NaN
+            alpha = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - m_new), 0.0)
+            pexp = jnp.exp(s - m_new[..., None])
+            pexp = jnp.where(jnp.isfinite(s), pexp, 0.0)
+            l_new = l_b * alpha + jnp.sum(pexp, axis=-1)
+            o_new = o_b * alpha[..., None] + jnp.einsum(
+                "bhgqk,bkhd->bhgqd", pexp.astype(jnp.bfloat16), vb,
+                preferred_element_type=jnp.float32)
+            return o_new, m_new, l_new
+
+        if nb == 1:
+            o1, m1, l1 = one_block((qf_bk[0], o[0], m[0], l[0], ids_bk[0]))
+            o, m, l = o1[None], m1[None], l1[None]
+        else:
+            o, m, l = lax.map(one_block, (qf_bk, o, m, l, ids_bk))
 
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (o, m_new, l, k_nxt, v_nxt)
+        return (o, m, l, k_nxt, v_nxt)
 
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
-    out = o / jnp.maximum(l, 1e-30)[..., None]              # [B,Hkv,G,Sq,D]
+    out = o / jnp.maximum(l, 1e-30)[..., None]      # [nb,B,Hkv,G,bq,D]
+    out = jnp.moveaxis(out, 0, 3).reshape(b, hkv, g, sq, d)
     out = jnp.moveaxis(out, 3, 1).reshape(b, sq, h, d)
     return out.astype(q.dtype)
 

@@ -77,3 +77,17 @@ def test_single_device_ring_degenerates():
                       np.float32)
     got = np.asarray(sp_attention(q, k, v, mesh_of(1), "sp"), np.float32)
     np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+
+def test_q_blocked_long_chunk_matches_reference():
+    """Local chunks longer than 1024 take the Q-blocked path inside each
+    ring step (bounded score working set — unblocked, a 32k/sp=4 7B
+    prefill materialized an 8.6GB score tensor per step). The blocked
+    math must stay exact: S=4096 over sp=2 gives local chunks of 2048
+    (bq=1024, two blocks per step)."""
+    b, s, h, hkv, d = 1, 4096, 2, 2, 8
+    q, k, v = rand_qkv(b, s, h, hkv, d, seed=5)
+    want = np.asarray(sdp_attention(q, k, v, jnp.zeros((), jnp.int32)),
+                      np.float32)
+    got = np.asarray(sp_attention(q, k, v, mesh_of(2), "sp"), np.float32)
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
