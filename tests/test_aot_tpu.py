@@ -473,21 +473,49 @@ def test_sharded_train_step_compiles_v5e_mesh(v5e, aot_flags):
     assert "all-reduce" in comp.as_text()
 
 
+def _tp_compile(v5e, cfg, make_params, max_seq=2048):
+    """Shared explicit-TP abstract-compile harness: build the tp=4 mesh,
+    sharded param/cache/ids ShapeDtypeStructs from `make_params(cfg,
+    n)` (evaluated under eval_shape), compile TP._tp_fn for the real
+    topology. Returns (compiled, hlo_text)."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+
+    from bigdl_tpu.models import llama as M
+    from bigdl_tpu.ops.kvcache import KVCache
+    from bigdl_tpu.parallel import tp as TP
+
+    mesh = Mesh(np.array(v5e.devices), ("tp",))
+    n = mesh.shape["tp"]
+    pshape = jax.eval_shape(lambda: make_params(cfg, n))
+    specs = TP.tp_param_specs(pshape, mesh)
+    p_s = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
+        pshape, specs)
+    cshape = jax.eval_shape(lambda: M.new_cache(cfg, 1, max_seq))
+    csh = NamedSharding(mesh, TP.tp_cache_specs())
+    rep = NamedSharding(mesh, jax.sharding.PartitionSpec())
+    cache_s = KVCache(
+        jax.ShapeDtypeStruct(cshape.k.shape, cshape.k.dtype, sharding=csh),
+        jax.ShapeDtypeStruct(cshape.v.shape, cshape.v.dtype, sharding=csh),
+        jax.ShapeDtypeStruct((), jnp.int32, sharding=rep))
+    ids = jax.ShapeDtypeStruct((1, 1), jnp.int32, sharding=rep)
+    fn = TP._tp_fn(cfg, mesh, "tp")
+    with mesh:
+        comp = fn.lower(p_s, ids, cache_s).compile()
+    return comp, comp.as_text()
+
+
 def test_explicit_tp_kernels_compile_v5e_mesh(v5e, aot_flags):
     """The explicit-shard_map TP path (parallel/tp.py) is the
     kernel-capable multi-chip route: the partitioned program must
     contain Mosaic custom-calls (kernels on LOCAL shards) AND the
     row-parallel all-reduce."""
-    import numpy as np
-    from jax.sharding import Mesh, NamedSharding
-
-    from bigdl_tpu.models import llama as M
     from bigdl_tpu.models.llama import LlamaConfig
-    from bigdl_tpu.ops.kvcache import KVCache
     from bigdl_tpu.parallel import tp as TP
     from bigdl_tpu.utils.testing import random_llama_params
 
-    mesh = Mesh(np.array(v5e.devices), ("tp",))
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=4096, intermediate_size=11008,
         num_hidden_layers=2, num_attention_heads=32,
@@ -495,28 +523,8 @@ def test_explicit_tp_kernels_compile_v5e_mesh(v5e, aot_flags):
     # pad_ff_for_tp: gate/up/down shards lane-align (11008 -> 11264),
     # lm_head vocab shards too (32000 -> 32256) — the same transform
     # shard_params_tp applies on real arrays
-    pshape = jax.eval_shape(lambda: TP.pad_ff_for_tp(
-        random_llama_params(cfg, "sym_int4"), mesh.shape["tp"]))
-    specs = TP.tp_param_specs(pshape, mesh)
-    p_s = jax.tree.map(
-        lambda a, s: jax.ShapeDtypeStruct(
-            a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
-        pshape, specs)
-    cshape = jax.eval_shape(lambda: M.new_cache(cfg, 1, 2048))
-    csh = NamedSharding(mesh, TP.tp_cache_specs())
-    cache_s = KVCache(
-        jax.ShapeDtypeStruct(cshape.k.shape, cshape.k.dtype, sharding=csh),
-        jax.ShapeDtypeStruct(cshape.v.shape, cshape.v.dtype, sharding=csh),
-        jax.ShapeDtypeStruct((), jnp.int32,
-                             sharding=NamedSharding(
-                                 mesh, jax.sharding.PartitionSpec())))
-    ids = jax.ShapeDtypeStruct(
-        (1, 1), jnp.int32,
-        sharding=NamedSharding(mesh, jax.sharding.PartitionSpec()))
-    fn = TP._tp_fn(cfg, mesh, "tp")
-    with mesh:
-        comp = fn.lower(p_s, ids, cache_s).compile()
-    txt = comp.as_text()
+    comp, txt = _tp_compile(v5e, cfg, lambda c, n: TP.pad_ff_for_tp(
+        random_llama_params(c, "sym_int4"), n))
     assert _has_mosaic_call(comp), (
         "explicit TP compiled without Mosaic kernels — the whole point "
         "of the shard_map path")
@@ -528,43 +536,16 @@ def test_explicit_tp_moe_compiles_v5e_mesh(v5e, aot_flags):
     compile for the real v5e topology with Mosaic kernels AND the
     all-reduce — expert ff sharded across tp, psum on the partial
     expert outputs (8x7B geometry at 2 layers to bound compile time)."""
-    import numpy as np
-    from jax.sharding import Mesh, NamedSharding
-
-    from bigdl_tpu.models import llama as M
     from bigdl_tpu.models.mixtral import MixtralConfig
-    from bigdl_tpu.ops.kvcache import KVCache
-    from bigdl_tpu.parallel import tp as TP
     from bigdl_tpu.utils.testing import random_mixtral_params
 
-    mesh = Mesh(np.array(v5e.devices), ("tp",))
     cfg = MixtralConfig(
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_hidden_layers=2, num_attention_heads=32,
         num_key_value_heads=8, num_local_experts=8,
         num_experts_per_tok=2)
-    pshape = jax.eval_shape(
-        lambda: random_mixtral_params(cfg, "sym_int4"))
-    specs = TP.tp_param_specs(pshape, mesh)
-    p_s = jax.tree.map(
-        lambda a, s: jax.ShapeDtypeStruct(
-            a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
-        pshape, specs)
-    cshape = jax.eval_shape(lambda: M.new_cache(cfg, 1, 2048))
-    csh = NamedSharding(mesh, TP.tp_cache_specs())
-    cache_s = KVCache(
-        jax.ShapeDtypeStruct(cshape.k.shape, cshape.k.dtype, sharding=csh),
-        jax.ShapeDtypeStruct(cshape.v.shape, cshape.v.dtype, sharding=csh),
-        jax.ShapeDtypeStruct((), jnp.int32,
-                             sharding=NamedSharding(
-                                 mesh, jax.sharding.PartitionSpec())))
-    ids = jax.ShapeDtypeStruct(
-        (1, 1), jnp.int32,
-        sharding=NamedSharding(mesh, jax.sharding.PartitionSpec()))
-    fn = TP._tp_fn(cfg, mesh, "tp")
-    with mesh:
-        comp = fn.lower(p_s, ids, cache_s).compile()
-    txt = comp.as_text()
+    comp, txt = _tp_compile(
+        v5e, cfg, lambda c, n: random_mixtral_params(c, "sym_int4"))
     assert _has_mosaic_call(comp), (
         "explicit-TP MoE compiled without Mosaic kernels")
     assert "all-reduce" in txt
@@ -576,43 +557,17 @@ def test_explicit_tp_parallel_residual_compiles_v5e_mesh(v5e, aot_flags):
     topology under explicit TP with Mosaic kernels AND the all-reduce —
     these families previously could never use Pallas kernels
     multi-chip."""
-    import numpy as np
-    from jax.sharding import Mesh, NamedSharding
-
-    from bigdl_tpu.models import llama as M
     from bigdl_tpu.models.llama import LlamaConfig
-    from bigdl_tpu.ops.kvcache import KVCache
     from bigdl_tpu.parallel import tp as TP
     from bigdl_tpu.utils.testing import random_llama_params
 
-    mesh = Mesh(np.array(v5e.devices), ("tp",))
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=4096, intermediate_size=16384,
         num_hidden_layers=2, num_attention_heads=32,
         num_key_value_heads=8, parallel_residual=True,
         shared_input_norm=True, mlp_gated=False, hidden_act="gelu")
-    pshape = jax.eval_shape(lambda: TP.pad_ff_for_tp(
-        random_llama_params(cfg, "sym_int4"), mesh.shape["tp"]))
-    specs = TP.tp_param_specs(pshape, mesh)
-    p_s = jax.tree.map(
-        lambda a, s: jax.ShapeDtypeStruct(
-            a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
-        pshape, specs)
-    cshape = jax.eval_shape(lambda: M.new_cache(cfg, 1, 2048))
-    csh = NamedSharding(mesh, TP.tp_cache_specs())
-    cache_s = KVCache(
-        jax.ShapeDtypeStruct(cshape.k.shape, cshape.k.dtype, sharding=csh),
-        jax.ShapeDtypeStruct(cshape.v.shape, cshape.v.dtype, sharding=csh),
-        jax.ShapeDtypeStruct((), jnp.int32,
-                             sharding=NamedSharding(
-                                 mesh, jax.sharding.PartitionSpec())))
-    ids = jax.ShapeDtypeStruct(
-        (1, 1), jnp.int32,
-        sharding=NamedSharding(mesh, jax.sharding.PartitionSpec()))
-    fn = TP._tp_fn(cfg, mesh, "tp")
-    with mesh:
-        comp = fn.lower(p_s, ids, cache_s).compile()
-    txt = comp.as_text()
+    comp, txt = _tp_compile(v5e, cfg, lambda c, n: TP.pad_ff_for_tp(
+        random_llama_params(c, "sym_int4"), n))
     assert _has_mosaic_call(comp)
     assert "all-reduce" in txt
 
@@ -712,4 +667,30 @@ def test_cp_32k_ring_prefill_compiles_v5e_mesh(v5e, aot_flags):
     per_chip = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
                 + ma.output_size_in_bytes)
     # replicated int4 weights (~4GB) + 1/4 of the 32k KV + ring buffers
+    assert per_chip < 16e9, f"{per_chip / 1e9:.2f} GB exceeds one v5e"
+
+
+def test_llama70b_int4_tp4_fits_v5e_mesh(v5e, aot_flags):
+    """The reference's 70B multi-device claim (Deepspeed-AutoTP runs
+    llama2-70B INT4 across 4 devices, example/GPU/Deepspeed-AutoTP):
+    FULL llama2-70B geometry (80 layers, GQA 64/8, ff 28672) in
+    sym_int4 under explicit tp=4 must compile for the v5e 2x2 topology
+    with per-chip memory inside 16GB (~35GB packed weights / 4 + its KV
+    shard), Mosaic kernels on the shards, and the all-reduce."""
+    from bigdl_tpu.models.llama import LlamaConfig
+    from bigdl_tpu.parallel import tp as TP
+    from bigdl_tpu.utils.testing import random_llama_params
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=8192, intermediate_size=28672,
+        num_hidden_layers=80, num_attention_heads=64,
+        num_key_value_heads=8, max_position_embeddings=4096)
+    comp, txt = _tp_compile(v5e, cfg, lambda c, n: TP.pad_ff_for_tp(
+        random_llama_params(c, "sym_int4"), n))
+    assert _has_mosaic_call(comp)
+    assert "all-reduce" in txt
+    ma = comp.memory_analysis()
+    RECORDED["llama70b_tp4"] = ma
+    per_chip = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                + ma.output_size_in_bytes)
     assert per_chip < 16e9, f"{per_chip / 1e9:.2f} GB exceeds one v5e"
