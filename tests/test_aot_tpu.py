@@ -429,47 +429,66 @@ def test_sharded_int4_inference_compiles_v5e_mesh(v5e, aot_flags):
     assert "all-reduce" in txt, "no row-parallel reduction emitted"
 
 
-def test_sharded_train_step_compiles_v5e_mesh(v5e, aot_flags):
-    """dp x tp training step (grad all-reduce over dp, tensor-parallel
-    activations over tp) compiles for the v5e 2x2 topology."""
+def _train_step_compile(v5e, cfg, mesh_shape, step_factory,
+                        params_builder, batch_shape):
+    """Shared sharded-train-step AOT harness: build the (dp, tp) mesh,
+    ShapeDtypeStructs for params (sharded by llama_param_specs),
+    replicated optimizer state, dp-sharded batch; compile the step for
+    the real topology. Returns the compiled executable."""
     import numpy as np
     import optax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-    from bigdl_tpu.models import llama as M
-    from bigdl_tpu.models.llama import LlamaConfig
     from bigdl_tpu.parallel.sharding import llama_param_specs
-    from bigdl_tpu.training import make_train_step
-    from bigdl_tpu.utils.testing import random_llama_params
 
-    mesh = Mesh(np.array(v5e.devices).reshape(2, 2), ("dp", "tp"))
-    cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=2048, intermediate_size=5504,
-        num_hidden_layers=2, num_attention_heads=16,
-        num_key_value_heads=16, max_position_embeddings=1024)
-    pshape = jax.eval_shape(lambda: random_llama_params(cfg, None))
-    specs = llama_param_specs(pshape, mesh)
+    mesh = Mesh(np.array(v5e.devices).reshape(*mesh_shape), ("dp", "tp"))
+    built = jax.eval_shape(params_builder)
 
-    def sds(a, s):
-        return jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                    sharding=NamedSharding(mesh, s))
+    def sds_tree(tree):
+        specs = llama_param_specs(tree, mesh)
+        return jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
+            tree, specs)
 
-    p_s = jax.tree.map(sds, pshape, specs)
     opt = optax.adamw(1e-4)
+    step = step_factory(opt)
+    trainable = built[0] if isinstance(built, tuple) else built
     os_s = jax.tree.map(
         lambda a: jax.ShapeDtypeStruct(
             a.shape, a.dtype,
             sharding=NamedSharding(mesh, PartitionSpec())),
-        jax.eval_shape(lambda: opt.init(
-            jax.tree.map(lambda x: jnp.zeros(x.shape, x.dtype), pshape))))
+        jax.eval_shape(lambda: opt.init(jax.tree.map(
+            lambda x: jnp.zeros(x.shape, x.dtype), trainable))))
     batch = {
-        k: jax.ShapeDtypeStruct((4, 256), jnp.int32,
+        k: jax.ShapeDtypeStruct(batch_shape, jnp.int32,
                                 sharding=NamedSharding(
                                     mesh, PartitionSpec("dp")))
         for k in ("input_ids", "attention_mask")}
-    step = make_train_step(M.forward_train, cfg, opt)
     with mesh:
-        comp = step.lower(p_s, os_s, batch).compile()
+        if isinstance(built, tuple):    # (trainable, frozen) QLoRA split
+            t_s, f_s = sds_tree(built[0]), sds_tree(built[1])
+            return step.lower(t_s, os_s, f_s, batch).compile()
+        return step.lower(sds_tree(built), os_s, batch).compile()
+
+
+def test_sharded_train_step_compiles_v5e_mesh(v5e, aot_flags):
+    """dp x tp training step (grad all-reduce over dp, tensor-parallel
+    activations over tp) compiles for the v5e 2x2 topology."""
+    from bigdl_tpu.models import llama as M
+    from bigdl_tpu.models.llama import LlamaConfig
+    from bigdl_tpu.training import make_train_step
+    from bigdl_tpu.utils.testing import random_llama_params
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+        num_hidden_layers=2, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=1024)
+    comp = _train_step_compile(
+        v5e, cfg, (2, 2),
+        lambda opt: make_train_step(M.forward_train, cfg, opt),
+        lambda: random_llama_params(cfg, None),
+        (4, 256))
     assert "all-reduce" in comp.as_text()
 
 
@@ -691,6 +710,45 @@ def test_llama70b_int4_tp4_fits_v5e_mesh(v5e, aot_flags):
     assert "all-reduce" in txt
     ma = comp.memory_analysis()
     RECORDED["llama70b_tp4"] = ma
+    per_chip = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                + ma.output_size_in_bytes)
+    assert per_chip < 16e9, f"{per_chip / 1e9:.2f} GB exceeds one v5e"
+
+
+def test_llama70b_qlora_step_tp4_fits_v5e_mesh(v5e, aot_flags):
+    """The reference's 70B finetuning claim (QLoRA Alpaca 70B in 3.14h
+    on 8 GPUs, README.md:20): a FULL llama2-70B QLoRA train step —
+    frozen sym_int4 base sharded tp=4 (the 35GB base cannot split any
+    coarser on 16GB chips, so dp=1 here; the dp grad all-reduce is
+    covered by test_sharded_train_step_compiles_v5e_mesh at dp=2 and
+    the CPU-mesh QLoRA tests), trainable LoRA adapters, the recipe's
+    micro-batch 8 x 256 — must compile for the v5e 2x2 topology with
+    per-chip memory inside 16GB and the tp all-reduces present."""
+    from bigdl_tpu.models import llama as M
+    from bigdl_tpu.models.llama import LlamaConfig
+    from bigdl_tpu.qlora import LoraConfig, attach_lora, \
+        lora_trainable_mask
+    from bigdl_tpu.training import make_lora_train_step, partition
+    from bigdl_tpu.utils.testing import random_llama_params
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=8192, intermediate_size=28672,
+        num_hidden_layers=80, num_attention_heads=64,
+        num_key_value_heads=8, max_position_embeddings=4096)
+
+    def build():
+        params = random_llama_params(cfg, qtype="sym_int4")
+        params = attach_lora(params, LoraConfig(r=16,
+                                                training_mode="qlora"))
+        return partition(params, lora_trainable_mask(params))
+
+    comp = _train_step_compile(
+        v5e, cfg, (1, 4),
+        lambda opt: make_lora_train_step(M.forward_train, cfg, opt),
+        build, (8, 256))
+    assert "all-reduce" in comp.as_text()
+    ma = comp.memory_analysis()
+    RECORDED["llama70b_qlora_tp4"] = ma
     per_chip = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
                 + ma.output_size_in_bytes)
     assert per_chip < 16e9, f"{per_chip / 1e9:.2f} GB exceeds one v5e"
