@@ -146,8 +146,12 @@ def test_missing_expert_tensor_rejected():
 
 
 def test_expert_parallel_sharding(params):
-    """Shard the expert axis over the CPU mesh; outputs must not change."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    """shard_moe_params (the public ep helper) splits every experts_*
+    plane on the expert axis, replicates everything else, and the
+    sharded forward matches single-device."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from bigdl_tpu.parallel.sharding import shard_moe_params
 
     cfg = TINY_MIXTRAL
     devs = np.array(jax.devices()[:4]).reshape(4)
@@ -156,16 +160,18 @@ def test_expert_parallel_sharding(params):
                         % cfg.vocab_size)[None])
     want = np.asarray(mx.forward_train(params, cfg, toks))
 
-    def shard_leaf(path, x):
-        names = [getattr(p, "name", getattr(p, "key", None)) for p in path]
-        spec = P()
-        if any(isinstance(n, str) and n.startswith("experts_")
-               for n in names):
-            # leaves are [L, E, ...]: shard E
-            spec = P(None, "ep")
-        return jax.device_put(x, NamedSharding(mesh, spec))
+    sharded = shard_moe_params(params, mesh, axis="ep")
+    n_exp, n_rep = 0, 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(sharded)[0]:
+        names = [getattr(p, "key", getattr(p, "name", None)) for p in path]
+        is_exp = any(isinstance(n, str) and n.startswith("experts_")
+                     for n in names)
+        assert leaf.sharding.spec == (P(None, "ep") if is_exp else P()), \
+            (names, leaf.sharding.spec)
+        n_exp += is_exp
+        n_rep += not is_exp
+    assert n_exp and n_rep
 
-    sharded = jax.tree_util.tree_map_with_path(shard_leaf, params)
     with mesh:
         got = np.asarray(mx.forward_train(sharded, cfg, toks))
     np.testing.assert_allclose(want, got, atol=1e-2, rtol=1e-2)

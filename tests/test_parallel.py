@@ -155,46 +155,3 @@ def test_merged_layout_shards_and_matches(setup):
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(logits_ref), rtol=2e-2, atol=2e-2)
 
-
-def test_shard_moe_params_splits_experts_only():
-    """shard_moe_params: every experts_* plane splits on the expert
-    axis (dim 1 of [L, E, ...]), everything else replicates, and the
-    sharded forward matches single-device."""
-    from jax.sharding import Mesh
-
-    from bigdl_tpu.models import mixtral as mx
-    from bigdl_tpu.models.mixtral import MixtralConfig
-    from bigdl_tpu.parallel.sharding import shard_moe_params
-    from bigdl_tpu.utils.testing import random_mixtral_params
-
-    cfg = MixtralConfig(
-        vocab_size=128, hidden_size=64, intermediate_size=96,
-        num_hidden_layers=2, num_attention_heads=8,
-        num_key_value_heads=4, max_position_embeddings=64,
-        num_local_experts=4, num_experts_per_tok=2)
-    params = random_mixtral_params(cfg, qtype="sym_int4", seed=0)
-    toks = jnp.asarray(np.arange(1, 9, dtype=np.int32)[None])
-    ref = np.asarray(mx.forward_train(params, cfg, toks))
-
-    mesh = Mesh(np.array(jax.devices()[:4]), ("ep",))
-    sharded = shard_moe_params(params, mesh, axis="ep")
-
-    def leaf_specs(tree):
-        out = {}
-        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-            name = "/".join(str(getattr(p, "key", getattr(p, "name", p)))
-                            for p in path)
-            out[name] = leaf.sharding.spec
-        return out
-
-    specs = leaf_specs(sharded)
-    expert_keys = [k for k in specs if "experts_" in k]
-    assert expert_keys, "no expert planes found"
-    for k in expert_keys:
-        assert specs[k] == P(None, "ep"), (k, specs[k])
-    for k in (set(specs) - set(expert_keys)):
-        assert specs[k] == P(), (k, specs[k])
-
-    with mesh:
-        got = np.asarray(mx.forward_train(sharded, cfg, toks))
-    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
