@@ -242,3 +242,39 @@ def test_model_hub_kwarg(tmp_path):
     if not has_ms:
         with pytest.raises(ImportError, match="modelscope"):
             _resolve_hub_path("org/nonexistent-repo", "modelscope")
+
+
+def test_mxu_layout_save_roundtrip(tiny_hf_dir, tmp_path):
+    """The TPU shipped default loads with the int4-dtype MXU layout;
+    save_low_bit must repack to the canonical interchange format and the
+    reloaded model (canonical) must generate the same tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.config import set_flags
+    from bigdl_tpu.ops.quant import QTensor
+    from bigdl_tpu.transformers import AutoModelForCausalLM
+
+    set_flags(mxu_layout="on")
+    try:
+        m1 = AutoModelForCausalLM.from_pretrained(
+            tiny_hf_dir, load_in_4bit=True, max_seq=64)
+    finally:
+        set_flags(mxu_layout="auto")
+    # the layout actually applied (int4-dtype planes present)
+    datas = [leaf.data.dtype for leaf in jax.tree_util.tree_leaves(
+        m1.params, is_leaf=lambda x: isinstance(x, QTensor))
+        if isinstance(leaf, QTensor)]
+    assert jnp.int4 in datas, "mxu layout did not apply"
+
+    save_dir = str(tmp_path / "mxu_rt")
+    m1.save_low_bit(save_dir)
+    m2 = AutoModelForCausalLM.load_low_bit(save_dir)
+    datas2 = [leaf.data.dtype for leaf in jax.tree_util.tree_leaves(
+        m2.params, is_leaf=lambda x: isinstance(x, QTensor))
+        if isinstance(leaf, QTensor)]
+    assert jnp.int4 not in datas2, "saved checkpoint kept the MXU layout"
+
+    out1 = m1.generate([2, 8, 30, 4], max_new_tokens=8)
+    out2 = m2.generate([2, 8, 30, 4], max_new_tokens=8)
+    np.testing.assert_array_equal(out1, out2)
