@@ -361,3 +361,27 @@ def test_cached_record_scan_skips_re_emissions(tmp_path):
     got = bench._latest_valid_onchip_record(str(run_dir))
     assert got["value"] == 30.0
     assert got["cached_from"] == "bench_20250101_000000.json"
+
+
+def test_ab_configs_sane():
+    """A/B config table integrity: unique labels, only known flag keys
+    (a typo'd override would silently A/B the default config twice)."""
+    import dataclasses
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    from bigdl_tpu.config import RuntimeFlags
+
+    labels = [l for l, _ in bench.AB_CONFIGS]
+    assert len(labels) == len(set(labels))
+    flag_names = {f.name for f in dataclasses.fields(RuntimeFlags)}
+    for label, overrides in bench.AB_CONFIGS:
+        for key in overrides:
+            if key.startswith("_"):
+                assert key in ("_qtype", "_kv_quantized", "_merged"), \
+                    (label, key)
+            else:
+                assert key in flag_names, (label, key)

@@ -36,6 +36,6 @@ while true; do
     sleep 60
   else
     echo "$ts tunnel dead" >> tpu_runs/watch.log
-    sleep 240
+    sleep 120
   fi
 done
