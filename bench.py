@@ -354,15 +354,25 @@ def _ordered_configs(run_dir: str) -> list:
 
     parts = sorted(glob.glob(os.path.join(run_dir, "bench_partial_*.jsonl")))
     bad: set = set()
-    if parts:
+    # newest window with ATTRIBUTABLE evidence wins: a window where the
+    # tunnel died (only no_fault records) says nothing about config
+    # health and must not erase an earlier window's demotion memory
+    for path in reversed(parts):
+        faults, attributable = set(), False
         try:
-            with open(parts[-1]) as f:
+            with open(path) as f:
                 for ln in f:
                     rec = json.loads(ln)
-                    if "error" in rec:
-                        bad.add(rec.get("config"))
+                    if "error" in rec and not rec.get("no_fault"):
+                        faults.add(rec.get("config"))
+                        attributable = True
+                    elif "next_token_ms" in rec:
+                        attributable = True
         except (OSError, json.JSONDecodeError):
-            pass
+            continue
+        if attributable:
+            bad = faults
+            break
     if not bad:
         return list(AB_CONFIGS)
     healthy = [c for c in AB_CONFIGS if c[0] not in bad]
@@ -503,22 +513,44 @@ def main() -> None:
                   f"({'INVALID' if 'invalid' in entry else 'ok'}, "
                   f"{time.time() - t0:.0f}s)", file=sys.stderr)
         except subprocess.TimeoutExpired as te:
+            child_err = ""
             if te.stderr:
-                err = te.stderr
-                sys.stderr.write(err.decode("utf-8", "replace")[-2000:]
-                                 if isinstance(err, bytes) else err[-2000:])
-            ab_results[label] = {"error": f"timeout {cfg_timeout}s"}
+                child_err = (te.stderr.decode("utf-8", "replace")
+                             if isinstance(te.stderr, bytes) else te.stderr)
+                sys.stderr.write(child_err[-2000:])
+            if "bench-phase" in child_err:
+                last = [ln for ln in child_err.splitlines()
+                        if "bench-phase" in ln][-1]
+                ab_results[label] = {
+                    "error": f"timeout {cfg_timeout}s after: {last[-120:]}"}
+            else:
+                # no phase breadcrumb means the child never got past jax
+                # backend init — the tunnel died, the CONFIG is not at
+                # fault (the 08:03 window post-mortem); ordered_configs
+                # must not demote it next window
+                ab_results[label] = {
+                    "error": f"timeout {cfg_timeout}s before any phase "
+                             "(tunnel death, not the config)",
+                    "no_fault": True}
             print(f"bench[{label}]: TIMEOUT", file=sys.stderr)
         except Exception as e:
             ab_results[label] = {"error": f"{type(e).__name__}: {e}"}
             print(f"bench[{label}]: FAILED {e}", file=sys.stderr)
+        tunnel_dead = False
+        if "error" in ab_results[label]:
+            # probe BEFORE persisting: if the tunnel itself is gone, the
+            # config is not at fault even when it died mid-phase — a
+            # fault record here would demote a healthy config next window
+            tunnel_dead = _probe_backend(60) != "tpu"
+            if tunnel_dead:
+                ab_results[label]["no_fault"] = True
         try:
             with open(partial_path, "a") as pf:
                 pf.write(json.dumps({"config": label,
                                      **ab_results[label]}) + "\n")
         except OSError:
             pass
-        if "error" in ab_results[label] and _probe_backend(60) != "tpu":
+        if tunnel_dead:
             # a kernel fault can take the whole tunnel down server-side;
             # don't burn the window timing out every remaining config
             print("bench: backend no longer answers — aborting remaining "

@@ -385,3 +385,55 @@ def test_ab_configs_sane():
                     (label, key)
             else:
                 assert key in flag_names, (label, key)
+
+
+def test_no_fault_timeouts_do_not_demote(tmp_path):
+    """A timeout BEFORE any phase breadcrumb means the tunnel died in
+    jax init — the config is not at fault and must keep its slot."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    first = bench.AB_CONFIGS[0][0]
+    with open(os.path.join(str(tmp_path),
+                           "bench_partial_20990101_000000.jsonl"),
+              "w") as f:
+        f.write(json.dumps({
+            "config": first, "no_fault": True,
+            "error": "timeout 900s before any phase "
+                     "(tunnel death, not the config)"}) + "\n")
+    assert bench._ordered_configs(str(tmp_path)) == list(bench.AB_CONFIGS)
+
+
+def test_all_no_fault_window_keeps_demotion_memory(tmp_path):
+    """A window where the tunnel died (only no_fault records) must not
+    erase an EARLIER window's genuine demotion."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    wedger = bench.AB_CONFIGS[0][0]
+    with open(os.path.join(str(tmp_path),
+                           "bench_partial_20990101_000000.jsonl"),
+              "w") as f:
+        f.write(json.dumps({"config": wedger,
+                            "error": "timeout 900s after: decode"}) + "\n")
+        f.write(json.dumps({"config": bench.AB_CONFIGS[1][0],
+                            "next_token_ms": 12.0}) + "\n")
+    # NEWER window: tunnel died in init — no attributable evidence
+    with open(os.path.join(str(tmp_path),
+                           "bench_partial_20990102_000000.jsonl"),
+              "w") as f:
+        f.write(json.dumps({"config": bench.AB_CONFIGS[2][0],
+                            "no_fault": True,
+                            "error": "timeout before any phase"}) + "\n")
+    order = bench._ordered_configs(str(tmp_path))
+    assert order[-1][0] == wedger, [c[0] for c in order]

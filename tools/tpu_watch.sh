@@ -1,17 +1,25 @@
 #!/bin/bash
-# Opportunistic on-chip runner: probe the axon TPU tunnel every 5 min;
-# when it answers, run the on-chip kernel validation + bench and record
-# artifacts, then keep watching (the tunnel flaps — grab numbers while
-# it's up). Results land in tpu_runs/ with timestamps.
+# Opportunistic on-chip runner: probe the axon TPU tunnel every 2 min;
+# when it answers, grab numbers while it's up (the tunnel flaps, and the
+# 08:03 window lasted ~3 minutes). Results land in tpu_runs/.
+#
+# Ordering is window-economics-driven:
+# 1. bench.py FIRST — the headline metric. Its per-config subprocesses
+#    share a persistent XLA compile cache, so even a window too short to
+#    finish one config banks its completed compiles for the next window;
+#    adaptive ordering runs last window's failures LAST.
+# 2. The kernel-isolation onchip suite second, then the qlora/serving/
+#    speculative benches (each CPU-falls-back harmlessly if the tunnel
+#    died mid-window).
+# 3. The gated 7B runtime-death reproducer LAST — a wedge there costs
+#    nothing (everything else is already on disk).
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p tpu_runs
 while true; do
   ts=$(date +%Y%m%d_%H%M%S)
   if timeout 90 python -u -c "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
     echo "$ts tunnel ALIVE — running on-chip suite" >> tpu_runs/watch.log
-    ONCHIP_STEP_TIMEOUT=${ONCHIP_STEP_TIMEOUT:-300} timeout 1500 python -u tools/tpu_onchip.py > "tpu_runs/onchip_$ts.log" 2>&1
-    echo "$ts onchip exit=$?" >> tpu_runs/watch.log
-      # budget: one BENCH_CONFIG_TIMEOUT_S per A/B config (default read
+    # budget: one BENCH_CONFIG_TIMEOUT_S per A/B config (default read
     # from bench.py so the two never drift)
     bt=${BENCH_CONFIG_TIMEOUT_S:-$(python -c "import bench; print(bench.CONFIG_TIMEOUT_S)" 2>/dev/null || echo 900)}
     ncfg=$(python -c "import bench; print(len(bench.AB_CONFIGS))" 2>/dev/null || echo 8)
@@ -21,6 +29,8 @@ while true; do
     BENCH_TOTAL_BUDGET_S=$((ncfg * bt + 1200)) \
       timeout $((ncfg * bt + 1500)) python -u bench.py > "tpu_runs/bench_$ts.json" 2> "tpu_runs/bench_$ts.log"
     echo "$ts bench exit=$?" >> tpu_runs/watch.log
+    ONCHIP_STEP_TIMEOUT=${ONCHIP_STEP_TIMEOUT:-300} timeout 1500 python -u tools/tpu_onchip.py > "tpu_runs/onchip_$ts.log" 2>&1
+    echo "$ts onchip exit=$?" >> tpu_runs/watch.log
     timeout 1800 python -u bench_qlora.py > "tpu_runs/qlora_$ts.json" 2> "tpu_runs/qlora_$ts.log"
     echo "$ts bench_qlora exit=$?" >> tpu_runs/watch.log
     timeout 2400 python -u bench_serving.py > "tpu_runs/serving_$ts.json" 2> "tpu_runs/serving_$ts.log"
@@ -28,7 +38,7 @@ while true; do
     timeout 1800 python -u bench_speculative.py > "tpu_runs/spec_$ts.json" 2> "tpu_runs/spec_$ts.log"
     echo "$ts bench_speculative exit=$?" >> tpu_runs/watch.log
     # LAST: the 7B runtime-death reproducer — isolated, phase-printing;
-    # a wedge here costs nothing (every other number is already on disk)
+    # a wedge here must not cost the window's other numbers
     ONCHIP_7B=1 ONCHIP_ONLY=model_forward_7b ONCHIP_STEP_TIMEOUT=900 \
       timeout 1000 python -u tools/tpu_onchip.py \
       > "tpu_runs/onchip7b_$ts.log" 2>&1
