@@ -18,5 +18,6 @@ from bigdl_tpu.parallel.sharding import (  # noqa: F401
     llama_param_specs,
     shard_params,
     shard_batch,
+    shard_moe_params,
     replicate,
 )
