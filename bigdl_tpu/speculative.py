@@ -329,3 +329,150 @@ def speculative_generate(
         out.extend(int(t) for t in emitted)
         cur = toks_r[:, n]
     return np.asarray(out[:max_new_tokens], np.int32)[None]
+
+
+# ---------------------------------------------------------------------------
+# Prompt-lookup speculation: n-gram drafts from the token HISTORY, no
+# draft model at all (beyond the reference, whose only speculation is
+# self-speculation with a quantized draft model, speculative.py:443).
+# Greedy decoding stays EXACT — the target verifies every proposal —
+# while repetitive spans (code, quotes, retrieved context) decode up to
+# gamma+1 tokens per target forward.
+
+
+def make_lookup_round(fwd_target: Callable, cfg_target: Any, gamma: int,
+                      ngram: int = 2):
+    """Build the fused per-round executable for prompt-lookup.
+
+    round(params_t, cache_t, hist, hist_len, cur_tok) ->
+        (out_tokens [1, gamma+1], n_accept [1], found flag, cache_t)
+
+    The driver below intentionally mirrors speculative_generate's loop
+    (same validation, prefill timing, eos truncation) with lookup state
+    instead of draft-model state — keep edits to either in sync.
+
+    `hist` is the full token sequence so far (prompt + emitted), valid
+    up to `hist_len`, with `cur_tok == hist[hist_len-1]`. The draft is
+    the gamma tokens FOLLOWING the most recent earlier occurrence of the
+    trailing `ngram` tokens; with no match the round degrades to a
+    plain (verified) single-token step. All index work happens on
+    device — no host sync inside the round.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def lookup_round(params_t, cache_t: KVCache, hist: jax.Array,
+                     hist_len: jax.Array, cur_tok: jax.Array):
+        pos0 = cache_t.pos
+        size = hist.shape[0]
+        pos_ar = jnp.arange(size, dtype=jnp.int32)
+
+        # positions p whose trailing ngram equals the CURRENT trailing
+        # ngram (hist[hist_len-ngram .. hist_len-1]); p itself must be
+        # strictly before the current position so the draft is history
+        match = (pos_ar >= ngram - 1) & (pos_ar < hist_len - 1)
+        for j in range(ngram):
+            h_at = hist[jnp.clip(pos_ar - j, 0, size - 1)]
+            match &= h_at == hist[jnp.clip(hist_len - 1 - j, 0, size - 1)]
+        p_best = jnp.max(jnp.where(match, pos_ar, -1))
+        found = p_best >= 0
+
+        draft = jnp.where(
+            found,
+            hist[jnp.clip(p_best + 1 + jnp.arange(gamma), 0, size - 1)],
+            0)[None, :]                                     # [1, gamma]
+        # proposals past the end of written history are stale guesses;
+        # they simply fail verification
+
+        verify_in = jnp.concatenate([cur_tok[:, None], draft], axis=1)
+        logits_t, cache_t = fwd_target(params_t, cfg_target, verify_in,
+                                       cache_t)             # [1, g+1, V]
+        target_pred = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+
+        matches = (draft == target_pred[:, :-1]) & found
+        n_accept = jnp.sum(
+            jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1)
+        idx = jnp.arange(gamma + 1)[None, :]
+        out = jnp.where(
+            idx < n_accept[:, None],
+            jnp.concatenate([draft, draft[:, -1:]], axis=1),
+            jnp.take_along_axis(target_pred, n_accept[:, None], axis=1))
+
+        new_pos = pos0 + n_accept[0] + 1
+        return out, n_accept, found, cache_t.reset_pos(new_pos)
+
+    return lookup_round
+
+
+def prompt_lookup_generate(
+    params: Any,
+    cfg: Any,
+    input_ids,                              # [S] or [1, S] ints
+    *,
+    family_forward: Callable,
+    family_prefill: Callable,
+    new_cache: Callable,                    # (cfg, batch, max_seq) -> KVCache
+    max_new_tokens: int = 128,
+    gamma: int = 8,
+    ngram: int = 2,
+    eos_token_id: Optional[int] = None,
+    max_seq: int = 2048,
+    kv_quantized: bool = False,
+    stats: Optional[SpecStats] = None,
+) -> np.ndarray:
+    """Greedy generation with prompt-lookup speculation. Returns new
+    tokens [1, <=N], identical to plain greedy decoding."""
+    ids = np.asarray(input_ids, np.int32)
+    if ids.ndim == 1:
+        ids = ids[None]
+    if ids.shape[0] != 1:
+        raise ValueError("prompt-lookup decoding supports batch size 1")
+    s = ids.shape[1]
+    if s + max_new_tokens + gamma + 1 > max_seq:
+        raise ValueError(f"prompt ({s}) + max_new_tokens "
+                         f"({max_new_tokens}) + gamma+1 ({gamma + 1}) "
+                         f"exceeds max_seq {max_seq}")
+
+    cache = new_cache(cfg, 1, max_seq, kv_quantized)
+    prefill = jax.jit(family_prefill, static_argnums=1, donate_argnums=3)
+
+    t0 = time.perf_counter()
+    logits, cache = prefill(params, cfg, jnp.asarray(ids), cache)
+    cur = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    cur_host = int(np.asarray(cur)[0])
+    if stats is not None:
+        stats.first_token_s = time.perf_counter() - t0
+
+    lookup_round = make_lookup_round(family_forward, cfg, gamma, ngram)
+
+    hist = np.zeros((max_seq,), np.int32)
+    hist[:s] = ids[0]
+    hist_len = s + 1
+    hist[s] = cur_host
+
+    out: List[int] = [cur_host]
+    while len(out) < max_new_tokens:
+        if eos_token_id is not None and out[-1] == eos_token_id:
+            break
+        t1 = time.perf_counter()
+        toks_r, n_acc, found, cache = lookup_round(
+            params, cache, jnp.asarray(hist),
+            jnp.asarray(hist_len, jnp.int32), cur)
+        toks_host = np.asarray(toks_r)[0]
+        n = int(np.asarray(n_acc)[0])
+        if stats is not None:
+            stats.rounds += 1
+            stats.accepted.append(n)
+            # a no-match round proposed NOTHING — recording gamma would
+            # deflate accept_rate vs draft-model speculation, whose
+            # driver records the true n_draft
+            stats.drafted.append(gamma if bool(np.asarray(found)) else 0)
+            stats.round_s.append(time.perf_counter() - t1)
+        emitted = list(toks_host[: n + 1])
+        if eos_token_id is not None and eos_token_id in emitted:
+            emitted = emitted[: emitted.index(eos_token_id) + 1]
+        out.extend(int(t) for t in emitted)
+        k = len(emitted)
+        hist[hist_len: hist_len + k] = emitted[:max(0, max_seq - hist_len)]
+        hist_len = min(hist_len + k, max_seq)
+        cur = toks_r[:, min(n, gamma)]
+    return np.asarray(out[:max_new_tokens], np.int32)[None]

@@ -140,6 +140,8 @@ class TpuCausalLM:
         gamma: int = 4,
         th_stop_draft: float = 0.8,
         auto_th_stop_draft: bool = True,
+        prompt_lookup: bool = False,
+        ngram: int = 2,
         spec_stats=None,
         visual=None,     # (vidx [B,S], vemb [Nv,D]) — multimodal prefill
         num_beams: int = 1,
@@ -159,6 +161,27 @@ class TpuCausalLM:
             eos_token_id = self.hf_config.get("eos_token_id")
             if isinstance(eos_token_id, list):
                 eos_token_id = eos_token_id[0]
+        # prompt-lookup speculation: n-gram drafts from the context, no
+        # draft model, exact greedy output (beyond the reference)
+        if (prompt_lookup and ids.shape[0] == 1 and visual is None
+                and num_beams <= 1 and not do_sample
+                and not self.family.is_recurrent):
+            from bigdl_tpu.speculative import prompt_lookup_generate
+
+            new = prompt_lookup_generate(
+                self.params, self.config, ids,
+                family_forward=self.family.forward,
+                family_prefill=self.family.prefill,
+                new_cache=self.family.new_cache,
+                max_new_tokens=max_new_tokens,
+                gamma=gamma,
+                ngram=ngram,
+                eos_token_id=eos_token_id,
+                max_seq=self.max_seq,
+                kv_quantized=self.kv_quantized,
+                stats=spec_stats,
+            )
+            return np.concatenate([ids, new], axis=1)
         # beam search preempts speculation: beams change WHICH sequence
         # is returned (semantics), speculation only changes latency
         if (self.draft_params is not None and ids.shape[0] == 1

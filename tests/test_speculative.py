@@ -134,3 +134,81 @@ def test_sampling_mode_runs_and_accepts_self_draft(prompt):
     assert np.all((out1 >= 0) & (out1 < TINY_LLAMA.vocab_size))
     # identical models: acceptance should be high (p == q)
     assert stats.mean_accept > 2.0, stats.accepted
+
+
+def test_prompt_lookup_matches_plain_greedy():
+    """Prompt-lookup speculation is EXACT: output identical to plain
+    greedy decoding, with and without n-gram matches in the prompt."""
+    from bigdl_tpu.generation import generate_on_device
+    from bigdl_tpu.speculative import prompt_lookup_generate
+
+    params = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=0)
+    prompts = [
+        # repetitive prompt: the 2-gram table has hits
+        np.array([5, 9, 3, 7, 5, 9, 3, 7, 5, 9], np.int32),
+        # no repetition
+        np.array([2, 11, 23, 31, 47, 59], np.int32),
+    ]
+    for prompt in prompts:
+        cache = llama_mod.new_cache(TINY_LLAMA, 1, 128)
+        want, _ = generate_on_device(
+            params, TINY_LLAMA, llama_mod.forward,
+            jnp.asarray(prompt[None]), cache, max_new_tokens=24)
+        stats = SpecStats()
+        got = prompt_lookup_generate(
+            params, TINY_LLAMA, prompt,
+            family_forward=llama_mod.forward,
+            family_prefill=llama_mod.forward_last_token,
+            new_cache=lambda c, b, s, q=False: llama_mod.new_cache(
+                c, b, s, quantized=q),
+            max_new_tokens=24, gamma=4, max_seq=128, stats=stats)
+        np.testing.assert_array_equal(np.asarray(want)[0], got[0])
+        assert stats.rounds > 0
+
+
+def test_prompt_lookup_accepts_on_repetition():
+    """Random-weight greedy decode settles into cycles — the lookup
+    draft must then accept > 0 tokens per round on average (fewer
+    target forwards than tokens)."""
+    from bigdl_tpu.speculative import prompt_lookup_generate
+
+    params = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=1)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    stats = SpecStats()
+    out = prompt_lookup_generate(
+        params, TINY_LLAMA, prompt,
+        family_forward=llama_mod.forward,
+        family_prefill=llama_mod.forward_last_token,
+        new_cache=lambda c, b, s, q=False: llama_mod.new_cache(
+            c, b, s, quantized=q),
+        max_new_tokens=48, gamma=6, max_seq=128, stats=stats)
+    assert out.shape[1] == 48
+    # greedy cycles -> fewer target forwards than emitted tokens, with
+    # real acceptances once the cycle enters the n-gram table
+    assert stats.rounds < 48, stats.rounds
+    assert sum(stats.accepted) > 0, stats.accepted
+
+
+def test_prompt_lookup_eos_stops():
+    from bigdl_tpu.speculative import prompt_lookup_generate
+
+    params = random_llama_params(TINY_LLAMA, qtype="sym_int4", seed=0)
+    prompt = np.array([5, 9, 3, 7, 5, 9, 3, 7], np.int32)
+    # run once to learn what tokens come out, pick one as "eos"
+    free = prompt_lookup_generate(
+        params, TINY_LLAMA, prompt,
+        family_forward=llama_mod.forward,
+        family_prefill=llama_mod.forward_last_token,
+        new_cache=lambda c, b, s, q=False: llama_mod.new_cache(
+            c, b, s, quantized=q),
+        max_new_tokens=16, gamma=4, max_seq=128)
+    eos = int(free[0, 5])
+    out = prompt_lookup_generate(
+        params, TINY_LLAMA, prompt,
+        family_forward=llama_mod.forward,
+        family_prefill=llama_mod.forward_last_token,
+        new_cache=lambda c, b, s, q=False: llama_mod.new_cache(
+            c, b, s, quantized=q),
+        max_new_tokens=16, gamma=4, max_seq=128, eos_token_id=eos)
+    assert eos in out[0]
+    assert list(out[0]).index(eos) == len(out[0]) - 1
