@@ -383,7 +383,35 @@ def _ordered_configs(run_dir: str) -> list:
     return healthy + demoted
 
 
+def _acquire_single_instance(max_wait_s: int = 2700):
+    """One full bench run at a time: the driver's round-end invocation
+    must not fight the watcher's in-flight window run for the chip (and
+    the libtpu lockfile). Blocks up to max_wait_s for the other run to
+    finish — its compiles land in the shared cache, so waiting is
+    cheaper than contending — then proceeds regardless. Returns the
+    held file object (kept open for the process lifetime) or None."""
+    import fcntl
+
+    os.makedirs(RUN_DIR, exist_ok=True)
+    f = open(os.path.join(RUN_DIR, "bench.lock"), "w")
+    deadline = time.time() + max_wait_s
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return f
+        except OSError:
+            if time.time() > deadline:
+                print("bench: another bench run still holds the lock "
+                      f"after {max_wait_s}s — proceeding anyway",
+                      file=sys.stderr)
+                return None
+            print("bench: waiting for an in-flight bench run to finish",
+                  file=sys.stderr)
+            time.sleep(min(30.0, max(1.0, deadline - time.time())))
+
+
 def main() -> None:
+    _lock = _acquire_single_instance()
     # probe BEFORE importing jax here: a wedged TPU tunnel would hang this
     # process with no recourse (import-time probing would tax every
     # `import bench` too, so it lives in main())
