@@ -364,7 +364,11 @@ def _ordered_configs(run_dir: str) -> list:
                 for ln in f:
                     rec = json.loads(ln)
                     if "error" in rec and not rec.get("no_fault"):
-                        faults.add(rec.get("config"))
+                        # fast fails (clean exception within seconds)
+                        # are attributable but not wedge-capable — run
+                        # them in normal order so a fix lands same-day
+                        if not rec.get("fast_fail"):
+                            faults.add(rec.get("config"))
                         attributable = True
                     elif "next_token_ms" in rec:
                         attributable = True
@@ -563,6 +567,13 @@ def main() -> None:
             print(f"bench[{label}]: TIMEOUT", file=sys.stderr)
         except Exception as e:
             ab_results[label] = {"error": f"{type(e).__name__}: {e}"}
+            # a config that failed FAST (clean subprocess exit, no
+            # timeout) cannot have wedged the window; demoting it would
+            # delay a since-fixed retry behind the whole matrix
+            # (2026-08-02: the 3 mxu-layout configs died in seconds on a
+            # D2H bug fixed the same window)
+            if time.time() - t0 < 120:
+                ab_results[label]["fast_fail"] = True
             print(f"bench[{label}]: FAILED {e}", file=sys.stderr)
         tunnel_dead = False
         if "error" in ab_results[label]:

@@ -186,9 +186,14 @@ def _gemv_kernel_fold(x3_ref, data_ref, scale_ref, out_ref, acc_ref, *,
     the standard body. Asym formats keep the standard kernel (the
     zero-point adds a rank-1 correction term not worth the fuss).
 
-    x arrives PRE-SPLIT as [M, K/block, block] (host-side reshape):
-    splitting x's lane dimension inside the kernel is a Mosaic
-    "unsupported shape cast" (caught by the AOT suite)."""
+    x arrives PRE-SPLIT as [K/block, M, block] (host-side reshape +
+    transpose): splitting x's lane dimension inside the kernel is a
+    Mosaic "unsupported shape cast" (caught by the AOT suite), and the
+    batch (scale-block) axis must sit at the SAME position in both dot
+    operands — the chip-side Mosaic rejects lhs-batch-at-1/rhs-batch-
+    at-0 with "batch dims must be equal" (seen live 2026-08-02; the
+    offline Mosaic accepted it, a version skew the AOT gate can't
+    see)."""
     k = pl.program_id(1)
     rows = bk // block
 
@@ -210,9 +215,9 @@ def _gemv_kernel_fold(x3_ref, data_ref, scale_ref, out_ref, acc_ref, *,
     else:                                        # sym int8
         cb = data_ref[:].reshape(rows, block, bn).astype(jnp.bfloat16)
 
-    # batched over scale blocks: [M, rows, B] x [rows, B, bn]
+    # batched over scale blocks: [rows, M, B] x [rows, B, bn]
     part = jax.lax.dot_general(
-        x3_ref[:], cb, (((2,), (1,)), ((1,), (0,))),
+        x3_ref[:], cb, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32)      # [rows, M, bn]
     s = scale_ref[:].astype(jnp.float32)         # [rows, bn]
     acc_ref[:] += jnp.sum(part * s[:, None, :], axis=0)
@@ -243,7 +248,7 @@ def _gemv_kernel_mxu(x3_ref, data_ref, scale_ref, out_ref, acc_ref, *,
 
     cb = data_ref[:].astype(jnp.bfloat16).reshape(rows, block, bn)
     part = jax.lax.dot_general(
-        x3_ref[:], cb, (((2,), (1,)), ((1,), (0,))),
+        x3_ref[:], cb, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32)          # [rows, M, bn]
     s = scale_ref[:].astype(jnp.float32)             # [rows, bn]
     acc_ref[:] += jnp.sum(part * s[:, None, :], axis=0)
@@ -288,7 +293,7 @@ def _gemv_kernel_mxu8(x3_ref, sxt_ref, data_ref, scale_ref, out_ref,
 
     cb = data_ref[:].astype(jnp.int8).reshape(rows, block, bn)
     part = jax.lax.dot_general(
-        x3_ref[:], cb, (((2,), (1,)), ((1,), (0,))),
+        x3_ref[:], cb, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.int32)            # [rows, M, bn]
     s = scale_ref[:].astype(jnp.float32)             # [rows, bn]
     sxt = sxt_ref[:].astype(jnp.float32)             # [rows, M]
@@ -429,9 +434,11 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
                 f"(got {w.data.dtype}); apply quant.to_mxu_layout")
         data_spec = pl.BlockSpec((bk, bn), lambda j, k: (k, j))
         # x pre-split per scale block OUTSIDE the kernel (lane-dim
-        # reshapes inside are a Mosaic unsupported shape cast)
-        x3 = x2.reshape(mp, kp // b, b)
-        x3_spec = pl.BlockSpec((mp, bk // b, b), lambda j, k: (0, k, 0))
+        # reshapes inside are a Mosaic unsupported shape cast), blocks
+        # leading so the batched dot's batch dims align (see
+        # _gemv_kernel_fold docstring)
+        x3 = x2.reshape(mp, kp // b, b).transpose(1, 0, 2)
+        x3_spec = pl.BlockSpec((bk // b, mp, b), lambda j, k: (k, 0, 0))
         if variant == "mxuflat":
             kernel = functools.partial(
                 _gemv_kernel_mxuflat, block=b, bk=bk, bn=bn, nk=nk)
@@ -446,12 +453,11 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
             # per-block q8 activation quantization (VPU work over just
             # M x K elements, fused into the surrounding jit by XLA)
             xf = x3.astype(jnp.float32)
-            amax = jnp.max(jnp.abs(xf), axis=-1)              # [mp, K/b]
-            sx = amax * (1.0 / 127.0)
-            inv = jnp.where(sx == 0, 0.0,
-                            1.0 / jnp.where(sx == 0, 1.0, sx))
+            amax = jnp.max(jnp.abs(xf), axis=-1)              # [K/b, mp]
+            sxt = amax * (1.0 / 127.0)
+            inv = jnp.where(sxt == 0, 0.0,
+                            1.0 / jnp.where(sxt == 0, 1.0, sxt))
             xq = jnp.round(xf * inv[..., None]).astype(jnp.int8)
-            sxt = sx.T                                        # [K/b, mp]
             sxt_spec = pl.BlockSpec((bk // b, mp), lambda j, k: (k, 0))
             kernel = functools.partial(
                 _gemv_kernel_mxu8, block=b, bk=bk, bn=bn, nk=nk)
@@ -463,8 +469,9 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
             bk=bk, bn=bn, nk=nk, bits=bits)
         data_spec = pl.BlockSpec((bk // 2 if bits == 4 else bk, bn),
                                  lambda j, k: (k, j))
-        operands = [x2.reshape(mp, kp // b, b), w.data, w.scale]
-        in_specs = [pl.BlockSpec((mp, bk // b, b), lambda j, k: (0, k, 0)),
+        operands = [x2.reshape(mp, kp // b, b).transpose(1, 0, 2),
+                    w.data, w.scale]
+        in_specs = [pl.BlockSpec((bk // b, mp, b), lambda j, k: (k, 0, 0)),
                     data_spec, scale_spec]
     else:
         kernel = functools.partial(

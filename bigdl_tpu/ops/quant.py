@@ -1109,17 +1109,128 @@ def to_mxu_layout(qt: QTensor) -> QTensor:
         blk = packed.reshape(*lead, k2 // b2, b2, n)
         return dataclasses.replace(
             qt, data=unpack(blk, jnp, jnp.int8, jnp.int4))
-    # concrete weights convert on HOST: the device expansion would
-    # materialize ~4x the packed bytes (uint8 codes + int8) as a
-    # transient next to the resident model — a multi-GB load-time HBM
-    # spike for 7B stacked leaves (same rationale as parallel/tp.py
-    # _pad_axis). numpy's ml_dtypes int4 transfers straight to a
-    # bit-packed device array.
-    import ml_dtypes
+    def host_convert(host):
+        # numpy's ml_dtypes int4 transfers straight to a bit-packed
+        # device array with the layout every consumer expects.
+        import ml_dtypes
 
-    host = np.asarray(packed).reshape(*lead, k2 // b2, b2, n)
-    data = jnp.asarray(unpack(host, np, np.int8, ml_dtypes.int4))
-    return dataclasses.replace(qt, data=data)
+        host = host.reshape(*lead, k2 // b2, b2, n)
+        return jnp.asarray(unpack(host, np, np.int8, ml_dtypes.int4))
+
+    if isinstance(packed, np.ndarray):
+        return dataclasses.replace(qt, data=host_convert(packed))
+    # Concrete DEVICE weights convert on device, chunked. Two wrong
+    # ways, both hit live: a host round-trip (np.asarray) dies on the
+    # axon tunnel — D2H of device uint8 arrays is UNIMPLEMENTED
+    # (2026-08-02 window, the shipped-default bench config failed at
+    # load); an unchunked device expansion materializes ~4x the packed
+    # bytes (uint8 codes + int8) as a transient next to the resident
+    # model — a multi-GB load-time HBM spike for 7B stacked leaves.
+    # lax.map over the superblock axis bounds the transient to one
+    # [b2, n] row group. Every step is belt-and-braces guarded: an
+    # experimental backend (axon) has runtime gaps we can only discover
+    # live, and a failed relayout must degrade to the canonical packing
+    # (28.6 ms/token on the split-block kernels) rather than kill the
+    # load.
+    import logging
+
+    log = logging.getLogger(__name__)
+    try:
+        return dataclasses.replace(
+            qt, data=_mxu_unpack_device(packed, b2))
+    except Exception as e:  # noqa: BLE001 — backend gaps surface as
+        #                     JaxRuntimeError/RecursionError/TypeError
+        log.warning("device-side int4 relayout failed (%s: %s); "
+                    "trying the host round-trip", type(e).__name__, e)
+    try:
+        return dataclasses.replace(
+            qt, data=host_convert(np.asarray(packed)))
+    except Exception as e:  # noqa: BLE001
+        log.warning("host round-trip relayout also failed (%s: %s); "
+                    "keeping the canonical split-block layout",
+                    type(e).__name__, e)
+        return qt
+
+
+@functools.lru_cache(maxsize=None)
+def _mxu_unpack_jit(rank: int, b2: int, device):
+    """Jitted split-block packed uint8 -> int4 codes (see to_mxu_layout).
+
+    The output layout is pinned to row-major default: left to the
+    compiler, this program emits an exotic int4 layout
+    ({1,2,0:T(64,128)}, seen live 2026-08-02) that differs from what a
+    host->device transfer produces — and any downstream executable
+    compiled against transferred weights (e.g. out of the persistent
+    compile cache) then needs an implicit relayout device_put at
+    dispatch, which trips JAX's "Recursively calling jit" guard."""
+
+    def impl(packed):
+        *lead, k2, n = packed.shape
+        blk = packed.reshape(-1, b2, n)
+
+        def step(rows):
+            codes = jnp.concatenate([rows & 0x0F, rows >> 4], axis=-2)
+            return (codes.astype(jnp.int8) - jnp.int8(8)).astype(jnp.int4)
+
+        out = jax.lax.map(step, blk)        # [S, 2*b2, n] int4
+        return out.reshape(*lead, k2 * 2, n)
+
+    try:
+        from jax.experimental.layout import Format, Layout
+        from jax.sharding import SingleDeviceSharding
+
+        fmt = Format(Layout(tuple(range(rank))),
+                     SingleDeviceSharding(device))
+        return jax.jit(impl, out_shardings=fmt)
+    except (ImportError, TypeError, ValueError) as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "int4 relayout jit: could not pin the row-major output "
+            "layout (%s: %s) — compiler-chosen layouts risk an implicit "
+            "relayout at downstream dispatch", type(e).__name__, e)
+        return jax.jit(impl)
+
+
+@functools.lru_cache(maxsize=None)
+def _mxu_ref_format(rank: int, device):
+    """The Format a host->device int4 transfer produces on `device`.
+
+    Compiled consumers (including executables revived from the
+    persistent compile cache, which were built against transferred
+    weights) expect exactly this layout; handing them anything else
+    forces an implicit relayout device_put inside dispatch arg-prep,
+    which JAX 0.9 rejects with "Recursively calling jit". major_to_minor
+    alone is not enough — the live failure showed a row-major but
+    differently-TILED arg ({2,1,0:T(64,128)} vs the transfer default) —
+    so the reference is measured, not assumed: transfer one tile and
+    read its format."""
+    import ml_dtypes
+    from jax.experimental.layout import Format
+    from jax.sharding import SingleDeviceSharding
+
+    probe = np.zeros((1,) * (rank - 2) + (8, 128), ml_dtypes.int4)
+    arr = jax.device_put(probe, device)
+    return Format(arr.format.layout, SingleDeviceSharding(device))
+
+
+def _mxu_unpack_device(packed, b2: int):
+    dev = next(iter(packed.devices())) if hasattr(packed, "devices") \
+        else None
+    out = _mxu_unpack_jit(packed.ndim, b2, dev)(packed)
+    try:
+        fmt = _mxu_ref_format(out.ndim, dev)
+        if out.format.layout != fmt.layout:
+            # eager relayout: a device_put OUTSIDE any dispatch is legal
+            # and runs as one compiled on-device copy
+            out = jax.device_put(out, fmt)
+    except Exception as e:  # noqa: BLE001 — probe is best-effort
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "int4 layout normalization skipped (%s: %s)",
+            type(e).__name__, e)
+    return out
 
 
 def from_mxu_layout(qt: QTensor) -> QTensor:
