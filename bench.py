@@ -247,7 +247,26 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     weight_bytes = sum(
         leaf.nbytes for leaf in jax.tree_util.tree_leaves(
             params, is_leaf=lambda x: isinstance(x, QTensor)))
+    # observability snapshot rides along in the JSON: TTFT/TPOT sample
+    # distributions from this run's timing iters plus whatever the
+    # default registry accumulated (kernel probe outcomes, speculative
+    # acceptance when a spec bench ran in-process)
+    from bigdl_tpu.observability.metrics import (MetricsRegistry,
+                                                 default_registry)
+
+    obs = MetricsRegistry()
+    ttft_h = obs.histogram("bigdl_tpu_ttft_seconds",
+                           "Prefill + first token wall time.")
+    for f in firsts:
+        ttft_h.observe(f / 1e3)
+    obs.histogram("bigdl_tpu_tpot_seconds",
+                  "Differenced per-token decode time.").observe(
+        next_ms / 1e3)
+    obs_summary = obs.summary()
+    obs_summary.update(default_registry().summary())
+
     return {
+        "observability": obs_summary,
         "first_token_ms": round(max(first_raw - overhead_ms, 0.0), 3),
         "first_token_ms_raw": round(first_raw, 3),
         "next_token_ms": round(next_ms, 3),
@@ -532,7 +551,8 @@ def main() -> None:
                      "final_token": raw["final_token"],
                      "weight_bytes": raw["weight_bytes"],
                      "qtype": raw["qtype"],
-                     "kv_quantized": raw["kv_quantized"]}
+                     "kv_quantized": raw["kv_quantized"],
+                     "observability": raw.get("observability", {})}
             if raw["next_token_ms"] < dfloor or \
                     raw["first_token_ms"] < pfloor:
                 entry["invalid"] = (
@@ -637,6 +657,7 @@ def main() -> None:
         valid=True,
         first_token_ms=round(first_ms, 3),
         best_config=best,
+        observability=ok[best].get("observability", {}),
     )
     if fastest != best:
         record["fastest_config"] = fastest

@@ -84,11 +84,16 @@ def _bench_generate(model, prompt, out_len, num_trials, warm_up):
 
 
 def _bench_serving(model, prompt, out_len, num_trials, warm_up):
+    from bigdl_tpu.observability.metrics import MetricsRegistry
     from bigdl_tpu.serving import EngineConfig, LLMEngine, SamplingParams
 
     batch = 4
+    # fresh registry per bench: the output rows report THIS run's
+    # TTFT/TPOT distributions, not process-lifetime accumulation
+    reg = MetricsRegistry()
     eng = LLMEngine(model, EngineConfig(
-        max_batch=batch, max_seq=model.max_seq, prefix_cache_entries=0))
+        max_batch=batch, max_seq=model.max_seq, prefix_cache_entries=0),
+        registry=reg)
     prompts = [((prompt * (i + 3)) % model.config.vocab_size).tolist()
                for i in range(2 * batch)]
     sp = SamplingParams(max_tokens=out_len)
@@ -100,8 +105,17 @@ def _bench_serving(model, prompt, out_len, num_trials, warm_up):
         outs = eng.generate(prompts, sp)
         wall = time.perf_counter() - t0
         best = max(best, sum(len(o) for o in outs) / wall)
-    return {"serving_tokens_per_s": round(best, 2),
-            "batch": batch, "requests": len(prompts)}
+    summary = reg.summary()
+    out = {"serving_tokens_per_s": round(best, 2),
+           "batch": batch, "requests": len(prompts),
+           "observability": summary}
+    ttft = summary.get("bigdl_tpu_ttft_seconds")
+    if isinstance(ttft, dict):
+        out["ttft_p50_ms"] = round(ttft["p50"] * 1e3, 3)
+    tpot = summary.get("bigdl_tpu_tpot_seconds")
+    if isinstance(tpot, dict):
+        out["tpot_p50_ms"] = round(tpot["p50"] * 1e3, 3)
+    return out
 
 
 def _bench_explicit_tp(model, prompt, out_len, num_trials, warm_up):
@@ -155,6 +169,17 @@ def run_one(model_path: str, low_bit: str, in_len: int, out_len: int,
                "explicit_tp": _bench_explicit_tp,
                "gspmd_tp": _bench_gspmd_tp}.get(api, _bench_generate)
     metrics = harness(model, prompt, out_len, num_trials, warm_up)
+    if api == "speculative":
+        # the spec drivers publish acceptance to the default registry
+        # (speculative._spec_observe); surface it in the row
+        from bigdl_tpu.observability.metrics import default_registry
+
+        summary = default_registry().summary()
+        acc = {k: v for k, v in summary.items()
+               if k.startswith(("bigdl_tpu_spec_accept_ratio",
+                                "bigdl_tpu_spec_tokens_total"))}
+        if acc:
+            metrics["observability"] = acc
     return {
         "model": model_path,
         "low_bit": low_bit,

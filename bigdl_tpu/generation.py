@@ -424,6 +424,12 @@ class Generator:
             sample_pen, static_argnames=("temperature", "top_k", "top_p",
                                          "rep", "pres", "freq"))
         self._counts = jax.jit(token_counts, static_argnums=(1,))
+        # phase timing published as bigdl_tpu_generate_{prefill,decode}
+        # _seconds histograms (observability registry); .summary() gives
+        # the host-side view
+        from bigdl_tpu.utils.profiling import StepTimer
+
+        self.step_timer = StepTimer(metrics_prefix="bigdl_tpu_generate")
 
     def _bucket(self, n: int) -> int:
         """Round prompt length up to a power-of-two bucket to bound the
@@ -551,6 +557,7 @@ class Generator:
         key, sk = jax.random.split(key)
         tok = sample(logits[:, -1, :], sk)
         tok_host = np.asarray(tok)
+        self.step_timer.record("prefill", time.perf_counter() - t0)
         if stats is not None:
             stats.first_token_s = time.perf_counter() - t0
 
@@ -575,6 +582,7 @@ class Generator:
                 tok = jnp.where(finished_dev, 0, tok)
                 finished_dev = finished_dev | (tok == gen.eos_token_id)
             tok_host = np.asarray(tok)
+            self.step_timer.record("decode", time.perf_counter() - t1)
             if stats is not None:
                 stats.rest_token_s.append(time.perf_counter() - t1)
             yield tok_host

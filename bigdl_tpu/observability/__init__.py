@@ -1,0 +1,62 @@
+"""Dependency-free metrics + request tracing for bigdl_tpu.
+
+Two pieces, both stdlib-only (jax is allowed elsewhere in the package
+but this subpackage must import with nothing beyond the standard
+library — tests/test_observability.py enforces it):
+
+- ``metrics``: Counter / Gauge / Histogram registry with labels and
+  Prometheus text exposition (``MetricsRegistry.render()``). The
+  serving engine, speculative decoders, kernel probes and StepTimer all
+  publish here; ``GET /metrics`` on the API server renders the
+  engine's registry.
+- ``tracing``: per-request lifecycle spans (queue wait, prefill, TTFT,
+  decode/TPOT, preemptions) kept in a ring buffer and optionally
+  appended as JSONL to ``$BIGDL_TPU_EVENT_LOG``; ``GET /v1/stats``
+  serves the snapshot.
+
+Metric name -> engine field map (see also serving/engine.py):
+
+==========================================  ===============================
+metric                                      source
+==========================================  ===============================
+bigdl_tpu_request_phase_seconds{phase=...}  RequestSpan queue/prefill/decode
+bigdl_tpu_ttft_seconds                      RequestSpan.ttft_s
+bigdl_tpu_tpot_seconds                      LLMEngine.step() decode timing
+bigdl_tpu_slot_occupancy                    len(LLMEngine._slots)
+bigdl_tpu_queue_depth                       len(LLMEngine._queue)
+bigdl_tpu_admissions_total                  LLMEngine._admission_step
+bigdl_tpu_preemptions_total                 LLMEngine._preempt
+bigdl_tpu_stall_guard_trips_total           LLMEngine._stall_steps trip
+bigdl_tpu_requests_finished_total{reason}   LLMEngine._finish
+bigdl_tpu_engine_steps_total                LLMEngine.step
+bigdl_tpu_tokens_generated_total            LLMEngine._emit
+bigdl_tpu_kernel_probe_total{kernel,...}    ops/probing.record_probe_result
+bigdl_tpu_spec_accept_ratio{mode}           speculative._spec_observe
+bigdl_tpu_spec_round_seconds{mode}          speculative._spec_observe
+bigdl_tpu_spec_tokens_total{mode,kind}      speculative._spec_observe
+==========================================  ===============================
+"""
+
+from bigdl_tpu.observability.metrics import (
+    LATENCY_BUCKETS_S,
+    RATIO_BUCKETS,
+    MetricFamily,
+    MetricsRegistry,
+    default_registry,
+)
+from bigdl_tpu.observability.tracing import (
+    RequestSpan,
+    RequestTracer,
+    validate_event_log_path,
+)
+
+__all__ = [
+    "LATENCY_BUCKETS_S",
+    "RATIO_BUCKETS",
+    "MetricFamily",
+    "MetricsRegistry",
+    "default_registry",
+    "RequestSpan",
+    "RequestTracer",
+    "validate_event_log_path",
+]

@@ -78,7 +78,8 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
         else:
             from bigdl_tpu.ops.pallas.prefill_attention import (
                 prefill_attention_pallas as kernel)
-        from bigdl_tpu.ops.probing import probe_compile
+        from bigdl_tpu.ops.probing import (probe_compile,
+                                           record_probe_result)
 
         # The probe is usually reached while TRACING a model's outer jit;
         # compile-only AOT probing (see ops/probing.py) never executes,
@@ -93,10 +94,14 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
             jax.ShapeDtypeStruct((1, skv, hkv, hd), kdt),
             jax.ShapeDtypeStruct((), jnp.int32))
         _probe_cache[key] = True
+        record_probe_result(f"{kind}_attention", True)
         return True
     except Exception as e:
         import logging
 
+        from bigdl_tpu.ops.probing import record_probe_result
+
+        record_probe_result(f"{kind}_attention", False)
         msg = f"{type(e).__name__}: {e}".lower()
         permanent = any(mk in msg for mk in _COMPILE_ERROR_MARKERS)
         if not permanent:

@@ -216,6 +216,9 @@ def vmapped_pallas_ok(qtype: str, k: int = 256, n: int = 256) -> bool:
             "(%s: %s); MoE decode gather uses the XLA matmul", qtype,
             k, n, type(e).__name__, e)
         ok = False
+    from bigdl_tpu.ops.probing import record_probe_result
+
+    record_probe_result("vmapped_gemm", ok)
     _VMAPPED_PALLAS[key] = ok
     return ok
 

@@ -390,6 +390,9 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int,
             "%s) — %s: %s; using the generic tiles", variant, kp, n,
             qtype, type(e).__name__, e)
         ok = False
+    from bigdl_tpu.ops.probing import record_probe_result
+
+    record_probe_result(f"gemv_{variant}", ok)
     _gemv_probe_cache[key] = ok
     return ok
 

@@ -211,6 +211,9 @@ def ragged_kernel_compiles(qtype: Optional[str], k: int, n: int) -> bool:
             "(%s: %s); using the dense combine path", k, n, qtype,
             type(e).__name__, e)
         ok = False
+    from bigdl_tpu.ops.probing import record_probe_result
+
+    record_probe_result("moe_ragged", ok)
     _probe_cache[key] = ok
     return ok
 

@@ -27,6 +27,25 @@ import jax
 import jax.numpy as jnp
 
 
+def record_probe_result(kernel: str, ok: bool) -> None:
+    """Count a probe outcome in the observability registry
+    (bigdl_tpu_kernel_probe_total{kernel, outcome="compiled"|"fallback"}).
+    Every dispatch-site probe calls this exactly once per new geometry,
+    making the round-3 failure class — every kernel silently pinned to
+    XLA — visible on /metrics."""
+    try:
+        from bigdl_tpu.observability.metrics import default_registry
+
+        default_registry().counter(
+            "bigdl_tpu_kernel_probe_total",
+            "Kernel compile-probe outcomes "
+            "(compiled vs XLA fallback) per kernel.",
+            labelnames=("kernel", "outcome"),
+        ).labels(kernel, "compiled" if ok else "fallback").inc()
+    except Exception:
+        pass  # telemetry must never break dispatch
+
+
 def probe_compile(fn, *arg_structs) -> None:
     """AOT-compile `fn` for the ambient backend from abstract shapes.
 

@@ -11,6 +11,14 @@ Endpoints: GET /v1/models, POST /v1/completions, POST /v1/chat/completions
 (stream=true -> text/event-stream chunks, OpenAI wire format), and
 POST /v1/embeddings when constructed with an embedder (BertEmbedder).
 
+Observability endpoints (bigdl_tpu/observability/):
+- GET /metrics — Prometheus text exposition of the engine's registry
+- GET /v1/stats — JSON engine snapshot (slots, queues, metric
+  summaries, recent request spans)
+- POST /v1/profiler/start {"log_dir": ...} / POST /v1/profiler/stop —
+  on-demand jax.profiler device trace against the live server
+  (TensorBoard/Perfetto; wraps utils/profiling.start_profiler)
+
 Tokenization: pass a HF tokenizer (transformers.AutoTokenizer) at
 construction; prompts may also be raw token-id lists, in which case
 completions return token ids (useful for tests and token-level clients).
@@ -332,6 +340,17 @@ class OpenAIServer:
                         {"id": server.model_name, "object": "model"}]})
                 elif self.path in ("/health", "/ping"):
                     self._json(200, {"status": "ok"})
+                elif self.path == "/metrics":
+                    body = server.engine.registry.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/v1/stats":
+                    self._json(200, server.engine.stats_snapshot())
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -348,9 +367,30 @@ class OpenAIServer:
                         return self._completions(body, chat=True)
                     if self.path == "/v1/embeddings":
                         return self._embeddings(body)
+                    if self.path == "/v1/profiler/start":
+                        return self._profiler(body, start=True)
+                    if self.path == "/v1/profiler/stop":
+                        return self._profiler(body, start=False)
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
                 self._json(404, {"error": "not found"})
+
+            def _profiler(self, body: dict, start: bool):
+                from bigdl_tpu.utils import profiling
+
+                try:
+                    if start:
+                        log_dir = body.get("log_dir")
+                        if not log_dir:
+                            return self._json(
+                                400, {"error": "'log_dir' required"})
+                        out = profiling.start_profiler(log_dir)
+                    else:
+                        out = profiling.stop_profiler()
+                except RuntimeError as e:
+                    # double-start / stop-without-start
+                    return self._json(409, {"error": str(e)})
+                self._json(200, out)
 
             def _embeddings(self, body: dict):
                 if server.embedder is None or \

@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.observability.metrics import RATIO_BUCKETS, default_registry
+from bigdl_tpu.observability.tracing import RequestTracer
 from bigdl_tpu.ops.kvcache import KVCache, init_cache
 
 
@@ -228,7 +230,7 @@ class LLMEngine:
     """
 
     def __init__(self, model: Any, config: Optional[EngineConfig] = None,
-                 cp_mesh: Any = None):
+                 cp_mesh: Any = None, registry=None, tracer=None):
         self.cfg_engine = config or EngineConfig()
         self.params = model.params
         self.cfg = model.config
@@ -381,6 +383,62 @@ class LLMEngine:
         # insertion (LRU) order — host DRAM, not HBM
         self._prefix_cache: Dict[Tuple[int, ...], Tuple[Any, Any]] = {}
 
+        # -- observability (bigdl_tpu/observability/__init__.py has the
+        # full metric-name <-> engine-field map). Families are
+        # get-or-create, so sharing a registry across engines or with the
+        # probe/spec sites is safe.
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.tracer = tracer if tracer is not None else RequestTracer()
+        m = self.registry
+        self._m_phase = m.histogram(
+            "bigdl_tpu_request_phase_seconds",
+            "Per-request phase latency (queue wait, prefill, decode).",
+            labelnames=("phase",))
+        for ph in ("queue", "prefill", "decode"):   # render from scrape 1
+            self._m_phase.labels(ph)
+        self._m_ttft = m.histogram(
+            "bigdl_tpu_ttft_seconds",
+            "Time to first token: arrival to first sampled token.")
+        self._m_tpot = m.histogram(
+            "bigdl_tpu_tpot_seconds",
+            "Time per output token: batched decode step wall time "
+            "(every active stream advances one token per step).")
+        self._m_occupancy = m.gauge(
+            "bigdl_tpu_slot_occupancy", "Active decode slots.")
+        self._m_queue_depth = m.gauge(
+            "bigdl_tpu_queue_depth",
+            "Requests waiting for admission (slot + CP lanes).")
+        self._m_admissions = m.counter(
+            "bigdl_tpu_admissions_total",
+            "Completed admissions (prefill finished, slot running).")
+        self._m_preemptions = m.counter(
+            "bigdl_tpu_preemptions_total",
+            "Sequences evicted to the queue by the starvation guard.")
+        self._m_stall_trips = m.counter(
+            "bigdl_tpu_stall_guard_trips_total",
+            "Times the stall guard reached preempt_after_steps.")
+        self._m_finished = m.counter(
+            "bigdl_tpu_requests_finished_total",
+            "Finished sequences by reason.", labelnames=("reason",))
+        self._m_steps = m.counter(
+            "bigdl_tpu_engine_steps_total",
+            "step() iterations that did work.")
+        self._m_tokens = m.counter(
+            "bigdl_tpu_tokens_generated_total",
+            "Tokens emitted to clients.")
+        # pre-register the families fed by ops/probing.py and
+        # speculative.py so /metrics exposes them before the first
+        # probe or speculative round runs in this process
+        m.counter("bigdl_tpu_kernel_probe_total",
+                  "Kernel compile-probe outcomes "
+                  "(compiled vs XLA fallback) per kernel.",
+                  labelnames=("kernel", "outcome"))
+        m.histogram("bigdl_tpu_spec_accept_ratio",
+                    "Speculative decoding acceptance ratio per "
+                    "verify round.", labelnames=("mode",),
+                    buckets=RATIO_BUCKETS)
+
     # -- public api ---------------------------------------------------------
 
     def add_request(self, request_id: str, prompt_token_ids, params=None):
@@ -428,9 +486,15 @@ class LLMEngine:
                     params, n=1, best_of=None,
                     seed=None if params.seed is None else params.seed + i)
                 self._children[cid] = (request_id, i)
-                target.append(Request(cid, list(ids), cparams))
+                creq = Request(cid, list(ids), cparams)
+                self.tracer.start(cid, prompt_len=len(ids),
+                                  t_arrival=creq.arrival)
+                target.append(creq)
             return
-        target.append(Request(request_id, ids, params))
+        req = Request(request_id, ids, params)
+        self.tracer.start(request_id, prompt_len=len(ids),
+                          t_arrival=req.arrival)
+        target.append(req)
 
     def abort_request(self, request_id: str) -> None:
         """Reference api_server behavior on client disconnect
@@ -490,6 +554,7 @@ class LLMEngine:
                     self._abort.discard(cand.request_id)
                     self._push_output(cand.request_id, RequestOutput(
                         cand.request_id, [], True, "abort"))
+                    self._obs_finish(cand.request_id, "abort")
                     cand = None
                 req = cand
             if req is None:
@@ -516,6 +581,7 @@ class LLMEngine:
                                  jnp.asarray(consumed, jnp.int32))
             a = self._admitting = _Admission(req, free, bucket, consumed,
                                              cache1)
+            self.tracer.admitted(req.request_id)
 
         if a.req.request_id in self._abort:      # aborted mid-admission
             self._abort.discard(a.req.request_id)
@@ -544,6 +610,7 @@ class LLMEngine:
             s.generated = [int(first)]
             s.last_token = int(first)
             s.active = True
+            self._obs_admission_complete(a.req.request_id)
             self._emit(s, lp)
             self._check_done(a.slot_idx)
             self._admitting = None
@@ -620,6 +687,7 @@ class LLMEngine:
     def _finish_admission_abort(self, a: _Admission) -> None:
         self._push_output(a.req.request_id, RequestOutput(
             a.req.request_id, [], True, "abort"))
+        self._obs_finish(a.req.request_id, "abort")
         self._admitting = None
 
     def _setup_slot_sampler(self, s: _Slot) -> None:
@@ -801,6 +869,53 @@ class LLMEngine:
             self._abort.discard(f"{fo.parent_id}#{i}")   # no leaks
         self._fanouts.pop(fo.parent_id, None)
 
+    # -- observability hooks ------------------------------------------------
+
+    def _obs_admission_complete(self, rid: str) -> None:
+        """First token of an admission just sampled: close out the queue
+        and prefill phases, record TTFT (first admission only — a
+        preempt-resume already streamed its first token)."""
+        span = self.tracer.get(rid)
+        now = time.time()
+        just_first = span is not None and span.t_first_token is None
+        if span is not None and span.t_admitted is not None:
+            qw = span.queue_wait_s
+            if qw is not None and qw >= 0:
+                self._m_phase.labels("queue").observe(qw)
+            self._m_phase.labels("prefill").observe(
+                max(now - span.t_admitted, 0.0))
+        self.tracer.first_token(rid)
+        if just_first and span.ttft_s is not None:
+            self._m_ttft.observe(span.ttft_s)
+        self._m_admissions.inc()
+
+    def _obs_finish(self, rid: str, reason: str,
+                    n_generated: int = 0) -> None:
+        span = self.tracer.finish(rid, reason, n_generated=n_generated)
+        if span is not None:
+            d = span.decode_s
+            if d is not None and d >= 0:
+                self._m_phase.labels("decode").observe(d)
+        self._m_finished.labels(reason).inc()
+
+    def _update_gauges(self) -> None:
+        self._m_occupancy.set(sum(1 for s in self.slots if s.active))
+        self._m_queue_depth.set(len(self.waiting) + len(self._cp_waiting))
+
+    def stats_snapshot(self) -> dict:
+        """JSON-ready engine state for `GET /v1/stats`: live occupancy,
+        queue depths, metric summaries and recent request spans."""
+        return {
+            "slots": {"total": len(self.slots),
+                      "active": sum(1 for s in self.slots if s.active)},
+            "queue_depth": len(self.waiting),
+            "cp_queue_depth": len(self._cp_waiting),
+            "admitting": self._admitting is not None,
+            "stall_steps": self._stall_steps,
+            "metrics": self.registry.summary(),
+            "requests": self.tracer.snapshot(),
+        }
+
     def _finish(self, idx: int, reason: str) -> None:
         s = self.slots[idx]
         if s.req is None:
@@ -810,6 +925,7 @@ class LLMEngine:
             s.req.request_id,
             RequestOutput(s.req.request_id, [], True, reason),
             score=s.cum_logprob, length=gen_len)
+        self._obs_finish(s.req.request_id, reason, n_generated=gen_len)
         s.req = None
         s.active = False
         s.generated = []
@@ -825,6 +941,7 @@ class LLMEngine:
             s.req.request_id,
             RequestOutput(s.req.request_id, [s.last_token], False,
                           logprobs=[lp] if want_lp else None))
+        self._m_tokens.inc()
 
     def _check_done(self, idx: int) -> bool:
         s = self.slots[idx]
@@ -851,11 +968,12 @@ class LLMEngine:
     def _cp_finish(self, reason: str) -> None:
         a = self._cp_active
         s = a.slot
+        gen_len = s.req.generated_offset + len(s.generated)
         self._push_output(
             s.req.request_id,
             RequestOutput(s.req.request_id, [], True, reason),
-            score=s.cum_logprob,
-            length=s.req.generated_offset + len(s.generated))
+            score=s.cum_logprob, length=gen_len)
+        self._obs_finish(s.req.request_id, reason, n_generated=gen_len)
         self._cp_active = None
 
     def _cp_check_done(self) -> None:
@@ -893,6 +1011,7 @@ class LLMEngine:
                     self._abort.discard(req.request_id)
                     self._push_output(req.request_id, RequestOutput(
                         req.request_id, [], True, "abort"))
+                    self._obs_finish(req.request_id, "abort")
                     continue
                 break
             else:
@@ -904,12 +1023,14 @@ class LLMEngine:
             cache = cp_empty_cache(self.cfg, 1, alloc, self._cp_mesh,
                                    self._cp_axis)
             adm = self._cp_admitting = _CPAdmitting(req, cache, 0, alloc)
+            self.tracer.admitted(req.request_id)
 
         if adm is not None:
             if adm.req.request_id in self._abort:
                 self._abort.discard(adm.req.request_id)
                 self._push_output(adm.req.request_id, RequestOutput(
                     adm.req.request_id, [], True, "abort"))
+                self._obs_finish(adm.req.request_id, "abort")
                 self._cp_admitting = None
                 return True
             ids = adm.req.prompt_token_ids
@@ -934,6 +1055,7 @@ class LLMEngine:
             slot.active = True
             self._cp_active = _CPActive(slot, adm.cache, plen, adm.alloc)
             self._cp_admitting = None
+            self._obs_admission_complete(slot.req.request_id)
             self._emit(slot, lp)
             self._cp_check_done()
             return True
@@ -982,6 +1104,8 @@ class LLMEngine:
         self.cache = KVCache(self.cache.k, self.cache.v,
                              self.cache.pos.at[victim].set(0))
         self.waiting.append(resumed)
+        self._m_preemptions.inc()
+        self.tracer.preempted(resumed.request_id)
 
     def step(self) -> bool:
         """One engine iteration (reference LLMEngine.step): advance the
@@ -1001,6 +1125,7 @@ class LLMEngine:
                 and all(s.active for s in self.slots)):
             self._stall_steps += 1
             if self._stall_steps >= ce.preempt_after_steps:
+                self._m_stall_trips.inc()
                 self._preempt()
                 self._stall_steps = 0
         else:
@@ -1017,8 +1142,13 @@ class LLMEngine:
 
         active = [i for i, s in enumerate(self.slots) if s.active]
         if not active:
-            return cp_did or self._admitting is not None
+            did = cp_did or self._admitting is not None
+            if did:
+                self._m_steps.inc()
+            self._update_gauges()
+            return did
 
+        t_decode0 = time.perf_counter()
         tokens = np.zeros((self.cfg_engine.max_batch,), np.int32)
         for i in active:
             tokens[i] = self.slots[i].last_token
@@ -1076,6 +1206,11 @@ class LLMEngine:
             s.generated.append(tok)
             self._emit(s, lp)
             self._check_done(i)
+        # one batched step advances EVERY active stream one token, so
+        # step wall time IS each stream's time-per-output-token
+        self._m_tpot.observe(time.perf_counter() - t_decode0)
+        self._m_steps.inc()
+        self._update_gauges()
         return True
 
     # -- convenience: blocking one-shot generation --------------------------

@@ -74,6 +74,35 @@ class SpecStats:
         return self.mean_accept + 1.0
 
 
+def _spec_observe(mode: str, n_accept: int, n_draft: int,
+                  round_s: float) -> None:
+    """Publish one verify round to the observability registry
+    (bigdl_tpu_spec_accept_ratio / _round_seconds / _tokens_total,
+    labeled mode="draft_model"|"prompt_lookup"). Unconditional — unlike
+    SpecStats, which only exists when the caller asks for it."""
+    try:
+        from bigdl_tpu.observability.metrics import (RATIO_BUCKETS,
+                                                     default_registry)
+
+        m = default_registry()
+        if n_draft > 0:
+            m.histogram("bigdl_tpu_spec_accept_ratio",
+                        "Speculative decoding acceptance ratio per "
+                        "verify round.", labelnames=("mode",),
+                        buckets=RATIO_BUCKETS,
+                        ).labels(mode).observe(n_accept / n_draft)
+        m.histogram("bigdl_tpu_spec_round_seconds",
+                    "Wall time of one draft+verify round.",
+                    labelnames=("mode",)).labels(mode).observe(round_s)
+        tok = m.counter("bigdl_tpu_spec_tokens_total",
+                        "Draft tokens proposed / accepted.",
+                        labelnames=("mode", "kind"))
+        tok.labels(mode, "drafted").inc(n_draft)
+        tok.labels(mode, "accepted").inc(n_accept)
+    except Exception:
+        pass  # telemetry must never break the decode loop
+
+
 def make_spec_round(
     fwd_target: Callable,
     cfg_target: Any,
@@ -316,11 +345,13 @@ def speculative_generate(
         toks_host = np.asarray(toks_r)[0]
         n = int(np.asarray(n_acc)[0])
         nd = int(np.asarray(n_drf))      # scalar loop counter
+        round_s = time.perf_counter() - t1
+        _spec_observe("draft_model", n, nd, round_s)
         if stats is not None:
             stats.rounds += 1
             stats.accepted.append(n)
             stats.drafted.append(nd)
-            stats.round_s.append(time.perf_counter() - t1)
+            stats.round_s.append(round_s)
         if auto_th_stop_draft and th_stop_draft > 0.0:
             th = _update_threshold(th, n / max(nd, 1))
         emitted = list(toks_host[: n + 1])
@@ -459,14 +490,17 @@ def prompt_lookup_generate(
             jnp.asarray(hist_len, jnp.int32), cur)
         toks_host = np.asarray(toks_r)[0]
         n = int(np.asarray(n_acc)[0])
+        round_s = time.perf_counter() - t1
+        # a no-match round proposed NOTHING — recording gamma would
+        # deflate accept_rate vs draft-model speculation, whose
+        # driver records the true n_draft
+        nd = gamma if bool(np.asarray(found)) else 0
+        _spec_observe("prompt_lookup", n, nd, round_s)
         if stats is not None:
             stats.rounds += 1
             stats.accepted.append(n)
-            # a no-match round proposed NOTHING — recording gamma would
-            # deflate accept_rate vs draft-model speculation, whose
-            # driver records the true n_draft
-            stats.drafted.append(gamma if bool(np.asarray(found)) else 0)
-            stats.round_s.append(time.perf_counter() - t1)
+            stats.drafted.append(nd)
+            stats.round_s.append(round_s)
         emitted = list(toks_host[: n + 1])
         if eos_token_id is not None and eos_token_id in emitted:
             emitted = emitted[: emitted.index(eos_token_id) + 1]

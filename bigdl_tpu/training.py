@@ -42,6 +42,7 @@ def make_train_step(
     cfg: Any,
     optimizer: optax.GradientTransformation,
     trainable_filter: Optional[Callable[[Any], Any]] = None,
+    timer: Optional[Any] = None,
 ) -> Callable:
     """Build a jittable `step(params, opt_state, batch) -> (params,
     opt_state, loss)`.
@@ -49,6 +50,10 @@ def make_train_step(
     `trainable_filter(params) -> pytree of bool` freezes leaves (QLoRA:
     only adapters train). Gradients for frozen leaves are zeroed before the
     optimizer, so optimizer state for them stays inert.
+
+    `timer` (a utils/profiling.StepTimer) wraps each call in
+    `timed("train_step", ...)` — blocking wall time per step, published
+    to the observability registry when the timer has a metrics prefix.
     """
 
     def loss_fn(params, batch):
@@ -67,7 +72,13 @@ def make_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return step
+    if timer is None:
+        return step
+
+    def timed_step(params, opt_state, batch):
+        return timer.timed("train_step", step, params, opt_state, batch)
+
+    return timed_step
 
 
 # ---------------------------------------------------------------------------
@@ -99,8 +110,10 @@ def make_lora_train_step(
     forward_train: Callable,   # (params, cfg, tokens) -> logits
     cfg: Any,
     optimizer: optax.GradientTransformation,
+    timer: Optional[Any] = None,
 ) -> Callable:
     """Build `step(train, opt_state, frozen, batch)` for adapter training.
+    `timer` as in make_train_step.
 
     Usage:
         train, frozen = partition(params, lora_trainable_mask(params))
@@ -122,4 +135,11 @@ def make_lora_train_step(
         train = optax.apply_updates(train, updates)
         return train, opt_state, loss
 
-    return step
+    if timer is None:
+        return step
+
+    def timed_step(train, opt_state, frozen, batch):
+        return timer.timed("train_step", step, train, opt_state, frozen,
+                           batch)
+
+    return timed_step

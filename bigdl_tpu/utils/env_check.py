@@ -49,6 +49,15 @@ def collect() -> dict:
 
     info["env"] = {k: v for k, v in os.environ.items()
                    if k.startswith(("JAX_", "XLA_", "BIGDL_", "LIBTPU"))}
+
+    # observability event log (serving request tracer JSONL sink):
+    # report up front whether the configured path is actually writable —
+    # the tracer itself degrades silently by design
+    ev = os.environ.get("BIGDL_TPU_EVENT_LOG")
+    if ev:
+        from bigdl_tpu.observability.tracing import validate_event_log_path
+
+        info["event_log"] = validate_event_log_path(ev)
     return info
 
 

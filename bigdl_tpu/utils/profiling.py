@@ -17,8 +17,9 @@ CPU test runs.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from typing import Dict, Iterator
+from typing import Dict, Iterator, Optional
 
 import jax
 
@@ -33,6 +34,45 @@ def trace(log_dir: str) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# On-demand profiler for the API server (POST /v1/profiler/{start,stop}):
+# same jax.profiler trace as `trace()` above but split into explicit
+# start/stop calls so a capture can bracket live traffic. One capture at
+# a time per process (jax.profiler itself is single-session).
+_profiler_lock = threading.Lock()
+_profiler_dir: Optional[str] = None
+
+
+def start_profiler(log_dir: str) -> dict:
+    """Start a device trace into `log_dir`; error if one is running."""
+    global _profiler_dir
+    with _profiler_lock:
+        if _profiler_dir is not None:
+            raise RuntimeError(
+                f"profiler already capturing into {_profiler_dir}")
+        jax.profiler.start_trace(log_dir,
+                                 create_perfetto_link=False,
+                                 create_perfetto_trace=True)
+        _profiler_dir = log_dir
+        return {"status": "started", "log_dir": log_dir}
+
+
+def stop_profiler() -> dict:
+    """Stop the running capture; error if none is running."""
+    global _profiler_dir
+    with _profiler_lock:
+        if _profiler_dir is None:
+            raise RuntimeError("no profiler capture in progress")
+        log_dir, _profiler_dir = _profiler_dir, None
+        jax.profiler.stop_trace()
+        return {"status": "stopped", "log_dir": log_dir}
+
+
+def profiler_status() -> dict:
+    with _profiler_lock:
+        return {"capturing": _profiler_dir is not None,
+                "log_dir": _profiler_dir}
 
 
 @contextlib.contextmanager
@@ -50,32 +90,64 @@ class StepTimer:
     step output before reading the clock, so tunnel dispatch latency
     doesn't masquerade as compute time."""
 
-    def __init__(self):
+    def __init__(self, metrics_prefix: Optional[str] = None,
+                 registry=None):
+        """With `metrics_prefix` set, every sample is also observed into a
+        `{prefix}_{name}_seconds` histogram in `registry` (the
+        observability default registry when None)."""
         self.times: Dict[str, list] = {}
+        self._metrics_prefix = metrics_prefix
+        self._registry = registry
+
+    def record(self, name: str, seconds: float) -> None:
+        """Append one sample; mirror it to the metrics registry when a
+        prefix was configured."""
+        self.times.setdefault(name, []).append(seconds)
+        if self._metrics_prefix is None:
+            return
+        try:
+            if self._registry is None:
+                from bigdl_tpu.observability.metrics import default_registry
+                self._registry = default_registry()
+            self._registry.histogram(
+                f"{self._metrics_prefix}_{name}_seconds",
+                f"StepTimer samples for {name}.",
+            ).observe(seconds)
+        except Exception:
+            pass  # telemetry must never break the timed code path
 
     @contextlib.contextmanager
     def measure(self, name: str, result=None) -> Iterator[None]:
         t0 = time.perf_counter()
-        yield
-        if result is not None:
-            jax.block_until_ready(result)
-        self.times.setdefault(name, []).append(time.perf_counter() - t0)
+        try:
+            yield
+        except BaseException:
+            # the block failed — a sample here would mix error paths into
+            # the latency distribution, so drop it
+            raise
+        else:
+            if result is not None:
+                jax.block_until_ready(result)
+            self.record(name, time.perf_counter() - t0)
 
     def timed(self, name: str, fn, *args, **kwargs):
         """Run fn, block on its output, record the wall time, return it."""
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
         jax.block_until_ready(out)
-        self.times.setdefault(name, []).append(time.perf_counter() - t0)
+        self.record(name, time.perf_counter() - t0)
         return out
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         out = {}
         for name, ts in self.times.items():
+            s = sorted(ts)
             out[name] = {
                 "count": len(ts),
                 "mean_ms": sum(ts) / len(ts) * 1e3,
-                "min_ms": min(ts) * 1e3,
+                "min_ms": s[0] * 1e3,
+                "max_ms": s[-1] * 1e3,
+                "p50_ms": s[len(s) // 2] * 1e3,
                 "total_s": sum(ts),
             }
         return out
