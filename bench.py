@@ -103,12 +103,20 @@ AB_CONFIGS = [
     ("int8-weights", dict(matmul_backend="auto", attention_backend="auto",
                           matmul_gemv="auto", _qtype="sym_int8")),
     ("fp8-kv", dict(matmul_backend="auto", attention_backend="auto",
-                    matmul_gemv="auto", _kv_quantized=True)),
+                    matmul_gemv="auto", _kv_cache_dtype="fp8_e5m2")),
 ]
+
+# `--kv-cache-dtype a,b,...` sweep rows (not part of the default A/B
+# matrix): each dtype runs the shipped dispatch flags with only the KV
+# storage dtype varied, so the per-dtype TPOT/kv_cache_bytes deltas are
+# attributable to the cache alone
+KV_SWEEP_FLAGS = dict(matmul_backend="auto", attention_backend="auto",
+                      matmul_gemv="auto")
 
 
 def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
-                 merged: bool = True) -> dict:
+                 merged: bool = True,
+                 kv_cache_dtype: "str | None" = None) -> dict:
     """Time prefill + decode under the AMBIENT flags; returns raw numbers.
 
     Runs on whatever jax.default_backend() answers. The final token is
@@ -122,8 +130,12 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
 
     from bigdl_tpu.config import enable_compilation_cache
     from bigdl_tpu.models import llama as llama_mod
+    from bigdl_tpu.ops.kvcache import kv_cache_bytes, resolve_kv_cache_dtype
     from bigdl_tpu.utils.testing import (LLAMA2_7B, TINY_LLAMA,
                                          random_llama_params)
+
+    kv_dtype = resolve_kv_cache_dtype(
+        kv_cache_dtype if kv_cache_dtype is not None else kv_quantized)
 
     # compiled 7B programs persist across subprocesses AND tunnel windows
     enable_compilation_cache()
@@ -153,7 +165,7 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
             tp = llama_mod.merge_projections(tp, TINY_LLAMA)
         tp = _maybe_mxu_layout(tp)
         tcache = llama_mod.new_cache(TINY_LLAMA, 1, 64,
-                                     quantized=kv_quantized)
+                                     quantized=kv_dtype)
         tlg, tcache = jax.jit(llama_mod.forward, static_argnums=1)(
             tp, TINY_LLAMA, jnp.ones((1, 8), jnp.int32), tcache)
         np.asarray(tlg)
@@ -203,7 +215,7 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
 
     def run(decode_fn, tag=None):
         cache = llama_mod.new_cache(cfg, 1, max_seq,
-                                    quantized=kv_quantized)
+                                    quantized=kv_dtype)
         t0 = time.perf_counter()
         logits, cache = prefill(params, cfg, tokens, cache)
         tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
@@ -278,7 +290,13 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
         "prompt_len": prompt_len,
         "decode_steps": steps,
         "qtype": qtype,
-        "kv_quantized": kv_quantized,
+        "kv_cache_dtype": kv_dtype,
+        "kv_quantized": kv_dtype != "bf16",
+        # logical cache footprint (eval_shape: no second allocation);
+        # int4 counted at two codes per byte
+        "kv_cache_bytes": kv_cache_bytes(jax.eval_shape(
+            lambda: llama_mod.new_cache(cfg, 1, max_seq,
+                                        quantized=kv_dtype))),
     }
 
 
@@ -313,16 +331,27 @@ def _floors(cfg, weight_bytes: int, prompt_len: int) -> tuple:
 
 
 def _one_config(label: str) -> None:
-    """Subprocess entry: run ONE dispatch configuration, print JSON."""
-    overrides = dict(dict(AB_CONFIGS)[label])
+    """Subprocess entry: run ONE dispatch configuration, print JSON.
+
+    `kv-<dtype>` labels are the --kv-cache-dtype sweep rows: shipped
+    dispatch flags, only the KV storage dtype varied."""
+    cfgs = dict(AB_CONFIGS)
+    if label in cfgs:
+        overrides = dict(cfgs[label])
+    elif label.startswith("kv-"):
+        overrides = dict(KV_SWEEP_FLAGS, _kv_cache_dtype=label[3:])
+    else:
+        raise KeyError(label)
     qtype = overrides.pop("_qtype", "sym_int4")
     kv_quantized = overrides.pop("_kv_quantized", False)
+    kv_cache_dtype = overrides.pop("_kv_cache_dtype", None)
     merged = overrides.pop("_merged", True)
     from bigdl_tpu.config import set_flags
 
     set_flags(**overrides)
     print(json.dumps(bench_config(qtype=qtype, kv_quantized=kv_quantized,
-                                  merged=merged)))
+                                  merged=merged,
+                                  kv_cache_dtype=kv_cache_dtype)))
 
 
 def _latest_valid_onchip_record(run_dir: str | None = None) -> dict | None:
@@ -433,7 +462,7 @@ def _acquire_single_instance(max_wait_s: int = 2700):
             time.sleep(min(30.0, max(1.0, deadline - time.time())))
 
 
-def main() -> None:
+def main(kv_sweep: "list[str] | None" = None) -> None:
     _lock = _acquire_single_instance()
     # probe BEFORE importing jax here: a wedged TPU tunnel would hang this
     # process with no recourse (import-time probing would tax every
@@ -485,6 +514,14 @@ def main() -> None:
             model="tiny-llama(cpu-fallback)",
             best_config="cpu-fallback",
         )
+        if kv_sweep:
+            # per-dtype rows even off-chip: the bytes column is exact
+            # (shape math), the timing column is a smoke number
+            record["kv_sweep"] = {
+                d: {k: r[k] for k in ("next_token_ms", "first_token_ms",
+                                      "kv_cache_bytes")}
+                for d, r in ((d, bench_config(kv_cache_dtype=d))
+                             for d in kv_sweep)}
         cached = _latest_valid_onchip_record()
         if cached is not None:
             # surface the newest real on-chip record alongside the smoke
@@ -516,7 +553,9 @@ def main() -> None:
     t_start = time.time()
 
     ab_results = {}
-    for label, _ in _ordered_configs(run_dir):
+    schedule = ([(f"kv-{d}", None) for d in kv_sweep] if kv_sweep
+                else _ordered_configs(run_dir))
+    for label, _ in schedule:
         # never overshoot the budget: a config only starts with a
         # meaningful slice left, and its subprocess timeout is capped at
         # the REMAINING budget (not the full CONFIG_TIMEOUT_S)
@@ -551,6 +590,8 @@ def main() -> None:
                      "final_token": raw["final_token"],
                      "weight_bytes": raw["weight_bytes"],
                      "qtype": raw["qtype"],
+                     "kv_cache_dtype": raw.get("kv_cache_dtype", "bf16"),
+                     "kv_cache_bytes": raw.get("kv_cache_bytes"),
                      "kv_quantized": raw["kv_quantized"],
                      "observability": raw.get("observability", {})}
             if raw["next_token_ms"] < dfloor or \
@@ -614,7 +655,7 @@ def main() -> None:
             # don't burn the window timing out every remaining config
             print("bench: backend no longer answers — aborting remaining "
                   "configs", file=sys.stderr)
-            for rest, _ in AB_CONFIGS:
+            for rest, _ in schedule:
                 if rest not in ab_results:
                     ab_results[rest] = {"error": "tunnel died earlier "
                                                  "in the run"}
@@ -628,6 +669,11 @@ def main() -> None:
           and v.get("qtype") == "sym_int4"
           and not v.get("kv_quantized")}
     record["ab"] = ab_results
+    if kv_sweep:
+        record["kv_sweep"] = {
+            lbl[3:]: {k: v[k] for k in ("next_token_ms", "first_token_ms",
+                                        "kv_cache_bytes") if k in v}
+            for lbl, v in ab_results.items() if lbl.startswith("kv-")}
     if not ok:
         # keep the record honest: no valid on-chip numbers were produced
         # THIS run — but the newest prior valid record is still the best
@@ -711,8 +757,23 @@ def _efficiency(cfg, weight_bytes: int, prompt_len: int, steps: int,
     }
 
 
+def _parse_kv_sweep(argv: "list[str]") -> "list[str] | None":
+    """`--kv-cache-dtype a,b,c` (or `=`-joined) -> validated dtype list."""
+    from bigdl_tpu.ops.kvcache import resolve_kv_cache_dtype
+
+    spec = None
+    for i, a in enumerate(argv):
+        if a == "--kv-cache-dtype" and i + 1 < len(argv):
+            spec = argv[i + 1]
+        elif a.startswith("--kv-cache-dtype="):
+            spec = a.split("=", 1)[1]
+    if spec is None:
+        return None
+    return [resolve_kv_cache_dtype(d) for d in spec.split(",") if d]
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--config":
         _one_config(sys.argv[2])
     else:
-        main()
+        main(kv_sweep=_parse_kv_sweep(sys.argv[1:]))

@@ -26,7 +26,9 @@ import time
 
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from bench import _probe_backend, chip_peaks
+    from bench import _parse_kv_sweep, _probe_backend, chip_peaks
+
+    kv_sweep = _parse_kv_sweep(sys.argv[1:])
 
     backend = _probe_backend()
     if backend is None:
@@ -71,6 +73,7 @@ def main() -> None:
                 forward = staticmethod(llama_mod.forward)
                 prefill = staticmethod(llama_mod.forward_last_token)
                 new_cache = staticmethod(llama_mod.new_cache)
+                SUPPORTS_SCALED_KV = llama_mod.SUPPORTS_SCALED_KV
 
             self.family = Fam()
 
@@ -80,11 +83,11 @@ def main() -> None:
     weight_bytes = sum(
         leaf.nbytes for leaf in jax.tree_util.tree_leaves(
             model.params, is_leaf=lambda x: isinstance(x, QTensor)))
-    def run_wave(b: int) -> tuple:
+    def run_wave(b: int, kv_dtype: str = "bf16") -> tuple:
         """(tokens/s, done, generated, wall_s, n_req) at max_batch=b."""
         n_req = 3 * b
         eng = LLMEngine(model, EngineConfig(
-            max_batch=b, max_seq=max_seq,
+            max_batch=b, max_seq=max_seq, kv_cache_dtype=kv_dtype,
             prefix_cache_entries=0))    # no reuse between identical runs
         rng = np.random.default_rng(0)
         prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
@@ -151,6 +154,25 @@ def main() -> None:
         "model": "llama2-7b" if on_tpu else "tiny-llama(cpu-fallback)",
         "qtype": "sym_int4",
     }
+    if kv_sweep:
+        # --kv-cache-dtype rows: aggregate throughput + per-stream TPOT
+        # + exact cache footprint (eval_shape, no allocation) per dtype
+        from bigdl_tpu.ops.kvcache import init_cache, kv_cache_bytes
+
+        out["kv_sweep"] = {}
+        for d in kv_sweep:
+            t_, d_, g_, w_, n_ = run_wave(batch, d)
+            out["kv_sweep"][d] = {
+                "tokens_per_s": round(t_, 1),
+                "tpot_ms": round(1000.0 * batch / max(t_, 1e-9), 3),
+                "completed": int(d_),
+                "n_requests": n_,
+                "kv_cache_bytes": kv_cache_bytes(jax.eval_shape(
+                    lambda d=d: init_cache(
+                        cfg.num_hidden_layers, batch, max_seq,
+                        cfg.num_key_value_heads, cfg.hd,
+                        kv_cache_dtype=d, per_slot_pos=True))),
+            }
     if poisoned:
         out["note"] = ("throughput beat the HBM ceiling — runtime did "
                        "not execute (poisoned buffers)")

@@ -46,6 +46,8 @@ TEST_APIS = (
     "transformers_low_bit",   # alias; low_bit taken from the config
     "no_merge",               # split-projection layout A/B
     "fp8_kv",                 # e5m2-quantized KV cache
+    "int8_kv",                # block-scaled int8 KV cache
+    "int4_kv",                # block-scaled int4 KV cache
     "speculative",            # self-speculative decoding
     "serving",                # LLMEngine continuous batching: tokens/s
     "explicit_tp",            # shard_map TP over all local devices
@@ -64,7 +66,9 @@ def _load(model_path, low_bit, max_seq, api):
         # avoids a merge-then-unmerge round trip over every layer
         kwargs["merge_projections"] = False
     if api == "fp8_kv":
-        kwargs["quantize_kv_cache"] = True
+        kwargs["kv_cache_dtype"] = "fp8_e5m2"
+    elif api.endswith("_kv"):
+        kwargs["kv_cache_dtype"] = api[:-3]
     return AutoModelForCausalLM.from_pretrained(
         model_path, load_in_low_bit=low_bit, max_seq=max_seq, **kwargs)
 

@@ -55,8 +55,12 @@ class RuntimeFlags:
     # host-side C++ kernels (bigdl_tpu.native); disable to force pure JAX
     disable_native: bool = False
     native_cache_dir: Optional[str] = None
-    # default for quantize_kv_cache when the caller doesn't specify
-    # (reference IPEX_LLM_QUANTIZE_KV_CACHE)
+    # default KV-cache storage dtype when the caller doesn't specify:
+    # "bf16" | "fp8_e5m2" | "int8" | "int4" (block-scaled codes)
+    kv_cache_dtype: str = "bf16"
+    # DEPRECATED boolean alias for kv_cache_dtype="fp8_e5m2" (reference
+    # IPEX_LLM_QUANTIZE_KV_CACHE); consulted only when kv_cache_dtype
+    # is left at its default
     quantize_kv_cache: bool = False
     # default max sequence length for loaded models
     default_max_seq: int = 2048
@@ -81,6 +85,8 @@ class RuntimeFlags:
             mxu_layout=os.environ.get("BIGDL_TPU_MXU_LAYOUT", "auto"),
             disable_native=_env_bool("BIGDL_TPU_DISABLE_NATIVE"),
             native_cache_dir=os.environ.get("BIGDL_TPU_NATIVE_CACHE"),
+            kv_cache_dtype=os.environ.get(
+                "BIGDL_TPU_KV_CACHE_DTYPE", "bf16").strip().lower() or "bf16",
             quantize_kv_cache=_env_bool("BIGDL_TPU_QUANTIZE_KV_CACHE"),
             default_max_seq=int(os.environ.get("BIGDL_TPU_MAX_SEQ", "2048")),
             aot_target=(os.environ.get("BIGDL_TPU_AOT_TARGET") or "").strip()
@@ -96,6 +102,20 @@ def flags() -> RuntimeFlags:
     if _flags is None:
         _flags = RuntimeFlags.from_env()
     return _flags
+
+
+def default_kv_cache_dtype() -> str:
+    """Effective default KV-cache storage dtype from flags.
+
+    `kv_cache_dtype` wins when set to anything but the default; otherwise
+    the deprecated `quantize_kv_cache` boolean maps True -> "fp8_e5m2"
+    (with its one-time deprecation warning)."""
+    from bigdl_tpu.ops.kvcache import resolve_kv_cache_dtype
+
+    f = flags()
+    if f.kv_cache_dtype and f.kv_cache_dtype != "bf16":
+        return resolve_kv_cache_dtype(f.kv_cache_dtype)
+    return resolve_kv_cache_dtype(f.quantize_kv_cache)
 
 
 def target_is_tpu() -> bool:

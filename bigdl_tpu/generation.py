@@ -385,12 +385,19 @@ class Generator:
 
     def __init__(self, params: Dict[str, Any], cfg,
                  forward_fn=None, prefill_fn=None, max_seq: int = 2048,
-                 kv_quantized: bool = False, new_cache_fn=None,
-                 recurrent: Optional[bool] = None):
+                 kv_quantized=False, new_cache_fn=None,
+                 recurrent: Optional[bool] = None,
+                 kv_cache_dtype: Optional[str] = None):
+        from bigdl_tpu.ops.kvcache import resolve_kv_cache_dtype
+
         self.params = params
         self.cfg = cfg
         self.max_seq = max_seq
-        self.kv_quantized = kv_quantized
+        # canonical storage name; kv_quantized is the deprecated alias
+        # (True -> fp8_e5m2) and also accepts a dtype name directly
+        self.kv_cache_dtype = resolve_kv_cache_dtype(
+            kv_cache_dtype if kv_cache_dtype is not None else kv_quantized)
+        self.kv_quantized = self.kv_cache_dtype != "bf16"   # legacy mirror
         self.new_cache = new_cache_fn or llama_mod.new_cache
         self.recurrent = recurrent      # None: sniff from the cache type
         fwd = forward_fn or llama_mod.forward
@@ -471,7 +478,7 @@ class Generator:
                 f"exceeds max_seq {self.max_seq}")
 
         cache = self.new_cache(self.cfg, b, self.max_seq,
-                               self.kv_quantized)
+                               self.kv_cache_dtype)
         recurrent = (not isinstance(cache, KVCache)
                      if self.recurrent is None else self.recurrent)
         if recurrent:

@@ -30,6 +30,7 @@ from jax import lax
 from bigdl_tpu.models.bert import _masked_attention
 from bigdl_tpu.ops.attention import sdp_attention
 from bigdl_tpu.ops.kvcache import KVCache, init_cache as init_kv, \
+    reject_scaled_kv, \
     read_layer, update_layer
 from bigdl_tpu.ops.matmul import linear
 from bigdl_tpu.ops.norms import layer_norm
@@ -176,8 +177,9 @@ def encode(params: Dict[str, Any], cfg: BartConfig,
 
 def init_decoder_cache(params: Dict[str, Any], cfg: BartConfig,
                        enc_out: jax.Array, max_seq: Optional[int] = None,
-                       quantized: bool = False,
+                       quantized=False,
                        src_mask: Optional[jax.Array] = None) -> BartCache:
+    reject_scaled_kv(quantized, "bart")
     b, s_enc, _ = enc_out.shape
     h, hd = cfg.decoder_attention_heads, cfg.hd
     max_seq = max_seq or cfg.max_position_embeddings

@@ -38,6 +38,7 @@ import numpy as np
 from jax import lax
 
 from bigdl_tpu.ops.kvcache import KVCache, init_cache, read_layer, \
+    reject_scaled_kv, \
     update_layer
 from bigdl_tpu.ops.matmul import linear
 from bigdl_tpu.ops.norms import layer_norm
@@ -119,7 +120,8 @@ class ChatGLMCache:
 
 
 def new_cache(cfg: ChatGLMConfig, batch: int, max_seq: int,
-              quantized: bool = False) -> ChatGLMCache:
+              quantized=False) -> ChatGLMCache:
+    reject_scaled_kv(quantized, "chatglm")
     return ChatGLMCache(
         kv=init_cache(cfg.num_layers, batch, max_seq,
                       cfg.num_attention_heads, cfg.hd,

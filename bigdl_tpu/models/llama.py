@@ -34,7 +34,8 @@ from jax import lax
 import functools
 
 from bigdl_tpu.ops.attention import sdp_attention
-from bigdl_tpu.ops.kvcache import KVCache, init_cache, read_layer, update_layer
+from bigdl_tpu.ops.kvcache import (KVCache, init_cache, read_layer,
+                                   read_layer_quantized, update_layer)
 from bigdl_tpu.ops.matmul import linear
 from bigdl_tpu.ops.embedding import embedding_lookup
 from bigdl_tpu.ops.norms import layer_norm, rms_norm
@@ -599,14 +600,27 @@ def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
         k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
 
     if cache_ctx is not None:
-        ck, cv, clidx, pos = cache_ctx
-        ck, cv = update_layer(ck, cv, clidx, k, v, pos)
-        kf, vf = read_layer(ck, cv, clidx)
-        attn = sdp_attention(q, kf, vf, pos, scale=scale,
-                             sliding_window=sw,
-                             logits_soft_cap=cfg.attn_soft_cap,
-                             alibi_slopes=slopes)
-        out = (ck, cv)
+        ck, cv, cks, cvs, clidx, pos = cache_ctx
+        if cks is not None:
+            # block-scaled storage: quantize-on-append, then hand raw
+            # codes + scale planes to the attention dispatch so the
+            # dequant fuses into the kernels
+            ck, cv, cks, cvs = update_layer(ck, cv, clidx, k, v, pos,
+                                            cks, cvs)
+            kq, vq, ksc, vsc = read_layer_quantized(ck, cv, cks, cvs, clidx)
+            attn = sdp_attention(q, kq, vq, pos, scale=scale,
+                                 sliding_window=sw,
+                                 logits_soft_cap=cfg.attn_soft_cap,
+                                 alibi_slopes=slopes,
+                                 k_scale=ksc, v_scale=vsc)
+        else:
+            ck, cv = update_layer(ck, cv, clidx, k, v, pos)
+            kf, vf = read_layer(ck, cv, clidx)
+            attn = sdp_attention(q, kf, vf, pos, scale=scale,
+                                 sliding_window=sw,
+                                 logits_soft_cap=cfg.attn_soft_cap,
+                                 alibi_slopes=slopes)
+        out = (ck, cv, cks, cvs)
     else:
         attn = sdp_attention(q, k, v, jnp.zeros((), jnp.int32), scale=scale,
                              sliding_window=sw,
@@ -657,11 +671,12 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, slopes,
 
 
 def _layer_step(cfg: LlamaConfig, slopes, carry, xs):
-    x, ck, cv, pos, cos, sin = carry
+    x, ck, cv, cks, cvs, pos, cos, sin = carry
     lp, lidx = xs
-    x, (ck, cv) = _decoder_layer(x, lp, cfg, cos, sin, slopes,
-                                 cache_ctx=(ck, cv, lidx, pos), lidx=lidx)
-    return (x, ck, cv, pos, cos, sin), None
+    x, (ck, cv, cks, cvs) = _decoder_layer(
+        x, lp, cfg, cos, sin, slopes,
+        cache_ctx=(ck, cv, cks, cvs, lidx, pos), lidx=lidx)
+    return (x, ck, cv, cks, cvs, pos, cos, sin), None
 
 
 def forward(
@@ -707,9 +722,11 @@ def forward(
     slopes = _model_slopes(cfg)
 
     lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
-    (x, ck, cv, _, _, _), _ = lax.scan(
+    # scale planes are None for bf16/fp8 storage — None is an empty
+    # pytree, so the scan carry structure stays consistent either way
+    (x, ck, cv, cks, cvs, _, _, _), _ = lax.scan(
         lambda c, xs: _layer_step(cfg, slopes, c, xs),
-        (x, cache.k, cache.v, pos, cos, sin),
+        (x, cache.k, cache.v, cache.k_scale, cache.v_scale, pos, cos, sin),
         (params["layers"], lidx),
     )
 
@@ -717,7 +734,7 @@ def forward(
         x = x[:, -1:, :]
     x = _norm(x, params["norm"], params.get("norm_bias"), cfg)
     logits = _lm_head(x, params, cfg)
-    return logits, KVCache(ck, cv, pos + sq)
+    return logits, KVCache(ck, cv, pos + sq, cks, cvs)
 
 
 def forward_last_token(
@@ -841,8 +858,15 @@ def forward_train(
     return _lm_head(x, params, cfg)
 
 
+# this family threads int8/int4 scale planes through its forward scan;
+# serving consults the attribute before enabling block-scaled storage
+SUPPORTS_SCALED_KV = True
+
+
 def new_cache(cfg: LlamaConfig, batch: int, max_seq: int,
-              quantized: bool = False) -> KVCache:
+              quantized=False) -> KVCache:
+    """`quantized` accepts the legacy bool (True -> fp8_e5m2, deprecated)
+    or a kv_cache_dtype name ("bf16"|"fp8_e5m2"|"int8"|"int4")."""
     return init_cache(cfg.num_hidden_layers, batch, max_seq,
                       cfg.num_key_value_heads, cfg.hd,
                       quantized=quantized)

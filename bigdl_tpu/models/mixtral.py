@@ -32,7 +32,8 @@ from jax import lax
 from bigdl_tpu.models import llama as llama_mod
 from bigdl_tpu.models.llama import LlamaConfig
 from bigdl_tpu.ops.attention import sdp_attention
-from bigdl_tpu.ops.kvcache import KVCache, read_layer, update_layer
+from bigdl_tpu.ops.kvcache import (KVCache, read_layer,
+                                   read_layer_quantized, update_layer)
 from bigdl_tpu.ops.matmul import linear, q_matmul
 from bigdl_tpu.ops.norms import rms_norm
 from bigdl_tpu.ops.quant import QTensor
@@ -76,7 +77,7 @@ def moe_block(x: jax.Array, lp: Dict[str, Any], cfg: MixtralConfig) -> jax.Array
 
 
 def _layer_step(cfg: MixtralConfig, carry, xs):
-    x, ck, cv, pos, cos, sin = carry
+    x, ck, cv, cks, cvs, pos, cos, sin = carry
     lp, lidx = xs
     b, sq, d = x.shape
     h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
@@ -88,14 +89,22 @@ def _layer_step(cfg: MixtralConfig, carry, xs):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    ck, cv = update_layer(ck, cv, lidx, k, v, pos)
-    kf, vf = read_layer(ck, cv, lidx)
-    attn = sdp_attention(q, kf, vf, pos, sliding_window=cfg.sliding_window)
+    if cks is not None:   # block-scaled int8/int4 storage (see llama)
+        ck, cv, cks, cvs = update_layer(ck, cv, lidx, k, v, pos, cks, cvs)
+        kq, vq, ksc, vsc = read_layer_quantized(ck, cv, cks, cvs, lidx)
+        attn = sdp_attention(q, kq, vq, pos,
+                             sliding_window=cfg.sliding_window,
+                             k_scale=ksc, v_scale=vsc)
+    else:
+        ck, cv = update_layer(ck, cv, lidx, k, v, pos)
+        kf, vf = read_layer(ck, cv, lidx)
+        attn = sdp_attention(q, kf, vf, pos,
+                             sliding_window=cfg.sliding_window)
     x = x + linear(attn.reshape(b, sq, h * hd), lp["o_proj"])
 
     hidden = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
     x = x + moe_block(hidden, lp, cfg)
-    return (x, ck, cv, pos, cos, sin), None
+    return (x, ck, cv, cks, cvs, pos, cos, sin), None
 
 
 def forward(
@@ -121,9 +130,9 @@ def forward(
         cos, sin = cos * rope_mscale, sin * rope_mscale
 
     lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
-    (x, ck, cv, _, _, _), _ = lax.scan(
+    (x, ck, cv, cks, cvs, _, _, _), _ = lax.scan(
         lambda c, xs: _layer_step(cfg, c, xs),
-        (x, cache.k, cache.v, pos, cos, sin),
+        (x, cache.k, cache.v, cache.k_scale, cache.v_scale, pos, cos, sin),
         (params["layers"], lidx),
     )
 
@@ -136,7 +145,7 @@ def forward(
                          preferred_element_type=jnp.float32)
     else:
         logits = linear(x, lm_head)
-    return logits.astype(jnp.float32), KVCache(ck, cv, pos + sq)
+    return logits.astype(jnp.float32), KVCache(ck, cv, pos + sq, cks, cvs)
 
 
 def forward_last_token(params, cfg, tokens, cache, compute_dtype=jnp.bfloat16):
@@ -185,8 +194,11 @@ def forward_train(
     return logits.astype(jnp.float32)
 
 
+SUPPORTS_SCALED_KV = True   # scale planes threaded through _layer_step
+
+
 def new_cache(cfg: MixtralConfig, batch: int, max_seq: int,
-              quantized: bool = False) -> KVCache:
+              quantized=False) -> KVCache:
     return llama_mod.new_cache(cfg, batch, max_seq, quantized)
 
 

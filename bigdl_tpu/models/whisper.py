@@ -33,6 +33,7 @@ from jax import lax
 
 from bigdl_tpu.ops.attention import sdp_attention
 from bigdl_tpu.ops.kvcache import KVCache, init_cache as init_kv, \
+    reject_scaled_kv, \
     read_layer, update_layer
 from bigdl_tpu.ops.matmul import linear
 from bigdl_tpu.ops.norms import layer_norm
@@ -158,8 +159,9 @@ def encode(params: Dict[str, Any], cfg: WhisperConfig,
 
 def init_decoder_cache(params: Dict[str, Any], cfg: WhisperConfig,
                        enc_out: jax.Array, max_seq: Optional[int] = None,
-                       quantized: bool = False) -> WhisperCache:
+                       quantized=False) -> WhisperCache:
     """Allocate the self KV cache and precompute cross K/V per layer."""
+    reject_scaled_kv(quantized, "whisper")
     b, s_enc, _ = enc_out.shape
     h, hd = cfg.decoder_attention_heads, cfg.hd
     max_seq = max_seq or cfg.max_target_positions

@@ -38,6 +38,7 @@ from bigdl_tpu.models import llama as M
 from bigdl_tpu.models.llama import LlamaConfig
 from bigdl_tpu.ops.attention import sdp_attention
 from bigdl_tpu.ops.kvcache import KVCache, init_cache as init_kv, \
+    reject_scaled_kv, \
     read_layer, update_layer
 from bigdl_tpu.ops.matmul import linear
 from bigdl_tpu.ops.norms import layer_norm, rms_norm
@@ -73,7 +74,8 @@ class YuanCache:
 
 
 def new_cache(cfg: LlamaConfig, batch: int, max_seq: int,
-              quantized: bool = False) -> YuanCache:
+              quantized=False) -> YuanCache:
+    reject_scaled_kv(quantized, "yuan")
     return YuanCache(
         kv=init_kv(cfg.num_hidden_layers, batch, max_seq,
                    cfg.num_key_value_heads, cfg.hd, quantized=quantized),

@@ -34,7 +34,16 @@ bigdl_tpu_kernel_probe_total{kernel,...}    ops/probing.record_probe_result
 bigdl_tpu_spec_accept_ratio{mode}           speculative._spec_observe
 bigdl_tpu_spec_round_seconds{mode}          speculative._spec_observe
 bigdl_tpu_spec_tokens_total{mode,kind}      speculative._spec_observe
+bigdl_tpu_kv_cache_bytes{dtype,component}   ops/kvcache.publish_kv_cache_bytes
+bigdl_tpu_kv_dequant_path_total{dtype,path} ops/attention._note_dequant_path
 ==========================================  ===============================
+
+``bigdl_tpu_kv_cache_bytes`` reports the batched KV cache's logical
+storage footprint split by component ("codes", "scales", "total" — int4
+counts two codes per byte). ``bigdl_tpu_kv_dequant_path_total`` counts
+how quantized attention dequantized: "fused" (inside the Pallas kernel)
+vs "xla" (upcast fallback); increments happen at trace time, so read it
+as "which path compiled", not a per-token rate.
 """
 
 from bigdl_tpu.observability.metrics import (

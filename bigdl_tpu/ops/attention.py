@@ -49,6 +49,24 @@ def reset_probe_cache() -> None:
     jax.clear_caches()
 
 
+def _note_dequant_path(kv_dtype_name: str, path: str) -> None:
+    """Count which dequant path a quantized-KV attention dispatch took
+    ("fused" Pallas kernel vs "xla" fallback). Trace-time counts: each
+    (shape, dtype) combination increments once per trace, not once per
+    executed step — enough to tell WHICH path a deployment is on.
+    Best-effort; metrics never gate dispatch."""
+    try:
+        from bigdl_tpu.observability import default_registry
+
+        default_registry().counter(
+            "bigdl_tpu_kv_dequant_path_total",
+            "KV-cache dequantization dispatches by storage dtype and "
+            "path (fused kernel vs XLA fallback); trace-time counts",
+            labelnames=("dtype", "path")).labels(kv_dtype_name, path).inc()
+    except Exception:
+        pass
+
+
 def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
                      skv: int, kv_dtype_name: str) -> bool:
     """Eager probe, cached PER GEOMETRY: does the Pallas kernel compile
@@ -87,12 +105,25 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
         # trace — a concrete call here used to die on live TPUs with
         # "Evaluation rule for 'program_id' not implemented".
         kdt = jnp.dtype(kv_dtype_name)
-        probe_compile(
-            lambda qq, kk, vv, pp: kernel(qq, kk, vv, pp, hd ** -0.5),
-            jax.ShapeDtypeStruct((1, sq, h, hd), jnp.bfloat16),
-            jax.ShapeDtypeStruct((1, skv, hkv, hd), kdt),
-            jax.ShapeDtypeStruct((1, skv, hkv, hd), kdt),
-            jax.ShapeDtypeStruct((), jnp.int32))
+        if kv_dtype_name in ("int8", "int4"):
+            # block-scaled codes probe with their f32 scale planes — the
+            # scaled kernel bodies are distinct Mosaic programs
+            probe_compile(
+                lambda qq, kk, vv, pp, ks, vs: kernel(
+                    qq, kk, vv, pp, hd ** -0.5, k_scale=ks, v_scale=vs),
+                jax.ShapeDtypeStruct((1, sq, h, hd), jnp.bfloat16),
+                jax.ShapeDtypeStruct((1, skv, hkv, hd), kdt),
+                jax.ShapeDtypeStruct((1, skv, hkv, hd), kdt),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((1, skv, hkv), jnp.float32),
+                jax.ShapeDtypeStruct((1, skv, hkv), jnp.float32))
+        else:
+            probe_compile(
+                lambda qq, kk, vv, pp: kernel(qq, kk, vv, pp, hd ** -0.5),
+                jax.ShapeDtypeStruct((1, sq, h, hd), jnp.bfloat16),
+                jax.ShapeDtypeStruct((1, skv, hkv, hd), kdt),
+                jax.ShapeDtypeStruct((1, skv, hkv, hd), kdt),
+                jax.ShapeDtypeStruct((), jnp.int32))
         _probe_cache[key] = True
         record_probe_result(f"{kind}_attention", True)
         return True
@@ -132,6 +163,8 @@ def sdp_attention(
     sliding_window: Optional[int] = None,
     alibi_slopes: Optional[jax.Array] = None,   # [H] f32 (bloom families)
     backend: Optional[str] = None,   # overrides flags().attention_backend
+    k_scale: Optional[jax.Array] = None,   # [B, Skv, Hkv] f32: int8/int4
+    v_scale: Optional[jax.Array] = None,   # codes' per-(token, head) scales
 ) -> jax.Array:
     """Causal SDP against a (possibly partially-filled) KV cache.
 
@@ -141,12 +174,19 @@ def sdp_attention(
     Decode (Sq=1) on TPU dispatches to the fused Pallas kernel
     (ops/pallas/decode_attention — the reference's `sdp_fp8`/ESIMD
     `sdp_forward` equivalent) unless BIGDL_TPU_ATTENTION_BACKEND=xla.
+
+    Block-scaled KV (kv_cache_dtype int8/int4): pass the raw code planes
+    as k/v plus their scale planes — the kernels dequantize in-register;
+    the XLA fallback upcasts codes * scales before the einsums.
     """
     b, sq, h, d = q.shape
     skv, hkv = k.shape[1], k.shape[2]
     g = h // hkv
     if scale is None:
         scale = d ** -0.5
+    quant_name = (str(k.dtype)
+                  if k.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32)
+                  else None)
 
     from bigdl_tpu.config import flags, target_is_tpu, under_spmd
 
@@ -163,14 +203,20 @@ def sdp_attention(
 
         supported = decode_attention_supported(
             q, k, v, q_pos, scale, logits_soft_cap, sliding_window,
-            alibi_slopes)
+            alibi_slopes, k_scale)
         on_tpu = target_is_tpu()
         if supported and be == "pallas":
+            if quant_name:
+                _note_dequant_path(quant_name, "fused")
             return decode_attention_pallas(q, k, v, q_pos, float(scale),
-                                           interpret=not on_tpu)
+                                           interpret=not on_tpu,
+                                           k_scale=k_scale, v_scale=v_scale)
         if supported and on_tpu and _kernel_compiles(
                 "decode", h, hkv, d, 1, skv, str(k.dtype)):
-            return decode_attention_pallas(q, k, v, q_pos, float(scale))
+            if quant_name:
+                _note_dequant_path(quant_name, "fused")
+            return decode_attention_pallas(q, k, v, q_pos, float(scale),
+                                           k_scale=k_scale, v_scale=v_scale)
 
         from bigdl_tpu.ops.pallas.prefill_attention import (
             prefill_attention_pallas, prefill_attention_supported)
@@ -181,20 +227,35 @@ def sdp_attention(
         pre_ok = (getattr(q_pos, "ndim", 0) == 0
                   and prefill_attention_supported(
                       q, k, v, q_pos, scale, logits_soft_cap,
-                      sliding_window, alibi_slopes))
+                      sliding_window, alibi_slopes, k_scale))
         if pre_ok and be == "pallas":
+            if quant_name:
+                _note_dequant_path(quant_name, "fused")
             return prefill_attention_pallas(q, k, v, q_pos, float(scale),
-                                            interpret=not on_tpu)
+                                            interpret=not on_tpu,
+                                            k_scale=k_scale, v_scale=v_scale)
         # probe once per BLOCK CLASS of sq (256-aligned vs 128-aligned),
         # not per exact prompt length
         probe_sq = 256 if sq % 256 == 0 else 128
         if pre_ok and on_tpu and _kernel_compiles(
                 "prefill", h, hkv, d, probe_sq, skv, str(k.dtype)):
-            return prefill_attention_pallas(q, k, v, q_pos, float(scale))
+            if quant_name:
+                _note_dequant_path(quant_name, "fused")
+            return prefill_attention_pallas(q, k, v, q_pos, float(scale),
+                                            k_scale=k_scale, v_scale=v_scale)
 
+    if quant_name:
+        _note_dequant_path(quant_name, "xla")
     qf = q.reshape(b, sq, hkv, g, d).astype(jnp.bfloat16)
-    kf = k.astype(jnp.bfloat16)
-    vf = v.astype(jnp.bfloat16)
+    if k_scale is not None:
+        # dequant in f32 (a bf16 scale multiply would round the scales)
+        kf = (k.astype(jnp.float32)
+              * k_scale[..., None].astype(jnp.float32)).astype(jnp.bfloat16)
+        vf = (v.astype(jnp.float32)
+              * v_scale[..., None].astype(jnp.float32)).astype(jnp.bfloat16)
+    else:
+        kf = k.astype(jnp.bfloat16)
+        vf = v.astype(jnp.bfloat16)
 
     # [B, Hkv, G, Sq, Skv]
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf,

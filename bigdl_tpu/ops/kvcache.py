@@ -1,4 +1,4 @@
-"""Static-shape KV cache with optional FP8 storage.
+"""Static-shape KV cache with low-bit storage dtypes.
 
 TPU-native re-design of the reference's KV caching
 (`DynamicNormalCache`/`DynamicFp8Cache`, reference transformers/kv.py:28-123,
@@ -12,10 +12,22 @@ the jit-compiled decode step has one shape for its whole lifetime. Validity
 is tracked by a scalar `pos`; attention masks keys at positions >= the
 query's position + 1 (so garbage in the unwritten tail is never read).
 
-FP8 ("quantize_kv_cache"): stores K/V as float8_e5m2 exactly like the
-reference's scale-free e5m2 cache (models/utils.py:99-153), halving KV HBM
-traffic; values are upcast at attention time and XLA fuses the cast into the
-matmul operand read.
+Storage dtypes (`kv_cache_dtype`):
+
+==========  =============================================================
+bf16        plain bfloat16 (default)
+fp8_e5m2    scale-free float8_e5m2, the reference's e5m2 cache
+            (models/utils.py:99-153); upcast fused into the matmul read
+int8        symmetric int8 codes + per-(token, head) f32 scales
+int4        symmetric jnp.int4 codes (XLA packs two per byte) + scales
+==========  =============================================================
+
+int8/int4 quantize on append: each written [D] vector gets one absmax
+scale, so appends at arbitrary (unaligned) positions never re-quantize
+neighbours and slot reuse can never leak a stale scale. Scales live in
+separate [L, B, S, Hkv] f32 planes (`k_scale`/`v_scale`, None for the
+scale-free dtypes) so the code planes keep the exact cache layout the
+attention kernels already stream.
 
 Layout: [num_layers, batch, max_seq, kv_heads, head_dim] — the whole stack is
 one array per K/V so a `lax.scan` over layers can carry it and update layer
@@ -25,21 +37,91 @@ slices in place (donated buffers alias, so there is no copy in the hot loop).
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+import warnings
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+# canonical kv_cache_dtype names -> storage dtypes
+KV_CACHE_DTYPES = {
+    "bf16": jnp.bfloat16,
+    "fp8_e5m2": jnp.float8_e5m2,
+    "int8": jnp.int8,
+    "int4": jnp.int4,
+}
+# dtypes that carry per-(token, head) scale planes
+SCALED_KV_DTYPES = ("int8", "int4")
+_KV_QMAX = {"int8": 127.0, "int4": 7.0}
+_DTYPE_ALIASES = {"bfloat16": "bf16", "fp8": "fp8_e5m2",
+                  "float8_e5m2": "fp8_e5m2", "e5m2": "fp8_e5m2"}
+
+_warned_quantized_alias = False
+
+
+def resolve_kv_cache_dtype(spec, default: str = "bf16") -> str:
+    """Normalize a kv-cache dtype spec to a canonical name.
+
+    Accepts the canonical strings (plus common aliases), None (-> default)
+    and — for backward compatibility with the old `quantize_kv_cache` /
+    `kv_quantized` booleans — True (deprecated alias for "fp8_e5m2",
+    warned once per process) / False (-> default)."""
+    global _warned_quantized_alias
+    if spec is None:
+        return default
+    if isinstance(spec, bool):
+        if spec:
+            if not _warned_quantized_alias:
+                _warned_quantized_alias = True
+                warnings.warn(
+                    "quantize_kv_cache/kv_quantized=True is deprecated; "
+                    "use kv_cache_dtype='fp8_e5m2' (or 'int8'/'int4' for "
+                    "block-scaled storage)", DeprecationWarning,
+                    stacklevel=3)
+            return "fp8_e5m2"
+        return default
+    s = str(spec).strip().lower()
+    s = _DTYPE_ALIASES.get(s, s)
+    if s not in KV_CACHE_DTYPES:
+        raise ValueError(
+            f"unknown kv_cache_dtype {spec!r}; choose from "
+            f"{sorted(KV_CACHE_DTYPES)}")
+    return s
+
+
+def reject_scaled_kv(spec, family: str) -> None:
+    """Guard for model families whose forward does not thread the
+    int8/int4 scale planes: fail at cache allocation with a clear
+    message instead of silently attending over raw codes."""
+    if resolve_kv_cache_dtype(spec) in SCALED_KV_DTYPES:
+        raise NotImplementedError(
+            f"kv_cache_dtype int8/int4 is not supported by the "
+            f"{family} family (its forward does not carry the scale "
+            f"planes); use 'bf16' or 'fp8_e5m2'")
+
+
+def kv_dtype_name(storage_dtype) -> str:
+    """Canonical name for a cache storage dtype (inverse of the table)."""
+    dt = jnp.dtype(storage_dtype)
+    for name, d in KV_CACHE_DTYPES.items():
+        if jnp.dtype(d) == dt:
+            return name
+    return str(dt)
 
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class KVCache:
-    k: jax.Array    # [L, B, S_max, H_kv, D]
+    k: jax.Array    # [L, B, S_max, H_kv, D] storage dtype
     v: jax.Array    # [L, B, S_max, H_kv, D]
     pos: jax.Array  # scalar int32: number of valid positions
+    # per-(token, head) f32 dequant scales for int8/int4 storage;
+    # None for the scale-free dtypes (bf16 / fp8_e5m2)
+    k_scale: Optional[jax.Array] = None   # [L, B, S_max, H_kv] f32
+    v_scale: Optional[jax.Array] = None
 
     def tree_flatten(self):
-        return (self.k, self.v, self.pos), None
+        return (self.k, self.v, self.pos, self.k_scale, self.v_scale), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -53,10 +135,15 @@ class KVCache:
     def num_layers(self) -> int:
         return self.k.shape[0]
 
+    @property
+    def kv_dtype(self) -> str:
+        """Canonical kv_cache_dtype name of the storage."""
+        return kv_dtype_name(self.k.dtype)
+
     def reset_pos(self, pos) -> "KVCache":
         """Same buffers, new validity pointer (generation pad repair /
         speculative rollback)."""
-        return KVCache(self.k, self.v, pos)
+        return KVCache(self.k, self.v, pos, self.k_scale, self.v_scale)
 
 
 def init_cache(
@@ -66,23 +153,56 @@ def init_cache(
     kv_heads: int,
     head_dim: int,
     dtype=jnp.bfloat16,
-    quantized: bool = False,
+    quantized=False,
     per_slot_pos: bool = False,
+    kv_cache_dtype: Optional[str] = None,
 ) -> KVCache:
-    """Allocate an empty cache. quantized=True stores float8_e5m2.
+    """Allocate an empty cache.
+
+    `kv_cache_dtype` picks the storage ("bf16" | "fp8_e5m2" | "int8" |
+    "int4"); `quantized` is the deprecated boolean alias (True ->
+    "fp8_e5m2") and, for plumbing convenience, also accepts a dtype
+    name string directly.
 
     per_slot_pos=True gives every batch row its own position counter —
     the continuous-batching layout (each serving slot decodes at its own
     depth, the capability the reference's vLLM port builds from per-seq
     KV dicts, vllm/model_executor/models/bigdl_model.py:88-139)."""
-    dt = jnp.float8_e5m2 if quantized else dtype
+    name = resolve_kv_cache_dtype(
+        kv_cache_dtype if kv_cache_dtype is not None else quantized)
+    dt = dtype if name == "bf16" else KV_CACHE_DTYPES[name]
     shape = (num_layers, batch, max_seq, kv_heads, head_dim)
+    scaled = name in SCALED_KV_DTYPES
+    sshape = (num_layers, batch, max_seq, kv_heads)
     return KVCache(
         k=jnp.zeros(shape, dt),
         v=jnp.zeros(shape, dt),
         pos=(jnp.zeros((batch,), jnp.int32) if per_slot_pos
              else jnp.zeros((), jnp.int32)),
+        k_scale=jnp.zeros(sshape, jnp.float32) if scaled else None,
+        v_scale=jnp.zeros(sshape, jnp.float32) if scaled else None,
     )
+
+
+def quantize_kv(x: jax.Array, storage_dtype) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric absmax quantization of the trailing [D] vectors.
+
+    Returns (codes in storage_dtype, f32 scales of x.shape[:-1]).
+    Zero vectors get scale 0 and all-zero codes (dequant is exact)."""
+    qmax = _KV_QMAX[kv_dtype_name(storage_dtype)]
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax / qmax
+    inv = jnp.where(scale > 0, 1.0 / jnp.maximum(scale, 1e-30), 0.0)
+    codes = jnp.clip(jnp.round(xf * inv[..., None]), -qmax, qmax)
+    return codes.astype(storage_dtype), scale
+
+
+def dequantize_kv(codes: jax.Array, scale: jax.Array,
+                  compute_dtype=jnp.bfloat16) -> jax.Array:
+    """codes [.., D] * scale [..] -> compute_dtype (dequant in f32)."""
+    return (codes.astype(jnp.float32)
+            * scale[..., None].astype(jnp.float32)).astype(compute_dtype)
 
 
 def update_layer(
@@ -92,32 +212,60 @@ def update_layer(
     k_new: jax.Array,   # [B, S_new, H_kv, D]
     v_new: jax.Array,
     pos: jax.Array,     # scalar int32 write offset, or [B] per-slot offsets
-) -> Tuple[jax.Array, jax.Array]:
+    cache_ks: Optional[jax.Array] = None,   # [L, B, S_max, H_kv] f32
+    cache_vs: Optional[jax.Array] = None,
+):
     """Write k_new/v_new into layer `layer` at sequence offset `pos`.
 
     `pos` may be a vector of per-batch offsets (continuous-batching serving:
     every slot decodes at its own depth). Returns the updated full-stack
     arrays; under jit with donated inputs this lowers to in-place updates.
+
+    With scale planes (`cache_ks`/`cache_vs`, int8/int4 storage) the new
+    values are quantized on append — one absmax scale per written [D]
+    vector, so unaligned offsets never disturb neighbouring tokens — and
+    a 4-tuple (ck, cv, cks, cvs) is returned instead of (ck, cv).
     """
-    k_new = k_new.astype(cache_k.dtype)
-    v_new = v_new.astype(cache_v.dtype)
+    scaled = cache_ks is not None
+    if scaled:
+        k_new, ks_new = quantize_kv(k_new, cache_k.dtype)
+        v_new, vs_new = quantize_kv(v_new, cache_v.dtype)
+    else:
+        k_new = k_new.astype(cache_k.dtype)
+        v_new = v_new.astype(cache_v.dtype)
     if getattr(pos, "ndim", 0) == 1:
         def write(c_b, n_b, p):           # [S,H,D], [S_new,H,D]
             return jax.lax.dynamic_update_slice(c_b, n_b, (p, 0, 0))
+
+        def write2(c_b, n_b, p):          # [S,H], [S_new,H] scale planes
+            return jax.lax.dynamic_update_slice(c_b, n_b, (p, 0))
 
         ck_l = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
         cv_l = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
         ck_l = jax.vmap(write)(ck_l, k_new, pos)
         cv_l = jax.vmap(write)(cv_l, v_new, pos)
-        return (
-            jax.lax.dynamic_update_index_in_dim(cache_k, ck_l, layer, 0),
-            jax.lax.dynamic_update_index_in_dim(cache_v, cv_l, layer, 0),
-        )
+        ck = jax.lax.dynamic_update_index_in_dim(cache_k, ck_l, layer, 0)
+        cv = jax.lax.dynamic_update_index_in_dim(cache_v, cv_l, layer, 0)
+        if not scaled:
+            return ck, cv
+        ks_l = jax.lax.dynamic_index_in_dim(cache_ks, layer, 0,
+                                            keepdims=False)
+        vs_l = jax.lax.dynamic_index_in_dim(cache_vs, layer, 0,
+                                            keepdims=False)
+        ks_l = jax.vmap(write2)(ks_l, ks_new, pos)
+        vs_l = jax.vmap(write2)(vs_l, vs_new, pos)
+        return (ck, cv,
+                jax.lax.dynamic_update_index_in_dim(cache_ks, ks_l, layer, 0),
+                jax.lax.dynamic_update_index_in_dim(cache_vs, vs_l, layer, 0))
     idx = (layer, 0, pos, 0, 0)
-    return (
-        jax.lax.dynamic_update_slice(cache_k, k_new[None], idx),
-        jax.lax.dynamic_update_slice(cache_v, v_new[None], idx),
-    )
+    ck = jax.lax.dynamic_update_slice(cache_k, k_new[None], idx)
+    cv = jax.lax.dynamic_update_slice(cache_v, v_new[None], idx)
+    if not scaled:
+        return ck, cv
+    sidx = (layer, 0, pos, 0)
+    return (ck, cv,
+            jax.lax.dynamic_update_slice(cache_ks, ks_new[None], sidx),
+            jax.lax.dynamic_update_slice(cache_vs, vs_new[None], sidx))
 
 
 def read_layer(
@@ -125,8 +273,73 @@ def read_layer(
     cache_v: jax.Array,
     layer: jax.Array | int,
     compute_dtype=jnp.bfloat16,
+    cache_ks: Optional[jax.Array] = None,
+    cache_vs: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Full-length K/V for one layer, upcast from storage dtype."""
+    """Full-length K/V for one layer, upcast (and dequantized when scale
+    planes are given) from storage dtype — the XLA fallback path. The
+    fused kernels take codes + scales directly via `read_layer_quantized`."""
     k = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
     v = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
+    if cache_ks is not None:
+        ks = jax.lax.dynamic_index_in_dim(cache_ks, layer, 0, keepdims=False)
+        vs = jax.lax.dynamic_index_in_dim(cache_vs, layer, 0, keepdims=False)
+        return (dequantize_kv(k, ks, compute_dtype),
+                dequantize_kv(v, vs, compute_dtype))
     return k.astype(compute_dtype), v.astype(compute_dtype)
+
+
+def read_layer_quantized(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    cache_ks: jax.Array,
+    cache_vs: jax.Array,
+    layer: jax.Array | int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One layer's raw codes + scales (no dequantization) — feed these to
+    `sdp_attention(.., k_scale=, v_scale=)` so the upcast happens inside
+    the fused kernels."""
+    k = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
+    v = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
+    ks = jax.lax.dynamic_index_in_dim(cache_ks, layer, 0, keepdims=False)
+    vs = jax.lax.dynamic_index_in_dim(cache_vs, layer, 0, keepdims=False)
+    return k, v, ks, vs
+
+
+def _logical_nbytes(a: jax.Array) -> int:
+    """Logical storage bytes: int4 packs two codes per byte (same
+    convention as QTensor.nbytes in ops/quant.py)."""
+    if jnp.dtype(a.dtype) == jnp.dtype(jnp.int4):
+        return -(-a.size // 2)
+    return a.size * jnp.dtype(a.dtype).itemsize
+
+
+def kv_cache_bytes(cache: KVCache) -> Dict[str, int]:
+    """Storage footprint of a cache: codes planes, scale planes, total."""
+    codes = _logical_nbytes(cache.k) + _logical_nbytes(cache.v)
+    scales = 0
+    if cache.k_scale is not None:
+        scales = (_logical_nbytes(cache.k_scale)
+                  + _logical_nbytes(cache.v_scale))
+    return {"codes": codes, "scales": scales, "total": codes + scales}
+
+
+def publish_kv_cache_bytes(cache: KVCache, registry=None) -> Dict[str, int]:
+    """Set the `bigdl_tpu_kv_cache_bytes` gauge (labelled by cache dtype
+    and component) from a cache's storage footprint. Best-effort: metric
+    export never gates cache allocation."""
+    sizes = kv_cache_bytes(cache)
+    try:
+        if registry is None:
+            from bigdl_tpu.observability import default_registry
+            registry = default_registry()
+        g = registry.gauge(
+            "bigdl_tpu_kv_cache_bytes",
+            "KV cache storage bytes by dtype and component "
+            "(codes | scales | total); int4 counted at two codes per byte",
+            labelnames=("dtype", "component"))
+        for comp, val in sizes.items():
+            g.labels(cache.kv_dtype, comp).set(float(val))
+    except Exception:
+        pass
+    return sizes

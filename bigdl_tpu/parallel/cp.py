@@ -25,6 +25,7 @@ forward_train's attn_fn branch).
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -35,6 +36,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.models import llama as M
 from bigdl_tpu.ops.matmul import linear
+
+_WARNED_CP_SCALED = False    # one warning per process for int8/int4 CP
 from bigdl_tpu.ops.ring import ring_attention
 from bigdl_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -244,17 +247,35 @@ def _decode_fn(cfg, mesh, axis, compute_dtype):
 
 
 def cp_empty_cache(cfg, batch: int, max_seq: int, mesh: Mesh,
-                   axis: str = "sp", compute_dtype=jnp.bfloat16):
+                   axis: str = "sp", compute_dtype=jnp.bfloat16,
+                   kv_cache_dtype: str = "bf16"):
     """Zero sequence-sharded (ck, cv) caches for incremental CP prefill
-    (cp_prefill_chunk); max_seq % mesh size == 0."""
+    (cp_prefill_chunk); max_seq % mesh size == 0.
+
+    kv_cache_dtype selects the STORAGE dtype: "fp8_e5m2" stores e5m2
+    (the einsum read sites already upcast to bf16); "int8"/"int4" need
+    per-token scale planes the sharded (ck, cv) tuple does not carry, so
+    the CP lane falls back to bf16 storage with a one-time warning."""
     n = mesh.shape[axis]
     if max_seq % n:
         raise ValueError(f"max_seq {max_seq} not divisible by {n}")
+    if kv_cache_dtype == "fp8_e5m2":
+        compute_dtype = jnp.float8_e5m2
+    elif kv_cache_dtype in ("int8", "int4"):
+        global _WARNED_CP_SCALED
+        if not _WARNED_CP_SCALED:
+            _WARNED_CP_SCALED = True
+            warnings.warn(
+                f"kv_cache_dtype={kv_cache_dtype!r} is not supported on "
+                "the context-parallel overflow lane (no scale planes in "
+                "the sequence-sharded cache); CP requests store bf16",
+                stacklevel=2)
+    return_dtype = compute_dtype
     shape = (cfg.num_hidden_layers, batch, max_seq,
              cfg.num_key_value_heads, cfg.hd)
     sh = NamedSharding(mesh, P(None, None, axis))
-    ck = jax.device_put(jnp.zeros(shape, compute_dtype), sh)
-    return ck, jax.device_put(jnp.zeros(shape, compute_dtype), sh)
+    ck = jax.device_put(jnp.zeros(shape, return_dtype), sh)
+    return ck, jax.device_put(jnp.zeros(shape, return_dtype), sh)
 
 
 def cp_prefill_chunk(

@@ -258,8 +258,17 @@ def tp_cache_specs(axis: str = "tp") -> P:
 
 
 def new_cache_tp(cfg, batch: int, max_seq: int, mesh: Mesh,
-                 quantized: bool = False, axis: str = "tp") -> KVCache:
+                 quantized=False, axis: str = "tp") -> KVCache:
     _tp_cfg(cfg, mesh.shape[axis], axis)  # fail fast, clear message
+    from bigdl_tpu.ops.kvcache import (SCALED_KV_DTYPES,
+                                       resolve_kv_cache_dtype)
+
+    if resolve_kv_cache_dtype(quantized) in SCALED_KV_DTYPES:
+        # the shard_mapped TP step carries only the k/v planes; the
+        # int8/int4 scale planes are not threaded through its specs yet
+        raise NotImplementedError(
+            "kv_cache_dtype int8/int4 is not supported under explicit "
+            "tensor parallelism; use 'bf16' or 'fp8_e5m2'")
     cache = M.new_cache(cfg, batch, max_seq, quantized=quantized)
     sh = NamedSharding(mesh, tp_cache_specs(axis))
     return KVCache(jax.device_put(cache.k, sh),

@@ -42,7 +42,9 @@ import numpy as np
 
 from bigdl_tpu.observability.metrics import RATIO_BUCKETS, default_registry
 from bigdl_tpu.observability.tracing import RequestTracer
-from bigdl_tpu.ops.kvcache import KVCache, init_cache
+from bigdl_tpu.ops.kvcache import (KVCache, init_cache,
+                                   publish_kv_cache_bytes,
+                                   resolve_kv_cache_dtype)
 
 
 @dataclasses.dataclass
@@ -113,7 +115,11 @@ class EngineConfig:
     max_batch: int = 8
     max_seq: int = 2048
     prefill_bucket: int = 16       # smallest prefill compile bucket
-    kv_quantized: bool = False
+    # KV cache storage dtype: "bf16", "fp8_e5m2", "int8" or "int4"
+    # (int8/int4 carry per-(token, head) scales and need a family with
+    # SUPPORTS_SCALED_KV). "bf16" defers to the deprecated kv_quantized.
+    kv_cache_dtype: str = "bf16"
+    kv_quantized: bool = False     # deprecated: True == "fp8_e5m2"
     # chunked prefill: a step() never runs more than this many prompt
     # tokens of prefill before the batched decode, so a long admission
     # cannot stall in-flight streams for more than one chunk's latency
@@ -254,10 +260,20 @@ class LLMEngine:
 
         ce = self.cfg_engine
         B = ce.max_batch
+        self.kv_cache_dtype = resolve_kv_cache_dtype(
+            ce.kv_cache_dtype if ce.kv_cache_dtype != "bf16"
+            else ce.kv_quantized)
+        if (self.kv_cache_dtype in ("int8", "int4")
+                and not getattr(self.family, "SUPPORTS_SCALED_KV", False)):
+            raise ValueError(
+                f"kv_cache_dtype={self.kv_cache_dtype!r} needs a family "
+                f"that threads scale planes through its forward; "
+                f"{getattr(self.family, 'name', '?')!r} does not "
+                "(SUPPORTS_SCALED_KV)")
         self.cache = init_cache(
             self.cfg.num_hidden_layers, B, ce.max_seq,
             self.cfg.num_key_value_heads, self.cfg.hd,
-            quantized=ce.kv_quantized, per_slot_pos=True)
+            kv_cache_dtype=self.kv_cache_dtype, per_slot_pos=True)
 
         self.slots = [_Slot() for _ in range(B)]
         # deque (admission pops the front; preemption appends the back)
@@ -348,21 +364,30 @@ class LLMEngine:
         self._sample_device = sample_device
 
         # prefill one sequence on a private 1-row cache, then splice its K/V
-        # and position into the batched cache at the slot index
+        # (and, for scaled dtypes, the per-token scale planes) and position
+        # into the batched cache at the slot index
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def insert(cache: KVCache, k1, v1, slot, plen):
+        def insert(cache: KVCache, cache1: KVCache, slot, plen):
             # the private cache may be chunk-padded past max_seq; the
             # tail holds only pad garbage (plen <= max_seq is enforced
             # at add_request), so clip the splice statically
             max_s = cache.k.shape[2]
-            k1 = k1[:, :, :max_s]
-            v1 = v1[:, :, :max_s]
+            k1 = cache1.k[:, :, :max_s]
+            v1 = cache1.v[:, :, :max_s]
             k = jax.lax.dynamic_update_slice(
                 cache.k, k1.astype(cache.k.dtype), (0, slot, 0, 0, 0))
             v = jax.lax.dynamic_update_slice(
                 cache.v, v1.astype(cache.v.dtype), (0, slot, 0, 0, 0))
+            ks = vs = None
+            if cache.k_scale is not None:
+                ks = jax.lax.dynamic_update_slice(
+                    cache.k_scale, cache1.k_scale[:, :, :max_s],
+                    (0, slot, 0, 0))
+                vs = jax.lax.dynamic_update_slice(
+                    cache.v_scale, cache1.v_scale[:, :, :max_s],
+                    (0, slot, 0, 0))
             pos = cache.pos.at[slot].set(plen)
-            return KVCache(k, v, pos)
+            return KVCache(k, v, pos, ks, vs)
 
         self._insert = insert
 
@@ -379,9 +404,19 @@ class LLMEngine:
         # two and size the cache up to a multiple of it (_admission_step)
         self._chunk = 1 << (max(1, ce.prefill_chunk).bit_length() - 1)
         self._admitting: Optional[_Admission] = None
-        # prefix cache: {prompt_tuple: (k_np [L,1,plen,H,D], v_np)} in
+        # prefix cache: {prompt_tuple: (k, v[, k_scale, v_scale])} in
         # insertion (LRU) order — host DRAM, not HBM
-        self._prefix_cache: Dict[Tuple[int, ...], Tuple[Any, Any]] = {}
+        self._prefix_cache: Dict[Tuple[int, ...], Tuple[Any, ...]] = {}
+        # lookup index over the prefix cache: length (a multiple of the
+        # granularity g) -> {hash(prompt[:length]): stored key}. Admission
+        # probes O(max_seq/chunk) bucketed lengths instead of scanning
+        # every entry token-by-token. Usable only when every possible
+        # chunk width is a multiple of g; otherwise _seed_from_prefix_cache
+        # falls back to the linear scan.
+        g = min(self._chunk, max(1, ce.prefill_bucket))
+        self._prefix_g = g if (self._chunk % g == 0
+                               and ce.prefill_bucket % g == 0) else 0
+        self._prefix_index: Dict[int, Dict[int, Tuple[int, ...]]] = {}
 
         # -- observability (bigdl_tpu/observability/__init__.py has the
         # full metric-name <-> engine-field map). Families are
@@ -438,6 +473,9 @@ class LLMEngine:
                     "Speculative decoding acceptance ratio per "
                     "verify round.", labelnames=("mode",),
                     buckets=RATIO_BUCKETS)
+        # batched-cache storage footprint per component (codes vs scales);
+        # shapes are static for the engine lifetime, so set once
+        publish_kv_cache_bytes(self.cache, m)
 
     # -- public api ---------------------------------------------------------
 
@@ -568,17 +606,27 @@ class LLMEngine:
             cache1 = init_cache(
                 self.cfg.num_hidden_layers, 1, alloc,
                 self.cfg.num_key_value_heads, self.cfg.hd,
-                quantized=self.cfg_engine.kv_quantized)
+                kv_cache_dtype=self.kv_cache_dtype)
             consumed, seed_kv = self._seed_from_prefix_cache(
                 req.prompt_token_ids, chunk)
             if consumed:
-                k_np, v_np = seed_kv
+                k_np, v_np = seed_kv[0], seed_kv[1]
                 kb = np.zeros(cache1.k.shape, k_np.dtype)
                 vb = np.zeros_like(kb)
                 kb[:, :, :consumed] = k_np[:, :, :consumed]
                 vb[:, :, :consumed] = v_np[:, :, :consumed]
+                ksb = vsb = None
+                if cache1.k_scale is not None:
+                    ks_np, vs_np = seed_kv[2], seed_kv[3]
+                    ksb = np.zeros(cache1.k_scale.shape, np.float32)
+                    vsb = np.zeros_like(ksb)
+                    ksb[:, :, :consumed] = ks_np[:, :, :consumed]
+                    vsb[:, :, :consumed] = vs_np[:, :, :consumed]
+                    ksb = jnp.asarray(ksb)
+                    vsb = jnp.asarray(vsb)
                 cache1 = KVCache(jnp.asarray(kb), jnp.asarray(vb),
-                                 jnp.asarray(consumed, jnp.int32))
+                                 jnp.asarray(consumed, jnp.int32),
+                                 ksb, vsb)
             a = self._admitting = _Admission(req, free, bucket, consumed,
                                              cache1)
             self.tracer.admitted(req.request_id)
@@ -600,7 +648,7 @@ class LLMEngine:
 
         if a.consumed >= plen:
             self._remember_prefix(a.req.prompt_token_ids, a.cache1)
-            self.cache = self._insert(self.cache, a.cache1.k, a.cache1.v,
+            self.cache = self._insert(self.cache, a.cache1,
                                       a.slot_idx, plen)
             s = self.slots[a.slot_idx]
             s.req = a.req
@@ -619,30 +667,51 @@ class LLMEngine:
     def _materialize(entry):
         """Pending device slices -> host numpy (cheap if the async copy
         already landed). device_get can hand back non-contiguous views on
-        some backends; force contiguity before keeping them around."""
-        k, v = entry
-        if not isinstance(k, np.ndarray):
-            k = np.ascontiguousarray(np.asarray(k))
-            v = np.ascontiguousarray(np.asarray(v))
-        return k, v
+        some backends; force contiguity before keeping them around.
+        Entries are (k, v) or, for scaled dtypes, (k, v, k_scale,
+        v_scale)."""
+        if not isinstance(entry[0], np.ndarray):
+            entry = tuple(np.ascontiguousarray(np.asarray(x))
+                          for x in entry)
+        return entry
 
     def _seed_from_prefix_cache(self, prompt: List[int], chunk: int):
-        """(consumed, (k, v)) for the longest usable cached prefix —
+        """(consumed, entry) for the longest usable cached prefix —
         rounded DOWN to a chunk multiple (continuation chunks must stay
         chunk-aligned) and capped at plen-1 (the final token must run to
-        produce sampling logits). (0, None) on miss."""
+        produce sampling logits). (0, None) on miss.
+
+        Lookup goes through the bucketed prefix-hash index: only chunk
+        multiples are usable, so probe the candidate lengths directly
+        (longest first), O(max_seq/chunk) hashes independent of how many
+        entries the cache holds. A hash hit is verified against the
+        stored key before use — a collision degrades to a miss at that
+        length, never to a wrong seed."""
+        if not self._prefix_cache:
+            return 0, None
         best = 0
         best_key = None
-        for stored in self._prefix_cache:
-            n = 0
-            for a, b in zip(stored, prompt):
-                if a != b:
+        if self._prefix_g and chunk % self._prefix_g == 0:
+            pt = tuple(prompt)
+            top = chunk * ((len(prompt) - 1) // chunk)
+            for length in range(top, 0, -chunk):
+                key = self._prefix_index.get(length, {}).get(
+                    hash(pt[:length]))
+                if key is not None and key[:length] == pt[:length]:
+                    best, best_key = length, key
                     break
-                n += 1
-            if n > best:
-                best, best_key = n, stored
-        best = min(best, len(prompt) - 1)
-        best -= best % chunk
+        else:
+            # non-divisible bucket/chunk configuration: linear scan
+            for stored in self._prefix_cache:
+                n = 0
+                for a, b in zip(stored, prompt):
+                    if a != b:
+                        break
+                    n += 1
+                if n > best:
+                    best, best_key = n, stored
+            best = min(best, len(prompt) - 1)
+            best -= best % chunk
         if best <= 0:
             return 0, None
         entry = self._materialize(self._prefix_cache[best_key])
@@ -654,6 +723,25 @@ class LLMEngine:
         if best <= 0:
             return 0, None
         return best, entry
+
+    def _prefix_index_add(self, key: Tuple[int, ...]) -> None:
+        g = self._prefix_g
+        if not g:
+            return
+        for length in range(g, len(key) + 1, g):
+            self._prefix_index.setdefault(length, {})[
+                hash(key[:length])] = key
+
+    def _prefix_index_drop(self, key: Tuple[int, ...]) -> None:
+        g = self._prefix_g
+        if not g:
+            return
+        for length in range(g, len(key) + 1, g):
+            d = self._prefix_index.get(length)
+            if d is not None and d.get(hash(key[:length])) == key:
+                del d[hash(key[:length])]
+                if not d:
+                    del self._prefix_index[length]
 
     def _remember_prefix(self, prompt: List[int], cache1: KVCache) -> None:
         """Snapshot the prompt's (truncated) KV for later prefix reuse.
@@ -669,20 +757,26 @@ class LLMEngine:
         entry = self._prefix_cache.pop(key, None)
         if entry is None:
             keep = min(len(prompt), ce.prefix_cache_max_tokens)
-            k1 = cache1.k[:, :, :keep]
-            v1 = cache1.v[:, :, :keep]
-            try:
-                k1.copy_to_host_async()
-                v1.copy_to_host_async()
-            except Exception:
-                pass                      # backend without async copies
-            entry = (k1, v1)
+            planes = [cache1.k[:, :, :keep], cache1.v[:, :, :keep]]
+            if cache1.k_scale is not None:
+                planes += [cache1.k_scale[:, :, :keep],
+                           cache1.v_scale[:, :, :keep]]
+            for p in planes:
+                try:
+                    p.copy_to_host_async()
+                except Exception:
+                    pass                  # backend without async copies
+            entry = tuple(planes)
+            self._prefix_index_add(key)
         self._prefix_cache[key] = entry          # (re-)insert most-recent
         while len(self._prefix_cache) > ce.prefix_cache_entries:
-            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+            old = next(iter(self._prefix_cache))
+            self._prefix_cache.pop(old)
+            self._prefix_index_drop(old)
 
     def reset_prefix_cache(self) -> None:
         self._prefix_cache.clear()
+        self._prefix_index.clear()
 
     def _finish_admission_abort(self, a: _Admission) -> None:
         self._push_output(a.req.request_id, RequestOutput(
@@ -933,7 +1027,8 @@ class LLMEngine:
         s.counts_out = None
         # reset the slot's position so the idle row stops deepening
         self.cache = KVCache(self.cache.k, self.cache.v,
-                             self.cache.pos.at[idx].set(0))
+                             self.cache.pos.at[idx].set(0),
+                             self.cache.k_scale, self.cache.v_scale)
 
     def _emit(self, s: _Slot, lp: Optional[LogprobEntry] = None) -> None:
         want_lp = s.req.params.logprobs is not None and lp is not None
@@ -1021,7 +1116,8 @@ class LLMEngine:
             want = len(ids) + req.params.max_tokens + 1
             alloc = min(-(-want // n) * n, self.cfg_engine.cp_max_seq)
             cache = cp_empty_cache(self.cfg, 1, alloc, self._cp_mesh,
-                                   self._cp_axis)
+                                   self._cp_axis,
+                                   kv_cache_dtype=self.kv_cache_dtype)
             adm = self._cp_admitting = _CPAdmitting(req, cache, 0, alloc)
             self.tracer.admitted(req.request_id)
 
@@ -1102,7 +1198,8 @@ class LLMEngine:
         s.counts = None
         s.counts_out = None
         self.cache = KVCache(self.cache.k, self.cache.v,
-                             self.cache.pos.at[victim].set(0))
+                             self.cache.pos.at[victim].set(0),
+                             self.cache.k_scale, self.cache.v_scale)
         self.waiting.append(resumed)
         self._m_preemptions.inc()
         self.tracer.preempted(resumed.request_id)

@@ -290,7 +290,8 @@ def speculative_generate(
     eos_token_id: Optional[int] = None,
     max_seq: int = 2048,
     seed: int = 0,
-    kv_quantized: bool = False,
+    kv_quantized=False,
+    kv_cache_dtype: Optional[str] = None,
     th_stop_draft: float = 0.8,
     auto_th_stop_draft: bool = True,
     stats: Optional[SpecStats] = None,
@@ -313,8 +314,14 @@ def speculative_generate(
         raise ValueError(f"prompt ({s}) + max_new_tokens ({max_new_tokens}) "
                          f"+ gamma+1 ({gamma + 1}) exceeds max_seq {max_seq}")
 
-    cache_t = new_cache(cfg_target, 1, max_seq, kv_quantized)
-    cache_d = new_cache(cfg_draft, 1, max_seq, kv_quantized)
+    from bigdl_tpu.ops.kvcache import resolve_kv_cache_dtype
+
+    # canonical dtype string rides the legacy positional `quantized` slot
+    # of the family new_cache adapters (they resolve bools and names)
+    kv_dtype = resolve_kv_cache_dtype(
+        kv_cache_dtype if kv_cache_dtype is not None else kv_quantized)
+    cache_t = new_cache(cfg_target, 1, max_seq, kv_dtype)
+    cache_d = new_cache(cfg_draft, 1, max_seq, kv_dtype)
 
     prefill = jax.jit(family_prefill, static_argnums=1, donate_argnums=3)
 
@@ -447,7 +454,8 @@ def prompt_lookup_generate(
     ngram: int = 2,
     eos_token_id: Optional[int] = None,
     max_seq: int = 2048,
-    kv_quantized: bool = False,
+    kv_quantized=False,
+    kv_cache_dtype: Optional[str] = None,
     stats: Optional[SpecStats] = None,
 ) -> np.ndarray:
     """Greedy generation with prompt-lookup speculation. Returns new
@@ -463,7 +471,10 @@ def prompt_lookup_generate(
                          f"({max_new_tokens}) + gamma+1 ({gamma + 1}) "
                          f"exceeds max_seq {max_seq}")
 
-    cache = new_cache(cfg, 1, max_seq, kv_quantized)
+    from bigdl_tpu.ops.kvcache import resolve_kv_cache_dtype
+
+    cache = new_cache(cfg, 1, max_seq, resolve_kv_cache_dtype(
+        kv_cache_dtype if kv_cache_dtype is not None else kv_quantized))
     prefill = jax.jit(family_prefill, static_argnums=1, donate_argnums=3)
 
     t0 = time.perf_counter()

@@ -99,7 +99,10 @@ class TpuCausalLM:
         model_path: Optional[str] = None,
         max_seq: int = 2048,
         kv_quantized: bool = False,
+        kv_cache_dtype: Optional[str] = None,
     ):
+        from bigdl_tpu.ops.kvcache import resolve_kv_cache_dtype
+
         self.params = _maybe_mxu_layout(params)
         self.config = cfg
         self.family = family
@@ -107,7 +110,9 @@ class TpuCausalLM:
         self.qtype = qtype
         self.model_path = model_path
         self.max_seq = max_seq
-        self.kv_quantized = kv_quantized
+        self.kv_cache_dtype = resolve_kv_cache_dtype(
+            kv_cache_dtype if kv_cache_dtype is not None else kv_quantized)
+        self.kv_quantized = self.kv_cache_dtype != "bf16"
         self.draft_params: Any = None   # set when loaded with speculative=True
         self._generator: Optional[Generator] = None
 
@@ -120,7 +125,7 @@ class TpuCausalLM:
                 forward_fn=self.family.forward,
                 prefill_fn=self.family.prefill,
                 max_seq=self.max_seq,
-                kv_quantized=self.kv_quantized,
+                kv_cache_dtype=self.kv_cache_dtype,
                 new_cache_fn=self.family.new_cache,
                 recurrent=self.family.is_recurrent,
             )
@@ -178,7 +183,7 @@ class TpuCausalLM:
                 ngram=ngram,
                 eos_token_id=eos_token_id,
                 max_seq=self.max_seq,
-                kv_quantized=self.kv_quantized,
+                kv_cache_dtype=self.kv_cache_dtype,
                 stats=spec_stats,
             )
             return np.concatenate([ids, new], axis=1)
@@ -203,7 +208,7 @@ class TpuCausalLM:
                 eos_token_id=eos_token_id,
                 max_seq=self.max_seq,
                 seed=seed,
-                kv_quantized=self.kv_quantized,
+                kv_cache_dtype=self.kv_cache_dtype,
                 th_stop_draft=th_stop_draft,
                 auto_th_stop_draft=auto_th_stop_draft,
                 stats=spec_stats,
@@ -404,6 +409,7 @@ class _BaseAutoModelClass:
         modules_to_not_convert=(),
         max_seq: Optional[int] = None,
         quantize_kv_cache: Optional[bool] = None,
+        kv_cache_dtype: Optional[str] = None,
         speculative: bool = False,
         embedding_qtype: Optional[str] = None,
         imatrix: Optional[Any] = None,
@@ -411,10 +417,18 @@ class _BaseAutoModelClass:
         model_hub: str = "huggingface",
         **_ignored,
     ) -> TpuCausalLM:
+        from bigdl_tpu.config import default_kv_cache_dtype
         from bigdl_tpu.config import flags
+        from bigdl_tpu.ops.kvcache import resolve_kv_cache_dtype
 
-        if quantize_kv_cache is None:
-            quantize_kv_cache = flags().quantize_kv_cache
+        if kv_cache_dtype is None:
+            if quantize_kv_cache is None:
+                # neither kwarg given: env/flag defaults decide
+                kv_cache_dtype = default_kv_cache_dtype()
+            else:
+                kv_cache_dtype = resolve_kv_cache_dtype(quantize_kv_cache)
+        else:
+            kv_cache_dtype = resolve_kv_cache_dtype(kv_cache_dtype)
         path = _resolve_hub_path(pretrained_model_name_or_path, model_hub)
         if lowbit_io.is_low_bit_dir(path):
             if speculative:
@@ -429,7 +443,7 @@ class _BaseAutoModelClass:
                     "from the original checkpoint with the imatrix")
             # max_seq=None lets the manifest's saved value win
             return cls.load_low_bit(path, max_seq=max_seq,
-                                    quantize_kv_cache=quantize_kv_cache,
+                                    kv_cache_dtype=kv_cache_dtype,
                                     merge_projections=merge_projections)
         if os.path.isfile(path) and path.endswith(".gguf"):
             if speculative:
@@ -453,7 +467,7 @@ class _BaseAutoModelClass:
                                 qtype="gguf",
                                 model_path=os.path.dirname(path),
                                 max_seq=max_seq or 2048,
-                                kv_quantized=quantize_kv_cache)
+                                kv_cache_dtype=kv_cache_dtype)
             # vocab already parsed once; CLIs reconstruct a tokenizer from
             # this instead of re-reading the file
             model.gguf_tokenizer_info = tok_info
@@ -532,7 +546,7 @@ class _BaseAutoModelClass:
         params = _maybe_merge(params, cfg, family, merge_projections)
         model = TpuCausalLM(params, cfg, family, hf_config, qtype,
                             model_path=path, max_seq=max_seq,
-                            kv_quantized=quantize_kv_cache)
+                            kv_cache_dtype=kv_cache_dtype)
         model = _attach_qwen_vl(model)
         if speculative:
             # self-speculation: same checkpoint as a sym_int4 draft
@@ -558,6 +572,7 @@ class _BaseAutoModelClass:
     @classmethod
     def load_low_bit(cls, path: str, max_seq: Optional[int] = None,
                      quantize_kv_cache: bool = False,
+                     kv_cache_dtype: Optional[str] = None,
                      merge_projections: bool = True,
                      **_ignored) -> TpuCausalLM:
         params, manifest = lowbit_io.load_low_bit(path)
@@ -572,6 +587,7 @@ class _BaseAutoModelClass:
             model_path=path,
             max_seq=max_seq or manifest.get("extra", {}).get("max_seq", 2048),
             kv_quantized=quantize_kv_cache,
+            kv_cache_dtype=kv_cache_dtype,
         ))
 
 

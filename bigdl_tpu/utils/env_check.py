@@ -58,6 +58,22 @@ def collect() -> dict:
         from bigdl_tpu.observability.tracing import validate_event_log_path
 
         info["event_log"] = validate_event_log_path(ev)
+
+    # KV cache storage dtype: fail loudly here rather than at the first
+    # model load (a typo'd dtype name otherwise surfaces deep in
+    # init_cache)
+    kvd = os.environ.get("BIGDL_TPU_KV_CACHE_DTYPE")
+    if kvd:
+        from bigdl_tpu.ops.kvcache import (KV_CACHE_DTYPES,
+                                           resolve_kv_cache_dtype)
+
+        try:
+            info["kv_cache_dtype"] = {
+                "value": resolve_kv_cache_dtype(kvd), "valid": True}
+        except ValueError:
+            info["kv_cache_dtype"] = {
+                "value": kvd, "valid": False,
+                "choices": sorted(KV_CACHE_DTYPES)}
     return info
 
 
@@ -71,7 +87,8 @@ def main() -> int:
                 print(f"  {ek}={ev}")
         else:
             print(f"{k:<{width}} : {v}")
-    ok = "jax_error" not in info and "bigdl_tpu_error" not in info
+    ok = ("jax_error" not in info and "bigdl_tpu_error" not in info
+          and info.get("kv_cache_dtype", {}).get("valid", True))
     print("status :", "OK" if ok else "PROBLEMS FOUND")
     return 0 if ok else 1
 

@@ -381,7 +381,8 @@ def test_ab_configs_sane():
     for label, overrides in bench.AB_CONFIGS:
         for key in overrides:
             if key.startswith("_"):
-                assert key in ("_qtype", "_kv_quantized", "_merged"), \
+                assert key in ("_qtype", "_kv_quantized",
+                               "_kv_cache_dtype", "_merged"), \
                     (label, key)
             else:
                 assert key in flag_names, (label, key)
