@@ -276,9 +276,13 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
         next_ms / 1e3)
     obs_summary = obs.summary()
     obs_summary.update(default_registry().summary())
+    from bigdl_tpu.observability.compile_watch import compile_table
 
     return {
         "observability": obs_summary,
+        # per-executable compile counts/times for this process — a bench
+        # row whose compile table grew between runs recompiled something
+        "jit_compile_table": compile_table(),
         "first_token_ms": round(max(first_raw - overhead_ms, 0.0), 3),
         "first_token_ms_raw": round(first_raw, 3),
         "next_token_ms": round(next_ms, 3),

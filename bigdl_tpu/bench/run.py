@@ -110,9 +110,12 @@ def _bench_serving(model, prompt, out_len, num_trials, warm_up):
         wall = time.perf_counter() - t0
         best = max(best, sum(len(o) for o in outs) / wall)
     summary = reg.summary()
+    from bigdl_tpu.observability.compile_watch import compile_table
+
     out = {"serving_tokens_per_s": round(best, 2),
            "batch": batch, "requests": len(prompts),
-           "observability": summary}
+           "observability": summary,
+           "jit_compile_table": compile_table()}
     ttft = summary.get("bigdl_tpu_ttft_seconds")
     if isinstance(ttft, dict):
         out["ttft_p50_ms"] = round(ttft["p50"] * 1e3, 3)
@@ -184,6 +187,10 @@ def run_one(model_path: str, low_bit: str, in_len: int, out_len: int,
                                 "bigdl_tpu_spec_tokens_total"))}
         if acc:
             metrics["observability"] = acc
+    if "jit_compile_table" not in metrics:
+        from bigdl_tpu.observability.compile_watch import compile_table
+
+        metrics["jit_compile_table"] = compile_table()
     return {
         "model": model_path,
         "low_bit": low_bit,

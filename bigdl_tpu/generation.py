@@ -21,6 +21,7 @@ import numpy as np
 from jax import lax
 
 from bigdl_tpu.models import llama as llama_mod
+from bigdl_tpu.observability.compile_watch import tracked_jit
 from bigdl_tpu.ops.kvcache import KVCache
 
 
@@ -324,16 +325,16 @@ def _beam_fns(cfg, forward_fn, prefill_fn, b: int, w: int, eos_token_id):
     analog of Generator's cached prefill/decode)."""
 
     pre = prefill_fn or forward_fn
-    prefill = jax.jit(lambda p, i, c: pre(p, cfg, i, c))
+    prefill = tracked_jit("beam_prefill", lambda p, i, c: pre(p, cfg, i, c))
 
     def prefill_lp(p, i, c):
         lg, c = prefill(p, i, c)
         return jax.nn.log_softmax(
             lg[:, -1, :].astype(jnp.float32), -1), c
 
-    expand = jax.jit(lambda x: jnp.repeat(x, w, axis=1))
+    expand = tracked_jit("beam_expand", lambda x: jnp.repeat(x, w, axis=1))
 
-    @jax.jit
+    @functools.partial(tracked_jit, "beam_select")
     def select(lp, scores, done, lengths, toks, t):
         """lp [B*W, V] log-probs -> (next_tok [B*W], new state)."""
         v = lp.shape[-1]
@@ -361,7 +362,8 @@ def _beam_fns(cfg, forward_fn, prefill_fn, b: int, w: int, eos_token_id):
         return (tok.reshape(-1), top_sc, done_n, lengths_n, toks_n,
                 flat_parent)
 
-    @functools.partial(jax.jit, donate_argnums=(2,))
+    @functools.partial(tracked_jit, "beam_reorder_decode",
+                       donate_argnums=(2,))
     def reorder_decode(params, parent_flat, cache, tok_flat):
         cache = jax.tree.map(
             lambda x: jnp.take(x, parent_flat, axis=1)
@@ -403,18 +405,21 @@ class Generator:
         fwd = forward_fn or llama_mod.forward
         pre = prefill_fn or llama_mod.forward_last_token
 
-        self._decode = jax.jit(
+        self._decode = tracked_jit(
+            "generate_decode",
             lambda p, c, t, kv: fwd(p, c, t, kv), static_argnums=(1,),
             donate_argnums=(3,))
-        self._prefill = jax.jit(
+        self._prefill = tracked_jit(
+            "generate_prefill",
             lambda p, c, t, kv: pre(p, c, t, kv), static_argnums=(1,),
             donate_argnums=(3,))
         # multimodal prefill (families whose prefill takes visual=):
         # built lazily so text-only models never trace it
         self._prefill_raw = pre
         self._prefill_vis = None
-        self._sample = jax.jit(
-            sample_token, static_argnames=("temperature", "top_k", "top_p"))
+        self._sample = tracked_jit(
+            "generate_sample", sample_token,
+            static_argnames=("temperature", "top_k", "top_p"))
 
         def sample_pen(lg, k, rep_counts, out_counts, *, temperature,
                        top_k, top_p, rep, pres, freq):
@@ -427,10 +432,12 @@ class Generator:
             out_counts = out_counts.at[rows, tok].add(1)
             return tok, rep_counts, out_counts
 
-        self._sample_pen = jax.jit(
-            sample_pen, static_argnames=("temperature", "top_k", "top_p",
-                                         "rep", "pres", "freq"))
-        self._counts = jax.jit(token_counts, static_argnums=(1,))
+        self._sample_pen = tracked_jit(
+            "generate_sample_pen", sample_pen,
+            static_argnames=("temperature", "top_k", "top_p",
+                             "rep", "pres", "freq"))
+        self._counts = tracked_jit("generate_token_counts", token_counts,
+                                   static_argnums=(1,))
         # phase timing published as bigdl_tpu_generate_{prefill,decode}
         # _seconds histograms (observability registry); .summary() gives
         # the host-side view
@@ -516,7 +523,8 @@ class Generator:
                     [vemb, np.zeros((rows - vemb.shape[0],) +
                                     vemb.shape[1:], vemb.dtype)])
             if self._prefill_vis is None:
-                self._prefill_vis = jax.jit(
+                self._prefill_vis = tracked_jit(
+                    "generate_prefill_vis",
                     lambda p, c, t, kv, vi, ve: self._prefill_raw(
                         p, c, t, kv, visual=(vi, ve)),
                     static_argnums=(1,), donate_argnums=(3,))

@@ -1,8 +1,8 @@
-"""Dependency-free metrics + request tracing for bigdl_tpu.
+"""Dependency-free metrics, tracing, compile telemetry and postmortems.
 
-Two pieces, both stdlib-only (jax is allowed elsewhere in the package
-but this subpackage must import with nothing beyond the standard
-library — tests/test_observability.py enforces it):
+Four pieces, all stdlib-only at import time (jax is allowed elsewhere
+in the package but this subpackage must import with nothing beyond the
+standard library — tests/test_observability.py enforces it):
 
 - ``metrics``: Counter / Gauge / Histogram registry with labels and
   Prometheus text exposition (``MetricsRegistry.render()``). The
@@ -11,8 +11,20 @@ library — tests/test_observability.py enforces it):
   engine's registry.
 - ``tracing``: per-request lifecycle spans (queue wait, prefill, TTFT,
   decode/TPOT, preemptions) kept in a ring buffer and optionally
-  appended as JSONL to ``$BIGDL_TPU_EVENT_LOG``; ``GET /v1/stats``
-  serves the snapshot.
+  appended as JSONL to ``$BIGDL_TPU_EVENT_LOG`` (size-rotated at
+  ``$BIGDL_TPU_EVENT_LOG_MAX_BYTES`` with a ``.1`` rollover);
+  ``GET /v1/stats`` serves the snapshot.
+- ``compile_watch``: ``tracked_jit(name, fn, ...)`` — jax.jit plus
+  compile accounting (count, wall time, abstract-shape signature per
+  executable) feeding the jit metrics below, a process-wide
+  ``compile_table()``, and a recompile-storm warning past
+  ``$BIGDL_TPU_RECOMPILE_WARN`` compiles per name.
+- ``flight``: ``FlightRecorder`` ring buffer of per-step engine events
+  plus postmortem dumps — on engine-step exception, stall-guard trip,
+  or SIGTERM/SIGINT a single JSON (flight tail, span tail, metrics
+  snapshot, compile table, config + env fingerprint) is written to
+  ``$BIGDL_TPU_POSTMORTEM_DIR``; ``GET /v1/debug/dump`` serves the
+  same dict on demand.
 
 Metric name -> engine field map (see also serving/engine.py):
 
@@ -36,6 +48,8 @@ bigdl_tpu_spec_round_seconds{mode}          speculative._spec_observe
 bigdl_tpu_spec_tokens_total{mode,kind}      speculative._spec_observe
 bigdl_tpu_kv_cache_bytes{dtype,component}   ops/kvcache.publish_kv_cache_bytes
 bigdl_tpu_kv_dequant_path_total{dtype,path} ops/attention._note_dequant_path
+bigdl_tpu_jit_compiles_total{fn}            compile_watch.TrackedJit
+bigdl_tpu_jit_compile_seconds{fn}           compile_watch.TrackedJit
 ==========================================  ===============================
 
 ``bigdl_tpu_kv_cache_bytes`` reports the batched KV cache's logical
@@ -44,8 +58,36 @@ counts two codes per byte). ``bigdl_tpu_kv_dequant_path_total`` counts
 how quantized attention dequantized: "fused" (inside the Pallas kernel)
 vs "xla" (upcast fallback); increments happen at trace time, so read it
 as "which path compiled", not a per-token rate.
+
+``bigdl_tpu_jit_compiles_total{fn}`` counts jax.jit compiles per
+tracked executable name (one per new abstract shape signature — e.g.
+one per (prefill bucket, kv dtype) pair for ``engine_prefill``);
+``bigdl_tpu_jit_compile_seconds{fn}`` holds the first-call wall time
+of each. A steadily incrementing compile counter in steady state IS the
+recompile-storm signature these exist to catch.
+
+Environment knobs: ``BIGDL_TPU_EVENT_LOG`` (span JSONL sink) +
+``BIGDL_TPU_EVENT_LOG_MAX_BYTES`` (rotate to ``.1`` past this size),
+``BIGDL_TPU_POSTMORTEM_DIR`` (where crash/stall/signal dumps land),
+``BIGDL_TPU_RECOMPILE_WARN`` (compiles-per-name warning threshold,
+default 8). All are validated by ``python -m bigdl_tpu.utils.env_check``.
 """
 
+from bigdl_tpu.observability.compile_watch import (
+    TrackedJit,
+    compile_table,
+    reset_compile_table,
+    resolve_recompile_threshold,
+    tracked_jit,
+)
+from bigdl_tpu.observability.flight import (
+    FlightRecorder,
+    build_postmortem,
+    env_fingerprint,
+    install_signal_dumps,
+    validate_postmortem_dir,
+    write_postmortem,
+)
 from bigdl_tpu.observability.metrics import (
     LATENCY_BUCKETS_S,
     RATIO_BUCKETS,
@@ -56,6 +98,7 @@ from bigdl_tpu.observability.metrics import (
 from bigdl_tpu.observability.tracing import (
     RequestSpan,
     RequestTracer,
+    resolve_event_log_max_bytes,
     validate_event_log_path,
 )
 
@@ -67,5 +110,17 @@ __all__ = [
     "default_registry",
     "RequestSpan",
     "RequestTracer",
+    "resolve_event_log_max_bytes",
     "validate_event_log_path",
+    "TrackedJit",
+    "tracked_jit",
+    "compile_table",
+    "reset_compile_table",
+    "resolve_recompile_threshold",
+    "FlightRecorder",
+    "build_postmortem",
+    "env_fingerprint",
+    "install_signal_dumps",
+    "validate_postmortem_dir",
+    "write_postmortem",
 ]

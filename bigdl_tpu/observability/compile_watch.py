@@ -1,0 +1,288 @@
+"""Compile telemetry: ``tracked_jit`` wrappers around every ``jax.jit``.
+
+The hot paths in this stack are configuration-sensitive by design —
+prefill compiles per (prompt-length bucket, kv dtype), the engine keeps
+per-shape sampler executables, speculative rounds compile per gamma.
+That is the intended cost model ("compile few, reuse forever"), but it
+also means a mis-bucketed client or a dtype knob flipped mid-flight can
+silently recompile every step and nothing in steady-state latency
+metrics says why. ``tracked_jit(name, fn, ...)`` is ``jax.jit`` plus an
+accounting layer:
+
+- a per-wrapper signature set (pytree structure + abstract shape/dtype
+  of every leaf) detects first-call-for-a-signature, i.e. a compile;
+- each compile increments ``bigdl_tpu_jit_compiles_total{fn=name}`` and
+  observes the first-call wall time (trace + lower + compile + first
+  dispatch) into ``bigdl_tpu_jit_compile_seconds{fn=name}``;
+- the process-wide compile table (``compile_table()``) keeps per-name
+  counts, cumulative seconds, and the most recent signatures — embedded
+  in postmortem dumps (observability/flight.py) and BENCH json;
+- crossing the recompile-storm threshold (``warn_threshold=`` or
+  ``$BIGDL_TPU_RECOMPILE_WARN``, default 8 compiles per name) logs one
+  warning and flags the table entry.
+
+Detection is signature-based rather than hooking XLA: it is exact for
+the wrappers' own cache (jax.jit keys its trace cache on the same
+abstract signature) and costs one tree_flatten per call.
+
+Stdlib-only at import time (tests/test_observability.py enforces it):
+jax is imported lazily inside ``tracked_jit``, which only ever runs from
+modules that already depend on jax.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_RECOMPILE_WARN = 8
+# signatures kept per name in the compile table (newest last); the
+# counters keep counting past this bound
+MAX_SIGNATURES_PER_NAME = 32
+
+_lock = threading.Lock()
+_table: Dict[str, Dict[str, Any]] = {}
+
+
+def resolve_recompile_threshold(value: Optional[object] = None) -> int:
+    """The recompile-storm warning threshold: explicit value, else
+    ``$BIGDL_TPU_RECOMPILE_WARN``, else the default. Raises ValueError
+    on a non-positive or non-integer setting (utils/env_check.py
+    surfaces this for the env var)."""
+    if value is None:
+        value = os.environ.get("BIGDL_TPU_RECOMPILE_WARN")
+    if value is None or value == "":
+        return DEFAULT_RECOMPILE_WARN
+    try:
+        n = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"recompile threshold must be a positive integer, got "
+            f"{value!r}")
+    if n <= 0:
+        raise ValueError(
+            f"recompile threshold must be a positive integer, got {n}")
+    return n
+
+
+def _leaf_sig(x: Any) -> Tuple:
+    """Hashable abstract signature of one DYNAMIC (traced) pytree leaf,
+    matching how jax's trace cache keys it: arrays by (dtype, shape);
+    python bool/int/float/complex by TYPE ONLY (jax traces them as
+    weak-typed 0-d arrays, so the value does not recompile — a beam
+    step counter t=0,1,2,... reuses one executable); anything else by
+    type (+hash when it has one)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (str(dtype), tuple(shape))
+    if isinstance(x, (bool, int, float, complex)):
+        return (type(x).__name__,)
+    try:
+        return (type(x).__name__, hash(x))
+    except TypeError:
+        return (type(x).__name__,)
+
+
+def _static_sig(x: Any) -> Tuple:
+    """Signature of a static_argnums/static_argnames argument: keyed by
+    VALUE — that is what jax keys compiles on for statics."""
+    try:
+        hash(x)
+        return (type(x).__name__, x)
+    except TypeError:
+        return (type(x).__name__, repr(x))
+
+
+def _sig_str(sig: Tuple) -> str:
+    """Compact human-readable form for the compile table (arrays as
+    'f32[2,8]'-style, statics as key=value)."""
+    _treedef, leaves, statics = sig
+    parts: List[str] = []
+    for leaf in leaves:
+        if (len(leaf) == 2 and isinstance(leaf[1], tuple)
+                and all(isinstance(d, int) for d in leaf[1])):
+            parts.append(f"{leaf[0]}[{','.join(map(str, leaf[1]))}]")
+        else:
+            parts.append(repr(leaf[1]) if len(leaf) > 1 else leaf[0])
+    for key, val in statics:
+        parts.append(f"{key}={val[1]!r}")
+    return "(" + ", ".join(parts) + ")"
+
+
+class TrackedJit:
+    """A jax.jit-compiled callable with compile accounting.
+
+    Calls pass straight through to the jitted function; the only
+    per-call overhead on the cache-hit path is one tree_flatten of the
+    arguments. Unknown attributes (``lower``, ``clear_cache``, ...)
+    forward to the underlying jitted callable.
+    """
+
+    def __init__(self, name: str, fn, registry=None,
+                 warn_threshold: Optional[int] = None, **jit_kwargs):
+        import jax
+
+        self.name = name
+        self._fn = fn
+        self._jitted = jax.jit(fn, **jit_kwargs)
+        self._flatten = jax.tree_util.tree_flatten
+        self._registry = registry
+        try:
+            self._warn_threshold = resolve_recompile_threshold(
+                warn_threshold)
+        except ValueError:
+            logger.warning(
+                "invalid BIGDL_TPU_RECOMPILE_WARN=%r; using default %d",
+                os.environ.get("BIGDL_TPU_RECOMPILE_WARN"),
+                DEFAULT_RECOMPILE_WARN)
+            self._warn_threshold = DEFAULT_RECOMPILE_WARN
+        sa = jit_kwargs.get("static_argnums", ())
+        self._static_argnums = (sa,) if isinstance(sa, int) else tuple(sa)
+        sn = jit_kwargs.get("static_argnames", ())
+        self._static_argnames = (sn,) if isinstance(sn, str) else tuple(sn)
+        self._seen: set = set()
+        self._seen_lock = threading.Lock()
+
+    # -- call path -----------------------------------------------------------
+
+    def _signature(self, args, kwargs) -> Tuple:
+        """Mirror jax's compile key: static args by value, everything
+        else by pytree structure + abstract leaf signature."""
+        statics: List[Tuple] = []
+        dyn_args = []
+        for i, a in enumerate(args):
+            if i in self._static_argnums:
+                statics.append((i, _static_sig(a)))
+            else:
+                dyn_args.append(a)
+        dyn_kwargs = {}
+        for k, v in kwargs.items():
+            if k in self._static_argnames:
+                statics.append((k, _static_sig(v)))
+            else:
+                dyn_kwargs[k] = v
+        leaves, treedef = self._flatten((dyn_args, dyn_kwargs))
+        return (treedef, tuple(_leaf_sig(x) for x in leaves),
+                tuple(statics))
+
+    def __call__(self, *args, **kwargs):
+        try:
+            sig = self._signature(args, kwargs)
+            with self._seen_lock:
+                hit = sig in self._seen
+        except Exception:
+            # unhashable exotic leaf: telemetry must never break the
+            # compiled path — run untracked
+            return self._jitted(*args, **kwargs)
+        if hit:
+            return self._jitted(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        with self._seen_lock:
+            self._seen.add(sig)
+        self._record_compile(sig, dt)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._jitted, item)
+
+    # -- accounting ----------------------------------------------------------
+
+    @property
+    def compiles(self) -> int:
+        with self._seen_lock:
+            return len(self._seen)
+
+    def _record_compile(self, sig: Tuple, seconds: float) -> None:
+        try:
+            self._observe_metrics(seconds)
+        except Exception:
+            pass
+        storm = False
+        with _lock:
+            ent = _table.setdefault(self.name, {
+                "compiles": 0, "total_s": 0.0, "signatures": [],
+                "last_compile_ts": 0.0, "storm": False})
+            ent["compiles"] += 1
+            ent["total_s"] += seconds
+            ent["last_compile_ts"] = time.time()
+            sigs = ent["signatures"]
+            sigs.append({"signature": _sig_str(sig),
+                         "seconds": round(seconds, 6)})
+            del sigs[:-MAX_SIGNATURES_PER_NAME]
+            if ent["compiles"] >= self._warn_threshold \
+                    and not ent["storm"]:
+                ent["storm"] = True
+                storm = True
+        if storm:
+            logger.warning(
+                "recompile storm: %r compiled %d times (threshold %d) — "
+                "check for unbucketed shapes or per-call dtype churn",
+                self.name, self._warn_threshold, self._warn_threshold)
+
+    def _observe_metrics(self, seconds: float) -> None:
+        from bigdl_tpu.observability.metrics import default_registry
+
+        regs = [default_registry()]
+        if self._registry is not None and self._registry is not regs[0]:
+            regs.append(self._registry)
+        for reg in regs:
+            reg.counter(
+                "bigdl_tpu_jit_compiles_total",
+                "jax.jit compiles per tracked executable "
+                "(one per new abstract shape signature).",
+                labelnames=("fn",)).labels(self.name).inc()
+            reg.histogram(
+                "bigdl_tpu_jit_compile_seconds",
+                "First-call wall time per new signature "
+                "(trace + lower + compile + first dispatch).",
+                labelnames=("fn",)).labels(self.name).observe(seconds)
+
+
+def tracked_jit(name: str, fn=None, *, registry=None,
+                warn_threshold: Optional[int] = None, **jit_kwargs):
+    """jax.jit with compile telemetry (see module docstring).
+
+    ``tracked_jit("decode", fn, donate_argnums=(2,))`` or as a
+    decorator factory: ``@tracked_jit("decode", donate_argnums=(2,))``.
+    ``registry`` additionally mirrors the compile metrics into a
+    non-default registry (e.g. the engine's)."""
+    if fn is None:
+        def deco(f):
+            return TrackedJit(name, f, registry=registry,
+                              warn_threshold=warn_threshold, **jit_kwargs)
+        return deco
+    return TrackedJit(name, fn, registry=registry,
+                      warn_threshold=warn_threshold, **jit_kwargs)
+
+
+def compile_table() -> Dict[str, Dict[str, Any]]:
+    """JSON-ready snapshot of the process-wide compile table:
+    {name: {compiles, total_s, signatures[...], last_compile_ts,
+    storm}}."""
+    with _lock:
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, ent in sorted(_table.items()):
+            out[name] = {
+                "compiles": ent["compiles"],
+                "total_s": round(ent["total_s"], 6),
+                "last_compile_ts": round(ent["last_compile_ts"], 6),
+                "storm": ent["storm"],
+                "signatures": [dict(s) for s in ent["signatures"]],
+            }
+        return out
+
+
+def reset_compile_table() -> None:
+    """Drop the process-wide table (tests / fresh bench runs). Does NOT
+    reset per-wrapper signature sets — already-compiled executables stay
+    uncounted, which is the truthful reading."""
+    with _lock:
+        _table.clear()

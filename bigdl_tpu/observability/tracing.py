@@ -31,6 +31,27 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 
+def resolve_event_log_max_bytes(value=None):
+    """Size bound for the JSONL sink: explicit value, else
+    ``$BIGDL_TPU_EVENT_LOG_MAX_BYTES``, else None (unbounded). Raises
+    ValueError on a non-positive or non-integer setting
+    (utils/env_check.py surfaces this for the env var)."""
+    if value is None:
+        value = os.environ.get("BIGDL_TPU_EVENT_LOG_MAX_BYTES")
+    if value is None or value == "":
+        return None
+    try:
+        n = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"event log size limit must be a positive integer, got "
+            f"{value!r}")
+    if n <= 0:
+        raise ValueError(
+            f"event log size limit must be a positive integer, got {n}")
+    return n
+
+
 def validate_event_log_path(path: str) -> dict:
     """Report whether `path` is usable as a JSONL event-log sink
     (utils/env_check.py surfaces this for BIGDL_TPU_EVENT_LOG)."""
@@ -123,7 +144,8 @@ class RequestTracer:
     buffer of finished spans; optional JSONL event sink."""
 
     def __init__(self, capacity: int = 256,
-                 event_log_path: Optional[str] = None):
+                 event_log_path: Optional[str] = None,
+                 event_log_max_bytes: Optional[int] = None):
         if event_log_path is None:
             event_log_path = os.environ.get("BIGDL_TPU_EVENT_LOG")
         self._lock = threading.Lock()
@@ -133,6 +155,19 @@ class RequestTracer:
         self._sink_path = event_log_path or None
         self._sink = None
         self._sink_dead = False
+        # size-bounded rotation: when the sink would grow past the
+        # limit it is renamed to `<path>.1` (replacing any previous
+        # rollover) and a fresh file is started — total disk footprint
+        # is bounded at ~2x the limit
+        if event_log_max_bytes is None:
+            try:
+                event_log_max_bytes = resolve_event_log_max_bytes()
+            except ValueError:
+                # env_check reports the bad value; the tracer itself
+                # degrades to an unbounded sink rather than dying
+                event_log_max_bytes = None
+        self._sink_max_bytes = event_log_max_bytes
+        self._sink_bytes = 0
 
     # -- JSONL sink ---------------------------------------------------------
 
@@ -145,7 +180,20 @@ class RequestTracer:
         try:
             if self._sink is None:
                 self._sink = open(self._sink_path, "a", buffering=1)
-            self._sink.write(json.dumps(line) + "\n")
+                try:
+                    self._sink_bytes = os.path.getsize(self._sink_path)
+                except OSError:
+                    self._sink_bytes = 0
+            payload = json.dumps(line) + "\n"
+            if (self._sink_max_bytes is not None and self._sink_bytes
+                    and self._sink_bytes + len(payload)
+                    > self._sink_max_bytes):
+                self._sink.close()
+                os.replace(self._sink_path, self._sink_path + ".1")
+                self._sink = open(self._sink_path, "a", buffering=1)
+                self._sink_bytes = 0
+            self._sink.write(payload)
+            self._sink_bytes += len(payload)
         except OSError as e:
             # one warning, then the sink stays off — tracing must never
             # take the serving loop down

@@ -14,10 +14,16 @@ POST /v1/embeddings when constructed with an embedder (BertEmbedder).
 Observability endpoints (bigdl_tpu/observability/):
 - GET /metrics — Prometheus text exposition of the engine's registry
 - GET /v1/stats — JSON engine snapshot (slots, queues, metric
-  summaries, recent request spans)
+  summaries, recent request spans, jit compile table)
+- GET /v1/debug/dump — on-demand postmortem JSON (flight-recorder
+  tail, span tail, metrics snapshot, compile table, config + env
+  fingerprint); the same document the engine writes to
+  $BIGDL_TPU_POSTMORTEM_DIR on step exceptions, stall-guard trips,
+  and (via the CLI's signal hooks) SIGTERM/SIGINT
 - POST /v1/profiler/start {"log_dir": ...} / POST /v1/profiler/stop —
   on-demand jax.profiler device trace against the live server
   (TensorBoard/Perfetto; wraps utils/profiling.start_profiler)
+- GET /v1/profiler/status — whether a capture is running, and where
 
 Tokenization: pass a HF tokenizer (transformers.AutoTokenizer) at
 construction; prompts may also be raw token-id lists, in which case
@@ -67,6 +73,13 @@ class _EngineLoop:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=2)
+
+
+def _jsonable(obj):
+    """Round-trip through JSON with repr() fallback — the postmortem
+    dict may carry values json.dumps can't encode natively (the same
+    default=repr the on-disk dump writer uses)."""
+    return json.loads(json.dumps(obj, default=repr))
 
 
 def _chat_to_prompt(messages: List[dict], tokenizer) -> Any:
@@ -351,6 +364,15 @@ class OpenAIServer:
                     self.wfile.write(body)
                 elif self.path == "/v1/stats":
                     self._json(200, server.engine.stats_snapshot())
+                elif self.path == "/v1/debug/dump":
+                    # same document the engine writes to
+                    # $BIGDL_TPU_POSTMORTEM_DIR, served live
+                    self._json(200, _jsonable(
+                        server.engine.postmortem("on_demand")))
+                elif self.path == "/v1/profiler/status":
+                    from bigdl_tpu.utils import profiling
+
+                    self._json(200, profiling.profiler_status())
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -568,6 +590,11 @@ def main():
         embedder_tok = AutoTokenizer.from_pretrained(args.embedder)
     server = OpenAIServer(engine, tokenizer, embedder=embedder,
                           embedder_tokenizer=embedder_tok)
+    # operator kill (SIGTERM from a deploy, ^C) leaves a postmortem in
+    # $BIGDL_TPU_POSTMORTEM_DIR before default termination proceeds
+    from bigdl_tpu.observability.flight import install_signal_dumps
+
+    install_signal_dumps(engine.write_postmortem)
     print(f"serving on http://{args.host}:{args.port}/v1")
     server.serve(args.host, args.port)
 

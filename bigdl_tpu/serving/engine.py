@@ -40,6 +40,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.observability.compile_watch import tracked_jit
+from bigdl_tpu.observability.flight import FlightRecorder, build_postmortem
+from bigdl_tpu.observability.flight import write_postmortem as \
+    _write_postmortem_file
 from bigdl_tpu.observability.metrics import RATIO_BUCKETS, default_registry
 from bigdl_tpu.observability.tracing import RequestTracer
 from bigdl_tpu.ops.kvcache import (KVCache, init_cache,
@@ -236,7 +240,8 @@ class LLMEngine:
     """
 
     def __init__(self, model: Any, config: Optional[EngineConfig] = None,
-                 cp_mesh: Any = None, registry=None, tracer=None):
+                 cp_mesh: Any = None, registry=None, tracer=None,
+                 flight: Optional[FlightRecorder] = None):
         self.cfg_engine = config or EngineConfig()
         self.params = model.params
         self.cfg = model.config
@@ -285,6 +290,20 @@ class LLMEngine:
         self._children: Dict[str, Tuple[str, int]] = {}
         self._fanouts: Dict[str, _Fanout] = {}
         self._stall_steps = 0       # consecutive steps with starved queue
+        self._step_idx = 0          # lifetime step() counter
+
+        # observability backbone, created BEFORE the jit definitions so
+        # tracked_jit can mirror compile metrics into the engine's
+        # registry (bigdl_tpu/observability/__init__.py has the full
+        # metric-name <-> engine-field map). Families are get-or-create,
+        # so sharing a registry across engines or with the probe/spec
+        # sites is safe.
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.tracer = tracer if tracer is not None else RequestTracer()
+        # flight recorder: bounded ring of structured step/scheduling
+        # events; its tail is the core of every postmortem dump
+        self.flight = flight if flight is not None else FlightRecorder()
 
         # context-parallel overflow lane (long prompts)
         self._cp_mesh = cp_mesh
@@ -307,15 +326,18 @@ class LLMEngine:
 
         fwd = self.family.forward
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
+        @functools.partial(tracked_jit, "engine_decode",
+                           registry=self.registry, donate_argnums=(2,))
         def decode(params, tokens, cache):   # tokens [B] int32
             logits, cache = fwd(params, self.cfg, tokens[:, None], cache)
             return logits[:, -1, :], cache
 
         self._decode = decode
         # greedy fast path: one fused argmax, [B] ints across the tunnel
-        self._argmax = jax.jit(
-            lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32))
+        self._argmax = tracked_jit(
+            "engine_argmax",
+            lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32),
+            registry=self.registry)
         # batched DEVICE sampler: temperature / top-k / top-p via
         # gumbel-max, one seeded stream per slot. Serves every slot that
         # needs no penalty counts and no logprobs — the [B, V] logits
@@ -324,7 +346,8 @@ class LLMEngine:
         # full-featured path). Seeded slots derive their key from
         # (seed, absolute position), so a preempt-resume — or a change
         # in WHICH other requests share the batch — replays identically.
-        @jax.jit
+        @functools.partial(tracked_jit, "engine_sample_device",
+                           registry=self.registry)
         def sample_device(lg, temps, top_ks, top_ps, seeds, poss):
             lg = lg.astype(jnp.float32)                      # [B, V]
             v = lg.shape[-1]
@@ -366,7 +389,8 @@ class LLMEngine:
         # prefill one sequence on a private 1-row cache, then splice its K/V
         # (and, for scaled dtypes, the per-token scale planes) and position
         # into the batched cache at the slot index
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(tracked_jit, "engine_insert",
+                           registry=self.registry, donate_argnums=(0,))
         def insert(cache: KVCache, cache1: KVCache, slot, plen):
             # the private cache may be chunk-padded past max_seq; the
             # tail holds only pad garbage (plen <= max_seq is enforced
@@ -391,10 +415,12 @@ class LLMEngine:
 
         self._insert = insert
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
+        @functools.partial(tracked_jit, "engine_prefill",
+                           registry=self.registry, donate_argnums=(2,))
         def prefill_chunk(params, tokens, cache1):
-            # one jitted fn; XLA caches an executable per (chunk width,
-            # cache bucket) shape pair
+            # one tracked fn; XLA caches an executable per (chunk width,
+            # cache bucket, kv dtype) shape tuple — the compile table's
+            # per-signature rows ARE the engine's prefill executables
             return fwd(params, self.cfg, tokens, cache1)
 
         self._prefill = prefill_chunk
@@ -418,13 +444,8 @@ class LLMEngine:
                                and ce.prefill_bucket % g == 0) else 0
         self._prefix_index: Dict[int, Dict[int, Tuple[int, ...]]] = {}
 
-        # -- observability (bigdl_tpu/observability/__init__.py has the
-        # full metric-name <-> engine-field map). Families are
-        # get-or-create, so sharing a registry across engines or with the
-        # probe/spec sites is safe.
-        self.registry = registry if registry is not None \
-            else default_registry()
-        self.tracer = tracer if tracer is not None else RequestTracer()
+        # -- metric families (registry/tracer/flight created above,
+        # before the jit definitions)
         m = self.registry
         self._m_phase = m.histogram(
             "bigdl_tpu_request_phase_seconds",
@@ -476,6 +497,11 @@ class LLMEngine:
         # batched-cache storage footprint per component (codes vs scales);
         # shapes are static for the engine lifetime, so set once
         publish_kv_cache_bytes(self.cache, m)
+        self.flight.record(
+            "engine_init", max_batch=B, max_seq=ce.max_seq,
+            kv_cache_dtype=self.kv_cache_dtype,
+            prefill_chunk=self._chunk, family=getattr(
+                self.family, "name", type(self.family).__name__))
 
     # -- public api ---------------------------------------------------------
 
@@ -630,6 +656,11 @@ class LLMEngine:
             a = self._admitting = _Admission(req, free, bucket, consumed,
                                              cache1)
             self.tracer.admitted(req.request_id)
+            self.flight.record(
+                "admit_start", step=self._step_idx,
+                request_id=req.request_id, slot=free, bucket=bucket,
+                prompt_len=len(req.prompt_token_ids),
+                prefix_seeded=consumed)
 
         if a.req.request_id in self._abort:      # aborted mid-admission
             self._abort.discard(a.req.request_id)
@@ -982,6 +1013,8 @@ class LLMEngine:
         if just_first and span.ttft_s is not None:
             self._m_ttft.observe(span.ttft_s)
         self._m_admissions.inc()
+        self.flight.record("admit_complete", step=self._step_idx,
+                           request_id=rid)
 
     def _obs_finish(self, rid: str, reason: str,
                     n_generated: int = 0) -> None:
@@ -991,6 +1024,8 @@ class LLMEngine:
             if d is not None and d >= 0:
                 self._m_phase.labels("decode").observe(d)
         self._m_finished.labels(reason).inc()
+        self.flight.record("finish", step=self._step_idx, request_id=rid,
+                           reason=reason, n_generated=n_generated)
 
     def _update_gauges(self) -> None:
         self._m_occupancy.set(sum(1 for s in self.slots if s.active))
@@ -998,7 +1033,10 @@ class LLMEngine:
 
     def stats_snapshot(self) -> dict:
         """JSON-ready engine state for `GET /v1/stats`: live occupancy,
-        queue depths, metric summaries and recent request spans."""
+        queue depths, metric summaries, recent request spans, and the
+        jit compile table."""
+        from bigdl_tpu.observability.compile_watch import compile_table
+
         return {
             "slots": {"total": len(self.slots),
                       "active": sum(1 for s in self.slots if s.active)},
@@ -1006,9 +1044,40 @@ class LLMEngine:
             "cp_queue_depth": len(self._cp_waiting),
             "admitting": self._admitting is not None,
             "stall_steps": self._stall_steps,
+            "engine_steps": self._step_idx,
             "metrics": self.registry.summary(),
             "requests": self.tracer.snapshot(),
+            "compile_table": compile_table(),
         }
+
+    def _config_fingerprint(self) -> dict:
+        out = dataclasses.asdict(self.cfg_engine)
+        out["kv_cache_dtype_resolved"] = self.kv_cache_dtype
+        out["family"] = getattr(self.family, "name",
+                                type(self.family).__name__)
+        out["eos_token_id"] = self.eos_token_id
+        return out
+
+    def postmortem(self, reason: str = "on_demand",
+                   error: Optional[BaseException] = None) -> dict:
+        """The postmortem dict (flight tail, span tail, metrics
+        snapshot, compile table, config + env fingerprint) — what
+        `GET /v1/debug/dump` serves and crash dumps write."""
+        return build_postmortem(
+            reason, flight=self.flight, tracer=self.tracer,
+            registry=self.registry, config=self._config_fingerprint(),
+            error=error)
+
+    def write_postmortem(self, reason: str,
+                         error: Optional[BaseException] = None,
+                         directory: Optional[str] = None):
+        """Write the postmortem JSON to `directory` (default
+        $BIGDL_TPU_POSTMORTEM_DIR); returns the path or None. Never
+        raises."""
+        return _write_postmortem_file(
+            reason, directory=directory, flight=self.flight,
+            tracer=self.tracer, registry=self.registry,
+            config=self._config_fingerprint(), error=error)
 
     def _finish(self, idx: int, reason: str) -> None:
         s = self.slots[idx]
@@ -1203,11 +1272,31 @@ class LLMEngine:
         self.waiting.append(resumed)
         self._m_preemptions.inc()
         self.tracer.preempted(resumed.request_id)
+        self.flight.record(
+            "preempt", step=self._step_idx,
+            request_id=resumed.request_id, slot=victim,
+            n_generated=resumed.generated_offset)
 
     def step(self) -> bool:
         """One engine iteration (reference LLMEngine.step): advance the
         (chunked) admission by one chunk, then run one batched decode
-        step. Returns True if any work was done."""
+        step. Returns True if any work was done.
+
+        A step that raises records the exception into the flight
+        recorder and writes a postmortem dump (when
+        $BIGDL_TPU_POSTMORTEM_DIR is set) before re-raising — the
+        engine loop thread dying silently is exactly the failure mode
+        the flight recorder exists for."""
+        self._step_idx += 1
+        try:
+            return self._step_inner()
+        except Exception as e:
+            self.flight.record("step_exception", step=self._step_idx,
+                               error=repr(e))
+            self.write_postmortem("engine_step_exception", error=e)
+            raise
+
+    def _step_inner(self) -> bool:
         # aborts
         for i, s in enumerate(self.slots):
             if s.active and s.req.request_id in self._abort:
@@ -1223,6 +1312,13 @@ class LLMEngine:
             self._stall_steps += 1
             if self._stall_steps >= ce.preempt_after_steps:
                 self._m_stall_trips.inc()
+                self.flight.record(
+                    "stall_guard_trip", step=self._step_idx,
+                    stall_steps=self._stall_steps,
+                    queue_depth=len(self.waiting))
+                # a trip means admission starved for preempt_after_steps
+                # consecutive steps — dump the evidence while it is hot
+                self.write_postmortem("stall_guard_trip")
                 self._preempt()
                 self._stall_steps = 0
         else:
@@ -1242,6 +1338,8 @@ class LLMEngine:
             did = cp_did or self._admitting is not None
             if did:
                 self._m_steps.inc()
+                self._flight_step("admit" if self._admitting is not None
+                                  else "cp", 0)
             self._update_gauges()
             return did
 
@@ -1307,8 +1405,20 @@ class LLMEngine:
         # step wall time IS each stream's time-per-output-token
         self._m_tpot.observe(time.perf_counter() - t_decode0)
         self._m_steps.inc()
+        self._flight_step("decode", len(active))
         self._update_gauges()
         return True
+
+    def _flight_step(self, phase: str, n_active: int) -> None:
+        """One structured flight-recorder event per working step: what
+        the engine was doing, with how many streams, against what
+        backlog — the per-step breadcrumb trail a postmortem replays."""
+        self.flight.record(
+            "step", step=self._step_idx, phase=phase,
+            occupancy=n_active, queue_depth=len(self.waiting),
+            cp_queue_depth=len(self._cp_waiting),
+            admitting=self._admitting is not None,
+            stall_steps=self._stall_steps)
 
     # -- convenience: blocking one-shot generation --------------------------
 

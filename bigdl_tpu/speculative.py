@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from bigdl_tpu.observability.compile_watch import tracked_jit
 from bigdl_tpu.ops.kvcache import KVCache
 
 
@@ -129,7 +130,7 @@ def make_spec_round(
 
     sampling = do_sample and temperature > 0.0
 
-    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    @functools.partial(tracked_jit, "spec_round", donate_argnums=(2, 3))
     def spec_round(params_t, params_d, cache_t: KVCache, cache_d: KVCache,
                    cur_tok: jax.Array, key: jax.Array, th_stop: jax.Array):
         b = cur_tok.shape[0]
@@ -323,7 +324,8 @@ def speculative_generate(
     cache_t = new_cache(cfg_target, 1, max_seq, kv_dtype)
     cache_d = new_cache(cfg_draft, 1, max_seq, kv_dtype)
 
-    prefill = jax.jit(family_prefill, static_argnums=1, donate_argnums=3)
+    prefill = tracked_jit("spec_prefill", family_prefill,
+                          static_argnums=1, donate_argnums=3)
 
     t0 = time.perf_counter()
     toks = jnp.asarray(ids)
@@ -397,7 +399,7 @@ def make_lookup_round(fwd_target: Callable, cfg_target: Any, gamma: int,
     device — no host sync inside the round.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(1,))
+    @functools.partial(tracked_jit, "lookup_round", donate_argnums=(1,))
     def lookup_round(params_t, cache_t: KVCache, hist: jax.Array,
                      hist_len: jax.Array, cur_tok: jax.Array):
         pos0 = cache_t.pos
@@ -475,7 +477,8 @@ def prompt_lookup_generate(
 
     cache = new_cache(cfg, 1, max_seq, resolve_kv_cache_dtype(
         kv_cache_dtype if kv_cache_dtype is not None else kv_quantized))
-    prefill = jax.jit(family_prefill, static_argnums=1, donate_argnums=3)
+    prefill = tracked_jit("lookup_prefill", family_prefill,
+                          static_argnums=1, donate_argnums=3)
 
     t0 = time.perf_counter()
     logits, cache = prefill(params, cfg, jnp.asarray(ids), cache)

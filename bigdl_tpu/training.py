@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from bigdl_tpu.observability.compile_watch import tracked_jit
+
 
 def next_token_loss(logits: jax.Array, tokens: jax.Array,
                     mask: Optional[jax.Array] = None) -> jax.Array:
@@ -61,7 +63,7 @@ def make_train_step(
         return next_token_loss(logits, batch["input_ids"],
                                batch.get("attention_mask"))
 
-    @jax.jit
+    @functools.partial(tracked_jit, "train_step")
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         if trainable_filter is not None:
@@ -128,7 +130,7 @@ def make_lora_train_step(
         return next_token_loss(logits, batch["input_ids"],
                                batch.get("attention_mask"))
 
-    @jax.jit
+    @functools.partial(tracked_jit, "lora_train_step")
     def step(train, opt_state, frozen, batch):
         loss, grads = jax.value_and_grad(loss_fn)(train, frozen, batch)
         updates, opt_state = optimizer.update(grads, opt_state, train)

@@ -59,6 +59,43 @@ def collect() -> dict:
 
         info["event_log"] = validate_event_log_path(ev)
 
+    # event-log rotation limit: the tracer degrades to unbounded on a
+    # bad value, so report it here where an operator will see it
+    evmax = os.environ.get("BIGDL_TPU_EVENT_LOG_MAX_BYTES")
+    if evmax:
+        from bigdl_tpu.observability.tracing import \
+            resolve_event_log_max_bytes
+
+        try:
+            info["event_log_max_bytes"] = {
+                "value": resolve_event_log_max_bytes(evmax), "valid": True}
+        except ValueError as e:
+            info["event_log_max_bytes"] = {
+                "value": evmax, "valid": False, "error": str(e)}
+
+    # postmortem dump directory: write_postmortem swallows failures by
+    # contract, so an unwritable dir would otherwise only show up as a
+    # missing dump after a crash
+    pm = os.environ.get("BIGDL_TPU_POSTMORTEM_DIR")
+    if pm:
+        from bigdl_tpu.observability.flight import validate_postmortem_dir
+
+        info["postmortem_dir"] = validate_postmortem_dir(pm)
+
+    # recompile-storm warning threshold (compile_watch falls back to the
+    # default on a bad value; surface it here instead)
+    rw = os.environ.get("BIGDL_TPU_RECOMPILE_WARN")
+    if rw:
+        from bigdl_tpu.observability.compile_watch import \
+            resolve_recompile_threshold
+
+        try:
+            info["recompile_warn"] = {
+                "value": resolve_recompile_threshold(rw), "valid": True}
+        except ValueError as e:
+            info["recompile_warn"] = {
+                "value": rw, "valid": False, "error": str(e)}
+
     # KV cache storage dtype: fail loudly here rather than at the first
     # model load (a typo'd dtype name otherwise surfaces deep in
     # init_cache)
@@ -88,7 +125,10 @@ def main() -> int:
         else:
             print(f"{k:<{width}} : {v}")
     ok = ("jax_error" not in info and "bigdl_tpu_error" not in info
-          and info.get("kv_cache_dtype", {}).get("valid", True))
+          and info.get("kv_cache_dtype", {}).get("valid", True)
+          and info.get("event_log_max_bytes", {}).get("valid", True)
+          and info.get("recompile_warn", {}).get("valid", True)
+          and info.get("postmortem_dir", {}).get("writable", True))
     print("status :", "OK" if ok else "PROBLEMS FOUND")
     return 0 if ok else 1
 

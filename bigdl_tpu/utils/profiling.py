@@ -147,7 +147,26 @@ class StepTimer:
                 "mean_ms": sum(ts) / len(ts) * 1e3,
                 "min_ms": s[0] * 1e3,
                 "max_ms": s[-1] * 1e3,
-                "p50_ms": s[len(s) // 2] * 1e3,
+                "p50_ms": _percentile(s, 0.50) * 1e3,
+                "p90_ms": _percentile(s, 0.90) * 1e3,
+                "p99_ms": _percentile(s, 0.99) * 1e3,
                 "total_s": sum(ts),
             }
         return out
+
+
+def _percentile(sorted_samples, q: float) -> float:
+    """Linear-interpolation percentile over pre-sorted samples (numpy's
+    default method, without numpy). The old `s[len(s) // 2]` median
+    picked the UPPER of the two middle samples on even-length inputs,
+    biasing p50 high; interpolation returns their midpoint."""
+    s = sorted_samples
+    if not s:
+        return float("nan")
+    if len(s) == 1:
+        return s[0]
+    pos = q * (len(s) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(s) - 1)
+    frac = pos - lo
+    return s[lo] * (1.0 - frac) + s[hi] * frac

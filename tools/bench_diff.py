@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Compare two BENCH_*.json files and flag regressions.
+
+Usage:
+    python tools/bench_diff.py OLD.json NEW.json [--threshold PCT]
+
+Both the raw bench-record form (the dict bench.py / bigdl_tpu.bench
+emit) and the driver wrapper form ({"n", "cmd", "rc", "tail",
+"parsed"}) are accepted — the wrapper's "parsed" block is compared when
+present. Nested sub-records (ab variants, cpu_fallback_smoke, ...) are
+walked too, so per-config latencies get their own rows.
+
+A metric regresses when it moves in its bad direction by more than
+--threshold percent (default 5): latencies and byte footprints UP,
+throughput DOWN. Exit status: 0 no regressions, 1 regressions found,
+2 usage / unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional, Tuple
+
+# comparable scalar fields -> direction ("lower" / "higher" is better)
+METRIC_DIRECTIONS = {
+    "first_token_ms": "lower",
+    "first_token_ms_raw": "lower",
+    "next_token_ms": "lower",
+    "rest_token_ms": "lower",
+    "ttft_p50_ms": "lower",
+    "tpot_p50_ms": "lower",
+    "decode_ideal_ms": "lower",
+    "kv_cache_bytes": "lower",
+    "weight_bytes": "lower",
+    "serving_tokens_per_s": "higher",
+    "tokens_per_s": "higher",
+    "decode_mfu": "higher",
+    "prefill_mfu": "higher",
+    "decode_hbm_roofline_util": "higher",
+}
+
+
+def load_record(path: str) -> dict:
+    """Read a BENCH json; unwrap the driver's {"parsed": ...} wrapper
+    when that is what we got."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object, got "
+                         f"{type(doc).__name__}")
+    if set(doc) >= {"cmd", "rc", "parsed"}:
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            raise ValueError(
+                f"{path}: wrapper has no parsed bench record "
+                f"(parsed={parsed!r}) — nothing to compare")
+        return parsed
+    return doc
+
+
+def flatten_metrics(rec: dict, prefix: str = "",
+                    out: Optional[Dict[str, Tuple[float, str]]] = None,
+                    depth: int = 0) -> Dict[str, Tuple[float, str]]:
+    """{dotted.name: (value, direction)} for every comparable scalar,
+    recursing into sub-record dicts (ab variants etc.)."""
+    if out is None:
+        out = {}
+    for key, val in rec.items():
+        name = f"{prefix}{key}"
+        if key in METRIC_DIRECTIONS and isinstance(val, (int, float)) \
+                and not isinstance(val, bool):
+            out[name] = (float(val), METRIC_DIRECTIONS[key])
+        elif key == "value" and isinstance(val, (int, float)) \
+                and not isinstance(val, bool) and rec.get("unit") == "ms":
+            # the headline {"metric": ..., "value": ..., "unit": "ms"}
+            # row: a latency, keyed by its metric name
+            label = rec.get("metric", "value")
+            out[f"{prefix}{label}"] = (float(val), "lower")
+        elif isinstance(val, dict) and depth < 3 \
+                and key not in ("observability", "jit_compile_table"):
+            flatten_metrics(val, f"{name}.", out, depth + 1)
+    return out
+
+
+def diff(old: Dict[str, Tuple[float, str]],
+         new: Dict[str, Tuple[float, str]],
+         threshold_pct: float):
+    """Returns (rows, regressions): rows are (name, old, new, pct,
+    direction, regressed) for every metric present in both files."""
+    rows = []
+    regressions = []
+    for name in sorted(set(old) & set(new)):
+        o, direction = old[name]
+        n, _ = new[name]
+        if o == 0:
+            pct = 0.0 if n == 0 else float("inf") * (1 if n > 0 else -1)
+        else:
+            pct = (n - o) / abs(o) * 100.0
+        bad = pct > threshold_pct if direction == "lower" \
+            else pct < -threshold_pct
+        rows.append((name, o, n, pct, direction, bad))
+        if bad:
+            regressions.append(name)
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline BENCH json")
+    ap.add_argument("new", help="candidate BENCH json")
+    ap.add_argument("--threshold", type=float, default=5.0,
+                    help="regression threshold in percent (default 5)")
+    args = ap.parse_args(argv)
+
+    try:
+        old = flatten_metrics(load_record(args.old))
+        new = flatten_metrics(load_record(args.new))
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+
+    rows, regressions = diff(old, new, args.threshold)
+    if not rows:
+        print("bench_diff: no comparable metrics between "
+              f"{args.old} and {args.new}", file=sys.stderr)
+        return 0
+
+    width = max(len(r[0]) for r in rows)
+    print(f"{'metric':<{width}}  {'old':>14}  {'new':>14}  {'delta':>9}")
+    for name, o, n, pct, direction, bad in rows:
+        arrow = "" if not bad else \
+            "  REGRESSION" + (" (want lower)" if direction == "lower"
+                              else " (want higher)")
+        print(f"{name:<{width}}  {o:>14.4f}  {n:>14.4f}  {pct:>+8.2f}%"
+              f"{arrow}")
+    missing = sorted(set(old) ^ set(new))
+    if missing:
+        print(f"(not in both files, skipped: {', '.join(missing)})")
+    if regressions:
+        print(f"{len(regressions)} regression(s) past "
+              f"{args.threshold:g}%: {', '.join(regressions)}")
+        return 1
+    print(f"no regressions past {args.threshold:g}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
