@@ -277,9 +277,23 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     obs_summary = obs.summary()
     obs_summary.update(default_registry().summary())
     from bigdl_tpu.observability.compile_watch import compile_table
+    from bigdl_tpu.observability.memory import default_ledger, memory_report
+
+    kv_bytes = kv_cache_bytes(jax.eval_shape(
+        lambda: llama_mod.new_cache(cfg, 1, max_seq,
+                                    quantized=kv_dtype)))
+    ledger = default_ledger()
+    ledger.register("weights", "bench_model", int(weight_bytes),
+                    qtype=qtype)
+    ledger.register("kv_cache", "bench_cache", kv_bytes["total"],
+                    dtype=kv_dtype)
 
     return {
         "observability": obs_summary,
+        # static ledger totals + live device stats (TPU runs) + peak
+        # jit scratch — tools/bench_diff.py compares the headline
+        # scalars under --max-hbm-regress-pct
+        "memory": memory_report(ledger),
         # per-executable compile counts/times for this process — a bench
         # row whose compile table grew between runs recompiled something
         "jit_compile_table": compile_table(),
@@ -298,9 +312,7 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
         "kv_quantized": kv_dtype != "bf16",
         # logical cache footprint (eval_shape: no second allocation);
         # int4 counted at two codes per byte
-        "kv_cache_bytes": kv_cache_bytes(jax.eval_shape(
-            lambda: llama_mod.new_cache(cfg, 1, max_seq,
-                                        quantized=kv_dtype))),
+        "kv_cache_bytes": kv_bytes,
     }
 
 

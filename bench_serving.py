@@ -154,6 +154,20 @@ def main() -> None:
         "model": "llama2-7b" if on_tpu else "tiny-llama(cpu-fallback)",
         "qtype": "sym_int4",
     }
+    # memory report for bench_diff: wave engines keep private ledgers,
+    # so register the measured config's totals in the process ledger
+    from bigdl_tpu.observability.memory import default_ledger, memory_report
+    from bigdl_tpu.ops.kvcache import kv_cache_nbytes
+
+    ledger = default_ledger()
+    ledger.register("weights", "bench_serving_model", int(weight_bytes),
+                    qtype="sym_int4")
+    ledger.register(
+        "kv_cache", "bench_serving_batched",
+        kv_cache_nbytes(cfg.num_hidden_layers, batch, max_seq,
+                        cfg.num_key_value_heads, cfg.hd, "bf16")["total"],
+        dtype="bf16", slots=batch)
+    out["memory"] = memory_report(ledger)
     if kv_sweep:
         # --kv-cache-dtype rows: aggregate throughput + per-stream TPOT
         # + exact cache footprint (eval_shape, no allocation) per dtype

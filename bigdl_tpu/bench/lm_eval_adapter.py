@@ -27,7 +27,9 @@ def _bucket(n: int, lo: int = 32) -> int:
 def _jitted(fwd):
     """One persistent jit wrapper per family forward — a fresh jax.jit per
     call would retrace/recompile every request."""
-    return jax.jit(fwd, static_argnums=1)
+    from bigdl_tpu.observability.compile_watch import tracked_jit
+
+    return tracked_jit("lm_eval_forward", fwd, static_argnums=1)
 
 
 def context_logprobs(model: Any, context_ids) -> np.ndarray:

@@ -36,7 +36,9 @@ def perplexity(
     if ids.size < window + 1:
         raise ValueError(f"need > {window + 1} tokens, got {ids.size}")
 
-    logp = jax.jit(lambda p, t: jax.nn.log_softmax(
+    from bigdl_tpu.observability.compile_watch import tracked_jit
+
+    logp = tracked_jit("perplexity_logp", lambda p, t: jax.nn.log_softmax(
         fwd(p, cfg, t).astype(jnp.float32), axis=-1), static_argnums=())
 
     total_nll, total_cnt = 0.0, 0

@@ -453,6 +453,23 @@ class Generator:
             b *= 2
         return min(b, self.max_seq)
 
+    def _register_memory(self, cache, batch: int) -> None:
+        """Record this generation's weights + cache footprint in the
+        process memory ledger (observability/memory.py) — postmortems
+        and bench memory reports read it. Best-effort."""
+        try:
+            from bigdl_tpu.observability.memory import (default_ledger,
+                                                        tree_nbytes)
+
+            led = default_ledger()
+            led.register("weights", "generator_params",
+                         tree_nbytes(self.params))
+            led.register("kv_cache", "generator_cache",
+                         tree_nbytes(cache),
+                         dtype=self.kv_cache_dtype, batch=batch)
+        except Exception:
+            pass
+
     def generate(
         self,
         input_ids,                       # [B, S] or [S] ints
@@ -488,6 +505,7 @@ class Generator:
                                self.kv_cache_dtype)
         recurrent = (not isinstance(cache, KVCache)
                      if self.recurrent is None else self.recurrent)
+        self._register_memory(cache, b)
         if recurrent:
             # recurrent families (RWKV): the state absorbs every token it
             # sees, so pad tokens cannot be masked retroactively — prefill

@@ -44,10 +44,11 @@ class TpuLLMCore:
         self._embed_fwd = None
         if fwd is not None and "return_hidden" in \
                 inspect.signature(fwd).parameters:
-            import jax
+            from bigdl_tpu.observability.compile_watch import tracked_jit
 
             cfg = self.model.config
-            self._embed_fwd = jax.jit(
+            self._embed_fwd = tracked_jit(
+                "langchain_embed_forward",
                 lambda p, t: fwd(p, cfg, t, return_hidden=True))
 
     def complete(self, prompt: str, max_new_tokens: int = 256,

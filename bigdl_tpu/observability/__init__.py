@@ -1,6 +1,7 @@
-"""Dependency-free metrics, tracing, compile telemetry and postmortems.
+"""Dependency-free metrics, tracing, compile telemetry, memory
+accounting and postmortems.
 
-Four pieces, all stdlib-only at import time (jax is allowed elsewhere
+Five pieces, all stdlib-only at import time (jax is allowed elsewhere
 in the package but this subpackage must import with nothing beyond the
 standard library — tests/test_observability.py enforces it):
 
@@ -19,6 +20,17 @@ standard library — tests/test_observability.py enforces it):
   executable) feeding the jit metrics below, a process-wide
   ``compile_table()``, and a recompile-storm warning past
   ``$BIGDL_TPU_RECOMPILE_WARN`` compiles per name.
+- ``memory``: ``MemoryLedger`` — exact static HBM accounting
+  (packed weight / KV-cache / adapter bytes registered at build and
+  allocation time) plus live ``device.memory_stats()`` telemetry
+  (``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``, a no-op
+  ``{}`` on CPU/interpret), a ``headroom()`` budget view driven by
+  ``$BIGDL_TPU_HBM_BUDGET_FRACTION``, and ``would_fit(nbytes)`` — the
+  predicate behind the serving engine's headroom-aware admission
+  (deferral shows up as ``bigdl_tpu_admission_deferred_total``, an
+  ``admit_deferred`` flight event, and ``GET /v1/memory``).
+  ``memory_report()`` rolls the snapshot plus the compile table's peak
+  temp bytes into the bench JSON records.
 - ``flight``: ``FlightRecorder`` ring buffer of per-step engine events
   plus postmortem dumps — on engine-step exception, stall-guard trip,
   or SIGTERM/SIGINT a single JSON (flight tail, span tail, metrics
@@ -50,6 +62,9 @@ bigdl_tpu_kv_cache_bytes{dtype,component}   ops/kvcache.publish_kv_cache_bytes
 bigdl_tpu_kv_dequant_path_total{dtype,path} ops/attention._note_dequant_path
 bigdl_tpu_jit_compiles_total{fn}            compile_watch.TrackedJit
 bigdl_tpu_jit_compile_seconds{fn}           compile_watch.TrackedJit
+bigdl_tpu_hbm_bytes{kind}                   memory.MemoryLedger.publish
+bigdl_tpu_hbm_headroom_bytes                memory.MemoryLedger.publish
+bigdl_tpu_admission_deferred_total{reason}  LLMEngine._admission_step
 ==========================================  ===============================
 
 ``bigdl_tpu_kv_cache_bytes`` reports the batched KV cache's logical
@@ -64,13 +79,29 @@ tracked executable name (one per new abstract shape signature — e.g.
 one per (prefill bucket, kv dtype) pair for ``engine_prefill``);
 ``bigdl_tpu_jit_compile_seconds{fn}`` holds the first-call wall time
 of each. A steadily incrementing compile counter in steady state IS the
-recompile-storm signature these exist to catch.
+recompile-storm signature these exist to catch. Each first compile
+also captures ``compiled.memory_analysis()`` (temp/argument/output
+bytes) via an AOT lower+compile of the same signature; set
+``BIGDL_TPU_COMPILE_MEMORY=0`` to skip that extra compile.
+
+``bigdl_tpu_hbm_bytes{kind}`` carries both the ledger's static sums
+per kind ("weights", "kv_cache", ...) and the device telemetry rows
+("device_in_use", "device_peak", "device_limit" — absent without a
+real accelerator). ``bigdl_tpu_hbm_headroom_bytes`` is
+``budget_fraction * bytes_limit - bytes_in_use``; when an admission's
+KV-cache cost exceeds it the request stays queued and
+``bigdl_tpu_admission_deferred_total{reason="memory"}`` increments.
 
 Environment knobs: ``BIGDL_TPU_EVENT_LOG`` (span JSONL sink) +
 ``BIGDL_TPU_EVENT_LOG_MAX_BYTES`` (rotate to ``.1`` past this size),
 ``BIGDL_TPU_POSTMORTEM_DIR`` (where crash/stall/signal dumps land),
 ``BIGDL_TPU_RECOMPILE_WARN`` (compiles-per-name warning threshold,
-default 8). All are validated by ``python -m bigdl_tpu.utils.env_check``.
+default 8), ``BIGDL_TPU_HBM_BUDGET_FRACTION`` (admission budget as a
+fraction of ``bytes_limit``, float in (0, 1], default 0.9),
+``BIGDL_TPU_MEMORY_POLL_SEC`` (min seconds between live
+``memory_stats()`` reads, default 1.0), ``BIGDL_TPU_COMPILE_MEMORY``
+(set 0 to skip per-compile memory analysis). All are validated by
+``python -m bigdl_tpu.utils.env_check``.
 """
 
 from bigdl_tpu.observability.compile_watch import (
@@ -87,6 +118,16 @@ from bigdl_tpu.observability.flight import (
     install_signal_dumps,
     validate_postmortem_dir,
     write_postmortem,
+)
+from bigdl_tpu.observability.memory import (
+    MemoryLedger,
+    default_ledger,
+    device_memory_stats,
+    memory_report,
+    reset_default_ledger,
+    resolve_hbm_budget_fraction,
+    resolve_memory_poll_sec,
+    tree_nbytes,
 )
 from bigdl_tpu.observability.metrics import (
     LATENCY_BUCKETS_S,
@@ -117,6 +158,14 @@ __all__ = [
     "compile_table",
     "reset_compile_table",
     "resolve_recompile_threshold",
+    "MemoryLedger",
+    "default_ledger",
+    "device_memory_stats",
+    "memory_report",
+    "reset_default_ledger",
+    "resolve_hbm_budget_fraction",
+    "resolve_memory_poll_sec",
+    "tree_nbytes",
     "FlightRecorder",
     "build_postmortem",
     "env_fingerprint",

@@ -19,7 +19,17 @@ accounting layer:
   in postmortem dumps (observability/flight.py) and BENCH json;
 - crossing the recompile-storm threshold (``warn_threshold=`` or
   ``$BIGDL_TPU_RECOMPILE_WARN``, default 8 compiles per name) logs one
-  warning and flags the table entry.
+  warning and flags the table entry;
+- each compile also captures the executable's
+  ``compiled.memory_analysis()`` (temp / argument / output /
+  generated-code bytes) next to its seconds, and the table keeps the
+  per-name ``peak_temp_bytes`` — the scratch HBM a jitted fn needs on
+  top of its operands. Capture goes through jax's AOT path
+  (``lower(...).compile()`` on abstract placeholder shapes), whose
+  executable cache is SEPARATE from the traced-call cache: the first
+  capture per signature pays one extra XLA compile. Set
+  ``$BIGDL_TPU_COMPILE_MEMORY=0`` to skip capture when compile wall
+  time matters more than memory attribution.
 
 Detection is signature-based rather than hooking XLA: it is exact for
 the wrappers' own cache (jax.jit keys its trace cache on the same
@@ -44,6 +54,14 @@ DEFAULT_RECOMPILE_WARN = 8
 # signatures kept per name in the compile table (newest last); the
 # counters keep counting past this bound
 MAX_SIGNATURES_PER_NAME = 32
+COMPILE_MEMORY_ENV = "BIGDL_TPU_COMPILE_MEMORY"
+
+
+def memory_capture_enabled() -> bool:
+    """Whether per-compile memory_analysis capture is on (default yes;
+    ``$BIGDL_TPU_COMPILE_MEMORY`` in {0, false, off, no} disables)."""
+    return os.environ.get(COMPILE_MEMORY_ENV, "1").strip().lower() \
+        not in ("0", "false", "off", "no")
 
 _lock = threading.Lock()
 _table: Dict[str, Dict[str, Any]] = {}
@@ -182,16 +200,76 @@ class TrackedJit:
             return self._jitted(*args, **kwargs)
         if hit:
             return self._jitted(*args, **kwargs)
+        # placeholders must be built BEFORE the call: donate_argnums
+        # deletes input buffers during it
+        placeholders = self._placeholders(args, kwargs)
         t0 = time.perf_counter()
         out = self._jitted(*args, **kwargs)
         dt = time.perf_counter() - t0
         with self._seen_lock:
             self._seen.add(sig)
-        self._record_compile(sig, dt)
+        self._record_compile(sig, dt, self._memory_analysis(placeholders))
         return out
 
     def __getattr__(self, item):
         return getattr(self._jitted, item)
+
+    # -- memory analysis -----------------------------------------------------
+
+    def _placeholders(self, args, kwargs):
+        """(args, kwargs) with every dynamic array leaf replaced by a
+        ShapeDtypeStruct — abstract inputs for the AOT lowering, safe
+        against donated buffers. Statics keep their real values (jax
+        keys compiles on them); non-array dynamic leaves (python
+        scalars) pass through, matching how the traced call saw them.
+        None when capture is disabled."""
+        if not memory_capture_enabled():
+            return None
+        try:
+            import jax
+
+            def abstract(x):
+                shape = getattr(x, "shape", None)
+                dtype = getattr(x, "dtype", None)
+                if shape is not None and dtype is not None:
+                    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+                return x
+
+            ph_args = tuple(
+                a if i in self._static_argnums
+                else jax.tree_util.tree_map(abstract, a)
+                for i, a in enumerate(args))
+            ph_kwargs = {
+                k: v if k in self._static_argnames
+                else jax.tree_util.tree_map(abstract, v)
+                for k, v in kwargs.items()}
+            return (ph_args, ph_kwargs)
+        except Exception:
+            return None
+
+    def _memory_analysis(self, placeholders) -> Optional[Dict[str, int]]:
+        """Best-effort CompiledMemoryStats for one signature via the
+        AOT path (its executable cache is separate from the traced
+        call's, so the first capture per signature pays one extra XLA
+        compile — see module docstring). Never raises."""
+        if placeholders is None:
+            return None
+        try:
+            ph_args, ph_kwargs = placeholders
+            stats = self._jitted.lower(
+                *ph_args, **ph_kwargs).compile().memory_analysis()
+            if stats is None:
+                return None
+            return {
+                "temp_bytes": int(stats.temp_size_in_bytes),
+                "argument_bytes": int(stats.argument_size_in_bytes),
+                "output_bytes": int(stats.output_size_in_bytes),
+                "alias_bytes": int(stats.alias_size_in_bytes),
+                "generated_code_bytes": int(
+                    stats.generated_code_size_in_bytes),
+            }
+        except Exception:
+            return None
 
     # -- accounting ----------------------------------------------------------
 
@@ -200,7 +278,8 @@ class TrackedJit:
         with self._seen_lock:
             return len(self._seen)
 
-    def _record_compile(self, sig: Tuple, seconds: float) -> None:
+    def _record_compile(self, sig: Tuple, seconds: float,
+                        memory: Optional[Dict[str, int]] = None) -> None:
         try:
             self._observe_metrics(seconds)
         except Exception:
@@ -209,13 +288,20 @@ class TrackedJit:
         with _lock:
             ent = _table.setdefault(self.name, {
                 "compiles": 0, "total_s": 0.0, "signatures": [],
-                "last_compile_ts": 0.0, "storm": False})
+                "last_compile_ts": 0.0, "storm": False,
+                "peak_temp_bytes": 0})
+            ent.setdefault("peak_temp_bytes", 0)
             ent["compiles"] += 1
             ent["total_s"] += seconds
             ent["last_compile_ts"] = time.time()
             sigs = ent["signatures"]
-            sigs.append({"signature": _sig_str(sig),
-                         "seconds": round(seconds, 6)})
+            row = {"signature": _sig_str(sig),
+                   "seconds": round(seconds, 6)}
+            if memory is not None:
+                row["memory"] = dict(memory)
+                ent["peak_temp_bytes"] = max(
+                    ent["peak_temp_bytes"], memory.get("temp_bytes", 0))
+            sigs.append(row)
             del sigs[:-MAX_SIGNATURES_PER_NAME]
             if ent["compiles"] >= self._warn_threshold \
                     and not ent["storm"]:
@@ -265,8 +351,10 @@ def tracked_jit(name: str, fn=None, *, registry=None,
 
 def compile_table() -> Dict[str, Dict[str, Any]]:
     """JSON-ready snapshot of the process-wide compile table:
-    {name: {compiles, total_s, signatures[...], last_compile_ts,
-    storm}}."""
+    {name: {compiles, total_s, peak_temp_bytes, signatures[...],
+    last_compile_ts, storm}}. Signature rows carry a "memory" dict
+    (temp/argument/output/alias/generated-code bytes) when capture was
+    on and the AOT analysis succeeded."""
     with _lock:
         out: Dict[str, Dict[str, Any]] = {}
         for name, ent in sorted(_table.items()):
@@ -275,7 +363,10 @@ def compile_table() -> Dict[str, Dict[str, Any]]:
                 "total_s": round(ent["total_s"], 6),
                 "last_compile_ts": round(ent["last_compile_ts"], 6),
                 "storm": ent["storm"],
-                "signatures": [dict(s) for s in ent["signatures"]],
+                "peak_temp_bytes": ent.get("peak_temp_bytes", 0),
+                "signatures": [
+                    {k: (dict(v) if isinstance(v, dict) else v)
+                     for k, v in s.items()} for s in ent["signatures"]],
             }
         return out
 

@@ -120,11 +120,15 @@ def env_fingerprint() -> dict:
 def build_postmortem(reason: str, *, flight: Optional[FlightRecorder] = None,
                      tracer=None, registry=None,
                      config: Optional[dict] = None,
+                     memory: Optional[dict] = None,
                      error: Optional[BaseException] = None,
                      events_tail: int = 256,
                      spans_tail: int = 32) -> dict:
     """Assemble the postmortem dict. Every section degrades to a
-    partial record rather than failing the dump."""
+    partial record rather than failing the dump. ``memory`` is a
+    ready-made snapshot (the engine passes its ledger view); when
+    omitted, the process-default MemoryLedger's snapshot is used so
+    even bare dumps answer "where was HBM when it died"."""
     out: dict = {"reason": reason, "ts": round(time.time(), 6)}
     if error is not None:
         out["error"] = {
@@ -161,6 +165,15 @@ def build_postmortem(reason: str, *, flight: Optional[FlightRecorder] = None,
         out["compile_table"] = compile_table()
     except Exception as e:
         out["compile_table"] = {"error": repr(e)}
+    if memory is not None:
+        out["memory"] = memory
+    else:
+        try:
+            from bigdl_tpu.observability.memory import default_ledger
+
+            out["memory"] = default_ledger().snapshot()
+        except Exception as e:
+            out["memory"] = {"error": repr(e)}
     return out
 
 

@@ -314,6 +314,28 @@ def _logical_nbytes(a: jax.Array) -> int:
     return a.size * jnp.dtype(a.dtype).itemsize
 
 
+def kv_cache_nbytes(num_layers: int, batch: int, max_seq: int,
+                    kv_heads: int, head_dim: int,
+                    kv_cache_dtype: Optional[str] = None) -> Dict[str, int]:
+    """Storage footprint of a WOULD-BE cache, computed from its
+    geometry without allocating anything — byte-for-byte identical to
+    ``kv_cache_bytes(init_cache(...))`` (the memory ledger and the
+    engine's admission-cost estimate depend on that exactness; tests
+    assert it). Same components: codes planes, scale planes, total."""
+    name = resolve_kv_cache_dtype(kv_cache_dtype)
+    dt = jnp.dtype(KV_CACHE_DTYPES[name])
+    n = num_layers * batch * max_seq * kv_heads * head_dim
+    if name == "int4":
+        codes = 2 * (-(-n // 2))       # k + v, two codes per byte each
+    else:
+        codes = 2 * n * dt.itemsize
+    scales = 0
+    if name in SCALED_KV_DTYPES:
+        scales = 2 * num_layers * batch * max_seq * kv_heads \
+            * jnp.dtype(jnp.float32).itemsize
+    return {"codes": codes, "scales": scales, "total": codes + scales}
+
+
 def kv_cache_bytes(cache: KVCache) -> Dict[str, int]:
     """Storage footprint of a cache: codes planes, scale planes, total."""
     codes = _logical_nbytes(cache.k) + _logical_nbytes(cache.v)

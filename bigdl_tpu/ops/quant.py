@@ -1175,13 +1175,15 @@ def _mxu_unpack_jit(rank: int, b2: int, device):
         out = jax.lax.map(step, blk)        # [S, 2*b2, n] int4
         return out.reshape(*lead, k2 * 2, n)
 
+    from bigdl_tpu.observability.compile_watch import tracked_jit
+
     try:
         from jax.experimental.layout import Format, Layout
         from jax.sharding import SingleDeviceSharding
 
         fmt = Format(Layout(tuple(range(rank))),
                      SingleDeviceSharding(device))
-        return jax.jit(impl, out_shardings=fmt)
+        return tracked_jit("int4_mxu_relayout", impl, out_shardings=fmt)
     except (ImportError, TypeError, ValueError) as e:
         import logging
 
@@ -1189,7 +1191,7 @@ def _mxu_unpack_jit(rank: int, b2: int, device):
             "int4 relayout jit: could not pin the row-major output "
             "layout (%s: %s) — compiler-chosen layouts risk an implicit "
             "relayout at downstream dispatch", type(e).__name__, e)
-        return jax.jit(impl)
+        return tracked_jit("int4_mxu_relayout", impl)
 
 
 @functools.lru_cache(maxsize=None)

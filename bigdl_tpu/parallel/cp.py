@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.models import llama as M
+from bigdl_tpu.observability.compile_watch import tracked_jit
 from bigdl_tpu.ops.matmul import linear
 
 _WARNED_CP_SCALED = False    # one warning per process for int8/int4 CP
@@ -137,7 +138,7 @@ def _prefill_fn(cfg, mesh, axis, s, max_seq, compute_dtype):
 
     spec_tok = P(None, axis)
     spec_cache = P(None, None, axis)
-    return jax.jit(_shard_map(
+    return tracked_jit("cp_prefill", _shard_map(
         local, mesh=mesh, in_specs=(P(), spec_tok),
         out_specs=(P(), spec_cache, spec_cache), **_REP_KW))
 
@@ -239,7 +240,7 @@ def _decode_fn(cfg, mesh, axis, compute_dtype):
         return lg, ck2, cv2
 
     spec_cache = P(None, None, axis)
-    return jax.jit(_shard_map(
+    return tracked_jit("cp_decode_step", _shard_map(
         local, mesh=mesh,
         in_specs=(P(), P(), spec_cache, spec_cache, P()),
         out_specs=(P(), spec_cache, spec_cache), **_REP_KW),
@@ -368,7 +369,7 @@ def _extend_fn(cfg, mesh, axis, c, compute_dtype):
         return lg, ck2, cv2
 
     spec_cache = P(None, None, axis)
-    return jax.jit(_shard_map(
+    return tracked_jit("cp_prefill_chunk", _shard_map(
         local, mesh=mesh,
         in_specs=(P(), P(), spec_cache, spec_cache, P(), P()),
         out_specs=(P(), spec_cache, spec_cache), **_REP_KW),

@@ -39,6 +39,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.models import llama as M
+from bigdl_tpu.observability.compile_watch import tracked_jit
 from bigdl_tpu.ops.kvcache import KVCache
 from bigdl_tpu.ops.matmul import linear
 from bigdl_tpu.parallel.sharding import llama_param_specs
@@ -409,7 +410,7 @@ def _tp_fn(cfg, mesh, axis):
         lg, ck, cv = f(params, tokens, cache.k, cache.v, cache.pos)
         return lg, KVCache(ck, cv, cache.pos + tokens.shape[1])
 
-    return jax.jit(run, donate_argnums=(2,))
+    return tracked_jit("tp_forward_step", run, donate_argnums=(2,))
 
 
 def tp_forward_step(

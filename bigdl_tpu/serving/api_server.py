@@ -15,6 +15,9 @@ Observability endpoints (bigdl_tpu/observability/):
 - GET /metrics — Prometheus text exposition of the engine's registry
 - GET /v1/stats — JSON engine snapshot (slots, queues, metric
   summaries, recent request spans, jit compile table)
+- GET /v1/memory — HBM memory snapshot (ledger static report, live
+  device memory_stats when the backend has them, budget/headroom math
+  and the engine's admission-deferral accounting)
 - GET /v1/debug/dump — on-demand postmortem JSON (flight-recorder
   tail, span tail, metrics snapshot, compile table, config + env
   fingerprint); the same document the engine writes to
@@ -364,6 +367,11 @@ class OpenAIServer:
                     self.wfile.write(body)
                 elif self.path == "/v1/stats":
                     self._json(200, server.engine.stats_snapshot())
+                elif self.path == "/v1/memory":
+                    # ledger static report + live device stats +
+                    # headroom math (observability/memory.py)
+                    self._json(200, _jsonable(
+                        server.engine.memory_snapshot()))
                 elif self.path == "/v1/debug/dump":
                     # same document the engine writes to
                     # $BIGDL_TPU_POSTMORTEM_DIR, served live

@@ -44,9 +44,11 @@ from bigdl_tpu.observability.compile_watch import tracked_jit
 from bigdl_tpu.observability.flight import FlightRecorder, build_postmortem
 from bigdl_tpu.observability.flight import write_postmortem as \
     _write_postmortem_file
+from bigdl_tpu.observability.memory import MemoryLedger, tree_nbytes
 from bigdl_tpu.observability.metrics import RATIO_BUCKETS, default_registry
 from bigdl_tpu.observability.tracing import RequestTracer
-from bigdl_tpu.ops.kvcache import (KVCache, init_cache,
+from bigdl_tpu.ops.kvcache import (KVCache, init_cache, kv_cache_bytes,
+                                   kv_cache_nbytes,
                                    publish_kv_cache_bytes,
                                    resolve_kv_cache_dtype)
 
@@ -157,6 +159,12 @@ class EngineConfig:
     # only the first N prompt tokens are snapshotted — bounds the D2H
     # transfer and host memory per entry (system prompts live here)
     prefix_cache_max_tokens: int = 1024
+    # headroom-aware admission: an admission whose private prefill
+    # cache would push bytes_in_use past this fraction of the device's
+    # bytes_limit is deferred (FCFS order kept) until headroom returns.
+    # None defers to $BIGDL_TPU_HBM_BUDGET_FRACTION (default 0.9).
+    # Backends without memory_stats() (CPU/interpret) always admit.
+    hbm_budget_fraction: Optional[float] = None
 
 
 class _Slot:
@@ -241,7 +249,9 @@ class LLMEngine:
 
     def __init__(self, model: Any, config: Optional[EngineConfig] = None,
                  cp_mesh: Any = None, registry=None, tracer=None,
-                 flight: Optional[FlightRecorder] = None):
+                 flight: Optional[FlightRecorder] = None,
+                 ledger: Optional[MemoryLedger] = None,
+                 memory_stats_provider: Optional[Callable[[], dict]] = None):
         self.cfg_engine = config or EngineConfig()
         self.params = model.params
         self.cfg = model.config
@@ -304,6 +314,15 @@ class LLMEngine:
         # flight recorder: bounded ring of structured step/scheduling
         # events; its tail is the core of every postmortem dump
         self.flight = flight if flight is not None else FlightRecorder()
+        # HBM ledger: static bytes for params + batched KV registered
+        # below, live device telemetry for headroom-aware admission. A
+        # passed-in ledger keeps its own budget fraction; tests inject
+        # memory_stats_provider for deterministic deferral.
+        self.ledger = ledger if ledger is not None else MemoryLedger(
+            stats_provider=memory_stats_provider,
+            budget_fraction=ce.hbm_budget_fraction)
+        self._deferred_admissions = 0   # lifetime deferral count
+        self._deferred_streak = False   # one flight event per streak
 
         # context-parallel overflow lane (long prompts)
         self._cp_mesh = cp_mesh
@@ -494,12 +513,31 @@ class LLMEngine:
                     "Speculative decoding acceptance ratio per "
                     "verify round.", labelnames=("mode",),
                     buckets=RATIO_BUCKETS)
+        self._m_deferred = m.counter(
+            "bigdl_tpu_admission_deferred_total",
+            "Admissions deferred by the headroom guard, by reason.",
+            labelnames=("reason",))
+        self._m_deferred.labels("memory")   # render from scrape 1
         # batched-cache storage footprint per component (codes vs scales);
         # shapes are static for the engine lifetime, so set once
         publish_kv_cache_bytes(self.cache, m)
+        # static ledger entries: params (packed, QTensor/int4-aware) and
+        # the batched KV cache; per-slot bytes drive the admission cost
+        kvb = kv_cache_bytes(self.cache)
+        self.ledger.register(
+            "weights", "engine_params", tree_nbytes(self.params),
+            family=getattr(self.family, "name",
+                           type(self.family).__name__))
+        self.ledger.register(
+            "kv_cache", "engine_batched", kvb["total"],
+            dtype=self.kv_cache_dtype, codes=kvb["codes"],
+            scales=kvb["scales"], slots=B)
+        self._kv_bytes_per_slot = kvb["total"] // B
+        self.ledger.publish(m)
         self.flight.record(
             "engine_init", max_batch=B, max_seq=ce.max_seq,
             kv_cache_dtype=self.kv_cache_dtype,
+            kv_cache_total_bytes=kvb["total"],
             prefill_chunk=self._chunk, family=getattr(
                 self.family, "name", type(self.family).__name__))
 
@@ -596,6 +634,18 @@ class LLMEngine:
             b *= 2
         return min(b, self.cfg_engine.max_seq)
 
+    def _admission_cost(self, prompt_len: int) -> int:
+        """HBM bytes the admission of a prompt of this length newly
+        allocates: its private 1-row prefill cache, sized exactly as
+        `_admission_step` will size it (chunk-multiple >= bucket)."""
+        bucket = self._bucket(prompt_len)
+        chunk = min(self._chunk, bucket)
+        alloc = -(-bucket // chunk) * chunk
+        return kv_cache_nbytes(
+            self.cfg.num_hidden_layers, 1, alloc,
+            self.cfg.num_key_value_heads, self.cfg.hd,
+            self.kv_cache_dtype)["total"]
+
     def _admission_step(self) -> None:
         """Advance chunked admission by AT MOST one chunk (bounds the
         decode gap a long prompt can cause). Starts a new admission when
@@ -623,6 +673,27 @@ class LLMEngine:
                 req = cand
             if req is None:
                 return
+            # headroom guard: the admission's private prefill cache is
+            # the one new HBM allocation this path makes — defer (FCFS
+            # order kept, request back at the FRONT) while it would
+            # push bytes_in_use past the budget. would_fit() is None on
+            # backends without memory_stats(): always admit there.
+            cost = self._admission_cost(len(req.prompt_token_ids))
+            if self.ledger.would_fit(cost) is False:
+                self.waiting.appendleft(req)
+                self._deferred_admissions += 1
+                self._m_deferred.labels("memory").inc()
+                if not self._deferred_streak:
+                    self._deferred_streak = True
+                    hr = self.ledger.headroom()
+                    self.flight.record(
+                        "admit_deferred", step=self._step_idx,
+                        request_id=req.request_id, reason="memory",
+                        needed_bytes=cost,
+                        headroom_bytes=hr.get("headroom_bytes"),
+                        bytes_limit=hr.get("bytes_limit"))
+                return
+            self._deferred_streak = False
             # private cache sized to a chunk multiple (>= bucket) so no
             # chunk write can straddle the end; _insert clips the splice
             # back down to the batched cache's max_seq
@@ -1030,6 +1101,26 @@ class LLMEngine:
     def _update_gauges(self) -> None:
         self._m_occupancy.set(sum(1 for s in self.slots if s.active))
         self._m_queue_depth.set(len(self.waiting) + len(self._cp_waiting))
+        # hbm gauges: the ledger throttles its own device poll
+        # ($BIGDL_TPU_MEMORY_POLL_SEC), so per-step publish is cheap
+        self.ledger.publish(self.registry)
+
+    def memory_snapshot(self) -> dict:
+        """The `GET /v1/memory` dict: ledger static report + live
+        device stats + budget math, plus the engine's own admission
+        accounting."""
+        snap = self.ledger.snapshot()
+        snap["engine"] = {
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "kv_bytes_per_slot": self._kv_bytes_per_slot,
+            "admissions_deferred": self._deferred_admissions,
+            "hbm_budget_fraction": self.ledger.budget_fraction,
+            "next_admission_cost_bytes": (
+                self._admission_cost(
+                    len(self.waiting[0].prompt_token_ids))
+                if self.waiting else None),
+        }
+        return snap
 
     def stats_snapshot(self) -> dict:
         """JSON-ready engine state for `GET /v1/stats`: live occupancy,
@@ -1048,6 +1139,7 @@ class LLMEngine:
             "metrics": self.registry.summary(),
             "requests": self.tracer.snapshot(),
             "compile_table": compile_table(),
+            "memory": self.memory_snapshot(),
         }
 
     def _config_fingerprint(self) -> dict:
@@ -1066,7 +1158,15 @@ class LLMEngine:
         return build_postmortem(
             reason, flight=self.flight, tracer=self.tracer,
             registry=self.registry, config=self._config_fingerprint(),
-            error=error)
+            memory=self._memory_best_effort(), error=error)
+
+    def _memory_best_effort(self) -> Optional[dict]:
+        """memory_snapshot() for dump paths: a failing snapshot must
+        not mask the failure being dumped."""
+        try:
+            return self.memory_snapshot()
+        except Exception as e:
+            return {"error": repr(e)}
 
     def write_postmortem(self, reason: str,
                          error: Optional[BaseException] = None,
@@ -1077,7 +1177,8 @@ class LLMEngine:
         return _write_postmortem_file(
             reason, directory=directory, flight=self.flight,
             tracer=self.tracer, registry=self.registry,
-            config=self._config_fingerprint(), error=error)
+            config=self._config_fingerprint(),
+            memory=self._memory_best_effort(), error=error)
 
     def _finish(self, idx: int, reason: str) -> None:
         s = self.slots[idx]

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.models import bert as B
+from bigdl_tpu.observability.compile_watch import tracked_jit
 from bigdl_tpu.ops.quant import FLOAT_QTYPES
 from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
 
@@ -32,7 +33,9 @@ class _BertTaskModel:
         self.config = cfg
         self.hf_config = hf_config
         self.qtype = qtype
-        self._fwd = jax.jit(type(self).HEAD_FN, static_argnums=(1,))
+        self._fwd = tracked_jit(
+            f"bert_{type(self).__name__}", type(self).HEAD_FN,
+            static_argnums=(1,))
 
     def _ids(self, input_ids, attention_mask, token_type_ids):
         ids = jnp.asarray(np.asarray(input_ids, np.int32))

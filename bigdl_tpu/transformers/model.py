@@ -115,6 +115,19 @@ class TpuCausalLM:
         self.kv_quantized = self.kv_cache_dtype != "bf16"
         self.draft_params: Any = None   # set when loaded with speculative=True
         self._generator: Optional[Generator] = None
+        # packed weight bytes into the process memory ledger at build
+        # time (postmortems / GET /v1/memory / bench reports read it);
+        # best-effort — accounting never gates a load
+        try:
+            from bigdl_tpu.observability.memory import (default_ledger,
+                                                        tree_nbytes)
+
+            default_ledger().register(
+                "weights", "causal_lm", tree_nbytes(self.params),
+                qtype=qtype, family=getattr(family, "name",
+                                            type(family).__name__))
+        except Exception:
+            pass
 
     # -- generation ---------------------------------------------------------
     @property
@@ -329,8 +342,11 @@ class TpuQwenVLCausalLM(TpuCausalLM):
         else:
             pixels = QV.preprocess_images(images, self.visual_cfg)
         if self._encode_jit is None:
-            self._encode_jit = jax.jit(functools.partial(
-                QV.encode_images, vcfg=self.visual_cfg))
+            from bigdl_tpu.observability.compile_watch import tracked_jit
+
+            self._encode_jit = tracked_jit(
+                "qwen_vl_encode_images", functools.partial(
+                    QV.encode_images, vcfg=self.visual_cfg))
         return np.asarray(self._encode_jit(self.params["visual"],
                                            pixels=jnp.asarray(pixels)))
 

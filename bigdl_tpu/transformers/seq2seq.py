@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.models import whisper as W
+from bigdl_tpu.observability.compile_watch import tracked_jit
 from bigdl_tpu.ops.quant import FLOAT_QTYPES
 from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
 
@@ -51,11 +52,14 @@ class TpuSpeechSeq2Seq:
         self.hf_config = hf_config
         self.qtype = qtype
         self.model_path = model_path
-        self._encode = jax.jit(W.encode, static_argnums=(1,))
-        self._decode = jax.jit(W.decode_step, static_argnums=(1,),
-                               donate_argnums=(3,))
-        self._init_cache = jax.jit(W.init_decoder_cache,
-                                   static_argnums=(1, 3))
+        self._encode = tracked_jit("whisper_encode", W.encode,
+                                   static_argnums=(1,))
+        self._decode = tracked_jit("whisper_decode", W.decode_step,
+                                   static_argnums=(1,),
+                                   donate_argnums=(3,))
+        self._init_cache = tracked_jit("whisper_init_cache",
+                                       W.init_decoder_cache,
+                                       static_argnums=(1, 3))
 
     def encode(self, input_features) -> jax.Array:
         mel = jnp.asarray(np.asarray(input_features, np.float32))
@@ -115,11 +119,14 @@ class TpuSeq2SeqLM:
         self.hf_config = hf_config
         self.qtype = qtype
         self.model_path = model_path
-        self._encode = jax.jit(Bt.encode, static_argnums=(1,))
-        self._decode = jax.jit(Bt.decode_step, static_argnums=(1,),
-                               donate_argnums=(3,))
-        self._init_cache = jax.jit(Bt.init_decoder_cache,
-                                   static_argnums=(1, 3, 4))
+        self._encode = tracked_jit("seq2seq_encode", Bt.encode,
+                                   static_argnums=(1,))
+        self._decode = tracked_jit("seq2seq_decode", Bt.decode_step,
+                                   static_argnums=(1,),
+                                   donate_argnums=(3,))
+        self._init_cache = tracked_jit("seq2seq_init_cache",
+                                       Bt.init_decoder_cache,
+                                       static_argnums=(1, 3, 4))
 
     def save_low_bit(self, path: str) -> None:
         from bigdl_tpu.transformers import lowbit_io

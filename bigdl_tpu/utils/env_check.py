@@ -96,6 +96,32 @@ def collect() -> dict:
             info["recompile_warn"] = {
                 "value": rw, "valid": False, "error": str(e)}
 
+    # HBM admission budget fraction (the memory ledger falls back to
+    # the default on a bad value; surface it here instead)
+    bf = os.environ.get("BIGDL_TPU_HBM_BUDGET_FRACTION")
+    if bf:
+        from bigdl_tpu.observability.memory import \
+            resolve_hbm_budget_fraction
+
+        try:
+            info["hbm_budget_fraction"] = {
+                "value": resolve_hbm_budget_fraction(bf), "valid": True}
+        except ValueError as e:
+            info["hbm_budget_fraction"] = {
+                "value": bf, "valid": False, "error": str(e)}
+
+    # live memory_stats poll throttle (same fallback contract)
+    mp = os.environ.get("BIGDL_TPU_MEMORY_POLL_SEC")
+    if mp:
+        from bigdl_tpu.observability.memory import resolve_memory_poll_sec
+
+        try:
+            info["memory_poll_sec"] = {
+                "value": resolve_memory_poll_sec(mp), "valid": True}
+        except ValueError as e:
+            info["memory_poll_sec"] = {
+                "value": mp, "valid": False, "error": str(e)}
+
     # KV cache storage dtype: fail loudly here rather than at the first
     # model load (a typo'd dtype name otherwise surfaces deep in
     # init_cache)
@@ -128,6 +154,8 @@ def main() -> int:
           and info.get("kv_cache_dtype", {}).get("valid", True)
           and info.get("event_log_max_bytes", {}).get("valid", True)
           and info.get("recompile_warn", {}).get("valid", True)
+          and info.get("hbm_budget_fraction", {}).get("valid", True)
+          and info.get("memory_poll_sec", {}).get("valid", True)
           and info.get("postmortem_dir", {}).get("writable", True))
     print("status :", "OK" if ok else "PROBLEMS FOUND")
     return 0 if ok else 1

@@ -17,6 +17,17 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Per-compile memory_analysis capture (observability/compile_watch) goes
+# through jax's AOT path, whose executable cache is separate from the
+# traced-call cache: every first call per signature would pay a SECOND
+# full XLA compile. Across the whole suite (hundreds of executables in
+# one process) that doubles compile wall time and has crashed XLA's CPU
+# compiler under the accumulated load — so CI runs with capture off,
+# keeping the compile count identical to an uninstrumented run. The
+# capture path itself is exercised by tests that explicitly opt in
+# (tests/test_memory_ledger.py sets BIGDL_TPU_COMPILE_MEMORY=1).
+os.environ.setdefault("BIGDL_TPU_COMPILE_MEMORY", "0")
+
 import jax  # noqa: E402
 
 # Belt and braces: if jax was already imported by a pytest plugin before this

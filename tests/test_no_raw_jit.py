@@ -1,0 +1,54 @@
+"""Guard-lint: no raw ``jax.jit(`` calls outside the tracked wrapper.
+
+Every jit in ``bigdl_tpu/`` must go through
+``observability.compile_watch.tracked_jit`` so compiles land in the
+compile table (counts, seconds, memory analysis) and recompile storms
+get flagged. A raw ``jax.jit(`` silently opts out of all of that, so
+this test fails the build on any new one.
+
+Allowlist:
+  - ``observability/compile_watch.py`` — the wrapper itself.
+  - ``ops/probing.py`` — probe_compile AOT-compiles a throwaway fn to
+    measure compile cost; it is never executed and tracking it would
+    pollute the table with probe noise.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "bigdl_tpu"
+
+ALLOWED = {
+    "observability/compile_watch.py",
+    "ops/probing.py",
+}
+
+# matches jax.jit( as a call — not mentions in comments/docstrings that
+# merely name the API without an opening paren right after
+RAW_JIT = re.compile(r"\bjax\.jit\(")
+
+
+def test_no_raw_jax_jit():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        if rel in ALLOWED:
+            continue
+        for lineno, line in enumerate(
+                path.read_text(encoding="utf-8").splitlines(), 1):
+            if RAW_JIT.search(line):
+                offenders.append(f"bigdl_tpu/{rel}:{lineno}: "
+                                 f"{line.strip()}")
+    assert not offenders, (
+        "raw jax.jit( call(s) found — use "
+        "bigdl_tpu.observability.compile_watch.tracked_jit instead so "
+        "the compile lands in the compile table:\n"
+        + "\n".join(offenders))
+
+
+def test_allowlist_is_current():
+    """Allowlisted files must still exist (stale entries rot)."""
+    for rel in ALLOWED:
+        assert (PKG / rel).is_file(), f"allowlist entry gone: {rel}"

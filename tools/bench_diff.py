@@ -12,7 +12,12 @@ walked too, so per-config latencies get their own rows.
 
 A metric regresses when it moves in its bad direction by more than
 --threshold percent (default 5): latencies and byte footprints UP,
-throughput DOWN. Exit status: 0 no regressions, 1 regressions found,
+throughput DOWN. The memory report's headline scalars
+(hbm_static_total_bytes, hbm_device_peak_bytes, jit_peak_temp_bytes)
+get their own --max-hbm-regress-pct threshold (default: --threshold).
+Records missing any block — memory, jit_compile_table, observability,
+or individual metric keys — are fine: only keys present in BOTH files
+are compared. Exit status: 0 no regressions, 1 regressions found,
 2 usage / unreadable input.
 """
 
@@ -41,6 +46,14 @@ METRIC_DIRECTIONS = {
     "decode_hbm_roofline_util": "higher",
 }
 
+# memory-report headline scalars (bench "memory" block): compared
+# under --max-hbm-regress-pct instead of --threshold
+HBM_METRICS = {
+    "hbm_static_total_bytes": "lower",
+    "hbm_device_peak_bytes": "lower",
+    "jit_peak_temp_bytes": "lower",
+}
+
 
 def load_record(path: str) -> dict:
     """Read a BENCH json; unwrap the driver's {"parsed": ...} wrapper
@@ -64,20 +77,36 @@ def flatten_metrics(rec: dict, prefix: str = "",
                     out: Optional[Dict[str, Tuple[float, str]]] = None,
                     depth: int = 0) -> Dict[str, Tuple[float, str]]:
     """{dotted.name: (value, direction)} for every comparable scalar,
-    recursing into sub-record dicts (ab variants etc.)."""
+    recursing into sub-record dicts (ab variants etc.). Tolerant by
+    construction: absent keys/blocks simply contribute nothing (a
+    pre-memory or pre-compile-table record still compares on whatever
+    it has)."""
     if out is None:
         out = {}
+    if not isinstance(rec, dict):
+        return out
     for key, val in rec.items():
         name = f"{prefix}{key}"
         if key in METRIC_DIRECTIONS and isinstance(val, (int, float)) \
                 and not isinstance(val, bool):
             out[name] = (float(val), METRIC_DIRECTIONS[key])
+        elif key in HBM_METRICS and isinstance(val, (int, float)) \
+                and not isinstance(val, bool):
+            out[name] = (float(val), HBM_METRICS[key])
         elif key == "value" and isinstance(val, (int, float)) \
                 and not isinstance(val, bool) and rec.get("unit") == "ms":
             # the headline {"metric": ..., "value": ..., "unit": "ms"}
             # row: a latency, keyed by its metric name
             label = rec.get("metric", "value")
             out[f"{prefix}{label}"] = (float(val), "lower")
+        elif key == "memory" and isinstance(val, dict):
+            # only the headline scalars: the snapshot's nested static/
+            # device/headroom dicts churn per environment
+            for mk, direction in HBM_METRICS.items():
+                mv = val.get(mk)
+                if isinstance(mv, (int, float)) \
+                        and not isinstance(mv, bool):
+                    out[f"{name}.{mk}"] = (float(mv), direction)
         elif isinstance(val, dict) and depth < 3 \
                 and key not in ("observability", "jit_compile_table"):
             flatten_metrics(val, f"{name}.", out, depth + 1)
@@ -86,9 +115,14 @@ def flatten_metrics(rec: dict, prefix: str = "",
 
 def diff(old: Dict[str, Tuple[float, str]],
          new: Dict[str, Tuple[float, str]],
-         threshold_pct: float):
+         threshold_pct: float,
+         hbm_threshold_pct: Optional[float] = None):
     """Returns (rows, regressions): rows are (name, old, new, pct,
-    direction, regressed) for every metric present in both files."""
+    direction, regressed) for every metric present in both files.
+    Memory-report scalars (HBM_METRICS keys) regress past
+    ``hbm_threshold_pct`` (default: ``threshold_pct``)."""
+    if hbm_threshold_pct is None:
+        hbm_threshold_pct = threshold_pct
     rows = []
     regressions = []
     for name in sorted(set(old) & set(new)):
@@ -98,8 +132,10 @@ def diff(old: Dict[str, Tuple[float, str]],
             pct = 0.0 if n == 0 else float("inf") * (1 if n > 0 else -1)
         else:
             pct = (n - o) / abs(o) * 100.0
-        bad = pct > threshold_pct if direction == "lower" \
-            else pct < -threshold_pct
+        leaf = name.rsplit(".", 1)[-1]
+        limit = hbm_threshold_pct if leaf in HBM_METRICS \
+            else threshold_pct
+        bad = pct > limit if direction == "lower" else pct < -limit
         rows.append((name, o, n, pct, direction, bad))
         if bad:
             regressions.append(name)
@@ -112,6 +148,9 @@ def main(argv=None) -> int:
     ap.add_argument("new", help="candidate BENCH json")
     ap.add_argument("--threshold", type=float, default=5.0,
                     help="regression threshold in percent (default 5)")
+    ap.add_argument("--max-hbm-regress-pct", type=float, default=None,
+                    help="separate threshold for the memory report's "
+                         "HBM scalars (default: --threshold)")
     args = ap.parse_args(argv)
 
     try:
@@ -121,7 +160,8 @@ def main(argv=None) -> int:
         print(f"bench_diff: {e}", file=sys.stderr)
         return 2
 
-    rows, regressions = diff(old, new, args.threshold)
+    rows, regressions = diff(old, new, args.threshold,
+                             args.max_hbm_regress_pct)
     if not rows:
         print("bench_diff: no comparable metrics between "
               f"{args.old} and {args.new}", file=sys.stderr)

@@ -446,13 +446,20 @@ def main():
 
             enable_compilation_cache()   # reuse compiles across windows
             result = STEPS[name]()
-            print(json.dumps({"step": name, "ok": True,
-                              "elapsed_s": round(time.time() - t0, 2),
-                              "result": result}))
+            rec = {"step": name, "ok": True,
+                   "elapsed_s": round(time.time() - t0, 2),
+                   "result": result}
         except Exception as e:  # noqa: BLE001
-            print(json.dumps({"step": name, "ok": False,
-                              "elapsed_s": round(time.time() - t0, 2),
-                              "error": f"{type(e).__name__}: {e}"}))
+            rec = {"step": name, "ok": False,
+                   "elapsed_s": round(time.time() - t0, 2),
+                   "error": f"{type(e).__name__}: {e}"}
+        # real-HBM high-water mark AFTER the step's kernels ran ({} on
+        # CPU smoke runs) — the parent turns this into a memory_stats
+        # line per step in onchip_results.jsonl
+        from bigdl_tpu.observability.memory import device_memory_stats
+
+        rec["memory_stats"] = device_memory_stats()
+        print(json.dumps(rec))
         return
 
     os.makedirs("tpu_runs", exist_ok=True)
@@ -485,10 +492,15 @@ def main():
             rec = {"step": name, "ok": False,
                    "error": f"{type(e).__name__}: {e}"}
         rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        # split the child's device telemetry into its own jsonl line so
+        # HBM peaks per kernel step grep out of the log directly
+        mem = rec.pop("memory_stats", None)
         print(json.dumps(rec), flush=True)
         results.append(rec)
         with open("tpu_runs/onchip_results.jsonl", "a") as f:
             f.write(json.dumps(rec) + "\n")
+            f.write(json.dumps({"step": name, "memory_stats": mem or {},
+                                "ts": rec["ts"]}) + "\n")
         if not rec["ok"] and not _backend_alive():
             # a kernel fault can wedge the tunnel server-side; record it
             # and stop instead of timing out every remaining step
