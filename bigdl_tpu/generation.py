@@ -42,6 +42,10 @@ class GenerationConfig:
     # logit -= count * frequency_penalty + (count > 0) * presence_penalty
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # raise FloatingPointError on NaN/Inf logits instead of silently
+    # sampling garbage (off by default: it forces a per-step host check;
+    # the serving engine has its own always-on batched health check)
+    check_logits: bool = False
 
     @property
     def needs_token_counts(self) -> bool:
@@ -389,9 +393,15 @@ class Generator:
                  forward_fn=None, prefill_fn=None, max_seq: int = 2048,
                  kv_quantized=False, new_cache_fn=None,
                  recurrent: Optional[bool] = None,
-                 kv_cache_dtype: Optional[str] = None):
+                 kv_cache_dtype: Optional[str] = None,
+                 faults=None):
         from bigdl_tpu.ops.kvcache import resolve_kv_cache_dtype
+        from bigdl_tpu.robustness.faults import NULL as _no_faults
 
+        # same fault-injection surface the serving engine exposes
+        # (robustness/faults.py): chaos tests drive the offline decode
+        # loop through identical step/logits hooks. Default: no-op.
+        self.faults = faults if faults is not None else _no_faults
         self.params = params
         self.cfg = cfg
         self.max_seq = max_seq
@@ -587,6 +597,10 @@ class Generator:
             return self._sample(lg, k, temperature=temp, top_k=gen.top_k,
                                 top_p=gen.top_p)
 
+        if gen.check_logits and not np.isfinite(
+                np.asarray(logits[:, -1, :])).all():
+            raise FloatingPointError("non-finite logits after prefill")
+
         key, sk = jax.random.split(key)
         tok = sample(logits[:, -1, :], sk)
         tok_host = np.asarray(tok)
@@ -601,12 +615,24 @@ class Generator:
             finished |= tok_host == gen.eos_token_id
             finished_dev = jnp.asarray(finished)
 
-        for _ in range(gen.max_new_tokens - 1):
+        for step_i in range(1, gen.max_new_tokens):
             if finished.all():
                 break
             t1 = time.perf_counter()
+            # fault hooks mirror the serving engine's step points
+            self.faults.raise_point("step", step_i)
+            ms = self.faults.sleep_ms("step", step_i)
+            if ms > 0:
+                time.sleep(ms / 1000.0)
             logits, cache = self._decode(
                 self.params, self.cfg, tok[:, None], cache)
+            bad = self.faults.poison_rows(step_i, list(range(b)))
+            if bad:
+                logits = logits.at[jnp.asarray(bad)].set(jnp.nan)
+            if gen.check_logits and not np.isfinite(
+                    np.asarray(logits[:, -1, :])).all():
+                raise FloatingPointError(
+                    f"non-finite logits at decode step {step_i}")
             key, sk = jax.random.split(key)
             tok = sample(logits[:, -1, :], sk)
             if gen.eos_token_id is not None:

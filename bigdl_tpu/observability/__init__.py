@@ -65,6 +65,10 @@ bigdl_tpu_jit_compile_seconds{fn}           compile_watch.TrackedJit
 bigdl_tpu_hbm_bytes{kind}                   memory.MemoryLedger.publish
 bigdl_tpu_hbm_headroom_bytes                memory.MemoryLedger.publish
 bigdl_tpu_admission_deferred_total{reason}  LLMEngine._admission_step
+bigdl_tpu_requests_quarantined_total{reason} LLMEngine._quarantine_slot
+bigdl_tpu_step_retries_total                LLMEngine._on_step_failure
+bigdl_tpu_faults_injected_total{kind}       robustness.FaultInjector
+bigdl_tpu_engine_draining                   LLMEngine.begin_drain
 ==========================================  ===============================
 
 ``bigdl_tpu_kv_cache_bytes`` reports the batched KV cache's logical

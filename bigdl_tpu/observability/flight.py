@@ -91,6 +91,18 @@ class FlightRecorder:
             self._events.clear()
 
 
+def exception_fields(error: BaseException, max_len: int = 200) -> dict:
+    """Flat ``{"error_type", "error_msg"}`` fields for a flight event:
+    the exception's type name and its truncated message, so events like
+    ``step_exception`` / ``quarantined`` are debuggable straight from
+    the ring buffer without chasing the postmortem file (which carries
+    the full traceback)."""
+    msg = str(error)
+    if len(msg) > max_len:
+        msg = msg[: max_len - 1] + "…"
+    return {"error_type": type(error).__name__, "error_msg": msg}
+
+
 def env_fingerprint() -> dict:
     """Process + environment identity for a postmortem: interpreter,
     pid, argv, accelerator-relevant env flags, and library versions for

@@ -34,6 +34,10 @@ module and observability/__init__ for the field mapping):
     bigdl_tpu_spec_accept_ratio{mode=draft|lookup}               histogram
     bigdl_tpu_spec_round_seconds{mode=...}                       histogram
     bigdl_tpu_spec_tokens_total{mode=...,kind=drafted|accepted}  counter
+    bigdl_tpu_requests_quarantined_total{reason=nan_logits|crash_loop}
+    bigdl_tpu_step_retries_total                                 counter
+    bigdl_tpu_faults_injected_total{kind=...}                    counter
+    bigdl_tpu_engine_draining                                    gauge
 """
 
 from __future__ import annotations

@@ -1,0 +1,74 @@
+"""Fault tolerance for the serving stack.
+
+Three legs (ROADMAP "heavy traffic" north star — the engine must
+degrade per-request, never per-process):
+
+- ``faults``: the deterministic, seedable fault-injection harness
+  (``$BIGDL_TPU_FAULT_SPEC``) whose hooks live inside the engine's real
+  step / admit / prefill / logits paths, so chaos tests exercise the
+  same recovery code production failures hit.
+- request lifecycle hardening (serving/engine.py): per-request
+  deadlines (``$BIGDL_TPU_REQUEST_DEADLINE_MS`` /
+  ``SamplingParams.max_time_ms``), client-disconnect cancellation, and
+  bounded step retries with exponential backoff.
+- blast-radius isolation + graceful drain (serving/engine.py +
+  serving/api_server.py): per-slot NaN/Inf health checks, per-slot
+  crash counters, quarantine with structured errors, and SIGTERM drain
+  (``$BIGDL_TPU_DRAIN_TIMEOUT_SEC``) answering 503/504 at the API.
+
+This module is stdlib+numpy only — it is imported by the engine's hot
+step loop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from bigdl_tpu.robustness.faults import (FAULT_SPEC_ENV, FaultClause,
+                                         FaultInjector, InjectedFault,
+                                         parse_fault_spec,
+                                         validate_fault_spec)
+
+REQUEST_DEADLINE_ENV = "BIGDL_TPU_REQUEST_DEADLINE_MS"
+DRAIN_TIMEOUT_ENV = "BIGDL_TPU_DRAIN_TIMEOUT_SEC"
+
+_DEFAULT_DRAIN_TIMEOUT_SEC = 30.0
+
+
+def resolve_request_deadline_ms(
+        value: Optional[str] = None) -> Optional[float]:
+    """Default per-request deadline in ms (None = no deadline).
+    Raises ``ValueError`` on a non-positive or non-numeric value —
+    env_check surfaces it; the engine falls back to no deadline."""
+    raw = value if value is not None else os.environ.get(
+        REQUEST_DEADLINE_ENV, "")
+    if not raw:
+        return None
+    ms = float(raw)                    # ValueError propagates
+    if ms <= 0:
+        raise ValueError(
+            f"{REQUEST_DEADLINE_ENV} must be positive, got {raw!r}")
+    return ms
+
+
+def resolve_drain_timeout_sec(value: Optional[str] = None) -> float:
+    """Drain deadline in seconds (default 30). Raises ``ValueError``
+    on a non-positive or non-numeric value."""
+    raw = value if value is not None else os.environ.get(
+        DRAIN_TIMEOUT_ENV, "")
+    if not raw:
+        return _DEFAULT_DRAIN_TIMEOUT_SEC
+    sec = float(raw)                   # ValueError propagates
+    if sec <= 0:
+        raise ValueError(
+            f"{DRAIN_TIMEOUT_ENV} must be positive, got {raw!r}")
+    return sec
+
+
+__all__ = [
+    "FAULT_SPEC_ENV", "REQUEST_DEADLINE_ENV", "DRAIN_TIMEOUT_ENV",
+    "FaultClause", "FaultInjector", "InjectedFault",
+    "parse_fault_spec", "validate_fault_spec",
+    "resolve_request_deadline_ms", "resolve_drain_timeout_sec",
+]

@@ -1,0 +1,258 @@
+"""Deterministic, seedable fault injection for the serving stack.
+
+Chaos testing only exercises real recovery code when the faults land in
+the real execution paths: the serving engine calls into this module at
+its step / admit / prefill / logits points (serving/engine.py), and the
+`Generator` step path exposes the same hook (generation.py). With no
+spec configured every hook is a no-op costing one attribute check.
+
+Spec grammar (``$BIGDL_TPU_FAULT_SPEC`` or ``parse_fault_spec()``):
+
+    spec    := clause (';' clause)*
+    clause  := kind '@' param (',' param)*
+    param   := key '=' value
+
+Kinds and the injection points they attach to:
+
+- ``step_exception``  — raise ``InjectedFault`` from the engine's
+  batched decode step (point ``"step"``). The engine's retry /
+  quarantine machinery is the recovery path under test.
+- ``admit_exception`` — raise from the admission bookkeeping path
+  (point ``"admit"``), blaming a single identifiable request.
+- ``prefill_exception`` — raise around the chunked prefill call
+  (point ``"prefill"``), also request-attributable.
+- ``nan_logits``      — poison one slot's logits row with NaN after the
+  decode (point ``"logits"``); exercises the per-slot health check and
+  quarantine. ``slot=i`` targets a fixed row (default: the lowest
+  active slot).
+- ``slow_step``       — sleep ``ms=`` milliseconds at the step point;
+  exercises deadline enforcement without a slow model.
+
+Trigger params (every kind):
+
+- ``p=0.05``        — fire with probability p per visit (seeded; see
+  ``seed=``). Deterministic given the seed and visit order.
+- ``after_step=N``  — fire at the first visit whose ``step >= N``.
+- ``at_step=N``     — fire at visits with ``step == N`` exactly.
+- ``every=N``       — fire every Nth visit to the point (1 = always).
+- ``times=K``       — total-fire cap (default 1 for ``after_step`` /
+  ``at_step``, unlimited otherwise; ``times=0`` means unlimited).
+- ``seed=S``        — seed for this clause's RNG (default 0): two runs
+  with the same spec inject the identical fault sequence.
+- ``ms=M``          — sleep milliseconds (``slow_step`` only).
+- ``slot=i``        — target row (``nan_logits`` only).
+
+Example: ``step_exception@p=0.05,seed=7;slow_step@ms=500,every=10``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+FAULT_SPEC_ENV = "BIGDL_TPU_FAULT_SPEC"
+
+KINDS = ("step_exception", "admit_exception", "prefill_exception",
+         "nan_logits", "slow_step")
+
+# injection point -> exception kinds that fire there
+_RAISE_POINTS = {
+    "step": "step_exception",
+    "admit": "admit_exception",
+    "prefill": "prefill_exception",
+}
+
+_INT_PARAMS = ("after_step", "at_step", "every", "times", "seed", "slot")
+_FLOAT_PARAMS = ("p", "ms")
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the injection harness. ``transient`` mirrors
+    what the recovery code assumes about real-world analogs (XLA
+    transfer hiccups, tunnel resets): retrying may succeed."""
+
+    def __init__(self, kind: str, point: str, step: int):
+        super().__init__(f"injected {kind} at {point} (step {step})")
+        self.kind = kind
+        self.point = point
+        self.step = step
+        self.transient = True
+
+
+@dataclasses.dataclass
+class FaultClause:
+    kind: str
+    p: float = 0.0
+    after_step: Optional[int] = None
+    at_step: Optional[int] = None
+    every: int = 0
+    times: Optional[int] = None       # None = unlimited
+    seed: int = 0
+    ms: float = 0.0
+    slot: Optional[int] = None
+    # runtime state
+    fired: int = 0
+    visits: int = 0
+    _rng: Optional[np.random.Generator] = None
+
+    def __post_init__(self):
+        if self.times is None and (self.after_step is not None
+                                   or self.at_step is not None):
+            self.times = 1            # one-shot by default for step pins
+        if self.times == 0:
+            self.times = None
+        self._rng = np.random.default_rng(self.seed)
+
+    def should_fire(self, step: int) -> bool:
+        self.visits += 1
+        if self.times is not None and self.fired >= self.times:
+            return False
+        hit = False
+        if self.at_step is not None:
+            hit = step == self.at_step
+        elif self.after_step is not None:
+            hit = step >= self.after_step
+        elif self.every > 0:
+            hit = self.visits % self.every == 0
+        elif self.p > 0.0:
+            hit = bool(self._rng.random() < self.p)
+        if hit:
+            self.fired += 1
+        return hit
+
+
+def parse_fault_spec(spec: str) -> List[FaultClause]:
+    """Parse a fault spec string; raises ``ValueError`` on malformed
+    input (unknown kind, bad param, non-numeric value)."""
+    clauses: List[FaultClause] = []
+    for raw in (spec or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind, _, params = raw.partition("@")
+        kind = kind.strip()
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} (choices: {', '.join(KINDS)})")
+        kw: Dict[str, object] = {}
+        for pair in params.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            key, sep, val = pair.partition("=")
+            key = key.strip()
+            if not sep:
+                raise ValueError(f"fault param {pair!r} is not key=value")
+            try:
+                if key in _INT_PARAMS:
+                    kw[key] = int(val)
+                elif key in _FLOAT_PARAMS:
+                    kw[key] = float(val)
+                else:
+                    raise ValueError(
+                        f"unknown fault param {key!r} for {kind!r}")
+            except ValueError as e:
+                if "unknown fault param" in str(e):
+                    raise
+                raise ValueError(
+                    f"fault param {key!r}={val!r} is not numeric") from None
+        if kw.get("p", 0.0) and not (0.0 < kw["p"] <= 1.0):  # type: ignore
+            raise ValueError(f"fault probability p={kw['p']} not in (0, 1]")
+        clauses.append(FaultClause(kind=kind, **kw))  # type: ignore[arg-type]
+    return clauses
+
+
+def validate_fault_spec(spec: str) -> dict:
+    """env_check report for ``$BIGDL_TPU_FAULT_SPEC``: parsed clause
+    kinds, or the parse error."""
+    try:
+        clauses = parse_fault_spec(spec)
+    except ValueError as e:
+        return {"value": spec, "valid": False, "error": str(e)}
+    return {"value": spec, "valid": True,
+            "clauses": [c.kind for c in clauses]}
+
+
+class FaultInjector:
+    """Holds the parsed clauses and answers the engine's hook calls.
+
+    ``NULL`` (the no-clause injector) is what engines get when no spec
+    is configured — every hook is a cheap early return. ``on_fire`` is
+    an optional callback ``(kind, point, step)`` the engine uses to
+    count ``bigdl_tpu_faults_injected_total`` and drop a flight event.
+    """
+
+    def __init__(self, clauses: Optional[List[FaultClause]] = None,
+                 on_fire=None):
+        self.clauses = clauses or []
+        self.on_fire = on_fire
+        self._by_kind: Dict[str, List[FaultClause]] = {}
+        for c in self.clauses:
+            self._by_kind.setdefault(c.kind, []).append(c)
+
+    @classmethod
+    def from_env(cls, env: Optional[str] = None) -> "FaultInjector":
+        spec = env if env is not None else os.environ.get(
+            FAULT_SPEC_ENV, "")
+        return cls(parse_fault_spec(spec)) if spec else cls()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.clauses)
+
+    def _fired(self, kind: str, point: str, step: int) -> None:
+        if self.on_fire is not None:
+            try:
+                self.on_fire(kind, point, step)
+            except Exception:
+                pass                  # telemetry must not alter the fault
+
+    def raise_point(self, point: str, step: int) -> None:
+        """Raise ``InjectedFault`` when a clause of the point's
+        exception kind fires. Engine calls this at step/admit/prefill."""
+        if not self.clauses:
+            return
+        kind = _RAISE_POINTS.get(point)
+        if kind is None:
+            return
+        for c in self._by_kind.get(kind, ()):
+            if c.should_fire(step):
+                self._fired(kind, point, step)
+                raise InjectedFault(kind, point, step)
+
+    def sleep_ms(self, point: str, step: int) -> float:
+        """Milliseconds the caller should sleep at this point (0 when
+        no slow_step clause fires). The caller sleeps — the injector
+        never blocks on its own."""
+        if not self.clauses or point != "step":
+            return 0.0
+        total = 0.0
+        for c in self._by_kind.get("slow_step", ()):
+            if c.should_fire(step):
+                self._fired("slow_step", point, step)
+                total += c.ms
+        return total
+
+    def poison_rows(self, step: int, active_rows) -> List[int]:
+        """Rows of the decode logits to overwrite with NaN this step
+        (empty when no nan_logits clause fires). A clause with
+        ``slot=i`` targets that row if it is active; otherwise the
+        lowest active row is poisoned."""
+        if not self.clauses or not active_rows:
+            return []
+        rows: List[int] = []
+        for c in self._by_kind.get("nan_logits", ()):
+            if c.should_fire(step):
+                row = c.slot if (c.slot is not None
+                                 and c.slot in active_rows) \
+                    else active_rows[0]
+                self._fired("nan_logits", "logits", step)
+                rows.append(row)
+        return rows
+
+
+#: shared no-op injector for unconfigured engines
+NULL = FaultInjector()

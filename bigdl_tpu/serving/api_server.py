@@ -36,13 +36,35 @@ completions return token ids (useful for tests and token-level clients).
 from __future__ import annotations
 
 import json
+import select
+import socket
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional
 
-from bigdl_tpu.serving.engine import LLMEngine, SamplingParams
+from bigdl_tpu.serving.engine import (EngineDraining, LLMEngine,
+                                      SamplingParams)
+
+#: engine finish reasons that map to HTTP 504 (the request ran out of
+#: time: its own deadline, or the server's drain window closed on it)
+_TIMEOUT_REASONS = ("deadline", "drain_timeout")
+
+
+def _socket_disconnected(sock) -> bool:
+    """True when the client peer has closed its end (readable socket
+    whose MSG_PEEK returns EOF). Used to cancel NON-streaming requests
+    — the streaming path learns the same thing from its write failing."""
+    try:
+        r, _, _ = select.select([sock], [], [], 0)
+        if not r:
+            return False
+        return sock.recv(1, socket.MSG_PEEK | socket.MSG_DONTWAIT) == b""
+    except (BlockingIOError, InterruptedError):
+        return False
+    except OSError:
+        return True
 
 
 class _EngineLoop:
@@ -195,25 +217,35 @@ class OpenAIServer:
                       else None),
             seed=(int(body["seed"]) if body.get("seed") is not None
                   else None),
+            max_time_ms=(float(body["max_time_ms"])
+                         if body.get("max_time_ms") is not None
+                         else None),
+            ignore_eos=bool(body.get("ignore_eos", False)),
         )
 
     def _run_request(self, token_ids, params, stream_cb=None,
-                     stop_strs=()):
+                     stop_strs=(), disconnect_check=None):
         """Returns (rid, {index: ids}, {index: logprob entries},
-        {index: finish_reason}, {index: final text}).
+        {index: finish_reason}, {index: final text}, {index: error}).
 
         stream_cb(text_delta, index) when set — deltas come from the
         ACCUMULATED decode (robust to multi-token characters), with a
         holdback of len(longest stop)-1 chars so a stop string never
         leaks into the stream. `stop_strs` are the OpenAI `stop`
         sequences (reference vllm SamplingParams.stop): output truncates
-        at the first match; a single-choice request aborts early."""
+        at the first match; a single-choice request aborts early.
+
+        `disconnect_check()` (non-streaming path) is polled while
+        waiting; when it reports the client gone the request is aborted
+        — the engine frees the slot AND drops the prompt's prefix-cache
+        entry, so a hung-up client stops costing HBM immediately."""
         rid = f"cmpl-{uuid.uuid4().hex[:16]}"
         self.engine.add_request(rid, token_ids, params)
         self.loop.notify()
         out_ids: dict = {}
         out_lps: dict = {}
         reasons: dict = {}
+        errors: dict = {}     # index -> structured engine error
         texts: dict = {}      # index -> full decoded (possibly cut) text
         emitted: dict = {}    # index -> chars already streamed
         scanned: dict = {}    # index -> chars already stop-scanned
@@ -284,7 +316,22 @@ class OpenAIServer:
                 self.loop.notify()
 
         done = False
+        aborted = False
+        next_conn_check = time.time() + 0.25
         while not done:
+            if disconnect_check is not None and not aborted \
+                    and time.time() >= next_conn_check:
+                next_conn_check = time.time() + 0.25
+                try:
+                    gone = disconnect_check()
+                except Exception:
+                    gone = True
+                if gone:
+                    # client hung up mid-generation: cancel, then keep
+                    # draining until the engine emits the abort-finish
+                    aborted = True
+                    self.engine.abort_request(rid)
+                    self.loop.notify()
             outs = self.engine.get_outputs(rid)
             if not outs:
                 time.sleep(0.002)
@@ -312,6 +359,8 @@ class OpenAIServer:
                              if hold else len(det.text))
                 if o.finish_reason is not None:
                     reasons.setdefault(idx, o.finish_reason)
+                if o.error is not None:
+                    errors.setdefault(idx, o.error)
                 if o.finished:
                     reasons.setdefault(idx, o.finish_reason or "stop")
                     done = True
@@ -333,7 +382,7 @@ class OpenAIServer:
         # index; drop any empty phantom choice beyond n
         out_ids = {i: v for i, v in out_ids.items() if i < n_choices}
         texts = {i: v for i, v in texts.items() if i < n_choices}
-        return rid, out_ids, out_lps, reasons, texts
+        return rid, out_ids, out_lps, reasons, texts, errors
 
     # -- http ---------------------------------------------------------------
 
@@ -342,20 +391,39 @@ class OpenAIServer:
             def log_message(self, *a):   # quiet
                 pass
 
-            def _json(self, code: int, obj: dict):
+            def _json(self, code: int, obj: dict, headers=()):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _draining_503(self):
+                # shedding during drain: tell the client when a fresh
+                # replica should be up (reference: k8s preStop drain)
+                retry = server.engine.drain_retry_after_sec()
+                return self._json(
+                    503, {"error": {
+                        "message": "server is draining; retry against "
+                                   "another replica",
+                        "type": "unavailable", "code": 503,
+                        "retry_after": retry}},
+                    headers=(("Retry-After", str(retry)),))
 
             def do_GET(self):
                 if self.path == "/v1/models":
                     self._json(200, {"object": "list", "data": [
                         {"id": server.model_name, "object": "model"}]})
                 elif self.path in ("/health", "/ping"):
-                    self._json(200, {"status": "ok"})
+                    # a draining replica reports 503 so load balancers
+                    # stop routing to it while in-flight work finishes
+                    if server.engine.draining:
+                        self._json(503, {"status": "draining"})
+                    else:
+                        self._json(200, {"status": "ok"})
                 elif self.path == "/metrics":
                     body = server.engine.registry.render().encode()
                     self.send_response(200)
@@ -401,6 +469,8 @@ class OpenAIServer:
                         return self._profiler(body, start=True)
                     if self.path == "/v1/profiler/stop":
                         return self._profiler(body, start=False)
+                except EngineDraining:
+                    return self._draining_503()
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
                 self._json(404, {"error": "not found"})
@@ -464,6 +534,11 @@ class OpenAIServer:
                     stops = (stops,)
                 stops = tuple(s for s in stops if s)
                 created = int(time.time())
+                # shed BEFORE the stream branch commits its 200 header
+                # (add_request would raise EngineDraining anyway, but by
+                # then a streaming response is already half-written)
+                if server.engine.draining:
+                    return self._draining_503()
 
                 if body.get("stream"):
                     self.send_response(200)
@@ -489,15 +564,35 @@ class OpenAIServer:
                             b"data: " + json.dumps(chunk).encode() + b"\n\n")
                         self.wfile.flush()
 
-                    rid, out_ids, out_lps, reasons, _ = \
+                    rid, out_ids, out_lps, reasons, _, _ = \
                         server._run_request(ids, params, stream_cb=cb,
                                             stop_strs=stops)
                     self.wfile.write(b"data: [DONE]\n\n")
                     self.wfile.flush()
                     return
 
-                rid, out_ids, out_lps, reasons, texts = \
-                    server._run_request(ids, params, stop_strs=stops)
+                rid, out_ids, out_lps, reasons, texts, errors = \
+                    server._run_request(
+                        ids, params, stop_strs=stops,
+                        disconnect_check=lambda: _socket_disconnected(
+                            self.connection))
+                # robustness status mapping: a request that ran out of
+                # time (its own deadline, or the drain window closing on
+                # it) is a gateway timeout; a quarantined request is a
+                # server error with the engine's structured diagnosis
+                timed_out = [r for r in reasons.values()
+                             if r in _TIMEOUT_REASONS]
+                if timed_out:
+                    return self._json(504, {"error": {
+                        "message": f"request timed out ({timed_out[0]})",
+                        "type": "timeout", "code": 504,
+                        "reason": timed_out[0], "id": rid}})
+                if any(r == "error" for r in reasons.values()):
+                    detail = next(iter(errors.values()), {})
+                    return self._json(500, {"error": {
+                        "message": "request failed in the engine",
+                        "type": "engine_error", "code": 500,
+                        "id": rid, **detail}})
                 choices = []
                 total_completion = 0
                 for idx in sorted(out_ids):
@@ -550,6 +645,21 @@ class OpenAIServer:
             self._httpd.serve_forever()
         return self._httpd
 
+    def begin_drain(self, timeout_sec: Optional[float] = None) -> None:
+        """Graceful-drain entry point (the CLI's SIGTERM handler):
+        admission stops (new requests get 503 + Retry-After), in-flight
+        requests run to completion, and whatever outlives the drain
+        window fails with 504. Poll `engine.drained` (or `wait_drained`)
+        to know when it is safe to exit."""
+        self.engine.begin_drain(timeout_sec)
+        self.loop.notify()       # wake the step loop to run the drain
+
+    def wait_drained(self, poll_sec: float = 0.05) -> None:
+        """Block until every in-flight request has finished (or the
+        drain deadline failed it). Call after begin_drain()."""
+        while not self.engine.drained:
+            time.sleep(poll_sec)
+
     def shutdown(self):
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -598,13 +708,33 @@ def main():
         embedder_tok = AutoTokenizer.from_pretrained(args.embedder)
     server = OpenAIServer(engine, tokenizer, embedder=embedder,
                           embedder_tokenizer=embedder_tok)
+
+    # SIGTERM (a deploy's kill) drains instead of dying: stop admitting
+    # (503 + Retry-After), finish in-flight work up to
+    # $BIGDL_TPU_DRAIN_TIMEOUT_SEC, then exit cleanly. Registered FIRST
+    # so install_signal_dumps (below) chains to it after its postmortem.
+    import signal as _signal
+
+    def _drain_and_exit(signum, frame):
+        server.begin_drain()
+
+        def _watch():
+            server.wait_drained()
+            server.shutdown()
+
+        threading.Thread(target=_watch, daemon=True).start()
+
+    _signal.signal(_signal.SIGTERM, _drain_and_exit)
+
     # operator kill (SIGTERM from a deploy, ^C) leaves a postmortem in
-    # $BIGDL_TPU_POSTMORTEM_DIR before default termination proceeds
+    # $BIGDL_TPU_POSTMORTEM_DIR before drain (SIGTERM) or default
+    # termination (^C) proceeds
     from bigdl_tpu.observability.flight import install_signal_dumps
 
     install_signal_dumps(engine.write_postmortem)
     print(f"serving on http://{args.host}:{args.port}/v1")
     server.serve(args.host, args.port)
+    server.loop.stop()
 
 
 if __name__ == "__main__":

@@ -41,7 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.observability.compile_watch import tracked_jit
-from bigdl_tpu.observability.flight import FlightRecorder, build_postmortem
+from bigdl_tpu.observability.flight import (FlightRecorder, build_postmortem,
+                                            exception_fields)
 from bigdl_tpu.observability.flight import write_postmortem as \
     _write_postmortem_file
 from bigdl_tpu.observability.memory import MemoryLedger, tree_nbytes
@@ -51,6 +52,15 @@ from bigdl_tpu.ops.kvcache import (KVCache, init_cache, kv_cache_bytes,
                                    kv_cache_nbytes,
                                    publish_kv_cache_bytes,
                                    resolve_kv_cache_dtype)
+from bigdl_tpu.robustness import (resolve_drain_timeout_sec,
+                                  resolve_request_deadline_ms)
+from bigdl_tpu.robustness.faults import FaultInjector
+
+
+class EngineDraining(RuntimeError):
+    """Raised by ``add_request`` while the engine drains (SIGTERM /
+    ``begin_drain``): the caller should retry against another replica.
+    The API server maps it to 503 + ``Retry-After``."""
 
 
 @dataclasses.dataclass
@@ -77,6 +87,12 @@ class SamplingParams:
     # alternatives per step. None = off.
     logprobs: Optional[int] = None
     seed: Optional[int] = None
+    # per-request deadline (wall ms from arrival, enforced per step);
+    # None defers to $BIGDL_TPU_REQUEST_DEADLINE_MS (unset = no
+    # deadline). An expired request finishes with reason "deadline"
+    # (HTTP 504 at the API server) wherever it is in its lifecycle —
+    # queued, mid-prefill, or decoding.
+    max_time_ms: Optional[float] = None
 
     @property
     def needs_counts(self) -> bool:
@@ -96,6 +112,13 @@ class Request:
     # against max_tokens without being re-emitted
     generated_offset: int = 0
     resumed_cum_logprob: float = 0.0
+    # absolute deadline (time.time()), resolved at add_request from
+    # max_time_ms / $BIGDL_TPU_REQUEST_DEADLINE_MS; survives
+    # preempt-resume (the clock does not restart on readmission)
+    deadline: Optional[float] = None
+    # step/prefill failures attributed to this request (blast-radius
+    # blame counter); past max_slot_crashes the request is quarantined
+    crashes: int = 0
 
 
 @dataclasses.dataclass
@@ -114,6 +137,9 @@ class RequestOutput:
     finish_reason: Optional[str] = None
     index: int = 0                    # choice index (n>1 fan-out)
     logprobs: Optional[List[LogprobEntry]] = None
+    # structured failure detail for finish_reason "error" (quarantine):
+    # {"reason", "request_id"[, "type", "message"]}
+    error: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -165,6 +191,32 @@ class EngineConfig:
     # None defers to $BIGDL_TPU_HBM_BUDGET_FRACTION (default 0.9).
     # Backends without memory_stats() (CPU/interpret) always admit.
     hbm_budget_fraction: Optional[float] = None
+    # -- robustness (bigdl_tpu/robustness/) ---------------------------
+    # default per-request deadline in ms; None defers to
+    # $BIGDL_TPU_REQUEST_DEADLINE_MS (unset = no deadline).
+    # SamplingParams.max_time_ms overrides per request.
+    request_deadline_ms: Optional[float] = None
+    # transient step failures: a failing step() is retried up to this
+    # many consecutive times (exponential backoff from
+    # retry_backoff_ms) before the exception propagates. Failures that
+    # can be blamed on one request (mid-admission, or a slot crossing
+    # max_slot_crashes) quarantine that request and refresh the budget
+    # — the engine degrades per-request, never per-process.
+    max_step_retries: int = 3
+    retry_backoff_ms: float = 20.0
+    # per-request crash budget: once this many step/prefill failures
+    # are attributed to one request it is quarantined (finish reason
+    # "error", bigdl_tpu_requests_quarantined_total{reason="crash_loop"})
+    max_slot_crashes: int = 3
+    # per-step NaN/Inf logits health check: a non-finite decode row
+    # quarantines exactly that slot (reason "nan_logits") while every
+    # other slot keeps decoding. Costs one tiny [B]-bool readback per
+    # decode step; False disables.
+    logits_health_check: bool = True
+    # graceful drain: in-flight work gets this long to finish after
+    # begin_drain() before being failed with reason "drain_timeout".
+    # None defers to $BIGDL_TPU_DRAIN_TIMEOUT_SEC (default 30).
+    drain_timeout_sec: Optional[float] = None
 
 
 class _Slot:
@@ -251,7 +303,8 @@ class LLMEngine:
                  cp_mesh: Any = None, registry=None, tracer=None,
                  flight: Optional[FlightRecorder] = None,
                  ledger: Optional[MemoryLedger] = None,
-                 memory_stats_provider: Optional[Callable[[], dict]] = None):
+                 memory_stats_provider: Optional[Callable[[], dict]] = None,
+                 faults: Optional[FaultInjector] = None):
         self.cfg_engine = config or EngineConfig()
         self.params = model.params
         self.cfg = model.config
@@ -324,6 +377,32 @@ class LLMEngine:
         self._deferred_admissions = 0   # lifetime deferral count
         self._deferred_streak = False   # one flight event per streak
 
+        # -- robustness: fault injection + lifecycle hardening
+        # (bigdl_tpu/robustness/). The injector's hooks sit in the real
+        # step/admit/prefill/logits paths below; with no spec configured
+        # each is one attribute check.
+        self.faults = faults if faults is not None \
+            else FaultInjector.from_env()
+        self.faults.on_fire = self._on_fault_fired
+        try:
+            self._request_deadline_ms = (
+                ce.request_deadline_ms
+                if ce.request_deadline_ms is not None
+                else resolve_request_deadline_ms())
+        except ValueError:
+            self._request_deadline_ms = None    # env_check reports it
+        try:
+            self._drain_timeout_sec = (
+                ce.drain_timeout_sec if ce.drain_timeout_sec is not None
+                else resolve_drain_timeout_sec())
+        except ValueError:
+            self._drain_timeout_sec = 30.0      # env_check reports it
+        self._draining = False
+        self._drain_deadline: Optional[float] = None
+        self._any_deadline = False      # fast path: skip expiry scans
+        self._consec_failures = 0       # consecutive failing step()s
+        self._retry_total = 0           # lifetime retried steps
+
         # context-parallel overflow lane (long prompts)
         self._cp_mesh = cp_mesh
         self._cp_axis = cp_mesh.axis_names[0] if cp_mesh is not None \
@@ -356,6 +435,13 @@ class LLMEngine:
         self._argmax = tracked_jit(
             "engine_argmax",
             lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32),
+            registry=self.registry)
+        # per-slot logits health: [B] bools across the tunnel — the
+        # blast-radius check that turns a NaN/Inf decode row into ONE
+        # quarantined request instead of a poisoned batch
+        self._health = tracked_jit(
+            "engine_health",
+            lambda lg: jnp.isfinite(lg).all(axis=-1),
             registry=self.registry)
         # batched DEVICE sampler: temperature / top-k / top-p via
         # gumbel-max, one seeded stream per slot. Serves every slot that
@@ -518,6 +604,22 @@ class LLMEngine:
             "Admissions deferred by the headroom guard, by reason.",
             labelnames=("reason",))
         self._m_deferred.labels("memory")   # render from scrape 1
+        self._m_quarantined = m.counter(
+            "bigdl_tpu_requests_quarantined_total",
+            "Requests failed by blast-radius isolation, by reason.",
+            labelnames=("reason",))
+        for r in ("nan_logits", "crash_loop"):   # render from scrape 1
+            self._m_quarantined.labels(r)
+        self._m_retries = m.counter(
+            "bigdl_tpu_step_retries_total",
+            "Engine steps retried after a transient failure.")
+        self._m_faults = m.counter(
+            "bigdl_tpu_faults_injected_total",
+            "Faults fired by the injection harness "
+            "($BIGDL_TPU_FAULT_SPEC), by kind.", labelnames=("kind",))
+        self._m_draining = m.gauge(
+            "bigdl_tpu_engine_draining",
+            "1 while the engine refuses new requests (graceful drain).")
         # batched-cache storage footprint per component (codes vs scales);
         # shapes are static for the engine lifetime, so set once
         publish_kv_cache_bytes(self.cache, m)
@@ -544,6 +646,10 @@ class LLMEngine:
     # -- public api ---------------------------------------------------------
 
     def add_request(self, request_id: str, prompt_token_ids, params=None):
+        if self._draining:
+            raise EngineDraining(
+                "engine is draining (admission stopped); retry against "
+                "another replica")
         params = params or SamplingParams()
         ids = list(prompt_token_ids)
         long = len(ids) + 1 > self.cfg_engine.max_seq
@@ -574,6 +680,13 @@ class LLMEngine:
         best_of = params.best_of or params.n
         if best_of < params.n:
             raise ValueError(f"best_of ({best_of}) < n ({params.n})")
+        if params.max_time_ms is not None and params.max_time_ms <= 0:
+            raise ValueError("max_time_ms must be positive")
+        deadline_ms = (params.max_time_ms
+                       if params.max_time_ms is not None
+                       else self._request_deadline_ms)
+        if deadline_ms is not None:
+            self._any_deadline = True
         with self._lock:
             self._outputs[request_id] = []
         target = self._cp_waiting if long else self.waiting
@@ -589,11 +702,15 @@ class LLMEngine:
                     seed=None if params.seed is None else params.seed + i)
                 self._children[cid] = (request_id, i)
                 creq = Request(cid, list(ids), cparams)
+                if deadline_ms is not None:
+                    creq.deadline = creq.arrival + deadline_ms / 1000.0
                 self.tracer.start(cid, prompt_len=len(ids),
                                   t_arrival=creq.arrival)
                 target.append(creq)
             return
         req = Request(request_id, ids, params)
+        if deadline_ms is not None:
+            req.deadline = req.arrival + deadline_ms / 1000.0
         self.tracer.start(request_id, prompt_len=len(ids),
                           t_arrival=req.arrival)
         target.append(req)
@@ -732,6 +849,10 @@ class LLMEngine:
                 request_id=req.request_id, slot=free, bucket=bucket,
                 prompt_len=len(req.prompt_token_ids),
                 prefix_seeded=consumed)
+            # chaos: admission failures are attributable to ONE request
+            # (self._admitting is set), exercising the requeue/
+            # quarantine blame path in _on_step_failure
+            self.faults.raise_point("admit", self._step_idx)
 
         if a.req.request_id in self._abort:      # aborted mid-admission
             self._abort.discard(a.req.request_id)
@@ -743,6 +864,7 @@ class LLMEngine:
         padded = np.zeros((1, chunk), np.int32)
         part = a.req.prompt_token_ids[a.consumed:a.consumed + chunk]
         padded[0, :len(part)] = part
+        self.faults.raise_point("prefill", self._step_idx)
         logits, a.cache1 = self._prefill(
             self.params, jnp.asarray(padded), a.cache1)
         start = a.consumed
@@ -879,6 +1001,12 @@ class LLMEngine:
     def reset_prefix_cache(self) -> None:
         self._prefix_cache.clear()
         self._prefix_index.clear()
+
+    def _drop_prefix(self, prompt: List[int]) -> None:
+        """Evict one prompt's KV snapshot (cancellation/quarantine)."""
+        key = tuple(prompt)
+        if self._prefix_cache.pop(key, None) is not None:
+            self._prefix_index_drop(key)
 
     def _finish_admission_abort(self, a: _Admission) -> None:
         self._push_output(a.req.request_id, RequestOutput(
@@ -1140,6 +1268,18 @@ class LLMEngine:
             "requests": self.tracer.snapshot(),
             "compile_table": compile_table(),
             "memory": self.memory_snapshot(),
+            "robustness": {
+                "draining": self._draining,
+                "drain_deadline": self._drain_deadline,
+                "faults_enabled": self.faults.enabled,
+                "step_retries": self._retry_total,
+                "consecutive_failures": self._consec_failures,
+                "request_deadline_ms": self._request_deadline_ms,
+                "slot_crashes": {
+                    s.req.request_id: s.req.crashes
+                    for s in self.slots
+                    if s.active and s.req.crashes > 0},
+            },
         }
 
     def _config_fingerprint(self) -> dict:
@@ -1148,6 +1288,9 @@ class LLMEngine:
         out["family"] = getattr(self.family, "name",
                                 type(self.family).__name__)
         out["eos_token_id"] = self.eos_token_id
+        out["request_deadline_ms_resolved"] = self._request_deadline_ms
+        out["drain_timeout_sec_resolved"] = self._drain_timeout_sec
+        out["fault_spec_active"] = self.faults.enabled
         return out
 
     def postmortem(self, reason: str = "on_demand",
@@ -1180,14 +1323,19 @@ class LLMEngine:
             config=self._config_fingerprint(),
             memory=self._memory_best_effort(), error=error)
 
-    def _finish(self, idx: int, reason: str) -> None:
+    def _finish(self, idx: int, reason: str,
+                error: Optional[dict] = None) -> None:
         s = self.slots[idx]
         if s.req is None:
             return
         gen_len = s.req.generated_offset + len(s.generated)
+        if reason in ("abort", "error"):
+            # a cancelled client's snapshot is dead weight; a poisoned
+            # request's snapshot must never seed a future admission
+            self._drop_prefix(s.req.prompt_token_ids)
         self._push_output(
             s.req.request_id,
-            RequestOutput(s.req.request_id, [], True, reason),
+            RequestOutput(s.req.request_id, [], True, reason, error=error),
             score=s.cum_logprob, length=gen_len)
         self._obs_finish(s.req.request_id, reason, n_generated=gen_len)
         s.req = None
@@ -1378,24 +1526,238 @@ class LLMEngine:
             request_id=resumed.request_id, slot=victim,
             n_generated=resumed.generated_offset)
 
+    # -- robustness: quarantine, retries, deadlines, drain ------------------
+
+    def _on_fault_fired(self, kind: str, point: str, step: int) -> None:
+        """FaultInjector.on_fire: count + breadcrumb every injection."""
+        self._m_faults.labels(kind).inc()
+        self.flight.record("fault_injected", step=step, kind=kind,
+                           point=point)
+
+    def _fail_request(self, rid: str, reason: str,
+                      error: Optional[dict] = None) -> None:
+        """Fail a request that is NOT resident in a slot (queued or
+        mid-admission): deliver the finished output and close its
+        span."""
+        self._push_output(rid, RequestOutput(rid, [], True, reason,
+                                             error=error))
+        self._obs_finish(rid, reason)
+
+    def _quarantine_slot(self, idx: int, reason: str,
+                         error: Optional[BaseException] = None) -> None:
+        """Blast-radius isolation: fail ONE resident request with a
+        structured error while every other slot keeps decoding. Its
+        prefix snapshot is dropped (a poisoned prompt must not seed
+        future admissions), a `quarantined` flight event and counter
+        fire, and a postmortem dump captures the evidence."""
+        s = self.slots[idx]
+        rid = s.req.request_id
+        self._m_quarantined.labels(reason).inc()
+        fields = exception_fields(error) if error is not None else {}
+        self.flight.record("quarantined", step=self._step_idx,
+                           request_id=rid, slot=idx, reason=reason,
+                           crashes=s.req.crashes, **fields)
+        self._finish(idx, "error", error=self._quarantine_error(
+            reason, rid, error))
+        self.write_postmortem("request_quarantined", error=error)
+
+    def _quarantine_request(self, req: Request, reason: str,
+                            error: Optional[BaseException] = None) -> None:
+        """Quarantine a non-resident request (its admission keeps
+        crashing before it ever reaches a slot)."""
+        self._m_quarantined.labels(reason).inc()
+        fields = exception_fields(error) if error is not None else {}
+        self.flight.record("quarantined", step=self._step_idx,
+                           request_id=req.request_id, slot=None,
+                           reason=reason, crashes=req.crashes, **fields)
+        self._drop_prefix(req.prompt_token_ids)
+        self._fail_request(req.request_id, "error",
+                           error=self._quarantine_error(
+                               reason, req.request_id, error))
+        self.write_postmortem("request_quarantined", error=error)
+
+    @staticmethod
+    def _quarantine_error(reason: str, rid: str,
+                          error: Optional[BaseException]) -> dict:
+        out = {"reason": reason, "request_id": rid}
+        if error is not None:
+            out["type"] = type(error).__name__
+            out["message"] = str(error)[:200]
+        return out
+
+    def begin_drain(self, timeout_sec: Optional[float] = None) -> None:
+        """Graceful drain (SIGTERM path): stop admitting NEW requests
+        (add_request raises EngineDraining -> API 503 + Retry-After),
+        let in-flight work finish, and fail whatever remains at the
+        drain deadline with reason "drain_timeout" (-> API 504)."""
+        if self._draining:
+            return
+        self._draining = True
+        t = (timeout_sec if timeout_sec is not None
+             else self._drain_timeout_sec)
+        self._drain_deadline = time.time() + max(t, 0.0)
+        self._m_draining.set(1)
+        self.flight.record(
+            "drain_start", step=self._step_idx, timeout_sec=t,
+            queue_depth=len(self.waiting) + len(self._cp_waiting),
+            occupancy=sum(1 for s in self.slots if s.active))
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        return self._draining and not self.has_unfinished()
+
+    def drain_retry_after_sec(self) -> int:
+        """Seconds a 503'd client should wait before retrying: the
+        remaining drain window (a fresh replica should be up by then)."""
+        if self._drain_deadline is None:
+            return 1
+        return max(1, int(self._drain_deadline - time.time()) + 1)
+
+    def _drain_expire(self) -> None:
+        """Drain deadline reached with work still in flight: fail every
+        remaining request with reason "drain_timeout" so clients get a
+        definitive 504 instead of a cut socket."""
+        self.flight.record(
+            "drain_timeout", step=self._step_idx,
+            queue_depth=len(self.waiting) + len(self._cp_waiting),
+            occupancy=sum(1 for s in self.slots if s.active))
+        self.write_postmortem("drain_timeout")
+        for q in (self.waiting, self._cp_waiting):
+            for r in q:
+                self._fail_request(r.request_id, "drain_timeout")
+            q.clear()
+        if self._admitting is not None:
+            self._fail_request(self._admitting.req.request_id,
+                               "drain_timeout")
+            self._admitting = None
+        if self._cp_admitting is not None:
+            self._fail_request(self._cp_admitting.req.request_id,
+                               "drain_timeout")
+            self._cp_admitting = None
+        for i, s in enumerate(self.slots):
+            if s.active:
+                self._finish(i, "drain_timeout")
+        if self._cp_active is not None:
+            self._cp_finish("drain_timeout")
+
+    def _expire_deadlines(self) -> None:
+        """Per-step deadline enforcement across every lane a request
+        can live in: waiting queues, (CP) admission, resident slots,
+        and the CP pseudo-slot. Reason "deadline" -> API 504."""
+        now = time.time()
+
+        def expired(req: Request) -> bool:
+            return req.deadline is not None and now >= req.deadline
+
+        for q in (self.waiting, self._cp_waiting):
+            if any(expired(r) for r in q):
+                keep = [r for r in q if not expired(r)]
+                for r in q:
+                    if expired(r):
+                        self._fail_request(r.request_id, "deadline")
+                q.clear()
+                q.extend(keep)
+        a = self._admitting
+        if a is not None and expired(a.req):
+            self._fail_request(a.req.request_id, "deadline")
+            self._admitting = None
+        ca = self._cp_admitting
+        if ca is not None and expired(ca.req):
+            self._fail_request(ca.req.request_id, "deadline")
+            self._cp_admitting = None
+        for i, s in enumerate(self.slots):
+            if s.active and expired(s.req):
+                self._finish(i, "deadline")
+        if self._cp_active is not None \
+                and expired(self._cp_active.slot.req):
+            self._cp_finish("deadline")
+
+    def _on_step_failure(self, e: Exception) -> bool:
+        """Recovery path for a failed step(): record + dump, attribute
+        blame, quarantine crash-looping requests, and retry with
+        exponential backoff while the consecutive-failure budget lasts.
+        Re-raises when the budget is exhausted with no one to blame (a
+        systemic failure, not a poisoned request)."""
+        ce = self.cfg_engine
+        self._consec_failures += 1
+        attempt = self._consec_failures
+        self.flight.record("step_exception", step=self._step_idx,
+                           error=repr(e), attempt=attempt,
+                           **exception_fields(e))
+        self.write_postmortem("engine_step_exception", error=e)
+        blamed = False
+        a = self._admitting
+        if a is not None:
+            # mid-admission failures are attributable to ONE request:
+            # drop the (possibly corrupt) private cache and retry it
+            # from scratch at the FRONT of the queue (FCFS kept) until
+            # its crash budget runs out, then quarantine it
+            self._admitting = None
+            a.req.crashes += 1
+            if a.req.crashes > ce.max_slot_crashes:
+                self._quarantine_request(a.req, "crash_loop", error=e)
+            else:
+                self.waiting.appendleft(a.req)
+            blamed = True
+        else:
+            suspects = [i for i, s in enumerate(self.slots) if s.active]
+            for i in suspects:
+                self.slots[i].req.crashes += 1
+            over = [i for i in suspects
+                    if self.slots[i].req.crashes >= ce.max_slot_crashes]
+            if over:
+                # a batched decode failure cannot name its culprit; peel
+                # ONE suspect per round (latest arrival, mirroring the
+                # preemption victim policy) — repeated failures bisect
+                # the batch down to the poisoned request while every
+                # cleared slot keeps decoding
+                victim = max(over,
+                             key=lambda i: self.slots[i].req.arrival)
+                self._quarantine_slot(victim, "crash_loop", error=e)
+                blamed = True
+        if blamed:
+            # blame assigned and state changed: fresh retry budget
+            self._consec_failures = 0
+        elif attempt > ce.max_step_retries:
+            raise                        # the active exception (e)
+        self._retry_total += 1
+        self._m_retries.inc()
+        backoff_s = min(ce.retry_backoff_ms * (2 ** (attempt - 1)),
+                        2000.0) / 1000.0
+        self.flight.record("step_retry", step=self._step_idx,
+                           attempt=attempt,
+                           backoff_ms=round(backoff_s * 1000.0, 3))
+        if backoff_s > 0:
+            time.sleep(backoff_s)
+        return True
+
     def step(self) -> bool:
         """One engine iteration (reference LLMEngine.step): advance the
         (chunked) admission by one chunk, then run one batched decode
         step. Returns True if any work was done.
 
         A step that raises records the exception into the flight
-        recorder and writes a postmortem dump (when
-        $BIGDL_TPU_POSTMORTEM_DIR is set) before re-raising — the
-        engine loop thread dying silently is exactly the failure mode
-        the flight recorder exists for."""
+        recorder (with error type + truncated message) and writes a
+        postmortem dump (when $BIGDL_TPU_POSTMORTEM_DIR is set), then
+        enters the bounded-retry/quarantine path (_on_step_failure) —
+        transient failures back off and retry, attributable ones
+        quarantine the culprit request, and only budget exhaustion
+        with no one to blame propagates out of step()."""
         self._step_idx += 1
         try:
-            return self._step_inner()
+            self.faults.raise_point("step", self._step_idx)
+            ms = self.faults.sleep_ms("step", self._step_idx)
+            if ms > 0:
+                time.sleep(ms / 1000.0)
+            did = self._step_inner()
         except Exception as e:
-            self.flight.record("step_exception", step=self._step_idx,
-                               error=repr(e))
-            self.write_postmortem("engine_step_exception", error=e)
-            raise
+            return self._on_step_failure(e)
+        self._consec_failures = 0
+        return did
 
     def _step_inner(self) -> bool:
         # aborts
@@ -1403,6 +1765,18 @@ class LLMEngine:
             if s.active and s.req.request_id in self._abort:
                 self._abort.discard(s.req.request_id)
                 self._finish(i, "abort")
+
+        # per-request deadlines (skip the scan entirely until the first
+        # deadline-carrying request arrives)
+        if self._any_deadline:
+            self._expire_deadlines()
+
+        # graceful drain: past the deadline, fail whatever is left so
+        # blocked clients get a definitive 504 instead of a cut socket
+        if (self._draining and self._drain_deadline is not None
+                and time.time() >= self._drain_deadline
+                and self.has_unfinished()):
+            self._drain_expire()
 
         # starvation guard: requests queued while every slot grinds a
         # long generation eventually preempt the newest running sequence
@@ -1450,6 +1824,29 @@ class LLMEngine:
             tokens[i] = self.slots[i].last_token
         logits_dev, self.cache = self._decode(
             self.params, jnp.asarray(tokens), self.cache)
+
+        # fault injection: poison selected rows with NaN AFTER the
+        # decode — other rows' values are untouched, so healthy
+        # neighbors stay byte-identical to a fault-free run
+        bad = self.faults.poison_rows(self._step_idx, active)
+        if bad:
+            logits_dev = logits_dev.at[jnp.asarray(bad)].set(jnp.nan)
+
+        # per-slot logits health check: a NaN/Inf row fails ONE request
+        # (quarantine, structured error) while the rest of the batch
+        # keeps decoding — blast-radius isolation for numeric blowups
+        if ce.logits_health_check:
+            finite = np.asarray(self._health(logits_dev))
+            sick = [i for i in active if not bool(finite[i])]
+            if sick:
+                for i in sick:
+                    self._quarantine_slot(i, "nan_logits")
+                active = [i for i in active if i not in sick]
+            if not active:
+                self._m_steps.inc()
+                self._flight_step("decode", 0)
+                self._update_gauges()
+                return True
 
         def simple(s: _Slot) -> bool:
             # no penalty counts, no logprobs: the device sampler covers

@@ -137,6 +137,39 @@ def collect() -> dict:
             info["kv_cache_dtype"] = {
                 "value": kvd, "valid": False,
                 "choices": sorted(KV_CACHE_DTYPES)}
+
+    # fault-injection spec: a typo'd spec silently injecting nothing
+    # would make a chaos run vacuously green — fail the check instead
+    fs = os.environ.get("BIGDL_TPU_FAULT_SPEC")
+    if fs:
+        from bigdl_tpu.robustness.faults import validate_fault_spec
+
+        info["fault_spec"] = validate_fault_spec(fs)
+
+    # default per-request deadline (the engine falls back to NO deadline
+    # on a bad value; surface it here instead)
+    dl = os.environ.get("BIGDL_TPU_REQUEST_DEADLINE_MS")
+    if dl:
+        from bigdl_tpu.robustness import resolve_request_deadline_ms
+
+        try:
+            info["request_deadline_ms"] = {
+                "value": resolve_request_deadline_ms(dl), "valid": True}
+        except ValueError as e:
+            info["request_deadline_ms"] = {
+                "value": dl, "valid": False, "error": str(e)}
+
+    # graceful-drain window (engine falls back to the 30 s default)
+    dt = os.environ.get("BIGDL_TPU_DRAIN_TIMEOUT_SEC")
+    if dt:
+        from bigdl_tpu.robustness import resolve_drain_timeout_sec
+
+        try:
+            info["drain_timeout_sec"] = {
+                "value": resolve_drain_timeout_sec(dt), "valid": True}
+        except ValueError as e:
+            info["drain_timeout_sec"] = {
+                "value": dt, "valid": False, "error": str(e)}
     return info
 
 
@@ -156,6 +189,9 @@ def main() -> int:
           and info.get("recompile_warn", {}).get("valid", True)
           and info.get("hbm_budget_fraction", {}).get("valid", True)
           and info.get("memory_poll_sec", {}).get("valid", True)
+          and info.get("fault_spec", {}).get("valid", True)
+          and info.get("request_deadline_ms", {}).get("valid", True)
+          and info.get("drain_timeout_sec", {}).get("valid", True)
           and info.get("postmortem_dir", {}).get("writable", True))
     print("status :", "OK" if ok else "PROBLEMS FOUND")
     return 0 if ok else 1

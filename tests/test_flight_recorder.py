@@ -179,9 +179,17 @@ def test_step_exception_writes_postmortem(model, tmp_path, monkeypatch):
         raise RuntimeError("injected decode failure")
 
     eng._decode = raiser
-    with pytest.raises(RuntimeError, match="injected decode failure"):
-        for _ in range(16):
-            eng.step()
+    # the failure is blamed on the lone active request, which gets
+    # quarantined after its crash budget — the engine keeps running
+    # instead of propagating (blast-radius isolation; the postmortem
+    # below is the forensic record)
+    for _ in range(16):
+        eng.step()
+        if not eng.has_unfinished():
+            break
+    outs = eng.get_outputs("boom")
+    assert outs and outs[-1].finish_reason == "error"
+    assert outs[-1].error["reason"] == "crash_loop"
 
     dump = _read_single_postmortem(str(tmp_path),
                                    "engine_step_exception")

@@ -54,6 +54,17 @@ HBM_METRICS = {
     "jit_peak_temp_bytes": "lower",
 }
 
+# robustness counters pulled out of the "observability" registry
+# summary (its other series churn per run and stay skipped). Summary
+# keys carry label suffixes (`...{reason="nan_logits"}`); matching is
+# by family-name prefix. A run that starts quarantining requests or
+# retrying steps where the baseline did not IS a regression even when
+# every latency improved.
+ROBUSTNESS_COUNTERS = (
+    "bigdl_tpu_requests_quarantined_total",
+    "bigdl_tpu_step_retries_total",
+)
+
 
 def load_record(path: str) -> dict:
     """Read a BENCH json; unwrap the driver's {"parsed": ...} wrapper
@@ -99,6 +110,14 @@ def flatten_metrics(rec: dict, prefix: str = "",
             # row: a latency, keyed by its metric name
             label = rec.get("metric", "value")
             out[f"{prefix}{label}"] = (float(val), "lower")
+        elif key == "observability" and isinstance(val, dict):
+            # only the robustness counters: the full summary (latency
+            # histograms, per-phase gauges) churns per environment
+            for mk, mv in val.items():
+                if mk.startswith(ROBUSTNESS_COUNTERS) \
+                        and isinstance(mv, (int, float)) \
+                        and not isinstance(mv, bool):
+                    out[f"{name}.{mk}"] = (float(mv), "lower")
         elif key == "memory" and isinstance(val, dict):
             # only the headline scalars: the snapshot's nested static/
             # device/headroom dicts churn per environment
