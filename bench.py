@@ -701,6 +701,7 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
         if cached is not None:
             cached["failed_live_run"] = record
             print(json.dumps(cached))
+            _failed_lane_exit(ab_results)
             raise SystemExit(0)
         print(json.dumps(record))
         raise SystemExit(1)
@@ -728,6 +729,20 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
     record.update(_efficiency(LLAMA2_7B, ok[best]["weight_bytes"],
                               PROMPT_LEN, DECODE_STEPS, first_ms, next_ms))
     print(json.dumps(record))
+    _failed_lane_exit(ab_results)
+
+
+def _failed_lane_exit(ab_results: dict) -> None:
+    """Lane-failure summary AFTER the record is printed: the sweep
+    continues past an erroring lane (each records ``{"error": ...}``),
+    but the run's exit code must still say some lanes have no numbers.
+    Consumers read the stdout record either way; exit 2 distinguishes
+    partial-lane failure from total failure (exit 1)."""
+    failed = sorted(k for k, v in ab_results.items() if "error" in v)
+    if failed:
+        print(f"bench: {len(failed)} lane(s) failed: "
+              f"{', '.join(failed)}", file=sys.stderr)
+        raise SystemExit(2)
 
 
 def _efficiency(cfg, weight_bytes: int, prompt_len: int, steps: int,

@@ -24,11 +24,122 @@ import sys
 import time
 
 
+def _parse_replicas(argv: "list[str]") -> "int | None":
+    """``--replicas N`` -> replica count for the router lane."""
+    for i, a in enumerate(argv):
+        if a == "--replicas" and i + 1 < len(argv):
+            return int(argv[i + 1])
+        if a.startswith("--replicas="):
+            return int(a.split("=", 1)[1])
+    return None
+
+
+def run_router_bench(n_replicas: int, n_requests: int = 16,
+                     new_tokens: int = 8, prompt_len: int = 12) -> dict:
+    """Drive a threaded completion wave through the multi-replica
+    router (tiny-random CPU replicas, byte-identical weights) and
+    report aggregate throughput plus the router's own stats block
+    (failovers / replays / breaker trips — the counters bench_diff
+    gates lower-is-better). ``$BIGDL_TPU_FAULT_SPEC`` inherits into
+    the replicas, so a chaos run is the same command plus the spec."""
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from bigdl_tpu.serving.router import Router, RouterConfig
+
+    cmd = [sys.executable, "-m", "bigdl_tpu.serving.api_server",
+           "--tiny-random", "--host", "127.0.0.1", "--port", "{port}",
+           "--max-batch", "4", "--max-seq", "64"]
+    # replicas on CPU always: the router lane measures the tier, not
+    # the chip, and N processes grabbing an exclusive-access TPU would
+    # starve each other
+    router = Router(replica_cmd=cmd,
+                    config=RouterConfig(replicas=n_replicas,
+                                        health_sec=0.25),
+                    spawn_env={"JAX_PLATFORMS": "cpu"})
+    router.start()
+    httpd = router.serve(port=0, background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 250, prompt_len).tolist()
+               for _ in range(n_requests)]
+    results: list = []
+    lock = threading.Lock()
+
+    def one(i: int) -> None:
+        body = json.dumps({"prompt": prompts[i],
+                           "max_tokens": new_tokens}).encode()
+        try:
+            req = urllib.request.Request(
+                base + "/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                doc = json.loads(resp.read())
+            toks = doc.get("usage", {}).get("completion_tokens", 0)
+            with lock:
+                results.append(("ok", toks))
+        except Exception as e:
+            with lock:
+                results.append(("error", f"{type(e).__name__}: {e}"))
+
+    try:
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_requests)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        with urllib.request.urlopen(base + "/v1/router/stats",
+                                    timeout=10) as resp:
+            stats = json.loads(resp.read())
+    finally:
+        httpd.shutdown()
+        router.shutdown()
+    done = sum(1 for s, _ in results if s == "ok")
+    generated = sum(t for s, t in results if s == "ok")
+    return {
+        "replicas": n_replicas,
+        "n_requests": n_requests,
+        "completed": int(done),
+        "generated_tokens": int(generated),
+        "wall_s": round(wall, 2),
+        "tokens_per_s": round(generated / max(wall, 1e-9), 1),
+        "errors": [m for s, m in results if s == "error"][:5],
+        # GET /v1/router/stats embedded like the engine's memory /
+        # compile blocks: per-replica state + failover/replay/breaker
+        # counters ride along in the bench JSON
+        "router": stats,
+    }
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from bench import _parse_kv_sweep, _probe_backend, chip_peaks
 
     kv_sweep = _parse_kv_sweep(sys.argv[1:])
+    replicas = _parse_replicas(sys.argv[1:])
+    failed_lanes: "list[str]" = []
+
+    def finish(out: dict) -> None:
+        """Every exit path: run the router lane (when asked), emit the
+        record, and exit nonzero listing failed lanes — one erroring
+        lane records ``{"error": ...}``, the sweep continues."""
+        if replicas:
+            try:
+                out["router_bench"] = run_router_bench(replicas)
+            except Exception as e:
+                failed_lanes.append("router")
+                out["router_bench"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(out))
+        if failed_lanes:
+            print(f"bench_serving: {len(failed_lanes)} lane(s) failed: "
+                  f"{', '.join(failed_lanes)}", file=sys.stderr)
+            raise SystemExit(1)
 
     backend = _probe_backend()
     if backend is None:
@@ -127,7 +238,19 @@ def main() -> None:
         wall = time.perf_counter() - t0
         return generated / wall, done, generated, wall, n_req
 
-    tput, done, generated, wall, n_requests = run_wave(batch)
+    try:
+        tput, done, generated, wall, n_requests = run_wave(batch)
+    except Exception as e:
+        failed_lanes.append(f"serving-batch{batch}")
+        return finish({
+            "metric": ("llama2_7b_int4_serving_tokens_per_s" if on_tpu
+                       else "cpu_fallback_smoke_serving_tokens_per_s"),
+            "value": None, "unit": "tokens/s", "valid": False,
+            "batch": batch, "backend": backend,
+            "model": "llama2-7b" if on_tpu
+                     else "tiny-llama(cpu-fallback)",
+            "qtype": "sym_int4",
+            "error": f"{type(e).__name__}: {e}"})
 
     peak_tflops, peak_gbps = chip_peaks()
     ceiling = batch / (weight_bytes / (peak_gbps * 1e9))
@@ -175,18 +298,25 @@ def main() -> None:
 
         out["kv_sweep"] = {}
         for d in kv_sweep:
-            t_, d_, g_, w_, n_ = run_wave(batch, d)
-            out["kv_sweep"][d] = {
-                "tokens_per_s": round(t_, 1),
-                "tpot_ms": round(1000.0 * batch / max(t_, 1e-9), 3),
-                "completed": int(d_),
-                "n_requests": n_,
-                "kv_cache_bytes": kv_cache_bytes(jax.eval_shape(
-                    lambda d=d: init_cache(
-                        cfg.num_hidden_layers, batch, max_seq,
-                        cfg.num_key_value_heads, cfg.hd,
-                        kv_cache_dtype=d, per_slot_pos=True))),
-            }
+            try:
+                t_, d_, g_, w_, n_ = run_wave(batch, d)
+                out["kv_sweep"][d] = {
+                    "tokens_per_s": round(t_, 1),
+                    "tpot_ms": round(1000.0 * batch / max(t_, 1e-9), 3),
+                    "completed": int(d_),
+                    "n_requests": n_,
+                    "kv_cache_bytes": kv_cache_bytes(jax.eval_shape(
+                        lambda d=d: init_cache(
+                            cfg.num_hidden_layers, batch, max_seq,
+                            cfg.num_key_value_heads, cfg.hd,
+                            kv_cache_dtype=d, per_slot_pos=True))),
+                }
+            except Exception as e:
+                # one erroring dtype lane must not cost the others'
+                # already-measured rows
+                failed_lanes.append(f"kv-{d}")
+                out["kv_sweep"][d] = {
+                    "error": f"{type(e).__name__}: {e}"}
     if poisoned:
         out["note"] = ("throughput beat the HBM ceiling — runtime did "
                        "not execute (poisoned buffers)")
@@ -195,8 +325,7 @@ def main() -> None:
                        "requests complete — run was real but too slow "
                        "(or the tunnel wedged mid-run)")
     if poisoned or timed_out or not on_tpu:
-        print(json.dumps(out))
-        return
+        return finish(out)
 
     # the batch-8 record is already measured — put it on disk BEFORE the
     # batch-16 wave (a tunnel wedge mid-wave must not cost it); consumers
@@ -206,15 +335,20 @@ def main() -> None:
     # batch-16 wave (VERDICT r4 #4 asks 8 AND 16): decode still reads
     # the weights once per step, so throughput should climb toward 2x —
     # KV at 16 x 512 x 0.5 MB/tok = 4 GB still fits
-    t16, d16, g16, w16, n16 = run_wave(16)
-    c16 = ceiling / batch * 16
-    out["batch16"] = {
-        "tokens_per_s": round(t16, 1), "completed": int(d16),
-        "generated_tokens": int(g16), "wall_s": round(w16, 2),
-        "n_requests": n16, "tokens_per_s_ceiling": round(c16, 1),
-        "valid": bool(d16 == n16 and t16 <= c16 / 0.8),
-    }
-    print(json.dumps(out))
+    try:
+        t16, d16, g16, w16, n16 = run_wave(16)
+        c16 = ceiling / batch * 16
+        out["batch16"] = {
+            "tokens_per_s": round(t16, 1), "completed": int(d16),
+            "generated_tokens": int(g16), "wall_s": round(w16, 2),
+            "n_requests": n16, "tokens_per_s_ceiling": round(c16, 1),
+            "valid": bool(d16 == n16 and t16 <= c16 / 0.8),
+        }
+    except Exception as e:
+        # the batch-8 record above is already on disk; keep it
+        failed_lanes.append("serving-batch16")
+        out["batch16"] = {"error": f"{type(e).__name__}: {e}"}
+    finish(out)
 
 
 if __name__ == "__main__":

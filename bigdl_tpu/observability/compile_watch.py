@@ -66,6 +66,40 @@ def memory_capture_enabled() -> bool:
 _lock = threading.Lock()
 _table: Dict[str, Dict[str, Any]] = {}
 
+# first-call-for-a-signature compiles currently executing, process-wide.
+# A compile blocks the engine's step loop for seconds-to-minutes (real
+# TPU lowerings far exceed any sane wedge threshold), during which the
+# step heartbeat goes stale exactly like a genuine hang — liveness
+# checks (api_server /health) consult this to tell the two apart.
+_inflight_lock = threading.Lock()
+_compiles_inflight = 0
+
+
+def compiles_in_progress() -> int:
+    """Number of tracked first-call compiles executing right now.
+    Nonzero means a stale step heartbeat is the compiler working, not a
+    wedged replica. (A compile that itself hangs forever is reported as
+    'busy' rather than 'wedged' — the supervisor's spawn timeout is the
+    backstop for that.)"""
+    with _inflight_lock:
+        return _compiles_inflight
+
+
+class _CompileInFlight:
+    """Context manager bracketing one first-call compile."""
+
+    def __enter__(self):
+        global _compiles_inflight
+        with _inflight_lock:
+            _compiles_inflight += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _compiles_inflight
+        with _inflight_lock:
+            _compiles_inflight -= 1
+        return False
+
 
 def resolve_recompile_threshold(value: Optional[object] = None) -> int:
     """The recompile-storm warning threshold: explicit value, else
@@ -203,12 +237,17 @@ class TrackedJit:
         # placeholders must be built BEFORE the call: donate_argnums
         # deletes input buffers during it
         placeholders = self._placeholders(args, kwargs)
-        t0 = time.perf_counter()
-        out = self._jitted(*args, **kwargs)
-        dt = time.perf_counter() - t0
-        with self._seen_lock:
-            self._seen.add(sig)
-        self._record_compile(sig, dt, self._memory_analysis(placeholders))
+        with _CompileInFlight():
+            t0 = time.perf_counter()
+            out = self._jitted(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            with self._seen_lock:
+                self._seen.add(sig)
+            # the AOT memory_analysis below compiles the signature a
+            # second time — keep it inside the in-flight bracket so the
+            # heartbeat stays excused for its duration too
+            self._record_compile(sig, dt,
+                                 self._memory_analysis(placeholders))
         return out
 
     def __getattr__(self, item):

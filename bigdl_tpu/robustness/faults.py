@@ -27,6 +27,18 @@ Kinds and the injection points they attach to:
   active slot).
 - ``slow_step``       — sleep ``ms=`` milliseconds at the step point;
   exercises deadline enforcement without a slow model.
+- ``replica_crash``   — hard-kill THIS PROCESS (``os._exit``, default
+  code 137 — indistinguishable from an external ``kill -9``) at the
+  step point. The engine only consults this kind on steps with live
+  work, so the crash lands MID-REQUEST (``every=N`` counts busy steps).
+  The recovery path under test lives one level up: the serving
+  router's supervisor, failover, and request replay
+  (serving/router.py). ``code=`` overrides the exit code.
+- ``replica_hang``    — freeze the engine's step loop at the step
+  point (sleep ``ms=`` milliseconds, or forever when unset). The
+  process stays alive and its HTTP threads keep answering, so this
+  exercises wedge detection (`/health` heartbeat) and the router's
+  hang-kill-restart path rather than crash handling.
 
 Trigger params (every kind):
 
@@ -39,8 +51,10 @@ Trigger params (every kind):
   ``at_step``, unlimited otherwise; ``times=0`` means unlimited).
 - ``seed=S``        — seed for this clause's RNG (default 0): two runs
   with the same spec inject the identical fault sequence.
-- ``ms=M``          — sleep milliseconds (``slow_step`` only).
+- ``ms=M``          — sleep milliseconds (``slow_step``; for
+  ``replica_hang`` a bounded freeze instead of forever).
 - ``slot=i``        — target row (``nan_logits`` only).
+- ``code=C``        — process exit code (``replica_crash`` only).
 
 Example: ``step_exception@p=0.05,seed=7;slow_step@ms=500,every=10``.
 """
@@ -49,6 +63,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -56,7 +71,11 @@ import numpy as np
 FAULT_SPEC_ENV = "BIGDL_TPU_FAULT_SPEC"
 
 KINDS = ("step_exception", "admit_exception", "prefill_exception",
-         "nan_logits", "slow_step")
+         "nan_logits", "slow_step", "replica_crash", "replica_hang")
+
+#: default exit code for replica_crash — what an external ``kill -9``
+#: surfaces as through the shell (128 + SIGKILL)
+CRASH_EXIT_CODE = 137
 
 # injection point -> exception kinds that fire there
 _RAISE_POINTS = {
@@ -65,7 +84,8 @@ _RAISE_POINTS = {
     "prefill": "prefill_exception",
 }
 
-_INT_PARAMS = ("after_step", "at_step", "every", "times", "seed", "slot")
+_INT_PARAMS = ("after_step", "at_step", "every", "times", "seed", "slot",
+               "code")
 _FLOAT_PARAMS = ("p", "ms")
 
 
@@ -93,6 +113,7 @@ class FaultClause:
     seed: int = 0
     ms: float = 0.0
     slot: Optional[int] = None
+    code: Optional[int] = None        # replica_crash exit code
     # runtime state
     fired: int = 0
     visits: int = 0
@@ -222,6 +243,30 @@ class FaultInjector:
             if c.should_fire(step):
                 self._fired(kind, point, step)
                 raise InjectedFault(kind, point, step)
+
+    def process_point(self, point: str, step: int) -> None:
+        """Process-granularity faults for the multi-replica chaos
+        harness (serving/router.py). A firing ``replica_crash`` clause
+        hard-kills this process with ``os._exit`` (no atexit, no flush
+        — the same hole an OOM-kill or ``kill -9`` leaves); a firing
+        ``replica_hang`` clause blocks this thread for ``ms``
+        milliseconds (forever when unset), freezing the engine's step
+        loop while the process stays alive. Engine calls this at the
+        step point only."""
+        if not self.clauses or point != "step":
+            return
+        for c in self._by_kind.get("replica_crash", ()):
+            if c.should_fire(step):
+                self._fired("replica_crash", point, step)
+                os._exit(c.code if c.code is not None else CRASH_EXIT_CODE)
+        for c in self._by_kind.get("replica_hang", ()):
+            if c.should_fire(step):
+                self._fired("replica_hang", point, step)
+                if c.ms > 0:
+                    time.sleep(c.ms / 1000.0)
+                else:
+                    while True:       # until the supervisor kills us
+                        time.sleep(60.0)
 
     def sleep_ms(self, point: str, step: int) -> float:
         """Milliseconds the caller should sleep at this point (0 when

@@ -6,3 +6,7 @@ from bigdl_tpu.serving.engine import (  # noqa: F401
     RequestOutput,
     SamplingParams,
 )
+from bigdl_tpu.serving.router import (  # noqa: F401
+    Router,
+    RouterConfig,
+)

@@ -44,6 +44,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional
 
+from bigdl_tpu.observability.compile_watch import compiles_in_progress
 from bigdl_tpu.serving.engine import (EngineDraining, LLMEngine,
                                       SamplingParams)
 
@@ -167,10 +168,24 @@ class _IncrementalDetok:
 class OpenAIServer:
     def __init__(self, engine: LLMEngine, tokenizer=None,
                  model_name: str = "bigdl-tpu-model",
-                 embedder=None, embedder_tokenizer=None):
+                 embedder=None, embedder_tokenizer=None,
+                 wedge_sec: float = 10.0):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
+        # /health liveness: with unfinished work and no step() entered
+        # for this long, the step loop is wedged (hung transfer,
+        # replica_hang fault) — report 503 so a supervisor (the
+        # serving router, k8s) kills and replaces this replica instead
+        # of routing into a black hole
+        self.wedge_sec = wedge_sec
+        # client-disconnect cancellations by path: the streaming leg
+        # learns about a dead client from its SSE write failing, the
+        # non-streaming leg from the MSG_PEEK poll
+        self._cancelled = engine.registry.counter(
+            "bigdl_tpu_requests_cancelled_total",
+            "requests aborted because the client disconnected",
+            ["path"])
         # optional /v1/embeddings backend: a BertEmbedder (transformers/
         # embedder.py) served next to the LLM — the reference serves
         # embeddings through its langchain wrapper and FastChat worker;
@@ -224,7 +239,8 @@ class OpenAIServer:
         )
 
     def _run_request(self, token_ids, params, stream_cb=None,
-                     stop_strs=(), disconnect_check=None):
+                     stop_strs=(), disconnect_check=None,
+                     cancel_cb=None):
         """Returns (rid, {index: ids}, {index: logprob entries},
         {index: finish_reason}, {index: final text}, {index: error}).
 
@@ -235,10 +251,12 @@ class OpenAIServer:
         sequences (reference vllm SamplingParams.stop): output truncates
         at the first match; a single-choice request aborts early.
 
-        `disconnect_check()` (non-streaming path) is polled while
-        waiting; when it reports the client gone the request is aborted
-        — the engine frees the slot AND drops the prompt's prefix-cache
-        entry, so a hung-up client stops costing HBM immediately."""
+        `disconnect_check()` is polled while waiting (both paths); when
+        it reports the client gone — or a streaming SSE write fails —
+        the request is aborted: the engine frees the slot AND drops the
+        prompt's prefix-cache entry, so a hung-up client stops costing
+        HBM immediately. `cancel_cb()` fires exactly once on such a
+        client-driven cancellation (the counter hook)."""
         rid = f"cmpl-{uuid.uuid4().hex[:16]}"
         self.engine.add_request(rid, token_ids, params)
         self.loop.notify()
@@ -257,6 +275,16 @@ class OpenAIServer:
         # per choice (O(n) total, append-only deltas); plain stop-free
         # requests decode once at the end
         live_decode = bool(stop_strs) or stream_cb is not None
+        cancelled = [False]          # cancel_cb fired (at most once)
+
+        def cancel_once():
+            if not cancelled[0]:
+                cancelled[0] = True
+                if cancel_cb is not None:
+                    try:
+                        cancel_cb()
+                    except Exception:
+                        pass         # accounting must not alter the abort
 
         def emit(idx, upto):
             nonlocal stream_cb
@@ -270,9 +298,12 @@ class OpenAIServer:
                     stream_cb(full[start:upto], idx)
                     emitted[idx] = upto
                 except OSError:
-                    # client went away: free the slot, then keep
-                    # draining until the engine emits the abort-finish
-                    # (reference api_server.py:371 disconnect -> abort)
+                    # client went away mid-stream: free the slot (the
+                    # abort also drops the prompt's prefix-cache
+                    # entry), then keep draining until the engine
+                    # emits the abort-finish (reference
+                    # api_server.py:371 disconnect -> abort)
+                    cancel_once()
                     self.engine.abort_request(rid)
                     self.loop.notify()
                     stream_cb = None
@@ -330,6 +361,7 @@ class OpenAIServer:
                     # client hung up mid-generation: cancel, then keep
                     # draining until the engine emits the abort-finish
                     aborted = True
+                    cancel_once()
                     self.engine.abort_request(rid)
                     self.loop.notify()
             outs = self.engine.get_outputs(rid)
@@ -419,9 +451,29 @@ class OpenAIServer:
                         {"id": server.model_name, "object": "model"}]})
                 elif self.path in ("/health", "/ping"):
                     # a draining replica reports 503 so load balancers
-                    # stop routing to it while in-flight work finishes
+                    # stop routing to it while in-flight work finishes;
+                    # a WEDGED one (work pending, step loop frozen)
+                    # reports 503 so a supervisor replaces it — the
+                    # process answering HTTP proves nothing about the
+                    # engine thread. A stale heartbeat during a jit
+                    # compile is the compiler working (first call per
+                    # shape bucket legitimately blocks step() for
+                    # seconds-to-minutes), not a hang — report busy,
+                    # not wedged, or every cold replica gets killed
+                    # mid-compile by its supervisor.
+                    age = server.engine.step_heartbeat_age()
                     if server.engine.draining:
                         self._json(503, {"status": "draining"})
+                    elif server.engine.has_unfinished() \
+                            and age > server.wedge_sec:
+                        if compiles_in_progress():
+                            self._json(200, {"status": "compiling",
+                                             "heartbeat_age_sec":
+                                             round(age, 3)})
+                        else:
+                            self._json(503, {"status": "wedged",
+                                             "heartbeat_age_sec":
+                                             round(age, 3)})
                     else:
                         self._json(200, {"status": "ok"})
                 elif self.path == "/metrics":
@@ -565,17 +617,26 @@ class OpenAIServer:
                         self.wfile.flush()
 
                     rid, out_ids, out_lps, reasons, _, _ = \
-                        server._run_request(ids, params, stream_cb=cb,
-                                            stop_strs=stops)
-                    self.wfile.write(b"data: [DONE]\n\n")
-                    self.wfile.flush()
+                        server._run_request(
+                            ids, params, stream_cb=cb, stop_strs=stops,
+                            disconnect_check=lambda:
+                                _socket_disconnected(self.connection),
+                            cancel_cb=lambda: server._cancelled.labels(
+                                "stream").inc())
+                    try:
+                        self.wfile.write(b"data: [DONE]\n\n")
+                        self.wfile.flush()
+                    except OSError:
+                        pass    # client left after the last delta
                     return
 
                 rid, out_ids, out_lps, reasons, texts, errors = \
                     server._run_request(
                         ids, params, stop_strs=stops,
                         disconnect_check=lambda: _socket_disconnected(
-                            self.connection))
+                            self.connection),
+                        cancel_cb=lambda: server._cancelled.labels(
+                            "nonstream").inc())
                 # robustness status mapping: a request that ran out of
                 # time (its own deadline, or the drain window closing on
                 # it) is a gateway timeout; a quarantined request is a
@@ -667,13 +728,17 @@ class OpenAIServer:
 
 
 def main():
-    """CLI: python -m bigdl_tpu.serving.api_server --model PATH [...]"""
+    """CLI: python -m bigdl_tpu.serving.api_server --model PATH [...]
+
+    ``--tiny-random`` swaps the checkpoint for a seeded tiny random
+    llama (utils/testing.tiny_random_model) — the replica mode the
+    serving router's chaos tests and CPU bench lanes spawn: identical
+    seeds give byte-identical weights across replicas, so a replayed
+    greedy request must reproduce a dead replica's answer exactly."""
     import argparse
 
-    from bigdl_tpu.transformers.model import AutoModelForCausalLM
-
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", required=True)
+    ap.add_argument("--model", default=None)
     ap.add_argument("--load-in-low-bit", default="sym_int4")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8000)
@@ -681,18 +746,37 @@ def main():
     ap.add_argument("--max-seq", type=int, default=2048)
     ap.add_argument("--embedder", default=None,
                     help="BERT checkpoint for /v1/embeddings")
+    ap.add_argument("--tiny-random", action="store_true",
+                    help="serve a seeded tiny random model instead of "
+                         "a checkpoint (router tests / CPU bench)")
+    ap.add_argument("--tiny-seed", type=int, default=0)
+    ap.add_argument("--wedge-sec", type=float, default=10.0,
+                    help="/health reports wedged past this step-loop "
+                         "heartbeat age with work pending")
     args = ap.parse_args()
 
-    model = AutoModelForCausalLM.from_pretrained(
-        args.model, load_in_low_bit=args.load_in_low_bit,
-        max_seq=args.max_seq)
     tokenizer = None
-    try:
-        from transformers import AutoTokenizer
+    if args.tiny_random:
+        from bigdl_tpu.utils.testing import tiny_random_model
 
-        tokenizer = AutoTokenizer.from_pretrained(args.model)
-    except Exception:
-        pass
+        model = tiny_random_model(seed=args.tiny_seed)
+        # the synthetic config's rope table caps the usable context
+        args.max_seq = min(args.max_seq,
+                           model.config.max_position_embeddings)
+    else:
+        if not args.model:
+            ap.error("--model is required (or pass --tiny-random)")
+        from bigdl_tpu.transformers.model import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(
+            args.model, load_in_low_bit=args.load_in_low_bit,
+            max_seq=args.max_seq)
+        try:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(args.model)
+        except Exception:
+            pass
 
     from bigdl_tpu.serving.engine import EngineConfig
 
@@ -707,7 +791,8 @@ def main():
         embedder = BertEmbedder.from_pretrained(args.embedder)
         embedder_tok = AutoTokenizer.from_pretrained(args.embedder)
     server = OpenAIServer(engine, tokenizer, embedder=embedder,
-                          embedder_tokenizer=embedder_tok)
+                          embedder_tokenizer=embedder_tok,
+                          wedge_sec=args.wedge_sec)
 
     # SIGTERM (a deploy's kill) drains instead of dying: stop admitting
     # (503 + Retry-After), finish in-flight work up to

@@ -40,7 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.observability.compile_watch import tracked_jit
+from bigdl_tpu.observability.compile_watch import (compiles_in_progress,
+                                                   tracked_jit)
 from bigdl_tpu.observability.flight import (FlightRecorder, build_postmortem,
                                             exception_fields)
 from bigdl_tpu.observability.flight import write_postmortem as \
@@ -354,6 +355,7 @@ class LLMEngine:
         self._fanouts: Dict[str, _Fanout] = {}
         self._stall_steps = 0       # consecutive steps with starved queue
         self._step_idx = 0          # lifetime step() counter
+        self._last_step_ts = time.monotonic()   # step-loop heartbeat
 
         # observability backbone, created BEFORE the jit definitions so
         # tracked_jit can mirror compile metrics into the engine's
@@ -725,6 +727,15 @@ class LLMEngine:
                     self._abort.add(f"{request_id}#{i}")
             return
         self._abort.add(request_id)
+
+    def step_heartbeat_age(self) -> float:
+        """Seconds since the last step() entered. The driving loop
+        calls step() continuously (even idle), so a large age with
+        unfinished work means the step loop is WEDGED — a hung device
+        transfer, a replica_hang fault — while the process (and its
+        HTTP threads) look alive. `/health` turns this into a 503 so a
+        supervisor can kill and replace the replica."""
+        return time.monotonic() - self._last_step_ts
 
     def has_unfinished(self) -> bool:
         return (len(self.waiting) > 0 or self._admitting is not None
@@ -1269,6 +1280,9 @@ class LLMEngine:
             "compile_table": compile_table(),
             "memory": self.memory_snapshot(),
             "robustness": {
+                "step_heartbeat_age_sec": round(
+                    self.step_heartbeat_age(), 3),
+                "compiles_in_progress": compiles_in_progress(),
                 "draining": self._draining,
                 "drain_deadline": self._drain_deadline,
                 "faults_enabled": self.faults.enabled,
@@ -1748,8 +1762,17 @@ class LLMEngine:
         quarantine the culprit request, and only budget exhaustion
         with no one to blame propagates out of step()."""
         self._step_idx += 1
+        # liveness heartbeat, stamped BEFORE the fault hooks: a step
+        # that hangs (replica_hang, a wedged tunnel) leaves this stale,
+        # which is what the API server's /health wedge check reads
+        self._last_step_ts = time.monotonic()
         try:
             self.faults.raise_point("step", self._step_idx)
+            if self.has_unfinished():
+                # process-granularity faults (replica_crash/_hang) only
+                # fire on steps with live work: the chaos harness wants
+                # a replica dying MID-REQUEST, not on an idle spin
+                self.faults.process_point("step", self._step_idx)
             ms = self.faults.sleep_ms("step", self._step_idx)
             if ms > 0:
                 time.sleep(ms / 1000.0)

@@ -1,0 +1,1235 @@
+"""Multi-replica serving tier: a thin HTTP front over N supervised
+engine replicas.
+
+PR 5 made a single engine survive bad requests (quarantine, deadlines,
+drain); this module moves one failure domain up and makes the SERVICE
+survive a bad engine *process*. The reference's L6 serving layer (vLLM
+behind FastChat workers) leaves replication and failover to an external
+orchestrator; for a TPU-native stack serving heavy traffic we build
+that tier in-tree, on the drain/health/flight-recorder substrate the
+engine already provides:
+
+- **Replica supervisor** — spawns N ``api_server`` subprocesses, probes
+  ``/health`` every ``$BIGDL_TPU_ROUTER_HEALTH_SEC``, restarts crashed
+  replicas with exponential backoff, SIGKILLs hung ones (a live process
+  whose ``/health`` stops answering — or answers "wedged" off the
+  engine's step-loop heartbeat), and quarantines a replica that flaps
+  past ``$BIGDL_TPU_ROUTER_CRASH_BUDGET`` deaths inside the crash
+  window (the replica-granularity mirror of PR 5's per-request blame).
+- **Write-ahead request journal** — every admitted request is recorded
+  (raw body: prompt + sampling params, plus the assigned replica)
+  BEFORE the first byte is forwarded. When a replica dies mid-flight
+  its non-streaming requests are transparently REPLAYED on a healthy
+  replica (byte-identical for greedy sampling, since replicas share
+  weights); streaming requests get a structured SSE error event with a
+  ``retry_after`` hint instead of a dropped socket.
+- **Per-replica circuit breakers** — consecutive transport failures
+  trip the breaker (routing skips the replica), a cooldown later it
+  half-opens (one trial request), success closes it. Plus one optional
+  HEDGED retry (``$BIGDL_TPU_ROUTER_HEDGE_MS``): a non-streaming
+  request with no response past the hedge latency fires one duplicate
+  on a second replica and the first answer wins — the loser's
+  connection close triggers the engine's client-disconnect abort, so
+  the wasted work frees its slot immediately.
+- **Rolling restart** — ``POST /v1/admin/rolling_restart`` drains
+  replicas one at a time through PR 5's SIGTERM drain (in-flight work
+  finishes, new work is re-routed; a request that races the drain gets
+  the replica's 503 and is transparently re-routed), then respawns and
+  waits healthy before moving on: a config/weight rollout drops zero
+  requests and serves zero 5xx.
+- **Prefix-affinity routing** — consistent hash over the prompt prefix
+  so shared-system-prompt traffic lands where its prefix-cache entry
+  already lives, falling back to least-loaded (live ``/v1/stats``
+  occupancy) when the affinity target is down, tripped, or full.
+
+Observability: ``bigdl_tpu_router_*`` metric families (per-replica
+state gauge, failover/replay/hedge/breaker-trip/restart counters,
+routed-request latency histogram), router events in a flight recorder,
+and ``GET /v1/router/stats`` — the JSON snapshot bench embeds.
+
+Run: ``python -m bigdl_tpu.serving.router --model PATH --replicas 2``
+(or ``--tiny-random`` for the checkpoint-free chaos/bench mode).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import http.client
+import json
+import os
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from bigdl_tpu.observability.flight import FlightRecorder
+from bigdl_tpu.observability.metrics import MetricsRegistry
+
+ROUTER_HEALTH_ENV = "BIGDL_TPU_ROUTER_HEALTH_SEC"
+ROUTER_REPLICAS_ENV = "BIGDL_TPU_ROUTER_REPLICAS"
+ROUTER_HEDGE_ENV = "BIGDL_TPU_ROUTER_HEDGE_MS"
+ROUTER_CRASH_BUDGET_ENV = "BIGDL_TPU_ROUTER_CRASH_BUDGET"
+
+# replica lifecycle states -> bigdl_tpu_router_replica_state gauge codes
+STARTING = "starting"
+HEALTHY = "healthy"
+UNHEALTHY = "unhealthy"
+DRAINING = "draining"
+BACKOFF = "backoff"
+QUARANTINED = "quarantined"
+STATE_CODES = {STARTING: 0, HEALTHY: 1, UNHEALTHY: 2, DRAINING: 3,
+               BACKOFF: 4, QUARANTINED: 5}
+
+
+def resolve_router_health_sec(value: Optional[str] = None) -> float:
+    """Health-probe interval in seconds (default 1.0). Raises
+    ``ValueError`` on a non-positive or non-numeric value — env_check
+    surfaces it; the router falls back to the default."""
+    raw = value if value is not None else os.environ.get(
+        ROUTER_HEALTH_ENV, "")
+    if not raw:
+        return 1.0
+    sec = float(raw)                   # ValueError propagates
+    if sec <= 0:
+        raise ValueError(
+            f"{ROUTER_HEALTH_ENV} must be positive, got {raw!r}")
+    return sec
+
+
+def resolve_router_replicas(value: Optional[str] = None) -> int:
+    """Replica count (default 2, must be >= 1)."""
+    raw = value if value is not None else os.environ.get(
+        ROUTER_REPLICAS_ENV, "")
+    if not raw:
+        return 2
+    n = int(raw)                       # ValueError propagates
+    if n < 1:
+        raise ValueError(
+            f"{ROUTER_REPLICAS_ENV} must be >= 1, got {raw!r}")
+    return n
+
+
+def resolve_router_hedge_ms(value: Optional[str] = None) -> float:
+    """Hedged-retry latency threshold in ms (default 0 = hedging off)."""
+    raw = value if value is not None else os.environ.get(
+        ROUTER_HEDGE_ENV, "")
+    if not raw:
+        return 0.0
+    ms = float(raw)                    # ValueError propagates
+    if ms < 0:
+        raise ValueError(
+            f"{ROUTER_HEDGE_ENV} must be >= 0 (0 disables), got {raw!r}")
+    return ms
+
+
+def resolve_router_crash_budget(value: Optional[str] = None) -> int:
+    """Deaths inside the crash window before a replica is quarantined
+    (default 3, must be >= 1)."""
+    raw = value if value is not None else os.environ.get(
+        ROUTER_CRASH_BUDGET_ENV, "")
+    if not raw:
+        return 3
+    n = int(raw)                       # ValueError propagates
+    if n < 1:
+        raise ValueError(
+            f"{ROUTER_CRASH_BUDGET_ENV} must be >= 1, got {raw!r}")
+    return n
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """Knobs for the serving tier. ``None`` fields defer to their env
+    variables (resolver fallbacks apply on bad values via env_check)."""
+    replicas: Optional[int] = None          # $BIGDL_TPU_ROUTER_REPLICAS
+    health_sec: Optional[float] = None      # $BIGDL_TPU_ROUTER_HEALTH_SEC
+    hedge_ms: Optional[float] = None        # $BIGDL_TPU_ROUTER_HEDGE_MS
+    crash_budget: Optional[int] = None      # $BIGDL_TPU_ROUTER_CRASH_BUDGET
+    health_timeout_sec: float = 2.0    # per-probe HTTP timeout
+    unhealthy_after: int = 3           # probe failures before hang-kill
+    crash_window_sec: float = 60.0     # deaths inside count to the budget
+    backoff_base_sec: float = 0.25     # restart backoff: base * 2^deaths
+    backoff_max_sec: float = 30.0
+    breaker_threshold: int = 3         # consecutive failures to trip
+    breaker_cooldown_sec: float = 2.0  # open -> half-open delay
+    affinity_tokens: int = 32          # prompt prefix hashed for affinity
+    max_replays: int = 2               # failover replays per request
+    connect_timeout_sec: float = 5.0
+    forward_timeout_sec: float = 600.0  # backstop; deaths close the socket
+    spawn_timeout_sec: float = 180.0   # replica boot -> healthy
+    drain_exit_timeout_sec: float = 60.0  # SIGTERM -> exit before SIGKILL
+    # how long a request WAITS for a routable replica before 503ing:
+    # losing the last healthy replica usually means its replacement is
+    # seconds away (backoff + respawn), and giving up instantly would
+    # drop exactly the requests the replay journal exists to save
+    no_replica_wait_sec: float = 30.0
+
+    def resolve(self) -> "RouterConfig":
+        out = dataclasses.replace(self)
+        if out.replicas is None:
+            try:
+                out.replicas = resolve_router_replicas()
+            except ValueError:
+                out.replicas = 2          # env_check reports it
+        if out.health_sec is None:
+            try:
+                out.health_sec = resolve_router_health_sec()
+            except ValueError:
+                out.health_sec = 1.0
+        if out.hedge_ms is None:
+            try:
+                out.hedge_ms = resolve_router_hedge_ms()
+            except ValueError:
+                out.hedge_ms = 0.0
+        if out.crash_budget is None:
+            try:
+                out.crash_budget = resolve_router_crash_budget()
+            except ValueError:
+                out.crash_budget = 3
+        return out
+
+
+class ReplicaLost(RuntimeError):
+    """The replica's connection failed mid-request (death, hang-kill,
+    connection refused). The failover/replay path catches this."""
+
+
+class NoReplica(RuntimeError):
+    """No routable replica (all down, draining, or breaker-open)."""
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """One admitted request in the write-ahead journal: everything
+    needed to replay it on another replica (the raw JSON body IS the
+    prompt + SamplingParams), plus failover bookkeeping."""
+    rid: str
+    path: str
+    body: bytes
+    stream: bool
+    key: int                           # affinity hash
+    replica: Optional[int] = None      # currently assigned replica idx
+    generation: int = 0                # that replica's spawn generation
+    replays: int = 0
+    hedged: bool = False
+    admitted_at: float = dataclasses.field(default_factory=time.monotonic)
+
+
+class RequestJournal:
+    """In-memory write-ahead journal of in-flight requests. `admit`
+    happens BEFORE the first forward; `complete` removes the entry once
+    the client has its answer (or its structured error)."""
+
+    def __init__(self):
+        self._entries: Dict[str, JournalEntry] = {}
+        self._lock = threading.Lock()
+
+    def admit(self, entry: JournalEntry) -> None:
+        with self._lock:
+            self._entries[entry.rid] = entry
+
+    def assign(self, rid: str, replica: int, generation: int) -> None:
+        with self._lock:
+            e = self._entries.get(rid)
+            if e is not None:
+                e.replica = replica
+                e.generation = generation
+
+    def complete(self, rid: str) -> None:
+        with self._lock:
+            self._entries.pop(rid, None)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def inflight_on(self, replica: int) -> List[JournalEntry]:
+        with self._lock:
+            return [e for e in self._entries.values()
+                    if e.replica == replica]
+
+
+class Replica:
+    """Supervisor-side view of one engine replica process."""
+
+    def __init__(self, idx: int, port: int):
+        self.idx = idx
+        self.port = port
+        self.proc: Any = None            # Popen-like handle
+        self.state = STARTING
+        self.generation = 0              # bumped per (re)spawn
+        self.started_at = 0.0
+        self.probe_failures = 0
+        self.restarts = 0                # lifetime respawns
+        self.deaths: collections.deque = collections.deque(maxlen=32)
+        self.backoff_until = 0.0
+        self.last_exit: Optional[str] = None
+        self.planned_restart = False     # rolling restart owns the proc
+        self.inflight: set = set()       # router-assigned request ids
+        self.occupancy = 0.0             # active/total slots (probed)
+        self.queue_depth = 0
+        # circuit breaker
+        self.breaker = "closed"          # closed | open | half_open
+        self.breaker_failures = 0
+        self.breaker_open_until = 0.0
+
+    @property
+    def pid(self) -> Optional[int]:
+        return getattr(self.proc, "pid", None)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def snapshot(self) -> dict:
+        return {
+            "idx": self.idx, "port": self.port, "pid": self.pid,
+            "state": self.state, "generation": self.generation,
+            "restarts": self.restarts, "last_exit": self.last_exit,
+            "probe_failures": self.probe_failures,
+            "breaker": self.breaker,
+            "breaker_failures": self.breaker_failures,
+            "inflight": len(self.inflight),
+            "occupancy": self.occupancy,
+            "queue_depth": self.queue_depth,
+        }
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Router:
+    """Supervises N replicas and routes OpenAI-API traffic to them.
+
+    ``replica_cmd`` is the subprocess argv with a ``{port}`` placeholder
+    (default: ``api_server`` with the flags the CLI assembled); tests
+    inject ``spawn(idx, port) -> Popen-like`` to control the processes
+    entirely."""
+
+    def __init__(self, replica_cmd: Optional[List[str]] = None,
+                 spawn: Optional[Callable[[int, int], Any]] = None,
+                 config: Optional[RouterConfig] = None,
+                 ports: Optional[List[int]] = None,
+                 host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None,
+                 flight: Optional[FlightRecorder] = None,
+                 spawn_env: Optional[Dict[str, str]] = None):
+        if replica_cmd is None and spawn is None:
+            raise ValueError("pass replica_cmd (argv with a {port} "
+                             "placeholder) or a spawn(idx, port) factory")
+        self.cfg = (config or RouterConfig()).resolve()
+        self.host = host
+        self._replica_cmd = replica_cmd
+        self._spawn_fn = spawn
+        self._spawn_env = spawn_env
+        ports = list(ports) if ports else [
+            _free_port(host) for _ in range(self.cfg.replicas)]
+        if len(ports) != self.cfg.replicas:
+            raise ValueError(f"got {len(ports)} ports for "
+                             f"{self.cfg.replicas} replicas")
+        self.replicas = [Replica(i, p) for i, p in enumerate(ports)]
+        self.journal = RequestJournal()
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.flight = flight if flight is not None else FlightRecorder()
+        self._lock = threading.Lock()
+        self._stop = False
+        self._wake = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._admin_lock = threading.Lock()
+        self._rolling = False
+
+        # plain counters mirror the metric families so bench JSON and
+        # stats_snapshot() embed them without a registry scrape
+        self.counts = collections.Counter()
+        self._g_state = self.registry.gauge(
+            "bigdl_tpu_router_replica_state",
+            "replica lifecycle state (0 starting, 1 healthy, 2 "
+            "unhealthy, 3 draining, 4 backoff, 5 quarantined)",
+            ["replica"])
+        self._c_failovers = self.registry.counter(
+            "bigdl_tpu_router_failovers_total",
+            "in-flight requests whose replica died under them")
+        self._c_replays = self.registry.counter(
+            "bigdl_tpu_router_replays_total",
+            "non-streaming requests replayed on another replica")
+        self._c_hedges = self.registry.counter(
+            "bigdl_tpu_router_hedges_total",
+            "hedged duplicate requests fired past the latency threshold")
+        self._c_trips = self.registry.counter(
+            "bigdl_tpu_router_breaker_trips_total",
+            "circuit-breaker open transitions", ["replica"])
+        self._c_restarts = self.registry.counter(
+            "bigdl_tpu_router_restarts_total",
+            "replica respawns (crash recovery + rolling restarts)",
+            ["replica"])
+        self._c_requests = self.registry.counter(
+            "bigdl_tpu_router_requests_total",
+            "routed requests by replica and response code",
+            ["replica", "code"])
+        self._h_latency = self.registry.histogram(
+            "bigdl_tpu_router_request_seconds",
+            "end-to-end routed request latency")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, wait_healthy: bool = True) -> None:
+        for r in self.replicas:
+            self._respawn(r, initial=True)
+        self._supervisor = threading.Thread(target=self._supervise,
+                                            daemon=True)
+        self._supervisor.start()
+        if wait_healthy:
+            deadline = time.monotonic() + self.cfg.spawn_timeout_sec
+            while time.monotonic() < deadline:
+                if any(r.state == HEALTHY for r in self.replicas):
+                    return
+                time.sleep(0.05)
+            raise RuntimeError(
+                "no replica became healthy within "
+                f"{self.cfg.spawn_timeout_sec:.0f}s; last exits: "
+                f"{[r.last_exit for r in self.replicas]}")
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5)
+        for r in self.replicas:
+            if r.proc is None:
+                continue
+            try:
+                r.proc.terminate()
+            except Exception:
+                pass
+        deadline = time.monotonic() + 5.0
+        for r in self.replicas:
+            if r.proc is None:
+                continue
+            while r.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if r.proc.poll() is None:
+                try:
+                    r.proc.kill()
+                except Exception:
+                    pass
+
+    def _spawn(self, idx: int, port: int):
+        if self._spawn_fn is not None:
+            return self._spawn_fn(idx, port)
+        cmd = [a.replace("{port}", str(port)) for a in self._replica_cmd]
+        env = dict(os.environ)
+        if self._spawn_env:
+            env.update(self._spawn_env)
+        return subprocess.Popen(cmd, env=env,
+                                stdout=subprocess.DEVNULL)
+
+    def _respawn(self, r: Replica, initial: bool = False) -> None:
+        r.generation += 1
+        r.proc = self._spawn(r.idx, r.port)
+        r.started_at = time.monotonic()
+        r.probe_failures = 0
+        r.breaker = "closed"
+        r.breaker_failures = 0
+        self._set_state(r, STARTING)
+        if not initial:
+            r.restarts += 1
+            self.counts["restarts"] += 1
+            self._c_restarts.labels(str(r.idx)).inc()
+        self.flight.record("replica_spawn", replica=r.idx, port=r.port,
+                           pid=r.pid, generation=r.generation)
+
+    def _set_state(self, r: Replica, state: str) -> None:
+        if r.state != state:
+            self.flight.record("replica_state", replica=r.idx,
+                               prev=r.state, state=state)
+        r.state = state
+        self._g_state.labels(str(r.idx)).set(STATE_CODES[state])
+
+    # -- supervision --------------------------------------------------------
+
+    def _supervise(self) -> None:
+        while not self._stop:
+            try:
+                self._tick()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()   # the supervisor must survive
+            self._wake.wait(timeout=self.cfg.health_sec)
+            self._wake.clear()
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        for r in self.replicas:
+            if r.state == QUARANTINED or r.planned_restart:
+                continue
+            if r.state == BACKOFF:
+                if now >= r.backoff_until:
+                    self._respawn(r)
+                continue
+            if r.proc is not None and r.proc.poll() is not None:
+                self._handle_death(
+                    r, f"exit code {r.proc.returncode}")
+                continue
+            self._probe(r, now)
+
+    def _http_get(self, port: int, path: str,
+                  timeout: float) -> Tuple[int, bytes]:
+        conn = http.client.HTTPConnection(self.host, port,
+                                          timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def _probe(self, r: Replica, now: float) -> None:
+        try:
+            status, body = self._http_get(r.port, "/health",
+                                          self.cfg.health_timeout_sec)
+        except OSError:
+            status, body = -1, b""
+        if status == 200:
+            r.probe_failures = 0
+            if r.state != HEALTHY:
+                self._set_state(r, HEALTHY)
+            self._poll_stats(r)
+            return
+        detail = ""
+        if status == 503:
+            try:
+                detail = json.loads(body).get("status", "")
+            except (ValueError, AttributeError):
+                detail = ""
+        if detail == "draining":
+            # expected while the replica finishes in-flight work
+            # (rolling restart, operator SIGTERM); not a failure
+            self._set_state(r, DRAINING)
+            return
+        # refused / timed out / wedged: the process may be alive but
+        # the service is not there
+        r.probe_failures += 1
+        if r.state == STARTING:
+            if now - r.started_at > self.cfg.spawn_timeout_sec:
+                self._kill_hung(r, "never became healthy")
+            return
+        if r.state == HEALTHY:
+            self._set_state(r, UNHEALTHY)
+        if r.probe_failures >= self.cfg.unhealthy_after:
+            self._kill_hung(
+                r, f"hung ({r.probe_failures} probe failures"
+                   f"{', ' + detail if detail else ''})")
+
+    def _kill_hung(self, r: Replica, reason: str) -> None:
+        """A live-but-unresponsive replica (replica_hang, wedged step
+        loop) is killed so its sockets break and in-flight requests can
+        fail over — then handled exactly like a crash."""
+        self.flight.record("replica_hung", replica=r.idx, reason=reason)
+        try:
+            if r.proc is not None:
+                r.proc.kill()
+                r.proc.wait(timeout=5)
+        except Exception:
+            pass
+        self._handle_death(r, reason)
+
+    def _handle_death(self, r: Replica, reason: str) -> None:
+        now = time.monotonic()
+        r.last_exit = reason
+        r.deaths.append(now)
+        orphaned = self.journal.inflight_on(r.idx)
+        self.flight.record("replica_death", replica=r.idx, reason=reason,
+                           inflight=len(orphaned))
+        recent = [t for t in r.deaths
+                  if now - t <= self.cfg.crash_window_sec]
+        if len(recent) >= self.cfg.crash_budget:
+            # crash loop: stop feeding it restarts — the replica-level
+            # mirror of the engine's per-request crash-budget quarantine
+            self.counts["quarantined"] += 1
+            self._set_state(r, QUARANTINED)
+            self.flight.record("replica_quarantined", replica=r.idx,
+                               deaths_in_window=len(recent),
+                               window_sec=self.cfg.crash_window_sec)
+            return
+        backoff = min(self.cfg.backoff_max_sec,
+                      self.cfg.backoff_base_sec * (2 ** (len(recent) - 1)))
+        r.backoff_until = now + backoff
+        self._set_state(r, BACKOFF)
+        self.flight.record("replica_backoff", replica=r.idx,
+                           backoff_sec=round(backoff, 3))
+
+    def _poll_stats(self, r: Replica) -> None:
+        """Occupancy for least-loaded fallback routing; best-effort."""
+        try:
+            status, body = self._http_get(r.port, "/v1/stats",
+                                          self.cfg.health_timeout_sec)
+            if status != 200:
+                return
+            doc = json.loads(body)
+            slots = doc.get("slots", {})
+            total = max(int(slots.get("total", 1)), 1)
+            r.occupancy = float(slots.get("active", 0)) / total
+            r.queue_depth = int(doc.get("queue_depth", 0))
+        except (OSError, ValueError):
+            pass
+
+    # -- circuit breaker ----------------------------------------------------
+
+    def _breaker_failure(self, r: Replica) -> None:
+        r.breaker_failures += 1
+        if r.breaker == "half_open" or (
+                r.breaker == "closed"
+                and r.breaker_failures >= self.cfg.breaker_threshold):
+            r.breaker = "open"
+            r.breaker_open_until = (time.monotonic()
+                                    + self.cfg.breaker_cooldown_sec)
+            self.counts["breaker_trips"] += 1
+            self._c_trips.labels(str(r.idx)).inc()
+            self.flight.record("breaker_open", replica=r.idx,
+                               failures=r.breaker_failures)
+
+    def _breaker_success(self, r: Replica) -> None:
+        r.breaker_failures = 0
+        if r.breaker != "closed":
+            self.flight.record("breaker_close", replica=r.idx,
+                               was=r.breaker)
+            r.breaker = "closed"
+
+    def _routable(self, r: Replica) -> bool:
+        if r.state != HEALTHY or r.planned_restart:
+            return False
+        if r.breaker == "open":
+            if time.monotonic() < r.breaker_open_until:
+                return False
+            # cooldown elapsed: half-open, admit a trial request
+            r.breaker = "half_open"
+            self.flight.record("breaker_half_open", replica=r.idx)
+        return True
+
+    # -- routing ------------------------------------------------------------
+
+    def _affinity_key(self, body: dict) -> int:
+        prompt = body.get("prompt")
+        if prompt is None:
+            msgs = body.get("messages") or []
+            prompt = "\x00".join(
+                f"{m.get('role', '')}:{m.get('content', '')}"
+                for m in msgs)
+        if isinstance(prompt, list):
+            prefix = prompt[:self.cfg.affinity_tokens]
+        else:
+            prefix = str(prompt)[:self.cfg.affinity_tokens * 4]
+        digest = hashlib.sha1(repr(prefix).encode()).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    def _pick(self, key: int, exclude=()) -> Replica:
+        """Prefix-affinity first: the consistent-hash target takes the
+        request when it is routable and has a free slot (its prefix
+        cache already holds this prompt family's entry); otherwise the
+        least-loaded routable replica."""
+        n = len(self.replicas)
+        candidates = [r for r in self.replicas
+                      if r.idx not in exclude and self._routable(r)]
+        if not candidates:
+            raise NoReplica()
+        affinity = self.replicas[key % n]
+        if affinity in candidates and affinity.occupancy < 1.0:
+            return affinity
+        return min(candidates,
+                   key=lambda r: (r.occupancy, r.queue_depth,
+                                  len(r.inflight), r.idx))
+
+    def _pick_wait(self, key: int, exclude: Dict[int, int],
+                   deadline: float) -> Replica:
+        """``_pick`` that RIDES OUT a replica gap: with every replica
+        momentarily unroutable (the last healthy one just died and its
+        replacement is mid-spawn), keep polling until ``deadline``
+        instead of failing the request. ``exclude`` maps replica idx ->
+        the GENERATION that failed us: a respawned process at the same
+        index is a new generation and gets forgiven, while the dead
+        instance stays excluded even during the window where the
+        supervisor still believes it healthy (state is probe-delayed;
+        generation only moves on respawn)."""
+        while True:
+            try:
+                return self._pick(key, exclude)
+            except NoReplica:
+                stale = [i for i, gen in exclude.items()
+                         if self.replicas[i].generation != gen]
+                for i in stale:
+                    del exclude[i]
+                if stale:
+                    continue
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(min(0.05, self.cfg.health_sec))
+
+    def retry_after_hint(self) -> int:
+        """Seconds until a fresh replica is plausibly routable."""
+        return max(1, int(round(2 * self.cfg.health_sec)))
+
+    # -- forwarding ---------------------------------------------------------
+
+    def _forward_buffered(self, r: Replica, entry: JournalEntry
+                          ) -> Tuple[int, bytes]:
+        """POST the journaled body to one replica and buffer the full
+        response. Raises ``ReplicaLost`` on any transport failure — a
+        SIGKILLed process closes its sockets, so every death mode ends
+        here rather than in a client-visible hang."""
+        rid = entry.rid
+        r.inflight.add(rid)
+        conn = http.client.HTTPConnection(
+            self.host, r.port, timeout=self.cfg.connect_timeout_sec)
+        try:
+            conn.request("POST", entry.path, body=entry.body,
+                         headers={"Content-Type": "application/json"})
+            conn.sock.settimeout(self.cfg.forward_timeout_sec)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            raise ReplicaLost(f"replica {r.idx}: "
+                              f"{type(e).__name__}: {e}") from e
+        finally:
+            r.inflight.discard(rid)
+            conn.close()
+
+    def _forward_hedged(self, primary: Replica, entry: JournalEntry,
+                        exclude: Dict[int, int]
+                        ) -> Tuple[Replica, int, bytes]:
+        """Primary forward, plus ONE duplicate on another replica when
+        no response lands inside hedge_ms. First answer wins; the
+        loser's closed connection triggers the replica engine's
+        client-disconnect abort, freeing its slot."""
+        hedge_ms = self.cfg.hedge_ms
+        results: "queue.Queue" = queue.Queue()
+
+        def run(rep: Replica):
+            try:
+                status, data = self._forward_buffered(rep, entry)
+                results.put((rep, None, status, data))
+            except ReplicaLost as e:
+                results.put((rep, e, 0, b""))
+
+        threading.Thread(target=run, args=(primary,), daemon=True).start()
+        launched = 1
+        if hedge_ms > 0 and not entry.stream:
+            try:
+                got = results.get(timeout=hedge_ms / 1000.0)
+                results.put(got)       # not late: hand it back
+            except queue.Empty:
+                try:
+                    second = self._pick(
+                        entry.key, set(exclude) | {primary.idx})
+                except NoReplica:
+                    second = None
+                if second is not None:
+                    entry.hedged = True
+                    self.counts["hedges"] += 1
+                    self._c_hedges.inc()
+                    self.flight.record("hedge", rid=entry.rid,
+                                       primary=primary.idx,
+                                       hedge=second.idx)
+                    threading.Thread(target=run, args=(second,),
+                                     daemon=True).start()
+                    launched += 1
+        err: Optional[ReplicaLost] = None
+        err_rep = primary
+        for _ in range(launched):
+            rep, e, status, data = results.get()
+            if e is None:
+                return rep, status, data
+            err, err_rep = e, rep
+            self._breaker_failure(rep)
+        raise ReplicaLost(str(err)) from err
+
+    # -- request paths ------------------------------------------------------
+
+    def route_buffered(self, entry: JournalEntry) -> Tuple[int, bytes]:
+        """Non-streaming path: forward, and on replica loss REPLAY the
+        journaled request on a healthy replica (up to max_replays).
+        A replica's own 503 (drain race) re-routes without burning the
+        replay budget — that is the rolling restart's zero-5xx leg."""
+        t0 = time.monotonic()
+        pick_deadline = t0 + self.cfg.no_replica_wait_sec
+        exclude: Dict[int, int] = {}
+        reroutes = 0
+        while True:
+            try:
+                r = self._pick_wait(entry.key, exclude, pick_deadline)
+            except NoReplica:
+                return 503, json.dumps({"error": {
+                    "message": "no healthy replica; retry shortly",
+                    "type": "unavailable", "code": 503,
+                    "retry_after": self.retry_after_hint()}}).encode()
+            self.journal.assign(entry.rid, r.idx, r.generation)
+            try:
+                used, status, data = self._forward_hedged(
+                    r, entry, exclude)
+            except ReplicaLost as e:
+                exclude[r.idx] = r.generation
+                self.counts["failovers"] += 1
+                self._c_failovers.inc()
+                self.flight.record("failover", rid=entry.rid,
+                                   replica=r.idx, error=str(e)[:200])
+                if entry.replays < self.cfg.max_replays:
+                    entry.replays += 1
+                    self.counts["replays"] += 1
+                    self._c_replays.inc()
+                    self.flight.record("replay", rid=entry.rid,
+                                       attempt=entry.replays)
+                    continue
+                return 502, json.dumps({"error": {
+                    "message": "replica failed and replay budget is "
+                               "spent", "type": "replica_lost",
+                    "code": 502, "replays": entry.replays,
+                    "retry_after": self.retry_after_hint()}}).encode()
+            if status == 503:
+                # the replica is shedding (drain race): someone else
+                # takes it; re-route burns no replay budget
+                exclude[used.idx] = used.generation
+                reroutes += 1
+                self.counts["rerouted_503"] += 1
+                self.flight.record("reroute_503", rid=entry.rid,
+                                   replica=used.idx)
+                if reroutes <= len(self.replicas):
+                    continue
+                return 503, data
+            if status >= 500:
+                self._breaker_failure(used)
+            else:
+                self._breaker_success(used)
+            self.counts["requests"] += 1
+            self._c_requests.labels(str(used.idx), str(status)).inc()
+            self._h_latency.observe(time.monotonic() - t0)
+            return status, data
+
+    # streaming handled in the HTTP handler (needs the client socket)
+
+    # -- rolling restart ----------------------------------------------------
+
+    def rolling_restart(self) -> dict:
+        """Drain + respawn replicas ONE AT A TIME: stop routing to the
+        replica, SIGTERM it (the api_server's drain finishes in-flight
+        work, then the process exits), respawn, wait healthy, move on.
+        Raises ``RuntimeError`` when already in progress."""
+        if not self._admin_lock.acquire(blocking=False):
+            raise RuntimeError("rolling restart already in progress")
+        t0 = time.monotonic()
+        results = []
+        self._rolling = True
+        self.flight.record("rolling_restart_begin",
+                           replicas=len(self.replicas))
+        try:
+            for r in self.replicas:
+                if r.state == QUARANTINED:
+                    results.append({"replica": r.idx,
+                                    "skipped": "quarantined"})
+                    continue
+                r.planned_restart = True   # the supervisor hands over
+                self._set_state(r, DRAINING)
+                step = {"replica": r.idx, "pid": r.pid}
+                try:
+                    if r.proc is not None and r.proc.poll() is None:
+                        r.proc.terminate()     # SIGTERM -> drain
+                        try:
+                            r.proc.wait(
+                                timeout=self.cfg.drain_exit_timeout_sec)
+                        except Exception:
+                            r.proc.kill()
+                            r.proc.wait(timeout=5)
+                            step["forced_kill"] = True
+                    self._respawn(r)
+                    if not self._wait_healthy(
+                            r, self.cfg.spawn_timeout_sec):
+                        step["error"] = ("replacement never became "
+                                         "healthy")
+                        results.append(step)
+                        break
+                    step["ok"] = True
+                    results.append(step)
+                finally:
+                    r.planned_restart = False
+            return {"rolling_restart": results,
+                    "duration_s": round(time.monotonic() - t0, 3),
+                    "ok": all(s.get("ok") or s.get("skipped")
+                              for s in results)}
+        finally:
+            self._rolling = False
+            self.flight.record("rolling_restart_end")
+            self._admin_lock.release()
+
+    def _wait_healthy(self, r: Replica, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if r.proc is not None and r.proc.poll() is not None:
+                return False
+            try:
+                status, _ = self._http_get(r.port, "/health",
+                                           self.cfg.health_timeout_sec)
+                if status == 200:
+                    r.probe_failures = 0
+                    self._set_state(r, HEALTHY)
+                    return True
+            except OSError:
+                pass
+            time.sleep(min(0.1, self.cfg.health_sec))
+        return False
+
+    # -- introspection ------------------------------------------------------
+
+    def stats_snapshot(self) -> dict:
+        """JSON-ready router state for ``GET /v1/router/stats`` (and
+        the bench JSON's ``router`` block)."""
+        return {
+            "replicas": [r.snapshot() for r in self.replicas],
+            "journal_depth": self.journal.depth(),
+            "counters": {k: int(v) for k, v in sorted(
+                self.counts.items())},
+            "rolling_restart_in_progress": self._rolling,
+            "config": {
+                "replicas": self.cfg.replicas,
+                "health_sec": self.cfg.health_sec,
+                "hedge_ms": self.cfg.hedge_ms,
+                "crash_budget": self.cfg.crash_budget,
+                "breaker_threshold": self.cfg.breaker_threshold,
+                "max_replays": self.cfg.max_replays,
+                "affinity_tokens": self.cfg.affinity_tokens,
+            },
+        }
+
+    # -- http front ---------------------------------------------------------
+
+    def make_handler(router):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet
+                pass
+
+            def _json(self, code: int, obj, headers=()):
+                body = obj if isinstance(obj, bytes) \
+                    else json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except OSError:
+                    pass
+
+            def _proxy(self, method: str, body: Optional[bytes] = None):
+                """Pass non-completion traffic (models, stats, memory,
+                metrics-of-replica, profiler) to any routable replica."""
+                try:
+                    r = router._pick(0)
+                except NoReplica:
+                    return self._json(503, {"error": {
+                        "message": "no healthy replica",
+                        "type": "unavailable", "code": 503}})
+                conn = http.client.HTTPConnection(
+                    router.host, r.port,
+                    timeout=router.cfg.forward_timeout_sec)
+                try:
+                    conn.request(method, self.path, body=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    ctype = resp.getheader("Content-Type",
+                                           "application/json")
+                    self.send_response(resp.status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except (OSError, http.client.HTTPException) as e:
+                    self._json(502, {"error": {
+                        "message": f"replica proxy failed: {e}",
+                        "type": "replica_lost", "code": 502}})
+                finally:
+                    conn.close()
+
+            def do_GET(self):
+                if self.path in ("/health", "/ping"):
+                    n = sum(1 for r in router.replicas
+                            if router._routable(r))
+                    if n:
+                        self._json(200, {"status": "ok",
+                                         "routable_replicas": n})
+                    else:
+                        self._json(
+                            503,
+                            {"status": "no_healthy_replica",
+                             "retry_after": router.retry_after_hint()},
+                            headers=(("Retry-After",
+                                      str(router.retry_after_hint())),))
+                elif self.path == "/metrics":
+                    body = router.registry.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/v1/router/stats":
+                    self._json(200, router.stats_snapshot())
+                elif self.path == "/v1/router/flight":
+                    self._json(200, {"events":
+                                     router.flight.snapshot()})
+                else:
+                    self._proxy("GET")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b"{}"
+                if self.path == "/v1/admin/rolling_restart":
+                    try:
+                        out = router.rolling_restart()
+                    except RuntimeError as e:
+                        return self._json(409, {"error": str(e)})
+                    return self._json(200 if out.get("ok") else 500,
+                                      out)
+                if self.path not in ("/v1/completions",
+                                     "/v1/chat/completions"):
+                    return self._proxy("POST", raw)
+                try:
+                    body = json.loads(raw or b"{}")
+                except json.JSONDecodeError:
+                    return self._json(400, {"error": "bad json"})
+                entry = JournalEntry(
+                    rid=f"rtr-{uuid.uuid4().hex[:12]}",
+                    path=self.path, body=raw,
+                    stream=bool(body.get("stream")),
+                    key=router._affinity_key(body))
+                router.journal.admit(entry)   # write-ahead
+                try:
+                    if entry.stream:
+                        self._stream(entry)
+                    else:
+                        status, data = router.route_buffered(entry)
+                        headers = ()
+                        if status == 503:
+                            headers = (("Retry-After",
+                                        str(router.retry_after_hint())),)
+                        self._json(status, data, headers=headers)
+                finally:
+                    router.journal.complete(entry.rid)
+
+            def _stream(self, entry: JournalEntry):
+                """Relay SSE from the replica. A replica lost BEFORE
+                any byte reached the client re-routes invisibly; lost
+                MID-STREAM, the client gets a structured error event
+                plus [DONE] instead of a dropped socket (generation is
+                not transparently resumable — the client resubmits
+                after retry_after)."""
+                exclude: Dict[int, int] = {}
+                reroutes = 0
+                pick_deadline = (time.monotonic()
+                                 + router.cfg.no_replica_wait_sec)
+                while True:
+                    try:
+                        r = router._pick_wait(entry.key, exclude,
+                                              pick_deadline)
+                    except NoReplica:
+                        return self._json(503, {"error": {
+                            "message": "no healthy replica",
+                            "type": "unavailable", "code": 503,
+                            "retry_after": router.retry_after_hint()}})
+                    router.journal.assign(entry.rid, r.idx,
+                                          r.generation)
+                    r.inflight.add(entry.rid)
+                    conn = http.client.HTTPConnection(
+                        router.host, r.port,
+                        timeout=router.cfg.connect_timeout_sec)
+                    try:
+                        try:
+                            conn.request(
+                                "POST", entry.path, body=entry.body,
+                                headers={"Content-Type":
+                                         "application/json"})
+                            conn.sock.settimeout(
+                                router.cfg.forward_timeout_sec)
+                            resp = conn.getresponse()
+                        except (OSError,
+                                http.client.HTTPException) as e:
+                            # nothing streamed yet: invisible failover
+                            router._breaker_failure(r)
+                            exclude[r.idx] = r.generation
+                            router.counts["failovers"] += 1
+                            router._c_failovers.inc()
+                            router.flight.record(
+                                "failover", rid=entry.rid,
+                                replica=r.idx, error=str(e)[:200])
+                            if entry.replays < router.cfg.max_replays:
+                                entry.replays += 1
+                                router.counts["replays"] += 1
+                                router._c_replays.inc()
+                                continue
+                            return self._json(502, {"error": {
+                                "message": "replica failed before the "
+                                           "stream started",
+                                "type": "replica_lost", "code": 502}})
+                        if resp.status == 503 \
+                                and reroutes <= len(router.replicas):
+                            resp.read()
+                            exclude[r.idx] = r.generation
+                            reroutes += 1
+                            router.counts["rerouted_503"] += 1
+                            continue
+                        if resp.status != 200:
+                            data = resp.read()
+                            router._breaker_failure(r) \
+                                if resp.status >= 500 \
+                                else router._breaker_success(r)
+                            return self._json(resp.status, data)
+                        # 200: stream is live — relay line-wise
+                        router._breaker_success(r)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/event-stream")
+                        self.send_header("Cache-Control", "no-cache")
+                        self.end_headers()
+                        self._relay(entry, r, resp)
+                        return
+                    finally:
+                        r.inflight.discard(entry.rid)
+                        conn.close()
+
+            def _relay(self, entry: JournalEntry, r: Replica, resp):
+                saw_done = False
+                try:
+                    while True:
+                        line = resp.fp.readline()
+                        if not line:
+                            break
+                        if line.strip() == b"data: [DONE]":
+                            saw_done = True
+                        try:
+                            self.wfile.write(line)
+                            if line == b"\n":
+                                self.wfile.flush()
+                        except OSError:
+                            # CLIENT left: closing the replica conn
+                            # (finally below) trips the engine's SSE
+                            # write failure -> abort + slot free
+                            router.flight.record(
+                                "stream_client_gone", rid=entry.rid)
+                            return
+                except (OSError, http.client.HTTPException):
+                    pass                 # replica died mid-read
+                if saw_done:
+                    return
+                # REPLICA lost mid-stream: structured error, not a
+                # dropped socket
+                router.counts["failovers"] += 1
+                router.counts["stream_errors"] += 1
+                router._c_failovers.inc()
+                router._breaker_failure(r)
+                retry = router.retry_after_hint()
+                router.flight.record("stream_replica_lost",
+                                     rid=entry.rid, replica=r.idx)
+                event = {"error": {
+                    "message": "replica failed mid-stream; resubmit "
+                               "the request",
+                    "type": "replica_failover", "code": 503,
+                    "retry_after": retry}}
+                try:
+                    self.wfile.write(
+                        b"data: " + json.dumps(event).encode()
+                        + b"\n\ndata: [DONE]\n\n")
+                    self.wfile.flush()
+                except OSError:
+                    pass
+
+        return Handler
+
+    def serve(self, host: str = "127.0.0.1", port: int = 8080,
+              background: bool = False) -> ThreadingHTTPServer:
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          self.make_handler())
+        if background:
+            t = threading.Thread(target=self._httpd.serve_forever,
+                                 daemon=True)
+            t.start()
+        else:
+            self._httpd.serve_forever()
+        return self._httpd
+
+
+def main():
+    """CLI: python -m bigdl_tpu.serving.router --model PATH
+    --replicas N [--tiny-random] — spawns the replicas as
+    ``api_server`` subprocesses and serves the routed OpenAI API."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--load-in-low-bit", default="sym_int4")
+    ap.add_argument("--tiny-random", action="store_true")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="default $BIGDL_TPU_ROUTER_REPLICAS (2)")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=2048)
+    ap.add_argument("--health-sec", type=float, default=None,
+                    help="default $BIGDL_TPU_ROUTER_HEALTH_SEC (1.0)")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="default $BIGDL_TPU_ROUTER_HEDGE_MS (0 = off)")
+    ap.add_argument("--crash-budget", type=int, default=None,
+                    help="default $BIGDL_TPU_ROUTER_CRASH_BUDGET (3)")
+    args = ap.parse_args()
+
+    if not args.model and not args.tiny_random:
+        ap.error("--model is required (or pass --tiny-random)")
+    cmd = [sys.executable, "-m", "bigdl_tpu.serving.api_server",
+           "--host", args.host, "--port", "{port}",
+           "--max-batch", str(args.max_batch),
+           "--max-seq", str(args.max_seq)]
+    if args.tiny_random:
+        cmd += ["--tiny-random"]
+    else:
+        cmd += ["--model", args.model,
+                "--load-in-low-bit", args.load_in_low_bit]
+
+    router = Router(
+        replica_cmd=cmd,
+        config=RouterConfig(replicas=args.replicas,
+                            health_sec=args.health_sec,
+                            hedge_ms=args.hedge_ms,
+                            crash_budget=args.crash_budget),
+        host=args.host)
+    print(f"router: spawning {router.cfg.replicas} replicas on ports "
+          f"{[r.port for r in router.replicas]}", file=sys.stderr)
+    router.start()
+
+    def _term(signum, frame):
+        threading.Thread(target=router.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    print(f"routing on http://{args.host}:{args.port}/v1",
+          file=sys.stderr)
+    router.serve(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -10,8 +10,59 @@ Run: python -m bigdl_tpu.utils.env_check
 
 from __future__ import annotations
 
+import difflib
 import os
 import sys
+
+#: every knob the stack reads — the typo check suggests the nearest of
+#: these for any unrecognized BIGDL_TPU_* variable (a misspelled knob
+#: is silently ignored everywhere else, which is exactly the failure
+#: mode an env check exists to catch)
+KNOWN_ENV = (
+    "BIGDL_TPU_AOT_TARGET",
+    "BIGDL_TPU_ATTENTION_BACKEND",
+    "BIGDL_TPU_COMPILE_CACHE",
+    "BIGDL_TPU_COMPILE_MEMORY",
+    "BIGDL_TPU_DISABLE_NATIVE",
+    "BIGDL_TPU_DRAIN_TIMEOUT_SEC",
+    "BIGDL_TPU_EVENT_LOG",
+    "BIGDL_TPU_EVENT_LOG_MAX_BYTES",
+    "BIGDL_TPU_FAULT_SPEC",
+    "BIGDL_TPU_HBM_BUDGET_FRACTION",
+    "BIGDL_TPU_IQ_GRID_SOURCE",
+    "BIGDL_TPU_KV_CACHE_DTYPE",
+    "BIGDL_TPU_MATMUL_BACKEND",
+    "BIGDL_TPU_MATMUL_GEMV",
+    "BIGDL_TPU_MATMUL_PALLAS_MAX_M",
+    "BIGDL_TPU_MAX_SEQ",
+    "BIGDL_TPU_MEMORY_POLL_SEC",
+    "BIGDL_TPU_MOE_DISPATCH",
+    "BIGDL_TPU_MXU_LAYOUT",
+    "BIGDL_TPU_NATIVE_CACHE",
+    "BIGDL_TPU_POSTMORTEM_DIR",
+    "BIGDL_TPU_QUANTIZE_KV_CACHE",
+    "BIGDL_TPU_RECOMPILE_WARN",
+    "BIGDL_TPU_REQUEST_DEADLINE_MS",
+    "BIGDL_TPU_ROUTER_CRASH_BUDGET",
+    "BIGDL_TPU_ROUTER_HEALTH_SEC",
+    "BIGDL_TPU_ROUTER_HEDGE_MS",
+    "BIGDL_TPU_ROUTER_REPLICAS",
+)
+
+
+def find_env_typos(environ=None) -> list:
+    """Unrecognized ``BIGDL_TPU_*`` variables with a close known knob:
+    ``[{"unknown": ..., "did_you_mean": ...}]``. High match cutoff so
+    unrelated private variables don't false-positive."""
+    env = os.environ if environ is None else environ
+    typos = []
+    for k in sorted(env):
+        if not k.startswith("BIGDL_TPU_") or k in KNOWN_ENV:
+            continue
+        close = difflib.get_close_matches(k, KNOWN_ENV, n=1, cutoff=0.85)
+        if close:
+            typos.append({"unknown": k, "did_you_mean": close[0]})
+    return typos
 
 
 def collect() -> dict:
@@ -170,6 +221,34 @@ def collect() -> dict:
         except ValueError as e:
             info["drain_timeout_sec"] = {
                 "value": dt, "valid": False, "error": str(e)}
+
+    # serving-router knobs (the router falls back to defaults on bad
+    # values; surface range errors here instead)
+    router_knobs = (
+        ("router_health_sec", "BIGDL_TPU_ROUTER_HEALTH_SEC",
+         "resolve_router_health_sec"),
+        ("router_replicas", "BIGDL_TPU_ROUTER_REPLICAS",
+         "resolve_router_replicas"),
+        ("router_hedge_ms", "BIGDL_TPU_ROUTER_HEDGE_MS",
+         "resolve_router_hedge_ms"),
+        ("router_crash_budget", "BIGDL_TPU_ROUTER_CRASH_BUDGET",
+         "resolve_router_crash_budget"),
+    )
+    for key, envname, fname in router_knobs:
+        raw = os.environ.get(envname)
+        if not raw:
+            continue
+        from bigdl_tpu.serving import router as _router
+
+        try:
+            info[key] = {"value": getattr(_router, fname)(raw),
+                         "valid": True}
+        except ValueError as e:
+            info[key] = {"value": raw, "valid": False, "error": str(e)}
+
+    typos = find_env_typos()
+    if typos:
+        info["env_typos"] = typos
     return info
 
 
@@ -192,6 +271,11 @@ def main() -> int:
           and info.get("fault_spec", {}).get("valid", True)
           and info.get("request_deadline_ms", {}).get("valid", True)
           and info.get("drain_timeout_sec", {}).get("valid", True)
+          and info.get("router_health_sec", {}).get("valid", True)
+          and info.get("router_replicas", {}).get("valid", True)
+          and info.get("router_hedge_ms", {}).get("valid", True)
+          and info.get("router_crash_budget", {}).get("valid", True)
+          and not info.get("env_typos")
           and info.get("postmortem_dir", {}).get("writable", True))
     print("status :", "OK" if ok else "PROBLEMS FOUND")
     return 0 if ok else 1

@@ -99,6 +99,41 @@ def random_llama_params(
     return params
 
 
+class SyntheticCausalLM:
+    """Duck-typed stand-in for TpuCausalLM — ``.params`` / ``.config``
+    / ``.family`` / ``.hf_config`` is all ``LLMEngine`` needs. Weights
+    come from ``random_llama_params`` with an explicit seed, so two
+    PROCESSES built with the same seed hold byte-identical weights:
+    the serving router's replica-replay guarantees (a replayed greedy
+    request must reproduce the dead replica's answer exactly) are
+    testable without shipping a checkpoint into every subprocess."""
+
+    def __init__(self, params, cfg):
+        from bigdl_tpu.models import llama as llama_mod
+
+        self.params = params
+        self.config = cfg
+        self.hf_config = {"eos_token_id": None}
+
+        class _Family:
+            name = "llama-synthetic"
+            forward = staticmethod(llama_mod.forward)
+            prefill = staticmethod(llama_mod.forward_last_token)
+            new_cache = staticmethod(llama_mod.new_cache)
+            SUPPORTS_SCALED_KV = llama_mod.SUPPORTS_SCALED_KV
+
+        self.family = _Family()
+
+
+def tiny_random_model(seed: int = 0, qtype: Optional[str] = "sym_int4",
+                      cfg=None) -> SyntheticCausalLM:
+    """A tiny random llama ready for ``LLMEngine`` / ``OpenAIServer``
+    (the ``api_server --tiny-random`` replica mode and router tests)."""
+    cfg = cfg or TINY_LLAMA
+    return SyntheticCausalLM(
+        random_llama_params(cfg, qtype=qtype, seed=seed), cfg)
+
+
 def random_mixtral_params(
     cfg,
     qtype: Optional[str] = "sym_int4",

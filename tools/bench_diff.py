@@ -63,7 +63,24 @@ HBM_METRICS = {
 ROBUSTNESS_COUNTERS = (
     "bigdl_tpu_requests_quarantined_total",
     "bigdl_tpu_step_retries_total",
+    "bigdl_tpu_requests_cancelled_total",
+    "bigdl_tpu_router_failovers_total",
+    "bigdl_tpu_router_replays_total",
+    "bigdl_tpu_router_breaker_trips_total",
 )
+
+# the router's flat counters block (bench_serving --replicas embeds
+# GET /v1/router/stats as `router_bench.router`): every one of these
+# counts a recovery action, so MORE of them between two runs of the
+# same load is a robustness regression even when throughput improved
+ROUTER_COUNTERS = {
+    "failovers": "lower",
+    "replays": "lower",
+    "breaker_trips": "lower",
+    "quarantined": "lower",
+    "rerouted_503": "lower",
+    "stream_errors": "lower",
+}
 
 
 def load_record(path: str) -> dict:
@@ -118,6 +135,16 @@ def flatten_metrics(rec: dict, prefix: str = "",
                         and isinstance(mv, (int, float)) \
                         and not isinstance(mv, bool):
                     out[f"{name}.{mk}"] = (float(mv), "lower")
+        elif key == "router" and isinstance(val, dict) \
+                and isinstance(val.get("counters"), dict):
+            # embedded GET /v1/router/stats: gate the recovery-action
+            # counters lower-is-better (replica rows and config churn
+            # per run and stay skipped)
+            for mk, direction in ROUTER_COUNTERS.items():
+                mv = val["counters"].get(mk)
+                if isinstance(mv, (int, float)) \
+                        and not isinstance(mv, bool):
+                    out[f"{name}.counters.{mk}"] = (float(mv), direction)
         elif key == "memory" and isinstance(val, dict):
             # only the headline scalars: the snapshot's nested static/
             # device/headroom dicts churn per environment
