@@ -30,9 +30,36 @@ from __future__ import annotations
 import functools
 import json
 import os
+import re
 import subprocess
 import sys
 import time
+
+
+def _last_tb_frame(stderr: str) -> str:
+    """Last real traceback frame in a lane's stderr. Lanes run with
+    JAX_TRACEBACK_FILTERING=off, so this names the actual crash site
+    instead of jax's re-raise shim — the one line that makes an
+    erroring A/B lane diagnosable from the bench JSON alone."""
+    frames = re.findall(r'File "[^"]*", line \d+, in \S+', stderr or "")
+    return frames[-1] if frames else ""
+
+
+def _persist_lane_log(run_dir: str, label: str, stdout, stderr):
+    """Write a lane's FULL stdout+stderr next to the bench results and
+    return the path (referenced from the lane's JSON entry) — the
+    in-JSON error string only carries a tail."""
+    path = os.path.join(
+        run_dir, "lane_%s.log" % re.sub(r"[^\w.+-]", "_", str(label)))
+    try:
+        with open(path, "w") as f:
+            f.write("=== stdout ===\n")
+            f.write(stdout or "")
+            f.write("\n=== stderr ===\n")
+            f.write(stderr or "")
+        return path
+    except OSError:
+        return None
 
 
 def _probe_backend(timeout_s: int = 150):
@@ -582,18 +609,27 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
             continue
         cfg_timeout = min(CONFIG_TIMEOUT_S, int(remaining) - 30)
         t0 = time.time()
+        lane_log = None
+        proc = None
         try:
+            # unfiltered tracebacks: the child's crash frames must name
+            # the real site, not jax's traceback-filtering shim
             proc = subprocess.run(
                 [sys.executable, "-u", os.path.abspath(__file__),
                  "--config", label],
-                capture_output=True, text=True, timeout=cfg_timeout)
+                capture_output=True, text=True, timeout=cfg_timeout,
+                env={**os.environ, "JAX_TRACEBACK_FILTERING": "off"})
             sys.stderr.write(proc.stderr[-2000:])
+            lane_log = _persist_lane_log(run_dir, label,
+                                         proc.stdout, proc.stderr)
             lines = [ln for ln in proc.stdout.strip().splitlines()
                      if ln.startswith("{")]
             if not lines:
+                frame = _last_tb_frame(proc.stderr)
                 raise RuntimeError(
                     f"no output (rc={proc.returncode}); "
-                    f"stderr tail: {proc.stderr[-300:]}")
+                    + (f"crashed at: {frame}; " if frame else "")
+                    + f"stderr tail: {proc.stderr[-300:]}")
             raw = json.loads(lines[-1])
             if not raw.get("on_tpu"):
                 raise RuntimeError("config subprocess fell back off-TPU")
@@ -627,6 +663,12 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
                 child_err = (te.stderr.decode("utf-8", "replace")
                              if isinstance(te.stderr, bytes) else te.stderr)
                 sys.stderr.write(child_err[-2000:])
+            child_out = ""
+            if te.stdout:
+                child_out = (te.stdout.decode("utf-8", "replace")
+                             if isinstance(te.stdout, bytes) else te.stdout)
+            lane_log = _persist_lane_log(run_dir, label,
+                                         child_out, child_err)
             if "bench-phase" in child_err:
                 last = [ln for ln in child_err.splitlines()
                         if "bench-phase" in ln][-1]
@@ -643,7 +685,12 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
                     "no_fault": True}
             print(f"bench[{label}]: TIMEOUT", file=sys.stderr)
         except Exception as e:
-            ab_results[label] = {"error": f"{type(e).__name__}: {e}"}
+            err = f"{type(e).__name__}: {e}"
+            if "crashed at:" not in err and proc is not None:
+                frame = _last_tb_frame(proc.stderr or "")
+                if frame:
+                    err += f" (lane crashed at: {frame})"
+            ab_results[label] = {"error": err}
             # a config that failed FAST (clean subprocess exit, no
             # timeout) cannot have wedged the window; demoting it would
             # delay a since-fixed retry behind the whole matrix
@@ -652,6 +699,10 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
             if time.time() - t0 < 120:
                 ab_results[label]["fast_fail"] = True
             print(f"bench[{label}]: FAILED {e}", file=sys.stderr)
+        if lane_log and isinstance(ab_results.get(label), dict):
+            # full stdout/stderr on disk, referenced from the JSON —
+            # the error string above only carries a tail
+            ab_results[label]["lane_log"] = lane_log
         tunnel_dead = False
         if "error" in ab_results[label]:
             # probe BEFORE persisting: if the tunnel itself is gone, the

@@ -116,6 +116,128 @@ def run_router_bench(n_replicas: int, n_requests: int = 16,
     }
 
 
+def run_overload_bench(model, cfg, max_seq: int, prompt_len: int,
+                       new_tokens: int) -> dict:
+    """Open-loop overload lane: Poisson arrivals at 0.5x / 1x / 3x the
+    measured closed-loop capacity, mixed QoS classes and tenants,
+    against a deliberately small bounded queue. Reports goodput, shed
+    rate, and per-QoS p99 TTFT per lane. bench_diff gates the <=1x
+    lanes' shed_total / brownout_level_max at zero and the 3x lane's
+    goodput_tokens_per_s lower-is-worse."""
+    import numpy as np
+
+    from bigdl_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+    from bigdl_tpu.serving.overload import RequestShed
+
+    b = 2
+    prompt_len = min(prompt_len, 64)
+    new_tokens = min(new_tokens, 16)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(12 * b)]
+
+    def make_engine():
+        eng = LLMEngine(model, EngineConfig(
+            max_batch=b, max_seq=max_seq, prefix_cache_entries=0,
+            max_queue_depth=4 * b))
+        eng.generate(prompts[:b], SamplingParams(max_tokens=2))  # warmup
+        return eng
+
+    # closed-loop capacity probe: completed requests/s with every slot
+    # busy — the open-loop lanes' offered rates are multiples of this
+    eng = make_engine()
+    n_probe = 3 * b
+    t0 = time.perf_counter()
+    for i in range(n_probe):
+        eng.add_request(f"p{i}", prompts[i % len(prompts)],
+                        SamplingParams(max_tokens=new_tokens))
+    done = 0
+    deadline = time.perf_counter() + 300
+    while done < n_probe and time.perf_counter() < deadline:
+        if not eng.step():
+            time.sleep(0.001)
+        for i in range(n_probe):
+            done += sum(o.finished for o in eng.get_outputs(f"p{i}"))
+    capacity_rps = done / max(time.perf_counter() - t0, 1e-9)
+
+    out = {"capacity_rps": round(capacity_rps, 3),
+           "max_batch": b, "prompt_len": prompt_len,
+           "new_tokens": new_tokens}
+    qos_cycle = ("interactive", "standard", "batch")
+    for mult, tag in ((0.5, "x0.5"), (1.0, "x1"), (3.0, "x3")):
+        eng = make_engine()
+        rate = max(capacity_rps * mult, 1e-3)
+        n_req = 6 * b
+        arrivals = np.cumsum(
+            np.random.default_rng(7).exponential(1.0 / rate, n_req))
+        shed = 0
+        submitted: dict = {}     # rid -> (qos, t_submit)
+        ttft: dict = {}          # rid -> first-output latency (s)
+        finished: set = set()
+        generated = 0
+        brownout_max = 0
+        nxt = 0
+        t0 = time.perf_counter()
+        deadline = t0 + 300
+        while (nxt < n_req or len(finished) < len(submitted)) \
+                and time.perf_counter() < deadline:
+            now = time.perf_counter() - t0
+            while nxt < n_req and arrivals[nxt] <= now:
+                rid = f"o{nxt}"
+                sp = SamplingParams(
+                    max_tokens=new_tokens,
+                    qos=qos_cycle[nxt % 3],
+                    tenant=f"tenant-{nxt % 2}")
+                try:
+                    eng.add_request(rid, prompts[nxt % len(prompts)], sp)
+                    submitted[rid] = (sp.qos, time.perf_counter())
+                except RequestShed:
+                    shed += 1
+                nxt += 1
+            if not eng.step():
+                time.sleep(0.001)
+            brownout_max = max(brownout_max, eng.overload.level)
+            for rid, (q, ts) in list(submitted.items()):
+                if rid in finished:
+                    continue
+                for o in eng.get_outputs(rid):
+                    if o.new_token_ids and rid not in ttft:
+                        ttft[rid] = time.perf_counter() - ts
+                    generated += len(o.new_token_ids)
+                    if o.finished:
+                        finished.add(rid)
+        wall = time.perf_counter() - t0
+        by_qos = {q: sorted(v for r, v in ttft.items()
+                            if submitted[r][0] == q)
+                  for q in qos_cycle}
+        lane = {
+            "offered_rps": round(rate, 3),
+            "n_requests": n_req,
+            "admitted": len(submitted),
+            "completed": len(finished),
+            "generated_tokens": int(generated),
+            "wall_s": round(wall, 2),
+            "ttft_p99_ms": {
+                q: (round(1000 * float(np.percentile(v, 99)), 1)
+                    if v else None)
+                for q, v in by_qos.items()},
+        }
+        if mult <= 1.0:
+            # gated: any shed or brownout below capacity is a bug
+            lane["shed_total"] = shed
+            lane["brownout_level_max"] = brownout_max
+        else:
+            # shedding is the POINT at 3x — gate only the goodput
+            # (tokens of admitted-and-served work per second)
+            lane["goodput_tokens_per_s"] = round(
+                generated / max(wall, 1e-9), 1)
+            lane["shed_count"] = shed
+            lane["shed_rate"] = round(shed / n_req, 3)
+            lane["brownout_level_peak"] = brownout_max
+        out[tag] = lane
+    return out
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from bench import _parse_kv_sweep, _probe_backend, chip_peaks
@@ -291,6 +413,15 @@ def main() -> None:
                         cfg.num_key_value_heads, cfg.hd, "bf16")["total"],
         dtype="bf16", slots=batch)
     out["memory"] = memory_report(ledger)
+    # open-loop overload lane: capacity probe then Poisson arrivals at
+    # 0.5x/1x/3x — bench_diff gates its shed/brownout (<=1x must stay
+    # zero) and 3x goodput rows
+    try:
+        out["overload"] = run_overload_bench(
+            model, cfg, max_seq, prompt_len, new_tokens)
+    except Exception as e:
+        failed_lanes.append("overload")
+        out["overload"] = {"error": f"{type(e).__name__}: {e}"}
     if kv_sweep:
         # --kv-cache-dtype rows: aggregate throughput + per-stream TPOT
         # + exact cache footprint (eval_shape, no allocation) per dtype

@@ -39,6 +39,11 @@ Kinds and the injection points they attach to:
   process stays alive and its HTTP threads keep answering, so this
   exercises wedge detection (`/health` heartbeat) and the router's
   hang-kill-restart path rather than crash handling.
+- ``overload_storm``  — force the engine's brownout pressure signal to
+  ``pressure=`` (default 1.0) on steps where the clause fires (point
+  ``"storm"``), driving the overload ladder (serving/overload.py)
+  deterministically without real traffic: brownout escalation, QoS
+  shedding, and hysteresis recovery all become scriptable.
 
 Trigger params (every kind):
 
@@ -55,6 +60,8 @@ Trigger params (every kind):
   ``replica_hang`` a bounded freeze instead of forever).
 - ``slot=i``        — target row (``nan_logits`` only).
 - ``code=C``        — process exit code (``replica_crash`` only).
+- ``pressure=P``    — forced brownout pressure in [0, 1]
+  (``overload_storm`` only; default 1.0).
 
 Example: ``step_exception@p=0.05,seed=7;slow_step@ms=500,every=10``.
 """
@@ -71,7 +78,8 @@ import numpy as np
 FAULT_SPEC_ENV = "BIGDL_TPU_FAULT_SPEC"
 
 KINDS = ("step_exception", "admit_exception", "prefill_exception",
-         "nan_logits", "slow_step", "replica_crash", "replica_hang")
+         "nan_logits", "slow_step", "replica_crash", "replica_hang",
+         "overload_storm")
 
 #: default exit code for replica_crash — what an external ``kill -9``
 #: surfaces as through the shell (128 + SIGKILL)
@@ -86,7 +94,7 @@ _RAISE_POINTS = {
 
 _INT_PARAMS = ("after_step", "at_step", "every", "times", "seed", "slot",
                "code")
-_FLOAT_PARAMS = ("p", "ms")
+_FLOAT_PARAMS = ("p", "ms", "pressure")
 
 
 class InjectedFault(RuntimeError):
@@ -114,6 +122,7 @@ class FaultClause:
     ms: float = 0.0
     slot: Optional[int] = None
     code: Optional[int] = None        # replica_crash exit code
+    pressure: float = 1.0             # overload_storm forced pressure
     # runtime state
     fired: int = 0
     visits: int = 0
@@ -182,6 +191,10 @@ def parse_fault_spec(spec: str) -> List[FaultClause]:
                     f"fault param {key!r}={val!r} is not numeric") from None
         if kw.get("p", 0.0) and not (0.0 < kw["p"] <= 1.0):  # type: ignore
             raise ValueError(f"fault probability p={kw['p']} not in (0, 1]")
+        pr = kw.get("pressure")
+        if pr is not None and not (0.0 <= pr <= 1.0):  # type: ignore
+            raise ValueError(
+                f"overload_storm pressure={pr} not in [0, 1]")
         clauses.append(FaultClause(kind=kind, **kw))  # type: ignore[arg-type]
     return clauses
 
@@ -280,6 +293,22 @@ class FaultInjector:
                 self._fired("slow_step", point, step)
                 total += c.ms
         return total
+
+    def storm_pressure(self, step: int) -> Optional[float]:
+        """Forced brownout pressure for this step, or None when no
+        ``overload_storm`` clause fires. Multiple firing clauses take
+        the max. The engine feeds the result into its overload
+        controller IN PLACE OF the measured pressure floor, so a chaos
+        test drives the full brownout ladder without real load."""
+        if not self.clauses:
+            return None
+        forced: Optional[float] = None
+        for c in self._by_kind.get("overload_storm", ()):
+            if c.should_fire(step):
+                self._fired("overload_storm", "storm", step)
+                forced = c.pressure if forced is None \
+                    else max(forced, c.pressure)
+        return forced
 
     def poison_rows(self, step: int, active_rows) -> List[int]:
         """Rows of the decode logits to overwrite with NaN this step

@@ -1,10 +1,17 @@
 from bigdl_tpu.serving.engine import (  # noqa: F401
     EngineConfig,
+    EngineDraining,
     LLMEngine,
     LogprobEntry,
     Request,
     RequestOutput,
     SamplingParams,
+)
+from bigdl_tpu.serving.overload import (  # noqa: F401
+    QOS_CLASSES,
+    OverloadConfig,
+    OverloadController,
+    RequestShed,
 )
 from bigdl_tpu.serving.router import (  # noqa: F401
     Router,

@@ -35,6 +35,8 @@ completions return token ids (useful for tests and token-level clients).
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import json
 import select
 import socket
@@ -47,6 +49,7 @@ from typing import Any, List, Optional
 from bigdl_tpu.observability.compile_watch import compiles_in_progress
 from bigdl_tpu.serving.engine import (EngineDraining, LLMEngine,
                                       SamplingParams)
+from bigdl_tpu.serving.overload import RequestShed
 
 #: engine finish reasons that map to HTTP 504 (the request ran out of
 #: time: its own deadline, or the server's drain window closed on it)
@@ -236,11 +239,26 @@ class OpenAIServer:
                          if body.get("max_time_ms") is not None
                          else None),
             ignore_eos=bool(body.get("ignore_eos", False)),
+            qos=(str(body["qos"]) if body.get("qos") else None),
         )
+
+    @staticmethod
+    def _tenant_of(headers) -> str:
+        """Tenant identity for fair queuing / rate limits: explicit
+        X-Tenant-Id header, else a stable hash of the API key
+        (Authorization header), else the shared 'default' bucket."""
+        tid = headers.get("X-Tenant-Id")
+        if tid:
+            return str(tid)[:64]
+        auth = headers.get("Authorization")
+        if auth:
+            return "key-" + hashlib.sha256(
+                auth.encode("utf-8", "replace")).hexdigest()[:12]
+        return "default"
 
     def _run_request(self, token_ids, params, stream_cb=None,
                      stop_strs=(), disconnect_check=None,
-                     cancel_cb=None):
+                     cancel_cb=None, rid=None):
         """Returns (rid, {index: ids}, {index: logprob entries},
         {index: finish_reason}, {index: final text}, {index: error}).
 
@@ -256,10 +274,14 @@ class OpenAIServer:
         the request is aborted: the engine frees the slot AND drops the
         prompt's prefix-cache entry, so a hung-up client stops costing
         HBM immediately. `cancel_cb()` fires exactly once on such a
-        client-driven cancellation (the counter hook)."""
-        rid = f"cmpl-{uuid.uuid4().hex[:16]}"
-        self.engine.add_request(rid, token_ids, params)
-        self.loop.notify()
+        client-driven cancellation (the counter hook). When `rid` is
+        given the request was already added to the engine (the HTTP
+        layer admits BEFORE committing stream headers, so an admission
+        shed can still be a clean 429/503); otherwise add here."""
+        if rid is None:
+            rid = f"cmpl-{uuid.uuid4().hex[:16]}"
+            self.engine.add_request(rid, token_ids, params)
+            self.loop.notify()
         out_ids: dict = {}
         out_lps: dict = {}
         reasons: dict = {}
@@ -445,6 +467,25 @@ class OpenAIServer:
                         "retry_after": retry}},
                     headers=(("Retry-After", str(retry)),))
 
+            def _shed_response(self, e: RequestShed):
+                # early load shedding: the overload controller refused
+                # admission (bounded queue, rate limit, doomed-work
+                # test, or brownout) — 429 for per-tenant limits, 503
+                # for server-wide pressure, both with a Retry-After
+                # computed from the measured drain rate and ledger
+                # headroom so clients back off for the right duration
+                retry = int(e.retry_after_sec)
+                return self._json(
+                    e.http_status, {"error": {
+                        "message": f"request shed ({e.reason}): "
+                                   f"{e.detail or 'server overloaded'}",
+                        "type": ("rate_limited" if e.http_status == 429
+                                 else "overloaded"),
+                        "code": e.http_status, "reason": e.reason,
+                        "qos": e.qos, "tenant": e.tenant,
+                        "retry_after": retry}},
+                    headers=(("Retry-After", str(retry)),))
+
             def do_GET(self):
                 if self.path == "/v1/models":
                     self._json(200, {"object": "list", "data": [
@@ -523,6 +564,8 @@ class OpenAIServer:
                         return self._profiler(body, start=False)
                 except EngineDraining:
                     return self._draining_503()
+                except RequestShed as e:
+                    return self._shed_response(e)
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
                 self._json(404, {"error": "not found"})
@@ -581,6 +624,8 @@ class OpenAIServer:
                     prompt = body.get("prompt", "")
                 ids = server._encode(prompt)
                 params = server._params(body)
+                params = dataclasses.replace(
+                    params, tenant=server._tenant_of(self.headers))
                 stops = body.get("stop") or ()
                 if isinstance(stops, str):
                     stops = (stops,)
@@ -591,6 +636,13 @@ class OpenAIServer:
                 # then a streaming response is already half-written)
                 if server.engine.draining:
                     return self._draining_503()
+                # admit BEFORE the stream branch for the same reason:
+                # overload control (RequestShed -> 429/503 +
+                # Retry-After, handled in do_POST) must reject doomed
+                # work as a clean status line, not a broken SSE body
+                rid = f"cmpl-{uuid.uuid4().hex[:16]}"
+                server.engine.add_request(rid, ids, params)
+                server.loop.notify()
 
                 if body.get("stream"):
                     self.send_response(200)
@@ -622,7 +674,8 @@ class OpenAIServer:
                             disconnect_check=lambda:
                                 _socket_disconnected(self.connection),
                             cancel_cb=lambda: server._cancelled.labels(
-                                "stream").inc())
+                                "stream").inc(),
+                            rid=rid)
                     try:
                         self.wfile.write(b"data: [DONE]\n\n")
                         self.wfile.flush()
@@ -636,7 +689,8 @@ class OpenAIServer:
                         disconnect_check=lambda: _socket_disconnected(
                             self.connection),
                         cancel_cb=lambda: server._cancelled.labels(
-                            "nonstream").inc())
+                            "nonstream").inc(),
+                        rid=rid)
                 # robustness status mapping: a request that ran out of
                 # time (its own deadline, or the drain window closing on
                 # it) is a gateway timeout; a quarantined request is a

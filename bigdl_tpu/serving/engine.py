@@ -32,6 +32,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import math
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -56,6 +57,9 @@ from bigdl_tpu.ops.kvcache import (KVCache, init_cache, kv_cache_bytes,
 from bigdl_tpu.robustness import (resolve_drain_timeout_sec,
                                   resolve_request_deadline_ms)
 from bigdl_tpu.robustness.faults import FaultInjector
+from bigdl_tpu.serving.overload import (QOS_CLASSES, SHED_REASONS,
+                                        OverloadConfig, OverloadController,
+                                        RequestShed)
 
 
 class EngineDraining(RuntimeError):
@@ -94,6 +98,13 @@ class SamplingParams:
     # (HTTP 504 at the API server) wherever it is in its lifecycle —
     # queued, mid-prefill, or decoding.
     max_time_ms: Optional[float] = None
+    # overload control (serving/overload.py): QoS class — one of
+    # "interactive"/"standard"/"batch" (admission priority + who sheds
+    # first under pressure); None defers to $BIGDL_TPU_QOS_DEFAULT.
+    qos: Optional[str] = None
+    # tenant key for fair queuing and rate limits (the API server fills
+    # it from X-Tenant-Id / the API-key hash); empty = "default"
+    tenant: str = "default"
 
     @property
     def needs_counts(self) -> bool:
@@ -218,6 +229,16 @@ class EngineConfig:
     # begin_drain() before being failed with reason "drain_timeout".
     # None defers to $BIGDL_TPU_DRAIN_TIMEOUT_SEC (default 30).
     drain_timeout_sec: Optional[float] = None
+    # hard bound on queued requests (waiting + CP lanes), enforced at
+    # add_request with a RequestShed (HTTP 503) even when every other
+    # overload feature is off — an unbounded deque under a traffic
+    # storm is an OOM. None defers to $BIGDL_TPU_MAX_QUEUE_DEPTH
+    # (default 256). Shorthand for overload.max_queue_depth.
+    max_queue_depth: Optional[int] = None
+    # full overload-control policy (QoS aging, tenant rate limits,
+    # queue byte caps, brownout thresholds); None resolves every knob
+    # from its $BIGDL_TPU_* env variable (serving/overload.py)
+    overload: Optional[OverloadConfig] = None
 
 
 class _Slot:
@@ -290,6 +311,10 @@ class _Admission:
     bucket: int
     consumed: int
     cache1: KVCache
+    # effective prefill chunk, FROZEN at admission start: a brownout
+    # level change mid-admission must not change the chunk width the
+    # private cache was sized for
+    chunk: int
 
 
 class LLMEngine:
@@ -404,6 +429,33 @@ class LLMEngine:
         self._any_deadline = False      # fast path: skip expiry scans
         self._consec_failures = 0       # consecutive failing step()s
         self._retry_total = 0           # lifetime retried steps
+
+        # -- overload control (serving/overload.py): QoS priorities,
+        # tenant fair queuing + rate limits, bounded queues with early
+        # shedding, and the brownout ladder. Always constructed — the
+        # queue-depth hard bound protects even deployments that leave
+        # every policy knob at its default.
+        try:
+            oc = ce.overload or OverloadConfig()
+            if ce.max_queue_depth is not None:
+                oc = dataclasses.replace(
+                    oc, max_queue_depth=ce.max_queue_depth)
+            self.overload = OverloadController(oc)
+        except ValueError:
+            # env_check reports the bad knob; serve with pure defaults
+            self.overload = OverloadController(OverloadConfig(
+                qos_default="standard", qos_aging_sec=5.0,
+                tenant_rps=0.0, tenant_tps=0.0, tenant_burst=4.0,
+                brownout_high=0.85, brownout_low=0.6,
+                max_queue_depth=ce.max_queue_depth or 256,
+                max_queue_bytes=64 << 20))
+        # decode-step latency EWMA + its observed floor: the queue-wait
+        # admission test and the brownout latency-inflation signal
+        self._tpot_ewma = 0.0
+        self._tpot_floor: Optional[float] = None
+        # recent finish timestamps -> measured drain rate (Retry-After)
+        self._finish_times: "collections.deque[float]" = \
+            collections.deque(maxlen=64)
 
         # context-parallel overflow lane (long prompts)
         self._cp_mesh = cp_mesh
@@ -622,6 +674,25 @@ class LLMEngine:
         self._m_draining = m.gauge(
             "bigdl_tpu_engine_draining",
             "1 while the engine refuses new requests (graceful drain).")
+        self._m_shed = m.counter(
+            "bigdl_tpu_requests_shed_total",
+            "Requests rejected at admission by overload control, by "
+            "shed reason and QoS class.", labelnames=("reason", "qos"))
+        for r in SHED_REASONS:           # render from scrape 1
+            for q in QOS_CLASSES:
+                self._m_shed.labels(r, q)
+        self._m_brownout = m.gauge(
+            "bigdl_tpu_brownout_level",
+            "Brownout degradation level (0 healthy ... 3 shedding "
+            "batch QoS at admission).")
+        self._m_brownout.set(0)
+        self._m_tenant_queued = m.gauge(
+            "bigdl_tpu_tenant_queue_depth",
+            "Queued requests per tenant.", labelnames=("tenant",))
+        self._m_tenant_reqs = m.counter(
+            "bigdl_tpu_tenant_requests_total",
+            "Per-tenant admission outcomes.",
+            labelnames=("tenant", "outcome"))
         # batched-cache storage footprint per component (codes vs scales);
         # shapes are static for the engine lifetime, so set once
         publish_kv_cache_bytes(self.cache, m)
@@ -689,6 +760,21 @@ class LLMEngine:
                        else self._request_deadline_ms)
         if deadline_ms is not None:
             self._any_deadline = True
+        # -- overload control: validate QoS, run every early-shedding
+        # test (RequestShed -> HTTP 429/503 with Retry-After), and
+        # apply the brownout max_tokens cap — all BEFORE any engine
+        # state is created for the request
+        qos = params.qos or self.overload.cfg.qos_default
+        if qos not in QOS_CLASSES:
+            raise ValueError(
+                f"qos must be one of {QOS_CLASSES}, got {qos!r}")
+        params = dataclasses.replace(
+            params, qos=qos, tenant=params.tenant or "default")
+        self._overload_admit(request_id, ids, params, deadline_ms,
+                             best_of)
+        cap = self.overload.max_tokens_cap()
+        if cap is not None and params.max_tokens > cap:
+            params = dataclasses.replace(params, max_tokens=cap)
         with self._lock:
             self._outputs[request_id] = []
         target = self._cp_waiting if long else self.waiting
@@ -754,6 +840,106 @@ class LLMEngine:
                 self._outputs[request_id] = []
         return out
 
+    @property
+    def speculative_allowed(self) -> bool:
+        """False while browned out (level >= 1): speculative lookahead
+        is the first work shed under pressure. Speculative drivers
+        (bigdl_tpu/speculative.py harnesses) must consult this before
+        each propose/verify round when serving through an engine."""
+        return self.overload.speculative_allowed
+
+    # -- overload control ----------------------------------------------------
+
+    def _queue_bytes(self) -> int:
+        """Summed prompt footprint (int32 ids) of every queued request
+        — recomputed on demand so it can never drift from the queues
+        themselves (admission, expiry, preemption and aborts all
+        mutate them)."""
+        return 4 * (sum(len(r.prompt_token_ids) for r in self.waiting)
+                    + sum(len(r.prompt_token_ids)
+                          for r in self._cp_waiting))
+
+    def _drain_rate(self) -> float:
+        """Measured drain rate in finished requests/sec over the
+        recent finish window (0.0 until two finishes land)."""
+        ft = self._finish_times
+        if len(ft) >= 2 and ft[-1] > ft[0]:
+            return (len(ft) - 1) / (ft[-1] - ft[0])
+        return 0.0
+
+    def _shed_retry_after(self) -> int:
+        """Retry-After seconds for a capacity shed: time for the
+        current backlog to drain at the measured rate (TPOT-based
+        estimate before any request finished), floored higher while
+        the memory ledger reports thin headroom — a memory-bound
+        engine drains slower than its request rate suggests."""
+        depth = len(self.waiting) + len(self._cp_waiting)
+        rate = self._drain_rate()
+        if rate > 0:
+            est = depth / rate
+        else:
+            est = max(1.0, depth * max(self._tpot_ewma, 0.01))
+        hr = self.ledger.headroom()
+        hb, lim = hr.get("headroom_bytes"), hr.get("bytes_limit")
+        if hb is not None and lim and hb < 0.1 * lim:
+            est = max(est, 5.0)
+        return max(1, min(60, int(math.ceil(est))))
+
+    def _overload_admit(self, request_id: str, ids: List[int],
+                        params: SamplingParams,
+                        deadline_ms: Optional[float],
+                        n_seqs: int) -> None:
+        """Run the controller's early-shedding tests for one incoming
+        request; on shed, count + breadcrumb and re-raise."""
+        depth = len(self.waiting) + len(self._cp_waiting)
+        try:
+            self.overload.check_admission(
+                qos=params.qos, tenant=params.tenant, n_seqs=n_seqs,
+                prompt_len=len(ids), queue_depth=depth,
+                queue_bytes=self._queue_bytes(),
+                deadline_sec=(deadline_ms / 1000.0
+                              if deadline_ms is not None else None),
+                tpot_sec=self._tpot_ewma,
+                retry_after_sec=self._shed_retry_after(),
+                now=time.monotonic())
+        except RequestShed as e:
+            self._m_shed.labels(e.reason, e.qos).inc()
+            self._m_tenant_reqs.labels(e.tenant, "shed").inc()
+            self.flight.record(
+                "shed", step=self._step_idx, request_id=request_id,
+                reason=e.reason, qos=e.qos, tenant=e.tenant,
+                retry_after_sec=e.retry_after_sec, queue_depth=depth,
+                brownout_level=self.overload.level)
+            raise
+        self._m_tenant_reqs.labels(params.tenant, "admitted").inc()
+
+    def _overload_pressure(self) -> float:
+        """Measured pressure in [0, 1]: worst of queue-depth ratio,
+        memory-ledger headroom exhaustion, and decode-step latency
+        inflation over its observed floor (3x the floor saturates)."""
+        p = ((len(self.waiting) + len(self._cp_waiting))
+             / max(1, self.overload.cfg.max_queue_depth))
+        hr = self.ledger.headroom()
+        hb, lim = hr.get("headroom_bytes"), hr.get("bytes_limit")
+        if hb is not None and lim:
+            p = max(p, 1.0 - hb / lim)
+        if self._tpot_floor and self._tpot_ewma > self._tpot_floor:
+            p = max(p, min(1.0, (self._tpot_ewma / self._tpot_floor
+                                 - 1.0) / 2.0))
+        return min(1.0, max(0.0, p))
+
+    def _update_brownout(self) -> None:
+        pressure = self._overload_pressure()
+        storm = self.faults.storm_pressure(self._step_idx)
+        if storm is not None:
+            pressure = max(pressure, storm)
+        if self.overload.update_pressure(pressure) is not None:
+            self._m_brownout.set(self.overload.level)
+            self.flight.record(
+                "brownout", step=self._step_idx,
+                level=self.overload.level, pressure=round(pressure, 4),
+                speculative_allowed=self.overload.speculative_allowed)
+
     # -- engine internals ---------------------------------------------------
 
     def _bucket(self, n: int) -> int:
@@ -784,12 +970,22 @@ class LLMEngine:
                          if not s.active), None)
             if free is None:
                 return
+            # overload-aware scheduling replaces pure FCFS: strict QoS
+            # priority with aging promotion, then least-served tenant
+            # (deficit round-robin, quantum 1), then arrival order. The
+            # pick runs over a snapshot (HTTP threads append
+            # concurrently) and is removed by identity.
             req = None
-            while req is None and self.waiting:
-                try:
-                    cand = self.waiting.popleft()
-                except IndexError:
+            while req is None:
+                snapshot = list(self.waiting)
+                if not snapshot:
                     return
+                cand = snapshot[self.overload.select_index(
+                    snapshot, time.time())]
+                try:
+                    self.waiting.remove(cand)
+                except ValueError:
+                    return               # raced with another mutation
                 if cand.request_id in self._abort:
                     # aborted while still queued: the client is owed a
                     # finished output or its poll loop never ends
@@ -799,8 +995,6 @@ class LLMEngine:
                     self._obs_finish(cand.request_id, "abort")
                     cand = None
                 req = cand
-            if req is None:
-                return
             # headroom guard: the admission's private prefill cache is
             # the one new HBM allocation this path makes — defer (FCFS
             # order kept, request back at the FRONT) while it would
@@ -822,11 +1016,15 @@ class LLMEngine:
                         bytes_limit=hr.get("bytes_limit"))
                 return
             self._deferred_streak = False
+            self.overload.note_scheduled(req.params.tenant or "default")
             # private cache sized to a chunk multiple (>= bucket) so no
             # chunk write can straddle the end; _insert clips the splice
-            # back down to the batched cache's max_seq
+            # back down to the batched cache's max_seq. Brownout level
+            # >= 2 shrinks the chunk (still a power of two) so admission
+            # work yields to in-flight decodes sooner under pressure.
             bucket = self._bucket(len(req.prompt_token_ids))
-            chunk = min(self._chunk, bucket)
+            chunk = min(max(1, self._chunk
+                            >> self.overload.chunk_shift()), bucket)
             alloc = -(-bucket // chunk) * chunk
             cache1 = init_cache(
                 self.cfg.num_hidden_layers, 1, alloc,
@@ -853,7 +1051,7 @@ class LLMEngine:
                                  jnp.asarray(consumed, jnp.int32),
                                  ksb, vsb)
             a = self._admitting = _Admission(req, free, bucket, consumed,
-                                             cache1)
+                                             cache1, chunk)
             self.tracer.admitted(req.request_id)
             self.flight.record(
                 "admit_start", step=self._step_idx,
@@ -871,7 +1069,7 @@ class LLMEngine:
             return
 
         plen = len(a.req.prompt_token_ids)
-        chunk = min(self._chunk, a.bucket)
+        chunk = a.chunk
         padded = np.zeros((1, chunk), np.int32)
         part = a.req.prompt_token_ids[a.consumed:a.consumed + chunk]
         padded[0, :len(part)] = part
@@ -1234,12 +1432,23 @@ class LLMEngine:
             if d is not None and d >= 0:
                 self._m_phase.labels("decode").observe(d)
         self._m_finished.labels(reason).inc()
+        self._finish_times.append(time.time())   # drain-rate window
         self.flight.record("finish", step=self._step_idx, request_id=rid,
                            reason=reason, n_generated=n_generated)
 
     def _update_gauges(self) -> None:
         self._m_occupancy.set(sum(1 for s in self.slots if s.active))
         self._m_queue_depth.set(len(self.waiting) + len(self._cp_waiting))
+        # brownout ladder: one pressure sample per working step (the
+        # overload_storm fault overrides the measured signal here)
+        self._update_brownout()
+        tq: Dict[str, int] = {}
+        for q in (self.waiting, self._cp_waiting):
+            for r in q:
+                t = getattr(r.params, "tenant", None) or "default"
+                tq[t] = tq.get(t, 0) + 1
+        for t in self.overload.tenants:
+            self._m_tenant_queued.labels(t).set(tq.get(t, 0))
         # hbm gauges: the ledger throttles its own device poll
         # ($BIGDL_TPU_MEMORY_POLL_SEC), so per-step publish is cheap
         self.ledger.publish(self.registry)
@@ -1261,6 +1470,15 @@ class LLMEngine:
         }
         return snap
 
+    def _overload_snapshot(self) -> dict:
+        """The stats_snapshot "overload" block: controller state plus
+        the engine-side load measurements it feeds on."""
+        ov = self.overload.snapshot()
+        ov["queue_bytes"] = self._queue_bytes()
+        ov["tpot_ewma_ms"] = round(self._tpot_ewma * 1000.0, 3)
+        ov["drain_rate_rps"] = round(self._drain_rate(), 3)
+        return ov
+
     def stats_snapshot(self) -> dict:
         """JSON-ready engine state for `GET /v1/stats`: live occupancy,
         queue depths, metric summaries, recent request spans, and the
@@ -1279,6 +1497,7 @@ class LLMEngine:
             "requests": self.tracer.snapshot(),
             "compile_table": compile_table(),
             "memory": self.memory_snapshot(),
+            "overload": self._overload_snapshot(),
             "robustness": {
                 "step_heartbeat_age_sec": round(
                     self.step_heartbeat_age(), 3),
@@ -1369,6 +1588,10 @@ class LLMEngine:
             RequestOutput(s.req.request_id, [s.last_token], False,
                           logprobs=[lp] if want_lp else None))
         self._m_tokens.inc()
+        # post-paid tenant token-rate accounting: future admissions of
+        # a tenant in debt shed with 429 until its bucket refills
+        self.overload.note_generated(s.req.params.tenant or "default",
+                                     1, time.monotonic())
 
     def _check_done(self, idx: int) -> bool:
         s = self.slots[idx]
@@ -1789,6 +2012,27 @@ class LLMEngine:
                 self._abort.discard(s.req.request_id)
                 self._finish(i, "abort")
 
+        # queued aborts: sweep the waiting queues every step so an
+        # abandoned client's request frees its queue slot NOW — not
+        # when it finally reaches the queue front (under a storm that
+        # could be minutes of a dead request occupying bounded-queue
+        # capacity and inflating every wait estimate)
+        if self._abort and (self.waiting or self._cp_waiting):
+            for q in (self.waiting, self._cp_waiting):
+                if not any(r.request_id in self._abort for r in q):
+                    continue
+                keep = []
+                for r in q:
+                    if r.request_id in self._abort:
+                        self._abort.discard(r.request_id)
+                        self._push_output(r.request_id, RequestOutput(
+                            r.request_id, [], True, "abort"))
+                        self._obs_finish(r.request_id, "abort")
+                    else:
+                        keep.append(r)
+                q.clear()
+                q.extend(keep)
+
         # per-request deadlines (skip the scan entirely until the first
         # deadline-carrying request arrives)
         if self._any_deadline:
@@ -1924,7 +2168,14 @@ class LLMEngine:
             self._check_done(i)
         # one batched step advances EVERY active stream one token, so
         # step wall time IS each stream's time-per-output-token
-        self._m_tpot.observe(time.perf_counter() - t_decode0)
+        dt = time.perf_counter() - t_decode0
+        self._m_tpot.observe(dt)
+        # EWMA + observed floor feed the queue-wait admission test and
+        # the brownout latency-inflation signal
+        self._tpot_ewma = (dt if self._tpot_ewma == 0.0
+                           else 0.8 * self._tpot_ewma + 0.2 * dt)
+        if self._tpot_floor is None or self._tpot_ewma < self._tpot_floor:
+            self._tpot_floor = self._tpot_ewma
         self._m_steps.inc()
         self._flight_step("decode", len(active))
         self._update_gauges()

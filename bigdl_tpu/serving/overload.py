@@ -1,0 +1,583 @@
+"""Overload control for the serving engine: QoS, tenants, shedding.
+
+The engine's admission path (PRs 2-6) hardened what happens *after* a
+request is admitted — fault isolation, deadlines, drain, failover. This
+module is the policy tier *at* admission:
+
+* **QoS classes** — every request carries one of ``interactive`` /
+  ``standard`` / ``batch`` (``SamplingParams.qos``). Admission is
+  strict-priority with aging: a queued request is promoted one class
+  per ``qos_aging_sec`` waited, so batch work cannot starve forever.
+* **Per-tenant accounting** — token buckets bound each tenant's
+  request rate and generated-token rate (429 when exhausted), and
+  admission round-robins across tenants inside a QoS class
+  (deficit-round-robin with a one-request quantum), so one hot tenant
+  cannot starve the rest of the queue.
+* **Bounded queues + early shedding** — queue-depth and queue-bytes
+  caps, plus a queue-wait test (estimated wait from the measured TPOT
+  EWMA x queue depth vs. the request's deadline) reject doomed work
+  with 503 + ``Retry-After`` *before* it burns a slot. Batch sheds
+  first: each class only fills its fraction of the depth cap
+  (batch 50%, standard 75%, interactive 100%).
+* **Brownout** — a pressure signal in [0, 1] (queue-depth ratio,
+  memory-ledger headroom, step-latency inflation) drives a 4-level
+  ladder with hysteresis::
+
+      level 0  healthy    full service
+      level 1  warm       speculative lookahead off, max_tokens capped
+      level 2  hot        + prefill chunk shrunk, tighter token cap
+      level 3  melting    + batch-QoS requests shed at admission
+
+  Escalation needs ``pressure >= brownout_high`` for
+  ``BROWNOUT_ENGAGE_STEPS`` consecutive updates; recovery needs
+  ``pressure <= brownout_low`` for ``BROWNOUT_RECOVER_STEPS`` — both
+  the threshold gap and the dwell are hysteresis, so the ladder does
+  not flap at the boundary.
+
+Everything here is pure policy over plain Python state: no JAX, no
+locks beyond the engine's own, and fully deterministic given the same
+sequence of (clock, event) inputs — which is what lets the
+``overload_storm`` chaos fault drive the whole ladder reproducibly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Dict, Optional, Sequence
+
+__all__ = [
+    "QOS_CLASSES",
+    "QOS_PRIORITY",
+    "OverloadConfig",
+    "OverloadController",
+    "RequestShed",
+    "resolve_qos_default",
+    "resolve_qos_aging_sec",
+    "resolve_tenant_rps",
+    "resolve_tenant_tps",
+    "resolve_tenant_burst",
+    "resolve_brownout_high",
+    "resolve_brownout_low",
+    "resolve_max_queue_depth",
+    "resolve_max_queue_bytes",
+]
+
+#: QoS classes in priority order (lower index admits first)
+QOS_CLASSES = ("interactive", "standard", "batch")
+QOS_PRIORITY = {name: i for i, name in enumerate(QOS_CLASSES)}
+
+#: fraction of the depth cap each class may fill — batch sheds first,
+#: interactive may use the whole queue
+QOS_DEPTH_FRACTION = {"interactive": 1.0, "standard": 0.75, "batch": 0.5}
+
+#: absolute max_tokens cap per brownout level (None = uncapped)
+BROWNOUT_MAX_TOKENS = (None, 256, 64, 16)
+
+#: right-shift applied to the prefill chunk per brownout level (chunk
+#: stays a power of two, so bucket allocation alignment is preserved)
+BROWNOUT_CHUNK_SHIFT = (0, 0, 2, 2)
+
+BROWNOUT_LEVELS = 3            # max level
+BROWNOUT_ENGAGE_STEPS = 3      # consecutive high-pressure updates to go up
+BROWNOUT_RECOVER_STEPS = 10    # consecutive low-pressure updates to go down
+
+#: rough queue footprint accounting: int32 token ids
+_BYTES_PER_TOKEN = 4
+
+
+# ---------------------------------------------------------------------------
+# env knobs
+
+
+def resolve_qos_default(raw: Optional[str] = None) -> str:
+    """$BIGDL_TPU_QOS_DEFAULT — QoS class for requests that name none
+    (default "standard")."""
+    if raw is None:
+        raw = os.environ.get("BIGDL_TPU_QOS_DEFAULT", "")
+    raw = raw.strip().lower()
+    if not raw:
+        return "standard"
+    if raw not in QOS_CLASSES:
+        raise ValueError(
+            f"BIGDL_TPU_QOS_DEFAULT must be one of {QOS_CLASSES}, "
+            f"got {raw!r}")
+    return raw
+
+
+def resolve_qos_aging_sec(raw: Optional[str] = None) -> float:
+    """$BIGDL_TPU_QOS_AGING_SEC — seconds of queue wait that promote a
+    request one QoS class (anti-starvation; default 5.0, must be > 0)."""
+    if raw is None:
+        raw = os.environ.get("BIGDL_TPU_QOS_AGING_SEC", "")
+    if not raw.strip():
+        return 5.0
+    val = float(raw)
+    if val <= 0:
+        raise ValueError(
+            f"BIGDL_TPU_QOS_AGING_SEC must be > 0, got {val}")
+    return val
+
+
+def resolve_tenant_rps(raw: Optional[str] = None) -> float:
+    """$BIGDL_TPU_TENANT_RPS — per-tenant request-rate limit in
+    requests/sec (default 0 = unlimited, must be >= 0)."""
+    if raw is None:
+        raw = os.environ.get("BIGDL_TPU_TENANT_RPS", "")
+    if not raw.strip():
+        return 0.0
+    val = float(raw)
+    if val < 0:
+        raise ValueError(f"BIGDL_TPU_TENANT_RPS must be >= 0, got {val}")
+    return val
+
+
+def resolve_tenant_tps(raw: Optional[str] = None) -> float:
+    """$BIGDL_TPU_TENANT_TPS — per-tenant generated-token-rate limit in
+    tokens/sec (default 0 = unlimited, must be >= 0)."""
+    if raw is None:
+        raw = os.environ.get("BIGDL_TPU_TENANT_TPS", "")
+    if not raw.strip():
+        return 0.0
+    val = float(raw)
+    if val < 0:
+        raise ValueError(f"BIGDL_TPU_TENANT_TPS must be >= 0, got {val}")
+    return val
+
+
+def resolve_tenant_burst(raw: Optional[str] = None) -> float:
+    """$BIGDL_TPU_TENANT_BURST — token-bucket burst multiplier: a
+    tenant's bucket holds ``burst x rate`` units (default 4.0,
+    must be >= 1)."""
+    if raw is None:
+        raw = os.environ.get("BIGDL_TPU_TENANT_BURST", "")
+    if not raw.strip():
+        return 4.0
+    val = float(raw)
+    if val < 1:
+        raise ValueError(
+            f"BIGDL_TPU_TENANT_BURST must be >= 1, got {val}")
+    return val
+
+
+def resolve_brownout_high(raw: Optional[str] = None) -> float:
+    """$BIGDL_TPU_BROWNOUT_HIGH — pressure at/above which brownout
+    escalates one level (default 0.85, must be in (0, 1])."""
+    if raw is None:
+        raw = os.environ.get("BIGDL_TPU_BROWNOUT_HIGH", "")
+    if not raw.strip():
+        return 0.85
+    val = float(raw)
+    if not 0 < val <= 1:
+        raise ValueError(
+            f"BIGDL_TPU_BROWNOUT_HIGH must be in (0, 1], got {val}")
+    return val
+
+
+def resolve_brownout_low(raw: Optional[str] = None) -> float:
+    """$BIGDL_TPU_BROWNOUT_LOW — pressure at/below which brownout
+    recovers one level (default 0.6, must be in [0, 1) and below the
+    high threshold for real hysteresis)."""
+    if raw is None:
+        raw = os.environ.get("BIGDL_TPU_BROWNOUT_LOW", "")
+    if not raw.strip():
+        return 0.6
+    val = float(raw)
+    if not 0 <= val < 1:
+        raise ValueError(
+            f"BIGDL_TPU_BROWNOUT_LOW must be in [0, 1), got {val}")
+    return val
+
+
+def resolve_max_queue_depth(raw: Optional[str] = None) -> int:
+    """$BIGDL_TPU_MAX_QUEUE_DEPTH — hard bound on total queued requests
+    across the decode and chunked-prefill waiting queues (default 256,
+    must be > 0). Enforced even when every other overload feature is
+    off: an unbounded deque under a storm is an OOM."""
+    if raw is None:
+        raw = os.environ.get("BIGDL_TPU_MAX_QUEUE_DEPTH", "")
+    if not raw.strip():
+        return 256
+    val = int(raw)
+    if val <= 0:
+        raise ValueError(
+            f"BIGDL_TPU_MAX_QUEUE_DEPTH must be > 0, got {val}")
+    return val
+
+
+def resolve_max_queue_bytes(raw: Optional[str] = None) -> int:
+    """$BIGDL_TPU_MAX_QUEUE_BYTES — cap on the summed prompt footprint
+    of queued requests (int32 token ids; default 64 MiB, must be > 0)."""
+    if raw is None:
+        raw = os.environ.get("BIGDL_TPU_MAX_QUEUE_BYTES", "")
+    if not raw.strip():
+        return 64 << 20
+    val = int(raw)
+    if val <= 0:
+        raise ValueError(
+            f"BIGDL_TPU_MAX_QUEUE_BYTES must be > 0, got {val}")
+    return val
+
+
+# ---------------------------------------------------------------------------
+# config / exception
+
+
+@dataclasses.dataclass
+class OverloadConfig:
+    """Policy knobs; ``None`` defers to the matching env knob."""
+
+    qos_default: Optional[str] = None
+    qos_aging_sec: Optional[float] = None
+    tenant_rps: Optional[float] = None       # 0 = unlimited
+    tenant_tps: Optional[float] = None       # 0 = unlimited
+    tenant_burst: Optional[float] = None
+    brownout_high: Optional[float] = None
+    brownout_low: Optional[float] = None
+    max_queue_depth: Optional[int] = None
+    max_queue_bytes: Optional[int] = None
+
+    def resolve(self) -> "OverloadConfig":
+        return OverloadConfig(
+            qos_default=(self.qos_default if self.qos_default is not None
+                         else resolve_qos_default()),
+            qos_aging_sec=(self.qos_aging_sec
+                           if self.qos_aging_sec is not None
+                           else resolve_qos_aging_sec()),
+            tenant_rps=(self.tenant_rps if self.tenant_rps is not None
+                        else resolve_tenant_rps()),
+            tenant_tps=(self.tenant_tps if self.tenant_tps is not None
+                        else resolve_tenant_tps()),
+            tenant_burst=(self.tenant_burst
+                          if self.tenant_burst is not None
+                          else resolve_tenant_burst()),
+            brownout_high=(self.brownout_high
+                           if self.brownout_high is not None
+                           else resolve_brownout_high()),
+            brownout_low=(self.brownout_low
+                          if self.brownout_low is not None
+                          else resolve_brownout_low()),
+            max_queue_depth=(self.max_queue_depth
+                             if self.max_queue_depth is not None
+                             else resolve_max_queue_depth()),
+            max_queue_bytes=(self.max_queue_bytes
+                             if self.max_queue_bytes is not None
+                             else resolve_max_queue_bytes()),
+        )
+
+
+class RequestShed(RuntimeError):
+    """Raised by admission when a request is rejected by overload
+    control. Maps to HTTP 429 (per-tenant rate limits) or 503
+    (capacity), always with a ``Retry-After`` hint."""
+
+    def __init__(self, reason: str, qos: str, tenant: str,
+                 retry_after_sec: int, http_status: int, detail: str = ""):
+        self.reason = reason
+        self.qos = qos
+        self.tenant = tenant
+        self.retry_after_sec = max(1, int(retry_after_sec))
+        self.http_status = int(http_status)
+        self.detail = detail
+        msg = detail or f"request shed: {reason}"
+        super().__init__(
+            f"{msg} (qos={qos}, tenant={tenant}, "
+            f"retry_after={self.retry_after_sec}s)")
+
+
+#: every shed reason x its HTTP status — pre-labelled into the shed
+#: counter so all series render from the first scrape
+SHED_REASONS = {
+    "queue_full": 503,       # class depth cap (hard cap for interactive)
+    "queue_bytes": 503,      # summed prompt footprint cap
+    "rate_limit": 429,       # tenant request-rate bucket empty
+    "token_rate": 429,       # tenant generated-token bucket in debt
+    "doomed": 503,           # cannot finish before its own deadline
+    "brownout": 503,         # level-3 brownout sheds batch QoS
+}
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` units/sec refill, ``capacity``
+    max. ``rate == 0`` disables the bucket (always admits). The level
+    may go negative via :meth:`charge` (post-paid debt, used for
+    generated tokens whose count is only known after the fact)."""
+
+    def __init__(self, rate: float, capacity: float):
+        self.rate = float(rate)
+        self.capacity = max(float(capacity), 1.0)
+        self.level = self.capacity
+        self._last = None  # type: Optional[float]
+
+    def _refill(self, now: float) -> None:
+        if self._last is None:
+            self._last = now
+            return
+        dt = max(0.0, now - self._last)
+        self._last = now
+        self.level = min(self.capacity, self.level + dt * self.rate)
+
+    def try_take(self, n: float, now: float) -> bool:
+        """Take ``n`` units if available; False (and no change) if not
+        (or if the bucket is in post-paid debt)."""
+        if self.rate <= 0:
+            return True
+        self._refill(now)
+        if self.level < n:
+            return False
+        self.level -= n
+        return True
+
+    def charge(self, n: float, now: float) -> None:
+        """Post-paid: deduct ``n`` units, allowing the level to go
+        negative. Future :meth:`try_take` calls fail until the debt
+        refills."""
+        if self.rate <= 0:
+            return
+        self._refill(now)
+        self.level -= n
+
+    def wait_sec(self, n: float, now: float) -> float:
+        """Seconds until ``n`` units will be available."""
+        if self.rate <= 0:
+            return 0.0
+        self._refill(now)
+        deficit = n - self.level
+        return max(0.0, deficit / self.rate)
+
+
+class _Tenant:
+    """Per-tenant accounting: rate buckets + fairness/served counters."""
+
+    def __init__(self, cfg: OverloadConfig):
+        self.rps = TokenBucket(cfg.tenant_rps,
+                               cfg.tenant_rps * cfg.tenant_burst)
+        self.tps = TokenBucket(cfg.tenant_tps,
+                               cfg.tenant_tps * cfg.tenant_burst)
+        self.admitted_total = 0
+        self.shed_total = 0
+        self.generated_total = 0
+        # DRR state: requests admitted since the controller started —
+        # admission picks the least-served tenant inside a QoS class,
+        # which is deficit round-robin with a one-request quantum
+        self.served = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "admitted_total": self.admitted_total,
+            "shed_total": self.shed_total,
+            "generated_total": self.generated_total,
+            "rps_level": round(self.rps.level, 3),
+            "tps_level": round(self.tps.level, 3),
+        }
+
+
+# ---------------------------------------------------------------------------
+# controller
+
+
+class OverloadController:
+    """All overload policy state for one engine. The engine owns the
+    clock (passes ``now`` explicitly) so tests and the
+    ``overload_storm`` fault stay deterministic."""
+
+    def __init__(self, config: Optional[OverloadConfig] = None):
+        self.cfg = (config or OverloadConfig()).resolve()
+        if self.cfg.brownout_low >= self.cfg.brownout_high:
+            raise ValueError(
+                "brownout_low must be < brownout_high for hysteresis "
+                f"(got low={self.cfg.brownout_low} >= "
+                f"high={self.cfg.brownout_high})")
+        self.tenants: Dict[str, _Tenant] = {}
+        self.level = 0
+        self.pressure = 0.0
+        self._hi_streak = 0
+        self._lo_streak = 0
+        self.shed_counts: Dict[str, int] = {r: 0 for r in SHED_REASONS}
+        self.level_changes = 0
+
+    # -- tenants ----------------------------------------------------------
+
+    def tenant(self, name: str) -> _Tenant:
+        t = self.tenants.get(name)
+        if t is None:
+            t = self.tenants[name] = _Tenant(self.cfg)
+        return t
+
+    def note_generated(self, tenant: str, n_tokens: int,
+                       now: float) -> None:
+        """Charge ``n_tokens`` generated tokens to the tenant's
+        token-rate bucket (post-paid: admission only checks for debt)."""
+        t = self.tenant(tenant)
+        t.generated_total += n_tokens
+        t.tps.charge(n_tokens, now)
+
+    # -- admission --------------------------------------------------------
+
+    def depth_limit(self, qos: str) -> int:
+        """Per-class queue-depth cap: batch sheds at 50% of the hard
+        cap, standard at 75%, interactive at 100%."""
+        frac = QOS_DEPTH_FRACTION.get(qos, 1.0)
+        return max(1, int(self.cfg.max_queue_depth * frac))
+
+    def check_admission(self, *, qos: str, tenant: str, n_seqs: int,
+                        prompt_len: int, queue_depth: int,
+                        queue_bytes: int, deadline_sec: Optional[float],
+                        tpot_sec: float, retry_after_sec: int,
+                        now: float) -> None:
+        """Run every early-shedding test; raises :class:`RequestShed`
+        on the first failure. ``retry_after_sec`` is the engine's
+        drain-rate / ledger-headroom estimate for capacity sheds;
+        rate-limit sheds compute their own from the bucket refill."""
+        t = self.tenant(tenant)
+
+        def shed(reason: str, retry: int, detail: str = ""):
+            t.shed_total += 1
+            self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+            raise RequestShed(reason, qos, tenant, retry,
+                              SHED_REASONS[reason], detail)
+
+        # 1. brownout level 3: shed batch work outright
+        if self.level >= BROWNOUT_LEVELS and qos == "batch":
+            shed("brownout", retry_after_sec,
+                 "engine browned out: batch QoS is shed until pressure "
+                 "recedes")
+
+        # 2. per-class queue depth (the interactive limit IS the hard
+        # cap, so the bound holds even for the highest class)
+        if queue_depth + n_seqs > self.depth_limit(qos):
+            shed("queue_full", retry_after_sec,
+                 f"queue depth {queue_depth} at the {qos} admission "
+                 f"limit {self.depth_limit(qos)}")
+
+        # 3. queue bytes
+        add_bytes = n_seqs * prompt_len * _BYTES_PER_TOKEN
+        if queue_bytes + add_bytes > self.cfg.max_queue_bytes:
+            shed("queue_bytes", retry_after_sec,
+                 f"queued prompt footprint {queue_bytes}B + {add_bytes}B "
+                 f"exceeds cap {self.cfg.max_queue_bytes}B")
+
+        # 4. tenant request-rate bucket
+        if not t.rps.try_take(n_seqs, now):
+            shed("rate_limit",
+                 int(math.ceil(t.rps.wait_sec(n_seqs, now))) or 1,
+                 f"tenant {tenant!r} over its request-rate limit "
+                 f"({self.cfg.tenant_rps}/s)")
+
+        # 5. tenant generated-token bucket (post-paid: shed while in
+        # debt from previously generated tokens)
+        if t.tps.rate > 0:
+            t.tps.wait_sec(0.0, now)  # refill to "now" before the check
+            if t.tps.level < 0:
+                shed("token_rate",
+                     int(math.ceil(-t.tps.level / t.tps.rate)) or 1,
+                     f"tenant {tenant!r} over its generated-token limit "
+                     f"({self.cfg.tenant_tps} tok/s)")
+
+        # 6. queue-wait test: if the backlog alone outlasts the
+        # request's deadline, it is doomed — reject now instead of
+        # burning queue+slot time and failing with 504 later
+        if deadline_sec is not None and tpot_sec > 0:
+            est_wait = tpot_sec * queue_depth
+            if est_wait > deadline_sec:
+                shed("doomed", retry_after_sec,
+                     f"estimated queue wait {est_wait:.2f}s exceeds the "
+                     f"request deadline {deadline_sec:.2f}s")
+
+        t.admitted_total += n_seqs
+
+    # -- scheduling -------------------------------------------------------
+
+    def effective_priority(self, qos: str, waited_sec: float) -> int:
+        """Strict priority with aging: one class of promotion per
+        ``qos_aging_sec`` waited (floor at the top class)."""
+        pr = QOS_PRIORITY.get(qos, QOS_PRIORITY["standard"])
+        if self.cfg.qos_aging_sec > 0:
+            pr -= int(waited_sec / self.cfg.qos_aging_sec)
+        return max(0, pr)
+
+    def select_index(self, waiting: Sequence, now: float) -> int:
+        """Pick the queue index to admit next: best effective priority
+        first, then the least-served tenant (DRR, quantum 1), then
+        queue order. Queue POSITION is the FCFS tiebreaker — not
+        arrival time — so a preempted request requeued at the back
+        yields to work that has never run (arrival still drives
+        aging). Pure — call :meth:`note_scheduled` only once the pick
+        is actually admitted (memory deferral may put it back)."""
+        best_i, best_key = 0, None
+        for i, req in enumerate(waiting):
+            qos = getattr(req.params, "qos", None) or "standard"
+            tenant = getattr(req.params, "tenant", None) or "default"
+            pr = self.effective_priority(qos, now - req.arrival)
+            key = (pr, self.tenant(tenant).served, i)
+            if best_key is None or key < best_key:
+                best_i, best_key = i, key
+        return best_i
+
+    def note_scheduled(self, tenant: str) -> None:
+        """Advance the tenant's DRR counter after a successful pick."""
+        self.tenant(tenant).served += 1
+
+    # -- brownout ---------------------------------------------------------
+
+    def update_pressure(self, pressure: float) -> Optional[int]:
+        """Feed one pressure sample; returns the new level if it
+        changed, else None. Hysteresis: both a threshold gap
+        (high/low) and a dwell (consecutive samples) gate transitions."""
+        self.pressure = max(0.0, min(1.0, float(pressure)))
+        if self.pressure >= self.cfg.brownout_high:
+            self._hi_streak += 1
+            self._lo_streak = 0
+        elif self.pressure <= self.cfg.brownout_low:
+            self._lo_streak += 1
+            self._hi_streak = 0
+        else:
+            self._hi_streak = 0
+            self._lo_streak = 0
+        if self._hi_streak >= BROWNOUT_ENGAGE_STEPS \
+                and self.level < BROWNOUT_LEVELS:
+            self.level += 1
+            self._hi_streak = 0
+            self.level_changes += 1
+            return self.level
+        if self._lo_streak >= BROWNOUT_RECOVER_STEPS and self.level > 0:
+            self.level -= 1
+            self._lo_streak = 0
+            self.level_changes += 1
+            return self.level
+        return None
+
+    @property
+    def speculative_allowed(self) -> bool:
+        """Speculative lookahead is the first work a brownout sheds."""
+        return self.level == 0
+
+    def max_tokens_cap(self) -> Optional[int]:
+        return BROWNOUT_MAX_TOKENS[min(self.level,
+                                       len(BROWNOUT_MAX_TOKENS) - 1)]
+
+    def chunk_shift(self) -> int:
+        return BROWNOUT_CHUNK_SHIFT[min(self.level,
+                                        len(BROWNOUT_CHUNK_SHIFT) - 1)]
+
+    # -- introspection ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "brownout_level": self.level,
+            "pressure": round(self.pressure, 4),
+            "speculative_allowed": self.speculative_allowed,
+            "max_tokens_cap": self.max_tokens_cap(),
+            "chunk_shift": self.chunk_shift(),
+            "max_queue_depth": self.cfg.max_queue_depth,
+            "max_queue_bytes": self.cfg.max_queue_bytes,
+            "shed": {k: v for k, v in sorted(self.shed_counts.items())
+                     if v},
+            "tenants": {name: t.snapshot()
+                        for name, t in sorted(self.tenants.items())},
+        }

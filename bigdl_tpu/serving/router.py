@@ -219,6 +219,7 @@ class JournalEntry:
     replays: int = 0
     hedged: bool = False
     admitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    tenant: Optional[str] = None       # X-Tenant-Id to forward
 
 
 class RequestJournal:
@@ -274,6 +275,8 @@ class Replica:
         self.inflight: set = set()       # router-assigned request ids
         self.occupancy = 0.0             # active/total slots (probed)
         self.queue_depth = 0
+        self.brownout = 0                # engine brownout level (probed)
+        self.tenants: dict = {}          # per-tenant counters (probed)
         # circuit breaker
         self.breaker = "closed"          # closed | open | half_open
         self.breaker_failures = 0
@@ -297,7 +300,21 @@ class Replica:
             "inflight": len(self.inflight),
             "occupancy": self.occupancy,
             "queue_depth": self.queue_depth,
+            "brownout": self.brownout,
         }
+
+
+def _retry_after_headers(data: bytes) -> tuple:
+    """Rebuild the Retry-After header from a buffered shed/drain
+    response body (the replica's header was consumed with the
+    buffered read; its JSON error block carries the same value)."""
+    try:
+        ra = json.loads(data).get("error", {}).get("retry_after")
+        if ra:
+            return (("Retry-After", str(int(ra))),)
+    except (ValueError, AttributeError, TypeError):
+        pass
+    return ()
 
 
 def _free_port(host: str = "127.0.0.1") -> int:
@@ -585,6 +602,9 @@ class Router:
             total = max(int(slots.get("total", 1)), 1)
             r.occupancy = float(slots.get("active", 0)) / total
             r.queue_depth = int(doc.get("queue_depth", 0))
+            ov = doc.get("overload") or {}
+            r.brownout = int(ov.get("brownout_level", 0))
+            r.tenants = ov.get("tenants") or {}
         except (OSError, ValueError):
             pass
 
@@ -648,11 +668,15 @@ class Router:
         if not candidates:
             raise NoReplica()
         affinity = self.replicas[key % n]
-        if affinity in candidates and affinity.occupancy < 1.0:
+        # a browned-out replica is degrading service to protect itself:
+        # prefix affinity is not worth routing INTO the pressure, and
+        # the least-loaded fallback prefers the lowest brownout level
+        if affinity in candidates and affinity.occupancy < 1.0 \
+                and affinity.brownout == 0:
             return affinity
         return min(candidates,
-                   key=lambda r: (r.occupancy, r.queue_depth,
-                                  len(r.inflight), r.idx))
+                   key=lambda r: (r.brownout, r.occupancy,
+                                  r.queue_depth, len(r.inflight), r.idx))
 
     def _pick_wait(self, key: int, exclude: Dict[int, int],
                    deadline: float) -> Replica:
@@ -683,7 +707,31 @@ class Router:
         """Seconds until a fresh replica is plausibly routable."""
         return max(1, int(round(2 * self.cfg.health_sec)))
 
+    @staticmethod
+    def _tenant_of(headers) -> Optional[str]:
+        """Same identity derivation as the replica api_server (explicit
+        X-Tenant-Id, else a stable API-key hash) so router-fronted and
+        direct traffic land in the same per-tenant buckets."""
+        tid = headers.get("X-Tenant-Id")
+        if tid:
+            return str(tid)[:64]
+        auth = headers.get("Authorization")
+        if auth:
+            return "key-" + hashlib.sha256(
+                auth.encode("utf-8", "replace")).hexdigest()[:12]
+        return None
+
     # -- forwarding ---------------------------------------------------------
+
+    @staticmethod
+    def _fwd_headers(entry: JournalEntry) -> Dict[str, str]:
+        """Headers for a replica forward: the client's tenant identity
+        must survive the hop or every request lands in the replica's
+        shared 'default' rate-limit bucket."""
+        h = {"Content-Type": "application/json"}
+        if entry.tenant:
+            h["X-Tenant-Id"] = entry.tenant
+        return h
 
     def _forward_buffered(self, r: Replica, entry: JournalEntry
                           ) -> Tuple[int, bytes]:
@@ -697,7 +745,7 @@ class Router:
             self.host, r.port, timeout=self.cfg.connect_timeout_sec)
         try:
             conn.request("POST", entry.path, body=entry.body,
-                         headers={"Content-Type": "application/json"})
+                         headers=self._fwd_headers(entry))
             conn.sock.settimeout(self.cfg.forward_timeout_sec)
             resp = conn.getresponse()
             return resp.status, resp.read()
@@ -798,9 +846,25 @@ class Router:
                                "spent", "type": "replica_lost",
                     "code": 502, "replays": entry.replays,
                     "retry_after": self.retry_after_hint()}}).encode()
+            if status == 429:
+                # per-tenant rate limit: every replica enforces the
+                # same tenant budget, so re-routing would just evade
+                # it — propagate verbatim (Retry-After preserved by
+                # the handler), no replay burn, no breaker hit
+                self._breaker_success(used)
+                self.counts["shed_429"] += 1
+                self.flight.record("shed_429", rid=entry.rid,
+                                   replica=used.idx,
+                                   tenant=entry.tenant or "default")
+                self.counts["requests"] += 1
+                self._c_requests.labels(str(used.idx),
+                                        str(status)).inc()
+                return status, data
             if status == 503:
-                # the replica is shedding (drain race): someone else
-                # takes it; re-route burns no replay budget
+                # the replica is shedding (drain race or overload):
+                # someone else takes it; re-route burns no replay
+                # budget — only when every replica shed does the 503
+                # reach the client
                 exclude[used.idx] = used.generation
                 reroutes += 1
                 self.counts["rerouted_503"] += 1
@@ -892,12 +956,25 @@ class Router:
 
     # -- introspection ------------------------------------------------------
 
+    def _tenant_aggregate(self) -> dict:
+        """Fleet-wide per-tenant counters: the sum of every replica's
+        probed overload.tenants block (admitted/shed/generated)."""
+        agg: Dict[str, collections.Counter] = {}
+        for r in self.replicas:
+            for name, t in (r.tenants or {}).items():
+                acc = agg.setdefault(str(name), collections.Counter())
+                for k, v in t.items():
+                    if isinstance(v, (int, float)):
+                        acc[k] += v
+        return {name: dict(c) for name, c in sorted(agg.items())}
+
     def stats_snapshot(self) -> dict:
         """JSON-ready router state for ``GET /v1/router/stats`` (and
         the bench JSON's ``router`` block)."""
         return {
             "replicas": [r.snapshot() for r in self.replicas],
             "journal_depth": self.journal.depth(),
+            "tenants": self._tenant_aggregate(),
             "counters": {k: int(v) for k, v in sorted(
                 self.counts.items())},
             "rolling_restart_in_progress": self._rolling,
@@ -1017,7 +1094,8 @@ class Router:
                     rid=f"rtr-{uuid.uuid4().hex[:12]}",
                     path=self.path, body=raw,
                     stream=bool(body.get("stream")),
-                    key=router._affinity_key(body))
+                    key=router._affinity_key(body),
+                    tenant=router._tenant_of(self.headers))
                 router.journal.admit(entry)   # write-ahead
                 try:
                     if entry.stream:
@@ -1025,9 +1103,10 @@ class Router:
                     else:
                         status, data = router.route_buffered(entry)
                         headers = ()
-                        if status == 503:
-                            headers = (("Retry-After",
-                                        str(router.retry_after_hint())),)
+                        if status in (429, 503):
+                            headers = _retry_after_headers(data) or (
+                                ("Retry-After",
+                                 str(router.retry_after_hint())),)
                         self._json(status, data, headers=headers)
                 finally:
                     router.journal.complete(entry.rid)
@@ -1062,8 +1141,7 @@ class Router:
                         try:
                             conn.request(
                                 "POST", entry.path, body=entry.body,
-                                headers={"Content-Type":
-                                         "application/json"})
+                                headers=router._fwd_headers(entry))
                             conn.sock.settimeout(
                                 router.cfg.forward_timeout_sec)
                             resp = conn.getresponse()
@@ -1086,6 +1164,15 @@ class Router:
                                 "message": "replica failed before the "
                                            "stream started",
                                 "type": "replica_lost", "code": 502}})
+                        if resp.status == 429:
+                            # tenant rate limit: same budget on every
+                            # replica — propagate, don't re-route
+                            data = resp.read()
+                            router._breaker_success(r)
+                            router.counts["shed_429"] += 1
+                            return self._json(
+                                429, data,
+                                headers=_retry_after_headers(data))
                         if resp.status == 503 \
                                 and reroutes <= len(router.replicas):
                             resp.read()

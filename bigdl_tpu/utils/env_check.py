@@ -21,6 +21,8 @@ import sys
 KNOWN_ENV = (
     "BIGDL_TPU_AOT_TARGET",
     "BIGDL_TPU_ATTENTION_BACKEND",
+    "BIGDL_TPU_BROWNOUT_HIGH",
+    "BIGDL_TPU_BROWNOUT_LOW",
     "BIGDL_TPU_COMPILE_CACHE",
     "BIGDL_TPU_COMPILE_MEMORY",
     "BIGDL_TPU_DISABLE_NATIVE",
@@ -34,12 +36,16 @@ KNOWN_ENV = (
     "BIGDL_TPU_MATMUL_BACKEND",
     "BIGDL_TPU_MATMUL_GEMV",
     "BIGDL_TPU_MATMUL_PALLAS_MAX_M",
+    "BIGDL_TPU_MAX_QUEUE_BYTES",
+    "BIGDL_TPU_MAX_QUEUE_DEPTH",
     "BIGDL_TPU_MAX_SEQ",
     "BIGDL_TPU_MEMORY_POLL_SEC",
     "BIGDL_TPU_MOE_DISPATCH",
     "BIGDL_TPU_MXU_LAYOUT",
     "BIGDL_TPU_NATIVE_CACHE",
     "BIGDL_TPU_POSTMORTEM_DIR",
+    "BIGDL_TPU_QOS_AGING_SEC",
+    "BIGDL_TPU_QOS_DEFAULT",
     "BIGDL_TPU_QUANTIZE_KV_CACHE",
     "BIGDL_TPU_RECOMPILE_WARN",
     "BIGDL_TPU_REQUEST_DEADLINE_MS",
@@ -47,6 +53,9 @@ KNOWN_ENV = (
     "BIGDL_TPU_ROUTER_HEALTH_SEC",
     "BIGDL_TPU_ROUTER_HEDGE_MS",
     "BIGDL_TPU_ROUTER_REPLICAS",
+    "BIGDL_TPU_TENANT_BURST",
+    "BIGDL_TPU_TENANT_RPS",
+    "BIGDL_TPU_TENANT_TPS",
 )
 
 
@@ -246,6 +255,38 @@ def collect() -> dict:
         except ValueError as e:
             info[key] = {"value": raw, "valid": False, "error": str(e)}
 
+    # overload-control knobs (QoS / per-tenant limits / bounded queue /
+    # brownout thresholds): the engine falls back to defaults on bad
+    # values, so range errors surface here instead
+    overload_knobs = (
+        ("qos_default", "BIGDL_TPU_QOS_DEFAULT", "resolve_qos_default"),
+        ("qos_aging_sec", "BIGDL_TPU_QOS_AGING_SEC",
+         "resolve_qos_aging_sec"),
+        ("tenant_rps", "BIGDL_TPU_TENANT_RPS", "resolve_tenant_rps"),
+        ("tenant_tps", "BIGDL_TPU_TENANT_TPS", "resolve_tenant_tps"),
+        ("tenant_burst", "BIGDL_TPU_TENANT_BURST",
+         "resolve_tenant_burst"),
+        ("brownout_high", "BIGDL_TPU_BROWNOUT_HIGH",
+         "resolve_brownout_high"),
+        ("brownout_low", "BIGDL_TPU_BROWNOUT_LOW",
+         "resolve_brownout_low"),
+        ("max_queue_depth", "BIGDL_TPU_MAX_QUEUE_DEPTH",
+         "resolve_max_queue_depth"),
+        ("max_queue_bytes", "BIGDL_TPU_MAX_QUEUE_BYTES",
+         "resolve_max_queue_bytes"),
+    )
+    for key, envname, fname in overload_knobs:
+        raw = os.environ.get(envname)
+        if not raw:
+            continue
+        from bigdl_tpu.serving import overload as _overload
+
+        try:
+            info[key] = {"value": getattr(_overload, fname)(raw),
+                         "valid": True}
+        except ValueError as e:
+            info[key] = {"value": raw, "valid": False, "error": str(e)}
+
     typos = find_env_typos()
     if typos:
         info["env_typos"] = typos
@@ -275,6 +316,15 @@ def main() -> int:
           and info.get("router_replicas", {}).get("valid", True)
           and info.get("router_hedge_ms", {}).get("valid", True)
           and info.get("router_crash_budget", {}).get("valid", True)
+          and info.get("qos_default", {}).get("valid", True)
+          and info.get("qos_aging_sec", {}).get("valid", True)
+          and info.get("tenant_rps", {}).get("valid", True)
+          and info.get("tenant_tps", {}).get("valid", True)
+          and info.get("tenant_burst", {}).get("valid", True)
+          and info.get("brownout_high", {}).get("valid", True)
+          and info.get("brownout_low", {}).get("valid", True)
+          and info.get("max_queue_depth", {}).get("valid", True)
+          and info.get("max_queue_bytes", {}).get("valid", True)
           and not info.get("env_typos")
           and info.get("postmortem_dir", {}).get("writable", True))
     print("status :", "OK" if ok else "PROBLEMS FOUND")

@@ -41,6 +41,12 @@ METRIC_DIRECTIONS = {
     "weight_bytes": "lower",
     "serving_tokens_per_s": "higher",
     "tokens_per_s": "higher",
+    # overload lanes (bench_serving "overload" block): at <=1x offered
+    # load shed_total/brownout_level_max must stay zero (any growth is
+    # inf% and flags), at 3x goodput dropping is the regression
+    "goodput_tokens_per_s": "higher",
+    "shed_total": "lower",
+    "brownout_level_max": "lower",
     "decode_mfu": "higher",
     "prefill_mfu": "higher",
     "decode_hbm_roofline_util": "higher",
@@ -64,6 +70,7 @@ ROBUSTNESS_COUNTERS = (
     "bigdl_tpu_requests_quarantined_total",
     "bigdl_tpu_step_retries_total",
     "bigdl_tpu_requests_cancelled_total",
+    "bigdl_tpu_requests_shed_total",
     "bigdl_tpu_router_failovers_total",
     "bigdl_tpu_router_replays_total",
     "bigdl_tpu_router_breaker_trips_total",
@@ -79,6 +86,7 @@ ROUTER_COUNTERS = {
     "breaker_trips": "lower",
     "quarantined": "lower",
     "rerouted_503": "lower",
+    "shed_429": "lower",
     "stream_errors": "lower",
 }
 
