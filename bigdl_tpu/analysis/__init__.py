@@ -1,0 +1,55 @@
+"""graftlint: AST static analysis for JAX hazards and lock discipline.
+
+Three rule families guard the serving stack's riskiest Python-side bug
+classes before they cost a bench run:
+
+* **JAX hazards** (:mod:`.jax_rules`) — host-device syncs inside
+  jit-traced code and on the engine step path, raw ``jax.jit`` outside
+  the tracked wrapper, trace-time nondeterminism, missing buffer
+  donation, recompile-prone static scalars.
+* **Lock discipline** (:mod:`.locks`) — infers which attributes are
+  guarded by which ``threading.Lock`` from ``with self._lock:``
+  bodies, then flags unguarded access to guarded state and inverted
+  nested lock orders across the engine/router/overload threads.
+* **Ratcheted baseline** (:mod:`.core`) — accepted findings live in
+  ``tools/graftlint_baseline.json``; the gate fails on anything new,
+  and the baseline may only shrink.
+
+Run it as ``python -m bigdl_tpu.analysis`` (or the ``graftlint``
+console script / ``tools/graftlint.py``); the tier-1 test
+``tests/test_graftlint.py`` runs the same entry points in-process.
+The analyzer itself is pure stdlib (ast + json + pathlib) and never
+executes or imports the code it inspects.
+"""
+
+from bigdl_tpu.analysis.core import (  # noqa: F401
+    RULES,
+    AnalysisResult,
+    Finding,
+    analyze,
+    baseline_fingerprints,
+    iter_package_files,
+    load_baseline,
+    new_findings,
+    ratchet_violations,
+    render_baseline,
+)
+from bigdl_tpu.analysis.jax_rules import (  # noqa: F401
+    RAW_JIT_ALLOWLIST,
+    RAW_JIT_MESSAGE,
+)
+
+__all__ = [
+    "RULES",
+    "AnalysisResult",
+    "Finding",
+    "analyze",
+    "baseline_fingerprints",
+    "iter_package_files",
+    "load_baseline",
+    "new_findings",
+    "ratchet_violations",
+    "render_baseline",
+    "RAW_JIT_ALLOWLIST",
+    "RAW_JIT_MESSAGE",
+]

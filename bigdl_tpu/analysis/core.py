@@ -1,0 +1,271 @@
+"""graftlint core: findings, suppressions, the ratcheted baseline.
+
+The analyzer is pure stdlib (ast + json) on purpose: it inspects
+source text only and never executes or imports the code it scans — a
+module with a broken import or a TPU-only dependency still lints. Rule logic lives in :mod:`.jax_rules` (tracing /
+host-sync hazards) and :mod:`.locks` (lock discipline); this module
+owns what a finding IS, how an inline suppression works, and how the
+baseline may evolve (shrink-only).
+
+Suppressions
+------------
+A line comment ``# graftlint: disable=<rule>[,<rule>...]`` (or
+``disable=all``) suppresses findings anchored to that line. Suppressed
+findings are counted and reported but never fail the gate — they are
+the audited-exception mechanism.
+
+Baseline ratchet
+----------------
+``tools/graftlint_baseline.json`` stores the findings the repo has
+accepted (legacy debt). The gate fails on any finding whose
+fingerprint is not covered by the baseline, and the baseline may only
+shrink: an update that would RAISE any rule's count is refused.
+Fingerprints are line-number-free (rule + file + enclosing object +
+normalized source snippet) so ordinary code motion does not churn
+them.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: rule catalog: name -> one-line description (the README table renders
+#: from the same strings)
+RULES: Dict[str, str] = {
+    "jax-raw-jit":
+        "raw jax.jit( call outside the tracked_jit allowlist",
+    "jax-host-sync-in-jit":
+        "host-device sync (.item()/np.*/float()/device_get) inside a "
+        "jit-traced function",
+    "jax-nondet-in-jit":
+        "wall-clock or Python/numpy RNG call inside a jit-traced "
+        "function (baked in at trace time)",
+    "jax-missing-donate":
+        "jit whose first arg is a KV-cache/params pytree without "
+        "donate_argnums covering it",
+    "jax-scalar-signature":
+        "unbounded Python scalar (len()/arithmetic) in a static jit "
+        "position: one compile per distinct value",
+    "step-host-sync":
+        "per-element or looped host-device pull on the engine step "
+        "path (pull once, index in numpy)",
+    "lock-guarded-unlocked":
+        "attribute written under a lock accessed without holding it",
+    "lock-order-inversion":
+        "two locks acquired in opposite nested orders (deadlock risk)",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit, anchored to a source line."""
+
+    rule: str
+    path: str          # repo-relative posix path
+    line: int          # 1-based
+    obj: str           # enclosing context, e.g. "LLMEngine._sample_host"
+    message: str
+    snippet: str       # stripped source line (fingerprint component)
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity: survives code motion, dies when
+        the offending line itself changes (which is the point — a
+        changed line must be re-audited)."""
+        snip = " ".join(self.snippet.split())
+        return f"{self.rule}::{self.path}::{self.obj}::{snip}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule}: "
+                f"{self.message} [{self.obj}]")
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "obj": self.obj,
+                "snippet": " ".join(self.snippet.split()),
+                "message": self.message}
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file handed to the rule families."""
+
+    path: pathlib.Path          # absolute
+    rel: str                    # repo-relative posix path
+    tree: ast.AST
+    lines: List[str]
+    suppressions: Dict[int, set] = dataclasses.field(default_factory=dict)
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        rules = self.suppressions.get(lineno)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+
+def parse_suppressions(lines: Sequence[str]) -> Dict[int, set]:
+    out: Dict[int, set] = {}
+    for i, line in enumerate(lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",")
+                      if r.strip()}
+    return out
+
+
+def load_module(path: pathlib.Path,
+                repo_root: Optional[pathlib.Path] = None
+                ) -> Optional[Module]:
+    """Parse one file; returns None on syntax errors (reported by the
+    CLI, not fatal — a broken file fails its own import/tests)."""
+    try:
+        src = path.read_text(encoding="utf-8")
+        tree = ast.parse(src)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    if repo_root is not None:
+        try:
+            rel = path.resolve().relative_to(
+                repo_root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+    else:
+        rel = path.as_posix()
+    lines = src.splitlines()
+    return Module(path=path, rel=rel, tree=tree, lines=lines,
+                  suppressions=parse_suppressions(lines))
+
+
+def iter_package_files(package_dir: pathlib.Path) -> List[pathlib.Path]:
+    return sorted(p for p in package_dir.rglob("*.py")
+                  if "__pycache__" not in p.parts)
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    parse_failures: List[str]
+
+    def counts(self) -> Dict[str, int]:
+        c: Dict[str, int] = {}
+        for f in self.findings:
+            c[f.rule] = c.get(f.rule, 0) + 1
+        return c
+
+
+def analyze(files: Iterable[pathlib.Path],
+            repo_root: Optional[pathlib.Path] = None,
+            rules: Optional[Sequence[str]] = None,
+            step_entries: Optional[dict] = None) -> AnalysisResult:
+    """Run every rule family over ``files``; split findings into live
+    vs inline-suppressed. ``rules`` restricts to a subset by name;
+    ``step_entries`` overrides the engine-step-path roots (tests point
+    it at fixture modules)."""
+    from bigdl_tpu.analysis import jax_rules, locks
+
+    modules: List[Module] = []
+    failures: List[str] = []
+    for p in files:
+        m = load_module(pathlib.Path(p), repo_root)
+        if m is None:
+            failures.append(str(p))
+        else:
+            modules.append(m)
+
+    raw: List[Finding] = []
+    raw += jax_rules.check(modules, step_entries=step_entries)
+    raw += locks.check(modules)
+    if rules is not None:
+        keep = set(rules)
+        raw = [f for f in raw if f.rule in keep]
+
+    by_path = {m.rel: m for m in modules}
+    live, suppressed = [], []
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        m = by_path.get(f.path)
+        if m is not None and m.suppressed(f.rule, f.line):
+            suppressed.append(f)
+        else:
+            live.append(f)
+    return AnalysisResult(live, suppressed, failures)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def load_baseline(path: pathlib.Path) -> dict:
+    """Read the baseline; a missing file is an empty baseline (the
+    strictest one)."""
+    try:
+        doc = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return {"version": 1, "counts": {}, "findings": []}
+    doc.setdefault("counts", {})
+    doc.setdefault("findings", [])
+    return doc
+
+
+def baseline_fingerprints(baseline: dict) -> "collections.Counter":
+    c: collections.Counter = collections.Counter()
+    for e in baseline.get("findings", []):
+        snip = " ".join(str(e.get("snippet", "")).split())
+        c[f"{e.get('rule')}::{e.get('path')}::{e.get('obj')}::{snip}"] += 1
+    return c
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: dict) -> List[Finding]:
+    """Findings not covered by the baseline. Multiplicity-aware: two
+    identical lines need two baseline entries."""
+    budget = baseline_fingerprints(baseline)
+    out = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def ratchet_violations(old: dict, findings: Sequence[Finding]
+                       ) -> List[str]:
+    """Per-rule counts may only shrink. Returns human-readable
+    violations (empty = update allowed)."""
+    new_counts: Dict[str, int] = {}
+    for f in findings:
+        new_counts[f.rule] = new_counts.get(f.rule, 0) + 1
+    old_counts = {k: int(v) for k, v in old.get("counts", {}).items()}
+    out = []
+    for rule, n in sorted(new_counts.items()):
+        if n > old_counts.get(rule, 0):
+            out.append(f"{rule}: {old_counts.get(rule, 0)} -> {n} "
+                       "(baseline may only shrink; fix the new finding "
+                       "or add an audited inline "
+                       f"'# graftlint: disable={rule}')")
+    return out
+
+
+def render_baseline(findings: Sequence[Finding]) -> str:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    doc = {
+        "version": 1,
+        "counts": dict(sorted(counts.items())),
+        "findings": [f.to_json() for f in sorted(
+            findings, key=lambda f: (f.path, f.line, f.rule))],
+    }
+    return json.dumps(doc, indent=2, sort_keys=False) + "\n"

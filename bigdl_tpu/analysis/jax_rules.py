@@ -1,0 +1,779 @@
+"""JAX hazard rules: tracing, host syncs, recompiles, donation.
+
+What counts as "jit-traced code"
+--------------------------------
+A function body is traced when it is
+
+* decorated with ``@tracked_jit(...)``, ``@functools.partial(
+  tracked_jit, ...)``, ``@jax.jit`` or ``@functools.partial(jax.jit,
+  ...)``, or
+* passed (as a ``def`` name or inline ``lambda``) to a
+  ``tracked_jit(...)`` / ``jax.jit(...)`` call in the same module.
+
+Nested ``def``s inside a traced body are traced too (``jax.vmap`` row
+functions and the like). Functions referenced by attribute
+(``tracked_jit("x", family.forward)``) have no visible body here and
+are skipped — the rule set is deliberately intra-module.
+
+Rules
+-----
+``jax-raw-jit``
+    Any ``jax.jit(`` call outside the allowlist (the tracked wrapper
+    itself plus the AOT compile-cost probe). Subsumes the old
+    ``tests/test_no_raw_jit.py`` regex scanner.
+``jax-host-sync-in-jit``
+    ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` /
+    ``jax.device_get`` / ``np.*(...)`` / ``float()``/``int()`` on a
+    TRACED expression inside a traced body: each forces the value onto
+    the host (ConcretizationError at best, a silent per-call D2H sync
+    at worst). Taint starts at the non-static parameters —
+    ``static_argnums``/``static_argnames`` values are plain Python at
+    trace time, so config math like ``float(1 << (qt.bits - 1))``
+    stays silent.
+``jax-nondet-in-jit``
+    ``time.time()``-family or ``random``/``np.random`` calls inside a
+    traced body: evaluated ONCE at trace time and baked into the
+    compiled executable (``jax.random`` is fine — that is the traced
+    RNG).
+``jax-missing-donate``
+    A traced function whose FIRST parameter is a KV cache
+    (``cache``/``cache1``/``kv``/``kv_cache``/``kvcache``) — or a
+    params/state pytree on a train/update step — without
+    ``donate_argnums`` covering position 0. The un-donated buffer
+    doubles peak HBM for the call.
+``jax-scalar-signature``
+    A call to a known jit-wrapped callable passing ``len(...)`` or an
+    arithmetic expression into a ``static_argnums``/``static_argnames``
+    position: every distinct value compiles a fresh executable (bucket
+    or trace the scalar instead).
+``step-host-sync``
+    On the engine step path (methods reachable from
+    ``LLMEngine.step``): a D2H pull (``np.asarray``/``np.array``/
+    ``np.ascontiguousarray``/``jax.device_get``) inside a loop or
+    comprehension, an ``.item()``/``.tolist()``/
+    ``.block_until_ready()`` anywhere, or ``float()``/``int()`` of a
+    subscript whose base is not provably host-resident numpy. The
+    sanctioned pattern is ONE ``np.asarray`` per step, then numpy
+    indexing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.core import Finding, Module
+
+#: files (path suffixes) allowed to call raw jax.jit — the wrapper
+#: itself and the compile-cost probe (its throwaway fn must NOT land in
+#: the compile table)
+RAW_JIT_ALLOWLIST = (
+    "bigdl_tpu/observability/compile_watch.py",
+    "bigdl_tpu/ops/probing.py",
+)
+
+#: kept byte-compatible with the retired tests/test_no_raw_jit.py
+RAW_JIT_MESSAGE = (
+    "raw jax.jit( call — use "
+    "bigdl_tpu.observability.compile_watch.tracked_jit instead so the "
+    "compile lands in the compile table")
+
+#: engine-step-path roots: path suffix -> (class, entry method)
+DEFAULT_STEP_ENTRIES = {
+    "bigdl_tpu/serving/engine.py": ("LLMEngine", "step"),
+}
+
+_CACHE_PARAMS = {"cache", "cache1", "kv", "kv_cache", "kvcache"}
+_STATE_PARAMS = {"params", "state", "train", "opt_state"}
+_TRAIN_HINTS = ("train", "update", "optimiz")
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_PULL_FUNCS = {"asarray", "array", "ascontiguousarray"}
+_TIME_FUNCS = {"time", "monotonic", "perf_counter", "time_ns",
+               "process_time"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.random.split' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _int_tuple(node: Optional[ast.AST]) -> Optional[Tuple[int, ...]]:
+    """Literal donate/static_argnums value: int or tuple/list of ints."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _str_tuple(node: Optional[ast.AST]) -> Tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One traced function the module can see the body of (or just the
+    jit kwargs, when the body is an attribute reference)."""
+
+    name: str                       # jit display name or fn name
+    fn: Optional[ast.AST]           # FunctionDef or Lambda, if visible
+    lineno: int
+    donate: Tuple[int, ...] = ()
+    static_nums: Tuple[int, ...] = ()
+    static_names: Tuple[str, ...] = ()
+    binding: Optional[Tuple[str, str]] = None   # ("self", "_decode") etc.
+
+
+def _jit_kwargs(call: ast.Call) -> dict:
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    return {
+        "donate": _int_tuple(kw.get("donate_argnums")) or (),
+        "static_nums": _int_tuple(kw.get("static_argnums")) or (),
+        "static_names": _str_tuple(kw.get("static_argnames")),
+    }
+
+
+def _is_tracked_jit(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d is not None and d.split(".")[-1] == "tracked_jit"
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return _dotted(node) == "jax.jit"
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """Collect jit sites, local def nodes, and assignments binding jit
+    results to names/attributes."""
+
+    def __init__(self):
+        self.defs: Dict[str, ast.AST] = {}      # fn name -> def node
+        self.sites: List[JitSite] = []
+        self.raw_jit_calls: List[ast.Call] = []
+        # names a jit result was bound to: ("self", attr) or ("", name)
+        self.bindings: Dict[Tuple[str, str], JitSite] = {}
+        self._pending_alias: Dict[str, JitSite] = {}
+
+    # -- defs ---------------------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.defs.setdefault(node.name, node)
+        site = self._site_from_decorators(node)
+        if site is not None:
+            self.sites.append(site)
+            self._pending_alias[node.name] = site
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _site_from_decorators(self, node) -> Optional[JitSite]:
+        for dec in node.decorator_list:
+            # @tracked_jit("name", ...) / @jax.jit / @tracked_jit
+            if _is_tracked_jit(dec) or _is_jax_jit(dec):
+                return JitSite(node.name, node, node.lineno)
+            if isinstance(dec, ast.Call):
+                f = dec.func
+                # @functools.partial(tracked_jit|jax.jit, "name", ...)
+                if (_dotted(f) or "").split(".")[-1] == "partial" \
+                        and dec.args \
+                        and (_is_tracked_jit(dec.args[0])
+                             or _is_jax_jit(dec.args[0])):
+                    return JitSite(self._display_name(dec, node.name),
+                                   node, node.lineno,
+                                   **_jit_kwargs(dec))
+                # @tracked_jit("name", donate_argnums=...) factory form
+                if _is_tracked_jit(f) or _is_jax_jit(f):
+                    return JitSite(self._display_name(dec, node.name),
+                                   node, node.lineno,
+                                   **_jit_kwargs(dec))
+        return None
+
+    @staticmethod
+    def _display_name(call: ast.Call, fallback: str) -> str:
+        for a in call.args:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                return a.value
+        return fallback
+
+    # -- calls --------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_jax_jit(node.func):
+            self.raw_jit_calls.append(node)
+        if _is_tracked_jit(node.func) or _is_jax_jit(node.func):
+            site = self._site_from_call(node)
+            if site is not None:
+                self.sites.append(site)
+                node._graftlint_site = site     # for binding detection
+        self.generic_visit(node)
+
+    def _site_from_call(self, node: ast.Call) -> Optional[JitSite]:
+        # tracked_jit("name", fn, ...) — fn may be args[0] (jax.jit) or
+        # args[1] (tracked_jit with a leading display name)
+        fn_node = None
+        name = "<jit>"
+        for a in node.args[:2]:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                name = a.value
+            elif isinstance(a, ast.Lambda):
+                fn_node = a
+            elif isinstance(a, ast.Name):
+                fn_node = self.defs.get(a.id)
+                name = a.id if name == "<jit>" else name
+        return JitSite(name, fn_node, node.lineno, **_jit_kwargs(node))
+
+    # -- bindings -----------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        site = getattr(node.value, "_graftlint_site", None)
+        if site is None and isinstance(node.value, ast.Name):
+            site = self._pending_alias.get(node.value.id)
+        if site is None and isinstance(node.value, ast.Call):
+            # assigned AFTER visit_Call ran (generic_visit order): probe
+            if _is_tracked_jit(node.value.func) \
+                    or _is_jax_jit(node.value.func):
+                site = self._site_from_call(node.value)
+                if site is not None and site not in self.sites:
+                    self.sites.append(site)
+        if site is not None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    site.binding = ("", t.id)
+                    self.bindings[("", t.id)] = site
+                elif isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name):
+                    site.binding = (t.value.id, t.attr)
+                    self.bindings[(t.value.id, t.attr)] = site
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# traced-body checks
+
+
+def _param_taint(fn: ast.AST, static_nums: Tuple[int, ...],
+                 static_names: Tuple[str, ...]) -> Set[str]:
+    """Parameters that carry TRACED values: everything except the
+    static_argnums/static_argnames positions (those are plain Python
+    at trace time — ``qt = get_qtype(qtype)`` off a static name is
+    host config, not a tracer)."""
+    a = fn.args
+    statics = set(static_names)
+    pos = [p.arg for p in getattr(a, "posonlyargs", [])] \
+        + [p.arg for p in a.args]
+    tainted: Set[str] = set()
+    for i, name in enumerate(pos):
+        if i not in static_nums and name not in statics:
+            tainted.add(name)
+    for p in a.kwonlyargs:
+        if p.arg not in statics:
+            tainted.add(p.arg)
+    if a.vararg:
+        tainted.add(a.vararg.arg)
+    if a.kwarg:
+        tainted.add(a.kwarg.arg)
+    tainted.discard("self")
+    return tainted
+
+
+class _TracedBody(ast.NodeVisitor):
+    """Flag host syncs and nondeterminism inside one traced body.
+
+    Host-sync checks are taint-gated: only expressions that (may)
+    derive from a traced parameter fire. ``float(1 << (qt.bits - 1))``
+    off a static-argname config object is trace-time Python and stays
+    silent; ``float(x[0])`` off a traced ``x`` fires. Subscripts take
+    the taint of their BASE only — indexing a module-level host table
+    with a trace-time key (``CODEBOOKS[qt.codebook]``) yields host
+    data even when the key's provenance is murky."""
+
+    def __init__(self, module: Module, obj: str, out: List[Finding],
+                 tainted: Iterable[str] = ()):
+        self.m = module
+        self.obj = obj
+        self.out = out
+        self.tainted: Set[str] = set(tainted)
+
+    def _emit(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.out.append(Finding(
+            rule=rule, path=self.m.rel, line=node.lineno, obj=self.obj,
+            message=msg, snippet=self.m.snippet(node.lineno)))
+
+    # -- taint of an expression --------------------------------------------
+
+    def _traced(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Subscript):
+            return self._traced(node.value)
+        if isinstance(node, (ast.Attribute, ast.Starred, ast.Await)):
+            return self._traced(node.value)
+        if isinstance(node, ast.Call):
+            return (any(self._traced(a) for a in node.args)
+                    or any(self._traced(k.value)
+                           for k in node.keywords)
+                    or (isinstance(node.func, ast.Attribute)
+                        and self._traced(node.func.value)))
+        if isinstance(node, ast.BinOp):
+            return self._traced(node.left) or self._traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._traced(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self._traced(node.body) or self._traced(node.orelse)
+        if isinstance(node, ast.Compare):
+            return self._traced(node.left) or any(
+                self._traced(c) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self._traced(v) for v in node.values)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._traced(e) for e in node.elts)
+        return False
+
+    def _taint_target(self, target: ast.AST, traced: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.tainted.add if traced
+             else self.tainted.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._taint_target(e, traced)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value, traced)
+
+    # -- propagation --------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)        # check RHS with pre-assign taint
+        traced = self._traced(node.value)
+        for t in node.targets:
+            self._taint_target(t, traced)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            self._taint_target(node.target, self._traced(node.value))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        if isinstance(node.target, ast.Name) \
+                and self._traced(node.value):
+            self.tainted.add(node.target.id)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        self._taint_target(node.target, self._traced(node.iter))
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def _visit_comp(self, node) -> None:
+        saved = set(self.tainted)
+        for gen in node.generators:
+            self.visit(gen.iter)
+            self._taint_target(gen.target, self._traced(gen.iter))
+            for cond in gen.ifs:
+                self.visit(cond)
+        if isinstance(node, ast.DictComp):
+            self.visit(node.key)
+            self.visit(node.value)
+        else:
+            self.visit(node.elt)
+        self.tainted = saved            # comprehension scope
+
+    visit_ListComp = visit_SetComp = _visit_comp
+    visit_GeneratorExp = visit_DictComp = _visit_comp
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested defs (vmap row fns, scan bodies) are traced too: they
+        # close over this body's tracers and their own params are traced
+        inner = _TracedBody(
+            self.m, f"{self.obj}.{node.name}", self.out,
+            self.tainted | _param_taint(node, (), ()))
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        inner = _TracedBody(
+            self.m, self.obj, self.out,
+            self.tainted | _param_taint(node, (), ()))
+        inner.visit(node.body)
+
+    # -- checks -------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        dotted = _dotted(f) or ""
+        root = dotted.split(".")[0] if dotted else ""
+        # .item() / .tolist() / .block_until_ready()
+        if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS \
+                and self._traced(f.value):
+            self._emit("jax-host-sync-in-jit", node,
+                       f".{f.attr}() forces the traced value onto the "
+                       "host")
+        elif dotted == "jax.device_get":
+            self._emit("jax-host-sync-in-jit", node,
+                       "jax.device_get inside traced code is a D2H "
+                       "sync per call")
+        elif root in ("np", "numpy"):
+            if dotted.split(".")[1:2] == ["random"]:
+                self._emit("jax-nondet-in-jit", node,
+                           f"{dotted}() draws host entropy at trace "
+                           "time; use jax.random with a threaded key")
+            elif any(self._traced(a) for a in node.args):
+                self._emit("jax-host-sync-in-jit", node,
+                           f"{dotted}() concretizes its traced "
+                           "argument on the host; use the jnp "
+                           "equivalent")
+        elif root == "random":
+            self._emit("jax-nondet-in-jit", node,
+                       f"{dotted}() is host RNG evaluated once at "
+                       "trace time; use jax.random")
+        elif root == "time" and dotted.split(".")[-1] in _TIME_FUNCS:
+            self._emit("jax-nondet-in-jit", node,
+                       f"{dotted}() is evaluated once at trace time "
+                       "and baked into the executable")
+        elif isinstance(f, ast.Name) and f.id in ("float", "int") \
+                and node.args and self._traced(node.args[0]):
+            self._emit("jax-host-sync-in-jit", node,
+                       f"{f.id}() on a traced value raises "
+                       "ConcretizationError (or silently syncs)")
+        self.generic_visit(node)
+
+
+def _walk_traced(site: JitSite, module: Module,
+                 out: List[Finding]) -> None:
+    fn = site.fn
+    if fn is None:
+        return
+    tainted = _param_taint(fn, site.static_nums, site.static_names)
+    checker = _TracedBody(module, site.name, out, tainted)
+    if isinstance(fn, ast.Lambda):
+        checker.visit(fn.body)
+    else:
+        for stmt in fn.body:
+            checker.visit(stmt)
+
+
+# ---------------------------------------------------------------------------
+# donation
+
+
+def _check_donate(site: JitSite, module: Module,
+                  out: List[Finding]) -> None:
+    fn = site.fn
+    if fn is None or isinstance(fn, ast.Lambda):
+        args = fn.args if fn is not None else None
+    else:
+        args = fn.args
+    if args is None or not args.args:
+        return
+    first = args.args[0].arg
+    lineno = site.lineno
+    if first in _CACHE_PARAMS:
+        if 0 not in site.donate:
+            out.append(Finding(
+                "jax-missing-donate", module.rel, lineno,
+                site.name,
+                f"first arg {first!r} is a KV cache: donate it "
+                "(donate_argnums=(0,)) or the splice doubles peak HBM",
+                module.snippet(lineno)))
+    elif first in _STATE_PARAMS and any(
+            h in site.name.lower() for h in _TRAIN_HINTS):
+        if 0 not in site.donate:
+            out.append(Finding(
+                "jax-missing-donate", module.rel, lineno,
+                site.name,
+                f"train-step first arg {first!r} is rebuilt every "
+                "call: donate it to halve peak optimizer memory",
+                module.snippet(lineno)))
+
+
+# ---------------------------------------------------------------------------
+# scalar signature drift
+
+
+class _JitCallScan(ast.NodeVisitor):
+    def __init__(self, module: Module,
+                 bindings: Dict[Tuple[str, str], JitSite],
+                 out: List[Finding]):
+        self.m = module
+        self.bindings = bindings
+        self.out = out
+
+    @staticmethod
+    def _drifting(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id == "len":
+            return "len(...)"
+        if isinstance(node, ast.BinOp):
+            return "an arithmetic expression"
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        site = None
+        if isinstance(f, ast.Name):
+            site = self.bindings.get(("", f.id))
+        elif isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name):
+            site = self.bindings.get((f.value.id, f.attr))
+        if site is not None and (site.static_nums or site.static_names):
+            for i, a in enumerate(node.args):
+                what = self._drifting(a)
+                if what and i in site.static_nums:
+                    self.out.append(Finding(
+                        "jax-scalar-signature", self.m.rel, node.lineno,
+                        site.name,
+                        f"{what} in static position {i} of jit "
+                        f"{site.name!r}: one compile per distinct "
+                        "value — round to a bucket or pass a traced "
+                        "array", self.m.snippet(node.lineno)))
+            for kw in node.keywords:
+                what = self._drifting(kw.value) if kw.arg else None
+                if what and kw.arg in site.static_names:
+                    self.out.append(Finding(
+                        "jax-scalar-signature", self.m.rel, node.lineno,
+                        site.name,
+                        f"{what} in static kwarg {kw.arg!r} of jit "
+                        f"{site.name!r}: one compile per distinct "
+                        "value", self.m.snippet(node.lineno)))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# engine step path
+
+
+def _class_methods(tree: ast.AST, cls_name: str
+                   ) -> Dict[str, ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            return {n.name: n for n in node.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+    return {}
+
+
+def _reachable(methods: Dict[str, ast.FunctionDef],
+               entry: str) -> Set[str]:
+    seen, todo = set(), [entry]
+    while todo:
+        name = todo.pop()
+        if name in seen or name not in methods:
+            continue
+        seen.add(name)
+        for node in ast.walk(methods[name]):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self" \
+                    and node.func.attr in methods:
+                todo.append(node.func.attr)
+    return seen
+
+
+class _HostProven:
+    """Order-of-appearance dataflow: which local names are provably
+    host-resident numpy (result of an np.* call, or arithmetic over
+    such names). Arithmetic with one proven-host operand stays host as
+    long as no non-numpy call appears in the expression: numpy ops
+    cannot move an array to the device on their own, and jit results
+    enter the step path as whole-statement assignments (which reset
+    provenance), not as bare sub-expressions."""
+
+    _HOST_ROOTS = ("np", "numpy")
+
+    def __init__(self):
+        self.host: Set[str] = set()
+
+    def _no_foreign_calls(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                d = _dotted(sub.func) or ""
+                root = d.split(".")[0] if d else ""
+                if root not in self._HOST_ROOTS \
+                        and root not in ("float", "int", "len",
+                                        "abs", "min", "max") \
+                        and d != "jax.device_get":
+                    return False
+        return True
+
+    def expr_is_host(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.host
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func) or ""
+            root = d.split(".")[0]
+            if root in self._HOST_ROOTS or d == "jax.device_get":
+                return True         # np.* RESULTS live on host
+            return False
+        if isinstance(node, ast.Subscript):
+            return self.expr_is_host(node.value)
+        if isinstance(node, ast.BinOp):
+            return ((self.expr_is_host(node.left)
+                     or self.expr_is_host(node.right))
+                    and self._no_foreign_calls(node))
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_is_host(node.operand)
+        if isinstance(node, ast.IfExp):
+            def ok(n):
+                return (isinstance(n, ast.Constant)
+                        or self.expr_is_host(n))
+            return ok(node.body) and ok(node.orelse)
+        return False
+
+    def note_assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if isinstance(node.value, ast.Constant) \
+                    and node.value.value is None:
+                return              # neutral: None placeholder
+            if self.expr_is_host(node.value):
+                self.host.add(name)
+            else:
+                self.host.discard(name)
+
+
+class _StepPath(ast.NodeVisitor):
+    """Flag looped D2H pulls and unproven float()/int() subscripts in
+    one step-path method."""
+
+    def __init__(self, module: Module, obj: str, out: List[Finding],
+                 proven: _HostProven, loop_depth: int = 0):
+        self.m = module
+        self.obj = obj
+        self.out = out
+        self.proven = proven
+        self.loop = loop_depth
+
+    def _emit(self, node: ast.AST, msg: str) -> None:
+        self.out.append(Finding(
+            "step-host-sync", self.m.rel, node.lineno, self.obj,
+            msg, self.m.snippet(node.lineno)))
+
+    def _enter_loop(self, node: ast.AST) -> None:
+        self.loop += 1
+        self.generic_visit(node)
+        self.loop -= 1
+
+    visit_For = visit_While = _enter_loop
+    visit_ListComp = visit_SetComp = _enter_loop
+    visit_DictComp = visit_GeneratorExp = _enter_loop
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        self.proven.note_assign(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # closures inherit the provenance known at their def site (they
+        # are called inline in the step loop)
+        inner = _StepPath(self.m, f"{self.obj}.{node.name}", self.out,
+                          self.proven, self.loop)
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        dotted = _dotted(f) or ""
+        root = dotted.split(".")[0] if dotted else ""
+        if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
+            self._emit(node,
+                       f".{f.attr}() is a per-element device sync — "
+                       "pull the whole array once with np.asarray")
+        elif ((root in ("np", "numpy")
+               and dotted.split(".")[-1] in _PULL_FUNCS)
+              or dotted == "jax.device_get"):
+            if self.loop > 0:
+                self._emit(node,
+                           f"{dotted}() inside a loop on the step "
+                           "path: one D2H pull per iteration — hoist "
+                           "a single pull above the loop and index in "
+                           "numpy")
+        elif isinstance(f, ast.Name) and f.id in ("float", "int") \
+                and node.args \
+                and isinstance(node.args[0], ast.Subscript) \
+                and not self.proven.expr_is_host(node.args[0]):
+            self._emit(node,
+                       f"{f.id}() of a subscript whose base is not "
+                       "provably host numpy: if it is a device array "
+                       "this is one D2H sync PER TOKEN — np.asarray "
+                       "the row once, then index")
+        self.generic_visit(node)
+
+
+def _check_step_path(module: Module, cls: str, entry: str,
+                     out: List[Finding]) -> None:
+    methods = _class_methods(module.tree, cls)
+    if entry not in methods:
+        return
+    for name in sorted(_reachable(methods, entry)):
+        fn = methods[name]
+        proven = _HostProven()
+        # parameters are unknown; np-typed defaults don't help
+        walker = _StepPath(module, f"{cls}.{name}", out, proven)
+        for stmt in fn.body:
+            walker.visit(stmt)
+
+
+# ---------------------------------------------------------------------------
+# entry
+
+
+def check(modules: Iterable[Module],
+          step_entries: Optional[dict] = None) -> List[Finding]:
+    out: List[Finding] = []
+    entries = DEFAULT_STEP_ENTRIES if step_entries is None \
+        else step_entries
+    for m in modules:
+        scan = _ModuleScan()
+        scan.visit(m.tree)
+
+        allowed = any(m.rel.endswith(sfx) for sfx in RAW_JIT_ALLOWLIST)
+        if not allowed:
+            for call in scan.raw_jit_calls:
+                out.append(Finding(
+                    "jax-raw-jit", m.rel, call.lineno, "<module>",
+                    RAW_JIT_MESSAGE, m.snippet(call.lineno)))
+
+        seen_fns = set()
+        for site in scan.sites:
+            if site.fn is not None and id(site.fn) not in seen_fns:
+                seen_fns.add(id(site.fn))
+                _walk_traced(site, m, out)
+                _check_donate(site, m, out)
+        _JitCallScan(m, scan.bindings, out).visit(m.tree)
+
+        for sfx, (cls, entry) in entries.items():
+            if m.rel.endswith(sfx):
+                _check_step_path(m, cls, entry, out)
+    return out

@@ -1,0 +1,328 @@
+"""Lock-discipline rules: guarded-attribute inference + order graph.
+
+The checker is annotation-free: it infers the lock <-> state map from
+the code itself, clang-thread-safety style but heuristic.
+
+Inference
+---------
+1. A *lock attribute* is any ``self.X = threading.Lock()`` /
+   ``RLock()`` assignment (collected globally across the scanned
+   modules, so nested acquisitions through other objects' locks can be
+   keyed too).
+2. Inside one class, an attribute ``A`` is *guarded by* lock ``L``
+   when ``self.A`` is WRITTEN somewhere in a ``with self.L:`` body
+   (writes: assignment, augmented assignment, subscript stores, and
+   mutating method calls — ``append``/``pop``/``setdefault``/...).
+   Reads under the lock alone do not bind: read-only config assigned
+   once in ``__init__`` stays free.
+3. ``__init__`` is exempt (construction precedes sharing), and nested
+   ``def``s inherit the locks held at their definition site (the
+   codebase's closures are called inline under the same lock).
+
+Rules
+-----
+``lock-guarded-unlocked``
+    Any access (read or write) of a guarded attribute outside its
+    lock, in any non-exempt method of the owning class. Accesses
+    through other receivers (``other.attr``) are invisible — route
+    cross-object mutation through a locked method of the owner.
+``lock-order-inversion``
+    Nested ``with`` acquisitions define order edges keyed by the LOCK
+    ATTRIBUTE NAME (``self._a`` nesting ``b._b`` adds ``_a -> _b``).
+    Both directions present anywhere in the scanned set is a deadlock
+    risk. Name-keying is a heuristic: give locks distinct names.
+
+Known limits (documented, deliberate): no interprocedural lock
+tracking (a helper that REQUIRES a held lock reads as unguarded — take
+the lock in the public method, or suppress with an audited inline
+disable), no ``.acquire()``/``.release()`` pairing (use ``with``), no
+aliasing of lock objects.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.core import Finding, Module
+
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard", "sort", "reverse",
+}
+
+_EXEMPT_METHODS = {"__init__", "__new__", "__del__"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = node.func
+    parts = []
+    while isinstance(d, ast.Attribute):
+        parts.append(d.attr)
+        d = d.value
+    if isinstance(d, ast.Name):
+        parts.append(d.id)
+    parts = list(reversed(parts))
+    return bool(parts) and parts[-1] in ("Lock", "RLock")
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    write: bool
+    held: Tuple[str, ...]       # lock attr names held (this class's)
+    lineno: int
+    method: str
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    name: str
+    module: Module
+    locks: Set[str] = dataclasses.field(default_factory=set)
+    accesses: List[_Access] = dataclasses.field(default_factory=list)
+    # lock -> attrs written under it (non-exempt methods)
+    guards: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class _OrderEdge:
+    outer: str
+    inner: str
+    module: Module
+    lineno: int
+    obj: str
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for ``self.x``, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Walk one method body tracking held locks; record every self.*
+    access and every nested lock acquisition."""
+
+    def __init__(self, cls: _ClassInfo, method: str,
+                 global_locks: Set[str], edges: List[_OrderEdge],
+                 held: Tuple[str, ...] = ()):
+        self.cls = cls
+        self.method = method
+        self.global_locks = global_locks
+        self.edges = edges
+        self.held = list(held)
+        # full held stack including OTHER objects' locks (for ordering)
+        self.order_stack: List[str] = list(held)
+
+    # -- with ---------------------------------------------------------------
+
+    def _lock_of_item(self, item: ast.withitem) -> Optional[Tuple[str, bool]]:
+        """(lock_attr_name, is_self) for ``with <recv>.<lock>:``."""
+        ctx = item.context_expr
+        attr = _self_attr(ctx)
+        if attr is not None and attr in self.cls.locks:
+            return attr, True
+        # other receivers: any attribute chain ending in a known lock
+        if isinstance(ctx, ast.Attribute) \
+                and ctx.attr in self.global_locks:
+            return ctx.attr, False
+        if isinstance(ctx, ast.Name) and ctx.id in self.global_locks:
+            return ctx.id, False
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[Tuple[str, bool]] = []
+        for item in node.items:
+            got = self._lock_of_item(item)
+            if got is not None:
+                name, is_self = got
+                if self.order_stack:
+                    self.edges.append(_OrderEdge(
+                        self.order_stack[-1], name, self.cls.module,
+                        node.lineno,
+                        f"{self.cls.name}.{self.method}"))
+                self.order_stack.append(name)
+                if is_self:
+                    self.held.append(name)
+                acquired.append(got)
+            # the context expr itself may contain accesses
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for name, is_self in reversed(acquired):
+            self.order_stack.pop()
+            if is_self:
+                self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- nested defs inherit the held set at their definition site ---------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        inner = _MethodScan(self.cls, f"{self.method}.{node.name}",
+                            self.global_locks, self.edges,
+                            tuple(self.held))
+        inner.order_stack = list(self.order_stack)
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = lambda self, node: None      # noqa: E731 — opaque
+
+    # -- accesses -----------------------------------------------------------
+
+    def _record(self, attr: str, write: bool, lineno: int) -> None:
+        if attr in self.cls.locks:
+            return
+        self.cls.accesses.append(_Access(
+            attr, write, tuple(self.held), lineno, self.method))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record(attr, isinstance(node.ctx,
+                                          (ast.Store, ast.Del)),
+                         node.lineno)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # self.A[k] = v  /  del self.A[k]  — write to A's contents
+        attr = _self_attr(node.value)
+        if attr is not None and isinstance(node.ctx,
+                                           (ast.Store, ast.Del)):
+            self._record(attr, True, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        t = node.target
+        attr = _self_attr(t)
+        if attr is None and isinstance(t, ast.Subscript):
+            attr = _self_attr(t.value)
+        if attr is not None:
+            self._record(attr, True, node.lineno)
+        # visit value side only (target Attribute already recorded)
+        self.visit(node.value)
+        if isinstance(t, ast.Subscript):
+            self.visit(t.slice)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # self.A.append(x) and friends mutate A
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = _self_attr(f.value)
+            if attr is not None:
+                self._record(attr, True, node.lineno)
+        self.generic_visit(node)
+
+
+def _scan_class(node: ast.ClassDef, module: Module,
+                global_locks: Set[str],
+                edges: List[_OrderEdge]) -> _ClassInfo:
+    cls = _ClassInfo(node.name, module)
+    # pass 1: this class's lock attrs (anywhere in its methods)
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+            for t in sub.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    cls.locks.add(attr)
+    # pass 2: accesses with held-lock context
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if item.name in _EXEMPT_METHODS:
+            continue
+        scan = _MethodScan(cls, item.name, global_locks, edges)
+        for stmt in item.body:
+            scan.visit(stmt)
+    # dedupe: one access per (attr, line), write wins (a mutator call
+    # like self.A.append records both the call-write and the load-read)
+    merged: Dict[Tuple[str, int, Tuple[str, ...]], _Access] = {}
+    for a in cls.accesses:
+        key = (a.attr, a.lineno, a.held)
+        prev = merged.get(key)
+        if prev is None or (a.write and not prev.write):
+            merged[key] = a
+    cls.accesses = sorted(merged.values(),
+                          key=lambda a: (a.lineno, a.attr))
+    # inference: lock -> attrs WRITTEN while held
+    for a in cls.accesses:
+        if a.write:
+            for lock in a.held:
+                cls.guards.setdefault(lock, set()).add(a.attr)
+    return cls
+
+
+def check(modules: Iterable[Module]) -> List[Finding]:
+    modules = list(modules)
+    # global pass: every lock attribute name in the scanned set
+    global_locks: Set[str] = set()
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Assign) \
+                    and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        global_locks.add(t.attr)
+                    elif isinstance(t, ast.Name):
+                        global_locks.add(t.id)
+
+    out: List[Finding] = []
+    edges: List[_OrderEdge] = []
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = _scan_class(node, m, global_locks, edges)
+            if not cls.guards:
+                continue
+            guarded_by: Dict[str, str] = {}
+            for lock, attrs in sorted(cls.guards.items()):
+                for a in attrs:
+                    guarded_by.setdefault(a, lock)
+            for a in cls.accesses:
+                lock = guarded_by.get(a.attr)
+                if lock is None or lock in a.held:
+                    continue
+                kind = "write" if a.write else "read"
+                out.append(Finding(
+                    "lock-guarded-unlocked", m.rel, a.lineno,
+                    f"{cls.name}.{a.method}",
+                    f"self.{a.attr} is written under self.{lock} "
+                    f"elsewhere in {cls.name} but this {kind} does "
+                    "not hold it",
+                    m.snippet(a.lineno)))
+
+    # order inversions: both directions present anywhere
+    seen_pairs: Set[Tuple[str, str]] = set()
+    forward: Dict[Tuple[str, str], _OrderEdge] = {}
+    for e in edges:
+        forward.setdefault((e.outer, e.inner), e)
+    for (a, b), e in sorted(forward.items(),
+                            key=lambda kv: (kv[1].module.rel,
+                                            kv[1].lineno)):
+        if a == b or frozenset((a, b)) in {frozenset(p)
+                                           for p in seen_pairs}:
+            continue
+        rev = forward.get((b, a))
+        if rev is not None:
+            seen_pairs.add((a, b))
+            out.append(Finding(
+                "lock-order-inversion", rev.module.rel, rev.lineno,
+                rev.obj,
+                f"acquires {b!r} then {a!r}, but {e.obj} "
+                f"({e.module.rel}:{e.lineno}) acquires {a!r} then "
+                f"{b!r} — a concurrent pair can deadlock",
+                rev.module.snippet(rev.lineno)))
+    return out

@@ -223,7 +223,11 @@ class MetricFamily:
         if self.labelnames:
             raise ValueError(
                 f"{self.name} is labeled {self.labelnames}; use .labels()")
-        return self._children[()]
+        # safe unlocked: an unlabeled family materializes its sole ()
+        # child in __init__ and labels() (the only _children writer)
+        # rejects unlabeled use, so this dict never changes after
+        # construction
+        return self._children[()]  # graftlint: disable=lock-guarded-unlocked
 
     def inc(self, amount: float = 1.0) -> None:
         self._default().inc(amount)

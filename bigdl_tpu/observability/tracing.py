@@ -178,22 +178,27 @@ class RequestTracer:
                 "event": event}
         line.update(data)
         try:
-            if self._sink is None:
-                self._sink = open(self._sink_path, "a", buffering=1)
-                try:
-                    self._sink_bytes = os.path.getsize(self._sink_path)
-                except OSError:
+            # handler threads and the engine thread both log: the
+            # open/rotate/write sequence must be atomic or a rotation
+            # can race a write into a closed file
+            with self._lock:
+                if self._sink is None:
+                    self._sink = open(self._sink_path, "a", buffering=1)
+                    try:
+                        self._sink_bytes = os.path.getsize(
+                            self._sink_path)
+                    except OSError:
+                        self._sink_bytes = 0
+                payload = json.dumps(line) + "\n"
+                if (self._sink_max_bytes is not None and self._sink_bytes
+                        and self._sink_bytes + len(payload)
+                        > self._sink_max_bytes):
+                    self._sink.close()
+                    os.replace(self._sink_path, self._sink_path + ".1")
+                    self._sink = open(self._sink_path, "a", buffering=1)
                     self._sink_bytes = 0
-            payload = json.dumps(line) + "\n"
-            if (self._sink_max_bytes is not None and self._sink_bytes
-                    and self._sink_bytes + len(payload)
-                    > self._sink_max_bytes):
-                self._sink.close()
-                os.replace(self._sink_path, self._sink_path + ".1")
-                self._sink = open(self._sink_path, "a", buffering=1)
-                self._sink_bytes = 0
-            self._sink.write(payload)
-            self._sink_bytes += len(payload)
+                self._sink.write(payload)
+                self._sink_bytes += len(payload)
         except OSError as e:
             # one warning, then the sink stays off — tracing must never
             # take the serving loop down

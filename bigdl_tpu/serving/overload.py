@@ -34,10 +34,15 @@ module is the policy tier *at* admission:
   the threshold gap and the dwell are hysteresis, so the ladder does
   not flap at the boundary.
 
-Everything here is pure policy over plain Python state: no JAX, no
-locks beyond the engine's own, and fully deterministic given the same
-sequence of (clock, event) inputs — which is what lets the
-``overload_storm`` chaos fault drive the whole ladder reproducibly.
+Everything here is pure policy over plain Python state: no JAX, and
+fully deterministic given the same sequence of (clock, event) inputs —
+which is what lets the ``overload_storm`` chaos fault drive the whole
+ladder reproducibly. The controller carries ONE RLock of its own:
+``check_admission`` runs on HTTP handler threads (inside the engine's
+``add_request``) while ``update_pressure`` / ``note_generated`` /
+``select_index`` run on the engine thread, and tenant bookkeeping is
+read-modify-write — determinism is per interleaving, not a substitute
+for mutual exclusion.
 """
 
 from __future__ import annotations
@@ -45,6 +50,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
+import threading
 from typing import Dict, Optional, Sequence
 
 __all__ = [
@@ -400,22 +406,29 @@ class OverloadController:
         self._lo_streak = 0
         self.shed_counts: Dict[str, int] = {r: 0 for r in SHED_REASONS}
         self.level_changes = 0
+        # handler threads (check_admission via add_request) race the
+        # engine thread (update_pressure / note_generated /
+        # select_index); RLock because check_admission re-enters
+        # through tenant()
+        self._lock = threading.RLock()
 
     # -- tenants ----------------------------------------------------------
 
     def tenant(self, name: str) -> _Tenant:
-        t = self.tenants.get(name)
-        if t is None:
-            t = self.tenants[name] = _Tenant(self.cfg)
-        return t
+        with self._lock:
+            t = self.tenants.get(name)
+            if t is None:
+                t = self.tenants[name] = _Tenant(self.cfg)
+            return t
 
     def note_generated(self, tenant: str, n_tokens: int,
                        now: float) -> None:
         """Charge ``n_tokens`` generated tokens to the tenant's
         token-rate bucket (post-paid: admission only checks for debt)."""
-        t = self.tenant(tenant)
-        t.generated_total += n_tokens
-        t.tps.charge(n_tokens, now)
+        with self._lock:
+            t = self.tenant(tenant)
+            t.generated_total += n_tokens
+            t.tps.charge(n_tokens, now)
 
     # -- admission --------------------------------------------------------
 
@@ -434,62 +447,65 @@ class OverloadController:
         on the first failure. ``retry_after_sec`` is the engine's
         drain-rate / ledger-headroom estimate for capacity sheds;
         rate-limit sheds compute their own from the bucket refill."""
-        t = self.tenant(tenant)
+        with self._lock:
+            t = self.tenant(tenant)
 
-        def shed(reason: str, retry: int, detail: str = ""):
-            t.shed_total += 1
-            self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
-            raise RequestShed(reason, qos, tenant, retry,
-                              SHED_REASONS[reason], detail)
+            def shed(reason: str, retry: int, detail: str = ""):
+                t.shed_total += 1
+                self.shed_counts[reason] = \
+                    self.shed_counts.get(reason, 0) + 1
+                raise RequestShed(reason, qos, tenant, retry,
+                                  SHED_REASONS[reason], detail)
 
-        # 1. brownout level 3: shed batch work outright
-        if self.level >= BROWNOUT_LEVELS and qos == "batch":
-            shed("brownout", retry_after_sec,
-                 "engine browned out: batch QoS is shed until pressure "
-                 "recedes")
+            # 1. brownout level 3: shed batch work outright
+            if self.level >= BROWNOUT_LEVELS and qos == "batch":
+                shed("brownout", retry_after_sec,
+                     "engine browned out: batch QoS is shed until "
+                     "pressure recedes")
 
-        # 2. per-class queue depth (the interactive limit IS the hard
-        # cap, so the bound holds even for the highest class)
-        if queue_depth + n_seqs > self.depth_limit(qos):
-            shed("queue_full", retry_after_sec,
-                 f"queue depth {queue_depth} at the {qos} admission "
-                 f"limit {self.depth_limit(qos)}")
+            # 2. per-class queue depth (the interactive limit IS the
+            # hard cap, so the bound holds even for the highest class)
+            if queue_depth + n_seqs > self.depth_limit(qos):
+                shed("queue_full", retry_after_sec,
+                     f"queue depth {queue_depth} at the {qos} admission "
+                     f"limit {self.depth_limit(qos)}")
 
-        # 3. queue bytes
-        add_bytes = n_seqs * prompt_len * _BYTES_PER_TOKEN
-        if queue_bytes + add_bytes > self.cfg.max_queue_bytes:
-            shed("queue_bytes", retry_after_sec,
-                 f"queued prompt footprint {queue_bytes}B + {add_bytes}B "
-                 f"exceeds cap {self.cfg.max_queue_bytes}B")
+            # 3. queue bytes
+            add_bytes = n_seqs * prompt_len * _BYTES_PER_TOKEN
+            if queue_bytes + add_bytes > self.cfg.max_queue_bytes:
+                shed("queue_bytes", retry_after_sec,
+                     f"queued prompt footprint {queue_bytes}B + "
+                     f"{add_bytes}B exceeds cap "
+                     f"{self.cfg.max_queue_bytes}B")
 
-        # 4. tenant request-rate bucket
-        if not t.rps.try_take(n_seqs, now):
-            shed("rate_limit",
-                 int(math.ceil(t.rps.wait_sec(n_seqs, now))) or 1,
-                 f"tenant {tenant!r} over its request-rate limit "
-                 f"({self.cfg.tenant_rps}/s)")
+            # 4. tenant request-rate bucket
+            if not t.rps.try_take(n_seqs, now):
+                shed("rate_limit",
+                     int(math.ceil(t.rps.wait_sec(n_seqs, now))) or 1,
+                     f"tenant {tenant!r} over its request-rate limit "
+                     f"({self.cfg.tenant_rps}/s)")
 
-        # 5. tenant generated-token bucket (post-paid: shed while in
-        # debt from previously generated tokens)
-        if t.tps.rate > 0:
-            t.tps.wait_sec(0.0, now)  # refill to "now" before the check
-            if t.tps.level < 0:
-                shed("token_rate",
-                     int(math.ceil(-t.tps.level / t.tps.rate)) or 1,
-                     f"tenant {tenant!r} over its generated-token limit "
-                     f"({self.cfg.tenant_tps} tok/s)")
+            # 5. tenant generated-token bucket (post-paid: shed while
+            # in debt from previously generated tokens)
+            if t.tps.rate > 0:
+                t.tps.wait_sec(0.0, now)  # refill to "now" pre-check
+                if t.tps.level < 0:
+                    shed("token_rate",
+                         int(math.ceil(-t.tps.level / t.tps.rate)) or 1,
+                         f"tenant {tenant!r} over its generated-token "
+                         f"limit ({self.cfg.tenant_tps} tok/s)")
 
-        # 6. queue-wait test: if the backlog alone outlasts the
-        # request's deadline, it is doomed — reject now instead of
-        # burning queue+slot time and failing with 504 later
-        if deadline_sec is not None and tpot_sec > 0:
-            est_wait = tpot_sec * queue_depth
-            if est_wait > deadline_sec:
-                shed("doomed", retry_after_sec,
-                     f"estimated queue wait {est_wait:.2f}s exceeds the "
-                     f"request deadline {deadline_sec:.2f}s")
+            # 6. queue-wait test: if the backlog alone outlasts the
+            # request's deadline, it is doomed — reject now instead of
+            # burning queue+slot time and failing with 504 later
+            if deadline_sec is not None and tpot_sec > 0:
+                est_wait = tpot_sec * queue_depth
+                if est_wait > deadline_sec:
+                    shed("doomed", retry_after_sec,
+                         f"estimated queue wait {est_wait:.2f}s exceeds "
+                         f"the request deadline {deadline_sec:.2f}s")
 
-        t.admitted_total += n_seqs
+            t.admitted_total += n_seqs
 
     # -- scheduling -------------------------------------------------------
 
@@ -509,19 +525,21 @@ class OverloadController:
         yields to work that has never run (arrival still drives
         aging). Pure — call :meth:`note_scheduled` only once the pick
         is actually admitted (memory deferral may put it back)."""
-        best_i, best_key = 0, None
-        for i, req in enumerate(waiting):
-            qos = getattr(req.params, "qos", None) or "standard"
-            tenant = getattr(req.params, "tenant", None) or "default"
-            pr = self.effective_priority(qos, now - req.arrival)
-            key = (pr, self.tenant(tenant).served, i)
-            if best_key is None or key < best_key:
-                best_i, best_key = i, key
-        return best_i
+        with self._lock:
+            best_i, best_key = 0, None
+            for i, req in enumerate(waiting):
+                qos = getattr(req.params, "qos", None) or "standard"
+                tenant = getattr(req.params, "tenant", None) or "default"
+                pr = self.effective_priority(qos, now - req.arrival)
+                key = (pr, self.tenant(tenant).served, i)
+                if best_key is None or key < best_key:
+                    best_i, best_key = i, key
+            return best_i
 
     def note_scheduled(self, tenant: str) -> None:
         """Advance the tenant's DRR counter after a successful pick."""
-        self.tenant(tenant).served += 1
+        with self._lock:
+            self.tenant(tenant).served += 1
 
     # -- brownout ---------------------------------------------------------
 
@@ -529,55 +547,61 @@ class OverloadController:
         """Feed one pressure sample; returns the new level if it
         changed, else None. Hysteresis: both a threshold gap
         (high/low) and a dwell (consecutive samples) gate transitions."""
-        self.pressure = max(0.0, min(1.0, float(pressure)))
-        if self.pressure >= self.cfg.brownout_high:
-            self._hi_streak += 1
-            self._lo_streak = 0
-        elif self.pressure <= self.cfg.brownout_low:
-            self._lo_streak += 1
-            self._hi_streak = 0
-        else:
-            self._hi_streak = 0
-            self._lo_streak = 0
-        if self._hi_streak >= BROWNOUT_ENGAGE_STEPS \
-                and self.level < BROWNOUT_LEVELS:
-            self.level += 1
-            self._hi_streak = 0
-            self.level_changes += 1
-            return self.level
-        if self._lo_streak >= BROWNOUT_RECOVER_STEPS and self.level > 0:
-            self.level -= 1
-            self._lo_streak = 0
-            self.level_changes += 1
-            return self.level
-        return None
+        with self._lock:
+            self.pressure = max(0.0, min(1.0, float(pressure)))
+            if self.pressure >= self.cfg.brownout_high:
+                self._hi_streak += 1
+                self._lo_streak = 0
+            elif self.pressure <= self.cfg.brownout_low:
+                self._lo_streak += 1
+                self._hi_streak = 0
+            else:
+                self._hi_streak = 0
+                self._lo_streak = 0
+            if self._hi_streak >= BROWNOUT_ENGAGE_STEPS \
+                    and self.level < BROWNOUT_LEVELS:
+                self.level += 1
+                self._hi_streak = 0
+                self.level_changes += 1
+                return self.level
+            if self._lo_streak >= BROWNOUT_RECOVER_STEPS \
+                    and self.level > 0:
+                self.level -= 1
+                self._lo_streak = 0
+                self.level_changes += 1
+                return self.level
+            return None
 
     @property
     def speculative_allowed(self) -> bool:
         """Speculative lookahead is the first work a brownout sheds."""
-        return self.level == 0
+        with self._lock:
+            return self.level == 0
 
     def max_tokens_cap(self) -> Optional[int]:
-        return BROWNOUT_MAX_TOKENS[min(self.level,
-                                       len(BROWNOUT_MAX_TOKENS) - 1)]
+        with self._lock:
+            return BROWNOUT_MAX_TOKENS[min(self.level,
+                                           len(BROWNOUT_MAX_TOKENS) - 1)]
 
     def chunk_shift(self) -> int:
-        return BROWNOUT_CHUNK_SHIFT[min(self.level,
-                                        len(BROWNOUT_CHUNK_SHIFT) - 1)]
+        with self._lock:
+            return BROWNOUT_CHUNK_SHIFT[min(self.level,
+                                            len(BROWNOUT_CHUNK_SHIFT) - 1)]
 
     # -- introspection ----------------------------------------------------
 
     def snapshot(self) -> dict:
-        return {
-            "brownout_level": self.level,
-            "pressure": round(self.pressure, 4),
-            "speculative_allowed": self.speculative_allowed,
-            "max_tokens_cap": self.max_tokens_cap(),
-            "chunk_shift": self.chunk_shift(),
-            "max_queue_depth": self.cfg.max_queue_depth,
-            "max_queue_bytes": self.cfg.max_queue_bytes,
-            "shed": {k: v for k, v in sorted(self.shed_counts.items())
-                     if v},
-            "tenants": {name: t.snapshot()
-                        for name, t in sorted(self.tenants.items())},
-        }
+        with self._lock:
+            return {
+                "brownout_level": self.level,
+                "pressure": round(self.pressure, 4),
+                "speculative_allowed": self.speculative_allowed,
+                "max_tokens_cap": self.max_tokens_cap(),
+                "chunk_shift": self.chunk_shift(),
+                "max_queue_depth": self.cfg.max_queue_depth,
+                "max_queue_bytes": self.cfg.max_queue_bytes,
+                "shed": {k: v for k, v in
+                         sorted(self.shed_counts.items()) if v},
+                "tenants": {name: t.snapshot()
+                            for name, t in sorted(self.tenants.items())},
+            }

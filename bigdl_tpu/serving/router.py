@@ -368,7 +368,10 @@ class Router:
         self._rolling = False
 
         # plain counters mirror the metric families so bench JSON and
-        # stats_snapshot() embed them without a registry scrape
+        # stats_snapshot() embed them without a registry scrape.
+        # Incremented from the supervisor thread AND HTTP handler
+        # threads: Counter's += is a read-modify-write, so every
+        # touch goes through _count()/counts_snapshot() under _lock
         self.counts = collections.Counter()
         self._g_state = self.registry.gauge(
             "bigdl_tpu_router_replica_state",
@@ -464,7 +467,7 @@ class Router:
         self._set_state(r, STARTING)
         if not initial:
             r.restarts += 1
-            self.counts["restarts"] += 1
+            self._count("restarts")
             self._c_restarts.labels(str(r.idx)).inc()
         self.flight.record("replica_spawn", replica=r.idx, port=r.port,
                            pid=r.pid, generation=r.generation)
@@ -577,7 +580,7 @@ class Router:
         if len(recent) >= self.cfg.crash_budget:
             # crash loop: stop feeding it restarts — the replica-level
             # mirror of the engine's per-request crash-budget quarantine
-            self.counts["quarantined"] += 1
+            self._count("quarantined")
             self._set_state(r, QUARANTINED)
             self.flight.record("replica_quarantined", replica=r.idx,
                                deaths_in_window=len(recent),
@@ -618,7 +621,7 @@ class Router:
             r.breaker = "open"
             r.breaker_open_until = (time.monotonic()
                                     + self.cfg.breaker_cooldown_sec)
-            self.counts["breaker_trips"] += 1
+            self._count("breaker_trips")
             self._c_trips.labels(str(r.idx)).inc()
             self.flight.record("breaker_open", replica=r.idx,
                                failures=r.breaker_failures)
@@ -787,7 +790,7 @@ class Router:
                     second = None
                 if second is not None:
                     entry.hedged = True
-                    self.counts["hedges"] += 1
+                    self._count("hedges")
                     self._c_hedges.inc()
                     self.flight.record("hedge", rid=entry.rid,
                                        primary=primary.idx,
@@ -830,13 +833,13 @@ class Router:
                     r, entry, exclude)
             except ReplicaLost as e:
                 exclude[r.idx] = r.generation
-                self.counts["failovers"] += 1
+                self._count("failovers")
                 self._c_failovers.inc()
                 self.flight.record("failover", rid=entry.rid,
                                    replica=r.idx, error=str(e)[:200])
                 if entry.replays < self.cfg.max_replays:
                     entry.replays += 1
-                    self.counts["replays"] += 1
+                    self._count("replays")
                     self._c_replays.inc()
                     self.flight.record("replay", rid=entry.rid,
                                        attempt=entry.replays)
@@ -852,11 +855,11 @@ class Router:
                 # it — propagate verbatim (Retry-After preserved by
                 # the handler), no replay burn, no breaker hit
                 self._breaker_success(used)
-                self.counts["shed_429"] += 1
+                self._count("shed_429")
                 self.flight.record("shed_429", rid=entry.rid,
                                    replica=used.idx,
                                    tenant=entry.tenant or "default")
-                self.counts["requests"] += 1
+                self._count("requests")
                 self._c_requests.labels(str(used.idx),
                                         str(status)).inc()
                 return status, data
@@ -867,7 +870,7 @@ class Router:
                 # reach the client
                 exclude[used.idx] = used.generation
                 reroutes += 1
-                self.counts["rerouted_503"] += 1
+                self._count("rerouted_503")
                 self.flight.record("reroute_503", rid=entry.rid,
                                    replica=used.idx)
                 if reroutes <= len(self.replicas):
@@ -877,7 +880,7 @@ class Router:
                 self._breaker_failure(used)
             else:
                 self._breaker_success(used)
-            self.counts["requests"] += 1
+            self._count("requests")
             self._c_requests.labels(str(used.idx), str(status)).inc()
             self._h_latency.observe(time.monotonic() - t0)
             return status, data
@@ -968,6 +971,15 @@ class Router:
                         acc[k] += v
         return {name: dict(c) for name, c in sorted(agg.items())}
 
+    def _count(self, key: str, n: int = 1) -> None:
+        """Bump a stats counter (thread-safe: supervisor + handlers)."""
+        with self._lock:
+            self.counts[key] += n
+
+    def counts_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: int(v) for k, v in sorted(self.counts.items())}
+
     def stats_snapshot(self) -> dict:
         """JSON-ready router state for ``GET /v1/router/stats`` (and
         the bench JSON's ``router`` block)."""
@@ -975,8 +987,7 @@ class Router:
             "replicas": [r.snapshot() for r in self.replicas],
             "journal_depth": self.journal.depth(),
             "tenants": self._tenant_aggregate(),
-            "counters": {k: int(v) for k, v in sorted(
-                self.counts.items())},
+            "counters": self.counts_snapshot(),
             "rolling_restart_in_progress": self._rolling,
             "config": {
                 "replicas": self.cfg.replicas,
@@ -1150,14 +1161,14 @@ class Router:
                             # nothing streamed yet: invisible failover
                             router._breaker_failure(r)
                             exclude[r.idx] = r.generation
-                            router.counts["failovers"] += 1
+                            router._count("failovers")
                             router._c_failovers.inc()
                             router.flight.record(
                                 "failover", rid=entry.rid,
                                 replica=r.idx, error=str(e)[:200])
                             if entry.replays < router.cfg.max_replays:
                                 entry.replays += 1
-                                router.counts["replays"] += 1
+                                router._count("replays")
                                 router._c_replays.inc()
                                 continue
                             return self._json(502, {"error": {
@@ -1169,7 +1180,7 @@ class Router:
                             # replica — propagate, don't re-route
                             data = resp.read()
                             router._breaker_success(r)
-                            router.counts["shed_429"] += 1
+                            router._count("shed_429")
                             return self._json(
                                 429, data,
                                 headers=_retry_after_headers(data))
@@ -1178,7 +1189,7 @@ class Router:
                             resp.read()
                             exclude[r.idx] = r.generation
                             reroutes += 1
-                            router.counts["rerouted_503"] += 1
+                            router._count("rerouted_503")
                             continue
                         if resp.status != 200:
                             data = resp.read()
@@ -1225,8 +1236,8 @@ class Router:
                     return
                 # REPLICA lost mid-stream: structured error, not a
                 # dropped socket
-                router.counts["failovers"] += 1
-                router.counts["stream_errors"] += 1
+                router._count("failovers")
+                router._count("stream_errors")
                 router._c_failovers.inc()
                 router._breaker_failure(r)
                 retry = router.retry_after_hint()

@@ -63,8 +63,11 @@ def make_train_step(
         return next_token_loss(logits, batch["input_ids"],
                                batch.get("attention_mask"))
 
+    # audited no-donate: relora-style callers snapshot the pre-step
+    # params tree (merge/reset cycles) after the call returns, so
+    # donating position 0 would hand them invalidated buffers
     @functools.partial(tracked_jit, "train_step")
-    def step(params, opt_state, batch):
+    def step(params, opt_state, batch):  # graftlint: disable=jax-missing-donate
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         if trainable_filter is not None:
             tmask = trainable_filter(params)
@@ -130,8 +133,10 @@ def make_lora_train_step(
         return next_token_loss(logits, batch["input_ids"],
                                batch.get("attention_mask"))
 
+    # audited no-donate: see train_step — merge-and-reset callers keep
+    # the previous adapter tree alive across the step boundary
     @functools.partial(tracked_jit, "lora_train_step")
-    def step(train, opt_state, frozen, batch):
+    def step(train, opt_state, frozen, batch):  # graftlint: disable=jax-missing-donate
         loss, grads = jax.value_and_grad(loss_fn)(train, frozen, batch)
         updates, opt_state = optimizer.update(grads, opt_state, train)
         train = optax.apply_updates(train, updates)

@@ -21,6 +21,19 @@ from bigdl_tpu.ops.quant import FLOAT_QTYPES
 from bigdl_tpu.utils.hf import iter_hf_tensors, load_hf_config
 
 
+def _bucket_seq(n: int, cap: int) -> int:
+    """Round a decoder-cache length up to a power-of-two bucket (capped
+    at the learned position table) so cache init AND decode compile
+    once per bucket instead of once per distinct
+    ``forced + max_new_tokens`` sum — the length is a static jit arg
+    and shapes the cache. Positions past the written prefix are masked
+    by write position in attention, so the slack rows are inert."""
+    b = 16
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
 def _greedy_decode_loop(decode_fn, params, cfg, ids: np.ndarray,
                         cache, max_new_tokens: int, eos: int) -> np.ndarray:
     """Shared forced-prefix greedy loop (whisper + bart facades):
@@ -101,7 +114,8 @@ class TpuSpeechSeq2Seq:
                 f"max_target_positions ({cfg.max_target_positions})")
         if max_new_tokens <= 0:
             return ids
-        max_seq = ids.shape[1] + max_new_tokens
+        max_seq = _bucket_seq(ids.shape[1] + max_new_tokens,
+                              cfg.max_target_positions)
         cache = self._init_cache(self.params, cfg, enc_out, max_seq)
         return _greedy_decode_loop(self._decode, self.params, cfg, ids,
                                    cache, max_new_tokens, eos)
@@ -174,8 +188,10 @@ class TpuSeq2SeqLM:
                 f"({cfg.max_position_embeddings})")
         if max_new_tokens <= 0:
             return ids
+        max_seq = _bucket_seq(ids.shape[1] + max_new_tokens,
+                              cfg.max_position_embeddings)
         cache = self._init_cache(self.params, cfg, enc_out,
-                                 ids.shape[1] + max_new_tokens, False, mask)
+                                 max_seq, False, mask)
         return _greedy_decode_loop(self._decode, self.params, cfg, ids,
                                    cache, max_new_tokens, eos)
 

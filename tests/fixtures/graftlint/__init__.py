@@ -1,0 +1,3 @@
+# Seeded-bug fixture modules for tests/test_graftlint.py. They are
+# PARSED by the analyzer, never imported or executed — the jax/np
+# references are text, not dependencies.

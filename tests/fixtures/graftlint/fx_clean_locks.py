@@ -1,0 +1,21 @@
+"""Clean module: consistent lock discipline, zero findings expected."""
+
+import threading
+
+
+class CleanStats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self.limit = 100                    # read-only config: lock-free
+
+    def record(self, n):
+        with self._lock:
+            self._total += n
+
+    def total(self):
+        with self._lock:
+            return self._total
+
+    def allowed(self, n):
+        return n <= self.limit

@@ -1,0 +1,25 @@
+"""Seeded bug: attribute written under a lock, read/written without."""
+
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._peak = 0
+
+    def record(self, n):
+        with self._lock:
+            self._total += n                # establishes the guard
+
+    def racy_bump(self, n):
+        self._total += n                    # write without the lock
+
+    def racy_read(self):
+        return self._total                  # read without the lock
+
+    def peak(self, n):
+        # _peak is never written under the lock -> unguarded, silent
+        self._peak = max(self._peak, n)
+        return self._peak

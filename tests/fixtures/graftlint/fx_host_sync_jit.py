@@ -1,0 +1,25 @@
+"""Seeded bug: host-device syncs inside a jit-traced body."""
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.observability.compile_watch import tracked_jit
+
+
+@functools.partial(tracked_jit, "fx_bad_forward")
+def bad_forward(params, x):
+    scale = float(x[0])                     # sync: traced subscript
+    mx = jnp.max(x)
+    top = mx.item()                         # sync: .item() on a tracer
+    host = np.asarray(x)                    # sync: np.* on a tracer
+    return params * scale * top + host.sum()
+
+
+@functools.partial(tracked_jit, "fx_ok_forward",
+                   static_argnames=("bits",))
+def ok_forward(x, bits):
+    # static-arg math is trace-time Python: must NOT be flagged
+    half = float(1 << (bits - 1))
+    return x * half

@@ -1,0 +1,26 @@
+"""Seeded bug: two locks acquired in opposite nested orders."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self, journal):
+        self._alock = threading.Lock()
+        self.journal = journal
+        self.rows = []
+
+    def post(self, row):
+        with self._alock:                   # _alock -> _block
+            with self.journal._block:
+                self.rows.append(row)
+
+
+class Journal:
+    def __init__(self):
+        self._block = threading.Lock()
+        self.entries = []
+
+    def sweep(self, ledger):
+        with self._block:                   # _block -> _alock: inverted
+            with ledger._alock:
+                self.entries.clear()

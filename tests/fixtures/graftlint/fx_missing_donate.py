@@ -1,0 +1,13 @@
+"""Seeded bug: KV-cache first arg without donate_argnums."""
+
+from bigdl_tpu.observability.compile_watch import tracked_jit
+
+
+def _decode(cache, tokens, params):
+    return cache, tokens, params
+
+
+decode = tracked_jit("fx_decode", _decode)          # no donation
+
+donated = tracked_jit("fx_decode_ok", _decode,
+                      donate_argnums=(0,))          # fine

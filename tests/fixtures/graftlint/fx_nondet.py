@@ -1,0 +1,15 @@
+"""Seeded bug: trace-time nondeterminism inside a jit body."""
+
+import random
+import time
+
+from bigdl_tpu.observability.compile_watch import tracked_jit
+
+
+def _noisy(x):
+    jitter = random.random()                # nondet: host RNG
+    stamp = time.time()                     # nondet: wall clock
+    return x * jitter + stamp
+
+
+noisy = tracked_jit("fx_noisy", _noisy)

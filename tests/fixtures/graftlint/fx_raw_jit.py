@@ -1,0 +1,7 @@
+"""Seeded bug: raw jax.jit outside the tracked_jit allowlist."""
+
+import jax
+
+
+def build():
+    return jax.jit(lambda x: x * 2)

@@ -1,0 +1,14 @@
+"""Seeded bug: unbounded Python scalar in a static jit position."""
+
+from bigdl_tpu.observability.compile_watch import tracked_jit
+
+
+def _prefill(params, seq_len):
+    return params
+
+
+prefill = tracked_jit("fx_prefill", _prefill, static_argnums=(1,))
+
+
+def run(params, ids, extra):
+    return prefill(params, len(ids) + extra)    # one compile per length

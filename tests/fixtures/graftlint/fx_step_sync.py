@@ -1,0 +1,23 @@
+"""Seeded bug: per-token host syncs on an engine-style step path."""
+
+import numpy as np
+
+
+class MiniEngine:
+    def step(self):
+        logits = self._forward()
+        return self._sample(logits)
+
+    def _forward(self):
+        return object()
+
+    def _sample(self, logits):
+        total = 0.0
+        for i in range(16):
+            total += float(logits[i])       # one D2H sync per token
+        rows = [np.asarray(r) for r in logits]      # pull inside a loop
+        return total, rows
+
+    def _sample_ok(self, logits):
+        ls = np.asarray(logits)             # ONE pull...
+        return float(ls[0])                 # ...then host indexing: ok

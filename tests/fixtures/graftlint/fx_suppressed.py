@@ -1,0 +1,5 @@
+"""Audited exception: an inline disable silences the finding."""
+
+import jax
+
+probe = jax.jit(lambda x: x)  # graftlint: disable=jax-raw-jit

@@ -1,0 +1,211 @@
+"""Tier-1 gate + unit coverage for graftlint (``bigdl_tpu.analysis``).
+
+Two jobs:
+
+1. **The gate** — the repo must be clean against
+   ``tools/graftlint_baseline.json``, the baseline must stay small,
+   and an update that would grow a rule's count must be refused
+   (the ratchet).
+2. **Detection coverage** — every seeded-bug fixture in
+   ``tests/fixtures/graftlint/`` is caught by the rule named in its
+   file, taint/static-arg exclusions stay silent, the clean lock
+   fixture yields zero findings, and inline suppressions work.
+
+The fixtures are parsed, never imported — no JAX needed to run this.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import pytest
+
+from bigdl_tpu.analysis import (
+    RULES,
+    analyze,
+    iter_package_files,
+    load_baseline,
+    new_findings,
+    ratchet_violations,
+)
+from bigdl_tpu.analysis.core import Finding
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "bigdl_tpu"
+FIXTURES = REPO / "tests" / "fixtures" / "graftlint"
+BASELINE = REPO / "tools" / "graftlint_baseline.json"
+
+
+def _scan(name: str, **kw):
+    """Analyze one fixture module; returns the AnalysisResult."""
+    path = FIXTURES / name
+    assert path.is_file(), f"fixture missing: {path}"
+    return analyze([path], repo_root=REPO, **kw)
+
+
+def _rules(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ---------------------------------------------------------------------------
+# the gate
+
+
+def test_repo_is_clean_vs_baseline():
+    result = analyze(iter_package_files(PKG), repo_root=REPO)
+    assert not result.parse_failures, result.parse_failures
+    fresh = new_findings(result.findings, load_baseline(BASELINE))
+    assert not fresh, (
+        "new graftlint finding(s) — fix them, add an audited "
+        "'# graftlint: disable=<rule>', or (legacy debt only) "
+        "rebaseline:\n" + "\n".join(f.render() for f in fresh))
+
+
+def test_baseline_is_small():
+    doc = json.loads(BASELINE.read_text(encoding="utf-8"))
+    assert len(doc["findings"]) < 10, (
+        "the accepted-debt baseline must stay under 10 findings; "
+        "fix some before adding more")
+    assert sum(doc["counts"].values()) == len(doc["findings"])
+
+
+def test_ratchet_refuses_growth():
+    old = load_baseline(BASELINE)
+    grown = [Finding("jax-raw-jit", "bigdl_tpu/new.py", 1, "<module>",
+                     "raw jit", "jax.jit(f)")]
+    violations = ratchet_violations(old, grown)
+    assert violations and "jax-raw-jit" in violations[0]
+    # shrinking (or staying empty) is always allowed
+    assert ratchet_violations(old, []) == []
+
+
+def test_rule_catalog_covers_findings():
+    for rule in ("jax-raw-jit", "jax-host-sync-in-jit",
+                 "jax-nondet-in-jit", "jax-missing-donate",
+                 "jax-scalar-signature", "step-host-sync",
+                 "lock-guarded-unlocked", "lock-order-inversion"):
+        assert rule in RULES
+
+
+# ---------------------------------------------------------------------------
+# seeded JAX-hazard fixtures
+
+
+def test_detects_host_sync_in_jit():
+    result = _scan("fx_host_sync_jit.py")
+    hits = [f for f in result.findings
+            if f.rule == "jax-host-sync-in-jit"]
+    msgs = " | ".join(f.message for f in hits)
+    assert len(hits) == 3, result.findings
+    assert "float()" in msgs and ".item()" in msgs \
+        and "np.asarray" in msgs
+    # static-arg math (float(1 << (bits - 1))) must stay silent
+    assert all(f.obj == "fx_bad_forward" for f in hits)
+
+
+def test_detects_raw_jit():
+    result = _scan("fx_raw_jit.py")
+    assert _rules(result) == ["jax-raw-jit"]
+    f = result.findings[0]
+    assert "tracked_jit" in f.message and "compile table" in f.message
+
+
+def test_detects_nondet_in_jit():
+    result = _scan("fx_nondet.py")
+    hits = [f for f in result.findings if f.rule == "jax-nondet-in-jit"]
+    msgs = " | ".join(f.message for f in hits)
+    assert len(hits) == 2 and "random" in msgs and "time" in msgs
+
+
+def test_detects_missing_donate():
+    result = _scan("fx_missing_donate.py")
+    hits = [f for f in result.findings
+            if f.rule == "jax-missing-donate"]
+    assert len(hits) == 1, result.findings
+    assert "cache" in hits[0].message
+
+
+def test_detects_scalar_signature_drift():
+    result = _scan("fx_scalar_sig.py")
+    hits = [f for f in result.findings
+            if f.rule == "jax-scalar-signature"]
+    assert len(hits) == 1 and "static position 1" in hits[0].message
+
+
+def test_detects_step_path_host_sync():
+    rel = "tests/fixtures/graftlint/fx_step_sync.py"
+    result = _scan("fx_step_sync.py",
+                   step_entries={rel: ("MiniEngine", "step")})
+    hits = [f for f in result.findings if f.rule == "step-host-sync"]
+    assert len(hits) >= 2, result.findings
+    assert {f.obj for f in hits} == {"MiniEngine._sample"}
+    # the pull-once-then-index method must stay silent
+    assert not any(f.obj.endswith("_sample_ok") for f in hits)
+
+
+def test_step_path_needs_entry():
+    # without the step_entries override the fixture is not an engine
+    result = _scan("fx_step_sync.py")
+    assert not any(f.rule == "step-host-sync" for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# seeded lock-discipline fixtures
+
+
+def test_detects_guarded_write_unguarded_access():
+    result = _scan("fx_guarded_write.py")
+    hits = [f for f in result.findings
+            if f.rule == "lock-guarded-unlocked"]
+    assert len(hits) == 2, result.findings
+    by_method = {f.obj: f for f in hits}
+    assert "Stats.racy_bump" in by_method
+    assert "Stats.racy_read" in by_method
+    assert "write" in by_method["Stats.racy_bump"].message
+    assert "read" in by_method["Stats.racy_read"].message
+    # _peak is never written under the lock: stays unguarded, silent
+    assert not any("_peak" in f.message for f in hits)
+
+
+def test_detects_lock_order_inversion():
+    result = _scan("fx_lock_inversion.py")
+    hits = [f for f in result.findings
+            if f.rule == "lock-order-inversion"]
+    assert len(hits) == 1, result.findings
+    assert "_alock" in hits[0].message and "_block" in hits[0].message
+    assert "deadlock" in hits[0].message
+
+
+def test_clean_locks_zero_findings():
+    result = _scan("fx_clean_locks.py")
+    assert result.findings == [], result.findings
+
+
+# ---------------------------------------------------------------------------
+# suppressions + fingerprints
+
+
+def test_inline_suppression():
+    result = _scan("fx_suppressed.py")
+    assert result.findings == []
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0].rule == "jax-raw-jit"
+
+
+def test_fingerprint_survives_code_motion():
+    a = Finding("r", "p.py", 10, "obj", "m", "x = jax.jit(f)")
+    b = Finding("r", "p.py", 99, "obj", "m", "x  =  jax.jit(f)")
+    assert a.fingerprint() == b.fingerprint()
+    c = Finding("r", "p.py", 10, "obj", "m", "y = jax.jit(f)")
+    assert a.fingerprint() != c.fingerprint()
+
+
+def test_cli_gate_exit_codes():
+    from bigdl_tpu.analysis.__main__ import main
+
+    # clean repo against the shipped baseline
+    assert main([]) == 0
+    # a seeded-bug fixture must fail the gate
+    assert main([str(FIXTURES / "fx_raw_jit.py"),
+                 "--no-baseline"]) == 1

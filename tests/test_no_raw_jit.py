@@ -6,7 +6,12 @@ compile table (counts, seconds, memory analysis) and recompile storms
 get flagged. A raw ``jax.jit(`` silently opts out of all of that, so
 this test fails the build on any new one.
 
-Allowlist:
+Since the graftlint PR this test runs the ``jax-raw-jit`` rule of the
+AST analyzer (``bigdl_tpu.analysis``) instead of the old regex scan:
+same contract, but calls in comments/strings no longer false-positive
+and the allowlist lives in ONE place
+(``bigdl_tpu.analysis.jax_rules.RAW_JIT_ALLOWLIST``):
+
   - ``observability/compile_watch.py`` — the wrapper itself.
   - ``ops/probing.py`` — probe_compile AOT-compiles a throwaway fn to
     measure compile cost; it is never executed and tracking it would
@@ -16,31 +21,19 @@ Allowlist:
 from __future__ import annotations
 
 import pathlib
-import re
 
-PKG = pathlib.Path(__file__).resolve().parent.parent / "bigdl_tpu"
+from bigdl_tpu.analysis import RAW_JIT_ALLOWLIST, analyze, \
+    iter_package_files
 
-ALLOWED = {
-    "observability/compile_watch.py",
-    "ops/probing.py",
-}
-
-# matches jax.jit( as a call — not mentions in comments/docstrings that
-# merely name the API without an opening paren right after
-RAW_JIT = re.compile(r"\bjax\.jit\(")
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "bigdl_tpu"
 
 
 def test_no_raw_jax_jit():
-    offenders = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(PKG).as_posix()
-        if rel in ALLOWED:
-            continue
-        for lineno, line in enumerate(
-                path.read_text(encoding="utf-8").splitlines(), 1):
-            if RAW_JIT.search(line):
-                offenders.append(f"bigdl_tpu/{rel}:{lineno}: "
-                                 f"{line.strip()}")
+    result = analyze(iter_package_files(PKG), repo_root=REPO,
+                     rules=["jax-raw-jit"])
+    offenders = [f"{f.path}:{f.line}: {f.snippet}"
+                 for f in result.findings]
     assert not offenders, (
         "raw jax.jit( call(s) found — use "
         "bigdl_tpu.observability.compile_watch.tracked_jit instead so "
@@ -50,5 +43,5 @@ def test_no_raw_jax_jit():
 
 def test_allowlist_is_current():
     """Allowlisted files must still exist (stale entries rot)."""
-    for rel in ALLOWED:
-        assert (PKG / rel).is_file(), f"allowlist entry gone: {rel}"
+    for rel in RAW_JIT_ALLOWLIST:
+        assert (REPO / rel).is_file(), f"allowlist entry gone: {rel}"

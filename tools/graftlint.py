@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""CI gate wrapper for graftlint (``bigdl_tpu.analysis``).
+
+Thin by design: resolves the repo root onto ``sys.path`` so the gate
+runs from a bare checkout (no install), then delegates to the package
+CLI. Exit codes pass through unchanged (0 clean, 1 new findings vs
+``tools/graftlint_baseline.json``, 2 ratchet violation / parse error),
+so a CI step can be exactly ``python tools/graftlint.py``.
+"""
+
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(REPO_ROOT))
+    from bigdl_tpu.analysis.__main__ import main
+
+    sys.exit(main())
