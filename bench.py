@@ -373,11 +373,44 @@ def _floors(cfg, weight_bytes: int, prompt_len: int) -> tuple:
     return decode_floor, prefill_floor
 
 
+# known environment-limitation signatures -> skip class. A lane whose
+# crash matches one of these is NOT a code fault: it cannot run in this
+# environment (tunnel backends without pallas lowering, runtimes
+# without fp8, HBM too small for a forced all-M kernel). Such lanes
+# become structured {"skip": reason, "skip_class": cls} records instead
+# of bare errors: they don't demote in _ordered_configs, don't flip the
+# run's exit code, and keep enough detail to revive on a capable chip.
+SKIP_SIGNATURES = (
+    ("Evaluation rule for 'program_id' not implemented",
+     "pallas-lowering-unsupported"),
+    ("Mosaic", "pallas-lowering-unsupported"),
+    ("MOSAIC", "pallas-lowering-unsupported"),
+    ("float8", "fp8-unsupported-backend"),
+    ("f8E5M2", "fp8-unsupported-backend"),
+    ("f8E4M3", "fp8-unsupported-backend"),
+    ("RESOURCE_EXHAUSTED", "hbm-oom"),
+    ("Out of memory", "hbm-oom"),
+)
+
+
+def _classify_skip(text: str) -> "str | None":
+    """Skip class for a crash message, or None when it is a real
+    fault that must keep failing the run."""
+    for needle, cls in SKIP_SIGNATURES:
+        if needle in text:
+            return cls
+    return None
+
+
 def _one_config(label: str) -> None:
     """Subprocess entry: run ONE dispatch configuration, print JSON.
 
     `kv-<dtype>` labels are the --kv-cache-dtype sweep rows: shipped
-    dispatch flags, only the KV storage dtype varied."""
+    dispatch flags, only the KV storage dtype varied.
+
+    A crash matching SKIP_SIGNATURES exits 0 with a structured skip
+    record: the parent must be able to tell "this lane cannot run
+    here" from "this lane found a bug"."""
     cfgs = dict(AB_CONFIGS)
     if label in cfgs:
         overrides = dict(cfgs[label])
@@ -392,9 +425,22 @@ def _one_config(label: str) -> None:
     from bigdl_tpu.config import set_flags
 
     set_flags(**overrides)
-    print(json.dumps(bench_config(qtype=qtype, kv_quantized=kv_quantized,
-                                  merged=merged,
-                                  kv_cache_dtype=kv_cache_dtype)))
+    try:
+        rec = bench_config(qtype=qtype, kv_quantized=kv_quantized,
+                           merged=merged, kv_cache_dtype=kv_cache_dtype)
+    except Exception as e:
+        import traceback
+
+        detail = f"{type(e).__name__}: {e}"
+        cls = _classify_skip(detail) or _classify_skip(
+            traceback.format_exc())
+        if cls is None:
+            raise
+        traceback.print_exc()
+        print(json.dumps({"skip": detail[:300], "skip_class": cls,
+                          "config": label}))
+        return
+    print(json.dumps(rec))
 
 
 def _latest_valid_onchip_record(run_dir: str | None = None) -> dict | None:
@@ -445,11 +491,12 @@ def _ordered_configs(run_dir: str) -> list:
 
     parts = sorted(glob.glob(os.path.join(run_dir, "bench_partial_*.jsonl")))
     bad: set = set()
+    starved: set = set()
     # newest window with ATTRIBUTABLE evidence wins: a window where the
     # tunnel died (only no_fault records) says nothing about config
     # health and must not erase an earlier window's demotion memory
     for path in reversed(parts):
-        faults, attributable = set(), False
+        faults, owed, attributable = set(), set(), False
         try:
             with open(path) as f:
                 for ln in f:
@@ -461,21 +508,35 @@ def _ordered_configs(run_dir: str) -> list:
                         if not rec.get("fast_fail"):
                             faults.add(rec.get("config"))
                         attributable = True
+                    elif rec.get("skip_class") == "budget-exhausted":
+                        # the window ran out of budget before this
+                        # config: it is OWED a slot at the front next
+                        # window, else the tail of the matrix starves
+                        # forever
+                        owed.add(rec.get("config"))
+                        attributable = True
                     elif "next_token_ms" in rec:
                         attributable = True
         except (OSError, json.JSONDecodeError):
             continue
         if attributable:
             bad = faults
+            starved = owed - faults
             break
-    if not bad:
+    if not bad and not starved:
         return list(AB_CONFIGS)
-    healthy = [c for c in AB_CONFIGS if c[0] not in bad]
+    first = [c for c in AB_CONFIGS if c[0] in starved]
+    healthy = [c for c in AB_CONFIGS
+               if c[0] not in bad and c[0] not in starved]
     demoted = [c for c in AB_CONFIGS if c[0] in bad]
-    print(f"bench: demoting {[c[0] for c in demoted]} (failed last "
-          f"window) behind {len(healthy)} healthy configs",
-          file=sys.stderr)
-    return healthy + demoted
+    if first:
+        print(f"bench: promoting {[c[0] for c in first]} (budget-"
+              "starved last window) to the front", file=sys.stderr)
+    if demoted:
+        print(f"bench: demoting {[c[0] for c in demoted]} (failed last "
+              f"window) behind {len(healthy)} healthy configs",
+              file=sys.stderr)
+    return first + healthy + demoted
 
 
 def _acquire_single_instance(max_wait_s: int = 2700):
@@ -604,8 +665,15 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
         # the REMAINING budget (not the full CONFIG_TIMEOUT_S)
         remaining = budget_s - (time.time() - t_start)
         if remaining < 120:
-            ab_results[label] = {"error": f"total budget {budget_s}s "
-                                          "exhausted before this config"}
+            # a structured skip, not an error: the config never ran, so
+            # it must not demote, must not fail the run's exit code,
+            # and (skip_class "budget-exhausted") gets promoted to the
+            # front of the next window's order instead of starving at
+            # the tail forever
+            ab_results[label] = {
+                "skip": f"total budget {budget_s}s exhausted before "
+                        "this config",
+                "skip_class": "budget-exhausted"}
             continue
         cfg_timeout = min(CONFIG_TIMEOUT_S, int(remaining) - 30)
         t0 = time.time()
@@ -631,6 +699,25 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
                     + (f"crashed at: {frame}; " if frame else "")
                     + f"stderr tail: {proc.stderr[-300:]}")
             raw = json.loads(lines[-1])
+            if "skip" in raw:
+                # the child classified its own crash as an environment
+                # limitation (SKIP_SIGNATURES) — record it structured
+                ab_results[label] = {"skip": raw["skip"],
+                                     "skip_class": raw.get(
+                                         "skip_class", "unclassified")}
+                print(f"bench[{label}]: SKIP "
+                      f"({raw.get('skip_class')}: {raw['skip'][:120]})",
+                      file=sys.stderr)
+                if lane_log:
+                    ab_results[label]["lane_log"] = lane_log
+                try:
+                    with open(partial_path, "a") as pf:
+                        pf.write(json.dumps({"config": label,
+                                             **ab_results[label]})
+                                 + "\n")
+                except OSError:
+                    pass
+                continue
             if not raw.get("on_tpu"):
                 raise RuntimeError("config subprocess fell back off-TPU")
             dfloor, pfloor = _floors(LLAMA2_7B, raw["weight_bytes"],
@@ -677,11 +764,12 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
             else:
                 # no phase breadcrumb means the child never got past jax
                 # backend init — the tunnel died, the CONFIG is not at
-                # fault (the 08:03 window post-mortem); ordered_configs
-                # must not demote it next window
+                # fault (the 08:03 window post-mortem); a structured
+                # skip carries that verdict explicitly
                 ab_results[label] = {
-                    "error": f"timeout {cfg_timeout}s before any phase "
-                             "(tunnel death, not the config)",
+                    "skip": f"timeout {cfg_timeout}s before any phase "
+                            "(tunnel death, not the config)",
+                    "skip_class": "tunnel-death",
                     "no_fault": True}
             print(f"bench[{label}]: TIMEOUT", file=sys.stderr)
         except Exception as e:
@@ -690,15 +778,26 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
                 frame = _last_tb_frame(proc.stderr or "")
                 if frame:
                     err += f" (lane crashed at: {frame})"
-            ab_results[label] = {"error": err}
-            # a config that failed FAST (clean subprocess exit, no
-            # timeout) cannot have wedged the window; demoting it would
-            # delay a since-fixed retry behind the whole matrix
-            # (2026-08-02: the 3 mxu-layout configs died in seconds on a
-            # D2H bug fixed the same window)
-            if time.time() - t0 < 120:
-                ab_results[label]["fast_fail"] = True
-            print(f"bench[{label}]: FAILED {e}", file=sys.stderr)
+            cls = _classify_skip(err)
+            if cls is None and proc is not None:
+                cls = _classify_skip(proc.stderr or "")
+            if cls is not None:
+                # the crash matches a known environment limitation the
+                # child could not classify itself (e.g. it died before
+                # printing): structured skip, not a fault
+                ab_results[label] = {"skip": err[:300],
+                                     "skip_class": cls}
+                print(f"bench[{label}]: SKIP ({cls})", file=sys.stderr)
+            else:
+                ab_results[label] = {"error": err}
+                # a config that failed FAST (clean subprocess exit, no
+                # timeout) cannot have wedged the window; demoting it
+                # would delay a since-fixed retry behind the whole
+                # matrix (2026-08-02: the 3 mxu-layout configs died in
+                # seconds on a D2H bug fixed the same window)
+                if time.time() - t0 < 120:
+                    ab_results[label]["fast_fail"] = True
+                print(f"bench[{label}]: FAILED {e}", file=sys.stderr)
         if lane_log and isinstance(ab_results.get(label), dict):
             # full stdout/stderr on disk, referenced from the JSON —
             # the error string above only carries a tail
@@ -710,7 +809,10 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
             # fault record here would demote a healthy config next window
             tunnel_dead = _probe_backend(60) != "tpu"
             if tunnel_dead:
-                ab_results[label]["no_fault"] = True
+                entry = ab_results[label]
+                entry["no_fault"] = True
+                entry["skip"] = entry.pop("error")
+                entry["skip_class"] = "tunnel-death"
         try:
             with open(partial_path, "a") as pf:
                 pf.write(json.dumps({"config": label,
@@ -724,8 +826,9 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
                   "configs", file=sys.stderr)
             for rest, _ in schedule:
                 if rest not in ab_results:
-                    ab_results[rest] = {"error": "tunnel died earlier "
-                                                 "in the run"}
+                    ab_results[rest] = {
+                        "skip": "tunnel died earlier in the run",
+                        "skip_class": "tunnel-death"}
             break
 
     # headline candidates: valid AND the shipped default model config —
@@ -787,8 +890,18 @@ def _failed_lane_exit(ab_results: dict) -> None:
     """Lane-failure summary AFTER the record is printed: the sweep
     continues past an erroring lane (each records ``{"error": ...}``),
     but the run's exit code must still say some lanes have no numbers.
+    Structured skips ({"skip": ..., "skip_class": ...} — environment
+    limitations, budget exhaustion, tunnel death) are reported but do
+    NOT fail the run: a lane that cannot run here is not a fault.
     Consumers read the stdout record either way; exit 2 distinguishes
     partial-lane failure from total failure (exit 1)."""
+    skipped = sorted(k for k, v in ab_results.items() if "skip" in v)
+    if skipped:
+        classes = {k: ab_results[k].get("skip_class", "?")
+                   for k in skipped}
+        print(f"bench: {len(skipped)} lane(s) skipped: "
+              + ", ".join(f"{k} ({v})" for k, v in classes.items()),
+              file=sys.stderr)
     failed = sorted(k for k, v in ab_results.items() if "error" in v)
     if failed:
         print(f"bench: {len(failed)} lane(s) failed: "

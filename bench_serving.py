@@ -116,6 +116,124 @@ def run_router_bench(n_replicas: int, n_requests: int = 16,
     }
 
 
+def run_autoscale_bench(n_replicas: int = 2, n_requests: int = 12,
+                        new_tokens: int = 8, prompt_len: int = 12) -> dict:
+    """Forced-scale-down recovery lane: burst at <=1x on the full
+    fleet (zero shed expected), forcibly retire one replica, then let
+    the autoscaler observe the pressure of a second burst and spawn
+    the replacement. The final burst's ``shed_total`` (gated by
+    bench_diff, lower-is-better — any growth past zero flags) proves
+    the fleet is back to zero-shed at the same offered load."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from bigdl_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
+    from bigdl_tpu.serving.router import HEALTHY, Router, RouterConfig
+
+    cmd = [sys.executable, "-m", "bigdl_tpu.serving.api_server",
+           "--tiny-random", "--host", "127.0.0.1", "--port", "{port}",
+           "--max-batch", "2", "--max-seq", "64"]
+    router = Router(replica_cmd=cmd,
+                    config=RouterConfig(replicas=n_replicas,
+                                        health_sec=0.25),
+                    spawn_env={"JAX_PLATFORMS": "cpu"})
+    router.start()
+    httpd = router.serve(port=0, background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    # ticks are driven by THIS loop, not the scaler thread:
+    # deterministic decisions, and the record names the restoring tick.
+    # Aggressive thresholds — one pressured poll is enough to act.
+    scaler = Autoscaler(router, AutoscalerConfig(
+        min_replicas=1, max_replicas=n_replicas, dwell_sec=0.0,
+        up_streak=1, down_streak=10 ** 6, flip_streak=10 ** 6,
+        queue_high=0.5, occupancy_high=0.2, inflight_high=1.0))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 250, prompt_len).tolist()
+               for _ in range(n_requests)]
+
+    def healthy_count() -> int:
+        return sum(1 for r in router.replicas if r.state == HEALTHY)
+
+    def wait_healthy(n: int, timeout: float = 90.0) -> int:
+        deadline = time.time() + timeout
+        while time.time() < deadline and healthy_count() < n:
+            time.sleep(0.1)
+        return healthy_count()
+
+    def burst() -> dict:
+        results: list = []
+        lock = threading.Lock()
+
+        def one(i: int) -> None:
+            body = json.dumps({"prompt": prompts[i % len(prompts)],
+                               "max_tokens": new_tokens}).encode()
+            req = urllib.request.Request(
+                base + "/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    json.loads(resp.read())
+                status = "ok"
+            except urllib.error.HTTPError as e:
+                status = "shed" if e.code == 429 else f"http_{e.code}"
+            except Exception as e:
+                status = type(e).__name__
+            with lock:
+                results.append(status)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_requests)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return {"n_requests": n_requests,
+                "completed": results.count("ok"),
+                "shed": results.count("shed"),
+                "errors": sorted(s for s in results
+                                 if s not in ("ok", "shed"))[:5]}
+
+    out: dict = {"replicas": n_replicas}
+    try:
+        wait_healthy(n_replicas)
+        out["baseline"] = burst()
+        victims = [r for r in router.replicas if r.state == HEALTHY]
+        with router._admin_lock:
+            forced = router.retire_replica(victims[-1],
+                                           reason="bench_forced_down")
+        out["forced_down"] = bool(forced)
+        # pressured burst in the background while the autoscaler ticks:
+        # queue depth / occupancy on the survivors is the restore signal
+        bg = threading.Thread(
+            target=lambda: out.__setitem__("pressure", burst()))
+        bg.start()
+        restore_tick = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            d = scaler.tick()
+            if d["action"] == "up":
+                restore_tick = d["tick"]
+                break
+            time.sleep(0.1)
+        bg.join()
+        out["restore_tick"] = restore_tick
+        out["healthy_after_restore"] = wait_healthy(n_replicas)
+        out["restored"] = bool(
+            out["healthy_after_restore"] >= n_replicas)
+        final = burst()
+        out["final"] = final
+        # the gated row: zero shed at the same <=1x load post-recovery
+        out["shed_total"] = final["shed"]
+        out["autoscaler"] = scaler.snapshot()
+    finally:
+        httpd.shutdown()
+        router.shutdown()
+    return out
+
+
 def run_overload_bench(model, cfg, max_seq: int, prompt_len: int,
                        new_tokens: int) -> dict:
     """Open-loop overload lane: Poisson arrivals at 0.5x / 1x / 3x the
@@ -256,6 +374,15 @@ def main() -> None:
             except Exception as e:
                 failed_lanes.append("router")
                 out["router_bench"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+            # forced-scale-down recovery: its shed_total row is the
+            # bench_diff gate proving the autoscaler restored zero-shed
+            try:
+                out["router_bench"]["autoscale"] = run_autoscale_bench(
+                    max(2, min(replicas, 3)))
+            except Exception as e:
+                failed_lanes.append("autoscale")
+                out["router_bench"]["autoscale"] = {
                     "error": f"{type(e).__name__}: {e}"}
         print(json.dumps(out))
         if failed_lanes:

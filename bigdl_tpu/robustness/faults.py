@@ -44,6 +44,19 @@ Kinds and the injection points they attach to:
   ``"storm"``), driving the overload ladder (serving/overload.py)
   deterministically without real traffic: brownout escalation, QoS
   shedding, and hysteresis recovery all become scriptable.
+- ``handoff_drop``    — make the prefill replica's KV-handoff POST to a
+  decode replica fail as if the wire dropped it (point ``"handoff"``,
+  consulted via ``drop_point`` before each transfer attempt; every
+  attempt counts as one visit, so ``every=N`` drops every Nth
+  attempt). The recovery path under test is the handoff
+  retry/backoff ladder and the local-decode fallback
+  (serving/api_server.py) — the request must never be lost.
+- ``scale_flap``      — force the fleet autoscaler to alternate
+  scale-up/scale-down decisions on ticks where the clause fires
+  (``flap_direction``), bypassing its dwell/hysteresis gating. The
+  invariants under test are the hard guards: never retire the last
+  healthy replica, never fight a rolling restart
+  (serving/autoscaler.py).
 
 Trigger params (every kind):
 
@@ -79,7 +92,7 @@ FAULT_SPEC_ENV = "BIGDL_TPU_FAULT_SPEC"
 
 KINDS = ("step_exception", "admit_exception", "prefill_exception",
          "nan_logits", "slow_step", "replica_crash", "replica_hang",
-         "overload_storm")
+         "overload_storm", "handoff_drop", "scale_flap")
 
 #: default exit code for replica_crash — what an external ``kill -9``
 #: surfaces as through the shell (128 + SIGKILL)
@@ -309,6 +322,40 @@ class FaultInjector:
                 forced = c.pressure if forced is None \
                     else max(forced, c.pressure)
         return forced
+
+    def drop_point(self, point: str, step: int) -> bool:
+        """True when a ``handoff_drop`` clause fires at this point —
+        the caller must treat the in-flight transfer attempt as lost
+        (no bytes delivered) and run its retry/fallback ladder. Only
+        the ``"handoff"`` point consults this today; each attempt is
+        one visit."""
+        if not self.clauses or point != "handoff":
+            return False
+        dropped = False
+        for c in self._by_kind.get("handoff_drop", ()):
+            if c.should_fire(step):
+                self._fired("handoff_drop", point, step)
+                dropped = True
+        return dropped
+
+    def flap_direction(self, step: int) -> Optional[str]:
+        """Forced autoscaler decision for this tick: ``"up"``, ``"down"``
+        (alternating per firing, starting with "up"), or None when no
+        ``scale_flap`` clause fires. The autoscaler applies the forced
+        direction INSTEAD OF its dwell/hysteresis-gated decision — its
+        hard guards (min/max replica bounds, last-healthy, rolling
+        restart exclusion) still apply and are exactly what a flap
+        chaos test exercises."""
+        if not self.clauses:
+            return None
+        direction: Optional[str] = None
+        for c in self._by_kind.get("scale_flap", ()):
+            if c.should_fire(step):
+                self._fired("scale_flap", "scale", step)
+                # c.fired was just incremented: odd firings go up,
+                # even firings go down — a deterministic flap
+                direction = "up" if c.fired % 2 == 1 else "down"
+        return direction
 
     def poison_rows(self, step: int, active_rows) -> List[int]:
         """Rows of the decode logits to overwrite with NaN this step

@@ -35,9 +35,11 @@ completions return token ids (useful for tests and token-level clients).
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import hashlib
 import json
+import os
 import select
 import socket
 import threading
@@ -45,6 +47,8 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional
+
+import numpy as np
 
 from bigdl_tpu.observability.compile_watch import compiles_in_progress
 from bigdl_tpu.serving.engine import (EngineDraining, LLMEngine,
@@ -54,6 +58,95 @@ from bigdl_tpu.serving.overload import RequestShed
 #: engine finish reasons that map to HTTP 504 (the request ran out of
 #: time: its own deadline, or the server's drain window closed on it)
 _TIMEOUT_REASONS = ("deadline", "drain_timeout")
+
+#: replica roles in the disaggregated fleet (serving/router.py,
+#: serving/autoscaler.py): a ``prefill`` replica runs chunked prefill
+#: and ships the prompt's quantized KV snapshot to a ``decode`` replica
+#: over POST /v1/internal/kv_handoff; ``mixed`` does both locally
+REPLICA_ROLES = ("mixed", "prefill", "decode")
+
+
+def resolve_replica_role(value: Optional[str] = None) -> str:
+    """$BIGDL_TPU_REPLICA_ROLE (default "mixed"); raises ValueError on
+    an unknown role."""
+    v = value if value is not None else os.environ.get(
+        "BIGDL_TPU_REPLICA_ROLE", "mixed")
+    v = (v or "mixed").strip().lower()
+    if v not in REPLICA_ROLES:
+        raise ValueError(f"replica role {v!r} not one of "
+                         f"{', '.join(REPLICA_ROLES)}")
+    return v
+
+
+def resolve_handoff_timeout_ms(value: Optional[float] = None) -> float:
+    """$BIGDL_TPU_HANDOFF_TIMEOUT_MS (default 5000): per-attempt wall
+    budget for one KV-handoff POST to a decode replica."""
+    if value is not None:
+        v = float(value)
+    else:
+        v = float(os.environ.get("BIGDL_TPU_HANDOFF_TIMEOUT_MS", "5000"))
+    if v <= 0:
+        raise ValueError(f"handoff timeout {v} ms must be > 0")
+    return v
+
+
+def resolve_handoff_retries(value: Optional[int] = None) -> int:
+    """$BIGDL_TPU_HANDOFF_RETRIES (default 2): transfer attempts beyond
+    the first before falling back to local mixed decode."""
+    if value is not None:
+        v = int(value)
+    else:
+        v = int(os.environ.get("BIGDL_TPU_HANDOFF_RETRIES", "2"))
+    if v < 0:
+        raise ValueError(f"handoff retries {v} must be >= 0")
+    return v
+
+
+def _np_dtype(name: str):
+    """np.dtype by name, falling back to the ml_dtypes extension types
+    (bfloat16, float8_e5m2, ...) the KV planes are stored in."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def planes_to_wire(entry) -> List[dict]:
+    """KV snapshot planes -> JSON-able wire form. Each plane rides as
+    raw bytes (base64) + dtype/shape, so int8/int4-quantized planes
+    ship at their quantized width (~1/4 of bf16 for int4+scales) —
+    exactly the prefix-cache entry layout, (k, v[, k_scale, v_scale])."""
+    out = []
+    for p in entry:
+        p = np.ascontiguousarray(p)
+        out.append({"dtype": p.dtype.name, "shape": list(p.shape),
+                    "data": base64.b64encode(p.tobytes()).decode("ascii")})
+    return out
+
+
+def planes_from_wire(objs: List[dict]):
+    """Inverse of planes_to_wire; raises ValueError on a malformed or
+    truncated plane."""
+    if not isinstance(objs, list) or not 2 <= len(objs) <= 4:
+        raise ValueError("planes must be a list of 2-4 plane objects")
+    entry = []
+    for o in objs:
+        if not isinstance(o, dict):
+            raise ValueError("each plane must be an object")
+        try:
+            dt = _np_dtype(str(o["dtype"]))
+            shape = tuple(int(s) for s in o["shape"])
+            raw = base64.b64decode(o["data"])
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            raise ValueError(f"malformed KV plane: {e}") from None
+        arr = np.frombuffer(raw, dtype=dt)
+        if arr.size != int(np.prod(shape)):
+            raise ValueError(
+                f"plane byte count {arr.size} != shape {shape}")
+        entry.append(arr.reshape(shape).copy())
+    return tuple(entry)
 
 
 def _socket_disconnected(sock) -> bool:
@@ -172,10 +265,43 @@ class OpenAIServer:
     def __init__(self, engine: LLMEngine, tokenizer=None,
                  model_name: str = "bigdl-tpu-model",
                  embedder=None, embedder_tokenizer=None,
-                 wedge_sec: float = 10.0):
+                 wedge_sec: float = 10.0,
+                 role: Optional[str] = None,
+                 handoff_timeout_ms: Optional[float] = None,
+                 handoff_retries: Optional[int] = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
+        # disaggregated-serving role: "prefill" replicas ship each
+        # non-streaming request's KV snapshot to a decode replica
+        # (X-Handoff-Targets, set by the router) instead of decoding
+        # locally; "decode" replicas accept those snapshots on
+        # /v1/internal/kv_handoff; "mixed" (the default) does both.
+        # None resolves $BIGDL_TPU_REPLICA_ROLE.
+        self.role = resolve_replica_role(role)
+        self._handoff_timeout_ms = resolve_handoff_timeout_ms(
+            handoff_timeout_ms)
+        self._handoff_retries = resolve_handoff_retries(handoff_retries)
+        # handoff accounting, shared between HTTP handler threads and
+        # /v1/stats readers — every touch goes through _handoff_lock
+        self._handoff_lock = threading.Lock()
+        self._handoff_counts = {"sends": 0, "accepted": 0, "retries": 0,
+                                "fallbacks": 0, "dropped": 0}
+        self._handoff_attempts = 0
+        self._m_handoff = {
+            key: engine.registry.counter(
+                f"bigdl_tpu_handoff_{key}_total", desc)
+            for key, desc in (
+                ("sends", "KV handoffs delivered to a decode replica."),
+                ("accepted", "KV handoffs accepted from a prefill "
+                             "replica."),
+                ("retries", "KV handoff attempts that failed and were "
+                            "retried."),
+                ("fallbacks", "KV handoffs abandoned after retries; "
+                              "request decoded locally."),
+                ("dropped", "KV handoff attempts dropped by the "
+                            "handoff_drop chaos fault."),
+            )}
         # /health liveness: with unfinished work and no step() entered
         # for this long, the step loop is wedged (hung transfer,
         # replica_hang fault) — report 503 so a supervisor (the
@@ -438,6 +564,108 @@ class OpenAIServer:
         texts = {i: v for i, v in texts.items() if i < n_choices}
         return rid, out_ids, out_lps, reasons, texts, errors
 
+    # -- KV handoff (prefill side) ------------------------------------------
+
+    def _count_handoff(self, key: str) -> None:
+        with self._handoff_lock:
+            self._handoff_counts[key] += 1
+        self._m_handoff[key].inc()
+
+    def _next_handoff_attempt(self) -> int:
+        with self._handoff_lock:
+            self._handoff_attempts += 1
+            return self._handoff_attempts
+
+    def handoff_snapshot(self) -> dict:
+        """The /v1/stats "handoff" block: flat counters the router's
+        stats poll turns into per-replica deltas."""
+        with self._handoff_lock:
+            return dict(self._handoff_counts)
+
+    def _handoff_eligible(self, body: dict, params) -> List[str]:
+        """Decode targets for this request, empty when the request must
+        decode locally: only a prefill-role replica hands off, only
+        non-streaming single-choice requests (the decode replica owns
+        the whole token stream), and only when the router named targets
+        (X-Handoff-Targets is absent on direct client connections)."""
+        if self.role != "prefill" or body.get("stream"):
+            return []
+        if max(params.n, 1) != 1 or params.best_of is not None:
+            return []
+        hdr = body.get("_handoff_targets")
+        if not hdr:
+            return []
+        return [t.strip() for t in str(hdr).split(",") if t.strip()]
+
+    def _prefill_and_handoff(self, ids, params, body: dict,
+                             targets: List[str]) -> Optional[dict]:
+        """Run chunked prefill locally (a 1-token generation, which
+        leaves the prompt's quantized KV snapshot in the prefix cache),
+        then ship the snapshot + request to a decode replica and relay
+        its completion JSON. Returns None when every attempt failed —
+        the caller falls back to local mixed decode, reusing the same
+        snapshot as its own prefix seed, so the request is NEVER lost
+        to a dead decode target (and the prefill work is not wasted).
+
+        Each attempt gets resolve_handoff_timeout_ms() of wall time;
+        failures retry with bounded exponential backoff, rotating
+        through `targets`, up to resolve_handoff_retries() retries.
+        The handoff_drop chaos fault (robustness/faults.py) is
+        consulted per attempt and makes it fail as if the wire dropped
+        the transfer."""
+        probe = dataclasses.replace(params, max_tokens=1, n=1,
+                                    best_of=None, logprobs=None)
+        _, _, _, reasons, _, _ = self._run_request(ids, probe)
+        if any(r in ("error",) + _TIMEOUT_REASONS
+               for r in reasons.values()):
+            return None          # prefill itself failed: local path decides
+        entry = self.engine.export_prefix_snapshot(ids)
+        if entry is None:
+            return None          # snapshot evicted/disabled: decode locally
+        req = {k: v for k, v in body.items()
+               if k not in ("stream", "prompt", "messages",
+                            "_handoff_targets")}
+        payload = json.dumps({
+            "prompt": [int(t) for t in ids],
+            "planes": planes_to_wire(entry),
+            "request": req,
+        }).encode()
+        import urllib.request
+
+        attempts = self._handoff_retries + 1
+        delay = 0.05
+        for i in range(attempts):
+            target = targets[i % len(targets)]
+            step = self._next_handoff_attempt()
+            if self.engine.faults.drop_point("handoff", step):
+                self._count_handoff("dropped")
+            else:
+                try:
+                    r = urllib.request.Request(
+                        f"http://{target}/v1/internal/kv_handoff",
+                        data=payload, method="POST",
+                        headers={"Content-Type": "application/json",
+                                 "X-Tenant-Id": params.tenant
+                                 or "default"})
+                    with urllib.request.urlopen(
+                            r, timeout=self._handoff_timeout_ms
+                            / 1000.0) as resp:
+                        if resp.status == 200:
+                            out = json.loads(resp.read())
+                            self._count_handoff("sends")
+                            return out
+                except Exception:
+                    pass         # timeout, refused, 5xx, dead target
+            if i + 1 < attempts:
+                self._count_handoff("retries")
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+        self._count_handoff("fallbacks")
+        self.engine.flight.record(
+            "handoff_fallback", targets=list(targets),
+            attempts=attempts, prompt_len=len(ids))
+        return None
+
     # -- http ---------------------------------------------------------------
 
     def make_handler(server):
@@ -527,7 +755,10 @@ class OpenAIServer:
                     self.end_headers()
                     self.wfile.write(body)
                 elif self.path == "/v1/stats":
-                    self._json(200, server.engine.stats_snapshot())
+                    snap = server.engine.stats_snapshot()
+                    snap["role"] = server.role
+                    snap["handoff"] = server.handoff_snapshot()
+                    self._json(200, snap)
                 elif self.path == "/v1/memory":
                     # ledger static report + live device stats +
                     # headroom math (observability/memory.py)
@@ -558,6 +789,8 @@ class OpenAIServer:
                         return self._completions(body, chat=True)
                     if self.path == "/v1/embeddings":
                         return self._embeddings(body)
+                    if self.path == "/v1/internal/kv_handoff":
+                        return self._kv_handoff(body)
                     if self.path == "/v1/profiler/start":
                         return self._profiler(body, start=True)
                     if self.path == "/v1/profiler/stop":
@@ -586,6 +819,32 @@ class OpenAIServer:
                     # double-start / stop-without-start
                     return self._json(409, {"error": str(e)})
                 self._json(200, out)
+
+            def _kv_handoff(self, body: dict):
+                """Decode side of the disaggregated prefill/decode
+                split: accept a prefill replica's KV snapshot, stage it
+                into the prefix cache (engine.stage_handoff — the
+                engine loop drains it before the next admission), then
+                run the request through the NORMAL completion path. The
+                admission's prefix seeding picks the staged planes up,
+                so decode skips the already-prefilled tokens while the
+                output stays byte-identical to a from-scratch run.
+                Shedding/draining surface as the usual 429/503 — the
+                prefill side treats any non-200 as a failed attempt."""
+                prompt = body.get("prompt")
+                if not (isinstance(prompt, list) and prompt
+                        and all(isinstance(t, int) for t in prompt)):
+                    return self._json(
+                        400, {"error": "'prompt' must be a non-empty "
+                                       "token-id list"})
+                planes = planes_from_wire(body.get("planes"))
+                req = body.get("request")
+                req = dict(req) if isinstance(req, dict) else {}
+                req.pop("stream", None)
+                req["prompt"] = prompt
+                server.engine.stage_handoff(prompt, planes)
+                server._count_handoff("accepted")
+                return self._completions(req, chat=False)
 
             def _embeddings(self, body: dict):
                 if server.embedder is None or \
@@ -636,6 +895,28 @@ class OpenAIServer:
                 # then a streaming response is already half-written)
                 if server.engine.draining:
                     return self._draining_503()
+                # disaggregated path: a prefill-role replica handed a
+                # non-streaming request by the router (X-Handoff-Targets
+                # names the decode candidates) prefills locally, ships
+                # the KV snapshot, and relays the decode replica's
+                # response verbatim. A None return means every transfer
+                # attempt failed — fall through to the normal local
+                # path below, which reuses the snapshot as its own
+                # prefix seed (the handoff ladder's terminal fallback:
+                # the request is never lost to a dead decode target).
+                hdr = self.headers.get("X-Handoff-Targets")
+                if hdr and "_handoff_targets" not in body:
+                    body = dict(body)
+                    body["_handoff_targets"] = hdr
+                # (chat keeps local decode: the relayed JSON is in
+                # text_completion shape)
+                targets = (() if chat
+                           else server._handoff_eligible(body, params))
+                if targets:
+                    out = server._prefill_and_handoff(
+                        ids, params, body, targets)
+                    if out is not None:
+                        return self._json(200, out)
                 # admit BEFORE the stream branch for the same reason:
                 # overload control (RequestShed -> 429/503 +
                 # Retry-After, handled in do_POST) must reject doomed
@@ -807,7 +1088,12 @@ def main():
     ap.add_argument("--wedge-sec", type=float, default=10.0,
                     help="/health reports wedged past this step-loop "
                          "heartbeat age with work pending")
+    ap.add_argument("--role", default=None, choices=list(REPLICA_ROLES),
+                    help="fleet role (default $BIGDL_TPU_REPLICA_ROLE "
+                         "or 'mixed'): prefill replicas ship KV to "
+                         "decode replicas after chunked prefill")
     args = ap.parse_args()
+    role = resolve_replica_role(args.role)
 
     tokenizer = None
     if args.tiny_random:
@@ -834,8 +1120,12 @@ def main():
 
     from bigdl_tpu.serving.engine import EngineConfig
 
-    engine = LLMEngine(model, EngineConfig(max_batch=args.max_batch,
-                                           max_seq=args.max_seq))
+    # a prefill replica must keep prompt KV snapshots or it has
+    # nothing to hand off; mixed/decode keep the host-DRAM-hungry
+    # prefix cache off unless opted in elsewhere
+    engine = LLMEngine(model, EngineConfig(
+        max_batch=args.max_batch, max_seq=args.max_seq,
+        prefix_cache_entries=32 if role == "prefill" else 0))
     embedder = embedder_tok = None
     if args.embedder:
         from transformers import AutoTokenizer
@@ -846,7 +1136,7 @@ def main():
         embedder_tok = AutoTokenizer.from_pretrained(args.embedder)
     server = OpenAIServer(engine, tokenizer, embedder=embedder,
                           embedder_tokenizer=embedder_tok,
-                          wedge_sec=args.wedge_sec)
+                          wedge_sec=args.wedge_sec, role=role)
 
     # SIGTERM (a deploy's kill) drains instead of dying: stop admitting
     # (503 + Retry-After), finish in-flight work up to

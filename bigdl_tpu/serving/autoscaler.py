@@ -1,0 +1,436 @@
+"""Load-signal autoscaler for the multi-replica serving tier.
+
+The router (serving/router.py) already probes every replica's
+``/v1/stats`` and keeps the fleet's load signals on each ``Replica``:
+brownout level, queue depth, slot occupancy, decode tpot EWMA, and HBM
+ledger headroom. This module closes the loop — a small supervisor that
+reshapes the fleet instead of only shedding:
+
+- **Scale up** — spawn a ``mixed`` replica when the fleet is pressured
+  (any replica browned out, mean queue depth or occupancy past the
+  thresholds, or ledger headroom thin) for ``up_streak`` consecutive
+  ticks.
+- **Scale down** — drain + retire the least-loaded ``mixed`` replica
+  when the fleet has been idle for ``down_streak`` consecutive ticks.
+  NEVER the last healthy replica (``Router.retire_replica`` refuses),
+  never below ``$BIGDL_TPU_AUTOSCALE_MIN``.
+- **Role reassignment** — when pressure persists at the max replica
+  bound, flip a ``mixed`` replica to ``prefill`` when TTFT pressure
+  dominates (deep queues, calm tpot: admission work is the bottleneck)
+  or to ``decode`` when TPOT pressure dominates (hot tpot EWMA, calm
+  queues: decode steps are the bottleneck).
+
+Every decision — applied, refused, or skipped — is recorded as a
+flight-recorder event and counted in
+``bigdl_tpu_autoscaler_decisions_total{action, reason}``.
+
+Discipline against the rest of the control plane:
+
+- **Dwell + hysteresis.** Actions are gated by a dwell window
+  (``$BIGDL_TPU_AUTOSCALE_DWELL_SEC`` since the previous action) and by
+  consecutive-tick streaks, so a noisy load signal cannot flap the
+  fleet. The ``scale_flap`` chaos fault (robustness/faults.py) forces
+  alternating decisions PAST the dwell gate — the hard guards below are
+  exactly what it exercises.
+- **Hard guards.** Scale decisions take the router's ``_admin_lock``
+  non-blocking: while a rolling restart holds it (or vice versa) the
+  tick is skipped with reason ``admin_busy``. The min/max bounds and
+  the last-healthy-replica refusal hold even under a forced flap.
+
+Run it with ``Autoscaler(router).start()`` (the router CLI's
+``--autoscale``), or drive ``tick()`` directly in tests.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from bigdl_tpu.robustness.faults import FaultInjector
+from bigdl_tpu.serving.router import HEALTHY, QUARANTINED, RETIRED
+
+AUTOSCALE_MIN_ENV = "BIGDL_TPU_AUTOSCALE_MIN"
+AUTOSCALE_MAX_ENV = "BIGDL_TPU_AUTOSCALE_MAX"
+AUTOSCALE_DWELL_ENV = "BIGDL_TPU_AUTOSCALE_DWELL_SEC"
+
+
+def resolve_autoscale_min(value: Optional[str] = None) -> int:
+    """Fleet floor (default 1, must be >= 1)."""
+    raw = value if value is not None else os.environ.get(
+        AUTOSCALE_MIN_ENV, "")
+    if not raw:
+        return 1
+    n = int(raw)                       # ValueError propagates
+    if n < 1:
+        raise ValueError(
+            f"{AUTOSCALE_MIN_ENV} must be >= 1, got {raw!r}")
+    return n
+
+
+def resolve_autoscale_max(value: Optional[str] = None) -> int:
+    """Fleet ceiling (default 4, must be >= 1; clamped up to the
+    resolved min by AutoscalerConfig.resolve)."""
+    raw = value if value is not None else os.environ.get(
+        AUTOSCALE_MAX_ENV, "")
+    if not raw:
+        return 4
+    n = int(raw)                       # ValueError propagates
+    if n < 1:
+        raise ValueError(
+            f"{AUTOSCALE_MAX_ENV} must be >= 1, got {raw!r}")
+    return n
+
+
+def resolve_autoscale_dwell_sec(value: Optional[str] = None) -> float:
+    """Minimum seconds between applied scale actions (default 30,
+    must be >= 0)."""
+    raw = value if value is not None else os.environ.get(
+        AUTOSCALE_DWELL_ENV, "")
+    if not raw:
+        return 30.0
+    sec = float(raw)                   # ValueError propagates
+    if sec < 0:
+        raise ValueError(
+            f"{AUTOSCALE_DWELL_ENV} must be >= 0, got {raw!r}")
+    return sec
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    """``None`` fields defer to their env variables (bad values fall
+    back to defaults; env_check reports them)."""
+    min_replicas: Optional[int] = None   # $BIGDL_TPU_AUTOSCALE_MIN
+    max_replicas: Optional[int] = None   # $BIGDL_TPU_AUTOSCALE_MAX
+    dwell_sec: Optional[float] = None    # $BIGDL_TPU_AUTOSCALE_DWELL_SEC
+    tick_sec: float = 1.0
+    # hysteresis: consecutive pressured/idle ticks before acting
+    up_streak: int = 3
+    down_streak: int = 6
+    # pressure thresholds over the healthy fleet
+    queue_high: float = 8.0        # mean queue depth -> TTFT pressure
+    occupancy_high: float = 0.9    # mean active/total slots
+    occupancy_low: float = 0.25    # idle bound for scale-down
+    # router-side outstanding requests per replica: unlike the polled
+    # signals above this is updated synchronously per forward, so a
+    # burst registers as pressure immediately (no poll-cadence race)
+    inflight_high: float = 8.0
+    headroom_low: float = 0.1      # min ledger headroom fraction
+    tpot_high_ms: float = 250.0    # max tpot EWMA -> TPOT pressure
+    # only flip roles after pressure persisted this long at max scale
+    flip_streak: int = 5
+
+    def resolve(self) -> "AutoscalerConfig":
+        out = dataclasses.replace(self)
+        if out.min_replicas is None:
+            try:
+                out.min_replicas = resolve_autoscale_min()
+            except ValueError:
+                out.min_replicas = 1      # env_check reports it
+        if out.max_replicas is None:
+            try:
+                out.max_replicas = resolve_autoscale_max()
+            except ValueError:
+                out.max_replicas = 4
+        if out.dwell_sec is None:
+            try:
+                out.dwell_sec = resolve_autoscale_dwell_sec()
+            except ValueError:
+                out.dwell_sec = 30.0
+        out.max_replicas = max(out.max_replicas, out.min_replicas)
+        return out
+
+
+class Autoscaler:
+    """Dwell/hysteresis-gated fleet reshaping over a running Router.
+
+    One decision loop thread (``start``/``stop``) — or ``tick()``
+    driven directly by tests. Cross-thread state (the decision log and
+    streak/dwell bookkeeping, read by HTTP handler threads via
+    ``snapshot()``) is guarded by ``_lock`` on every touch; the slow
+    fleet mutations (spawn, drain, respawn) run OUTSIDE it so a
+    snapshot never blocks on a drain."""
+
+    def __init__(self, router, config: Optional[AutoscalerConfig] = None,
+                 faults: Optional[FaultInjector] = None):
+        self.router = router
+        self.cfg = (config or AutoscalerConfig()).resolve()
+        if faults is None:
+            try:
+                faults = FaultInjector.from_env()
+            except ValueError:
+                faults = FaultInjector()   # env_check reports the spec
+        self.faults = faults
+        router.autoscaler = self
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        with self._lock:
+            self._tick_no = 0
+            self._up = 0                  # consecutive pressured ticks
+            self._down = 0                # consecutive idle ticks
+            self._pressed = 0             # pressured ticks at max scale
+            # dwell measured from construction: a fresh fleet earns its
+            # first action
+            self._last_action_at = time.monotonic()
+            self._decisions: collections.deque = collections.deque(
+                maxlen=128)
+        reg = router.registry
+        self._c_decisions = reg.counter(
+            "bigdl_tpu_autoscaler_decisions_total",
+            "autoscaler decisions by action and structured reason",
+            ["action", "reason"])
+        self._g_healthy = reg.gauge(
+            "bigdl_tpu_autoscaler_healthy_replicas",
+            "healthy replicas the autoscaler observed last tick")
+        self._g_active = reg.gauge(
+            "bigdl_tpu_autoscaler_active_replicas",
+            "non-retired, non-quarantined replicas (the scale bound)")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                self.tick()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()    # the loop must survive
+            self._stop_evt.wait(timeout=self.cfg.tick_sec)
+
+    # -- signals ------------------------------------------------------------
+
+    def _healthy(self) -> List[Any]:
+        return [r for r in self.router.replicas
+                if r.state == HEALTHY and not r.planned_restart]
+
+    def _active_count(self) -> int:
+        return sum(1 for r in self.router.replicas
+                   if r.state not in (RETIRED, QUARANTINED))
+
+    def signals(self) -> Dict[str, Any]:
+        """Fleet-level load signals from the router's last stats poll."""
+        reps = self._healthy()
+        n = len(reps)
+        if not n:
+            return {"healthy": 0, "brownout_max": 0, "queue_mean": 0.0,
+                    "occupancy_mean": 0.0, "inflight_mean": 0.0,
+                    "tpot_ewma_ms_max": 0.0, "headroom_min": None}
+        hrs = [r.headroom_frac for r in reps
+               if r.headroom_frac is not None]
+        return {
+            "healthy": n,
+            "brownout_max": max(r.brownout for r in reps),
+            "queue_mean": sum(r.queue_depth for r in reps) / n,
+            "occupancy_mean": sum(r.occupancy for r in reps) / n,
+            "inflight_mean": sum(len(r.inflight) for r in reps) / n,
+            "tpot_ewma_ms_max": max(r.tpot_ewma_ms for r in reps),
+            "headroom_min": min(hrs) if hrs else None,
+        }
+
+    @staticmethod
+    def _pressured(sig: Dict[str, Any], cfg: AutoscalerConfig) -> bool:
+        hr = sig["headroom_min"]
+        return (sig["brownout_max"] >= 1
+                or sig["queue_mean"] >= cfg.queue_high
+                or sig["occupancy_mean"] >= cfg.occupancy_high
+                or sig["inflight_mean"] >= cfg.inflight_high
+                or sig["tpot_ewma_ms_max"] >= cfg.tpot_high_ms
+                or (hr is not None and hr < cfg.headroom_low))
+
+    @staticmethod
+    def _idle(sig: Dict[str, Any], cfg: AutoscalerConfig) -> bool:
+        return (sig["brownout_max"] == 0
+                and sig["queue_mean"] == 0
+                and sig["inflight_mean"] == 0
+                and sig["tpot_ewma_ms_max"] < cfg.tpot_high_ms
+                and sig["occupancy_mean"] <= cfg.occupancy_low)
+
+    # -- the decision loop --------------------------------------------------
+
+    def tick(self) -> Dict[str, Any]:
+        """One decision cycle; returns the recorded decision dict.
+        Safe to call directly (tests) — the loop thread just calls it
+        on a timer."""
+        sig = self.signals()
+        self._g_healthy.set(sig["healthy"])
+        self._g_active.set(self._active_count())
+        tick_no, action, reason = self._decide(sig)
+        if action in ("up", "down", "flip_prefill", "flip_decode"):
+            action, reason = self._apply(action, reason, sig)
+        return self._record(tick_no, action, reason, sig)
+
+    def _decide(self, sig: Dict[str, Any]):
+        """Streak/dwell bookkeeping -> (tick_no, action, reason).
+        Takes ``_lock`` itself; the slow ``_apply`` runs after it is
+        released so ``snapshot()`` never blocks on a drain."""
+        at_max = self._active_count() >= self.cfg.max_replicas
+        with self._lock:
+            self._tick_no += 1
+            tick_no = self._tick_no
+            forced = self.faults.flap_direction(tick_no)
+            if forced is not None:
+                # chaos: bypass dwell AND hysteresis — the hard guards
+                # in _apply are the invariants under test
+                return tick_no, forced, "fault:scale_flap"
+            if sig["healthy"] == 0:
+                # the router's supervisor owns crash recovery; scaling
+                # a fleet with zero healthy replicas is its job
+                self._up = self._down = self._pressed = 0
+                return tick_no, "hold", "no_healthy_replica"
+            pressured = self._pressured(sig, self.cfg)
+            idle = self._idle(sig, self.cfg)
+            self._up = self._up + 1 if pressured else 0
+            self._down = self._down + 1 if idle else 0
+            self._pressed = self._pressed + 1 \
+                if (pressured and at_max) else 0
+            dwell_ok = (time.monotonic() - self._last_action_at
+                        >= self.cfg.dwell_sec)
+            if pressured and self._up >= self.cfg.up_streak:
+                if not at_max:
+                    if dwell_ok:
+                        return tick_no, "up", \
+                            self._pressure_reason(sig)
+                    return tick_no, "hold", "dwell"
+                if self._pressed >= self.cfg.flip_streak and dwell_ok:
+                    # at the ceiling, still pressured: reshape instead
+                    if sig["queue_mean"] >= self.cfg.queue_high \
+                            and sig["tpot_ewma_ms_max"] \
+                            < self.cfg.tpot_high_ms:
+                        return tick_no, "flip_prefill", \
+                            "ttft_pressure"
+                    if sig["tpot_ewma_ms_max"] \
+                            >= self.cfg.tpot_high_ms \
+                            and sig["queue_mean"] < self.cfg.queue_high:
+                        return tick_no, "flip_decode", \
+                            "tpot_pressure"
+                return tick_no, "hold", "at_max"
+            if idle and self._down >= self.cfg.down_streak:
+                if sig["healthy"] <= max(self.cfg.min_replicas, 1):
+                    return tick_no, "hold", "at_min"
+                if dwell_ok:
+                    return tick_no, "down", "idle"
+                return tick_no, "hold", "dwell"
+            return tick_no, "hold", "steady"
+
+    @staticmethod
+    def _pressure_reason(sig: Dict[str, Any]) -> str:
+        if sig["brownout_max"] >= 1:
+            return "brownout"
+        if sig["queue_mean"] > 0:
+            return "queue_depth"
+        if sig["inflight_mean"] > 0:
+            return "inflight"
+        if sig["tpot_ewma_ms_max"] > 0:
+            return "tpot_ewma"
+        return "headroom"
+
+    def _apply(self, action: str, reason: str, sig: Dict[str, Any]):
+        """Execute one decision under the router's admin lock. Returns
+        the (possibly downgraded) (action, reason) actually taken —
+        guard refusals come back as ``refused_*``."""
+        if not self.router._admin_lock.acquire(blocking=False):
+            # a rolling restart (or another admin op) owns the fleet:
+            # scale decisions must not fight it
+            return f"skipped_{action}", "admin_busy"
+        try:
+            if action == "up":
+                if self._active_count() >= self.cfg.max_replicas:
+                    return "refused_up", "at_max"
+                self.router.add_replica(role="mixed")
+                self._mark_action_locked()
+                return "up", reason
+            healthy = self._healthy()
+            if action == "down":
+                if len(healthy) <= max(self.cfg.min_replicas, 1):
+                    return "refused_down", "at_min"
+                victim = self._victim(healthy)
+                if victim is None or not self.router.retire_replica(
+                        victim, reason="autoscale_down"):
+                    return "refused_down", "last_healthy"
+                self._mark_action_locked()
+                return "down", reason
+            # role flips
+            mixed = [r for r in healthy if r.role == "mixed"]
+            if len(mixed) < 1 or len(healthy) < 2:
+                return f"refused_{action}", "no_mixed_replica"
+            victim = self._victim(mixed)
+            role = "prefill" if action == "flip_prefill" else "decode"
+            if not self.router.reassign_role(victim, role):
+                return f"refused_{action}", "flip_failed"
+            self._mark_action_locked()
+            return action, reason
+        finally:
+            self.router._admin_lock.release()
+
+    @staticmethod
+    def _victim(candidates: List[Any]):
+        """Least-loaded candidate, mixed-role first: retiring or
+        flipping a specialized replica costs the fleet a capability."""
+        if not candidates:
+            return None
+        return min(candidates,
+                   key=lambda r: (r.role != "mixed", r.occupancy,
+                                  r.queue_depth, len(r.inflight),
+                                  r.idx))
+
+    def _mark_action_locked(self) -> None:
+        with self._lock:
+            self._last_action_at = time.monotonic()
+            self._up = self._down = self._pressed = 0
+
+    def _record(self, tick_no: int, action: str, reason: str,
+                sig: Dict[str, Any]) -> Dict[str, Any]:
+        decision = {"tick": tick_no, "action": action, "reason": reason,
+                    "signals": sig}
+        self._c_decisions.labels(action, reason).inc()
+        if action != "hold":
+            self.router._count(f"autoscale_decision_{action}")
+            self.router.flight.record("autoscale_decision",
+                                      tick=tick_no, action=action,
+                                      reason=reason, **{
+                                          k: v for k, v in sig.items()
+                                          if v is not None})
+        with self._lock:
+            self._decisions.append(decision)
+        return decision
+
+    # -- introspection ------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready state for ``GET /v1/router/stats`` (embedded by
+        the router when attached)."""
+        with self._lock:
+            return {
+                "tick": self._tick_no,
+                "up_streak": self._up,
+                "down_streak": self._down,
+                "pressed_at_max": self._pressed,
+                "last_action_age_sec": round(
+                    time.monotonic() - self._last_action_at, 3),
+                "decisions": list(self._decisions)[-16:],
+                "config": {
+                    "min_replicas": self.cfg.min_replicas,
+                    "max_replicas": self.cfg.max_replicas,
+                    "dwell_sec": self.cfg.dwell_sec,
+                    "up_streak": self.cfg.up_streak,
+                    "down_streak": self.cfg.down_streak,
+                    "flip_streak": self.cfg.flip_streak,
+                },
+            }

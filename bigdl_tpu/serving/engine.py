@@ -602,6 +602,17 @@ class LLMEngine:
         self._prefix_g = g if (self._chunk % g == 0
                                and ce.prefill_bucket % g == 0) else 0
         self._prefix_index: Dict[int, Dict[int, Tuple[int, ...]]] = {}
+        # KV handoff inbox: (prompt_tuple, planes) staged by HTTP
+        # handler threads (stage_handoff), drained into the prefix
+        # cache by the engine loop at the top of _admission_step. The
+        # deque is the only cross-thread structure — append/popleft
+        # are atomic, and all prefix-cache mutation stays on the
+        # engine thread.
+        self._handoff_in: "collections.deque" = collections.deque()
+        # staged handoff keys in arrival order, engine-thread only:
+        # bounds how many remote snapshots can pin host DRAM when the
+        # local prefix cache is disabled (prefix_cache_entries == 0)
+        self._handoff_keys: "collections.deque" = collections.deque()
 
         # -- metric families (registry/tracer/flight created above,
         # before the jit definitions)
@@ -642,6 +653,9 @@ class LLMEngine:
         self._m_tokens = m.counter(
             "bigdl_tpu_tokens_generated_total",
             "Tokens emitted to clients.")
+        self._m_handoff_staged = m.counter(
+            "bigdl_tpu_handoff_staged_total",
+            "Remote KV-handoff snapshots staged into the prefix cache.")
         # pre-register the families fed by ops/probing.py and
         # speculative.py so /metrics exposes them before the first
         # probe or speculative round runs in this process
@@ -964,6 +978,7 @@ class LLMEngine:
         """Advance chunked admission by AT MOST one chunk (bounds the
         decode gap a long prompt can cause). Starts a new admission when
         a slot is free and the queue is non-empty."""
+        self._drain_handoffs()
         a = self._admitting
         if a is None:
             free = next((i for i, s in enumerate(self.slots)
@@ -1095,6 +1110,63 @@ class LLMEngine:
             self._emit(s, lp)
             self._check_done(a.slot_idx)
             self._admitting = None
+
+    # -- KV handoff (disaggregated prefill/decode, serving/api_server) ------
+
+    def export_prefix_snapshot(self, prompt: List[int]):
+        """Host-materialized KV planes for this exact prompt's prefix
+        snapshot, or None when nothing is cached for it. Planes are
+        ``(k, v)`` or ``(k, v, k_scale, v_scale)`` numpy arrays shaped
+        ``[L, 1, keep, H, D]`` (scales ``[L, 1, keep, H]``) — the
+        prefix-cache entry format, which is also the handoff wire
+        format. Safe from HTTP handler threads: one dict get plus
+        materialization of the entry's own planes; no engine-owned
+        structure is mutated (the materialized copy is NOT written
+        back — the engine loop re-materializes on its next touch)."""
+        entry = self._prefix_cache.get(tuple(prompt))
+        if entry is None:
+            return None
+        return self._materialize(entry)
+
+    def stage_handoff(self, prompt: List[int], planes) -> None:
+        """Queue a remote prefill's KV snapshot for injection into the
+        prefix cache. Called from HTTP handler threads BEFORE the
+        corresponding add_request; the engine loop drains the inbox at
+        the top of _admission_step, so the planes are visible to
+        _seed_from_prefix_cache before the request that shipped them
+        can be selected for admission. Only the thread-safe deque
+        append happens here."""
+        self._handoff_in.append((tuple(prompt), tuple(planes)))
+
+    def _drain_handoffs(self) -> None:
+        """Engine-loop half of stage_handoff: move staged snapshots
+        into the prefix cache (+ hash index). Staged entries are
+        bounded separately from prefix_cache_entries — a decode-role
+        replica typically runs with the local prefix cache disabled,
+        and remote snapshots must not accumulate without bound."""
+        if not self._handoff_in:
+            return
+        while True:
+            try:
+                key, entry = self._handoff_in.popleft()
+            except IndexError:
+                break
+            if key in self._prefix_cache:
+                self._prefix_cache.pop(key)      # refresh LRU position
+            else:
+                self._prefix_index_add(key)
+            self._prefix_cache[key] = entry
+            self._handoff_keys.append(key)
+            self._m_handoff_staged.inc()
+            seed_shape = tuple(entry[0].shape)
+            self.flight.record("handoff_staged", step=self._step_idx,
+                               prompt_len=len(key),
+                               seed_tokens=seed_shape[2])
+        cap = max(self.cfg_engine.prefix_cache_entries,
+                  2 * self.cfg_engine.max_batch)
+        while len(self._handoff_keys) > cap:
+            old = self._handoff_keys.popleft()
+            self._drop_prefix(list(old))
 
     @staticmethod
     def _materialize(entry):

@@ -84,8 +84,14 @@ UNHEALTHY = "unhealthy"
 DRAINING = "draining"
 BACKOFF = "backoff"
 QUARANTINED = "quarantined"
+RETIRED = "retired"                    # scaled down; never respawned
 STATE_CODES = {STARTING: 0, HEALTHY: 1, UNHEALTHY: 2, DRAINING: 3,
-               BACKOFF: 4, QUARANTINED: 5}
+               BACKOFF: 4, QUARANTINED: 5, RETIRED: 6}
+
+#: fleet roles (api_server.REPLICA_ROLES): decode replicas are reserved
+#: for KV-handoff decode work and only take client traffic when nothing
+#: else is routable
+ROLES = ("mixed", "prefill", "decode")
 
 
 def resolve_router_health_sec(value: Optional[str] = None) -> float:
@@ -169,6 +175,13 @@ class RouterConfig:
     # seconds away (backoff + respawn), and giving up instantly would
     # drop exactly the requests the replay journal exists to save
     no_replica_wait_sec: float = 30.0
+    # per-index fleet roles ("prefill" / "decode" / "mixed"); shorter
+    # than the replica count -> the rest default to "mixed". A prefill
+    # replica gets X-Handoff-Targets on its non-streaming forwards and
+    # ships KV to a decode replica (api_server /v1/internal/kv_handoff)
+    roles: Optional[List[str]] = None
+    # decode targets named per handoff (ordered least-loaded)
+    handoff_fanout: int = 3
 
     def resolve(self) -> "RouterConfig":
         out = dataclasses.replace(self)
@@ -259,9 +272,10 @@ class RequestJournal:
 class Replica:
     """Supervisor-side view of one engine replica process."""
 
-    def __init__(self, idx: int, port: int):
+    def __init__(self, idx: int, port: int, role: str = "mixed"):
         self.idx = idx
         self.port = port
+        self.role = role                 # mixed | prefill | decode
         self.proc: Any = None            # Popen-like handle
         self.state = STARTING
         self.generation = 0              # bumped per (re)spawn
@@ -277,6 +291,13 @@ class Replica:
         self.queue_depth = 0
         self.brownout = 0                # engine brownout level (probed)
         self.tenants: dict = {}          # per-tenant counters (probed)
+        # autoscaler load signals (probed from /v1/stats)
+        self.tpot_ewma_ms = 0.0          # decode-step latency EWMA
+        self.headroom_frac: Optional[float] = None  # HBM ledger headroom
+        # last probed handoff counter block + the spawn generation it
+        # belongs to (a respawn resets the replica's counters to zero)
+        self.handoff: dict = {}
+        self.handoff_gen = -1
         # circuit breaker
         self.breaker = "closed"          # closed | open | half_open
         self.breaker_failures = 0
@@ -292,7 +313,8 @@ class Replica:
     def snapshot(self) -> dict:
         return {
             "idx": self.idx, "port": self.port, "pid": self.pid,
-            "state": self.state, "generation": self.generation,
+            "state": self.state, "role": self.role,
+            "generation": self.generation,
             "restarts": self.restarts, "last_exit": self.last_exit,
             "probe_failures": self.probe_failures,
             "breaker": self.breaker,
@@ -301,6 +323,9 @@ class Replica:
             "occupancy": self.occupancy,
             "queue_depth": self.queue_depth,
             "brownout": self.brownout,
+            "tpot_ewma_ms": self.tpot_ewma_ms,
+            "headroom_frac": self.headroom_frac,
+            "handoff": dict(self.handoff),
         }
 
 
@@ -354,7 +379,14 @@ class Router:
         if len(ports) != self.cfg.replicas:
             raise ValueError(f"got {len(ports)} ports for "
                              f"{self.cfg.replicas} replicas")
-        self.replicas = [Replica(i, p) for i, p in enumerate(ports)]
+        roles = list(self.cfg.roles or [])
+        for ro in roles:
+            if ro not in ROLES:
+                raise ValueError(f"unknown replica role {ro!r} "
+                                 f"(choices: {', '.join(ROLES)})")
+        self.replicas = [
+            Replica(i, p, role=(roles[i] if i < len(roles) else "mixed"))
+            for i, p in enumerate(ports)]
         self.journal = RequestJournal()
         self.registry = registry if registry is not None \
             else MetricsRegistry()
@@ -366,6 +398,9 @@ class Router:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._admin_lock = threading.Lock()
         self._rolling = False
+        # attached by Autoscaler(router); stats_snapshot embeds its
+        # decision log when present
+        self.autoscaler: Any = None
 
         # plain counters mirror the metric families so bench JSON and
         # stats_snapshot() embed them without a registry scrape.
@@ -447,19 +482,23 @@ class Router:
                 except Exception:
                     pass
 
-    def _spawn(self, idx: int, port: int):
+    def _spawn(self, idx: int, port: int, role: str = "mixed"):
         if self._spawn_fn is not None:
             return self._spawn_fn(idx, port)
         cmd = [a.replace("{port}", str(port)) for a in self._replica_cmd]
         env = dict(os.environ)
         if self._spawn_env:
             env.update(self._spawn_env)
+        # the replica process learns its fleet role from the env the
+        # api_server CLI resolves ($BIGDL_TPU_REPLICA_ROLE) — role
+        # flips go through a drain-respawn, never a live mutation
+        env["BIGDL_TPU_REPLICA_ROLE"] = role
         return subprocess.Popen(cmd, env=env,
                                 stdout=subprocess.DEVNULL)
 
     def _respawn(self, r: Replica, initial: bool = False) -> None:
         r.generation += 1
-        r.proc = self._spawn(r.idx, r.port)
+        r.proc = self._spawn(r.idx, r.port, r.role)
         r.started_at = time.monotonic()
         r.probe_failures = 0
         r.breaker = "closed"
@@ -494,8 +533,8 @@ class Router:
 
     def _tick(self) -> None:
         now = time.monotonic()
-        for r in self.replicas:
-            if r.state == QUARANTINED or r.planned_restart:
+        for r in list(self.replicas):    # add_replica appends live
+            if r.state in (QUARANTINED, RETIRED) or r.planned_restart:
                 continue
             if r.state == BACKOFF:
                 if now >= r.backoff_until:
@@ -594,7 +633,10 @@ class Router:
                            backoff_sec=round(backoff, 3))
 
     def _poll_stats(self, r: Replica) -> None:
-        """Occupancy for least-loaded fallback routing; best-effort."""
+        """Occupancy for least-loaded fallback routing, plus the
+        autoscaler's load signals (brownout, queue depth, tpot EWMA,
+        ledger headroom) and the replica's handoff counters (turned
+        into fleet-level deltas); best-effort."""
         try:
             status, body = self._http_get(r.port, "/v1/stats",
                                           self.cfg.health_timeout_sec)
@@ -608,6 +650,25 @@ class Router:
             ov = doc.get("overload") or {}
             r.brownout = int(ov.get("brownout_level", 0))
             r.tenants = ov.get("tenants") or {}
+            r.tpot_ewma_ms = float(ov.get("tpot_ewma_ms", 0.0))
+            hr = (doc.get("memory") or {}).get("headroom") or {}
+            hb, lim = hr.get("headroom_bytes"), hr.get("bytes_limit")
+            r.headroom_frac = (float(hb) / float(lim)
+                               if isinstance(hb, (int, float))
+                               and isinstance(lim, (int, float))
+                               and lim else None)
+            ho = doc.get("handoff") or {}
+            ho = {k: int(v) for k, v in ho.items()
+                  if isinstance(v, (int, float))}
+            # per-generation deltas: a respawned replica restarts its
+            # counters at zero, so only compare within one generation
+            prev = r.handoff if r.handoff_gen == r.generation else {}
+            for key in ("retries", "fallbacks"):
+                d = ho.get(key, 0) - prev.get(key, 0)
+                if d > 0:
+                    self._count(f"handoff_{key}", d)
+            r.handoff = ho
+            r.handoff_gen = r.generation
         except (OSError, ValueError):
             pass
 
@@ -664,12 +725,18 @@ class Router:
         """Prefix-affinity first: the consistent-hash target takes the
         request when it is routable and has a free slot (its prefix
         cache already holds this prompt family's entry); otherwise the
-        least-loaded routable replica."""
+        least-loaded routable replica. Decode-role replicas are
+        reserved for handoff decode work — they take client traffic
+        only when NO other replica is routable (degraded fleet beats a
+        503)."""
         n = len(self.replicas)
         candidates = [r for r in self.replicas
                       if r.idx not in exclude and self._routable(r)]
         if not candidates:
             raise NoReplica()
+        front = [r for r in candidates if r.role != "decode"]
+        if front:
+            candidates = front
         affinity = self.replicas[key % n]
         # a browned-out replica is degrading service to protect itself:
         # prefix affinity is not worth routing INTO the pressure, and
@@ -726,14 +793,35 @@ class Router:
 
     # -- forwarding ---------------------------------------------------------
 
-    @staticmethod
-    def _fwd_headers(entry: JournalEntry) -> Dict[str, str]:
+    def _handoff_targets(self, prefill: Replica) -> List[str]:
+        """host:port decode candidates for a prefill replica's KV
+        handoff, ordered least-loaded. Decode-role replicas first;
+        with none routable, mixed replicas stand in (the prefill
+        replica itself is never a target)."""
+        cands = [r for r in self.replicas
+                 if r is not prefill and self._routable(r)]
+        pool = [r for r in cands if r.role == "decode"] \
+            or [r for r in cands if r.role == "mixed"]
+        pool.sort(key=lambda r: (r.brownout, r.occupancy,
+                                 r.queue_depth, len(r.inflight), r.idx))
+        return [f"{self.host}:{r.port}"
+                for r in pool[:max(1, self.cfg.handoff_fanout)]]
+
+    def _fwd_headers(self, entry: JournalEntry,
+                     r: Optional[Replica] = None) -> Dict[str, str]:
         """Headers for a replica forward: the client's tenant identity
         must survive the hop or every request lands in the replica's
-        shared 'default' rate-limit bucket."""
+        shared 'default' rate-limit bucket. A non-streaming forward to
+        a prefill-role replica also names its decode candidates
+        (X-Handoff-Targets) — the replica prefills, ships KV to the
+        first target it can reach, and relays the decode's answer."""
         h = {"Content-Type": "application/json"}
         if entry.tenant:
             h["X-Tenant-Id"] = entry.tenant
+        if r is not None and r.role == "prefill" and not entry.stream:
+            targets = self._handoff_targets(r)
+            if targets:
+                h["X-Handoff-Targets"] = ",".join(targets)
         return h
 
     def _forward_buffered(self, r: Replica, entry: JournalEntry
@@ -748,7 +836,7 @@ class Router:
             self.host, r.port, timeout=self.cfg.connect_timeout_sec)
         try:
             conn.request("POST", entry.path, body=entry.body,
-                         headers=self._fwd_headers(entry))
+                         headers=self._fwd_headers(entry, r))
             conn.sock.settimeout(self.cfg.forward_timeout_sec)
             resp = conn.getresponse()
             return resp.status, resp.read()
@@ -957,6 +1045,110 @@ class Router:
             time.sleep(min(0.1, self.cfg.health_sec))
         return False
 
+    # -- fleet mutation (autoscaler) ----------------------------------------
+    #
+    # All three mutators are called with self._admin_lock HELD by the
+    # caller (the autoscaler tick) — the same lock rolling_restart
+    # takes, so a scale decision can never race a rolling restart.
+    # Replicas are NEVER removed from self.replicas (routing holds
+    # positional idx lookups); a retired replica stays in the list in
+    # the terminal RETIRED state, which the supervisor skips.
+
+    def add_replica(self, role: str = "mixed") -> Replica:
+        """Grow the fleet by one replica (scale-up). Returns the new
+        Replica immediately (state STARTING); the supervisor's probe
+        loop promotes it to HEALTHY once /health answers."""
+        if role not in ROLES:
+            raise ValueError(f"unknown replica role {role!r}")
+        r = Replica(len(self.replicas), _free_port(self.host),
+                    role=role)
+        self._respawn(r, initial=True)
+        self.replicas.append(r)
+        self._count("autoscale_spawned")
+        self.flight.record("replica_added", replica=r.idx,
+                           port=r.port, role=role)
+        return r
+
+    def retire_replica(self, r: Replica,
+                       reason: str = "autoscale") -> bool:
+        """Drain and permanently remove one replica (scale-down):
+        routing stops immediately, SIGTERM runs the api_server's
+        graceful drain, and the slot is left in the terminal RETIRED
+        state. Returns False WITHOUT touching the process when the
+        replica is the last healthy one (a fleet of zero serves
+        nothing) or is not in a retirable state."""
+        healthy_others = [x for x in self.replicas
+                          if x is not r and x.state == HEALTHY
+                          and not x.planned_restart]
+        if r.state != HEALTHY or r.planned_restart \
+                or not healthy_others:
+            self._count("autoscale_refused")
+            self.flight.record(
+                "retire_refused", replica=r.idx,
+                reason=("last_healthy" if not healthy_others
+                        else f"state:{r.state}"))
+            return False
+        r.planned_restart = True         # supervisor hands the proc over
+        self._set_state(r, DRAINING)
+        try:
+            if r.proc is not None and r.proc.poll() is None:
+                r.proc.terminate()       # SIGTERM -> graceful drain
+                try:
+                    r.proc.wait(timeout=self.cfg.drain_exit_timeout_sec)
+                except Exception:
+                    try:
+                        r.proc.kill()
+                        r.proc.wait(timeout=5)
+                    except Exception:
+                        pass
+        finally:
+            self._set_state(r, RETIRED)
+            r.planned_restart = False
+        self._count("autoscale_retired")
+        self.flight.record("replica_retired", replica=r.idx,
+                           reason=reason)
+        return True
+
+    def reassign_role(self, r: Replica, role: str) -> bool:
+        """Flip one replica's fleet role via drain + respawn (the role
+        is a process property, resolved from the spawn env — never
+        mutated live). Refuses on the last healthy replica: the flip
+        makes it unavailable for a spawn cycle."""
+        if role not in ROLES:
+            raise ValueError(f"unknown replica role {role!r}")
+        healthy_others = [x for x in self.replicas
+                          if x is not r and x.state == HEALTHY
+                          and not x.planned_restart]
+        if r.state != HEALTHY or r.planned_restart \
+                or not healthy_others:
+            self._count("autoscale_refused")
+            self.flight.record("role_flip_refused", replica=r.idx,
+                               role=role)
+            return False
+        prev = r.role
+        r.planned_restart = True
+        self._set_state(r, DRAINING)
+        try:
+            if r.proc is not None and r.proc.poll() is None:
+                r.proc.terminate()
+                try:
+                    r.proc.wait(timeout=self.cfg.drain_exit_timeout_sec)
+                except Exception:
+                    try:
+                        r.proc.kill()
+                        r.proc.wait(timeout=5)
+                    except Exception:
+                        pass
+            r.role = role
+            self._respawn(r)
+            ok = self._wait_healthy(r, self.cfg.spawn_timeout_sec)
+        finally:
+            r.planned_restart = False
+        self._count("autoscale_role_flips")
+        self.flight.record("replica_role_flip", replica=r.idx,
+                           prev=prev, role=role, ok=ok)
+        return ok
+
     # -- introspection ------------------------------------------------------
 
     def _tenant_aggregate(self) -> dict:
@@ -989,6 +1181,11 @@ class Router:
             "tenants": self._tenant_aggregate(),
             "counters": self.counts_snapshot(),
             "rolling_restart_in_progress": self._rolling,
+            "roles": {ro: sum(1 for r in self.replicas
+                              if r.role == ro and r.state == HEALTHY)
+                      for ro in ROLES},
+            "autoscaler": (self.autoscaler.snapshot()
+                           if self.autoscaler is not None else None),
             "config": {
                 "replicas": self.cfg.replicas,
                 "health_sec": self.cfg.health_sec,
@@ -997,6 +1194,7 @@ class Router:
                 "breaker_threshold": self.cfg.breaker_threshold,
                 "max_replays": self.cfg.max_replays,
                 "affinity_tokens": self.cfg.affinity_tokens,
+                "handoff_fanout": self.cfg.handoff_fanout,
             },
         }
 
@@ -1152,7 +1350,7 @@ class Router:
                         try:
                             conn.request(
                                 "POST", entry.path, body=entry.body,
-                                headers=router._fwd_headers(entry))
+                                headers=router._fwd_headers(entry, r))
                             conn.sock.settimeout(
                                 router.cfg.forward_timeout_sec)
                             resp = conn.getresponse()
@@ -1294,10 +1492,20 @@ def main():
                     help="default $BIGDL_TPU_ROUTER_HEDGE_MS (0 = off)")
     ap.add_argument("--crash-budget", type=int, default=None,
                     help="default $BIGDL_TPU_ROUTER_CRASH_BUDGET (3)")
+    ap.add_argument("--roles", default=None,
+                    help="comma-separated per-index fleet roles, e.g. "
+                         "'prefill,decode' (rest default to mixed)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the load-signal autoscaler "
+                         "(serving/autoscaler.py; bounds from "
+                         "$BIGDL_TPU_AUTOSCALE_MIN/MAX, dwell from "
+                         "$BIGDL_TPU_AUTOSCALE_DWELL_SEC)")
     args = ap.parse_args()
 
     if not args.model and not args.tiny_random:
         ap.error("--model is required (or pass --tiny-random)")
+    roles = ([s.strip() for s in args.roles.split(",") if s.strip()]
+             if args.roles else None)
     cmd = [sys.executable, "-m", "bigdl_tpu.serving.api_server",
            "--host", args.host, "--port", "{port}",
            "--max-batch", str(args.max_batch),
@@ -1313,13 +1521,26 @@ def main():
         config=RouterConfig(replicas=args.replicas,
                             health_sec=args.health_sec,
                             hedge_ms=args.hedge_ms,
-                            crash_budget=args.crash_budget),
+                            crash_budget=args.crash_budget,
+                            roles=roles),
         host=args.host)
     print(f"router: spawning {router.cfg.replicas} replicas on ports "
           f"{[r.port for r in router.replicas]}", file=sys.stderr)
     router.start()
 
+    scaler = None
+    if args.autoscale:
+        from bigdl_tpu.serving.autoscaler import Autoscaler
+
+        scaler = Autoscaler(router)
+        scaler.start()
+        print(f"autoscaler: bounds [{scaler.cfg.min_replicas}, "
+              f"{scaler.cfg.max_replicas}], dwell "
+              f"{scaler.cfg.dwell_sec}s", file=sys.stderr)
+
     def _term(signum, frame):
+        if scaler is not None:
+            scaler.stop()
         threading.Thread(target=router.shutdown, daemon=True).start()
 
     signal.signal(signal.SIGTERM, _term)

@@ -21,6 +21,9 @@ import sys
 KNOWN_ENV = (
     "BIGDL_TPU_AOT_TARGET",
     "BIGDL_TPU_ATTENTION_BACKEND",
+    "BIGDL_TPU_AUTOSCALE_DWELL_SEC",
+    "BIGDL_TPU_AUTOSCALE_MAX",
+    "BIGDL_TPU_AUTOSCALE_MIN",
     "BIGDL_TPU_BROWNOUT_HIGH",
     "BIGDL_TPU_BROWNOUT_LOW",
     "BIGDL_TPU_COMPILE_CACHE",
@@ -30,6 +33,8 @@ KNOWN_ENV = (
     "BIGDL_TPU_EVENT_LOG",
     "BIGDL_TPU_EVENT_LOG_MAX_BYTES",
     "BIGDL_TPU_FAULT_SPEC",
+    "BIGDL_TPU_HANDOFF_RETRIES",
+    "BIGDL_TPU_HANDOFF_TIMEOUT_MS",
     "BIGDL_TPU_HBM_BUDGET_FRACTION",
     "BIGDL_TPU_IQ_GRID_SOURCE",
     "BIGDL_TPU_KV_CACHE_DTYPE",
@@ -48,6 +53,7 @@ KNOWN_ENV = (
     "BIGDL_TPU_QOS_DEFAULT",
     "BIGDL_TPU_QUANTIZE_KV_CACHE",
     "BIGDL_TPU_RECOMPILE_WARN",
+    "BIGDL_TPU_REPLICA_ROLE",
     "BIGDL_TPU_REQUEST_DEADLINE_MS",
     "BIGDL_TPU_ROUTER_CRASH_BUDGET",
     "BIGDL_TPU_ROUTER_HEALTH_SEC",
@@ -287,6 +293,51 @@ def collect() -> dict:
         except ValueError as e:
             info[key] = {"value": raw, "valid": False, "error": str(e)}
 
+    # fleet autoscaler bounds + dwell (the autoscaler falls back to
+    # defaults on bad values; surface range errors here instead)
+    autoscale_knobs = (
+        ("autoscale_min", "BIGDL_TPU_AUTOSCALE_MIN",
+         "resolve_autoscale_min"),
+        ("autoscale_max", "BIGDL_TPU_AUTOSCALE_MAX",
+         "resolve_autoscale_max"),
+        ("autoscale_dwell_sec", "BIGDL_TPU_AUTOSCALE_DWELL_SEC",
+         "resolve_autoscale_dwell_sec"),
+    )
+    for key, envname, fname in autoscale_knobs:
+        raw = os.environ.get(envname)
+        if not raw:
+            continue
+        from bigdl_tpu.serving import autoscaler as _autoscaler
+
+        try:
+            info[key] = {"value": getattr(_autoscaler, fname)(raw),
+                         "valid": True}
+        except ValueError as e:
+            info[key] = {"value": raw, "valid": False, "error": str(e)}
+
+    # KV-handoff transfer knobs + replica role (the api server refuses
+    # to start on a bad role, but a typo'd timeout/retry count would
+    # silently fall back — report both classes here)
+    handoff_knobs = (
+        ("replica_role", "BIGDL_TPU_REPLICA_ROLE",
+         "resolve_replica_role"),
+        ("handoff_timeout_ms", "BIGDL_TPU_HANDOFF_TIMEOUT_MS",
+         "resolve_handoff_timeout_ms"),
+        ("handoff_retries", "BIGDL_TPU_HANDOFF_RETRIES",
+         "resolve_handoff_retries"),
+    )
+    for key, envname, fname in handoff_knobs:
+        raw = os.environ.get(envname)
+        if not raw:
+            continue
+        from bigdl_tpu.serving import api_server as _api_server
+
+        try:
+            info[key] = {"value": getattr(_api_server, fname)(raw),
+                         "valid": True}
+        except ValueError as e:
+            info[key] = {"value": raw, "valid": False, "error": str(e)}
+
     typos = find_env_typos()
     if typos:
         info["env_typos"] = typos
@@ -325,6 +376,12 @@ def main() -> int:
           and info.get("brownout_low", {}).get("valid", True)
           and info.get("max_queue_depth", {}).get("valid", True)
           and info.get("max_queue_bytes", {}).get("valid", True)
+          and info.get("autoscale_min", {}).get("valid", True)
+          and info.get("autoscale_max", {}).get("valid", True)
+          and info.get("autoscale_dwell_sec", {}).get("valid", True)
+          and info.get("replica_role", {}).get("valid", True)
+          and info.get("handoff_timeout_ms", {}).get("valid", True)
+          and info.get("handoff_retries", {}).get("valid", True)
           and not info.get("env_typos")
           and info.get("postmortem_dir", {}).get("writable", True))
     print("status :", "OK" if ok else "PROBLEMS FOUND")

@@ -1,0 +1,29 @@
+"""Seeded bug: the supervisor-thread/handler-thread counter race. A
+decision-loop thread bumps shared counters and appends to a decision
+log under the lock; the HTTP handler thread's snapshot reads both
+without it — exactly the autoscaler shape the lock rules must catch."""
+
+import threading
+
+
+class FleetSupervisor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+        self._decisions = []
+
+    def supervise_tick(self, action):
+        # decision-loop thread: writes establish the guard
+        with self._lock:
+            self._counts[action] = self._counts.get(action, 0) + 1
+            self._decisions.append(action)
+
+    def snapshot(self):
+        # handler thread: racy reads of supervisor-owned state
+        return {"counts": dict(self._counts),
+                "decisions": list(self._decisions)}
+
+    def snapshot_ok(self):
+        with self._lock:
+            return {"counts": dict(self._counts),
+                    "decisions": list(self._decisions)}

@@ -1,0 +1,322 @@
+"""Unit coverage for the fleet autoscaler (serving/autoscaler.py).
+
+All in-thread, against the fake-process router from test_router: env
+resolvers + config resolution, hysteresis streaks, dwell gating, the
+hard guards (min/max bounds, last-healthy-replica refusal, the
+admin-lock exclusion against rolling restarts), role-flip direction
+selection at max scale, and the ``scale_flap`` chaos fault forcing
+decisions past the dwell gate without ever defeating a guard.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+from test_router import _fake_router  # noqa: E402
+
+from bigdl_tpu.robustness.faults import (FaultInjector,  # noqa: E402
+                                         parse_fault_spec)
+from bigdl_tpu.serving.autoscaler import (Autoscaler,  # noqa: E402
+                                          AutoscalerConfig,
+                                          resolve_autoscale_dwell_sec,
+                                          resolve_autoscale_max,
+                                          resolve_autoscale_min)
+from bigdl_tpu.serving.router import (HEALTHY, QUARANTINED,  # noqa: E402
+                                      RETIRED)
+
+
+# -- env resolvers + config -------------------------------------------------
+
+
+def test_autoscale_env_resolvers():
+    assert resolve_autoscale_min("") == 1
+    assert resolve_autoscale_min("3") == 3
+    assert resolve_autoscale_max("") == 4
+    assert resolve_autoscale_max("8") == 8
+    assert resolve_autoscale_dwell_sec("") == 30.0
+    assert resolve_autoscale_dwell_sec("2.5") == 2.5
+    assert resolve_autoscale_dwell_sec("0") == 0.0
+    for fn, bad in ((resolve_autoscale_min, "0"),
+                    (resolve_autoscale_min, "nope"),
+                    (resolve_autoscale_max, "-1"),
+                    (resolve_autoscale_dwell_sec, "-0.1"),
+                    (resolve_autoscale_dwell_sec, "soon")):
+        with pytest.raises(ValueError):
+            fn(bad)
+
+
+def test_config_resolves_env_and_clamps(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_MIN", "2")
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_MAX", "5")
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_DWELL_SEC", "1.5")
+    cfg = AutoscalerConfig().resolve()
+    assert (cfg.min_replicas, cfg.max_replicas, cfg.dwell_sec) == (2, 5, 1.5)
+    # bad env values fall back to defaults (env_check reports them)
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_MIN", "zero")
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_MAX", "-3")
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_DWELL_SEC", "soon")
+    cfg = AutoscalerConfig().resolve()
+    assert (cfg.min_replicas, cfg.max_replicas, cfg.dwell_sec) == (1, 4, 30.0)
+    # explicit fields win over env, and max is clamped up to min
+    cfg = AutoscalerConfig(min_replicas=3, max_replicas=1,
+                           dwell_sec=0.0).resolve()
+    assert (cfg.min_replicas, cfg.max_replicas, cfg.dwell_sec) == (3, 3, 0.0)
+
+
+def test_env_check_flags_bad_autoscale_and_handoff_knobs(monkeypatch):
+    from bigdl_tpu.utils.env_check import collect
+
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_MIN", "0")
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_MAX", "many")
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_DWELL_SEC", "-2")
+    monkeypatch.setenv("BIGDL_TPU_REPLICA_ROLE", "prefil")
+    monkeypatch.setenv("BIGDL_TPU_HANDOFF_TIMEOUT_MS", "0")
+    monkeypatch.setenv("BIGDL_TPU_HANDOFF_RETRIES", "-1")
+    info = collect()
+    for key in ("autoscale_min", "autoscale_max", "autoscale_dwell_sec",
+                "replica_role", "handoff_timeout_ms", "handoff_retries"):
+        assert info[key]["valid"] is False, key
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_MIN", "1")
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_MAX", "4")
+    monkeypatch.setenv("BIGDL_TPU_AUTOSCALE_DWELL_SEC", "15")
+    monkeypatch.setenv("BIGDL_TPU_REPLICA_ROLE", "prefill")
+    monkeypatch.setenv("BIGDL_TPU_HANDOFF_TIMEOUT_MS", "2500")
+    monkeypatch.setenv("BIGDL_TPU_HANDOFF_RETRIES", "3")
+    info = collect()
+    assert info["autoscale_max"]["value"] == 4
+    assert info["autoscale_dwell_sec"]["value"] == 15.0
+    assert info["replica_role"]["value"] == "prefill"
+    assert info["handoff_timeout_ms"]["value"] == 2500.0
+    assert info["handoff_retries"]["value"] == 3
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def _scaler(router, **cfg_kw):
+    cfg_kw.setdefault("min_replicas", 1)
+    cfg_kw.setdefault("max_replicas", 4)
+    cfg_kw.setdefault("dwell_sec", 0.0)
+    cfg_kw.setdefault("up_streak", 1)
+    cfg_kw.setdefault("down_streak", 1)
+    cfg_kw.setdefault("flip_streak", 1)
+    faults = cfg_kw.pop("faults", None) or FaultInjector()
+    return Autoscaler(router, AutoscalerConfig(**cfg_kw), faults=faults)
+
+
+def _pressure(router, queue=100.0, tpot=0.0):
+    for r in router.replicas:
+        r.queue_depth = queue
+        r.tpot_ewma_ms = tpot
+
+
+def _healthy_count(router):
+    return sum(1 for r in router.replicas
+               if r.state == HEALTHY and not r.planned_restart)
+
+
+# -- hysteresis + dwell -----------------------------------------------------
+
+
+def test_scale_up_waits_for_streak():
+    router = _fake_router(2)
+    a = _scaler(router, up_streak=3)
+    _pressure(router)
+    assert [a.tick()["action"] for _ in range(2)] == ["hold", "hold"]
+    d = a.tick()
+    assert d["action"] == "up" and d["reason"] == "queue_depth"
+    assert len(router.replicas) == 3        # spawned (STARTING)
+    assert router.counts["autoscale_spawned"] == 1
+    # the applied action resets the streak: next tick holds again
+    assert a.tick()["action"] == "hold"
+
+
+def test_dwell_gates_between_actions():
+    router = _fake_router(2)
+    a = _scaler(router, dwell_sec=9999.0)
+    _pressure(router)
+    d = a.tick()
+    assert (d["action"], d["reason"]) == ("hold", "dwell")
+    assert len(router.replicas) == 2
+
+
+def test_scale_down_idle_then_at_min():
+    router = _fake_router(3)
+    a = _scaler(router, down_streak=2)
+    assert a.tick()["action"] == "hold"
+    d = a.tick()
+    assert (d["action"], d["reason"]) == ("down", "idle")
+    assert sum(1 for r in router.replicas if r.state == RETIRED) == 1
+    a.tick()
+    d = a.tick()
+    assert (d["action"], d["reason"]) == ("down", "idle")
+    # 1 healthy left == min_replicas: once the idle streak re-accrues
+    # (the applied action reset it), the fleet holds at the floor
+    assert (a.tick()["action"], a.tick()["reason"]) == ("hold", "at_min")
+    for _ in range(3):
+        d = a.tick()
+        assert (d["action"], d["reason"]) == ("hold", "at_min")
+    assert _healthy_count(router) == 1
+
+
+def test_up_refused_at_max():
+    # flip_streak high: pressure at the ceiling holds instead of
+    # reshaping, so this isolates the scale-up bound
+    router = _fake_router(2)
+    a = _scaler(router, max_replicas=2, flip_streak=99)
+    _pressure(router)
+    d = a.tick()
+    assert (d["action"], d["reason"]) == ("hold", "at_max")
+
+
+def test_no_healthy_replica_holds():
+    router = _fake_router(2)
+    for r in router.replicas:
+        router._set_state(r, QUARANTINED)
+    a = _scaler(router)
+    _pressure(router)
+    d = a.tick()
+    assert (d["action"], d["reason"]) == ("hold", "no_healthy_replica")
+
+
+# -- hard guards ------------------------------------------------------------
+
+
+def test_never_retires_last_healthy_replica():
+    router = _fake_router(2)
+    router._set_state(router.replicas[1], QUARANTINED)
+    # the router-level guard, directly
+    assert router.retire_replica(router.replicas[0]) is False
+    assert router.replicas[0].state == HEALTHY
+    assert router.counts["autoscale_refused"] == 1
+    # and through the autoscaler's idle path: held at the floor
+    a = _scaler(router)
+    d = a.tick()
+    assert (d["action"], d["reason"]) == ("hold", "at_min")
+    assert _healthy_count(router) == 1
+
+
+def test_scale_flap_never_defeats_guards():
+    """scale_flap forces alternating up/down PAST dwell + hysteresis;
+    the bounds and last-healthy guards must still hold on every tick."""
+    router = _fake_router(2)
+    a = _scaler(router, max_replicas=2, dwell_sec=9999.0,
+                faults=FaultInjector(parse_fault_spec(
+                    "scale_flap@every=1,times=0")))
+    seen = []
+    for _ in range(8):
+        d = a.tick()
+        seen.append((d["action"], d["reason"]))
+        assert _healthy_count(router) >= 1     # the invariant under test
+    actions = [s[0] for s in seen]
+    # odd ticks force "up" (at the ceiling -> refused), even ticks force
+    # "down" (allowed exactly once, then the shrunken fleet refuses)
+    assert actions[0] == "refused_up" and seen[0][1] == "at_max"
+    assert "down" in actions                   # one retire went through
+    assert "refused_down" in actions           # ...then the floor held
+    assert sum(1 for x in actions if x == "down") == 1
+    # forced applied decisions carry the chaos reason; refusals carry
+    # the guard that stopped them
+    for action, reason in seen:
+        if action in ("up", "down"):
+            assert reason == "fault:scale_flap"
+        else:
+            assert reason in ("at_max", "at_min", "last_healthy")
+
+
+def test_rolling_restart_admin_lock_skips_scale_decisions():
+    """While a rolling restart holds the router's admin lock, scale
+    decisions are skipped -- the autoscaler must never fight it."""
+    router = _fake_router(2)
+    a = _scaler(router)
+    _pressure(router)
+    assert router._admin_lock.acquire(blocking=False)
+    try:
+        d = a.tick()
+        assert (d["action"], d["reason"]) == ("skipped_up", "admin_busy")
+        assert len(router.replicas) == 2       # nothing mutated
+    finally:
+        router._admin_lock.release()
+    d = a.tick()
+    assert d["action"] == "up"                 # lock released: applied
+
+
+def test_scale_down_refuses_while_replica_drains():
+    """A replica a rolling restart holds in drain (planned_restart) is
+    invisible to the autoscaler; retiring must hold at the floor when
+    the drain leaves only one other healthy replica."""
+    router = _fake_router(2)
+    router.replicas[1].planned_restart = True
+    a = _scaler(router)
+    d = a.tick()
+    assert (d["action"], d["reason"]) == ("hold", "at_min")
+    assert all(r.state == HEALTHY for r in router.replicas)
+
+
+# -- role flips at max scale ------------------------------------------------
+
+
+def _flip_recorder(router):
+    calls = []
+    router.reassign_role = lambda r, role: calls.append(
+        (r.idx, role)) or True
+    return calls
+
+
+def test_flip_prefill_on_ttft_pressure():
+    router = _fake_router(2)
+    calls = _flip_recorder(router)
+    a = _scaler(router, max_replicas=2)
+    _pressure(router, queue=100.0, tpot=0.0)   # deep queues, calm tpot
+    d = a.tick()
+    assert (d["action"], d["reason"]) == ("flip_prefill", "ttft_pressure")
+    assert calls == [(0, "prefill")]
+
+
+def test_flip_decode_on_tpot_pressure():
+    router = _fake_router(2)
+    calls = _flip_recorder(router)
+    a = _scaler(router, max_replicas=2)
+    _pressure(router, queue=0.0, tpot=10_000.0)  # hot tpot, calm queues
+    d = a.tick()
+    assert (d["action"], d["reason"]) == ("flip_decode", "tpot_pressure")
+    assert calls == [(0, "decode")]
+
+
+def test_flip_needs_a_mixed_replica():
+    router = _fake_router(2)
+    for r in router.replicas:
+        r.role = "decode"
+    a = _scaler(router, max_replicas=2)
+    _pressure(router, queue=100.0, tpot=0.0)
+    d = a.tick()
+    assert (d["action"], d["reason"]) == ("refused_flip_prefill",
+                                          "no_mixed_replica")
+
+
+# -- introspection ----------------------------------------------------------
+
+
+def test_snapshot_and_decision_log():
+    router = _fake_router(2)
+    a = _scaler(router, up_streak=2)
+    _pressure(router)
+    for _ in range(3):
+        a.tick()
+    snap = a.snapshot()
+    assert snap["tick"] == 3
+    assert snap["config"]["max_replicas"] == 4
+    acts = [d["action"] for d in snap["decisions"]]
+    assert acts == ["hold", "up", "hold"]
+    assert snap["decisions"][1]["signals"]["queue_mean"] == 100.0
+    # the decision landed in the router's stats + flight recorder too
+    assert router.counts["autoscale_decision_up"] == 1
+    assert any(e["event"] == "autoscale_decision"
+               for e in router.flight.snapshot())
+    # and the router stats snapshot embeds the autoscaler block
+    assert router.stats_snapshot()["autoscaler"]["tick"] == 3

@@ -168,6 +168,21 @@ def test_detects_guarded_write_unguarded_access():
     assert not any("_peak" in f.message for f in hits)
 
 
+def test_detects_supervisor_handler_counter_race():
+    """The autoscaler shape: a decision-loop thread bumps counters and
+    a decision log under the lock, an HTTP handler thread snapshots
+    them — an unlocked snapshot must be caught, a locked one silent."""
+    result = _scan("fx_supervisor_counter.py")
+    hits = [f for f in result.findings
+            if f.rule == "lock-guarded-unlocked"]
+    assert len(hits) == 2, result.findings
+    assert {f.obj for f in hits} == {"FleetSupervisor.snapshot"}
+    msgs = " | ".join(f.message for f in hits)
+    assert "_counts" in msgs and "_decisions" in msgs
+    assert not any(f.obj.endswith("snapshot_ok")
+                   for f in result.findings)
+
+
 def test_detects_lock_order_inversion():
     result = _scan("fx_lock_inversion.py")
     hits = [f for f in result.findings

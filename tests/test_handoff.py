@@ -1,0 +1,312 @@
+"""Disaggregated prefill/decode serving: the KV-handoff wire format
+and the subprocess chaos e2e for it.
+
+- **In-thread unit tests**: the planes wire codec (quantized KV
+  snapshot planes <-> base64 JSON) and the handoff env resolvers.
+- **Subprocess chaos e2e** (a ``["prefill", "decode"]`` fleet of real
+  ``api_server --tiny-random`` replicas with the SAME seed behind a
+  served router): greedy completions routed through the prefill ->
+  KV-handoff -> decode pipeline are byte-identical to generating
+  directly on a replica; an armed ``handoff_drop`` fault forces
+  transfer retries without losing a request; killing the decode target
+  mid-fleet falls back to local decode (zero 5xx); and a
+  ``replica_crash`` landing during an autoscaler-style scale-down
+  still completes every request with byte-identical output.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+from test_router import _completion_burst, _post  # noqa: E402
+
+from bigdl_tpu.serving.api_server import (planes_from_wire,  # noqa: E402
+                                          planes_to_wire,
+                                          resolve_handoff_retries,
+                                          resolve_handoff_timeout_ms,
+                                          resolve_replica_role)
+from bigdl_tpu.serving.router import (HEALTHY, QUARANTINED,  # noqa: E402
+                                      RETIRED, Router, RouterConfig)
+
+
+# -- wire codec (no model) --------------------------------------------------
+
+
+def test_planes_wire_roundtrip():
+    rng = np.random.default_rng(7)
+    import ml_dtypes
+
+    entry = (rng.standard_normal((2, 4, 3, 8), dtype=np.float32)
+             .astype(ml_dtypes.bfloat16),
+             rng.standard_normal((2, 4, 3, 8), dtype=np.float32)
+             .astype(ml_dtypes.bfloat16))
+    wire = planes_to_wire(entry)
+    assert [w["dtype"] for w in wire] == ["bfloat16", "bfloat16"]
+    back = planes_from_wire(json.loads(json.dumps(wire)))
+    for a, b in zip(entry, back):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+
+
+def test_planes_wire_roundtrip_quantized():
+    # int8-quantized planes + float32 scales: the 4-plane cache layout
+    rng = np.random.default_rng(3)
+    entry = (rng.integers(-128, 128, (1, 2, 5, 4), dtype=np.int8),
+             rng.integers(-128, 128, (1, 2, 5, 4), dtype=np.int8),
+             rng.standard_normal((1, 2, 5, 1)).astype(np.float32),
+             rng.standard_normal((1, 2, 5, 1)).astype(np.float32))
+    back = planes_from_wire(planes_to_wire(entry))
+    assert len(back) == 4
+    for a, b in zip(entry, back):
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+
+
+def test_planes_from_wire_rejects_malformed():
+    good = planes_to_wire((np.zeros((1, 2, 3, 4), np.float32),
+                           np.zeros((1, 2, 3, 4), np.float32)))
+    for bad in (
+            "planes",                       # not a list
+            good[:1],                       # too few planes
+            good * 3,                       # too many planes
+            [good[0], "plane"],             # non-dict plane
+            [good[0], dict(good[1], dtype="float999")],
+            [good[0], dict(good[1], data="!!!not-base64")],
+            [good[0], dict(good[1], shape=[1, 2, 3, 400])],  # truncated
+            [good[0], {k: v for k, v in good[1].items() if k != "data"}],
+    ):
+        with pytest.raises(ValueError):
+            planes_from_wire(bad)
+
+
+def test_handoff_env_resolvers():
+    assert resolve_replica_role("") == "mixed"
+    assert resolve_replica_role("Prefill") == "prefill"
+    assert resolve_handoff_timeout_ms(None) == 5000.0 \
+        or os.environ.get("BIGDL_TPU_HANDOFF_TIMEOUT_MS")
+    assert resolve_handoff_timeout_ms(250) == 250.0
+    assert resolve_handoff_retries(0) == 0
+    assert resolve_handoff_retries(3) == 3
+    with pytest.raises(ValueError):
+        resolve_replica_role("prefil")
+    with pytest.raises(ValueError):
+        resolve_handoff_timeout_ms(0)
+    with pytest.raises(ValueError):
+        resolve_handoff_retries(-1)
+
+
+# -- subprocess chaos e2e ---------------------------------------------------
+
+_FAULT_SPECS = {}          # idx -> spec; read at (re)spawn
+_ROLES = {0: "prefill", 1: "decode"}   # custom spawn bypasses router env
+
+
+def _spawn_replica(idx: int, port: int):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("BIGDL_TPU_FAULT_SPEC", None)
+    spec = _FAULT_SPECS.get(idx)
+    if spec:
+        env["BIGDL_TPU_FAULT_SPEC"] = spec
+    env["BIGDL_TPU_DRAIN_TIMEOUT_SEC"] = "30"
+    env["BIGDL_TPU_REPLICA_ROLE"] = _ROLES.get(idx, "mixed")
+    cmd = [sys.executable, "-m", "bigdl_tpu.serving.api_server",
+           "--tiny-random", "--tiny-seed", "7",
+           "--host", "127.0.0.1", "--port", str(port),
+           "--max-batch", "4", "--max-seq", "96", "--wedge-sec", "3"]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL)
+
+
+def _wait_fleet_healthy(router, timeout=240.0):
+    """All non-retired, non-quarantined replicas HEALTHY."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        live = [r for r in router.replicas
+                if r.state not in (RETIRED, QUARANTINED)]
+        if live and all(r.state == HEALTHY for r in live):
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"fleet not healthy after {timeout}s: "
+        f"{[(r.idx, r.role, r.state, r.last_exit) for r in router.replicas]}")
+
+
+def _get_stats(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/stats", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _reference_texts(port, prompts, max_tokens=8):
+    """Greedy texts generated directly on one replica (no router, no
+    X-Handoff-Targets header -> plain local generation): the oracle the
+    handoff pipeline must reproduce byte-identically."""
+    out = []
+    for p in prompts:
+        status, doc = _post(f"http://127.0.0.1:{port}", "/v1/completions",
+                            {"prompt": p, "max_tokens": max_tokens,
+                             "temperature": 0})
+        assert status == 200, doc
+        out.append(doc["choices"][0]["text"])
+    return out
+
+
+@pytest.fixture(scope="module")
+def disagg_cluster():
+    """prefill + decode replicas behind a served router. The prefill
+    replica starts with a handoff_drop fault that eats two transfer
+    attempts (the 3rd and 6th) — the retry ladder must absorb them."""
+    _FAULT_SPECS[0] = "handoff_drop@every=3,times=2"
+    router = Router(spawn=_spawn_replica, config=RouterConfig(
+        replicas=2, roles=["prefill", "decode"], health_sec=0.2,
+        backoff_base_sec=0.2, crash_budget=20, crash_window_sec=5.0,
+        unhealthy_after=4, spawn_timeout_sec=240.0,
+        drain_exit_timeout_sec=90.0, no_replica_wait_sec=120.0))
+    router.start(wait_healthy=True)
+    httpd = router.serve(port=0, background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        _wait_fleet_healthy(router)
+        yield router, base
+    finally:
+        _FAULT_SPECS.clear()
+        httpd.shutdown()
+        router.shutdown()
+
+
+def test_e2e_handoff_byte_identical_with_drop_retries(disagg_cluster):
+    """Greedy completions through prefill->KV-handoff->decode match a
+    direct single-replica run byte for byte, with the armed
+    handoff_drop fault absorbed by transfer retries (no fallback, no
+    client-visible error)."""
+    router, base = disagg_cluster
+    prefill, decode = router.replicas[0], router.replicas[1]
+    prompts = [[i + 1, i + 7, i + 13, 2, 5] for i in range(8)]
+    results = _completion_burst(base, prompts)
+    assert [s for s, _ in results] == [200] * 8
+    texts = [d["choices"][0]["text"] for _, d in results]
+    assert all(d["usage"]["completion_tokens"] == 8 for _, d in results)
+
+    # the pipeline really ran: prefill shipped KV, decode accepted it
+    pstats = _get_stats(prefill.port)
+    dstats = _get_stats(decode.port)
+    assert pstats["role"] == "prefill" and dstats["role"] == "decode"
+    ho = pstats["handoff"]
+    assert ho["sends"] >= len(prompts)
+    assert ho["retries"] >= 1, ho          # the drop fault fired
+    assert ho["dropped"] >= 1, ho
+    assert ho["fallbacks"] == 0, ho        # retries absorbed every drop
+    assert dstats["handoff"]["accepted"] >= len(prompts) - 2
+
+    # byte-identical to plain generation on the decode replica alone
+    assert _reference_texts(decode.port, prompts) == texts
+
+    # the router's stats poll picked the retry delta up as a counter
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if router.counts["handoff_retries"] >= 1:
+            break
+        time.sleep(0.05)
+    assert router.counts["handoff_retries"] >= 1
+    assert router.counts["handoff_fallbacks"] == 0
+
+
+def test_e2e_dead_decode_target_falls_back_locally(disagg_cluster):
+    """kill -9 the decode replica, then keep sending: the prefill
+    replica's handoff attempts fail, the retry ladder exhausts, and
+    every request still completes via local-decode fallback with
+    byte-identical greedy output — a dead decode target never loses a
+    request."""
+    router, base = disagg_cluster
+    prefill, decode = router.replicas[0], router.replicas[1]
+    _wait_fleet_healthy(router)
+    prompts = [[40 + i, 44, 48, 3] for i in range(4)]
+    expected = _reference_texts(prefill.port, prompts)
+    fallbacks_before = _get_stats(prefill.port)["handoff"]["fallbacks"]
+
+    os.kill(decode.pid, signal.SIGKILL)
+    results = _completion_burst(base, prompts)
+    assert all(s < 500 for s, _ in results), results
+    assert [s for s, _ in results] == [200] * 4
+    assert [d["choices"][0]["text"] for _, d in results] == expected
+
+    fallbacks_after = _get_stats(prefill.port)["handoff"]["fallbacks"]
+    assert fallbacks_after > fallbacks_before
+    _wait_fleet_healthy(router)            # supervisor respawned decode
+
+
+def test_e2e_crash_during_scale_down_zero_5xx(disagg_cluster):
+    """The acceptance chaos run: mid-burst, the decode replica is
+    retired (an autoscaler scale-down: drain via SIGTERM under the
+    admin lock) AND the surviving prefill replica is hard-killed — a
+    replica_crash landing inside the scale-down window. Every request
+    completes with zero 5xx (429 shed is acceptable) and a post-chaos
+    rerun reproduces every answer byte-identically."""
+    router, base = disagg_cluster
+    _wait_fleet_healthy(router)
+    prefill, decode = router.replicas[0], router.replicas[1]
+    prompts = [[60 + i, 61, 62, 63, 2] for i in range(8)]
+    expected = _reference_texts(prefill.port, prompts)
+
+    results = [None] * len(prompts)
+
+    def one(i):
+        results[i] = _post(base, "/v1/completions",
+                           {"prompt": prompts[i], "max_tokens": 8,
+                            "temperature": 0})
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+
+    def scale_down():
+        with router._admin_lock:
+            router.retire_replica(decode, reason="autoscale_down")
+
+    retire = threading.Thread(target=scale_down)
+    retire.start()
+    time.sleep(0.2)
+    try:
+        os.kill(prefill.pid, signal.SIGKILL)   # crash mid-scale-down
+    except ProcessLookupError:
+        pass
+    for t in threads:
+        t.join(timeout=300)
+    retire.join(timeout=120)
+
+    assert all(r is not None for r in results), "request hung"
+    codes = [s for s, _ in results]
+    assert not any(c >= 500 for c in codes), results
+    assert all(c in (200, 429) for c in codes), codes
+    assert decode.state == RETIRED
+    ok_texts = {tuple(prompts[i]): d["choices"][0]["text"]
+                for i, (s, d) in enumerate(results) if s == 200}
+    for i, p in enumerate(prompts):
+        if tuple(p) in ok_texts:
+            assert ok_texts[tuple(p)] == expected[i]
+
+    # restore the fleet: scale a fresh decode replica back in (the
+    # autoscaler's add path) and prove zero-loss steady state
+    _ROLES[len(router.replicas)] = "decode"
+    with router._admin_lock:
+        router.add_replica(role="decode")
+    _wait_fleet_healthy(router)
+    rerun = _completion_burst(base, prompts)
+    assert [s for s, _ in rerun] == [200] * len(prompts)
+    assert [d["choices"][0]["text"] for _, d in rerun] == expected
+    assert router.counts["autoscale_retired"] >= 1
+    assert router.counts["autoscale_spawned"] >= 1

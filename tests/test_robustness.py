@@ -109,6 +109,35 @@ def test_injector_hooks():
     assert null.poison_rows(1, [0]) == []
 
 
+def test_handoff_drop_hook():
+    inj = FaultInjector(parse_fault_spec("handoff_drop@every=1,times=2"))
+    assert [inj.drop_point("handoff", a) for a in (1, 2, 3, 4)] == \
+        [True, True, False, False]               # capped at times
+    inj = FaultInjector(parse_fault_spec("handoff_drop@every=2,times=0"))
+    assert [inj.drop_point("handoff", a) for a in (1, 2, 3, 4)] == \
+        [False, True, False, True]               # every 2nd attempt
+    assert not inj.drop_point("step", 2)         # wrong point: no-op
+    null = FaultInjector()
+    assert not null.drop_point("handoff", 1)
+
+
+def test_scale_flap_hook_alternates():
+    inj = FaultInjector(parse_fault_spec("scale_flap@every=1,times=0"))
+    assert [inj.flap_direction(t) for t in range(1, 6)] == \
+        ["up", "down", "up", "down", "up"]
+    inj = FaultInjector(parse_fault_spec("scale_flap@every=3,times=2"))
+    dirs = [inj.flap_direction(t) for t in range(1, 10)]
+    assert dirs[2] == "up" and dirs[5] == "down"  # ticks 3 and 6
+    assert sum(d is not None for d in dirs) == 2  # capped at times
+    assert FaultInjector().flap_direction(1) is None
+
+
+def test_validate_fault_spec_accepts_fleet_kinds():
+    ok = validate_fault_spec(
+        "handoff_drop@every=2,times=3;scale_flap@every=5")
+    assert ok["valid"] and ok["clauses"] == ["handoff_drop", "scale_flap"]
+
+
 def test_exception_fields_truncates():
     f = exception_fields(ValueError("x" * 500))
     assert f["error_type"] == "ValueError"

@@ -74,6 +74,19 @@ ROBUSTNESS_COUNTERS = (
     "bigdl_tpu_router_failovers_total",
     "bigdl_tpu_router_replays_total",
     "bigdl_tpu_router_breaker_trips_total",
+    # KV-handoff wire health: retries and local-decode fallbacks both
+    # mean a decode target failed to take a transfer
+    "bigdl_tpu_handoff_retries_total",
+    "bigdl_tpu_handoff_fallbacks_total",
+    # autoscaler guard activity: a refused or skipped decision means a
+    # scale action ran into a hard guard (last-healthy, bounds, admin
+    # lock) — more of those at the same load is a control regression.
+    # Applied decisions ("up"/"down"/flips) are intentionally NOT
+    # gated: the autoscale lane forces them by design. Label order is
+    # declaration order (action first), so a family{action=" prefix
+    # selects exactly these.
+    'bigdl_tpu_autoscaler_decisions_total{action="refused',
+    'bigdl_tpu_autoscaler_decisions_total{action="skipped',
 )
 
 # the router's flat counters block (bench_serving --replicas embeds
@@ -88,6 +101,22 @@ ROUTER_COUNTERS = {
     "rerouted_503": "lower",
     "shed_429": "lower",
     "stream_errors": "lower",
+    # disaggregated-serving health: handoff retries/fallbacks count
+    # failed KV transfers to decode replicas; autoscale_refused counts
+    # scale decisions stopped by a hard guard. Spawn/retire/flip
+    # counters are not gated — the autoscale lane drives them on
+    # purpose.
+    "handoff_retries": "lower",
+    "handoff_fallbacks": "lower",
+    "autoscale_refused": "lower",
+}
+
+# the HBM-bandwidth roofline utilization of the decode step is the
+# tentpole serving efficiency number: it gets a RATCHET — its own
+# (tighter) --max-roofline-regress-pct threshold, higher-is-better,
+# instead of riding the generic --threshold
+ROOFLINE_METRICS = {
+    "decode_hbm_roofline_util": "higher",
 }
 
 
@@ -170,13 +199,18 @@ def flatten_metrics(rec: dict, prefix: str = "",
 def diff(old: Dict[str, Tuple[float, str]],
          new: Dict[str, Tuple[float, str]],
          threshold_pct: float,
-         hbm_threshold_pct: Optional[float] = None):
+         hbm_threshold_pct: Optional[float] = None,
+         roofline_threshold_pct: Optional[float] = None):
     """Returns (rows, regressions): rows are (name, old, new, pct,
     direction, regressed) for every metric present in both files.
     Memory-report scalars (HBM_METRICS keys) regress past
-    ``hbm_threshold_pct`` (default: ``threshold_pct``)."""
+    ``hbm_threshold_pct`` (default: ``threshold_pct``); the decode
+    roofline ratchet (ROOFLINE_METRICS) past ``roofline_threshold_pct``
+    (default 2)."""
     if hbm_threshold_pct is None:
         hbm_threshold_pct = threshold_pct
+    if roofline_threshold_pct is None:
+        roofline_threshold_pct = 2.0
     rows = []
     regressions = []
     for name in sorted(set(old) & set(new)):
@@ -187,8 +221,12 @@ def diff(old: Dict[str, Tuple[float, str]],
         else:
             pct = (n - o) / abs(o) * 100.0
         leaf = name.rsplit(".", 1)[-1]
-        limit = hbm_threshold_pct if leaf in HBM_METRICS \
-            else threshold_pct
+        if leaf in HBM_METRICS:
+            limit = hbm_threshold_pct
+        elif leaf in ROOFLINE_METRICS:
+            limit = roofline_threshold_pct
+        else:
+            limit = threshold_pct
         bad = pct > limit if direction == "lower" else pct < -limit
         rows.append((name, o, n, pct, direction, bad))
         if bad:
@@ -205,6 +243,11 @@ def main(argv=None) -> int:
     ap.add_argument("--max-hbm-regress-pct", type=float, default=None,
                     help="separate threshold for the memory report's "
                          "HBM scalars (default: --threshold)")
+    ap.add_argument("--max-roofline-regress-pct", type=float,
+                    default=2.0,
+                    help="ratchet threshold for "
+                         "decode_hbm_roofline_util (default 2; "
+                         "higher-is-better)")
     args = ap.parse_args(argv)
 
     try:
@@ -215,7 +258,8 @@ def main(argv=None) -> int:
         return 2
 
     rows, regressions = diff(old, new, args.threshold,
-                             args.max_hbm_regress_pct)
+                             args.max_hbm_regress_pct,
+                             args.max_roofline_regress_pct)
     if not rows:
         print("bench_diff: no comparable metrics between "
               f"{args.old} and {args.new}", file=sys.stderr)
