@@ -444,7 +444,9 @@ def main() -> None:
         leaf.nbytes for leaf in jax.tree_util.tree_leaves(
             model.params, is_leaf=lambda x: isinstance(x, QTensor)))
     def run_wave(b: int, kv_dtype: str = "bf16") -> tuple:
-        """(tokens/s, done, generated, wall_s, n_req) at max_batch=b."""
+        """(tokens/s, done, generated, wall_s, n_req, engine) at
+        max_batch=b — the engine rides along so the caller can read
+        its step-phase histograms for the critical-path report."""
         n_req = 3 * b
         eng = LLMEngine(model, EngineConfig(
             max_batch=b, max_seq=max_seq, kv_cache_dtype=kv_dtype,
@@ -485,10 +487,11 @@ def main() -> None:
                     generated += len(out.new_token_ids)
                     done += out.finished
         wall = time.perf_counter() - t0
-        return generated / wall, done, generated, wall, n_req
+        return generated / wall, done, generated, wall, n_req, eng
 
     try:
-        tput, done, generated, wall, n_requests = run_wave(batch)
+        tput, done, generated, wall, n_requests, wave_eng = \
+            run_wave(batch)
     except Exception as e:
         failed_lanes.append(f"serving-batch{batch}")
         return finish({
@@ -540,6 +543,23 @@ def main() -> None:
                         cfg.num_key_value_heads, cfg.hd, "bf16")["total"],
         dtype="bf16", slots=batch)
     out["memory"] = memory_report(ledger)
+    # critical-path decomposition (ISSUE 13): per-phase p50/p99 from the
+    # engine's step-phase histograms — queue_wait/prefill are per-request,
+    # dispatch/device split each decode step into host dispatch-return vs
+    # blocked block_until_ready on the decode result. dispatch_overhead_ms
+    # (EWMA) is the lower-is-better ratchet bench_diff gates.
+    summ = wave_eng.registry.summary()
+    cp: dict = {}
+    for ph in ("queue_wait", "prefill", "dispatch", "device"):
+        s = summ.get('bigdl_tpu_step_phase_seconds{phase="%s"}' % ph) or {}
+        cp[ph] = {
+            "p50_ms": round(1000.0 * s.get("p50", 0.0), 3),
+            "p99_ms": round(1000.0 * s.get("p99", 0.0), 3),
+            "count": int(s.get("count", 0)),
+        }
+    cp["dispatch_overhead_ms"] = (
+        wave_eng.stats_snapshot()["dispatch_overhead_ms"])
+    out["critical_path"] = cp
     # open-loop overload lane: capacity probe then Poisson arrivals at
     # 0.5x/1x/3x — bench_diff gates its shed/brownout (<=1x must stay
     # zero) and 3x goodput rows
@@ -557,7 +577,7 @@ def main() -> None:
         out["kv_sweep"] = {}
         for d in kv_sweep:
             try:
-                t_, d_, g_, w_, n_ = run_wave(batch, d)
+                t_, d_, g_, w_, n_, _ = run_wave(batch, d)
                 out["kv_sweep"][d] = {
                     "tokens_per_s": round(t_, 1),
                     "tpot_ms": round(1000.0 * batch / max(t_, 1e-9), 3),
@@ -594,7 +614,7 @@ def main() -> None:
     # the weights once per step, so throughput should climb toward 2x —
     # KV at 16 x 512 x 0.5 MB/tok = 4 GB still fits
     try:
-        t16, d16, g16, w16, n16 = run_wave(16)
+        t16, d16, g16, w16, n16, _ = run_wave(16)
         c16 = ceiling / batch * 16
         out["batch16"] = {
             "tokens_per_s": round(t16, 1), "completed": int(d16),

@@ -13,8 +13,17 @@ standard library — tests/test_observability.py enforces it):
 - ``tracing``: per-request lifecycle spans (queue wait, prefill, TTFT,
   decode/TPOT, preemptions) kept in a ring buffer and optionally
   appended as JSONL to ``$BIGDL_TPU_EVENT_LOG`` (size-rotated at
-  ``$BIGDL_TPU_EVENT_LOG_MAX_BYTES`` with a ``.1`` rollover);
+  ``$BIGDL_TPU_EVENT_LOG_MAX_BYTES``, keeping
+  ``$BIGDL_TPU_EVENT_LOG_KEEP`` rolled files ``.1`` .. ``.N``);
   ``GET /v1/stats`` serves the snapshot.
+- ``disttrace``: fleet-wide distributed tracing — W3C-style
+  ``traceparent`` propagation (router -> replica -> engine -> KV-handoff
+  target), a thread-safe ``SpanRecorder`` of completed spans per
+  process (JSONL sink at ``$BIGDL_TPU_EVENT_LOG`` + ``.spans``, same
+  rotation policy), deterministic tail sampling via
+  ``$BIGDL_TPU_TRACE_SAMPLE``, and ``merge_timeline`` — the
+  clock-skew-adjusted stitch behind the router's
+  ``GET /v1/trace/{trace_id}``.
 - ``compile_watch``: ``tracked_jit(name, fn, ...)`` — jax.jit plus
   compile accounting (count, wall time, abstract-shape signature per
   executable) feeding the jit metrics below, a process-wide
@@ -97,7 +106,10 @@ KV-cache cost exceeds it the request stays queued and
 ``bigdl_tpu_admission_deferred_total{reason="memory"}`` increments.
 
 Environment knobs: ``BIGDL_TPU_EVENT_LOG`` (span JSONL sink) +
-``BIGDL_TPU_EVENT_LOG_MAX_BYTES`` (rotate to ``.1`` past this size),
+``BIGDL_TPU_EVENT_LOG_MAX_BYTES`` (rotate past this size) +
+``BIGDL_TPU_EVENT_LOG_KEEP`` (rotated files retained, default 1),
+``BIGDL_TPU_TRACE_SAMPLE`` (distributed-trace tail-sampling fraction,
+default 1.0),
 ``BIGDL_TPU_POSTMORTEM_DIR`` (where crash/stall/signal dumps land),
 ``BIGDL_TPU_RECOMPILE_WARN`` (compiles-per-name warning threshold,
 default 8), ``BIGDL_TPU_HBM_BUDGET_FRACTION`` (admission budget as a
@@ -140,10 +152,22 @@ from bigdl_tpu.observability.metrics import (
     MetricsRegistry,
     default_registry,
 )
+from bigdl_tpu.observability.disttrace import (
+    SpanRecorder,
+    make_traceparent,
+    merge_timeline,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+    resolve_trace_sample,
+    trace_sampled,
+)
 from bigdl_tpu.observability.tracing import (
     RequestSpan,
     RequestTracer,
+    resolve_event_log_keep,
     resolve_event_log_max_bytes,
+    rotate_event_log,
     validate_event_log_path,
 )
 
@@ -155,8 +179,18 @@ __all__ = [
     "default_registry",
     "RequestSpan",
     "RequestTracer",
+    "resolve_event_log_keep",
     "resolve_event_log_max_bytes",
+    "rotate_event_log",
     "validate_event_log_path",
+    "SpanRecorder",
+    "make_traceparent",
+    "merge_timeline",
+    "new_span_id",
+    "new_trace_id",
+    "parse_traceparent",
+    "resolve_trace_sample",
+    "trace_sampled",
     "TrackedJit",
     "tracked_jit",
     "compile_table",

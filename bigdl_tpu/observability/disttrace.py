@@ -1,0 +1,359 @@
+"""Fleet-wide distributed tracing: cross-process span propagation.
+
+PR 1's ``RequestTracer`` is strictly in-process; since the fleet grew a
+router, replica subprocesses, KV handoff, and an autoscaler, no single
+tool could answer "where did request X spend its 400 ms" once a request
+crossed the router. This module closes that gap with three pieces:
+
+- **Trace context** — a W3C-style ``traceparent`` header
+  (``00-<32 hex trace-id>-<16 hex span-id>-<2 hex flags>``) generated
+  at the router (or accepted from the client) and propagated on every
+  fleet-internal hop: router -> replica ``api_server`` -> engine ->
+  ``/v1/internal/kv_handoff`` decode target. ``parse_traceparent`` is
+  strict — a malformed header is *ignored* (a fresh trace starts), it
+  never faults a request.
+- **SpanRecorder** — a thread-safe per-process store of *completed*
+  spans (name, service, trace/span/parent ids, wall-clock start/end,
+  attrs). Spans are recorded post-hoc with explicit timestamps, so the
+  hot path never holds an open span object. Completed spans are also
+  appended to a JSONL sink next to ``$BIGDL_TPU_EVENT_LOG``
+  (``<path>.spans``) with the same size rotation + keep-N policy as
+  the request tracer's event log.
+- **Timeline merge** — the router's ``GET /v1/trace/{trace_id}`` fans
+  out to each replica's ``GET /v1/internal/spans?trace_id=`` and calls
+  ``merge_timeline`` to stitch one clock-skew-adjusted timeline (each
+  replica reports its own wall clock; the router shifts spans by the
+  midpoint-RTT offset). ``GET /v1/traces`` lists recent slow traces
+  (top-k by duration).
+
+Tail sampling: ``$BIGDL_TPU_TRACE_SAMPLE`` (0..1, default 1.0) decides
+which traces record spans. The decision is a *deterministic* hash of
+the trace id, so every process in the fleet keeps or drops the same
+traces without coordination.
+
+Stdlib-only by design (see observability/metrics.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from bigdl_tpu.observability.tracing import (
+    resolve_event_log_keep,
+    resolve_event_log_max_bytes,
+    rotate_event_log,
+)
+
+TRACE_SAMPLE_ENV = "BIGDL_TPU_TRACE_SAMPLE"
+
+#: strict W3C traceparent shape: version "00", lowercase hex only
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def resolve_trace_sample(value=None) -> float:
+    """Tail-sampling fraction in [0, 1]: explicit value, else
+    ``$BIGDL_TPU_TRACE_SAMPLE``, else 1.0 (record every trace). Raises
+    ValueError outside [0, 1] (utils/env_check.py surfaces this for
+    the env var; the recorder itself degrades to 1.0)."""
+    raw = value if value is not None else os.environ.get(
+        TRACE_SAMPLE_ENV, "")
+    if raw is None or raw == "":
+        return 1.0
+    f = float(raw)                     # ValueError propagates
+    if not 0.0 <= f <= 1.0:
+        raise ValueError(
+            f"{TRACE_SAMPLE_ENV} must be in [0, 1], got {raw!r}")
+    return f
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def make_traceparent(trace_id: str, span_id: str,
+                     flags: str = "01") -> str:
+    return f"00-{trace_id}-{span_id}-{flags}"
+
+
+def parse_traceparent(header) -> Optional[Tuple[str, str]]:
+    """``(trace_id, parent_span_id)`` from a traceparent header, or
+    None for anything malformed: wrong field count/width, uppercase or
+    non-hex digits, the forbidden ``ff`` version, or all-zero ids. A
+    rejected header means a fresh trace starts — it never errors the
+    request that carried it."""
+    if not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.strip())
+    if m is None:
+        return None
+    version, trace_id, span_id, _flags = m.groups()
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def trace_sampled(trace_id: str, sample: Optional[float] = None) -> bool:
+    """Deterministic tail-sampling: a pure function of the trace id, so
+    the router and every replica agree on which traces record without
+    coordination."""
+    if sample is None:
+        try:
+            sample = resolve_trace_sample()
+        except ValueError:
+            sample = 1.0               # env_check reports the bad value
+    if sample >= 1.0:
+        return True
+    if sample <= 0.0:
+        return False
+    return int(trace_id[:8], 16) / float(0xFFFFFFFF) < sample
+
+
+class SpanRecorder:
+    """Thread-safe store of completed spans, grouped by trace id.
+
+    ``record`` takes explicit wall-clock start/end timestamps — spans
+    are closed facts, not live objects, so the engine's step loop never
+    carries open-span state across iterations. Every span mutation and
+    read goes through ``_lock`` (handler threads query while the engine
+    thread records)."""
+
+    def __init__(self, service: str = "process", capacity: int = 1024,
+                 sink_path: Optional[str] = None,
+                 sink_max_bytes: Optional[int] = None,
+                 sink_keep: Optional[int] = None,
+                 sample: Optional[float] = None):
+        self.service = service
+        if sink_path is None:
+            base = os.environ.get("BIGDL_TPU_EVENT_LOG")
+            sink_path = (base + ".spans") if base else None
+        if sink_max_bytes is None:
+            try:
+                sink_max_bytes = resolve_event_log_max_bytes()
+            except ValueError:
+                sink_max_bytes = None  # env_check reports it
+        if sink_keep is None:
+            try:
+                sink_keep = resolve_event_log_keep()
+            except ValueError:
+                sink_keep = 1          # env_check reports it
+        if sample is None:
+            try:
+                sample = resolve_trace_sample()
+            except ValueError:
+                sample = 1.0           # env_check reports it
+        self.sample = sample
+        self._lock = threading.Lock()
+        self._spans: "collections.deque[dict]" = collections.deque(
+            maxlen=capacity)
+        # trace id -> its spans, insertion-ordered so eviction drops the
+        # oldest trace and annotate_recent sees the newest
+        self._by_trace: "collections.OrderedDict[str, List[dict]]" = \
+            collections.OrderedDict()
+        self._trace_cap = max(16, capacity // 8)
+        self._sink_path = sink_path or None
+        self._sink = None
+        self._sink_dead = False
+        self._sink_max_bytes = sink_max_bytes
+        self._sink_keep = sink_keep
+        self._sink_bytes = 0
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, name: str, trace_id: Optional[str],
+               span_id: Optional[str] = None,
+               parent_id: Optional[str] = None,
+               t_start: Optional[float] = None,
+               t_end: Optional[float] = None,
+               **attrs) -> Optional[dict]:
+        """Record one completed span; returns its dict, or None when the
+        trace is absent or tail-sampled out."""
+        if not trace_id or not trace_sampled(trace_id, self.sample):
+            return None
+        now = time.time()
+        t0 = now if t_start is None else t_start
+        t1 = now if t_end is None else t_end
+        span = {
+            "name": name,
+            "service": self.service,
+            "trace_id": trace_id,
+            "span_id": span_id or new_span_id(),
+            "parent_id": parent_id or None,
+            "t_start": round(t0, 6),
+            "t_end": round(t1, 6),
+            "duration_s": round(max(t1 - t0, 0.0), 6),
+        }
+        if attrs:
+            span["attrs"] = attrs
+        with self._lock:
+            self._spans.append(span)
+            group = self._by_trace.get(trace_id)
+            if group is None:
+                group = self._by_trace[trace_id] = []
+                while len(self._by_trace) > self._trace_cap:
+                    self._by_trace.popitem(last=False)
+            else:
+                self._by_trace.move_to_end(trace_id)
+            group.append(span)
+            self._sink_write(span)
+        return span
+
+    def annotate(self, trace_id: Optional[str], name: str,
+                 parent_id: Optional[str] = None,
+                 **attrs) -> Optional[dict]:
+        """Zero-duration event span: how fleet decisions (failover,
+        shed, brownout, autoscale) pin themselves to a timeline."""
+        return self.record(name, trace_id, parent_id=parent_id,
+                           event=True, **attrs)
+
+    def annotate_recent(self, name: str, limit: int = 8,
+                        **attrs) -> int:
+        """Attach a zero-duration event to the ``limit`` most recent
+        traces — fleet-scoped decisions (brownout level change,
+        autoscale action) land on the timeline of every request that
+        was in flight around them."""
+        with self._lock:
+            tids = list(self._by_trace)[-max(limit, 0):]
+        n = 0
+        for tid in tids:
+            if self.annotate(tid, name, **attrs) is not None:
+                n += 1
+        return n
+
+    # -- JSONL sink (same format + rotation policy as the request
+    # tracer's $BIGDL_TPU_EVENT_LOG sink) -----------------------------------
+
+    def _sink_write(self, span: dict) -> None:
+        # caller holds _lock: open/rotate/write must be atomic against
+        # concurrent recorders (engine thread + HTTP handler threads)
+        if self._sink_path is None or self._sink_dead:
+            return
+        try:
+            sink = self._sink  # graftlint: disable=lock-guarded-unlocked
+            if sink is None:
+                sink = open(self._sink_path, "a", buffering=1)
+                try:
+                    self._sink_bytes = os.path.getsize(self._sink_path)
+                except OSError:
+                    self._sink_bytes = 0
+            payload = json.dumps(span) + "\n"
+            if (self._sink_max_bytes is not None and self._sink_bytes
+                    and self._sink_bytes + len(payload)
+                    > self._sink_max_bytes):
+                sink.close()
+                rotate_event_log(self._sink_path, self._sink_keep)
+                sink = open(self._sink_path, "a", buffering=1)
+                self._sink_bytes = 0
+            sink.write(payload)
+            self._sink_bytes += len(payload)
+            self._sink = sink  # graftlint: disable=lock-guarded-unlocked
+        except OSError as e:
+            self._sink_dead = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "span log %s unwritable (%s); span JSONL disabled",
+                self._sink_path, e)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                except OSError:
+                    pass
+                self._sink = None
+
+    # -- queries ------------------------------------------------------------
+
+    def spans_for(self, trace_id: str) -> List[dict]:
+        with self._lock:
+            return [dict(s) for s in self._by_trace.get(trace_id, ())]
+
+    def recent_traces(self, k: int = 16) -> List[dict]:
+        """Top-k *slowest* recorded traces (wall duration across all
+        spans), newest data included — the ``GET /v1/traces`` payload."""
+        with self._lock:
+            items = [(tid, list(spans))
+                     for tid, spans in self._by_trace.items()]
+        out = []
+        for tid, spans in items:
+            if not spans:
+                continue
+            t0 = min(s["t_start"] for s in spans)
+            t1 = max(s["t_end"] for s in spans)
+            root = next((s for s in spans if not s.get("parent_id")),
+                        spans[0])
+            out.append({
+                "trace_id": tid,
+                "t_start": t0,
+                "duration_s": round(t1 - t0, 6),
+                "n_spans": len(spans),
+                "root": root["name"],
+                "services": sorted({s["service"] for s in spans}),
+            })
+        out.sort(key=lambda d: -d["duration_s"])
+        return out[:max(k, 0)]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"service": self.service,
+                    "spans": len(self._spans),
+                    "traces": len(self._by_trace),
+                    "sample": self.sample,
+                    "sink": self._sink_path,
+                    "sink_dead": self._sink_dead}
+
+
+def merge_timeline(trace_id: str,
+                   span_groups: Iterable[Tuple[float, List[dict]]],
+                   external_parents: Iterable[str] = ()) -> Dict[str, Any]:
+    """Stitch span groups from several processes into one timeline.
+
+    ``span_groups`` is ``[(skew_s, spans)]`` — each group's timestamps
+    are shifted by its clock-skew estimate (the router computes
+    ``skew = local_midpoint - remote_now`` per replica fan-out call).
+    ``external_parents`` are span ids known to live outside the fleet
+    (the client's own parent span): spans pointing at them are not
+    orphans. Any other span whose parent never reported is — the
+    ``bigdl_tpu_handoff_span_orphans_total`` condition, surfaced here
+    as ``orphan_spans``."""
+    spans: List[dict] = []
+    for skew, group in span_groups:
+        for s in group:
+            s = dict(s)
+            if skew:
+                s["t_start"] = round(s["t_start"] + skew, 6)
+                s["t_end"] = round(s["t_end"] + skew, 6)
+                s["skew_adjust_s"] = round(skew, 6)
+            spans.append(s)
+    spans.sort(key=lambda s: (s.get("t_start", 0.0),
+                              s.get("t_end", 0.0)))
+    known = {s.get("span_id") for s in spans}
+    known.update(external_parents)
+    orphans = sorted(s["span_id"] for s in spans
+                     if s.get("parent_id") and s["parent_id"] not in known)
+    doc: Dict[str, Any] = {
+        "trace_id": trace_id,
+        "n_spans": len(spans),
+        "services": sorted({s.get("service", "?") for s in spans}),
+        "orphan_spans": orphans,
+        "spans": spans,
+    }
+    if spans:
+        t0 = min(s["t_start"] for s in spans)
+        t1 = max(s["t_end"] for s in spans)
+        doc["t_start"] = t0
+        doc["duration_s"] = round(t1 - t0, 6)
+    return doc

@@ -52,6 +52,41 @@ def resolve_event_log_max_bytes(value=None):
     return n
 
 
+def resolve_event_log_keep(value=None) -> int:
+    """How many rotated event-log files to keep: explicit value, else
+    ``$BIGDL_TPU_EVENT_LOG_KEEP``, else 1 (the pre-existing single
+    ``.1`` rollover). Raises ValueError on a non-positive or
+    non-integer setting (utils/env_check.py surfaces this for the env
+    var; the tracer itself degrades to the default)."""
+    if value is None:
+        value = os.environ.get("BIGDL_TPU_EVENT_LOG_KEEP")
+    if value is None or value == "":
+        return 1
+    try:
+        n = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"event log keep count must be a positive integer, got "
+            f"{value!r}")
+    if n <= 0:
+        raise ValueError(
+            f"event log keep count must be a positive integer, got {n}")
+    return n
+
+
+def rotate_event_log(path: str, keep: int) -> None:
+    """Cascade ``path.{keep-1}`` -> ``path.{keep}``, ...,
+    ``path`` -> ``path.1``. With ``keep`` files retained plus the live
+    one, total disk footprint stays bounded at ~``(keep + 1)`` x the
+    rotation limit. Missing intermediates are skipped (a fresh deploy
+    with keep=5 has no ``.3`` yet)."""
+    for i in range(keep - 1, 0, -1):
+        src = f"{path}.{i}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i + 1}")
+    os.replace(path, path + ".1")
+
+
 def validate_event_log_path(path: str) -> dict:
     """Report whether `path` is usable as a JSONL event-log sink
     (utils/env_check.py surfaces this for BIGDL_TPU_EVENT_LOG)."""
@@ -87,6 +122,12 @@ class RequestSpan:
     n_preemptions: int = 0
     events: List[Tuple[float, str]] = dataclasses.field(
         default_factory=list)
+    # distributed-trace context (observability/disttrace.py): the fleet
+    # trace id, the upstream parent span id, and this request's own
+    # engine-side span id — None for untraced/unsampled requests
+    trace_id: Optional[str] = None
+    trace_parent: Optional[str] = None
+    trace_span: Optional[str] = None
 
     # -- derived durations (None until the span reaches that point) --------
 
@@ -130,6 +171,8 @@ class RequestSpan:
             "n_preemptions": self.n_preemptions,
             "finish_reason": self.finish_reason,
         }
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
         for k in ("queue_wait_s", "prefill_s", "ttft_s", "decode_s",
                   "tpot_s"):
             v = getattr(self, k)
@@ -145,7 +188,8 @@ class RequestTracer:
 
     def __init__(self, capacity: int = 256,
                  event_log_path: Optional[str] = None,
-                 event_log_max_bytes: Optional[int] = None):
+                 event_log_max_bytes: Optional[int] = None,
+                 event_log_keep: Optional[int] = None):
         if event_log_path is None:
             event_log_path = os.environ.get("BIGDL_TPU_EVENT_LOG")
         self._lock = threading.Lock()
@@ -156,9 +200,9 @@ class RequestTracer:
         self._sink = None
         self._sink_dead = False
         # size-bounded rotation: when the sink would grow past the
-        # limit it is renamed to `<path>.1` (replacing any previous
-        # rollover) and a fresh file is started — total disk footprint
-        # is bounded at ~2x the limit
+        # limit the rotated files cascade (`.1` -> `.2` -> ... up to
+        # $BIGDL_TPU_EVENT_LOG_KEEP files) and a fresh file is started
+        # — total disk footprint is bounded at ~(keep + 1)x the limit
         if event_log_max_bytes is None:
             try:
                 event_log_max_bytes = resolve_event_log_max_bytes()
@@ -166,7 +210,13 @@ class RequestTracer:
                 # env_check reports the bad value; the tracer itself
                 # degrades to an unbounded sink rather than dying
                 event_log_max_bytes = None
+        if event_log_keep is None:
+            try:
+                event_log_keep = resolve_event_log_keep()
+            except ValueError:
+                event_log_keep = 1     # env_check reports the bad value
         self._sink_max_bytes = event_log_max_bytes
+        self._sink_keep = event_log_keep
         self._sink_bytes = 0
 
     # -- JSONL sink ---------------------------------------------------------
@@ -194,7 +244,7 @@ class RequestTracer:
                         and self._sink_bytes + len(payload)
                         > self._sink_max_bytes):
                     self._sink.close()
-                    os.replace(self._sink_path, self._sink_path + ".1")
+                    rotate_event_log(self._sink_path, self._sink_keep)
                     self._sink = open(self._sink_path, "a", buffering=1)
                     self._sink_bytes = 0
                 self._sink.write(payload)
@@ -221,16 +271,29 @@ class RequestTracer:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self, request_id: str, prompt_len: int = 0,
-              t_arrival: Optional[float] = None) -> RequestSpan:
+              t_arrival: Optional[float] = None,
+              trace: Optional[Tuple[str, str, str]] = None) -> RequestSpan:
+        """``trace`` is the distributed-trace context
+        ``(trace_id, parent_span_id, own_span_id)`` threaded in by the
+        engine for requests that arrived with a ``traceparent``."""
         now = time.time()
         span = RequestSpan(request_id, prompt_len,
                            t_arrival=t_arrival or now,
                            t_enqueued=t_arrival or now)
+        if trace is not None:
+            span.trace_id, span.trace_parent, span.trace_span = trace
         span.events.append((span.t_arrival, "enqueue"))
         with self._lock:
             self._active[request_id] = span
-        self._log(request_id, "enqueue", prompt_len=prompt_len)
+        self._log(request_id, "enqueue", prompt_len=prompt_len,
+                  **self._trace_fields(span))
         return span
+
+    @staticmethod
+    def _trace_fields(span: Optional["RequestSpan"]) -> dict:
+        if span is None or span.trace_id is None:
+            return {}
+        return {"trace_id": span.trace_id}
 
     def get(self, request_id: str) -> Optional[RequestSpan]:
         with self._lock:
@@ -243,7 +306,8 @@ class RequestTracer:
             span.t_admitted = now
             span.events.append((now, "admit"))
             self._log(request_id, "admit",
-                      queue_wait_s=round(now - span.t_enqueued, 6))
+                      queue_wait_s=round(now - span.t_enqueued, 6),
+                      **self._trace_fields(span))
         return span
 
     def first_token(self, request_id: str) -> Optional[RequestSpan]:
@@ -253,7 +317,8 @@ class RequestTracer:
             span.t_first_token = now
             span.events.append((now, "first_token"))
             self._log(request_id, "first_token",
-                      ttft_s=round(now - span.t_arrival, 6))
+                      ttft_s=round(now - span.t_arrival, 6),
+                      **self._trace_fields(span))
         return span
 
     def preempted(self, request_id: str) -> Optional[RequestSpan]:
@@ -266,7 +331,8 @@ class RequestTracer:
             span.t_enqueued = now
             span.t_admitted = None
             span.events.append((now, "preempt"))
-            self._log(request_id, "preempt")
+            self._log(request_id, "preempt",
+                      **self._trace_fields(span))
         return span
 
     def finish(self, request_id: str, reason: str,
@@ -282,7 +348,8 @@ class RequestTracer:
             with self._lock:
                 self._finished.append(span)
             self._log(request_id, "finish", reason=reason,
-                      n_generated=n_generated)
+                      n_generated=n_generated,
+                      **self._trace_fields(span))
         return span
 
     # -- introspection ------------------------------------------------------

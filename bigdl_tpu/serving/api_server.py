@@ -23,6 +23,9 @@ Observability endpoints (bigdl_tpu/observability/):
   fingerprint); the same document the engine writes to
   $BIGDL_TPU_POSTMORTEM_DIR on step exceptions, stall-guard trips,
   and (via the CLI's signal hooks) SIGTERM/SIGINT
+- GET /v1/internal/spans?trace_id= — completed distributed-trace spans
+  for one trace (observability/disttrace.py), stamped with this
+  replica's wall clock; the router's GET /v1/trace/{id} fan-out target
 - POST /v1/profiler/start {"log_dir": ...} / POST /v1/profiler/stop —
   on-demand jax.profiler device trace against the live server
   (TensorBoard/Perfetto; wraps utils/profiling.start_profiler)
@@ -51,6 +54,9 @@ from typing import Any, List, Optional
 import numpy as np
 
 from bigdl_tpu.observability.compile_watch import compiles_in_progress
+from bigdl_tpu.observability.disttrace import (make_traceparent,
+                                               new_span_id,
+                                               parse_traceparent)
 from bigdl_tpu.serving.engine import (EngineDraining, LLMEngine,
                                       SamplingParams)
 from bigdl_tpu.serving.overload import RequestShed
@@ -302,6 +308,13 @@ class OpenAIServer:
                 ("dropped", "KV handoff attempts dropped by the "
                             "handoff_drop chaos fault."),
             )}
+        # a traced handoff whose decode target never echoed its child
+        # span id (X-Trace-Span): the decode leg of the timeline is
+        # missing — the span-propagation analog of a lost transfer
+        self._m_span_orphans = engine.registry.counter(
+            "bigdl_tpu_handoff_span_orphans_total",
+            "traced KV handoffs whose decode target never reported "
+            "its child span")
         # /health liveness: with unfinished work and no step() entered
         # for this long, the step loop is wedged (hung transfer,
         # replica_hang fault) — report 503 so a supervisor (the
@@ -384,7 +397,7 @@ class OpenAIServer:
 
     def _run_request(self, token_ids, params, stream_cb=None,
                      stop_strs=(), disconnect_check=None,
-                     cancel_cb=None, rid=None):
+                     cancel_cb=None, rid=None, trace=None):
         """Returns (rid, {index: ids}, {index: logprob entries},
         {index: finish_reason}, {index: final text}, {index: error}).
 
@@ -406,7 +419,7 @@ class OpenAIServer:
         shed can still be a clean 429/503); otherwise add here."""
         if rid is None:
             rid = f"cmpl-{uuid.uuid4().hex[:16]}"
-            self.engine.add_request(rid, token_ids, params)
+            self.engine.add_request(rid, token_ids, params, trace=trace)
             self.loop.notify()
         out_ids: dict = {}
         out_lps: dict = {}
@@ -598,7 +611,8 @@ class OpenAIServer:
         return [t.strip() for t in str(hdr).split(",") if t.strip()]
 
     def _prefill_and_handoff(self, ids, params, body: dict,
-                             targets: List[str]) -> Optional[dict]:
+                             targets: List[str],
+                             trace=None) -> Optional[dict]:
         """Run chunked prefill locally (a 1-token generation, which
         leaves the prompt's quantized KV snapshot in the prefix cache),
         then ship the snapshot + request to a decode replica and relay
@@ -615,7 +629,8 @@ class OpenAIServer:
         the transfer."""
         probe = dataclasses.replace(params, max_tokens=1, n=1,
                                     best_of=None, logprobs=None)
-        _, _, _, reasons, _, _ = self._run_request(ids, probe)
+        _, _, _, reasons, _, _ = self._run_request(ids, probe,
+                                                   trace=trace)
         if any(r in ("error",) + _TIMEOUT_REASONS
                for r in reasons.values()):
             return None          # prefill itself failed: local path decides
@@ -624,7 +639,20 @@ class OpenAIServer:
             return None          # snapshot evicted/disabled: decode locally
         req = {k: v for k, v in body.items()
                if k not in ("stream", "prompt", "messages",
-                            "_handoff_targets")}
+                            "_handoff_targets", "_traceparent")}
+        # the transfer claims its own (local) span, but the decode
+        # target parents its spans under the span id WE were handed —
+        # the router's, the nearest crash-durable ancestor — so a
+        # prefill death mid-relay cannot orphan the decode leg of the
+        # timeline (body, not header alone — the relay's _completions
+        # re-reads it from the staged request)
+        handoff_span = new_span_id() if trace is not None else None
+        t_handoff0 = time.time()
+        hdrs = {"Content-Type": "application/json",
+                "X-Tenant-Id": params.tenant or "default"}
+        if trace is not None:
+            req["_traceparent"] = make_traceparent(trace[0], trace[1])
+            hdrs["traceparent"] = req["_traceparent"]
         payload = json.dumps({
             "prompt": [int(t) for t in ids],
             "planes": planes_to_wire(entry),
@@ -643,27 +671,53 @@ class OpenAIServer:
                 try:
                     r = urllib.request.Request(
                         f"http://{target}/v1/internal/kv_handoff",
-                        data=payload, method="POST",
-                        headers={"Content-Type": "application/json",
-                                 "X-Tenant-Id": params.tenant
-                                 or "default"})
+                        data=payload, method="POST", headers=hdrs)
                     with urllib.request.urlopen(
                             r, timeout=self._handoff_timeout_ms
                             / 1000.0) as resp:
                         if resp.status == 200:
                             out = json.loads(resp.read())
                             self._count_handoff("sends")
+                            if trace is not None:
+                                if not resp.headers.get("X-Trace-Span"):
+                                    # decode target answered but never
+                                    # reported its child span: the
+                                    # timeline's decode leg is missing
+                                    self._m_span_orphans.inc()
+                                self.engine.spans.record(
+                                    "kv_handoff", trace[0],
+                                    span_id=handoff_span,
+                                    parent_id=trace[1],
+                                    t_start=t_handoff0,
+                                    t_end=time.time(),
+                                    target=target, attempt=i + 1)
                             return out
                 except Exception:
                     pass         # timeout, refused, 5xx, dead target
             if i + 1 < attempts:
                 self._count_handoff("retries")
+                if trace is not None:
+                    self.engine.spans.annotate(
+                        trace[0], "handoff_retry",
+                        parent_id=handoff_span, attempt=i + 1,
+                        target=target)
                 time.sleep(delay)
                 delay = min(delay * 2, 1.0)
         self._count_handoff("fallbacks")
         self.engine.flight.record(
             "handoff_fallback", targets=list(targets),
-            attempts=attempts, prompt_len=len(ids))
+            attempts=attempts, prompt_len=len(ids),
+            **({"trace_id": trace[0]} if trace is not None else {}))
+        if trace is not None:
+            # the abandoned transfer still claims its span (failed=True)
+            # so retry/fallback annotations parented under it resolve
+            self.engine.spans.record(
+                "kv_handoff", trace[0], span_id=handoff_span,
+                parent_id=trace[1], t_start=t_handoff0,
+                t_end=time.time(), failed=True, attempts=attempts)
+            self.engine.spans.annotate(
+                trace[0], "handoff_fallback", parent_id=handoff_span,
+                targets=list(targets), attempts=attempts)
         return None
 
     # -- http ---------------------------------------------------------------
@@ -678,7 +732,12 @@ class OpenAIServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
-                for k, v in headers:
+                # _trace_headers: response headers set by an outer
+                # handler layer (_kv_handoff's X-Trace-Span ack rides
+                # on the relayed _completions response)
+                for k, v in (tuple(headers)
+                             + tuple(getattr(self, "_trace_headers",
+                                             ()))):
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
@@ -773,6 +832,24 @@ class OpenAIServer:
                     from bigdl_tpu.utils import profiling
 
                     self._json(200, profiling.profiler_status())
+                elif self.path.startswith("/v1/internal/spans"):
+                    # the router's /v1/trace/{id} fan-out target:
+                    # completed spans for one trace, stamped with this
+                    # replica's wall clock so the router can estimate
+                    # and subtract clock skew
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    tid = (q.get("trace_id") or [None])[0]
+                    doc = {"now": time.time(),
+                           "service": server.engine.spans.service}
+                    if tid:
+                        doc["spans"] = \
+                            server.engine.spans.spans_for(tid)
+                    else:
+                        doc["traces"] = \
+                            server.engine.spans.recent_traces()
+                    self._json(200, doc)
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -842,9 +919,32 @@ class OpenAIServer:
                 req = dict(req) if isinstance(req, dict) else {}
                 req.pop("stream", None)
                 req["prompt"] = prompt
+                # trace propagation: claim a child span for the decode
+                # leg, re-parent the staged request under it, and echo
+                # its id (X-Trace-Span) so the prefill side knows the
+                # decode leg reported — a missing ack counts toward
+                # bigdl_tpu_handoff_span_orphans_total over there
+                tp = (req.get("_traceparent")
+                      or self.headers.get("traceparent"))
+                trace = parse_traceparent(tp)
+                t_accept0 = time.time()
+                sid = None
+                if trace is not None:
+                    sid = new_span_id()
+                    req["_traceparent"] = make_traceparent(trace[0],
+                                                           sid)
+                    self._trace_headers = (("X-Trace-Span", sid),)
                 server.engine.stage_handoff(prompt, planes)
                 server._count_handoff("accepted")
-                return self._completions(req, chat=False)
+                try:
+                    return self._completions(req, chat=False)
+                finally:
+                    if trace is not None:
+                        server.engine.spans.record(
+                            "kv_handoff.decode", trace[0],
+                            span_id=sid, parent_id=trace[1],
+                            t_start=t_accept0, t_end=time.time(),
+                            prompt_len=len(prompt))
 
             def _embeddings(self, body: dict):
                 if server.embedder is None or \
@@ -890,6 +990,11 @@ class OpenAIServer:
                     stops = (stops,)
                 stops = tuple(s for s in stops if s)
                 created = int(time.time())
+                # trace context: router/client header, or the staged
+                # _traceparent a kv_handoff relay carries in its body
+                tp = (self.headers.get("traceparent")
+                      or body.get("_traceparent"))
+                trace = parse_traceparent(tp)
                 # shed BEFORE the stream branch commits its 200 header
                 # (add_request would raise EngineDraining anyway, but by
                 # then a streaming response is already half-written)
@@ -914,7 +1019,7 @@ class OpenAIServer:
                            else server._handoff_eligible(body, params))
                 if targets:
                     out = server._prefill_and_handoff(
-                        ids, params, body, targets)
+                        ids, params, body, targets, trace=trace)
                     if out is not None:
                         return self._json(200, out)
                 # admit BEFORE the stream branch for the same reason:
@@ -922,7 +1027,8 @@ class OpenAIServer:
                 # Retry-After, handled in do_POST) must reject doomed
                 # work as a clean status line, not a broken SSE body
                 rid = f"cmpl-{uuid.uuid4().hex[:16]}"
-                server.engine.add_request(rid, ids, params)
+                server.engine.add_request(rid, ids, params,
+                                          trace=trace)
                 server.loop.notify()
 
                 if body.get("stream"):
@@ -1126,6 +1232,9 @@ def main():
     engine = LLMEngine(model, EngineConfig(
         max_batch=args.max_batch, max_seq=args.max_seq,
         prefix_cache_entries=32 if role == "prefill" else 0))
+    # span timelines name this process by its listen port, so the
+    # router's merged /v1/trace/{id} view tells the replicas apart
+    engine.spans.service = f"replica:{args.port}"
     embedder = embedder_tok = None
     if args.embedder:
         from transformers import AutoTokenizer

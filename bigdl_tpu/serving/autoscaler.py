@@ -407,6 +407,13 @@ class Autoscaler:
                                       reason=reason, **{
                                           k: v for k, v in sig.items()
                                           if v is not None})
+            # pin the decision to the timelines of requests in flight
+            # around it (tests drive stub routers without a recorder)
+            rec = getattr(self.router, "spans", None)
+            if rec is not None:
+                rec.annotate_recent("autoscale_decision",
+                                    action=action, reason=reason,
+                                    tick=tick_no)
         with self._lock:
             self._decisions.append(decision)
         return decision

@@ -43,6 +43,7 @@ import numpy as np
 
 from bigdl_tpu.observability.compile_watch import (compiles_in_progress,
                                                    tracked_jit)
+from bigdl_tpu.observability.disttrace import SpanRecorder, new_span_id
 from bigdl_tpu.observability.flight import (FlightRecorder, build_postmortem,
                                             exception_fields)
 from bigdl_tpu.observability.flight import write_postmortem as \
@@ -131,6 +132,10 @@ class Request:
     # step/prefill failures attributed to this request (blast-radius
     # blame counter); past max_slot_crashes the request is quarantined
     crashes: int = 0
+    # distributed-trace context (observability/disttrace.py):
+    # (trace_id, parent_span_id) propagated from the traceparent header;
+    # None for untraced requests
+    trace: Optional[Tuple[str, str]] = None
 
 
 @dataclasses.dataclass
@@ -391,6 +396,11 @@ class LLMEngine:
         self.registry = registry if registry is not None \
             else default_registry()
         self.tracer = tracer if tracer is not None else RequestTracer()
+        # distributed-trace span store (observability/disttrace.py):
+        # per-request queue_wait/prefill/decode spans and per-step
+        # dispatch/device sub-spans for requests carrying a traceparent;
+        # the API server serves it at GET /v1/internal/spans
+        self.spans = SpanRecorder(service="engine")
         # flight recorder: bounded ring of structured step/scheduling
         # events; its tail is the core of every postmortem dump
         self.flight = flight if flight is not None else FlightRecorder()
@@ -453,6 +463,12 @@ class LLMEngine:
         # admission test and the brownout latency-inflation signal
         self._tpot_ewma = 0.0
         self._tpot_floor: Optional[float] = None
+        # host-dispatch share of the decode step (dispatch-return vs
+        # blocked block_until_ready — the bench.py tunnel_overhead_ms
+        # measurement, run every step): the attribution denominator for
+        # the decode roofline gap, surfaced as stats_snapshot()
+        # dispatch_overhead_ms and ratcheted by tools/bench_diff.py
+        self._dispatch_ewma = 0.0
         # recent finish timestamps -> measured drain rate (Retry-After)
         self._finish_times: "collections.deque[float]" = \
             collections.deque(maxlen=64)
@@ -623,6 +639,14 @@ class LLMEngine:
             labelnames=("phase",))
         for ph in ("queue", "prefill", "decode"):   # render from scrape 1
             self._m_phase.labels(ph)
+        self._m_step_phase = m.histogram(
+            "bigdl_tpu_step_phase_seconds",
+            "Engine step critical-path decomposition: per-request "
+            "queue_wait/prefill, per-step host dispatch vs device "
+            "compute (blocked block_until_ready on the decode result).",
+            labelnames=("phase",))
+        for ph in ("queue_wait", "prefill", "dispatch", "device"):
+            self._m_step_phase.labels(ph)   # render from scrape 1
         self._m_ttft = m.histogram(
             "bigdl_tpu_ttft_seconds",
             "Time to first token: arrival to first sampled token.")
@@ -732,7 +756,8 @@ class LLMEngine:
 
     # -- public api ---------------------------------------------------------
 
-    def add_request(self, request_id: str, prompt_token_ids, params=None):
+    def add_request(self, request_id: str, prompt_token_ids, params=None,
+                    trace=None):
         if self._draining:
             raise EngineDraining(
                 "engine is draining (admission stopped); retry against "
@@ -785,7 +810,7 @@ class LLMEngine:
         params = dataclasses.replace(
             params, qos=qos, tenant=params.tenant or "default")
         self._overload_admit(request_id, ids, params, deadline_ms,
-                             best_of)
+                             best_of, trace)
         cap = self.overload.max_tokens_cap()
         if cap is not None and params.max_tokens > cap:
             params = dataclasses.replace(params, max_tokens=cap)
@@ -804,18 +829,30 @@ class LLMEngine:
                     seed=None if params.seed is None else params.seed + i)
                 self._children[cid] = (request_id, i)
                 creq = Request(cid, list(ids), cparams)
+                creq.trace = trace
                 if deadline_ms is not None:
                     creq.deadline = creq.arrival + deadline_ms / 1000.0
                 self.tracer.start(cid, prompt_len=len(ids),
-                                  t_arrival=creq.arrival)
+                                  t_arrival=creq.arrival,
+                                  trace=self._child_trace(trace))
                 target.append(creq)
             return
         req = Request(request_id, ids, params)
+        req.trace = trace
         if deadline_ms is not None:
             req.deadline = req.arrival + deadline_ms / 1000.0
         self.tracer.start(request_id, prompt_len=len(ids),
-                          t_arrival=req.arrival)
+                          t_arrival=req.arrival,
+                          trace=self._child_trace(trace))
         target.append(req)
+
+    @staticmethod
+    def _child_trace(trace):
+        # (trace_id, parent_span_id) from the wire becomes a tracer
+        # 3-tuple with a fresh span id for THIS request's engine span
+        if trace is None:
+            return None
+        return (trace[0], trace[1], new_span_id())
 
     def abort_request(self, request_id: str) -> None:
         """Reference api_server behavior on client disconnect
@@ -902,7 +939,7 @@ class LLMEngine:
     def _overload_admit(self, request_id: str, ids: List[int],
                         params: SamplingParams,
                         deadline_ms: Optional[float],
-                        n_seqs: int) -> None:
+                        n_seqs: int, trace=None) -> None:
         """Run the controller's early-shedding tests for one incoming
         request; on shed, count + breadcrumb and re-raise."""
         depth = len(self.waiting) + len(self._cp_waiting)
@@ -923,7 +960,13 @@ class LLMEngine:
                 "shed", step=self._step_idx, request_id=request_id,
                 reason=e.reason, qos=e.qos, tenant=e.tenant,
                 retry_after_sec=e.retry_after_sec, queue_depth=depth,
-                brownout_level=self.overload.level)
+                brownout_level=self.overload.level,
+                **({"trace_id": trace[0]} if trace else {}))
+            if trace is not None:
+                self.spans.annotate(trace[0], "shed", parent_id=trace[1],
+                                    request_id=request_id,
+                                    reason=e.reason, qos=e.qos,
+                                    tenant=e.tenant)
             raise
         self._m_tenant_reqs.labels(params.tenant, "admitted").inc()
 
@@ -953,6 +996,9 @@ class LLMEngine:
                 "brownout", step=self._step_idx,
                 level=self.overload.level, pressure=round(pressure, 4),
                 speculative_allowed=self.overload.speculative_allowed)
+            self.spans.annotate_recent(
+                "brownout", level=self.overload.level,
+                pressure=round(pressure, 4))
 
     # -- engine internals ---------------------------------------------------
 
@@ -1494,8 +1540,22 @@ class LLMEngine:
             qw = span.queue_wait_s
             if qw is not None and qw >= 0:
                 self._m_phase.labels("queue").observe(qw)
-            self._m_phase.labels("prefill").observe(
-                max(now - span.t_admitted, 0.0))
+                self._m_step_phase.labels("queue_wait").observe(qw)
+            pf = max(now - span.t_admitted, 0.0)
+            self._m_phase.labels("prefill").observe(pf)
+            self._m_step_phase.labels("prefill").observe(pf)
+            if (span.trace_id is not None and just_first
+                    and span.t_enqueued is not None):
+                self.spans.record(
+                    "queue_wait", span.trace_id,
+                    parent_id=span.trace_span,
+                    t_start=span.t_enqueued, t_end=span.t_admitted,
+                    request_id=rid)
+                self.spans.record(
+                    "prefill", span.trace_id,
+                    parent_id=span.trace_span,
+                    t_start=span.t_admitted, t_end=now,
+                    request_id=rid)
         self.tracer.first_token(rid)
         if just_first and span.ttft_s is not None:
             self._m_ttft.observe(span.ttft_s)
@@ -1510,6 +1570,23 @@ class LLMEngine:
             d = span.decode_s
             if d is not None and d >= 0:
                 self._m_phase.labels("decode").observe(d)
+            if span.trace_id is not None:
+                if (span.t_first_token is not None
+                        and span.t_finished is not None):
+                    self.spans.record(
+                        "decode", span.trace_id,
+                        parent_id=span.trace_span,
+                        t_start=span.t_first_token,
+                        t_end=span.t_finished, request_id=rid)
+                self.spans.record(
+                    "engine.request", span.trace_id,
+                    span_id=span.trace_span,
+                    parent_id=span.trace_parent,
+                    t_start=span.t_arrival,
+                    t_end=span.t_finished or time.time(),
+                    request_id=rid, finish_reason=reason,
+                    n_generated=n_generated,
+                    preemptions=span.n_preemptions)
         self._m_finished.labels(reason).inc()
         self._finish_times.append(time.time())   # drain-rate window
         self.flight.record("finish", step=self._step_idx, request_id=rid,
@@ -1572,6 +1649,8 @@ class LLMEngine:
             "admitting": self._admitting is not None,
             "stall_steps": self._stall_steps,
             "engine_steps": self._step_idx,
+            "dispatch_overhead_ms": round(
+                self._dispatch_ewma * 1000.0, 3),
             "metrics": self.registry.summary(),
             "requests": self.tracer.snapshot(),
             "compile_table": compile_table(),
@@ -2165,11 +2244,22 @@ class LLMEngine:
             return did
 
         t_decode0 = time.perf_counter()
+        t_wall0 = time.time()
         tokens = np.zeros((self.cfg_engine.max_batch,), np.int32)
         for i in active:
             tokens[i] = self.slots[i].last_token
         logits_dev, self.cache = self._decode(
             self.params, jnp.asarray(tokens), self.cache)
+        # dispatch vs device split: dispatch-return time is pure host
+        # work (trace + transfer enqueue); the blocked wait on the step
+        # result is device compute — the same two-sided measurement
+        # bench.py uses for tunnel_overhead_ms
+        t_dispatch = time.perf_counter()
+        jax.block_until_ready(logits_dev)  # graftlint: disable=step-host-sync
+        dispatch_s = t_dispatch - t_decode0
+        device_s = time.perf_counter() - t_dispatch
+        self._m_step_phase.labels("dispatch").observe(dispatch_s)
+        self._m_step_phase.labels("device").observe(device_s)
 
         # fault injection: poison selected rows with NaN AFTER the
         # decode — other rows' values are untouched, so healthy
@@ -2238,11 +2328,23 @@ class LLMEngine:
                 return int(toks[i]), None
             return self._sample_host(logits[i], self.slots[i])
 
+        # collect traced requests BEFORE _check_done: a finishing
+        # request's slot is freed (req=None, tracer entry closed)
+        # inside it, and its final step still belongs on the timeline
+        # — so capture the parent span id now, not at record time
+        traced: Dict[str, Tuple[str, Optional[str]]] = {}
         for i in active:
             s = self.slots[i]
             tok, lp = pick(i)
             s.last_token = tok
             s.generated.append(tok)
+            r = s.req
+            if r is not None and r.trace is not None:
+                sp = self.tracer.get(r.request_id)
+                traced.setdefault(
+                    r.trace[0],
+                    (r.request_id,
+                     sp.trace_span if sp is not None else None))
             self._emit(s, lp)
             self._check_done(i)
         # one batched step advances EVERY active stream one token, so
@@ -2255,6 +2357,18 @@ class LLMEngine:
                            else 0.8 * self._tpot_ewma + 0.2 * dt)
         if self._tpot_floor is None or self._tpot_ewma < self._tpot_floor:
             self._tpot_floor = self._tpot_ewma
+        self._dispatch_ewma = (
+            dispatch_s if self._dispatch_ewma == 0.0
+            else 0.8 * self._dispatch_ewma + 0.2 * dispatch_s)
+        # one decode_step span per distinct trace among active slots
+        for tid, (rid, parent_sid) in traced.items():
+            self.spans.record(
+                "decode_step", tid,
+                parent_id=parent_sid,
+                t_start=t_wall0, t_end=t_wall0 + dt,
+                step=self._step_idx, request_id=rid,
+                dispatch_ms=round(dispatch_s * 1000.0, 3),
+                device_ms=round(device_s * 1000.0, 3))
         self._m_steps.inc()
         self._flight_step("decode", len(active))
         self._update_gauges()

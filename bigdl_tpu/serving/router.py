@@ -45,7 +45,12 @@ engine already provides:
 Observability: ``bigdl_tpu_router_*`` metric families (per-replica
 state gauge, failover/replay/hedge/breaker-trip/restart counters,
 routed-request latency histogram), router events in a flight recorder,
-and ``GET /v1/router/stats`` — the JSON snapshot bench embeds.
+and ``GET /v1/router/stats`` — the JSON snapshot bench embeds. Every
+admitted completion gets a W3C-style ``traceparent`` (generated here or
+accepted from the client; observability/disttrace.py) forwarded on each
+replica hop; ``GET /v1/trace/{trace_id}`` returns the stitched
+clock-skew-adjusted fleet timeline and ``GET /v1/traces`` lists recent
+slow traces.
 
 Run: ``python -m bigdl_tpu.serving.router --model PATH --replicas 2``
 (or ``--tiny-random`` for the checkpoint-free chaos/bench mode).
@@ -69,6 +74,12 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from bigdl_tpu.observability.disttrace import (SpanRecorder,
+                                               make_traceparent,
+                                               merge_timeline,
+                                               new_span_id, new_trace_id,
+                                               parse_traceparent,
+                                               trace_sampled)
 from bigdl_tpu.observability.flight import FlightRecorder
 from bigdl_tpu.observability.metrics import MetricsRegistry
 
@@ -233,6 +244,10 @@ class JournalEntry:
     hedged: bool = False
     admitted_at: float = dataclasses.field(default_factory=time.monotonic)
     tenant: Optional[str] = None       # X-Tenant-Id to forward
+    # distributed-trace context (observability/disttrace.py):
+    # (trace_id, client_parent_span_id or None, router_span_id) — None
+    # when the trace was tail-sampled out, so no header is forwarded
+    trace: Optional[Tuple[str, Optional[str], str]] = None
 
 
 class RequestJournal:
@@ -391,6 +406,10 @@ class Router:
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.flight = flight if flight is not None else FlightRecorder()
+        # one traceparent per admitted request (generated here, or
+        # accepted from the client) stitches router + replica spans
+        # into the GET /v1/trace/{id} timeline
+        self.spans = SpanRecorder(service="router")
         self._lock = threading.Lock()
         self._stop = False
         self._wake = threading.Event()
@@ -818,6 +837,12 @@ class Router:
         h = {"Content-Type": "application/json"}
         if entry.tenant:
             h["X-Tenant-Id"] = entry.tenant
+        if entry.trace is not None:
+            # the replica parents its engine spans under the ROUTER
+            # span, not the client's — replays re-forward the same id,
+            # so every attempt lands on one timeline
+            h["traceparent"] = make_traceparent(entry.trace[0],
+                                                entry.trace[2])
         if r is not None and r.role == "prefill" and not entry.stream:
             targets = self._handoff_targets(r)
             if targets:
@@ -883,6 +908,12 @@ class Router:
                     self.flight.record("hedge", rid=entry.rid,
                                        primary=primary.idx,
                                        hedge=second.idx)
+                    if entry.trace is not None:
+                        self.spans.annotate(
+                            entry.trace[0], "hedge",
+                            parent_id=entry.trace[2],
+                            primary=primary.idx, hedge=second.idx,
+                            request_id=entry.rid)
                     threading.Thread(target=run, args=(second,),
                                      daemon=True).start()
                     launched += 1
@@ -923,14 +954,28 @@ class Router:
                 exclude[r.idx] = r.generation
                 self._count("failovers")
                 self._c_failovers.inc()
-                self.flight.record("failover", rid=entry.rid,
-                                   replica=r.idx, error=str(e)[:200])
+                self.flight.record(
+                    "failover", rid=entry.rid, replica=r.idx,
+                    error=str(e)[:200],
+                    **({"trace_id": entry.trace[0]}
+                       if entry.trace is not None else {}))
+                if entry.trace is not None:
+                    self.spans.annotate(
+                        entry.trace[0], "failover",
+                        parent_id=entry.trace[2], replica=r.idx,
+                        request_id=entry.rid, error=str(e)[:120])
                 if entry.replays < self.cfg.max_replays:
                     entry.replays += 1
                     self._count("replays")
                     self._c_replays.inc()
                     self.flight.record("replay", rid=entry.rid,
                                        attempt=entry.replays)
+                    if entry.trace is not None:
+                        self.spans.annotate(
+                            entry.trace[0], "failover_replay",
+                            parent_id=entry.trace[2],
+                            attempt=entry.replays,
+                            request_id=entry.rid)
                     continue
                 return 502, json.dumps({"error": {
                     "message": "replica failed and replay budget is "
@@ -1149,6 +1194,71 @@ class Router:
                            prev=prev, role=role, ok=ok)
         return ok
 
+    # -- distributed-trace fan-out ------------------------------------------
+
+    def trace_timeline(self, trace_id: str) -> dict:
+        """The ``GET /v1/trace/{id}`` document: this router's own spans
+        plus every replica's (``GET /v1/internal/spans?trace_id=``),
+        stitched by ``merge_timeline`` with a per-replica clock-skew
+        estimate (local midpoint of the fan-out RTT minus the replica's
+        reported ``now``)."""
+        groups: List[Tuple[float, List[dict]]] = [
+            (0.0, self.spans.spans_for(trace_id))]
+        for r in self.replicas:
+            if not r.alive():
+                continue
+            try:
+                t_req0 = time.time()
+                status, body = self._http_get(
+                    r.port, f"/v1/internal/spans?trace_id={trace_id}",
+                    self.cfg.health_timeout_sec)
+                t_req1 = time.time()
+                if status != 200:
+                    continue
+                doc = json.loads(body)
+                skew = ((t_req0 + t_req1) / 2.0
+                        - float(doc.get("now", t_req1)))
+                groups.append((skew, doc.get("spans") or []))
+            except (OSError, ValueError):
+                continue
+        # a client-supplied parent span lives outside the fleet: spans
+        # pointing at it are NOT orphans
+        ext = [s["parent_id"] for s in self.spans.spans_for(trace_id)
+               if s.get("name") == "router.request"
+               and s.get("parent_id")]
+        return merge_timeline(trace_id, groups, external_parents=ext)
+
+    def trace_index(self, k: int = 16) -> List[dict]:
+        """The ``GET /v1/traces`` list: recent slow traces (top-k by
+        duration) merged across the router and every live replica."""
+        best: Dict[str, dict] = {}
+
+        def take(t: dict) -> None:
+            tid = t.get("trace_id")
+            cur = best.get(tid)
+            if cur is None or t.get("duration_s", 0.0) \
+                    > cur.get("duration_s", 0.0):
+                best[tid] = t
+
+        for t in self.spans.recent_traces(k):
+            take(t)
+        for r in self.replicas:
+            if not r.alive():
+                continue
+            try:
+                status, body = self._http_get(
+                    r.port, "/v1/internal/spans",
+                    self.cfg.health_timeout_sec)
+                if status != 200:
+                    continue
+                for t in json.loads(body).get("traces") or []:
+                    take(t)
+            except (OSError, ValueError):
+                continue
+        out = sorted(best.values(),
+                     key=lambda d: -d.get("duration_s", 0.0))
+        return out[:max(k, 0)]
+
     # -- introspection ------------------------------------------------------
 
     def _tenant_aggregate(self) -> dict:
@@ -1178,6 +1288,7 @@ class Router:
         return {
             "replicas": [r.snapshot() for r in self.replicas],
             "journal_depth": self.journal.depth(),
+            "spans": self.spans.snapshot(),
             "tenants": self._tenant_aggregate(),
             "counters": self.counts_snapshot(),
             "rolling_restart_in_progress": self._rolling,
@@ -1279,6 +1390,19 @@ class Router:
                 elif self.path == "/v1/router/flight":
                     self._json(200, {"events":
                                      router.flight.snapshot()})
+                elif self.path.startswith("/v1/trace/"):
+                    tid = self.path[len("/v1/trace/"):].split("?")[0]
+                    self._json(200, router.trace_timeline(tid))
+                elif self.path == "/v1/traces" \
+                        or self.path.startswith("/v1/traces?"):
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        k = int((q.get("k") or ["16"])[0])
+                    except ValueError:
+                        k = 16
+                    self._json(200, {"traces": router.trace_index(k)})
                 else:
                     self._proxy("GET")
 
@@ -1299,13 +1423,26 @@ class Router:
                     body = json.loads(raw or b"{}")
                 except json.JSONDecodeError:
                     return self._json(400, {"error": "bad json"})
+                # trace context: accept the client's traceparent or
+                # mint a fresh trace id; the tail-sampling decision is
+                # a pure function of the id, so every replica agrees
+                client = parse_traceparent(
+                    self.headers.get("traceparent"))
+                tid, parent = client if client is not None \
+                    else (new_trace_id(), None)
+                trace = ((tid, parent, new_span_id())
+                         if trace_sampled(tid, router.spans.sample)
+                         else None)
                 entry = JournalEntry(
                     rid=f"rtr-{uuid.uuid4().hex[:12]}",
                     path=self.path, body=raw,
                     stream=bool(body.get("stream")),
                     key=router._affinity_key(body),
-                    tenant=router._tenant_of(self.headers))
+                    tenant=router._tenant_of(self.headers),
+                    trace=trace)
                 router.journal.admit(entry)   # write-ahead
+                t_req0 = time.time()
+                status = None
                 try:
                     if entry.stream:
                         self._stream(entry)
@@ -1316,9 +1453,24 @@ class Router:
                             headers = _retry_after_headers(data) or (
                                 ("Retry-After",
                                  str(router.retry_after_hint())),)
+                        if entry.trace is not None:
+                            headers = tuple(headers) + (
+                                ("X-Trace-Id", entry.trace[0]),)
                         self._json(status, data, headers=headers)
                 finally:
                     router.journal.complete(entry.rid)
+                    if entry.trace is not None:
+                        router.spans.record(
+                            "router.request", entry.trace[0],
+                            span_id=entry.trace[2],
+                            parent_id=entry.trace[1],
+                            t_start=t_req0, t_end=time.time(),
+                            request_id=entry.rid, path=self.path,
+                            stream=entry.stream,
+                            replays=entry.replays,
+                            hedged=entry.hedged,
+                            **({"status": status}
+                               if status is not None else {}))
 
             def _stream(self, entry: JournalEntry):
                 """Relay SSE from the replica. A replica lost BEFORE
@@ -1363,11 +1515,27 @@ class Router:
                             router._c_failovers.inc()
                             router.flight.record(
                                 "failover", rid=entry.rid,
-                                replica=r.idx, error=str(e)[:200])
+                                replica=r.idx, error=str(e)[:200],
+                                **({"trace_id": entry.trace[0]}
+                                   if entry.trace is not None
+                                   else {}))
+                            if entry.trace is not None:
+                                router.spans.annotate(
+                                    entry.trace[0], "failover",
+                                    parent_id=entry.trace[2],
+                                    replica=r.idx,
+                                    request_id=entry.rid)
                             if entry.replays < router.cfg.max_replays:
                                 entry.replays += 1
                                 router._count("replays")
                                 router._c_replays.inc()
+                                if entry.trace is not None:
+                                    router.spans.annotate(
+                                        entry.trace[0],
+                                        "failover_replay",
+                                        parent_id=entry.trace[2],
+                                        attempt=entry.replays,
+                                        request_id=entry.rid)
                                 continue
                             return self._json(502, {"error": {
                                 "message": "replica failed before the "

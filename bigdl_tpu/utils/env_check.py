@@ -31,6 +31,7 @@ KNOWN_ENV = (
     "BIGDL_TPU_DISABLE_NATIVE",
     "BIGDL_TPU_DRAIN_TIMEOUT_SEC",
     "BIGDL_TPU_EVENT_LOG",
+    "BIGDL_TPU_EVENT_LOG_KEEP",
     "BIGDL_TPU_EVENT_LOG_MAX_BYTES",
     "BIGDL_TPU_FAULT_SPEC",
     "BIGDL_TPU_HANDOFF_RETRIES",
@@ -62,6 +63,7 @@ KNOWN_ENV = (
     "BIGDL_TPU_TENANT_BURST",
     "BIGDL_TPU_TENANT_RPS",
     "BIGDL_TPU_TENANT_TPS",
+    "BIGDL_TPU_TRACE_SAMPLE",
 )
 
 
@@ -138,6 +140,34 @@ def collect() -> dict:
         except ValueError as e:
             info["event_log_max_bytes"] = {
                 "value": evmax, "valid": False, "error": str(e)}
+
+    # rotated-file retention: the tracer and the span sink both degrade
+    # to keep=1 on a bad value, so surface it here
+    evkeep = os.environ.get("BIGDL_TPU_EVENT_LOG_KEEP")
+    if evkeep:
+        from bigdl_tpu.observability.tracing import \
+            resolve_event_log_keep
+
+        try:
+            info["event_log_keep"] = {
+                "value": resolve_event_log_keep(evkeep), "valid": True}
+        except ValueError as e:
+            info["event_log_keep"] = {
+                "value": evkeep, "valid": False, "error": str(e)}
+
+    # distributed-trace tail sampling: the span recorder degrades to
+    # 1.0 (record everything) on a bad value
+    tsample = os.environ.get("BIGDL_TPU_TRACE_SAMPLE")
+    if tsample:
+        from bigdl_tpu.observability.disttrace import \
+            resolve_trace_sample
+
+        try:
+            info["trace_sample"] = {
+                "value": resolve_trace_sample(tsample), "valid": True}
+        except ValueError as e:
+            info["trace_sample"] = {
+                "value": tsample, "valid": False, "error": str(e)}
 
     # postmortem dump directory: write_postmortem swallows failures by
     # contract, so an unwritable dir would otherwise only show up as a
@@ -357,6 +387,8 @@ def main() -> int:
     ok = ("jax_error" not in info and "bigdl_tpu_error" not in info
           and info.get("kv_cache_dtype", {}).get("valid", True)
           and info.get("event_log_max_bytes", {}).get("valid", True)
+          and info.get("event_log_keep", {}).get("valid", True)
+          and info.get("trace_sample", {}).get("valid", True)
           and info.get("recompile_warn", {}).get("valid", True)
           and info.get("hbm_budget_fraction", {}).get("valid", True)
           and info.get("memory_poll_sec", {}).get("valid", True)

@@ -1,0 +1,28 @@
+"""Seeded bug: span-recorder buffers mutated under the lock in the
+record path, then snapshotted by an HTTP-handler thread without it."""
+
+import threading
+
+
+class MiniSpanRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans = []
+        self._by_trace = {}
+
+    def record(self, name, trace_id):
+        span = {"name": name, "trace_id": trace_id}
+        with self._lock:
+            self._spans.append(span)         # establishes the guard
+            self._by_trace.setdefault(trace_id, []).append(span)
+
+    def spans_for(self, trace_id):
+        # /v1/internal/spans handler thread: reads without the lock
+        return list(self._by_trace.get(trace_id, ()))
+
+    def tail(self, k):
+        return self._spans[-k:]              # read without the lock
+
+    def spans_for_ok(self, trace_id):
+        with self._lock:
+            return list(self._by_trace.get(trace_id, ()))

@@ -183,6 +183,22 @@ def test_detects_supervisor_handler_counter_race():
                    for f in result.findings)
 
 
+def test_detects_span_stack_race():
+    """The SpanRecorder shape: record() mutates the span buffers under
+    the lock, a /v1/internal/spans handler thread snapshots them — the
+    unlocked reads must be caught, the locked variant silent."""
+    result = _scan("fx_span_unclosed.py")
+    hits = [f for f in result.findings
+            if f.rule == "lock-guarded-unlocked"]
+    assert len(hits) == 2, result.findings
+    assert {f.obj for f in hits} == {"MiniSpanRecorder.spans_for",
+                                     "MiniSpanRecorder.tail"}
+    msgs = " | ".join(f.message for f in hits)
+    assert "_by_trace" in msgs and "_spans" in msgs
+    assert not any(f.obj.endswith("spans_for_ok")
+                   for f in result.findings)
+
+
 def test_detects_lock_order_inversion():
     result = _scan("fx_lock_inversion.py")
     hits = [f for f in result.findings

@@ -14,7 +14,10 @@ A metric regresses when it moves in its bad direction by more than
 --threshold percent (default 5): latencies and byte footprints UP,
 throughput DOWN. The memory report's headline scalars
 (hbm_static_total_bytes, hbm_device_peak_bytes, jit_peak_temp_bytes)
-get their own --max-hbm-regress-pct threshold (default: --threshold).
+get their own --max-hbm-regress-pct threshold (default: --threshold);
+the decode roofline and the critical-path dispatch overhead
+(dispatch_overhead_ms) ride tighter ratchets
+(--max-roofline-regress-pct / --max-dispatch-regress-pct, default 2).
 Records missing any block — memory, jit_compile_table, observability,
 or individual metric keys — are fine: only keys present in BOTH files
 are compared. Exit status: 0 no regressions, 1 regressions found,
@@ -111,6 +114,14 @@ ROUTER_COUNTERS = {
     "autoscale_refused": "lower",
 }
 
+# host dispatch overhead of the decode step (bench_serving
+# "critical_path" block, EWMA of dispatch-return time per step): the
+# tunnel-overhead number the paper optimizes, so it gets its own
+# (tighter) --max-dispatch-regress-pct ratchet, lower-is-better
+DISPATCH_METRICS = {
+    "dispatch_overhead_ms": "lower",
+}
+
 # the HBM-bandwidth roofline utilization of the decode step is the
 # tentpole serving efficiency number: it gets a RATCHET — its own
 # (tighter) --max-roofline-regress-pct threshold, higher-is-better,
@@ -158,6 +169,9 @@ def flatten_metrics(rec: dict, prefix: str = "",
         elif key in HBM_METRICS and isinstance(val, (int, float)) \
                 and not isinstance(val, bool):
             out[name] = (float(val), HBM_METRICS[key])
+        elif key in DISPATCH_METRICS and isinstance(val, (int, float)) \
+                and not isinstance(val, bool):
+            out[name] = (float(val), DISPATCH_METRICS[key])
         elif key == "value" and isinstance(val, (int, float)) \
                 and not isinstance(val, bool) and rec.get("unit") == "ms":
             # the headline {"metric": ..., "value": ..., "unit": "ms"}
@@ -200,17 +214,21 @@ def diff(old: Dict[str, Tuple[float, str]],
          new: Dict[str, Tuple[float, str]],
          threshold_pct: float,
          hbm_threshold_pct: Optional[float] = None,
-         roofline_threshold_pct: Optional[float] = None):
+         roofline_threshold_pct: Optional[float] = None,
+         dispatch_threshold_pct: Optional[float] = None):
     """Returns (rows, regressions): rows are (name, old, new, pct,
     direction, regressed) for every metric present in both files.
     Memory-report scalars (HBM_METRICS keys) regress past
     ``hbm_threshold_pct`` (default: ``threshold_pct``); the decode
     roofline ratchet (ROOFLINE_METRICS) past ``roofline_threshold_pct``
-    (default 2)."""
+    (default 2); the host dispatch-overhead ratchet (DISPATCH_METRICS)
+    past ``dispatch_threshold_pct`` (default 2)."""
     if hbm_threshold_pct is None:
         hbm_threshold_pct = threshold_pct
     if roofline_threshold_pct is None:
         roofline_threshold_pct = 2.0
+    if dispatch_threshold_pct is None:
+        dispatch_threshold_pct = 2.0
     rows = []
     regressions = []
     for name in sorted(set(old) & set(new)):
@@ -225,6 +243,8 @@ def diff(old: Dict[str, Tuple[float, str]],
             limit = hbm_threshold_pct
         elif leaf in ROOFLINE_METRICS:
             limit = roofline_threshold_pct
+        elif leaf in DISPATCH_METRICS:
+            limit = dispatch_threshold_pct
         else:
             limit = threshold_pct
         bad = pct > limit if direction == "lower" else pct < -limit
@@ -248,6 +268,10 @@ def main(argv=None) -> int:
                     help="ratchet threshold for "
                          "decode_hbm_roofline_util (default 2; "
                          "higher-is-better)")
+    ap.add_argument("--max-dispatch-regress-pct", type=float,
+                    default=2.0,
+                    help="ratchet threshold for dispatch_overhead_ms "
+                         "(default 2; lower-is-better)")
     args = ap.parse_args(argv)
 
     try:
@@ -259,7 +283,8 @@ def main(argv=None) -> int:
 
     rows, regressions = diff(old, new, args.threshold,
                              args.max_hbm_regress_pct,
-                             args.max_roofline_regress_pct)
+                             args.max_roofline_regress_pct,
+                             args.max_dispatch_regress_pct)
     if not rows:
         print("bench_diff: no comparable metrics between "
               f"{args.old} and {args.new}", file=sys.stderr)
