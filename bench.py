@@ -45,6 +45,18 @@ def _last_tb_frame(stderr: str) -> str:
     return frames[-1] if frames else ""
 
 
+def _exception_head(stderr: str) -> str:
+    """The terminal ``SomeError: message`` line in a lane's stderr.
+    The r05 window lost three lanes to identical 300-char stderr TAILS
+    (all runtime-shutdown noise); the exception head line is what
+    actually differs between failure modes, so it goes into the lane's
+    JSON error string alongside the crash frame and the tail."""
+    heads = [ln for ln in (stderr or "").splitlines()
+             if re.match(r"^[A-Za-z_.]+(Error|Exception|Fault|Exit)\b[:(]",
+                         ln)]
+    return heads[-1][:200] if heads else ""
+
+
 def _persist_lane_log(run_dir: str, label: str, stdout, stderr):
     """Write a lane's FULL stdout+stderr next to the bench results and
     return the path (referenced from the lane's JSON entry) — the
@@ -179,7 +191,7 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     prompt_len = PROMPT_LEN if on_tpu else 32
     steps = DECODE_STEPS if on_tpu else 8
 
-    from bigdl_tpu.transformers.model import _maybe_mxu_layout
+    from bigdl_tpu.ops.quant import prepack_tree
 
     if on_tpu and os.environ.get("BENCH_CANARY", "1") != "0":
         # tiny-geometry run under the SAME dispatch flags: if the 7B run
@@ -190,7 +202,7 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
         tp = random_llama_params(TINY_LLAMA, qtype=qtype)
         if merged:
             tp = llama_mod.merge_projections(tp, TINY_LLAMA)
-        tp = _maybe_mxu_layout(tp)
+        tp, _ = prepack_tree(tp)
         tcache = llama_mod.new_cache(TINY_LLAMA, 1, 64,
                                      quantized=kv_dtype)
         tlg, tcache = jax.jit(llama_mod.forward, static_argnums=1)(
@@ -204,11 +216,14 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     if merged:
         # merged QKV + gate/up — the shipped from_pretrained default
         params = llama_mod.merge_projections(params, cfg)
-    # the shipped from_pretrained load-time re-layout (int4-dtype MXU
-    # weights) — ONE implementation so bench measures exactly what the
-    # loader does
-    params = _maybe_mxu_layout(params)
+    # the shipped from_pretrained load-time prepack (int4-dtype MXU
+    # weight re-layout) — ONE implementation so bench measures exactly
+    # what the loader does; the report rides along in the bench JSON
+    t_pack = time.perf_counter()
+    params, prepack_report = prepack_tree(params)
     jax.block_until_ready(params)
+    prepack_report["prepack_ms"] = round(
+        (time.perf_counter() - t_pack) * 1e3, 1)
     phase("params ready on device")
     tokens = jnp.ones((1, prompt_len), jnp.int32)
 
@@ -324,6 +339,10 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
         # per-executable compile counts/times for this process — a bench
         # row whose compile table grew between runs recompiled something
         "jit_compile_table": compile_table(),
+        # load-time weight prepack report (ISSUE 14c): mode, QTensor
+        # counts, bytes re-laid-out, and the one-time transform cost —
+        # tools/bench_diff.py treats the block as informational
+        "prepack": prepack_report,
         "first_token_ms": round(max(first_raw - overhead_ms, 0.0), 3),
         "first_token_ms_raw": round(first_raw, 3),
         "next_token_ms": round(next_ms, 3),
@@ -694,8 +713,10 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
                      if ln.startswith("{")]
             if not lines:
                 frame = _last_tb_frame(proc.stderr)
+                head = _exception_head(proc.stderr)
                 raise RuntimeError(
                     f"no output (rc={proc.returncode}); "
+                    + (f"{head}; " if head else "")
                     + (f"crashed at: {frame}; " if frame else "")
                     + f"stderr tail: {proc.stderr[-300:]}")
             raw = json.loads(lines[-1])
@@ -732,6 +753,7 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
                      "kv_cache_dtype": raw.get("kv_cache_dtype", "bf16"),
                      "kv_cache_bytes": raw.get("kv_cache_bytes"),
                      "kv_quantized": raw["kv_quantized"],
+                     "prepack": raw.get("prepack"),
                      "observability": raw.get("observability", {})}
             if raw["next_token_ms"] < dfloor or \
                     raw["first_token_ms"] < pfloor:
@@ -874,6 +896,7 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
         valid=True,
         first_token_ms=round(first_ms, 3),
         best_config=best,
+        prepack=ok[best].get("prepack"),
         observability=ok[best].get("observability", {}),
     )
     if fastest != best:

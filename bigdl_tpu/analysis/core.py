@@ -55,6 +55,9 @@ RULES: Dict[str, str] = {
     "step-host-sync":
         "per-element or looped host-device pull on the engine step "
         "path (pull once, index in numpy)",
+    "jax-dispatch-in-decode-loop":
+        "jit dispatched inside a loop on the engine step path (one "
+        "launch per token — batch the call or lax.scan inside the jit)",
     "lock-guarded-unlocked":
         "attribute written under a lock accessed without holding it",
     "lock-order-inversion":

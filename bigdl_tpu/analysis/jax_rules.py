@@ -55,6 +55,13 @@ Rules
     subscript whose base is not provably host-resident numpy. The
     sanctioned pattern is ONE ``np.asarray`` per step, then numpy
     indexing.
+``jax-dispatch-in-decode-loop``
+    On the engine step path: a call to a jit-bound callable (a name or
+    ``self`` attribute a ``tracked_jit``/``jax.jit`` result was
+    assigned to) inside a ``for``/``while`` loop or comprehension.
+    Each call is a full host->device launch — per-token dispatch
+    overhead the resident decode step exists to remove. Batch the rows
+    into one call, or fold the loop into the jit (``lax.scan``).
 """
 
 from __future__ import annotations
@@ -731,8 +738,67 @@ class _StepPath(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _DispatchLoop(ast.NodeVisitor):
+    """Flag jit dispatches issued per loop iteration in one step-path
+    method. A call through a name/attribute a jit result was bound to
+    is one host->device launch; in a loop that is per-token dispatch
+    overhead. Loops INSIDE a traced body (lax.scan bodies, vmap row
+    fns) never reach here — bindings only cover module-level jit
+    results, and calling a jit from traced code is inlined anyway."""
+
+    def __init__(self, module: Module, obj: str, out: List[Finding],
+                 bindings: Dict[Tuple[str, str], JitSite],
+                 loop_depth: int = 0):
+        self.m = module
+        self.obj = obj
+        self.out = out
+        self.bindings = bindings
+        self.loop = loop_depth
+
+    def _enter_loop(self, node: ast.AST) -> None:
+        self.loop += 1
+        self.generic_visit(node)
+        self.loop -= 1
+
+    visit_For = visit_While = _enter_loop
+    visit_ListComp = visit_SetComp = _enter_loop
+    visit_DictComp = visit_GeneratorExp = _enter_loop
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # closures inherit the loop depth of their def site (they are
+        # called inline in the step loop)
+        inner = _DispatchLoop(self.m, f"{self.obj}.{node.name}",
+                              self.out, self.bindings, self.loop)
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.loop > 0:
+            f = node.func
+            site = None
+            if isinstance(f, ast.Name):
+                site = self.bindings.get(("", f.id))
+            elif isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name):
+                site = self.bindings.get((f.value.id, f.attr))
+            if site is not None:
+                self.out.append(Finding(
+                    "jax-dispatch-in-decode-loop", self.m.rel,
+                    node.lineno, self.obj,
+                    f"jit {site.name!r} dispatched inside a loop on "
+                    "the step path: one host->device launch per "
+                    "iteration — batch the rows into one call or fold "
+                    "the loop into the jit (lax.scan / resident step)",
+                    self.m.snippet(node.lineno)))
+        self.generic_visit(node)
+
+
 def _check_step_path(module: Module, cls: str, entry: str,
-                     out: List[Finding]) -> None:
+                     out: List[Finding],
+                     bindings: Optional[Dict[Tuple[str, str],
+                                             JitSite]] = None) -> None:
     methods = _class_methods(module.tree, cls)
     if entry not in methods:
         return
@@ -743,6 +809,10 @@ def _check_step_path(module: Module, cls: str, entry: str,
         walker = _StepPath(module, f"{cls}.{name}", out, proven)
         for stmt in fn.body:
             walker.visit(stmt)
+        if bindings:
+            disp = _DispatchLoop(module, f"{cls}.{name}", out, bindings)
+            for stmt in fn.body:
+                disp.visit(stmt)
 
 
 # ---------------------------------------------------------------------------
@@ -775,5 +845,6 @@ def check(modules: Iterable[Module],
 
         for sfx, (cls, entry) in entries.items():
             if m.rel.endswith(sfx):
-                _check_step_path(m, cls, entry, out)
+                _check_step_path(m, cls, entry, out,
+                                 bindings=scan.bindings)
     return out

@@ -52,6 +52,19 @@ class RuntimeFlags:
     # layout on TPU — native Mosaic int4 loads instead of the VPU
     # nibble-unpack chain; canonical split-block elsewhere), "on", "off"
     mxu_layout: str = "auto"
+    # load-time weight prepacking (ops/quant.prepack_tree): "auto"
+    # (retile QTensor planes into the kernel layout when the target is
+    # TPU — subsumes mxu_layout), "on" (force the retile anywhere),
+    # "off" (keep the canonical split-block planes). Applied ONCE at
+    # checkpoint load; save_low_bit always writes canonical planes.
+    prepack: str = "auto"
+    # resident single-dispatch decode step: fuse forward + sampling +
+    # EOS bookkeeping into ONE tracked_jit per token so the serving
+    # engine/generator issue a single host dispatch per step. "auto"
+    # (on whenever the step has no host-side per-row work: no penalty
+    # sampling, no fault hooks), "on" (same gate, assert-style intent),
+    # "off" (legacy multi-dispatch step)
+    decode_resident: str = "auto"
     # host-side C++ kernels (bigdl_tpu.native); disable to force pure JAX
     disable_native: bool = False
     native_cache_dir: Optional[str] = None
@@ -83,6 +96,11 @@ class RuntimeFlags:
                 "BIGDL_TPU_MATMUL_PALLAS_MAX_M", "128")),
             moe_dispatch=os.environ.get("BIGDL_TPU_MOE_DISPATCH", "auto"),
             mxu_layout=os.environ.get("BIGDL_TPU_MXU_LAYOUT", "auto"),
+            prepack=_tristate_env("BIGDL_TPU_PREPACK",
+                                  lambda s: resolve_prepack(s)),
+            decode_resident=_tristate_env(
+                "BIGDL_TPU_DECODE_RESIDENT",
+                lambda s: resolve_decode_resident(s)),
             disable_native=_env_bool("BIGDL_TPU_DISABLE_NATIVE"),
             native_cache_dir=os.environ.get("BIGDL_TPU_NATIVE_CACHE"),
             kv_cache_dtype=os.environ.get(
@@ -92,6 +110,50 @@ class RuntimeFlags:
             aot_target=(os.environ.get("BIGDL_TPU_AOT_TARGET") or "").strip()
             .lower() or None,
         )
+
+
+_TRISTATE = ("auto", "on", "off")
+
+
+def _tristate_env(name: str, resolver) -> str:
+    """Resolve a tristate env knob, falling back to "auto" on a bad
+    value: a typo must not crash the process at flag load —
+    utils/env_check.py runs the same resolver and reports it."""
+    try:
+        return resolver(os.environ.get(name, "auto"))
+    except ValueError:
+        return "auto"
+
+
+def resolve_prepack(spec) -> str:
+    """Normalize a BIGDL_TPU_PREPACK spec to "auto" | "on" | "off"."""
+    s = str(spec).strip().lower() if spec is not None else "auto"
+    s = {"1": "on", "true": "on", "0": "off", "false": "off",
+         "": "auto"}.get(s, s)
+    if s not in _TRISTATE:
+        raise ValueError(
+            f"unknown prepack mode {spec!r}; choose from {_TRISTATE}")
+    return s
+
+
+def resolve_decode_resident(spec) -> str:
+    """Normalize a BIGDL_TPU_DECODE_RESIDENT spec to "auto"|"on"|"off"."""
+    s = str(spec).strip().lower() if spec is not None else "auto"
+    s = {"1": "on", "true": "on", "0": "off", "false": "off",
+         "": "auto"}.get(s, s)
+    if s not in _TRISTATE:
+        raise ValueError(
+            f"unknown decode_resident mode {spec!r}; "
+            f"choose from {_TRISTATE}")
+    return s
+
+
+def decode_resident_enabled() -> bool:
+    """Effective resident-decode switch: "off" disables, "on"/"auto"
+    enable (the per-step gate — penalties, fault hooks, logprob rows —
+    lives at the call sites, which fall back to the legacy multi-
+    dispatch step for work that must run on host)."""
+    return flags().decode_resident != "off"
 
 
 _flags: Optional[RuntimeFlags] = None

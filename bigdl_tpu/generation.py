@@ -446,6 +446,27 @@ class Generator:
             "generate_sample_pen", sample_pen,
             static_argnames=("temperature", "top_k", "top_p",
                              "rep", "pres", "freq"))
+
+        def step_resident(p, c, tok, kv, key, finished, *, temperature,
+                          top_k, top_p, eos):
+            """ONE-dispatch decode step: layer-scanned forward + PRNG
+            split + sampling + EOS masking fused into a single
+            executable. Keeps the legacy step's exact op order (split
+            THEN sample THEN mask) so greedy output is byte-identical
+            and sampled output reuses the same key chain."""
+            lg, kv = fwd(p, c, tok[:, None], kv)
+            key, sk = jax.random.split(key)
+            nxt = sample_token(lg[:, -1, :], sk, temperature=temperature,
+                               top_k=top_k, top_p=top_p)
+            if eos is not None:
+                nxt = jnp.where(finished, 0, nxt)
+                finished = finished | (nxt == eos)
+            return nxt, kv, key, finished
+
+        self._decode_resident = tracked_jit(
+            "generate_decode_resident", step_resident,
+            static_argnums=(1,), donate_argnums=(3,),
+            static_argnames=("temperature", "top_k", "top_p", "eos"))
         self._counts = tracked_jit("generate_token_counts", token_counts,
                                    static_argnums=(1,))
         # phase timing published as bigdl_tpu_generate_{prefill,decode}
@@ -615,10 +636,36 @@ class Generator:
             finished |= tok_host == gen.eos_token_id
             finished_dev = jnp.asarray(finished)
 
+        # resident single-dispatch decode (ISSUE 14b): forward + PRNG
+        # split + sampling + EOS masking run as ONE executable per token,
+        # so the tunnel/dispatch overhead is paid once per step instead
+        # of once per phase. Host-side per-step work (penalty counters
+        # via _sample_pen's nonlocals, fault hooks, check_logits pulls)
+        # keeps the legacy multi-dispatch loop.
+        from bigdl_tpu.config import decode_resident_enabled
+        from bigdl_tpu.robustness.faults import NULL as _no_faults
+
+        resident = (decode_resident_enabled() and not penal
+                    and not gen.check_logits
+                    and self.faults is _no_faults)
+
         for step_i in range(1, gen.max_new_tokens):
             if finished.all():
                 break
             t1 = time.perf_counter()
+            if resident:
+                tok, cache, key, finished_dev = self._decode_resident(
+                    self.params, self.cfg, tok, cache, key, finished_dev,
+                    temperature=temp, top_k=gen.top_k, top_p=gen.top_p,
+                    eos=gen.eos_token_id)
+                tok_host = np.asarray(tok)
+                self.step_timer.record("decode", time.perf_counter() - t1)
+                if stats is not None:
+                    stats.rest_token_s.append(time.perf_counter() - t1)
+                yield tok_host
+                if gen.eos_token_id is not None:
+                    finished |= tok_host == gen.eos_token_id
+                continue
             # fault hooks mirror the serving engine's step points
             self.faults.raise_point("step", step_i)
             ms = self.faults.sleep_ms("step", step_i)

@@ -66,6 +66,33 @@ def memory_capture_enabled() -> bool:
 _lock = threading.Lock()
 _table: Dict[str, Dict[str, Any]] = {}
 
+# per-name DISPATCH counts (every call of a tracked executable, compile
+# or cache hit). The resident-decode work (ISSUE 14) is measured in
+# host dispatches per engine step; this table is how tests assert
+# "exactly one" without profiling the runtime.
+_dispatch_lock = threading.Lock()
+_dispatches: Dict[str, int] = {}
+
+
+def _count_dispatch(name: str) -> None:
+    with _dispatch_lock:
+        _dispatches[name] = _dispatches.get(name, 0) + 1
+
+
+def dispatch_table() -> Dict[str, int]:
+    """Snapshot of per-name tracked-jit dispatch counts since process
+    start (or the last ``reset_dispatch_table()``). One entry per
+    tracked executable name; every __call__ counts, compiles included."""
+    with _dispatch_lock:
+        return dict(_dispatches)
+
+
+def reset_dispatch_table() -> None:
+    """Zero the per-name dispatch counters (tests bracket an engine
+    step with reset + dispatch_table() to count its host dispatches)."""
+    with _dispatch_lock:
+        _dispatches.clear()
+
 # first-call-for-a-signature compiles currently executing, process-wide.
 # A compile blocks the engine's step loop for seconds-to-minutes (real
 # TPU lowerings far exceed any sane wedge threshold), during which the
@@ -224,6 +251,7 @@ class TrackedJit:
                 tuple(statics))
 
     def __call__(self, *args, **kwargs):
+        _count_dispatch(self.name)
         try:
             sig = self._signature(args, kwargs)
             with self._seen_lock:

@@ -118,9 +118,33 @@ def _q_matmul_xla_chunked(x: jax.Array, w: QTensor,
     return y.reshape(*x.shape[:-1], n)
 
 
+def _rows(x: jax.Array) -> int:
+    m = 1
+    for dim in x.shape[:-1]:
+        m *= dim
+    return m
+
+
+# one 7B-class weight (4096 x 11008 and up); decode-shaped calls against
+# anything this large get the bounded-temp chunked plan
+_DECODE_CHUNK_ELEMS = 1 << 25
+
+
 def _q_matmul_xla(x: jax.Array, w: QTensor) -> jax.Array:
     if w.qtype in _HEAVY_DECODE_QTYPES:
         y = _q_matmul_xla_chunked(x, w)
+        if y is not None:
+            return y
+    elif _rows(x) <= 16 and w.shape[0] * w.shape[1] >= _DECODE_CHUNK_ELEMS:
+        # decode against a 7B-class weight: the dense plan materializes
+        # the FULL bf16 dequant (2*K*N bytes of temp) per layer — across
+        # a scanned 32-layer decode XLA kept several alive at once and
+        # the forced-XLA bench lane died in RESOURCE_EXHAUSTED before
+        # producing a number. Chunking over N bounds the live temp to
+        # one chunk; over-N splits leave every dot column's K-reduction
+        # untouched, so the result is bitwise identical to the dense
+        # plan (prefill M is unaffected either way).
+        y = _q_matmul_xla_chunked(x, w, min_elems=_DECODE_CHUNK_ELEMS)
         if y is not None:
             return y
     dense = dequantize(w, dtype=jnp.bfloat16)
@@ -130,22 +154,109 @@ def _q_matmul_xla(x: jax.Array, w: QTensor) -> jax.Array:
     return y.astype(x.dtype)
 
 
+# formats with exact (or single-LUT) codes whose dequant factors as
+# code * blockscale (+ blockzero): these fuse into the contraction
+_FUSED_XLA_QTYPES = frozenset({"sym_int4", "asym_int4", "nf4", "sym_int8"})
+
+
+def _q_matmul_xla_fused(x: jax.Array, w: QTensor) -> jax.Array:
+    """Decode-shaped XLA path with the dequant fused INTO the dot.
+
+    The plain fallback computes dequantize(W) -> [K, N] bf16 -> dot: the
+    scale multiply touches all K*N weights and the scale-expanded bf16
+    weight is a full-size temp. Scales factor out of the contraction
+    (same algebra as the Pallas `_gemv_kernel_fold`):
+
+        y[m, n] = sum_r s[r, n] * sum_{j in block r} x[m, r, j] c[r, j, n]
+                  (+ sum_r z[r, n] * sum_j x[m, r, j]   for asym)
+
+    so this runs ONE batched `lax.dot_general` over the raw codes (int4
+    codes are exact in bf16; nf4 is one LUT take) and applies scales to
+    the [K/B, M, N] block partials in f32 — per-weight work drops to the
+    unpack+convert, and at decode M the partial stack is megabytes, not
+    the 2*K*N of a dense dequant. Used on TPU for decode-shaped calls
+    when the Pallas kernel is unavailable (unprobed geometry, SPMD
+    tracing), or forced via backend="xla_fused"."""
+    from bigdl_tpu.ops.quant import _unpack4, get_qtype
+    from bigdl_tpu.ops.codebooks import CODEBOOKS
+
+    qt = get_qtype(w.qtype)
+    if w.qtype not in _FUSED_XLA_QTYPES:
+        raise NotImplementedError(
+            f"fused XLA matmul does not support {w.qtype}")
+    b = qt.block_size
+    k, n = w.shape
+    kp = w.scale.shape[0] * b
+    batch_shape = x.shape[:-1]
+    x2 = x.reshape(-1, k).astype(jnp.bfloat16)
+    if kp != k:
+        x2 = jax.lax.pad(x2, jnp.zeros((), x2.dtype),
+                         ((0, 0, 0), (0, kp - k, 0)))
+    m = x2.shape[0]
+    rows = kp // b
+    x3 = x2.reshape(m, rows, b).transpose(1, 0, 2)            # [r, M, B]
+
+    data = w.data
+    if data.dtype == jnp.int4:                # MXU layout: codes direct
+        cb = data.astype(jnp.bfloat16)
+    elif qt.storage_bits == 8:
+        cb = data.astype(jnp.bfloat16)
+    else:
+        codes = _unpack4(data, b)                             # [kp, N] u8
+        if qt.kind == "codebook":
+            lut = jnp.asarray(CODEBOOKS[qt.codebook], jnp.bfloat16)
+            cb = jnp.take(lut, codes.astype(jnp.int32), axis=0)
+        elif qt.kind == "sym":
+            cb = codes.astype(jnp.bfloat16) - 8.0
+        else:                                                 # asym
+            cb = codes.astype(jnp.bfloat16)
+    cb3 = cb.reshape(rows, b, n)                              # [r, B, N]
+
+    part = jax.lax.dot_general(                               # [r, M, N]
+        x3, cb3, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    s = w.scale.astype(jnp.float32)                           # [r, N]
+    y = jnp.sum(part * s[:, None, :], axis=0)                 # [M, N]
+    if qt.kind == "asym":
+        xsum = jnp.sum(x3.astype(jnp.float32), axis=2).T      # [M, r]
+        y = y + jnp.dot(xsum, w.zero.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+    return y.astype(x.dtype).reshape(*batch_shape, n)
+
+
 def _q_matmul_dispatch(x: jax.Array, w: QTensor, be: str) -> jax.Array:
     if be == "xla":
+        return _q_matmul_xla(x, w)
+    if be == "xla_fused":
+        if w.qtype in _FUSED_XLA_QTYPES:
+            return _q_matmul_xla_fused(x, w)
         return _q_matmul_xla(x, w)
     if be in ("auto", "pallas"):
         from bigdl_tpu.config import flags, target_is_tpu, under_spmd
 
-        use_pallas = (w.qtype in _PALLAS_QTYPES and target_is_tpu()
+        on_tpu = target_is_tpu()
+        use_pallas = (w.qtype in _PALLAS_QTYPES and on_tpu
                       and not under_spmd(x, *jax.tree_util.tree_leaves(w)))
         if be == "auto" and use_pallas:
             # prefill-class M: the dequant kernel is VPU-bound while the
             # XLA dequantize-then-matmul plan rides the MXU (on-chip A/B
             # in RuntimeFlags.matmul_pallas_max_m's docstring)
-            m = 1
-            for dim in x.shape[:-1]:
-                m *= dim
+            m = _rows(x)
             use_pallas = m <= flags().matmul_pallas_max_m
+            if use_pallas:
+                from bigdl_tpu.ops.pallas.dequant_matmul import (
+                    GEMV_MAX_M, matmul_kernel_compiles)
+
+                if m > GEMV_MAX_M:
+                    # the generic tiles were the ONE unprobed Pallas
+                    # path — a Mosaic rejection there crashed the whole
+                    # forced-all-M bench lane instead of degrading
+                    from bigdl_tpu.ops.quant import get_qtype
+
+                    kp = w.scale.shape[0] * get_qtype(w.qtype).block_size
+                    use_pallas = matmul_kernel_compiles(
+                        w.qtype, m, kp, w.shape[1],
+                        mxu=w.data.dtype == jnp.int4)
         if be == "pallas" or use_pallas:
             try:
                 from bigdl_tpu.ops.pallas.dequant_matmul import (
@@ -155,6 +266,11 @@ def _q_matmul_dispatch(x: jax.Array, w: QTensor, be: str) -> jax.Array:
             except NotImplementedError:
                 if be == "pallas":
                     raise
+        if on_tpu and w.qtype in _FUSED_XLA_QTYPES and _rows(x) <= 32:
+            # decode-shaped call that could not take the Pallas kernel
+            # (SPMD tracing, failed probe): fuse the dequant into the
+            # dot rather than materializing the full bf16 weight
+            return _q_matmul_xla_fused(x, w)
         return _q_matmul_xla(x, w)
     raise ValueError(f"unknown matmul backend {be!r}")
 

@@ -33,9 +33,13 @@ from bigdl_tpu.ops.quant import QTensor, get_qtype
 from bigdl_tpu.ops.codebooks import CODEBOOKS
 
 
+# renamed TPUCompilerParams -> CompilerParams across jax versions
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 # generic grid is (M/bm, N/bn, K/bk): M and N tiles are independent,
 # only the K sweep carries the accumulator
-_GENERIC_SEMANTICS = pltpu.CompilerParams(
+_GENERIC_SEMANTICS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
@@ -315,7 +319,7 @@ def _scale_rows_ok(bk: int, b: int, kp: int) -> bool:
 
 
 def _matmul_tiles(qt, kp: int, n: int, bk_cands,
-                  budget: int = 4 * 1024 * 1024):
+                  budget: int = 4 * 1024 * 1024, bm: int = 16):
     """Largest eligible (bk, bn) streaming tile under the VMEM budget.
 
     Eligibility couples bk to the quant block (bk % block == 0) and to
@@ -324,7 +328,13 @@ def _matmul_tiles(qt, kp: int, n: int, bk_cands,
     ff=11008 (K=2752, an 86-row scale plane, legal only as ONE block)
     halves to 43 rows and falls off the kernel entirely (VERDICT r3 #4).
     So search the whole (bk, bn) grid, shrinking bn before bk, and keep
-    the largest legal product (ties favor the earlier = wider bn)."""
+    the largest legal product (ties favor the earlier = wider bn).
+
+    The budget accounts the M-dependent terms too (x tile bm*bk bf16 +
+    f32 accumulator bm*bn): at decode bm=16 they are noise, but at
+    prefill-class bm=256 they rival the streamed weight tile — ignoring
+    them let a forced all-M run (bench lane `pallas-all-m`) pick tiles
+    whose working set overflowed VMEM at 7B geometry."""
     b = qt.block_size
     best = None
     for bn in (512, 256, 128):
@@ -334,31 +344,44 @@ def _matmul_tiles(qt, kp: int, n: int, bk_cands,
             if not bk or kp % bk or bk % b \
                     or not _scale_rows_ok(bk, b, kp):
                 continue
-            if bk * bn * 3 > budget:
+            if bk * bn * 3 + bm * (2 * bk + 4 * bn) > budget:
                 continue
             if best is None or bk * bn > best[0] * best[1]:
                 best = (bk, bn)
     return best
 
 
-def _gemv_tiles(qt, kp: int, n: int):
+def _gemv_tiles(qt, kp: int, n: int, mp: int = 16):
     # kp itself is always legal (block dims == array dims), VMEM permitting
     return _matmul_tiles(qt, kp, n,
-                         [4096, 2048, 1024, 512, 256, 128, 64, 32, kp])
+                         [4096, 2048, 1024, 512, 256, 128, 64, 32, kp],
+                         bm=mp)
 
 
 _gemv_probe_cache: dict = {}
 
+# decode-GEMV M ceiling: the serving engine's decode batch. One padded
+# sublane tile (mp=16) covers bs<=16; bs 17-32 pads to TWO sublane tiles
+# (mp=32) — the x tile and accumulator double but stay VMEM-noise, and
+# decode remains HBM-bound so the pad FLOPs are free.
+GEMV_MAX_M = 32
+
+
+def _gemv_mp(m: int) -> int:
+    return 16 if m <= 16 else 32
+
 
 def gemv_kernel_compiles(qtype: str, kp: int, n: int,
-                         variant: str = "std") -> bool:
+                         variant: str = "std", m: int = 1) -> bool:
     """Eager per-geometry probe for the decode-GEMV variant (same
     contract as ops/attention._kernel_compiles): compiles the REAL tile
     classes on a stand-in sized (kp, bn) so a Mosaic rejection degrades
     to the generic tiling instead of crashing a jitted decode.
-    `variant`: "std" | "fold" | "mxu" | "mxu8" (see the kernel bodies)."""
+    `variant`: "std" | "fold" | "mxu" | "mxu8" (see the kernel bodies).
+    `m` only selects the padded row class (16 vs 32)."""
     qt = get_qtype(qtype)
-    tiles = _gemv_tiles(qt, kp, n)
+    mp = _gemv_mp(m)
+    tiles = _gemv_tiles(qt, kp, n, mp)
     if tiles is None:
         return False
     from bigdl_tpu.config import flags as _flags
@@ -366,7 +389,7 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int,
     if _flags().aot_target == "tpu":   # AOT lowering: trust the dispatch
         return True
     bk, bn = tiles
-    key = (qtype, kp, bn, bk, variant)
+    key = (qtype, kp, bn, bk, variant, mp)
     hit = _gemv_probe_cache.get(key)
     if hit is not None:
         return hit
@@ -377,9 +400,9 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int,
         # compile-only AOT probe (see ops/probing.py) — safe inside the
         # caller's jit trace, allocates nothing on device
         probe_compile(
-            lambda xx, ww: _q_gemv_pallas(xx, ww, qt, 1, kp, bn, False,
+            lambda xx, ww: _q_gemv_pallas(xx, ww, qt, mp, kp, bn, False,
                                           jnp.bfloat16, variant=variant),
-            jax.ShapeDtypeStruct((1, kp), jnp.bfloat16),
+            jax.ShapeDtypeStruct((mp, kp), jnp.bfloat16),
             quant_struct(kp, bn, qtype, mxu=mxu))
         ok = True
     except Exception as e:
@@ -397,22 +420,73 @@ def gemv_kernel_compiles(qtype: str, kp: int, n: int,
     return ok
 
 
+_matmul_probe_cache: dict = {}
+
+
+def matmul_kernel_compiles(qtype: str, m: int, kp: int, n: int,
+                           mxu: bool = False) -> bool:
+    """Eager per-geometry probe for the GENERIC tiled kernel. The bench
+    lane `pallas-all-m` (matmul_pallas_max_m=4096) crashed the whole
+    lane when a prefill-class tile hit a Mosaic rejection — the generic
+    path had no probe, unlike the GEMV variants and attention. Auto
+    dispatch now consults this so an unhappy geometry degrades to the
+    XLA matmul instead of dying inside a jitted forward. Keyed by the
+    padded bm class, not the raw M."""
+    qt = get_qtype(qtype)
+    bm, mp = _generic_bm(m)
+    tiles = _matmul_tiles(qt, kp, n,
+                          [2048, 1024, 512, 256, 128, 64, 32, kp], bm=bm)
+    if tiles is None:
+        return False
+    from bigdl_tpu.config import flags as _flags
+
+    if _flags().aot_target == "tpu":   # AOT lowering: trust the dispatch
+        return True
+    key = (qtype, bm, kp, n, bool(mxu))
+    hit = _matmul_probe_cache.get(key)
+    if hit is not None:
+        return hit
+    try:
+        from bigdl_tpu.ops.probing import probe_compile, quant_struct
+
+        probe_compile(
+            lambda xx, ww: _q_matmul_generic(xx, ww, qt, bm, kp, n, False,
+                                             jnp.bfloat16),
+            jax.ShapeDtypeStruct((bm, kp), jnp.bfloat16),
+            quant_struct(kp, n, qtype, mxu=mxu))
+        ok = True
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "pallas generic matmul unavailable for (M=%d, K=%d, N=%d, %s)"
+            " — %s: %s; using the XLA matmul", m, kp, n, qtype,
+            type(e).__name__, e)
+        ok = False
+    from bigdl_tpu.ops.probing import record_probe_result
+
+    record_probe_result("matmul_generic", ok)
+    _matmul_probe_cache[key] = ok
+    return ok
+
+
 def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
                    interpret: bool, out_dtype=None, variant: str = "std"):
-    """bs<=16 decode GEMV (the reference's `linear_fp16_esimd` decode
-    GEMV role, low_bit_linear.py:744-745). M pads to one 16-row tile; x
-    [16, K] and the scale column block are VMEM-resident for the whole K
-    sweep, the grid drops the M axis, and bn/bk maximize the streaming
-    tile. FLOP overhead of the pad is irrelevant — decode is HBM-bound.
+    """bs<=GEMV_MAX_M decode GEMV (the reference's `linear_fp16_esimd`
+    decode GEMV role, low_bit_linear.py:744-745). M pads to one 16-row
+    sublane tile (two for bs 17-32); x [mp, K] and the scale column
+    block are VMEM-resident for the whole K sweep, the grid drops the M
+    axis, and bn/bk maximize the streaming tile. FLOP overhead of the
+    pad is irrelevant — decode is HBM-bound.
     `variant`: "std" (unpack + per-weight scale), "fold" (scale-folded
     batched dot over the packed layout), "mxu"/"mxu8" (int4-dtype
     weights; see `_gemv_kernel_mxu`/`_gemv_kernel_mxu8`)."""
-    mp = 16
+    mp = _gemv_mp(m)
     if x2.shape[0] != mp:
         x2 = jax.lax.pad(x2, jnp.zeros((), x2.dtype),
                          ((0, mp - x2.shape[0], 0), (0, 0, 0)))
     b = qt.block_size
-    tiles = _gemv_tiles(qt, kp, n)
+    tiles = _gemv_tiles(qt, kp, n, mp)
     if tiles is None:
         raise NotImplementedError(f"shapes not tileable: K={kp} N={n}")
     bk, bn = tiles
@@ -498,7 +572,7 @@ def _q_gemv_pallas(x2: jax.Array, w: QTensor, qt, m: int, kp: int, n: int,
         # N tiles are independent; only the K sweep carries the
         # accumulator — telling Mosaic lets it software-pipeline the
         # packed-data stream across j boundaries
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )(*operands)
     return y[:m]
@@ -541,9 +615,9 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
         variant = "fold"
     else:
         variant = "std"
-    if m <= 16 and gv != "off" and (
+    if m <= GEMV_MAX_M and gv != "off" and (
             interpret or gemv_kernel_compiles(w.qtype, kp, n,
-                                              variant=variant)):
+                                              variant=variant, m=m)):
         try:
             y = _q_gemv_pallas(x2, w, qt, m, kp, n, interpret,
                                out_dtype=x.dtype, variant=variant)
@@ -551,18 +625,34 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
         except NotImplementedError:
             pass      # fall through to the generic tiling
 
-    # tile selection; pad M up to a bf16-tileable multiple (min sublane 16)
+    y = _q_matmul_generic(x2, w, qt, m, kp, n, interpret, x.dtype)
+    return y.reshape(*batch_shape, n)
+
+
+def _generic_bm(m: int):
+    """Generic-path row tile class: (bm, mp) with mp the padded M."""
     bm = _pick_tile(m, [256, 128, 64, 32, 16])
     if bm:
-        mp = m
-    else:
-        mp = m + ((-m) % 16)
+        return bm, m
+    mp = m + ((-m) % 16)
+    return (_pick_tile(mp, [256, 128, 64, 32, 16]) or mp), mp
+
+
+def _q_matmul_generic(x2: jax.Array, w: QTensor, qt, m: int, kp: int,
+                      n: int, interpret: bool, out_dtype) -> jax.Array:
+    """Generic-tile kernel dispatch: x2 [m, kp] bf16 (already K-padded)
+    against quantized W — grid (M/bm, N/bn, K/bk). Probed per geometry
+    by `matmul_kernel_compiles`."""
+    # pad M up to a bf16-tileable multiple (min sublane 16)
+    bm, mp = _generic_bm(m)
+    if mp != m:
         x2 = jax.lax.pad(x2, jnp.zeros((), x2.dtype),
                          ((0, mp - m, 0), (0, 0, 0)))
-        bm = _pick_tile(mp, [256, 128, 64, 32, 16]) or mp
     # joint (bk, bn) search keeps the working set (data tile + unpacked
-    # w tile + x tile) in VMEM without sacrificing scale-plane legality
-    tiles = _matmul_tiles(qt, kp, n, [2048, 1024, 512, 256, 128, 64, 32, kp])
+    # w tile + x tile + accumulator) in VMEM without sacrificing
+    # scale-plane legality
+    tiles = _matmul_tiles(qt, kp, n,
+                          [2048, 1024, 512, 256, 128, 64, 32, kp], bm=bm)
     if tiles is None:
         raise NotImplementedError(f"shapes not tileable: K={kp} N={n}")
     bk, bn = tiles
@@ -574,7 +664,7 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
     x_spec = pl.BlockSpec((bm, bk), lambda i, j, k: (i, k))
     scale_spec = pl.BlockSpec((bk // b, bn), lambda i, j, k: (k, j))
     out_spec = pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))
-    out_shape = jax.ShapeDtypeStruct((mp, n), x.dtype)
+    out_shape = jax.ShapeDtypeStruct((mp, n), out_dtype)
     scratch = [pltpu.VMEM((bm, bn), jnp.float32)]
 
     if w.data.dtype == jnp.int4:
@@ -639,7 +729,7 @@ def q_matmul_pallas_impl(x: jax.Array, w: QTensor, *,
 
     if mp != m:
         y = y[:m]
-    return y.reshape(*batch_shape, n)
+    return y
 
 
 # public jitted entry (standalone callers, probes, benchmarks); model

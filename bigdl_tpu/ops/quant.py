@@ -1259,3 +1259,50 @@ def tree_from_mxu_layout(tree):
     return jax.tree_util.tree_map(
         lambda x: from_mxu_layout(x) if isinstance(x, QTensor) else x,
         tree, is_leaf=lambda x: isinstance(x, QTensor))
+
+
+def prepack_tree(tree, mode: Optional[str] = None):
+    """One-time load-time weight prepacking: retile every QTensor's
+    code/scale planes into the layout the decode kernels want (today:
+    the int4-dtype MXU layout for sym_int4 — native Mosaic int4 loads
+    instead of the VPU nibble-unpack chain). Applied ONCE at checkpoint
+    load (transformers/model.py); `save_low_bit` always repacks to the
+    canonical split-block interchange format via `tree_from_mxu_layout`.
+
+    `mode`: "auto" (prepack when the compute target is TPU), "on",
+    "off"; defaults to flags().prepack (BIGDL_TPU_PREPACK). Subsumes
+    the older mxu_layout knob — either knob set to "off" disables,
+    and either set to "on" forces the retile even off-TPU (the CPU
+    fallbacks read both layouts, so "on" stays testable anywhere).
+
+    Returns (tree, report): report is a plain-JSON dict (mode, applied,
+    qtensor/converted counts, packed bytes) that the memory ledger and
+    the bench's `prepack` block record, so a failed or skipped retile
+    is visible in every perf artifact instead of silently changing
+    which kernel variant the A/B numbers measured."""
+    from bigdl_tpu.config import flags, resolve_prepack, target_is_tpu
+
+    f = flags()
+    mode = resolve_prepack(mode) if mode is not None else f.prepack
+    report: dict = {"mode": mode, "applied": False,
+                    "qtensors": 0, "converted": 0, "bytes_packed": 0}
+    off = mode == "off" or f.mxu_layout == "off"
+    force = mode == "on" or f.mxu_layout == "on"
+    if off or (not force and not target_is_tpu()):
+        return tree, report
+
+    is_q = lambda x: isinstance(x, QTensor)  # noqa: E731
+
+    def conv(x):
+        if not is_q(x):
+            return x
+        report["qtensors"] += 1
+        y = to_mxu_layout(x)
+        if y.data.dtype != x.data.dtype:
+            report["converted"] += 1
+        report["bytes_packed"] += int(y.nbytes)
+        return y
+
+    tree = jax.tree_util.tree_map(conv, tree, is_leaf=is_q)
+    report["applied"] = report["converted"] > 0
+    return tree, report

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.config import decode_resident_enabled
 from bigdl_tpu.observability.compile_watch import (compiles_in_progress,
                                                    tracked_jit)
 from bigdl_tpu.observability.disttrace import SpanRecorder, new_span_id
@@ -322,6 +323,47 @@ class _Admission:
     chunk: int
 
 
+def _device_sample_rows(lg, temps, top_ks, top_ps, seeds, poss):
+    """Batched on-device sampler body: temperature / top-k / top-p via
+    gumbel-max, one seeded stream per row. Shared by the standalone
+    ``engine_sample_device`` jit and the fused resident decode step so
+    the two paths are numerically identical token-for-token."""
+    lg = lg.astype(jnp.float32)                      # [B, V]
+    v = lg.shape[-1]
+    greedy = temps <= 0.0
+    t = lg / jnp.maximum(temps, 1e-6)[:, None]
+    # top-k: per-row threshold from the sorted copy (k=0 -> all;
+    # greedy rows keep all, their argmax ignores masking anyway)
+    k = jnp.where(greedy | (top_ks <= 0), v, top_ks)
+    sd = -jnp.sort(-t, axis=-1)
+    kth = jnp.take_along_axis(
+        sd, jnp.clip(k - 1, 0, v - 1)[:, None], axis=-1)
+    t = jnp.where(t < kth, -jnp.inf, t)
+    # top-p (nucleus) on the post-top-k distribution: keep the
+    # smallest sorted prefix whose mass reaches p (first always)
+    p = jnp.where(greedy, 1.0, top_ps)[:, None]
+    sd = -jnp.sort(-t, axis=-1)
+    probs = jax.nn.softmax(sd, axis=-1)
+    # p >= 1.0 keeps ALL tokens (matching _sample_host's
+    # `top_p < 1.0` gate): without it, f32 cumsum rounding can
+    # push the pre-token mass to 1.0 and mask real tail tokens
+    # on temperature-only requests
+    keep = ((jnp.cumsum(probs, axis=-1) - probs) < p) | (p >= 1.0)
+    # the top token survives even top_p=0.0 (OpenAI clients send
+    # it to mean greedy; all-False keep would mask every token)
+    keep = keep | (jnp.arange(v)[None, :] == 0)
+    cutoff = jnp.min(jnp.where(keep, sd, jnp.inf), axis=-1)
+    t = jnp.where(t < cutoff[:, None], -jnp.inf, t)
+
+    def row(row_t, row_lg, g, seed, pos):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+        gum = jax.random.gumbel(key, row_t.shape, row_t.dtype)
+        z = jnp.where(g, row_lg, row_t + gum)
+        return jnp.argmax(z).astype(jnp.int32)
+
+    return jax.vmap(row)(t, lg, greedy, seeds, poss)
+
+
 class LLMEngine:
     """Synchronous continuous-batching engine over one model.
 
@@ -524,42 +566,38 @@ class LLMEngine:
         @functools.partial(tracked_jit, "engine_sample_device",
                            registry=self.registry)
         def sample_device(lg, temps, top_ks, top_ps, seeds, poss):
-            lg = lg.astype(jnp.float32)                      # [B, V]
-            v = lg.shape[-1]
-            greedy = temps <= 0.0
-            t = lg / jnp.maximum(temps, 1e-6)[:, None]
-            # top-k: per-row threshold from the sorted copy (k=0 -> all;
-            # greedy rows keep all, their argmax ignores masking anyway)
-            k = jnp.where(greedy | (top_ks <= 0), v, top_ks)
-            sd = -jnp.sort(-t, axis=-1)
-            kth = jnp.take_along_axis(
-                sd, jnp.clip(k - 1, 0, v - 1)[:, None], axis=-1)
-            t = jnp.where(t < kth, -jnp.inf, t)
-            # top-p (nucleus) on the post-top-k distribution: keep the
-            # smallest sorted prefix whose mass reaches p (first always)
-            p = jnp.where(greedy, 1.0, top_ps)[:, None]
-            sd = -jnp.sort(-t, axis=-1)
-            probs = jax.nn.softmax(sd, axis=-1)
-            # p >= 1.0 keeps ALL tokens (matching _sample_host's
-            # `top_p < 1.0` gate): without it, f32 cumsum rounding can
-            # push the pre-token mass to 1.0 and mask real tail tokens
-            # on temperature-only requests
-            keep = ((jnp.cumsum(probs, axis=-1) - probs) < p) | (p >= 1.0)
-            # the top token survives even top_p=0.0 (OpenAI clients send
-            # it to mean greedy; all-False keep would mask every token)
-            keep = keep | (jnp.arange(v)[None, :] == 0)
-            cutoff = jnp.min(jnp.where(keep, sd, jnp.inf), axis=-1)
-            t = jnp.where(t < cutoff[:, None], -jnp.inf, t)
-
-            def row(row_t, row_lg, g, seed, pos):
-                key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
-                gum = jax.random.gumbel(key, row_t.shape, row_t.dtype)
-                z = jnp.where(g, row_lg, row_t + gum)
-                return jnp.argmax(z).astype(jnp.int32)
-
-            return jax.vmap(row)(t, lg, greedy, seeds, poss)
+            return _device_sample_rows(lg, temps, top_ks, top_ps,
+                                       seeds, poss)
 
         self._sample_device = sample_device
+
+        # resident single-dispatch decode step: layer-scanned forward +
+        # per-slot health check + on-device sampling fused into ONE
+        # executable, so a pure-decode engine step costs exactly one
+        # host dispatch (vs decode + health + argmax/sampler = 3). The
+        # greedy branch is the same fused argmax as engine_argmax (so
+        # greedy serving stays byte-identical) and the sampled branch
+        # is the shared _device_sample_rows body (so seeded streams
+        # replay identically whichever path served them). Used by
+        # _step_inner when every active slot is device-samplable and
+        # no fault clauses are live (poison_rows needs the logits on
+        # the host side of the dispatch).
+        @functools.partial(tracked_jit, "engine_decode_resident",
+                           registry=self.registry, donate_argnums=(2,),
+                           static_argnames=("all_greedy",))
+        def decode_resident(params, tokens, cache, temps, top_ks,
+                            top_ps, seeds, poss, *, all_greedy):
+            logits, cache = fwd(params, self.cfg, tokens[:, None], cache)
+            lg = logits[:, -1, :]
+            finite = jnp.isfinite(lg).all(axis=-1)
+            if all_greedy:
+                toks = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            else:
+                toks = _device_sample_rows(lg, temps, top_ks, top_ps,
+                                           seeds, poss)
+            return toks, finite, cache
+
+        self._decode_resident = decode_resident
 
         # prefill one sequence on a private 1-row cache, then splice its K/V
         # (and, for scaled dtypes, the per-token scale planes) and position
@@ -2248,14 +2286,62 @@ class LLMEngine:
         tokens = np.zeros((self.cfg_engine.max_batch,), np.int32)
         for i in active:
             tokens[i] = self.slots[i].last_token
-        logits_dev, self.cache = self._decode(
-            self.params, jnp.asarray(tokens), self.cache)
-        # dispatch vs device split: dispatch-return time is pure host
-        # work (trace + transfer enqueue); the blocked wait on the step
-        # result is device compute — the same two-sided measurement
-        # bench.py uses for tunnel_overhead_ms
-        t_dispatch = time.perf_counter()
-        jax.block_until_ready(logits_dev)  # graftlint: disable=step-host-sync
+
+        def simple(s: _Slot) -> bool:
+            # no penalty counts, no logprobs: the device sampler covers
+            # it (any temperature / top-k / top-p / seed)
+            return s.counts is None and s.n_logprobs < 0
+
+        def gather_params(rows):
+            b = self.cfg_engine.max_batch
+            temps = np.zeros((b,), np.float32)
+            top_ks = np.zeros((b,), np.int32)
+            top_ps = np.ones((b,), np.float32)
+            seeds = np.zeros((b,), np.int32)
+            poss = np.zeros((b,), np.int32)
+            for i in rows:
+                s = self.slots[i]
+                p = s.req.params
+                temps[i] = p.temperature
+                top_ks[i] = p.top_k
+                top_ps[i] = p.top_p
+                seeds[i] = s.dev_seed
+                poss[i] = s.req.generated_offset + len(s.generated)
+            return temps, top_ks, top_ps, seeds, poss
+
+        # resident fast path: when every active slot is device-samplable
+        # and no fault clause is live (poison_rows edits logits on the
+        # host side), forward + health + sampling run as ONE dispatch —
+        # the [B, V] logits never exist outside the executable
+        resident = (decode_resident_enabled()
+                    and not self.faults.enabled
+                    and all(simple(self.slots[i]) for i in active))
+        toks = None
+        finite_host = None
+        logits_dev = None
+        if resident:
+            temps, top_ks, top_ps, seeds, poss = gather_params(active)
+            all_greedy = all(
+                self.slots[i].req.params.temperature <= 0.0
+                for i in active)
+            toks_dev, finite_dev, self.cache = self._decode_resident(
+                self.params, jnp.asarray(tokens), self.cache,
+                jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), jnp.asarray(seeds),
+                jnp.asarray(poss), all_greedy=all_greedy)
+            # dispatch vs device split: dispatch-return time is pure
+            # host work (trace + transfer enqueue); the blocked wait on
+            # the step result is device compute — the same two-sided
+            # measurement bench.py uses for tunnel_overhead_ms
+            t_dispatch = time.perf_counter()
+            jax.block_until_ready(toks_dev)  # graftlint: disable=step-host-sync
+            toks = np.asarray(toks_dev)
+            finite_host = np.asarray(finite_dev)
+        else:
+            logits_dev, self.cache = self._decode(
+                self.params, jnp.asarray(tokens), self.cache)
+            t_dispatch = time.perf_counter()
+            jax.block_until_ready(logits_dev)  # graftlint: disable=step-host-sync
         dispatch_s = t_dispatch - t_decode0
         device_s = time.perf_counter() - t_dispatch
         self._m_step_phase.labels("dispatch").observe(dispatch_s)
@@ -2263,16 +2349,19 @@ class LLMEngine:
 
         # fault injection: poison selected rows with NaN AFTER the
         # decode — other rows' values are untouched, so healthy
-        # neighbors stay byte-identical to a fault-free run
-        bad = self.faults.poison_rows(self._step_idx, active)
-        if bad:
-            logits_dev = logits_dev.at[jnp.asarray(bad)].set(jnp.nan)
+        # neighbors stay byte-identical to a fault-free run (the
+        # resident path is gated off whenever fault clauses exist)
+        if not resident:
+            bad = self.faults.poison_rows(self._step_idx, active)
+            if bad:
+                logits_dev = logits_dev.at[jnp.asarray(bad)].set(jnp.nan)
 
         # per-slot logits health check: a NaN/Inf row fails ONE request
         # (quarantine, structured error) while the rest of the batch
         # keeps decoding — blast-radius isolation for numeric blowups
         if ce.logits_health_check:
-            finite = np.asarray(self._health(logits_dev))
+            finite = (finite_host if finite_host is not None
+                      else np.asarray(self._health(logits_dev)))
             sick = [i for i in active if not bool(finite[i])]
             if sick:
                 for i in sick:
@@ -2284,35 +2373,19 @@ class LLMEngine:
                 self._update_gauges()
                 return True
 
-        def simple(s: _Slot) -> bool:
-            # no penalty counts, no logprobs: the device sampler covers
-            # it (any temperature / top-k / top-p / seed)
-            return s.counts is None and s.n_logprobs < 0
-
         simple_rows = [i for i in active if simple(self.slots[i])]
         complex_rows = [i for i in active if not simple(self.slots[i])]
-        toks = None
-        if simple_rows and all(
+        if resident:
+            pass          # tokens already sampled inside the fused step
+        elif simple_rows and all(
                 self.slots[i].req.params.temperature <= 0.0
                 for i in simple_rows):
             # all-greedy fast path: one fused argmax, no sampling-param
             # transfers (the default-traffic hot path)
             toks = np.asarray(self._argmax(logits_dev))
         elif simple_rows:
-            b = self.cfg_engine.max_batch
-            temps = np.zeros((b,), np.float32)
-            top_ks = np.zeros((b,), np.int32)
-            top_ps = np.ones((b,), np.float32)
-            seeds = np.zeros((b,), np.int32)
-            poss = np.zeros((b,), np.int32)
-            for i in simple_rows:
-                s = self.slots[i]
-                p = s.req.params
-                temps[i] = p.temperature
-                top_ks[i] = p.top_k
-                top_ps[i] = p.top_p
-                seeds[i] = s.dev_seed
-                poss[i] = s.req.generated_offset + len(s.generated)
+            temps, top_ks, top_ps, seeds, poss = gather_params(
+                simple_rows)
             # runs for EVERY batch containing a simple slot (not only
             # all-simple ones): a seeded request must sample from the
             # same stream whether or not a penalties/logprobs request

@@ -50,19 +50,21 @@ def _resolve_hub_path(path: str, model_hub: str) -> str:
     return path
 
 
-def _maybe_mxu_layout(params: Any) -> Any:
-    """Re-layout sym_int4 weights to the int4-dtype MXU form when the
-    compute target is TPU (flags().mxu_layout: auto/on/off). One cheap
-    pass at load time; the decode GEMV then loads int4 natively instead
-    of burning the VPU on nibble unpacking (see ops/pallas/dequant_
-    matmul._gemv_kernel_mxu). save_low_bit repacks to canonical."""
-    from bigdl_tpu.config import flags, target_is_tpu
-    from bigdl_tpu.ops.quant import tree_to_mxu_layout
+def _prepack(params: Any):
+    """Load-time weight prepacking (ops/quant.prepack_tree): retile
+    QTensor planes into the decode kernels' layout once, at load. The
+    decode GEMV then loads int4 natively instead of burning the VPU on
+    nibble unpacking (see ops/pallas/dequant_matmul._gemv_kernel_mxu).
+    save_low_bit repacks to canonical. Returns (params, report)."""
+    from bigdl_tpu.ops.quant import prepack_tree
 
-    mode = flags().mxu_layout
-    if mode == "off" or (mode == "auto" and not target_is_tpu()):
-        return params
-    return tree_to_mxu_layout(params)
+    return prepack_tree(params)
+
+
+def _maybe_mxu_layout(params: Any) -> Any:
+    """Back-compat shim over `_prepack` (report dropped) — the prepack
+    flag subsumes the older mxu_layout knob."""
+    return _prepack(params)[0]
 
 
 def _maybe_merge(params: Any, cfg: Any, family: FamilyAdapter,
@@ -103,7 +105,7 @@ class TpuCausalLM:
     ):
         from bigdl_tpu.ops.kvcache import resolve_kv_cache_dtype
 
-        self.params = _maybe_mxu_layout(params)
+        self.params, self.prepack_report = _prepack(params)
         self.config = cfg
         self.family = family
         self.hf_config = hf_config
@@ -126,6 +128,12 @@ class TpuCausalLM:
                 "weights", "causal_lm", tree_nbytes(self.params),
                 qtype=qtype, family=getattr(family, "name",
                                             type(family).__name__))
+            if self.prepack_report.get("qtensors"):
+                default_ledger().register(
+                    "weights", "prepack",
+                    self.prepack_report.get("bytes_packed", 0),
+                    **{k: v for k, v in self.prepack_report.items()
+                       if k != "bytes_packed"})
         except Exception:
             pass
 

@@ -28,6 +28,7 @@ KNOWN_ENV = (
     "BIGDL_TPU_BROWNOUT_LOW",
     "BIGDL_TPU_COMPILE_CACHE",
     "BIGDL_TPU_COMPILE_MEMORY",
+    "BIGDL_TPU_DECODE_RESIDENT",
     "BIGDL_TPU_DISABLE_NATIVE",
     "BIGDL_TPU_DRAIN_TIMEOUT_SEC",
     "BIGDL_TPU_EVENT_LOG",
@@ -50,6 +51,7 @@ KNOWN_ENV = (
     "BIGDL_TPU_MXU_LAYOUT",
     "BIGDL_TPU_NATIVE_CACHE",
     "BIGDL_TPU_POSTMORTEM_DIR",
+    "BIGDL_TPU_PREPACK",
     "BIGDL_TPU_QOS_AGING_SEC",
     "BIGDL_TPU_QOS_DEFAULT",
     "BIGDL_TPU_QUANTIZE_KV_CACHE",
@@ -234,6 +236,26 @@ def collect() -> dict:
                 "value": kvd, "valid": False,
                 "choices": sorted(KV_CACHE_DTYPES)}
 
+    # decode fast-path tristates (config.py from_env falls back to
+    # "auto" on a bad value; surface the typo here instead): resident
+    # single-dispatch decode and load-time weight prepack
+    tristate_knobs = (
+        ("decode_resident", "BIGDL_TPU_DECODE_RESIDENT",
+         "resolve_decode_resident"),
+        ("prepack", "BIGDL_TPU_PREPACK", "resolve_prepack"),
+    )
+    for key, envname, fname in tristate_knobs:
+        raw = os.environ.get(envname)
+        if not raw:
+            continue
+        from bigdl_tpu import config as _config
+
+        try:
+            info[key] = {"value": getattr(_config, fname)(raw),
+                         "valid": True}
+        except ValueError as e:
+            info[key] = {"value": raw, "valid": False, "error": str(e)}
+
     # fault-injection spec: a typo'd spec silently injecting nothing
     # would make a chaos run vacuously green — fail the check instead
     fs = os.environ.get("BIGDL_TPU_FAULT_SPEC")
@@ -392,6 +414,8 @@ def main() -> int:
           and info.get("recompile_warn", {}).get("valid", True)
           and info.get("hbm_budget_fraction", {}).get("valid", True)
           and info.get("memory_poll_sec", {}).get("valid", True)
+          and info.get("decode_resident", {}).get("valid", True)
+          and info.get("prepack", {}).get("valid", True)
           and info.get("fault_spec", {}).get("valid", True)
           and info.get("request_deadline_ms", {}).get("valid", True)
           and info.get("drain_timeout_sec", {}).get("valid", True)

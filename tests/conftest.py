@@ -28,6 +28,14 @@ if "xla_force_host_platform_device_count" not in flags:
 # (tests/test_memory_ledger.py sets BIGDL_TPU_COMPILE_MEMORY=1).
 os.environ.setdefault("BIGDL_TPU_COMPILE_MEMORY", "0")
 
+# The AOT suite builds offline TPU topologies via libtpu, which by default
+# queries the GCE metadata server for worker identity. Off-GCE (or when the
+# metadata service answers 403) that is 30 retries per variable — minutes
+# of wall stall per pytest process before the query gives up and AOT
+# lowering proceeds identically. Nothing in the CPU suite runs on a real
+# TPU worker, so skip the query outright.
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+
 import jax  # noqa: E402
 
 # Belt and braces: if jax was already imported by a pytest plugin before this

@@ -1,0 +1,19 @@
+"""Seeded bug: one jit dispatch per loop iteration on the step path."""
+
+from bigdl_tpu.observability.compile_watch import tracked_jit
+
+
+def _decode_one(weights, tok):
+    return tok
+
+
+class MiniEngine:
+    def __init__(self):
+        self._decode = tracked_jit("fx_decode", _decode_one)
+
+    def step(self, weights, toks):
+        out = []
+        for t in toks:
+            out.append(self._decode(weights, t))    # one launch PER TOKEN
+        batched = self._decode(weights, toks)       # single dispatch: ok
+        return out, batched

@@ -84,6 +84,7 @@ def test_rule_catalog_covers_findings():
     for rule in ("jax-raw-jit", "jax-host-sync-in-jit",
                  "jax-nondet-in-jit", "jax-missing-donate",
                  "jax-scalar-signature", "step-host-sync",
+                 "jax-dispatch-in-decode-loop",
                  "lock-guarded-unlocked", "lock-order-inversion"):
         assert rule in RULES
 
@@ -148,6 +149,27 @@ def test_step_path_needs_entry():
     # without the step_entries override the fixture is not an engine
     result = _scan("fx_step_sync.py")
     assert not any(f.rule == "step-host-sync" for f in result.findings)
+
+
+def test_detects_dispatch_in_decode_loop():
+    rel = "tests/fixtures/graftlint/fx_dispatch_loop.py"
+    result = _scan("fx_dispatch_loop.py",
+                   step_entries={rel: ("MiniEngine", "step")})
+    hits = [f for f in result.findings
+            if f.rule == "jax-dispatch-in-decode-loop"]
+    assert len(hits) == 1, result.findings
+    assert hits[0].obj == "MiniEngine.step"
+    assert "fx_decode" in hits[0].message
+    assert "launch per" in hits[0].message
+    # the single batched dispatch after the loop stays silent
+    assert "PER TOKEN" in hits[0].snippet
+
+
+def test_dispatch_loop_needs_entry():
+    # outside a step-path entry the looped dispatch is not flagged
+    result = _scan("fx_dispatch_loop.py")
+    assert not any(f.rule == "jax-dispatch-in-decode-loop"
+                   for f in result.findings)
 
 
 # ---------------------------------------------------------------------------

@@ -125,9 +125,13 @@ DISPATCH_METRICS = {
 # the HBM-bandwidth roofline utilization of the decode step is the
 # tentpole serving efficiency number: it gets a RATCHET — its own
 # (tighter) --max-roofline-regress-pct threshold, higher-is-better,
-# instead of riding the generic --threshold
+# instead of riding the generic --threshold. decode_mfu rides the same
+# ratchet: the fused decode path moves both together (one dispatch,
+# same bytes), so a run that holds roofline but drops MFU is hiding a
+# compute regression behind the bandwidth number
 ROOFLINE_METRICS = {
     "decode_hbm_roofline_util": "higher",
+    "decode_mfu": "higher",
 }
 
 
@@ -205,7 +209,11 @@ def flatten_metrics(rec: dict, prefix: str = "",
                         and not isinstance(mv, bool):
                     out[f"{name}.{mk}"] = (float(mv), direction)
         elif isinstance(val, dict) and depth < 3 \
-                and key not in ("observability", "jit_compile_table"):
+                and key not in ("observability", "jit_compile_table",
+                                "prepack"):
+            # "prepack" is the load-time weight-prepack report (mode,
+            # counts, one-time transform ms) — informational, never a
+            # per-token metric, so it stays out of the comparison
             flatten_metrics(val, f"{name}.", out, depth + 1)
     return out
 
@@ -266,8 +274,8 @@ def main(argv=None) -> int:
     ap.add_argument("--max-roofline-regress-pct", type=float,
                     default=2.0,
                     help="ratchet threshold for "
-                         "decode_hbm_roofline_util (default 2; "
-                         "higher-is-better)")
+                         "decode_hbm_roofline_util and decode_mfu "
+                         "(default 2; higher-is-better)")
     ap.add_argument("--max-dispatch-regress-pct", type=float,
                     default=2.0,
                     help="ratchet threshold for dispatch_overhead_ms "
