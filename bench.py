@@ -362,22 +362,16 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     }
 
 
-def chip_peaks() -> tuple:
-    """(peak_bf16_tflops, peak_hbm_gbps) — v5e datasheet defaults,
-    env-overridable for other chips. One definition for the floors, the
-    efficiency block, and bench_qlora."""
-    return (float(os.environ.get("BIGDL_TPU_PEAK_BF16_TFLOPS", "197")),
-            float(os.environ.get("BIGDL_TPU_PEAK_HBM_GBPS", "819")))
-
-
-def model_flops_per_token(cfg) -> int:
-    """Forward matmul FLOPs per token (qkvo + gated mlp + lm_head; no
-    attention-over-cache term). Shared by the physics floors, the
-    efficiency block, and bench_qlora so the cost model cannot drift."""
-    d, ff, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
-    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
-    proj = 2 * (d * h * hd + 2 * d * hkv * hd + h * hd * d)
-    return cfg.num_hidden_layers * (proj + 2 * 3 * d * ff) + 2 * d * v
+# single-sourced roofline math (bigdl_tpu/observability/roofline.py):
+# the same functions drive the physics floors below, the efficiency
+# block, bench_qlora/bench_serving/bench_speculative AND the serving
+# engine's live bigdl_tpu_roofline_util gauges —
+# tests/test_perf_observability.py asserts bench output is
+# value-identical to the model on the r05 fixture numbers, so the
+# offline bench and the live gauges cannot silently drift
+from bigdl_tpu.observability.roofline import (  # noqa: E402
+    chip_peaks, model_flops_per_token)
+from bigdl_tpu.observability import roofline as _roofline  # noqa: E402
 
 
 def _floors(cfg, weight_bytes: int, prompt_len: int) -> tuple:
@@ -903,8 +897,10 @@ def main(kv_sweep: "list[str] | None" = None) -> None:
         record["fastest_config"] = fastest
         record["fastest_next_token_ms"] = round(
             ok[fastest]["next_token_ms"], 3)
-    record.update(_efficiency(LLAMA2_7B, ok[best]["weight_bytes"],
-                              PROMPT_LEN, DECODE_STEPS, first_ms, next_ms))
+    record.update(_roofline_block(
+        LLAMA2_7B, ok[best]["weight_bytes"], PROMPT_LEN, DECODE_STEPS,
+        first_ms, next_ms,
+        kv_cache_dtype=ok[best].get("kv_cache_dtype", "bf16")))
     print(json.dumps(record))
     _failed_lane_exit(ab_results)
 
@@ -940,39 +936,27 @@ def _efficiency(cfg, weight_bytes: int, prompt_len: int, steps: int,
     packed weight set plus the live KV slice, so the honest efficiency
     number is bytes-moved / (latency x peak-BW). Prefill is compute-bound,
     so its number is model FLOPs / (latency x peak-FLOPs) — classic MFU.
-    Chip peaks are v5e datasheet values, overridable for other chips.
+    The math lives in observability/roofline.py (value-identical to
+    what it always printed here — the identity test pins the r05
+    fixture numbers), shared with the engine's live gauges.
     `weight_bytes` is measured from the live param pytree in the config
     subprocess and passed through."""
-    peak_tflops, peak_gbps = chip_peaks()
+    return _roofline.efficiency(cfg, weight_bytes, prompt_len, steps,
+                                first_ms, next_ms)
 
-    l_ = cfg.num_hidden_layers
-    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
-    flops_tok = model_flops_per_token(cfg)
-    # attention FLOPs per token at cache length S: 2 matmuls over S keys
-    s_mid = prompt_len + steps // 2
-    attn_tok = l_ * 2 * 2 * h * hd * s_mid
 
-    # bytes read per decode token: all packed weights + live KV slice
-    kv_elt_bytes = 2  # bf16 cache
-    kv_bytes = 2 * l_ * s_mid * hkv * hd * kv_elt_bytes
-    ideal_decode_ms = (weight_bytes + kv_bytes) / (peak_gbps * 1e9) * 1e3
-
-    # prefill MFU over the whole prompt
-    prefill_flops = prompt_len * flops_tok + l_ * 2 * 2 * h * hd * (
-        prompt_len * prompt_len // 2)
-    prefill_mfu = prefill_flops / (first_ms / 1e3) / (peak_tflops * 1e12)
-
-    decode_mfu = (flops_tok + attn_tok) / (next_ms / 1e3) / (
-        peak_tflops * 1e12)
-    return {
-        "decode_hbm_roofline_util": round(ideal_decode_ms / next_ms, 4),
-        "decode_ideal_ms": round(ideal_decode_ms, 6),
-        "decode_mfu": round(decode_mfu, 5),
-        "prefill_mfu": round(prefill_mfu, 4),
-        "weight_bytes": int(weight_bytes),
-        "peak_bf16_tflops": peak_tflops,
-        "peak_hbm_gbps": peak_gbps,
-    }
+def _roofline_block(cfg, weight_bytes: int, prompt_len: int, steps: int,
+                    first_ms: float, next_ms: float,
+                    kv_cache_dtype: str = "bf16") -> dict:
+    """The headline record's efficiency numbers plus the per-phase
+    roofline attribution block (analytical FLOPs / HBM bytes / ideal ms
+    next to the measured ms) — both from observability/roofline.py."""
+    out = _efficiency(cfg, weight_bytes, prompt_len, steps,
+                      first_ms, next_ms)
+    out["roofline"] = _roofline.attribution(
+        cfg, weight_bytes, prompt_len, steps, first_ms, next_ms,
+        kv_cache_dtype=kv_cache_dtype)
+    return out
 
 
 def _parse_kv_sweep(argv: "list[str]") -> "list[str] | None":

@@ -52,6 +52,9 @@ RULES: Dict[str, str] = {
     "jax-scalar-signature":
         "unbounded Python scalar (len()/arithmetic) in a static jit "
         "position: one compile per distinct value",
+    "jax-unsynced-timing":
+        "time.* delta bracketing a jit dispatch with no "
+        "block_until_ready fence (measures enqueue, not compute)",
     "step-host-sync":
         "per-element or looped host-device pull on the engine step "
         "path (pull once, index in numpy)",

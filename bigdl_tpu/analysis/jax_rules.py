@@ -575,6 +575,168 @@ class _JitCallScan(ast.NodeVisitor):
 
 
 # ---------------------------------------------------------------------------
+# unsynced timing
+
+#: module-level sync fences: any of these forces the device to finish
+#: (or pulls the result to host) before the timer is read again
+_FENCE_DOTS = {"jax.block_until_ready", "jax.device_get",
+               "jax.effects_barrier"}
+
+
+class _UnsyncedTiming(ast.NodeVisitor):
+    """time.* delta bracketing a jit dispatch with no sync fence.
+
+    JAX dispatch is asynchronous: ``fn(x)`` returns as soon as the work
+    is enqueued, so ``time.perf_counter() - t0`` around an unfenced jit
+    call measures trace+enqueue overhead, not device compute.  The scan
+    is a per-function, statement-ordered state machine: assigning a
+    ``time.<fn>()`` result arms a timer, a call resolving through the
+    module's jit bindings marks every armed timer dispatch-pending, a
+    sync fence (block_until_ready / device_get / np.asarray / .item())
+    clears the pending bit, and an ``a - b`` read of a still-pending
+    timer is a finding.  Branches are scanned sequentially (lenient: a
+    fence on either arm clears the state).
+    """
+
+    def __init__(self, module: Module,
+                 bindings: Dict[Tuple[str, str], JitSite],
+                 out: List[Finding]):
+        self.m = module
+        self.bindings = bindings
+        self.out = out
+        self._ctx: List[str] = []
+
+    # -- scope bookkeeping ---------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._ctx.append(node.name)
+        self.generic_visit(node)
+        self._ctx.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._ctx.append(node.name)
+        timers: Dict[str, bool] = {}    # timer var -> dispatch pending
+        for stmt in node.body:
+            self._scan_stmt(stmt, timers)
+        self.generic_visit(node)        # nested defs get fresh state
+        self._ctx.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- statement walk ------------------------------------------------
+
+    def _scan_stmt(self, stmt: ast.stmt,
+                   timers: Dict[str, bool]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                      # separate scope, own timers
+        if isinstance(stmt, ast.If):
+            self._scan_expr(stmt.test, timers)
+            for s in stmt.body:
+                self._scan_stmt(s, timers)
+            for s in stmt.orelse:
+                self._scan_stmt(s, timers)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(stmt.iter, timers)
+            for s in stmt.body:
+                self._scan_stmt(s, timers)
+            for s in stmt.orelse:
+                self._scan_stmt(s, timers)
+            return
+        if isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test, timers)
+            for s in stmt.body:
+                self._scan_stmt(s, timers)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, timers)
+            for s in stmt.body:
+                self._scan_stmt(s, timers)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._scan_stmt(s, timers)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._scan_stmt(s, timers)
+            for s in stmt.orelse:
+                self._scan_stmt(s, timers)
+            for s in stmt.finalbody:
+                self._scan_stmt(s, timers)
+            return
+        self._scan_expr(stmt, timers)
+
+    def _scan_expr(self, node: ast.AST,
+                   timers: Dict[str, bool]) -> None:
+        calls = [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+        # dispatch BEFORE fence: np.asarray(self._decode(...)) both
+        # dispatches and syncs in one statement — the fence wins
+        if any(self._is_dispatch(c) for c in calls):
+            for k in timers:
+                timers[k] = True
+        if any(self._is_fence(c) for c in calls):
+            for k in timers:
+                timers[k] = False
+        for n in ast.walk(node):
+            if not (isinstance(n, ast.BinOp)
+                    and isinstance(n.op, ast.Sub)):
+                continue
+            for side in (n.left, n.right):
+                if isinstance(side, ast.Name) and timers.get(side.id):
+                    self.out.append(Finding(
+                        "jax-unsynced-timing", self.m.rel, n.lineno,
+                        ".".join(self._ctx) or "<module>",
+                        f"timing delta reads {side.id!r} across a jit "
+                        "dispatch with no block_until_ready fence: "
+                        "the call returns when work is ENQUEUED, so "
+                        "this measures dispatch overhead, not device "
+                        "compute — block_until_ready the result "
+                        "before reading the clock",
+                        self.m.snippet(n.lineno)))
+                    timers.pop(side.id, None)   # one finding per timer
+                    break
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and self._is_time_call(node.value):
+            timers[node.targets[0].id] = False
+
+    # -- classifiers ---------------------------------------------------
+
+    def _is_time_call(self, call: ast.Call) -> bool:
+        d = _dotted(call.func)
+        return bool(d) and "." in d and d.split(".")[0] == "time" \
+            and d.split(".")[-1] in _TIME_FUNCS
+
+    def _is_dispatch(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return ("", f.id) in self.bindings
+        if isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name):
+            return (f.value.id, f.attr) in self.bindings
+        return False
+
+    @staticmethod
+    def _is_fence(call: ast.Call) -> bool:
+        f = call.func
+        d = _dotted(f)
+        if d in _FENCE_DOTS:
+            return True
+        if isinstance(f, ast.Attribute):
+            if f.attr in _SYNC_METHODS:
+                return True
+            if d is not None:
+                parts = d.split(".")
+                if parts[0] in ("np", "numpy") \
+                        and parts[-1] in _PULL_FUNCS:
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
 # engine step path
 
 
@@ -842,6 +1004,7 @@ def check(modules: Iterable[Module],
                 _walk_traced(site, m, out)
                 _check_donate(site, m, out)
         _JitCallScan(m, scan.bindings, out).visit(m.tree)
+        _UnsyncedTiming(m, scan.bindings, out).visit(m.tree)
 
         for sfx, (cls, entry) in entries.items():
             if m.rel.endswith(sfx):

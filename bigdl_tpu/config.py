@@ -65,6 +65,10 @@ class RuntimeFlags:
     # sampling, no fault hooks), "on" (same gate, assert-style intent),
     # "off" (legacy multi-dispatch step)
     decode_resident: str = "auto"
+    # perf-regression sentinel (observability/sentinel.py): "auto"/"on"
+    # watch the decode EWMAs against the rolling baseline, "off" skip
+    # sentinel construction entirely (zero per-step overhead)
+    sentinel: str = "auto"
     # host-side C++ kernels (bigdl_tpu.native); disable to force pure JAX
     disable_native: bool = False
     native_cache_dir: Optional[str] = None
@@ -101,6 +105,8 @@ class RuntimeFlags:
             decode_resident=_tristate_env(
                 "BIGDL_TPU_DECODE_RESIDENT",
                 lambda s: resolve_decode_resident(s)),
+            sentinel=_tristate_env("BIGDL_TPU_SENTINEL",
+                                   lambda s: resolve_sentinel(s)),
             disable_native=_env_bool("BIGDL_TPU_DISABLE_NATIVE"),
             native_cache_dir=os.environ.get("BIGDL_TPU_NATIVE_CACHE"),
             kv_cache_dtype=os.environ.get(
@@ -146,6 +152,23 @@ def resolve_decode_resident(spec) -> str:
             f"unknown decode_resident mode {spec!r}; "
             f"choose from {_TRISTATE}")
     return s
+
+
+def resolve_sentinel(spec) -> str:
+    """Normalize a BIGDL_TPU_SENTINEL spec to "auto" | "on" | "off"."""
+    s = str(spec).strip().lower() if spec is not None else "auto"
+    s = {"1": "on", "true": "on", "0": "off", "false": "off",
+         "": "auto"}.get(s, s)
+    if s not in _TRISTATE:
+        raise ValueError(
+            f"unknown sentinel mode {spec!r}; choose from {_TRISTATE}")
+    return s
+
+
+def sentinel_enabled() -> bool:
+    """Effective perf-sentinel switch: "off" disables, "on"/"auto"
+    enable (the sentinel's own warmup/baseline logic handles the rest)."""
+    return flags().sentinel != "off"
 
 
 def decode_resident_enabled() -> bool:

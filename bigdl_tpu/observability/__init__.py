@@ -40,6 +40,18 @@ standard library — tests/test_observability.py enforces it):
   ``admit_deferred`` flight event, and ``GET /v1/memory``).
   ``memory_report()`` rolls the snapshot plus the compile table's peak
   temp bytes into the bench JSON records.
+- ``roofline``: the analytical FLOPs / HBM-bytes cost model (single
+  source for ``bench.py``'s efficiency block, the engine's live
+  ``bigdl_tpu_roofline_util{phase}`` / ``decode_ideal_ms`` gauges and
+  compile_watch's per-jit cost annotation). Chip peaks come from
+  ``$BIGDL_TPU_PEAK_BF16_TFLOPS`` / ``$BIGDL_TPU_PEAK_HBM_GBPS``
+  (v5e datasheet defaults).
+- ``sentinel``: ``PerfSentinel`` — dwell-gated perf-regression
+  detection over decode ms/token, roofline util and dispatch overhead
+  EWMAs vs a rolling baseline persisted at ``$BIGDL_TPU_PERF_HISTORY``
+  (size-rotated like the event log); trips emit ``perf_regression``
+  flight events + postmortems + a bounded profiler auto-capture, then
+  recover with hysteresis.
 - ``flight``: ``FlightRecorder`` ring buffer of per-step engine events
   plus postmortem dumps — on engine-step exception, stall-guard trip,
   or SIGTERM/SIGINT a single JSON (flight tail, span tail, metrics
@@ -122,9 +134,11 @@ fraction of ``bytes_limit``, float in (0, 1], default 0.9),
 
 from bigdl_tpu.observability.compile_watch import (
     TrackedJit,
+    annotate_costs,
     compile_table,
     reset_compile_table,
     resolve_recompile_threshold,
+    top_offenders,
     tracked_jit,
 )
 from bigdl_tpu.observability.flight import (
@@ -170,6 +184,27 @@ from bigdl_tpu.observability.tracing import (
     rotate_event_log,
     validate_event_log_path,
 )
+from bigdl_tpu.observability.roofline import (
+    attn_flops_per_token,
+    chip_peaks,
+    decode_costs,
+    jit_costs,
+    kv_bytes_per_token,
+    model_flops_per_token,
+    prefill_costs,
+)
+from bigdl_tpu.observability.roofline import (
+    attribution as roofline_attribution,
+    efficiency as roofline_efficiency,
+)
+from bigdl_tpu.observability.sentinel import (
+    PerfSentinel,
+    resolve_perf_history,
+    resolve_sentinel_recover_steps,
+    resolve_sentinel_threshold,
+    resolve_sentinel_trip_steps,
+    validate_perf_history_path,
+)
 
 __all__ = [
     "LATENCY_BUCKETS_S",
@@ -193,6 +228,8 @@ __all__ = [
     "trace_sampled",
     "TrackedJit",
     "tracked_jit",
+    "annotate_costs",
+    "top_offenders",
     "compile_table",
     "reset_compile_table",
     "resolve_recompile_threshold",
@@ -210,4 +247,19 @@ __all__ = [
     "install_signal_dumps",
     "validate_postmortem_dir",
     "write_postmortem",
+    "attn_flops_per_token",
+    "chip_peaks",
+    "decode_costs",
+    "jit_costs",
+    "kv_bytes_per_token",
+    "model_flops_per_token",
+    "prefill_costs",
+    "roofline_attribution",
+    "roofline_efficiency",
+    "PerfSentinel",
+    "resolve_perf_history",
+    "resolve_sentinel_recover_steps",
+    "resolve_sentinel_threshold",
+    "resolve_sentinel_trip_steps",
+    "validate_perf_history_path",
 ]

@@ -268,7 +268,10 @@ class TrackedJit:
         with _CompileInFlight():
             t0 = time.perf_counter()
             out = self._jitted(*args, **kwargs)
-            dt = time.perf_counter() - t0
+            # dispatch-return time on a FIRST call is dominated by the
+            # synchronous trace+compile — that is exactly what the
+            # compile table records, so no device fence here
+            dt = time.perf_counter() - t0  # graftlint: disable=jax-unsynced-timing
             with self._seen_lock:
                 self._seen.add(sig)
             # the AOT memory_analysis below compiles the signature a
@@ -435,7 +438,46 @@ def compile_table() -> Dict[str, Dict[str, Any]]:
                     {k: (dict(v) if isinstance(v, dict) else v)
                      for k, v in s.items()} for s in ent["signatures"]],
             }
+            for key in ("analytical_flops", "analytical_hbm_bytes"):
+                if key in ent:
+                    out[name][key] = ent[key]
         return out
+
+
+def annotate_costs(name: str, flops: Optional[float] = None,
+                   hbm_bytes: Optional[float] = None) -> None:
+    """Attach analytical roofline costs (observability/roofline.py
+    ``jit_costs``) to a tracked_jit's table entry, so the compile table
+    carries bytes-moved/FLOPs next to compile counts. Creates the entry
+    when the jit has not compiled yet (costs are known at engine build,
+    compiles happen lazily)."""
+    with _lock:
+        ent = _table.setdefault(name, {
+            "compiles": 0, "total_s": 0.0, "signatures": [],
+            "last_compile_ts": 0.0, "storm": False,
+            "peak_temp_bytes": 0})
+        if flops is not None:
+            ent["analytical_flops"] = float(flops)
+        if hbm_bytes is not None:
+            ent["analytical_hbm_bytes"] = float(hbm_bytes)
+
+
+def top_offenders(limit: int = 8) -> list:
+    """Tracked jits ranked by analytical HBM bytes moved (descending) —
+    the roofline view of "which executable is the bandwidth bill".
+    Entries without cost annotation rank last (by compile time)."""
+    table = compile_table()
+    rows = []
+    for name, ent in table.items():
+        rows.append({
+            "name": name,
+            "analytical_hbm_bytes": ent.get("analytical_hbm_bytes", 0.0),
+            "analytical_flops": ent.get("analytical_flops", 0.0),
+            "compiles": ent["compiles"],
+            "total_s": ent["total_s"],
+        })
+    rows.sort(key=lambda r: (-r["analytical_hbm_bytes"], -r["total_s"]))
+    return rows[:max(0, int(limit))]
 
 
 def reset_compile_table() -> None:

@@ -1,0 +1,237 @@
+"""Analytical roofline cost model — the single source of truth for
+FLOPs / HBM-bytes math shared by ``bench.py`` (offline efficiency
+block), the serving engine (live ``bigdl_tpu_roofline_util{phase}`` /
+``decode_ideal_ms`` gauges), compile_watch (per-jit cost annotation)
+and the perf-regression sentinel.
+
+Decode on one chip is HBM-bandwidth-bound: every token reads the whole
+packed weight set plus the live KV slice, so the honest efficiency
+number is bytes-moved / (latency x peak-BW). Prefill is compute-bound,
+so its number is model FLOPs / (latency x peak-FLOPs) — classic MFU.
+Chip peaks are v5e datasheet values, env-overridable for other chips.
+
+Import contract: **stdlib only** (``tests/test_observability.py``
+enforces that importing ``bigdl_tpu.observability`` pulls in no heavy
+deps). Model configs are duck-typed: anything with ``hidden_size``,
+``intermediate_size``, ``vocab_size``, ``num_attention_heads``,
+``num_key_value_heads``, ``hd`` and ``num_hidden_layers`` works
+(LlamaConfig does).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "KV_ELT_BYTES",
+    "attn_flops_per_token",
+    "attribution",
+    "chip_peaks",
+    "decode_costs",
+    "efficiency",
+    "jit_costs",
+    "kv_bytes_per_token",
+    "model_flops_per_token",
+    "prefill_costs",
+]
+
+# logical storage bytes per KV element (int4 packs two codes per byte);
+# scaled dtypes additionally carry fp32 scale planes, accounted in
+# kv_bytes_per_token. Mirrors ops/kvcache.py KV_CACHE_DTYPES without
+# importing jax.
+KV_ELT_BYTES: Dict[str, float] = {
+    "bf16": 2.0,
+    "fp8_e5m2": 1.0,
+    "int8": 1.0,
+    "int4": 0.5,
+}
+_SCALED_KV_DTYPES = ("int8", "int4")
+_SCALE_ELT_BYTES = 4.0  # fp32 scale per (token, head) plane
+
+
+def chip_peaks() -> Tuple[float, float]:
+    """(peak_bf16_tflops, peak_hbm_gbps) — v5e datasheet defaults,
+    env-overridable for other chips. One definition for the bench
+    floors, the efficiency block, bench_qlora and the live gauges."""
+    return (float(os.environ.get("BIGDL_TPU_PEAK_BF16_TFLOPS", "197")),
+            float(os.environ.get("BIGDL_TPU_PEAK_HBM_GBPS", "819")))
+
+
+def model_flops_per_token(cfg) -> int:
+    """Forward matmul FLOPs per token (qkvo + gated mlp + lm_head; no
+    attention-over-cache term). Shared by the physics floors, the
+    efficiency block and bench_qlora so the cost model cannot drift."""
+    d, ff, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    proj = 2 * (d * h * hd + 2 * d * hkv * hd + h * hd * d)
+    return cfg.num_hidden_layers * (proj + 2 * 3 * d * ff) + 2 * d * v
+
+
+def attn_flops_per_token(cfg, seq_len: int) -> int:
+    """Attention-over-cache FLOPs for one decoded token at cache length
+    ``seq_len``: two matmuls (QK^T and PV) over ``seq_len`` keys."""
+    h, hd = cfg.num_attention_heads, cfg.hd
+    return cfg.num_hidden_layers * 2 * 2 * h * hd * seq_len
+
+
+def kv_bytes_per_token(cfg, seq_len: int,
+                       kv_cache_dtype: str = "bf16") -> float:
+    """Live KV bytes read for one decoded token at cache length
+    ``seq_len``: K and V planes across all layers, plus fp32 scale
+    planes for block-scaled dtypes."""
+    elt = KV_ELT_BYTES.get(kv_cache_dtype)
+    if elt is None:
+        raise ValueError(
+            f"unknown kv_cache_dtype {kv_cache_dtype!r}; choose from "
+            f"{sorted(KV_ELT_BYTES)}")
+    l_, hkv, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                   cfg.hd)
+    bytes_ = 2.0 * l_ * seq_len * hkv * hd * elt
+    if kv_cache_dtype in _SCALED_KV_DTYPES:
+        bytes_ += 2.0 * l_ * seq_len * hkv * _SCALE_ELT_BYTES
+    return bytes_
+
+
+def decode_costs(cfg, weight_bytes: int, seq_len: int,
+                 kv_cache_dtype: str = "bf16",
+                 batch: int = 1) -> Dict[str, float]:
+    """Analytical cost of one decode step at cache length ``seq_len``:
+
+    - ``flops``: matmul + attention-over-cache FLOPs (per batch row)
+    - ``hbm_bytes``: packed weights read once for the whole batch, plus
+      the live KV slice per row
+    - ``ideal_ms``: bandwidth-bound floor for the step at peak HBM BW
+    """
+    _, peak_gbps = chip_peaks()
+    flops = float(batch) * (model_flops_per_token(cfg)
+                            + attn_flops_per_token(cfg, seq_len))
+    hbm_bytes = float(weight_bytes) + float(batch) * kv_bytes_per_token(
+        cfg, seq_len, kv_cache_dtype)
+    ideal_ms = hbm_bytes / (peak_gbps * 1e9) * 1e3
+    return {"flops": flops, "hbm_bytes": hbm_bytes, "ideal_ms": ideal_ms}
+
+
+def prefill_costs(cfg, prompt_len: int,
+                  batch: int = 1) -> Dict[str, float]:
+    """Analytical cost of prefilling ``prompt_len`` tokens: per-token
+    matmul FLOPs plus the causal-attention triangle (same
+    ``prompt_len**2 // 2`` accounting as the bench efficiency block)."""
+    l_ = cfg.num_hidden_layers
+    h, hd = cfg.num_attention_heads, cfg.hd
+    flops = float(batch) * (
+        prompt_len * model_flops_per_token(cfg)
+        + l_ * 2 * 2 * h * hd * (prompt_len * prompt_len // 2))
+    return {"flops": flops}
+
+
+def efficiency(cfg, weight_bytes: int, prompt_len: int, steps: int,
+               first_ms: float, next_ms: float) -> dict:
+    """MFU + HBM-roofline utilization (VERDICT r2 #2) — the exact
+    numbers ``bench.py`` prints in every headline record (it imports
+    this; ``tests/test_perf_observability.py`` asserts identity on the
+    r05 fixture so bench and live gauges cannot drift).
+
+    ``weight_bytes`` is measured from the live param pytree in the
+    config subprocess and passed through. The KV term deliberately
+    keeps the bench's bf16-cache accounting (the headline lane decodes
+    against a bf16 cache) — kv-dtype-aware live gauges go through
+    :func:`decode_costs` instead."""
+    peak_tflops, peak_gbps = chip_peaks()
+
+    l_ = cfg.num_hidden_layers
+    h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
+    flops_tok = model_flops_per_token(cfg)
+    # attention FLOPs per token at cache length S: 2 matmuls over S keys
+    s_mid = prompt_len + steps // 2
+    attn_tok = l_ * 2 * 2 * h * hd * s_mid
+
+    # bytes read per decode token: all packed weights + live KV slice
+    kv_elt_bytes = 2  # bf16 cache
+    kv_bytes = 2 * l_ * s_mid * hkv * hd * kv_elt_bytes
+    ideal_decode_ms = (weight_bytes + kv_bytes) / (peak_gbps * 1e9) * 1e3
+
+    # prefill MFU over the whole prompt
+    prefill_flops = prompt_len * flops_tok + l_ * 2 * 2 * h * hd * (
+        prompt_len * prompt_len // 2)
+    prefill_mfu = prefill_flops / (first_ms / 1e3) / (peak_tflops * 1e12)
+
+    decode_mfu = (flops_tok + attn_tok) / (next_ms / 1e3) / (
+        peak_tflops * 1e12)
+    return {
+        "decode_hbm_roofline_util": round(ideal_decode_ms / next_ms, 4),
+        "decode_ideal_ms": round(ideal_decode_ms, 6),
+        "decode_mfu": round(decode_mfu, 5),
+        "prefill_mfu": round(prefill_mfu, 4),
+        "weight_bytes": int(weight_bytes),
+        "peak_bf16_tflops": peak_tflops,
+        "peak_hbm_gbps": peak_gbps,
+    }
+
+
+def attribution(cfg, weight_bytes: int, prompt_len: int, steps: int,
+                first_ms: float, next_ms: float,
+                kv_cache_dtype: str = "bf16") -> dict:
+    """Per-phase roofline attribution block embedded in bench JSON:
+    analytical FLOPs / HBM bytes / ideal ms next to the measured ms, so
+    a bench record carries *why* a phase is slow, not just that it is."""
+    peak_tflops, peak_gbps = chip_peaks()
+    s_mid = prompt_len + steps // 2
+    dec = decode_costs(cfg, weight_bytes, s_mid, kv_cache_dtype)
+    pre = prefill_costs(cfg, prompt_len)
+    prefill_ideal_ms = pre["flops"] / (peak_tflops * 1e12) * 1e3
+    return {
+        "prefill": {
+            "flops": int(pre["flops"]),
+            "ideal_ms": round(prefill_ideal_ms, 6),
+            "measured_ms": round(first_ms, 3),
+            "mfu": round(pre["flops"] / (first_ms / 1e3)
+                         / (peak_tflops * 1e12), 4),
+        },
+        "decode": {
+            "flops": int(dec["flops"]),
+            "hbm_bytes": int(dec["hbm_bytes"]),
+            "ideal_ms": round(dec["ideal_ms"], 6),
+            "measured_ms": round(next_ms, 3),
+            "hbm_roofline_util": round(dec["ideal_ms"] / next_ms, 4),
+        },
+        "kv_cache_dtype": kv_cache_dtype,
+        "peak_bf16_tflops": peak_tflops,
+        "peak_hbm_gbps": peak_gbps,
+    }
+
+
+def jit_costs(cfg, weight_bytes: int, max_batch: int, max_seq: int,
+              prefill_bucket: int,
+              kv_cache_dtype: str = "bf16") -> Dict[str, Dict[str, float]]:
+    """Analytical {flops, hbm_bytes} per tracked_jit name, for
+    compile_watch cost annotation (the "top offenders" view ranks jits
+    by bytes moved). Worst-case shapes: decode at full cache, prefill
+    at one bucket."""
+    dec = decode_costs(cfg, weight_bytes, max_seq, kv_cache_dtype,
+                       batch=max_batch)
+    pre = prefill_costs(cfg, prefill_bucket)
+    kv_full = float(max_batch) * kv_bytes_per_token(
+        cfg, max_seq, kv_cache_dtype)
+    costs: Dict[str, Dict[str, float]] = {
+        "engine_decode": {"flops": dec["flops"],
+                          "hbm_bytes": dec["hbm_bytes"]},
+        "engine_decode_resident": {"flops": dec["flops"],
+                                   "hbm_bytes": dec["hbm_bytes"]},
+        "engine_prefill": {"flops": pre["flops"],
+                           "hbm_bytes": float(weight_bytes)},
+        # insert touches one row's KV planes; argmax/sample/health are
+        # O(vocab) epsilon next to a forward pass
+        "engine_insert": {"flops": 0.0,
+                          "hbm_bytes": kv_full / max(max_batch, 1)},
+        "engine_argmax": {
+            "flops": float(max_batch * cfg.vocab_size),
+            "hbm_bytes": float(2 * max_batch * cfg.vocab_size)},
+        "engine_sample_device": {
+            "flops": float(max_batch * cfg.vocab_size),
+            "hbm_bytes": float(2 * max_batch * cfg.vocab_size)},
+        "engine_health": {
+            "flops": float(max_batch * cfg.vocab_size),
+            "hbm_bytes": float(2 * max_batch * cfg.vocab_size)},
+    }
+    return costs

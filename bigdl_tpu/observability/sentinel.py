@@ -1,0 +1,362 @@
+"""Perf-regression sentinel: EWMAs of the serving hot-path health
+numbers (decode ms/token, HBM-roofline utilization, dispatch overhead)
+checked each working step against a persisted rolling baseline.
+
+The engine feeds :meth:`PerfSentinel.observe` once per decoding step;
+the sentinel keeps 0.8/0.2 EWMAs, compares them against a baseline
+loaded from the perf-history JSONL (``BIGDL_TPU_PERF_HISTORY``,
+size-rotated exactly like the event log) or — on a fresh deploy —
+established from the first ``warmup_steps`` steps, and reports:
+
+- ``"trip"`` after ``trip_steps`` *consecutive* steps past threshold
+  (the engine then emits the ``perf_regression`` flight event +
+  postmortem + counter and starts the bounded profiler auto-capture)
+- ``"recover"`` after ``recover_steps`` consecutive healthy steps
+  while tripped — the same dwell/hysteresis shape as the overload
+  brownout governor, so a boundary-hugging workload cannot flap.
+
+Stdlib-only (imports only sibling ``tracing`` rotation helpers);
+``tests/test_observability.py`` enforces the package contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .tracing import (
+    resolve_event_log_keep,
+    resolve_event_log_max_bytes,
+    rotate_event_log,
+)
+
+__all__ = [
+    "PerfSentinel",
+    "resolve_perf_history",
+    "resolve_sentinel_recover_steps",
+    "resolve_sentinel_threshold",
+    "resolve_sentinel_trip_steps",
+    "validate_perf_history_path",
+]
+
+# the three watched signals. Direction: for decode/dispatch ms a value
+# ABOVE baseline*(1+threshold) is bad; for roofline util a value BELOW
+# baseline*(1-threshold) is bad (util falling = drifting off the roof).
+METRICS = ("decode_ms", "roofline_util", "dispatch_ms")
+_HIGHER_IS_BAD = {"decode_ms": True, "roofline_util": False,
+                  "dispatch_ms": True}
+
+_EWMA_DECAY = 0.8  # same 0.8/0.2 blend as the engine's tpot/dispatch EWMAs
+_HISTORY_EVERY = 64        # append a baseline sample every N healthy steps
+_HISTORY_TAIL = 32         # baseline = median over the last N records
+
+
+def resolve_sentinel_threshold(value=None) -> float:
+    """Relative drift that counts as "past threshold": explicit value,
+    else ``$BIGDL_TPU_SENTINEL_THRESHOLD``, else 0.5 (a metric must be
+    50% worse than baseline). ValueError on a non-positive or
+    non-numeric setting (utils/env_check.py surfaces this)."""
+    if value is None:
+        value = os.environ.get("BIGDL_TPU_SENTINEL_THRESHOLD")
+    if value is None or value == "":
+        return 0.5
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"sentinel threshold must be a positive number, got "
+            f"{value!r}")
+    if f <= 0:
+        raise ValueError(
+            f"sentinel threshold must be a positive number, got {f}")
+    return f
+
+
+def _resolve_steps(value, env_name: str, default: int, what: str) -> int:
+    if value is None:
+        value = os.environ.get(env_name)
+    if value is None or value == "":
+        return default
+    try:
+        n = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{what} must be a positive integer, got {value!r}")
+    if n <= 0:
+        raise ValueError(f"{what} must be a positive integer, got {n}")
+    return n
+
+
+def resolve_sentinel_trip_steps(value=None) -> int:
+    """Consecutive past-threshold steps before the sentinel trips:
+    explicit value, else ``$BIGDL_TPU_SENTINEL_TRIP_STEPS``, else 5."""
+    return _resolve_steps(value, "BIGDL_TPU_SENTINEL_TRIP_STEPS", 5,
+                          "sentinel trip steps")
+
+
+def resolve_sentinel_recover_steps(value=None) -> int:
+    """Consecutive healthy steps before a tripped sentinel recovers
+    (hysteresis dwell): explicit value, else
+    ``$BIGDL_TPU_SENTINEL_RECOVER_STEPS``, else 10."""
+    return _resolve_steps(value, "BIGDL_TPU_SENTINEL_RECOVER_STEPS", 10,
+                          "sentinel recover steps")
+
+
+def resolve_perf_history(value=None) -> Optional[str]:
+    """Perf-history JSONL path: explicit value, else
+    ``$BIGDL_TPU_PERF_HISTORY``, else None (in-memory baseline only)."""
+    if value is None:
+        value = os.environ.get("BIGDL_TPU_PERF_HISTORY")
+    if value is None or value == "":
+        return None
+    return value
+
+
+def validate_perf_history_path(path: str) -> dict:
+    """Report whether `path` is usable as the perf-history sink
+    (utils/env_check.py surfaces this for BIGDL_TPU_PERF_HISTORY).
+    Same shape as tracing.validate_event_log_path."""
+    out = {"path": path}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    if not os.path.isdir(d):
+        out["writable"] = False
+        out["error"] = f"directory {d!r} does not exist"
+    elif os.path.exists(path) and not os.access(path, os.W_OK):
+        out["writable"] = False
+        out["error"] = f"{path!r} exists and is not writable"
+    elif not os.path.exists(path) and not os.access(d, os.W_OK):
+        out["writable"] = False
+        out["error"] = f"directory {d!r} is not writable"
+    else:
+        out["writable"] = True
+    return out
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if n % 2:
+        return s[n // 2]
+    return 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+class PerfSentinel:
+    """Dwell-gated regression detector over the serving perf EWMAs.
+
+    Thread-safety: ``observe``/``snapshot`` take an internal lock (the
+    engine calls observe from its worker thread; HTTP handler threads
+    snapshot it for ``/v1/perf``). Trip/recover callbacks run inline in
+    the observing thread and must not raise (the engine's handlers are
+    postmortem-grade: they swallow their own errors)."""
+
+    def __init__(self,
+                 threshold: Optional[float] = None,
+                 trip_steps: Optional[int] = None,
+                 recover_steps: Optional[int] = None,
+                 history_path: Optional[str] = None,
+                 warmup_steps: int = 16,
+                 on_trip: Optional[Callable[[dict], None]] = None,
+                 on_recover: Optional[Callable[[dict], None]] = None):
+        self.threshold = resolve_sentinel_threshold(threshold)
+        self.trip_steps = resolve_sentinel_trip_steps(trip_steps)
+        self.recover_steps = resolve_sentinel_recover_steps(recover_steps)
+        self.history_path = (history_path if history_path is not None
+                             else resolve_perf_history())
+        self.warmup_steps = max(1, int(warmup_steps))
+        self.on_trip = on_trip
+        self.on_recover = on_recover
+        self._lock = threading.Lock()
+        self._ewma: Dict[str, Optional[float]] = {m: None for m in METRICS}
+        self._baseline: Dict[str, float] = {}
+        self._steps = 0
+        self._bad_streak = 0
+        self._good_streak = 0
+        self._tripped = False
+        self._tripped_metrics: List[str] = []
+        self._trips = 0
+        self._recoveries = 0
+        self._last_trip_ts: Optional[float] = None
+        self._since_history = 0
+        self._history_error: Optional[str] = None
+        if self.history_path:
+            self._baseline = self._load_baseline(self.history_path)
+
+    # -- baseline persistence ---------------------------------------------
+
+    def _load_baseline(self, path: str) -> Dict[str, float]:
+        """Median over the history tail — robust to the occasional
+        recorded outlier. Unreadable/corrupt history degrades to an
+        empty baseline (established live after warmup) rather than
+        failing engine construction."""
+        records: List[dict] = []
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        doc = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(doc, dict):
+                        records.append(doc)
+        except FileNotFoundError:
+            return {}  # first run: no history yet is the normal state
+        except OSError as e:
+            self._history_error = str(e)
+            return {}
+        records = records[-_HISTORY_TAIL:]
+        base: Dict[str, float] = {}
+        for m in METRICS:
+            vals = [float(r[m]) for r in records
+                    if isinstance(r.get(m), (int, float))
+                    and float(r[m]) > 0]
+            if vals:
+                base[m] = _median(vals)
+        return base
+
+    def _append_history(self) -> None:
+        """One JSONL baseline sample, size-rotated like the event log.
+        Best-effort: a full disk must never take down the decode loop."""
+        path = self.history_path
+        if not path:
+            return
+        doc = {"ts": time.time()}
+        for m in METRICS:
+            # called with _lock held (observe's locked section)
+            if self._ewma[m] is not None:  # graftlint: disable=lock-guarded-unlocked
+                doc[m] = round(self._ewma[m], 6)  # graftlint: disable=lock-guarded-unlocked
+        line = json.dumps(doc, separators=(",", ":")) + "\n"
+        try:
+            max_bytes = resolve_event_log_max_bytes()
+            keep = resolve_event_log_keep()
+        except ValueError:
+            max_bytes, keep = None, 1
+        try:
+            if (max_bytes is not None and os.path.exists(path)
+                    and os.path.getsize(path) + len(line) > max_bytes):
+                rotate_event_log(path, keep)
+            with open(path, "a", encoding="utf-8") as fh:
+                fh.write(line)
+            self._history_error = None
+        except OSError as e:
+            self._history_error = str(e)
+
+    # -- the step hook ----------------------------------------------------
+
+    def observe(self, decode_ms: Optional[float] = None,
+                roofline_util: Optional[float] = None,
+                dispatch_ms: Optional[float] = None) -> Optional[str]:
+        """Fold one step's numbers in; returns ``"trip"`` /
+        ``"recover"`` on a state transition, else None."""
+        sample = {"decode_ms": decode_ms, "roofline_util": roofline_util,
+                  "dispatch_ms": dispatch_ms}
+        transition = None
+        info = None
+        with self._lock:
+            self._steps += 1
+            for m, v in sample.items():
+                if v is None:
+                    continue
+                prev = self._ewma[m]
+                self._ewma[m] = (v if prev is None
+                                 else _EWMA_DECAY * prev
+                                 + (1.0 - _EWMA_DECAY) * v)
+            if not self._baseline and self._steps >= self.warmup_steps:
+                self._baseline = {m: v for m, v in self._ewma.items()
+                                  if v is not None and v > 0}
+            bad = self._bad_metrics()
+            if bad:
+                self._bad_streak += 1
+                self._good_streak = 0
+            else:
+                self._good_streak += 1
+                self._bad_streak = 0
+            if (not self._tripped and bad
+                    and self._bad_streak >= self.trip_steps):
+                self._tripped = True
+                self._tripped_metrics = bad
+                self._trips += 1
+                self._last_trip_ts = time.time()
+                transition = "trip"
+                info = self._info_locked(bad)
+            elif (self._tripped
+                    and self._good_streak >= self.recover_steps):
+                self._tripped = False
+                recovered = self._tripped_metrics
+                self._tripped_metrics = []
+                self._recoveries += 1
+                transition = "recover"
+                info = self._info_locked(recovered)
+            if not self._tripped and not bad:
+                self._since_history += 1
+                if self._since_history >= _HISTORY_EVERY:
+                    self._since_history = 0
+                    self._append_history()
+        if transition == "trip" and self.on_trip is not None:
+            self.on_trip(info)
+        elif transition == "recover" and self.on_recover is not None:
+            self.on_recover(info)
+        return transition
+
+    def _bad_metrics(self) -> List[str]:
+        # called with _lock held (observe's locked section)
+        bad = []
+        for m in METRICS:
+            cur, base = self._ewma[m], self._baseline.get(m)  # graftlint: disable=lock-guarded-unlocked
+            if cur is None or base is None or base <= 0:
+                continue
+            if _HIGHER_IS_BAD[m]:
+                if cur > base * (1.0 + self.threshold):
+                    bad.append(m)
+            elif cur < base * (1.0 - self.threshold):
+                bad.append(m)
+        return bad
+
+    def _info_locked(self, metrics: List[str]) -> dict:
+        # "_locked" suffix = caller holds _lock (observe / snapshot)
+        return {
+            "metrics": list(metrics),
+            "ewma": {m: (round(v, 6) if v is not None else None)
+                     for m, v in self._ewma.items()},  # graftlint: disable=lock-guarded-unlocked
+            "baseline": {m: round(v, 6)
+                         for m, v in self._baseline.items()},  # graftlint: disable=lock-guarded-unlocked
+            "threshold": self.threshold,
+            "steps": self._steps,  # graftlint: disable=lock-guarded-unlocked
+        }
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def tripped(self) -> bool:
+        with self._lock:
+            return self._tripped
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "tripped": self._tripped,
+                "tripped_metrics": list(self._tripped_metrics),
+                "trips": self._trips,
+                "recoveries": self._recoveries,
+                "bad_streak": self._bad_streak,
+                "good_streak": self._good_streak,
+                "threshold": self.threshold,
+                "trip_steps": self.trip_steps,
+                "recover_steps": self.recover_steps,
+                "steps": self._steps,
+                "ewma": {m: (round(v, 6) if v is not None else None)
+                         for m, v in self._ewma.items()},
+                "baseline": {m: round(v, 6)
+                             for m, v in self._baseline.items()},
+                "history_path": self.history_path,
+            }
+            if self._last_trip_ts is not None:
+                out["last_trip_ts"] = self._last_trip_ts
+            if self._history_error is not None:
+                out["history_error"] = self._history_error
+            return out

@@ -828,6 +828,13 @@ class OpenAIServer:
                     # $BIGDL_TPU_POSTMORTEM_DIR, served live
                     self._json(200, _jsonable(
                         server.engine.postmortem("on_demand")))
+                elif self.path == "/v1/perf":
+                    # live roofline attribution + sentinel state
+                    # (engine.perf_snapshot); the router's
+                    # /v1/admin/profiler and /v1/router/stats aggregate
+                    # this per replica
+                    self._json(200, _jsonable(
+                        server.engine.perf_snapshot()))
                 elif self.path == "/v1/profiler/status":
                     from bigdl_tpu.utils import profiling
 
@@ -889,11 +896,14 @@ class OpenAIServer:
                         if not log_dir:
                             return self._json(
                                 400, {"error": "'log_dir' required"})
-                        out = profiling.start_profiler(log_dir)
+                        out = profiling.start_profiler(
+                            log_dir,
+                            max_sec=body.get("duration_sec"),
+                            capture_id=body.get("capture_id"))
                     else:
                         out = profiling.stop_profiler()
                 except RuntimeError as e:
-                    # double-start / stop-without-start
+                    # double-start / stop-without-start / dir over cap
                     return self._json(409, {"error": str(e)})
                 self._json(200, out)
 

@@ -33,6 +33,7 @@ import collections
 import dataclasses
 import functools
 import math
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -41,9 +42,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.config import decode_resident_enabled
-from bigdl_tpu.observability.compile_watch import (compiles_in_progress,
+from bigdl_tpu.config import decode_resident_enabled, sentinel_enabled
+from bigdl_tpu.observability import roofline
+from bigdl_tpu.observability.compile_watch import (annotate_costs,
+                                                   compiles_in_progress,
+                                                   top_offenders,
                                                    tracked_jit)
+from bigdl_tpu.observability.sentinel import PerfSentinel
 from bigdl_tpu.observability.disttrace import SpanRecorder, new_span_id
 from bigdl_tpu.observability.flight import (FlightRecorder, build_postmortem,
                                             exception_fields)
@@ -245,6 +250,13 @@ class EngineConfig:
     # queue byte caps, brownout thresholds); None resolves every knob
     # from its $BIGDL_TPU_* env variable (serving/overload.py)
     overload: Optional[OverloadConfig] = None
+    # perf-regression sentinel (observability/sentinel.py): None defers
+    # to config.sentinel_enabled() ($BIGDL_TPU_SENTINEL tristate);
+    # True/False force it per engine (tests)
+    sentinel: Optional[bool] = None
+    # perf-history JSONL path the sentinel baselines against; None
+    # defers to $BIGDL_TPU_PERF_HISTORY (unset = in-memory baseline)
+    perf_history: Optional[str] = None
 
 
 class _Slot:
@@ -775,8 +787,9 @@ class LLMEngine:
         # static ledger entries: params (packed, QTensor/int4-aware) and
         # the batched KV cache; per-slot bytes drive the admission cost
         kvb = kv_cache_bytes(self.cache)
+        self._weight_bytes = tree_nbytes(self.params)
         self.ledger.register(
-            "weights", "engine_params", tree_nbytes(self.params),
+            "weights", "engine_params", self._weight_bytes,
             family=getattr(self.family, "name",
                            type(self.family).__name__))
         self.ledger.register(
@@ -785,6 +798,55 @@ class LLMEngine:
             scales=kvb["scales"], slots=B)
         self._kv_bytes_per_slot = kvb["total"] // B
         self.ledger.publish(m)
+
+        # -- live roofline attribution + perf-regression sentinel
+        # (observability/roofline.py + sentinel.py). The decode gauge is
+        # the bench decode_hbm_roofline_util formula evaluated each
+        # working step from the measured step wall time; tests assert
+        # 4-decimal agreement with bench.py's offline math.
+        self._m_roofline = m.gauge(
+            "bigdl_tpu_roofline_util",
+            "Live roofline utilization per phase: decode is "
+            "bandwidth-bound (ideal bytes-ms over measured ms), "
+            "prefill is compute-bound (MFU).", labelnames=("phase",))
+        for ph in ("decode", "prefill"):    # render from scrape 1
+            self._m_roofline.labels(ph)
+        self._m_decode_ideal = m.gauge(
+            "bigdl_tpu_decode_ideal_ms",
+            "Bandwidth-bound floor for the current decode step "
+            "(weights + live KV over peak HBM GB/s).")
+        self._m_perf_regress = m.counter(
+            "bigdl_tpu_perf_regression_total",
+            "Sentinel trips by regressed metric "
+            "(tools/bench_diff.py gates this at 0).",
+            labelnames=("metric",))
+        from bigdl_tpu.observability.sentinel import METRICS as \
+            _SENTINEL_METRICS
+        for mt in _SENTINEL_METRICS:        # render from scrape 1
+            self._m_perf_regress.labels(mt)
+        self._last_perf: Optional[dict] = None     # last decode step
+        self._last_prefill_perf: Optional[dict] = None
+        self._pending_perf: Optional[Tuple[int, int]] = None
+        self._auto_capture_dir: Optional[str] = None
+        use_sentinel = (ce.sentinel if ce.sentinel is not None
+                        else sentinel_enabled())
+        self.sentinel: Optional[PerfSentinel] = None
+        if use_sentinel:
+            self.sentinel = PerfSentinel(
+                history_path=ce.perf_history,
+                on_trip=self._on_perf_trip,
+                on_recover=self._on_perf_recover)
+        # annotate the compile table with analytical per-jit costs so
+        # compile_table()/top_offenders() rank jits by bytes moved
+        try:
+            for name, c in roofline.jit_costs(
+                    self.cfg, self._weight_bytes, B, ce.max_seq,
+                    ce.prefill_bucket, self.kv_cache_dtype).items():
+                annotate_costs(name, flops=c["flops"],
+                               hbm_bytes=c["hbm_bytes"])
+        except Exception:
+            pass    # cost annotation is telemetry, never load-bearing
+
         self.flight.record(
             "engine_init", max_batch=B, max_seq=ce.max_seq,
             kv_cache_dtype=self.kv_cache_dtype,
@@ -1582,6 +1644,7 @@ class LLMEngine:
             pf = max(now - span.t_admitted, 0.0)
             self._m_phase.labels("prefill").observe(pf)
             self._m_step_phase.labels("prefill").observe(pf)
+            self._obs_prefill_perf(span.prompt_len, pf)
             if (span.trace_id is not None and just_first
                     and span.t_enqueued is not None):
                 self.spans.record(
@@ -1689,6 +1752,25 @@ class LLMEngine:
             "engine_steps": self._step_idx,
             "dispatch_overhead_ms": round(
                 self._dispatch_ewma * 1000.0, 3),
+            # compact live-perf subset for the router's poll loop; the
+            # full attribution lives at GET /v1/perf
+            "perf": {
+                "roofline_util_decode": (
+                    self._last_perf["roofline_util"]
+                    if self._last_perf else None),
+                "decode_ideal_ms": (
+                    self._last_perf["decode_ideal_ms"]
+                    if self._last_perf else None),
+                "roofline_mfu_prefill": (
+                    self._last_prefill_perf["mfu"]
+                    if self._last_prefill_perf else None),
+                "sentinel_tripped": (
+                    self.sentinel.tripped
+                    if self.sentinel is not None else None),
+                "sentinel_trips": (
+                    self.sentinel.snapshot()["trips"]
+                    if self.sentinel is not None else 0),
+            },
             "metrics": self.registry.summary(),
             "requests": self.tracer.snapshot(),
             "compile_table": compile_table(),
@@ -1709,6 +1791,139 @@ class LLMEngine:
                     for s in self.slots
                     if s.active and s.req.crashes > 0},
             },
+        }
+
+    # -- live roofline + perf-regression sentinel ---------------------------
+
+    def _perf_observe(self, wall_s: float, n_active: int,
+                      seq_len: int) -> None:
+        """Fold one decode step into the live roofline gauges and the
+        sentinel. Called from step() with the FULL step wall time; cost
+        is a handful of float ops + three gauge sets (the fastpath
+        dispatch-count test asserts it adds no device dispatches)."""
+        decode_ms = wall_s * 1e3
+        if decode_ms <= 0:
+            return
+        costs = roofline.decode_costs(
+            self.cfg, self._weight_bytes, seq_len,
+            self.kv_cache_dtype, batch=n_active)
+        ideal_ms = costs["ideal_ms"]
+        hbm_bytes = costs["hbm_bytes"]
+        flops = costs["flops"]
+        util = round(ideal_ms / decode_ms, 4)
+        self._m_roofline.labels("decode").set(util)
+        self._m_decode_ideal.set(round(ideal_ms, 6))
+        self._last_perf = {
+            "decode_ms": round(decode_ms, 3),
+            "decode_ideal_ms": round(ideal_ms, 6),
+            "roofline_util": util,
+            "hbm_bytes": int(hbm_bytes),
+            "flops": int(flops),
+            "seq_len": seq_len,
+            "batch": n_active,
+            "step": self._step_idx,
+        }
+        if self.sentinel is not None:
+            self.sentinel.observe(
+                decode_ms=decode_ms, roofline_util=util,
+                dispatch_ms=self._dispatch_ewma * 1e3)
+
+    def _obs_prefill_perf(self, prompt_len: int, prefill_s: float) -> None:
+        """Prefill-side roofline gauge (MFU), fed from the admission
+        observability hook."""
+        if prefill_s <= 0 or prompt_len <= 0:
+            return
+        peak_tflops, _ = roofline.chip_peaks()
+        flops = roofline.prefill_costs(self.cfg, prompt_len)["flops"]
+        mfu = round(flops / prefill_s / (peak_tflops * 1e12), 4)
+        self._m_roofline.labels("prefill").set(mfu)
+        self._last_prefill_perf = {
+            "prompt_len": prompt_len,
+            "prefill_ms": round(prefill_s * 1e3, 3),
+            "mfu": mfu,
+            "flops": int(flops),
+        }
+
+    def _on_perf_trip(self, info: dict) -> None:
+        """Sentinel tripped: counter + flight event + postmortem + a
+        bounded profiler auto-capture into the postmortem dir, all
+        best-effort (a perf regression must never become an outage)."""
+        try:
+            for mt in info.get("metrics", ()):
+                self._m_perf_regress.labels(mt).inc()
+            self.flight.record(
+                "perf_regression", step=self._step_idx,
+                metrics=list(info.get("metrics", ())),
+                ewma=info.get("ewma"), baseline=info.get("baseline"),
+                threshold=info.get("threshold"))
+            self.write_postmortem("perf_regression")
+            self._start_auto_capture(info)
+        except Exception:
+            pass
+
+    def _on_perf_recover(self, info: dict) -> None:
+        try:
+            self.flight.record(
+                "perf_recovered", step=self._step_idx,
+                metrics=list(info.get("metrics", ())),
+                ewma=info.get("ewma"), baseline=info.get("baseline"))
+            self._auto_capture_dir = None
+        except Exception:
+            pass
+
+    def _start_auto_capture(self, info: dict) -> None:
+        """Bounded jax.profiler capture at the moment of the slowdown:
+        at most BIGDL_TPU_PROFILER_MAX_SEC into a per-trip subdir of
+        the postmortem dir (skipped when no dir is configured or a
+        capture is already live), annotated onto any live traces."""
+        from bigdl_tpu.utils.profiling import start_profiler
+
+        base = os.environ.get("BIGDL_TPU_POSTMORTEM_DIR")
+        if not base:
+            return
+        cap_dir = os.path.abspath(os.path.join(
+            base, f"perf_capture_step{self._step_idx}"))
+        try:
+            out = start_profiler(cap_dir,
+                                 capture_id=f"perf-{self._step_idx}")
+        except Exception:
+            return      # capture live elsewhere, bad env, profiler err
+        self._auto_capture_dir = cap_dir
+        self.flight.record(
+            "perf_auto_capture", step=self._step_idx,
+            log_dir=cap_dir, max_sec=out.get("max_sec"))
+        # stitch the capture onto live traces: one span per distinct
+        # trace id among active slots, so the fleet timeline shows
+        # WHERE the profiler evidence lives
+        now = time.time()
+        for s in self.slots:
+            if s.active and s.req is not None and s.req.trace is not None:
+                self.spans.record(
+                    "perf_auto_capture", s.req.trace[0],
+                    t_start=now, t_end=now, step=self._step_idx,
+                    request_id=s.req.request_id, log_dir=cap_dir,
+                    metrics=list(info.get("metrics", ())))
+
+    def perf_snapshot(self) -> dict:
+        """JSON-ready live-performance view for ``GET /v1/perf``:
+        per-phase roofline attribution, the sentinel state, and the
+        compile table's top offenders by analytical bytes moved."""
+        peak_tflops, peak_gbps = roofline.chip_peaks()
+        return {
+            "decode": dict(self._last_perf) if self._last_perf else None,
+            "prefill": (dict(self._last_prefill_perf)
+                        if self._last_prefill_perf else None),
+            "tpot_ewma_ms": round(self._tpot_ewma * 1e3, 3),
+            "dispatch_overhead_ms": round(self._dispatch_ewma * 1e3, 3),
+            "weight_bytes": self._weight_bytes,
+            "model_flops_per_token": roofline.model_flops_per_token(
+                self.cfg),
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "peak_bf16_tflops": peak_tflops,
+            "peak_hbm_gbps": peak_gbps,
+            "sentinel": (self.sentinel.snapshot()
+                         if self.sentinel is not None else None),
+            "top_offenders": top_offenders(8),
         }
 
     def _config_fingerprint(self) -> dict:
@@ -2185,6 +2400,12 @@ class LLMEngine:
         # that hangs (replica_hang, a wedged tunnel) leaves this stale,
         # which is what the API server's /health wedge check reads
         self._last_step_ts = time.monotonic()
+        # sentinel wall clock from step() ENTRY: everything a client
+        # experiences per token — fault sleeps, scheduler work, the
+        # decode itself — belongs in the regression signal, so the
+        # timer brackets the whole step, not just the device call
+        t_step0 = time.perf_counter()
+        self._pending_perf = None
         try:
             self.faults.raise_point("step", self._step_idx)
             if self.has_unfinished():
@@ -2199,6 +2420,11 @@ class LLMEngine:
         except Exception as e:
             return self._on_step_failure(e)
         self._consec_failures = 0
+        if self._pending_perf is not None:
+            n_active, seq_len = self._pending_perf
+            self._pending_perf = None
+            self._perf_observe(time.perf_counter() - t_step0,
+                               n_active, seq_len)
         return did
 
     def _step_inner(self) -> bool:
@@ -2286,6 +2512,13 @@ class LLMEngine:
         tokens = np.zeros((self.cfg_engine.max_batch,), np.int32)
         for i in active:
             tokens[i] = self.slots[i].last_token
+        # mean live cache depth for the roofline sample, captured while
+        # every active slot's request is still attached (_check_done
+        # frees finishing slots before the step timing lands)
+        perf_seq_len = max(1, sum(
+            len(self.slots[i].req.prompt_token_ids)
+            + len(self.slots[i].generated)
+            for i in active) // len(active))
 
         def simple(s: _Slot) -> bool:
             # no penalty counts, no logprobs: the device sampler covers
@@ -2433,6 +2666,11 @@ class LLMEngine:
         self._dispatch_ewma = (
             dispatch_s if self._dispatch_ewma == 0.0
             else 0.8 * self._dispatch_ewma + 0.2 * dispatch_s)
+        # stage the roofline/sentinel sample for step() to finalize
+        # with the FULL step wall time (fault sleeps happen before this
+        # method's timing bracket)
+        if active:
+            self._pending_perf = (len(active), perf_seq_len)
         # one decode_step span per distinct trace among active slots
         for tid, (rid, parent_sid) in traced.items():
             self.spans.record(

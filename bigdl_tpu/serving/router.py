@@ -313,6 +313,9 @@ class Replica:
         # belongs to (a respawn resets the replica's counters to zero)
         self.handoff: dict = {}
         self.handoff_gen = -1
+        # compact live-perf block (roofline util, sentinel state)
+        # probed from /v1/stats; feeds the router perf aggregate
+        self.perf: Optional[dict] = None
         # circuit breaker
         self.breaker = "closed"          # closed | open | half_open
         self.breaker_failures = 0
@@ -341,6 +344,7 @@ class Replica:
             "tpot_ewma_ms": self.tpot_ewma_ms,
             "headroom_frac": self.headroom_frac,
             "handoff": dict(self.handoff),
+            "perf": dict(self.perf) if self.perf else None,
         }
 
 
@@ -576,6 +580,19 @@ class Router:
         finally:
             conn.close()
 
+    def _http_post(self, port: int, path: str, doc: dict,
+                   timeout: float) -> Tuple[int, bytes]:
+        body = json.dumps(doc).encode()
+        conn = http.client.HTTPConnection(self.host, port,
+                                          timeout=timeout)
+        try:
+            conn.request("POST", path, body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
     def _probe(self, r: Replica, now: float) -> None:
         try:
             status, body = self._http_get(r.port, "/health",
@@ -688,6 +705,8 @@ class Router:
                     self._count(f"handoff_{key}", d)
             r.handoff = ho
             r.handoff_gen = r.generation
+            perf = doc.get("perf")
+            r.perf = perf if isinstance(perf, dict) else None
         except (OSError, ValueError):
             pass
 
@@ -1073,6 +1092,107 @@ class Router:
             self.flight.record("rolling_restart_end")
             self._admin_lock.release()
 
+    def fleet_profiler(self, body: Optional[dict] = None) -> dict:
+        """``POST /v1/admin/profiler``: fan a time-boxed jax.profiler
+        capture out to every routable replica SIMULTANEOUSLY (the
+        interesting regressions are fleet-synchronized: a noisy
+        neighbor, a tunnel hiccup, a bad deploy hits every replica in
+        the same second). Each replica captures into its own subdir of
+        ``log_dir`` and auto-stops at ``duration_sec`` (clamped to
+        ``BIGDL_TPU_PROFILER_MAX_SEC``) via the profiler watchdog — no
+        stop fan-out needed. The whole capture is stitched to one fleet
+        ``capture_id`` (a trace id), recorded as a router span so
+        ``GET /v1/trace/{capture_id}`` shows who captured what.
+        Raises ``RuntimeError`` when an admin operation is already in
+        progress, ``ValueError`` on a bad duration."""
+        body = body or {}
+        duration = body.get("duration_sec")
+        if duration is not None:
+            try:
+                duration = float(duration)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"duration_sec must be a positive number, got "
+                    f"{body.get('duration_sec')!r}")
+            if duration <= 0:
+                raise ValueError(
+                    f"duration_sec must be a positive number, got "
+                    f"{duration}")
+        log_dir = body.get("log_dir") or os.path.join(
+            os.environ.get("BIGDL_TPU_POSTMORTEM_DIR") or "/tmp",
+            "fleet_profiler")
+        if not os.path.isabs(log_dir):
+            raise ValueError(
+                f"log_dir must be an absolute path, got {log_dir!r}")
+        if not self._admin_lock.acquire(blocking=False):
+            raise RuntimeError("an admin operation is already in "
+                               "progress")
+        try:
+            capture_id = new_trace_id()
+            t0 = time.time()
+            targets = [r for r in self.replicas
+                       if r.state == HEALTHY and r.alive()]
+            self.flight.record("fleet_profiler_begin",
+                               capture_id=capture_id,
+                               replicas=[r.idx for r in targets],
+                               log_dir=log_dir,
+                               duration_sec=duration)
+            # one thread per replica: the whole point is that every
+            # replica's capture brackets the SAME wall-clock window
+            # (profiler init can take seconds — serial fan-out would
+            # stagger the windows by that much per replica)
+            results = []
+            for r in targets:
+                sub = os.path.join(log_dir, capture_id,
+                                   f"replica{r.idx}")
+                results.append({"replica": r.idx, "port": r.port,
+                                "log_dir": sub})
+
+            def _start_one(r, row):
+                doc = {"log_dir": row["log_dir"],
+                       "capture_id": capture_id}
+                if duration is not None:
+                    doc["duration_sec"] = duration
+                try:
+                    status, raw = self._http_post(
+                        r.port, "/v1/profiler/start", doc,
+                        max(self.cfg.health_timeout_sec, 15.0))
+                    row["status"] = status
+                    try:
+                        row["body"] = json.loads(raw)
+                    except ValueError:
+                        pass
+                    row["ok"] = status == 200
+                except OSError as e:
+                    row["ok"] = False
+                    row["error"] = str(e)
+
+            threads = [threading.Thread(target=_start_one, args=tr,
+                                        daemon=True)
+                       for tr in zip(targets, results)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30.0)
+            for r, row in zip(targets, results):
+                row.setdefault("ok", False)
+                self.spans.record(
+                    "fleet_capture", capture_id,
+                    t_start=t0, t_end=time.time(),
+                    replica=r.idx, port=r.port,
+                    log_dir=row["log_dir"], ok=row["ok"])
+            started = sum(1 for row in results if row.get("ok"))
+            self._count("fleet_profiler_captures", started)
+            self.flight.record("fleet_profiler_end",
+                               capture_id=capture_id, started=started,
+                               replicas=len(results))
+            return {"capture_id": capture_id, "log_dir": log_dir,
+                    "duration_sec": duration, "replicas": results,
+                    "started": started, "ok": started == len(results)
+                    and bool(results)}
+        finally:
+            self._admin_lock.release()
+
     def _wait_healthy(self, r: Replica, timeout: float) -> bool:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -1282,6 +1402,30 @@ class Router:
         with self._lock:
             return {k: int(v) for k, v in sorted(self.counts.items())}
 
+    def _perf_aggregate(self) -> dict:
+        """Fleet roofline view from the per-replica /v1/stats perf
+        blocks: per-replica utils plus fleet min/mean (the min is the
+        alarm — one replica off the roof drags every hedged request)
+        and the count of tripped sentinels."""
+        per: Dict[str, dict] = {}
+        utils: List[float] = []
+        tripped = 0
+        for r in self.replicas:
+            if not r.perf:
+                continue
+            per[str(r.idx)] = dict(r.perf)
+            u = r.perf.get("roofline_util_decode")
+            if isinstance(u, (int, float)):
+                utils.append(float(u))
+            if r.perf.get("sentinel_tripped"):
+                tripped += 1
+        out: dict = {"replicas": per, "sentinels_tripped": tripped}
+        if utils:
+            out["decode_util_min"] = round(min(utils), 4)
+            out["decode_util_mean"] = round(
+                sum(utils) / len(utils), 4)
+        return out
+
     def stats_snapshot(self) -> dict:
         """JSON-ready router state for ``GET /v1/router/stats`` (and
         the bench JSON's ``router`` block)."""
@@ -1292,6 +1436,7 @@ class Router:
             "tenants": self._tenant_aggregate(),
             "counters": self.counts_snapshot(),
             "rolling_restart_in_progress": self._rolling,
+            "perf": self._perf_aggregate(),
             "roles": {ro: sum(1 for r in self.replicas
                               if r.role == ro and r.state == HEALTHY)
                       for ro in ROLES},
@@ -1412,6 +1557,19 @@ class Router:
                 if self.path == "/v1/admin/rolling_restart":
                     try:
                         out = router.rolling_restart()
+                    except RuntimeError as e:
+                        return self._json(409, {"error": str(e)})
+                    return self._json(200 if out.get("ok") else 500,
+                                      out)
+                if self.path == "/v1/admin/profiler":
+                    try:
+                        body = json.loads(raw or b"{}")
+                    except json.JSONDecodeError:
+                        return self._json(400, {"error": "bad json"})
+                    try:
+                        out = router.fleet_profiler(body)
+                    except ValueError as e:
+                        return self._json(400, {"error": str(e)})
                     except RuntimeError as e:
                         return self._json(409, {"error": str(e)})
                     return self._json(200 if out.get("ok") else 500,

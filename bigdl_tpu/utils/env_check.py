@@ -50,8 +50,13 @@ KNOWN_ENV = (
     "BIGDL_TPU_MOE_DISPATCH",
     "BIGDL_TPU_MXU_LAYOUT",
     "BIGDL_TPU_NATIVE_CACHE",
+    "BIGDL_TPU_PEAK_BF16_TFLOPS",
+    "BIGDL_TPU_PEAK_HBM_GBPS",
+    "BIGDL_TPU_PERF_HISTORY",
     "BIGDL_TPU_POSTMORTEM_DIR",
     "BIGDL_TPU_PREPACK",
+    "BIGDL_TPU_PROFILER_DIR_CAP_BYTES",
+    "BIGDL_TPU_PROFILER_MAX_SEC",
     "BIGDL_TPU_QOS_AGING_SEC",
     "BIGDL_TPU_QOS_DEFAULT",
     "BIGDL_TPU_QUANTIZE_KV_CACHE",
@@ -62,6 +67,10 @@ KNOWN_ENV = (
     "BIGDL_TPU_ROUTER_HEALTH_SEC",
     "BIGDL_TPU_ROUTER_HEDGE_MS",
     "BIGDL_TPU_ROUTER_REPLICAS",
+    "BIGDL_TPU_SENTINEL",
+    "BIGDL_TPU_SENTINEL_RECOVER_STEPS",
+    "BIGDL_TPU_SENTINEL_THRESHOLD",
+    "BIGDL_TPU_SENTINEL_TRIP_STEPS",
     "BIGDL_TPU_TENANT_BURST",
     "BIGDL_TPU_TENANT_RPS",
     "BIGDL_TPU_TENANT_TPS",
@@ -243,6 +252,7 @@ def collect() -> dict:
         ("decode_resident", "BIGDL_TPU_DECODE_RESIDENT",
          "resolve_decode_resident"),
         ("prepack", "BIGDL_TPU_PREPACK", "resolve_prepack"),
+        ("sentinel", "BIGDL_TPU_SENTINEL", "resolve_sentinel"),
     )
     for key, envname, fname in tristate_knobs:
         raw = os.environ.get(envname)
@@ -255,6 +265,51 @@ def collect() -> dict:
                          "valid": True}
         except ValueError as e:
             info[key] = {"value": raw, "valid": False, "error": str(e)}
+
+    # perf-history baseline sink (the sentinel degrades to a live
+    # baseline if the file is unwritable — report it up front, same
+    # contract as the event log)
+    ph = os.environ.get("BIGDL_TPU_PERF_HISTORY")
+    if ph:
+        from bigdl_tpu.observability.sentinel import \
+            validate_perf_history_path
+
+        info["perf_history"] = validate_perf_history_path(ph)
+
+    # perf-regression sentinel tuning (the sentinel falls back to
+    # defaults on bad values; surface range errors here instead)
+    sentinel_knobs = (
+        ("sentinel_threshold", "BIGDL_TPU_SENTINEL_THRESHOLD",
+         "resolve_sentinel_threshold"),
+        ("sentinel_trip_steps", "BIGDL_TPU_SENTINEL_TRIP_STEPS",
+         "resolve_sentinel_trip_steps"),
+        ("sentinel_recover_steps", "BIGDL_TPU_SENTINEL_RECOVER_STEPS",
+         "resolve_sentinel_recover_steps"),
+    )
+    for key, envname, fname in sentinel_knobs:
+        raw = os.environ.get(envname)
+        if not raw:
+            continue
+        from bigdl_tpu.observability import sentinel as _sentinel
+
+        try:
+            info[key] = {"value": getattr(_sentinel, fname)(raw),
+                         "valid": True}
+        except ValueError as e:
+            info[key] = {"value": raw, "valid": False, "error": str(e)}
+
+    # profiler capture time-box (start_profiler refuses to start on a
+    # bad value, but an operator wants to know before the incident)
+    pms = os.environ.get("BIGDL_TPU_PROFILER_MAX_SEC")
+    if pms:
+        from bigdl_tpu.utils.profiling import resolve_profiler_max_sec
+
+        try:
+            info["profiler_max_sec"] = {
+                "value": resolve_profiler_max_sec(pms), "valid": True}
+        except ValueError as e:
+            info["profiler_max_sec"] = {
+                "value": pms, "valid": False, "error": str(e)}
 
     # fault-injection spec: a typo'd spec silently injecting nothing
     # would make a chaos run vacuously green — fail the check instead
@@ -416,6 +471,12 @@ def main() -> int:
           and info.get("memory_poll_sec", {}).get("valid", True)
           and info.get("decode_resident", {}).get("valid", True)
           and info.get("prepack", {}).get("valid", True)
+          and info.get("sentinel", {}).get("valid", True)
+          and info.get("sentinel_threshold", {}).get("valid", True)
+          and info.get("sentinel_trip_steps", {}).get("valid", True)
+          and info.get("sentinel_recover_steps", {}).get("valid", True)
+          and info.get("profiler_max_sec", {}).get("valid", True)
+          and info.get("perf_history", {}).get("writable", True)
           and info.get("fault_spec", {}).get("valid", True)
           and info.get("request_deadline_ms", {}).get("valid", True)
           and info.get("drain_timeout_sec", {}).get("valid", True)

@@ -17,11 +17,35 @@ CPU test runs.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 import time
 from typing import Dict, Iterator, Optional
 
 import jax
+
+
+def resolve_profiler_max_sec(value=None) -> float:
+    """Hard cap on any on-demand profiler capture: explicit value, else
+    ``$BIGDL_TPU_PROFILER_MAX_SEC``, else 60 seconds. Every capture —
+    operator-started, router fleet fan-out, or sentinel auto-capture —
+    is auto-stopped at this deadline so an abandoned capture can never
+    run unbounded. ValueError on a non-positive or non-numeric setting
+    (utils/env_check.py surfaces this)."""
+    if value is None:
+        value = os.environ.get("BIGDL_TPU_PROFILER_MAX_SEC")
+    if value is None or value == "":
+        return 60.0
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"profiler max seconds must be a positive number, got "
+            f"{value!r}")
+    if f <= 0:
+        raise ValueError(
+            f"profiler max seconds must be a positive number, got {f}")
+    return f
 
 
 @contextlib.contextmanager
@@ -39,40 +63,167 @@ def trace(log_dir: str) -> Iterator[None]:
 # On-demand profiler for the API server (POST /v1/profiler/{start,stop}):
 # same jax.profiler trace as `trace()` above but split into explicit
 # start/stop calls so a capture can bracket live traffic. One capture at
-# a time per process (jax.profiler itself is single-session).
+# a time per process (jax.profiler itself is single-session). A
+# watchdog timer auto-stops every capture at its deadline.
 _profiler_lock = threading.Lock()
 _profiler_dir: Optional[str] = None
+_profiler_started_at: Optional[float] = None
+_profiler_deadline: Optional[float] = None
+_profiler_capture_id: Optional[str] = None
+_profiler_timer: Optional[threading.Timer] = None
+_last_capture: Optional[dict] = None
+
+# a runaway capture dir (Perfetto traces of a busy chip are big) stops
+# admission of NEW captures past this many bytes; env-overridable for
+# tests and small disks
+_CAPTURE_DIR_CAP_BYTES = 1 << 30
 
 
-def start_profiler(log_dir: str) -> dict:
-    """Start a device trace into `log_dir`; error if one is running."""
-    global _profiler_dir
+def _capture_dir_cap() -> int:
+    raw = os.environ.get("BIGDL_TPU_PROFILER_DIR_CAP_BYTES")
+    if raw:
+        try:
+            n = int(raw)
+            if n > 0:
+                return n
+        except ValueError:
+            pass
+    return _CAPTURE_DIR_CAP_BYTES
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def start_profiler(log_dir: str, max_sec: Optional[float] = None,
+                   capture_id: Optional[str] = None) -> dict:
+    """Start a device trace into `log_dir`; error if one is running.
+
+    Hardening (all three bit operators in practice): non-absolute paths
+    are rejected (a capture landing in whatever CWD the server happened
+    to start from is a lost capture), the directory is created if
+    missing, and an already-oversized capture dir refuses new captures.
+    A daemon watchdog stops the capture after ``max_sec`` (clamped to
+    ``resolve_profiler_max_sec()``) so it can never run unbounded."""
+    global _profiler_dir, _profiler_started_at, _profiler_deadline
+    global _profiler_capture_id, _profiler_timer
+    if not os.path.isabs(log_dir):
+        raise ValueError(
+            f"profiler log_dir must be an absolute path, got {log_dir!r}")
+    cap_sec = resolve_profiler_max_sec()
+    if max_sec is not None:
+        try:
+            max_sec = float(max_sec)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"profiler duration must be a positive number, got "
+                f"{max_sec!r}")
+        if max_sec <= 0:
+            raise ValueError(
+                f"profiler duration must be a positive number, got "
+                f"{max_sec}")
+        cap_sec = min(cap_sec, max_sec)
     with _profiler_lock:
         if _profiler_dir is not None:
             raise RuntimeError(
                 f"profiler already capturing into {_profiler_dir}")
+        os.makedirs(log_dir, exist_ok=True)
+        used = _dir_bytes(log_dir)
+        cap_bytes = _capture_dir_cap()
+        if used >= cap_bytes:
+            raise RuntimeError(
+                f"capture dir {log_dir} already holds {used} bytes "
+                f"(cap {cap_bytes}); clean it up before capturing")
         jax.profiler.start_trace(log_dir,
                                  create_perfetto_link=False,
                                  create_perfetto_trace=True)
+        now = time.time()
         _profiler_dir = log_dir
-        return {"status": "started", "log_dir": log_dir}
+        _profiler_started_at = now
+        _profiler_deadline = now + cap_sec
+        _profiler_capture_id = capture_id
+        _profiler_timer = threading.Timer(
+            cap_sec, _auto_stop, args=(log_dir,))
+        _profiler_timer.daemon = True
+        _profiler_timer.start()
+        out = {"status": "started", "log_dir": log_dir,
+               "max_sec": cap_sec, "deadline": _profiler_deadline}
+        if capture_id is not None:
+            out["capture_id"] = capture_id
+        return out
 
 
-def stop_profiler() -> dict:
-    """Stop the running capture; error if none is running."""
-    global _profiler_dir
+def _auto_stop(expected_dir: str) -> None:
+    """Watchdog body: stop the capture iff it is still the one we armed
+    for (an operator stop + fresh start must not be killed by a stale
+    timer)."""
+    with _profiler_lock:
+        if _profiler_dir != expected_dir:
+            return
+    try:
+        stop_profiler(_reason="auto_stop")
+    except RuntimeError:
+        pass  # lost the race with an operator stop: fine
+
+
+def stop_profiler(_reason: str = "manual") -> dict:
+    """Stop the running capture; error if none is running.
+
+    ``_profiler_dir`` is cleared BEFORE ``stop_trace()`` can raise
+    (try/finally): a failed stop used to leave the module convinced a
+    capture was live, wedging the profiler until process restart."""
+    global _profiler_dir, _profiler_started_at, _profiler_deadline
+    global _profiler_capture_id, _profiler_timer, _last_capture
     with _profiler_lock:
         if _profiler_dir is None:
             raise RuntimeError("no profiler capture in progress")
         log_dir, _profiler_dir = _profiler_dir, None
-        jax.profiler.stop_trace()
-        return {"status": "stopped", "log_dir": log_dir}
+        started_at, _profiler_started_at = _profiler_started_at, None
+        capture_id, _profiler_capture_id = _profiler_capture_id, None
+        _profiler_deadline = None
+        timer, _profiler_timer = _profiler_timer, None
+        if timer is not None:
+            timer.cancel()
+        out = {"status": "stopped", "log_dir": log_dir,
+               "stopped_by": _reason}
+        if started_at is not None:
+            out["duration_s"] = round(time.time() - started_at, 3)
+        if capture_id is not None:
+            out["capture_id"] = capture_id
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _last_capture = dict(out)
+        return out
 
 
 def profiler_status() -> dict:
+    """Structured view of the on-demand profiler: whether a capture is
+    live, its dir / start / deadline, the configured cap, and the last
+    finished capture (who stopped it, how long it ran)."""
+    try:
+        max_sec = resolve_profiler_max_sec()
+    except ValueError:
+        max_sec = 60.0  # status must render even with a bad env knob
     with _profiler_lock:
-        return {"capturing": _profiler_dir is not None,
-                "log_dir": _profiler_dir}
+        out = {"capturing": _profiler_dir is not None,
+               "log_dir": _profiler_dir,
+               "max_sec": max_sec}
+        if _profiler_dir is not None:
+            out["started_at"] = _profiler_started_at
+            out["deadline"] = _profiler_deadline
+            if _profiler_capture_id is not None:
+                out["capture_id"] = _profiler_capture_id
+        if _last_capture is not None:
+            out["last_capture"] = dict(_last_capture)
+        return out
 
 
 @contextlib.contextmanager

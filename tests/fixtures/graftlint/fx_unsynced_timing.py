@@ -1,0 +1,42 @@
+"""Seeded bug: timing a jit dispatch without a block_until_ready fence."""
+
+import time
+
+import jax
+import numpy as np
+
+from bigdl_tpu.observability.compile_watch import tracked_jit
+
+
+def _decode_one(weights, tok):
+    return tok
+
+
+class MiniEngine:
+    def __init__(self):
+        self._decode = tracked_jit("fx_decode", _decode_one)
+
+    def fx_bad_timing(self, weights, toks):
+        t0 = time.perf_counter()
+        out = self._decode(weights, toks)
+        dt = time.perf_counter() - t0       # UNFENCED: measures enqueue
+        return out, dt
+
+    def fx_good_timing(self, weights, toks):
+        t0 = time.perf_counter()
+        out = self._decode(weights, toks)
+        jax.block_until_ready(out)          # fence: device finished
+        dt = time.perf_counter() - t0
+        return out, dt
+
+    def fx_pull_timing(self, weights, toks):
+        t0 = time.perf_counter()
+        host = np.asarray(self._decode(weights, toks))  # pull IS a fence
+        dt = time.perf_counter() - t0
+        return host, dt
+
+    def fx_no_dispatch(self, toks):
+        t0 = time.perf_counter()
+        total = sum(toks)                   # host-only work: any timing ok
+        dt = time.perf_counter() - t0
+        return total, dt

@@ -287,6 +287,28 @@ def test_engine_resident_one_dispatch_per_step(tiny_params):
     assert dispatch_table() == {"engine_decode_resident": 5}
 
 
+def test_engine_resident_one_dispatch_with_sentinel(tiny_params):
+    """The perf sentinel + live roofline gauges ride the shared step
+    path as pure host-side float math: with the sentinel explicitly ON
+    a pure-decode step still issues exactly ONE host dispatch."""
+    from bigdl_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+    set_flags(decode_resident="on")
+    eng = LLMEngine(FakeModel(tiny_params, TINY_LLAMA),
+                    EngineConfig(max_batch=2, max_seq=128,
+                                 sentinel=True))
+    assert eng.sentinel is not None
+    eng.add_request("r0", [1, 2, 3, 4], SamplingParams(max_tokens=50))
+    eng.step()                              # admission + first decode
+    reset_dispatch_table()
+    for _ in range(5):
+        eng.step()
+    assert dispatch_table() == {"engine_decode_resident": 5}
+    # the observability hooks actually ran: gauges fed, sentinel stepped
+    assert eng._last_perf is not None
+    assert eng.sentinel.snapshot()["steps"] >= 5
+
+
 def test_engine_legacy_multi_dispatch_still_works(tiny_params):
     """Sanity for the fallback: with the resident step off the engine
     still decodes (multi-dispatch) — and never touches the fused jit."""

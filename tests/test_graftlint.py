@@ -84,7 +84,7 @@ def test_rule_catalog_covers_findings():
     for rule in ("jax-raw-jit", "jax-host-sync-in-jit",
                  "jax-nondet-in-jit", "jax-missing-donate",
                  "jax-scalar-signature", "step-host-sync",
-                 "jax-dispatch-in-decode-loop",
+                 "jax-dispatch-in-decode-loop", "jax-unsynced-timing",
                  "lock-guarded-unlocked", "lock-order-inversion"):
         assert rule in RULES
 
@@ -170,6 +170,18 @@ def test_dispatch_loop_needs_entry():
     result = _scan("fx_dispatch_loop.py")
     assert not any(f.rule == "jax-dispatch-in-decode-loop"
                    for f in result.findings)
+
+
+def test_detects_unsynced_timing():
+    result = _scan("fx_unsynced_timing.py")
+    hits = [f for f in result.findings
+            if f.rule == "jax-unsynced-timing"]
+    assert len(hits) == 1, result.findings
+    assert hits[0].obj == "MiniEngine.fx_bad_timing"
+    assert "'t0'" in hits[0].message
+    assert "block_until_ready" in hits[0].message
+    # the fenced, pulled, and dispatch-free variants stay silent
+    assert "UNFENCED" in hits[0].snippet
 
 
 # ---------------------------------------------------------------------------

@@ -713,3 +713,56 @@ def test_e2e_rolling_restart_zero_5xx(cluster):
     _wait_all_healthy(router)
     # restart counter moved for every replica
     assert router.counts["restarts"] >= 2
+
+
+def test_e2e_fleet_profiler_capture(cluster, tmp_path):
+    """POST /v1/admin/profiler fans a time-boxed capture to every
+    healthy replica SIMULTANEOUSLY: one fleet capture_id, one capture
+    subdir per replica (created synchronously by the replica before it
+    answers), the auto-stop watchdog owning the stop side, and the
+    fleet perf aggregate riding /v1/router/stats."""
+    router, base = cluster
+    _wait_all_healthy(router)
+    # make sure every replica has decoded (perf gauges need a step)
+    # and give the stats poller a beat to pick the perf blocks up
+    _completion_burst(base, [[1, 2, 3], [4, 5, 6]], max_tokens=4)
+    log_dir = str(tmp_path / "fleet")
+    status, doc = _post(base, "/v1/admin/profiler",
+                        {"duration_sec": 1, "log_dir": log_dir})
+    assert status == 200, doc
+    assert doc["ok"] is True and doc["started"] == 2
+    cap = doc["capture_id"]
+    assert cap and doc["duration_sec"] == 1.0
+    for row in doc["replicas"]:
+        assert row["ok"] is True and row["status"] == 200
+        # per-replica subdir keyed by the fleet capture id, already on
+        # disk (same filesystem): replica start_profiler makedirs it
+        assert row["log_dir"].startswith(os.path.join(log_dir, cap))
+        assert os.path.isdir(row["log_dir"])
+        assert row["body"]["capture_id"] == cap
+    # the capture is stitched onto the trace timeline under its id
+    spans = router.spans.spans_for(cap)
+    assert len(spans) == 2
+    assert {s["name"] for s in spans} == {"fleet_capture"}
+    # input validation surfaces as 400s, not replica fan-out
+    status, _ = _post(base, "/v1/admin/profiler",
+                      {"log_dir": "relative/dir"})
+    assert status == 400
+    status, _ = _post(base, "/v1/admin/profiler",
+                      {"duration_sec": -1, "log_dir": log_dir})
+    assert status == 400
+    # fleet perf aggregate: both replicas reporting, none tripped
+    deadline = time.monotonic() + 10.0
+    perf = {}
+    while time.monotonic() < deadline:
+        perf = router.stats_snapshot()["perf"]
+        if len(perf["replicas"]) == 2:
+            break
+        time.sleep(0.1)
+    assert len(perf["replicas"]) == 2, perf
+    assert perf["sentinels_tripped"] == 0
+    # tiny CPU replicas sit far off the roof (util ~0 at 4 decimals);
+    # the aggregate shape is what's under test here
+    assert 0 <= perf["decode_util_min"] <= perf["decode_util_mean"]
+    for rep in perf["replicas"].values():
+        assert rep["decode_ideal_ms"] is not None

@@ -90,7 +90,16 @@ ROBUSTNESS_COUNTERS = (
     # selects exactly these.
     'bigdl_tpu_autoscaler_decisions_total{action="refused',
     'bigdl_tpu_autoscaler_decisions_total{action="skipped',
+    # perf-regression sentinel trips (observability/sentinel.py) —
+    # additionally zero-gated below: a gated lane must never ship a
+    # run whose own sentinel fired
+    "bigdl_tpu_perf_regression_total",
 )
+
+# counters that must be exactly 0 in the candidate run, baseline or
+# not: a sentinel trip means the run itself detected a decode
+# regression while it was happening
+ZERO_COUNTERS = ("bigdl_tpu_perf_regression_total",)
 
 # the router's flat counters block (bench_serving --replicas embeds
 # GET /v1/router/stats as `router_bench.router`): every one of these
@@ -256,8 +265,17 @@ def diff(old: Dict[str, Tuple[float, str]],
         else:
             limit = threshold_pct
         bad = pct > limit if direction == "lower" else pct < -limit
+        if n > 0 and any(z in name for z in ZERO_COUNTERS):
+            bad = True      # zero-gated: nonzero is a failure outright
         rows.append((name, o, n, pct, direction, bad))
         if bad:
+            regressions.append(name)
+    # zero-gated counters present only in the candidate still fail:
+    # the baseline predates the sentinel, the trip is real either way
+    for name in sorted(set(new) - set(old)):
+        n, direction = new[name]
+        if n > 0 and any(z in name for z in ZERO_COUNTERS):
+            rows.append((name, 0.0, n, float("inf"), direction, True))
             regressions.append(name)
     return rows, regressions
 
