@@ -234,6 +234,89 @@ def run_autoscale_bench(n_replicas: int = 2, n_requests: int = 12,
     return out
 
 
+def run_prefix_share_bench(model, cfg, on_tpu: bool) -> dict:
+    """Shared-system-prompt lane: a wave of concurrent requests over
+    one common prompt prefix through a paged-KV engine with radix
+    prefix sharing on. A warmup request seeds the radix (the timed
+    wave measures steady-state sharing — the state a deployed system
+    prompt lives in), so every timed admission should reuse the
+    prefix pages wholesale instead of re-prefilling them. Emits the
+    two rows bench_diff gates: ``prefix_hit_tokens_frac`` (higher is
+    better — fraction of looked-up prompt tokens served from shared
+    pages) and ``page_pool_exhausted`` (lower — allocation stalls
+    mean the arena is undersized for the offered load)."""
+    import numpy as np
+
+    from bigdl_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+    if on_tpu:
+        # 512-token system prompt, Pallas-aligned 128-position pages
+        b, prefix_len, tail_len, new_tokens = 8, 512, 8, 16
+        max_seq, ps, bucket = 1024, 128, 128
+    else:
+        b, prefix_len, tail_len, new_tokens = 4, 48, 4, 8
+        max_seq, ps, bucket = 64, 16, 16
+    n_req = 2 * b
+    eng = LLMEngine(model, EngineConfig(
+        max_batch=b, max_seq=max_seq, prefix_cache_entries=0,
+        prefill_bucket=bucket, prefill_chunk=bucket,
+        kv_page_size=ps, prefix_sharing="on"))
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(1, cfg.vocab_size, prefix_len).tolist()
+    prompts = [prefix + rng.integers(1, cfg.vocab_size, tail_len).tolist()
+               for _ in range(n_req)]
+    # warmup seeds the radix with the shared prefix AND compiles the
+    # paged prefill/seed/decode executables outside the timed window
+    eng.generate([prefix], SamplingParams(max_tokens=2))
+    base = eng.stats_snapshot()["paged"]
+    base_radix = dict(base["radix"])
+
+    t0 = time.perf_counter()
+    submit: dict = {}
+    ttft: dict = {}
+    finished: set = set()
+    for i, p in enumerate(prompts):
+        eng.add_request(f"s{i}", p, SamplingParams(max_tokens=new_tokens))
+        submit[f"s{i}"] = time.perf_counter()
+    generated = 0
+    deadline = time.perf_counter() + 600
+    while len(finished) < n_req and time.perf_counter() < deadline:
+        if not eng.step():
+            time.sleep(0.001)
+        for rid, ts in submit.items():
+            if rid in finished:
+                continue
+            for o in eng.get_outputs(rid):
+                if o.new_token_ids and rid not in ttft:
+                    ttft[rid] = time.perf_counter() - ts
+                generated += len(o.new_token_ids)
+                if o.finished:
+                    finished.add(rid)
+    wall = time.perf_counter() - t0
+    snap = eng.stats_snapshot()["paged"]
+    looked = snap["radix"]["lookup_tokens"] - base_radix["lookup_tokens"]
+    hit = snap["radix"]["hit_tokens"] - base_radix["hit_tokens"]
+    vals = sorted(ttft.values())
+    return {
+        "n_requests": n_req,
+        "completed": len(finished),
+        "prefix_len": prefix_len,
+        "prompt_len": prefix_len + tail_len,
+        "page_size": snap["page_size"],
+        "num_pages": snap["num_pages"],
+        "pages_shared_peak_hint": snap["pages_shared"],
+        "generated_tokens": int(generated),
+        "wall_s": round(wall, 2),
+        "tokens_per_s": round(generated / max(wall, 1e-9), 1),
+        "prefix_hit_tokens_frac": round(hit / max(looked, 1), 4),
+        "ttft_p50_ms": (round(1000 * float(np.percentile(vals, 50)), 1)
+                        if vals else None),
+        "page_pool_exhausted": int(snap["pool_exhausted_total"]
+                                   - base["pool_exhausted_total"]),
+        "radix_nodes": snap["radix"]["nodes"],
+    }
+
+
 def run_overload_bench(model, cfg, max_seq: int, prompt_len: int,
                        new_tokens: int) -> dict:
     """Open-loop overload lane: Poisson arrivals at 0.5x / 1x / 3x the
@@ -433,7 +516,10 @@ def main() -> None:
                 forward = staticmethod(llama_mod.forward)
                 prefill = staticmethod(llama_mod.forward_last_token)
                 new_cache = staticmethod(llama_mod.new_cache)
+                forward_paged = staticmethod(llama_mod.forward_paged)
+                new_paged_cache = staticmethod(llama_mod.new_paged_cache)
                 SUPPORTS_SCALED_KV = llama_mod.SUPPORTS_SCALED_KV
+                SUPPORTS_PAGED_KV = llama_mod.SUPPORTS_PAGED_KV
 
             self.family = Fam()
 
@@ -569,6 +655,14 @@ def main() -> None:
     except Exception as e:
         failed_lanes.append("overload")
         out["overload"] = {"error": f"{type(e).__name__}: {e}"}
+    # shared-system-prompt lane (paged KV + radix sharing): bench_diff
+    # gates prefix_hit_tokens_frac higher-is-better and
+    # page_pool_exhausted lower-is-better
+    try:
+        out["prefix_share"] = run_prefix_share_bench(model, cfg, on_tpu)
+    except Exception as e:
+        failed_lanes.append("prefix_share")
+        out["prefix_share"] = {"error": f"{type(e).__name__}: {e}"}
     if kv_sweep:
         # --kv-cache-dtype rows: aggregate throughput + per-stream TPOT
         # + exact cache footprint (eval_shape, no allocation) per dtype

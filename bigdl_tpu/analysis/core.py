@@ -65,6 +65,10 @@ RULES: Dict[str, str] = {
         "attribute written under a lock accessed without holding it",
     "lock-order-inversion":
         "two locks acquired in opposite nested orders (deadlock risk)",
+    "paged-host-gather":
+        "host-side subscript of a paged-KV table (arena / block table "
+        "/ page table) on the engine step path — page indexing "
+        "belongs inside the tracked jit",
 }
 
 _SUPPRESS_RE = re.compile(

@@ -798,7 +798,12 @@ class _HostProven:
         if isinstance(node, ast.Constant):
             return True
         if isinstance(node, ast.Name):
-            return node.id in self.host
+            return node.id in self.host or \
+                node.id.endswith(_HOST_MIRROR_SUFFIXES)
+        if isinstance(node, ast.Attribute):
+            # naming convention shared with paged-host-gather: a
+            # _np/_host suffix declares a host numpy mirror
+            return node.attr.endswith(_HOST_MIRROR_SUFFIXES)
         if isinstance(node, ast.Call):
             d = _dotted(node.func) or ""
             root = d.split(".")[0]
@@ -957,6 +962,52 @@ class _DispatchLoop(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: attribute / name fragments that denote paged-KV indexing structures;
+#: a host-side subscript of one of these on the step path is a page
+#: gather outside the traced step (one per token where the paged decode
+#: contract is a single block-table H2D per step, with all per-token
+#: page indexing inside the jit — the kernel's scalar prefetch).
+_PAGED_TABLE_TOKENS = ("arena", "block_table", "page_table", "page_pool")
+
+#: naming convention for intentional host mirrors (the engine keeps an
+#: authoritative numpy block table and refreshes the device copy once
+#: per dirty step): these suffixes mark host numpy state, never a
+#: device array, so subscripting them is free
+_HOST_MIRROR_SUFFIXES = ("_np", "_host")
+
+
+class _PagedHostGather(ast.NodeVisitor):
+    """Flag host-side subscripts of paged-KV tables in one step-path
+    method (rule ``paged-host-gather``)."""
+
+    def __init__(self, module: Module, obj: str, out: List[Finding]):
+        self.m = module
+        self.obj = obj
+        self.out = out
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        base = node.value
+        name = None
+        if isinstance(base, ast.Name):
+            name = base.id
+        elif isinstance(base, ast.Attribute):
+            name = base.attr
+        if name is not None:
+            low = name.lower()
+            if not low.endswith(_HOST_MIRROR_SUFFIXES) \
+                    and any(t in low for t in _PAGED_TABLE_TOKENS):
+                self.out.append(Finding(
+                    "paged-host-gather", self.m.rel, node.lineno,
+                    self.obj,
+                    f"subscript of {name!r} on the step path: paged-KV "
+                    "tables must be indexed inside the tracked jit "
+                    "(ship the block table H2D once per step); a host "
+                    "numpy mirror is fine when named with a _np/_host "
+                    "suffix",
+                    self.m.snippet(node.lineno)))
+        self.generic_visit(node)
+
+
 def _check_step_path(module: Module, cls: str, entry: str,
                      out: List[Finding],
                      bindings: Optional[Dict[Tuple[str, str],
@@ -975,6 +1026,9 @@ def _check_step_path(module: Module, cls: str, entry: str,
             disp = _DispatchLoop(module, f"{cls}.{name}", out, bindings)
             for stmt in fn.body:
                 disp.visit(stmt)
+        gather = _PagedHostGather(module, f"{cls}.{name}", out)
+        for stmt in fn.body:
+            gather.visit(stmt)
 
 
 # ---------------------------------------------------------------------------

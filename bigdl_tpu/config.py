@@ -81,6 +81,21 @@ class RuntimeFlags:
     quantize_kv_cache: bool = False
     # default max sequence length for loaded models
     default_max_seq: int = 2048
+    # paged KV cache: positions per arena page. 0 = off (per-slot slab);
+    # otherwise a power of two that divides max_seq. 128 matches the TPU
+    # lane tile (one page == one S-block in the paged Pallas kernel);
+    # smaller values are legal on the XLA fallback path (tests use 16).
+    kv_page_size: int = 0
+    # paged KV cache: total physical pages in the arena. 0 = auto-size
+    # to max_batch * (max_seq / page_size) + 1 (the +1 is the pinned
+    # null page) — i.e. the same worst case the slab held. Undersize it
+    # deliberately to oversubscribe: admission then rides on prefix
+    # sharing actually deduplicating pages.
+    kv_pages: int = 0
+    # radix-tree prefix sharing across requests (paged mode only):
+    # "auto"/"on" share full-page prompt chunks copy-on-write, "off"
+    # keeps every sequence's pages private
+    prefix_sharing: str = "auto"
     # AOT cross-compilation target: set to "tpu" while LOWERING a program
     # for a TPU topology from a CPU host (tests/test_aot_tpu.py) so kernel
     # dispatch routes to Pallas even though jax.default_backend() is cpu.
@@ -113,6 +128,12 @@ class RuntimeFlags:
                 "BIGDL_TPU_KV_CACHE_DTYPE", "bf16").strip().lower() or "bf16",
             quantize_kv_cache=_env_bool("BIGDL_TPU_QUANTIZE_KV_CACHE"),
             default_max_seq=int(os.environ.get("BIGDL_TPU_MAX_SEQ", "2048")),
+            kv_page_size=_checked_env(
+                "BIGDL_TPU_KV_PAGE_SIZE", resolve_kv_page_size, 0),
+            kv_pages=_checked_env("BIGDL_TPU_KV_PAGES", resolve_kv_pages, 0),
+            prefix_sharing=_tristate_env(
+                "BIGDL_TPU_PREFIX_SHARING",
+                lambda s: resolve_prefix_sharing(s)),
             aot_target=(os.environ.get("BIGDL_TPU_AOT_TARGET") or "").strip()
             .lower() or None,
         )
@@ -129,6 +150,57 @@ def _tristate_env(name: str, resolver) -> str:
         return resolver(os.environ.get(name, "auto"))
     except ValueError:
         return "auto"
+
+
+def _checked_env(name: str, resolver, default):
+    """Resolve a validated (non-tristate) env knob, falling back to
+    ``default`` on a bad value — same contract as ``_tristate_env``:
+    utils/env_check.py re-runs the resolver and reports the typo."""
+    try:
+        return resolver(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def resolve_kv_page_size(spec) -> int:
+    """Normalize a BIGDL_TPU_KV_PAGE_SIZE spec: 0 disables paging,
+    otherwise a power-of-two count of token positions per page."""
+    try:
+        n = int(str(spec).strip() or 0)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"kv_page_size must be an integer, got {spec!r}")
+    if n < 0 or (n and n & (n - 1)):
+        raise ValueError(
+            f"kv_page_size must be 0 (off) or a power of two, "
+            f"got {spec!r}")
+    return n
+
+
+def resolve_kv_pages(spec) -> int:
+    """Normalize a BIGDL_TPU_KV_PAGES spec: 0 auto-sizes the arena,
+    otherwise a total page count >= 2 (page 0 is the pinned null page)."""
+    try:
+        n = int(str(spec).strip() or 0)
+    except (TypeError, ValueError):
+        raise ValueError(f"kv_pages must be an integer, got {spec!r}")
+    if n < 0 or n == 1:
+        raise ValueError(
+            f"kv_pages must be 0 (auto) or >= 2 (page 0 is reserved), "
+            f"got {spec!r}")
+    return n
+
+
+def resolve_prefix_sharing(spec) -> str:
+    """Normalize a BIGDL_TPU_PREFIX_SHARING spec to "auto"|"on"|"off"."""
+    s = str(spec).strip().lower() if spec is not None else "auto"
+    s = {"1": "on", "true": "on", "0": "off", "false": "off",
+         "": "auto"}.get(s, s)
+    if s not in _TRISTATE:
+        raise ValueError(
+            f"unknown prefix_sharing mode {spec!r}; "
+            f"choose from {_TRISTATE}")
+    return s
 
 
 def resolve_prepack(spec) -> str:

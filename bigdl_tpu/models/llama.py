@@ -33,9 +33,11 @@ from jax import lax
 
 import functools
 
-from bigdl_tpu.ops.attention import sdp_attention
+from bigdl_tpu.ops.attention import sdp_attention, sdp_attention_paged
 from bigdl_tpu.ops.kvcache import (KVCache, init_cache, read_layer,
                                    read_layer_quantized, update_layer)
+from bigdl_tpu.ops.paged import (PagedKVCache, init_paged_cache,
+                                 paged_update_layer)
 from bigdl_tpu.ops.matmul import linear
 from bigdl_tpu.ops.embedding import embedding_lookup
 from bigdl_tpu.ops.norms import layer_norm, rms_norm
@@ -568,8 +570,14 @@ def _split_qkv(qkv, b, sq, h, hkv, hd):
 
 
 def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
-                cache_ctx=None, lidx=None, record=None):
-    """QKV + rope + (cached) attention + output projection."""
+                cache_ctx=None, lidx=None, record=None,
+                block_tables=None):
+    """QKV + rope + (cached) attention + output projection.
+
+    With ``block_tables`` the cache planes in ``cache_ctx`` are page
+    ARENAS (``[L, P, ps, Hkv, D]``): appends scatter through the table
+    and attention reads via `sdp_attention_paged` (fused gather on TPU,
+    XLA take fallback elsewhere)."""
     b, sq, _ = hidden.shape
     h, hkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.hd
     scale = (cfg.query_pre_attn_scalar ** -0.5
@@ -599,7 +607,31 @@ def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
         q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
         k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
 
-    if cache_ctx is not None:
+    if cache_ctx is not None and block_tables is not None:
+        ck, cv, cks, cvs, clidx, pos = cache_ctx
+        if cks is not None:
+            ck, cv, cks, cvs = paged_update_layer(
+                ck, cv, clidx, k, v, pos, block_tables, cks, cvs)
+            kq = lax.dynamic_index_in_dim(ck, clidx, 0, keepdims=False)
+            vq = lax.dynamic_index_in_dim(cv, clidx, 0, keepdims=False)
+            ksc = lax.dynamic_index_in_dim(cks, clidx, 0, keepdims=False)
+            vsc = lax.dynamic_index_in_dim(cvs, clidx, 0, keepdims=False)
+            attn = sdp_attention_paged(q, kq, vq, block_tables, pos,
+                                       scale=scale, sliding_window=sw,
+                                       logits_soft_cap=cfg.attn_soft_cap,
+                                       alibi_slopes=slopes,
+                                       k_scale=ksc, v_scale=vsc)
+        else:
+            ck, cv = paged_update_layer(ck, cv, clidx, k, v, pos,
+                                        block_tables)
+            kf = lax.dynamic_index_in_dim(ck, clidx, 0, keepdims=False)
+            vf = lax.dynamic_index_in_dim(cv, clidx, 0, keepdims=False)
+            attn = sdp_attention_paged(q, kf, vf, block_tables, pos,
+                                       scale=scale, sliding_window=sw,
+                                       logits_soft_cap=cfg.attn_soft_cap,
+                                       alibi_slopes=slopes)
+        out = (ck, cv, cks, cvs)
+    elif cache_ctx is not None:
         ck, cv, cks, cvs, clidx, pos = cache_ctx
         if cks is not None:
             # block-scaled storage: quantize-on-append, then hand raw
@@ -634,7 +666,8 @@ def _attn_block(hidden, lp, cfg: LlamaConfig, cos, sin, slopes,
 
 
 def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, slopes,
-                   cache_ctx=None, lidx=None, record=None):
+                   cache_ctx=None, lidx=None, record=None,
+                   block_tables=None):
     """One transformer block, sequential/parallel/sandwich residual.
 
     `record(key, activation)` (optional, trace-time) observes the input of
@@ -643,7 +676,8 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, slopes,
     hidden = _norm(x, lp["input_layernorm"],
                    lp.get("input_layernorm_bias"), cfg)
     attn_out, cache_out = _attn_block(hidden, lp, cfg, cos, sin, slopes,
-                                      cache_ctx, lidx=lidx, record=record)
+                                      cache_ctx, lidx=lidx, record=record,
+                                      block_tables=block_tables)
     if cfg.sandwich_norms:
         # gemma2: x += postnorm(attn(prenorm(x))); same sandwich for mlp
         attn_out = _norm(attn_out, lp["post_attention_layernorm"],
@@ -748,6 +782,56 @@ def forward_last_token(
     """Prefill variant of `forward` with lm_head on the final position only."""
     return forward(params, cfg, tokens, cache, compute_dtype=compute_dtype,
                    last_only=True, visual=visual)
+
+
+def _paged_layer_step(cfg: LlamaConfig, slopes, block_tables, carry, xs):
+    x, ck, cv, cks, cvs, pos, cos, sin = carry
+    lp, lidx = xs
+    x, (ck, cv, cks, cvs) = _decoder_layer(
+        x, lp, cfg, cos, sin, slopes,
+        cache_ctx=(ck, cv, cks, cvs, lidx, pos), lidx=lidx,
+        block_tables=block_tables)
+    return (x, ck, cv, cks, cvs, pos, cos, sin), None
+
+
+def forward_paged(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    tokens: jax.Array,        # [B, Sq] int32
+    cache: PagedKVCache,
+    block_tables: jax.Array,  # [B, NP] int32
+    compute_dtype=jnp.bfloat16,
+    last_only: bool = False,
+) -> Tuple[jax.Array, PagedKVCache]:
+    """`forward` over a paged KV arena: appends scatter through the
+    block table, attention gathers through it (fused on TPU). Positions
+    are always per-slot ([B] `cache.pos`) — the paged layout exists for
+    continuous batching. With ``NP * page_size == max_seq`` the logits
+    are byte-identical to the slab `forward` at equal positions (tests
+    pin this for bf16/int8/int4 storage)."""
+    b, sq = tokens.shape
+    pos = cache.pos
+
+    inv_freq, rope_mscale = model_rope_freqs(cfg)
+    positions = pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None, :]
+    cos, sin = rope_cos_sin(positions, inv_freq)           # [B, Sq, hd/2]
+    x = embed_prologue(params, cfg, tokens, positions, compute_dtype)
+    if rope_mscale != 1.0:             # yarn attention temperature
+        cos, sin = cos * rope_mscale, sin * rope_mscale
+    slopes = _model_slopes(cfg)
+
+    lidx = jnp.arange(cfg.num_hidden_layers, dtype=jnp.int32)
+    (x, ck, cv, cks, cvs, _, _, _), _ = lax.scan(
+        lambda c, xs: _paged_layer_step(cfg, slopes, block_tables, c, xs),
+        (x, cache.k, cache.v, cache.k_scale, cache.v_scale, pos, cos, sin),
+        (params["layers"], lidx),
+    )
+
+    if last_only:
+        x = x[:, -1:, :]
+    x = _norm(x, params["norm"], params.get("norm_bias"), cfg)
+    logits = _lm_head(x, params, cfg)
+    return logits, PagedKVCache(ck, cv, pos + sq, cks, cvs)
 
 
 def ext_attn_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn):
@@ -862,6 +946,10 @@ def forward_train(
 # serving consults the attribute before enabling block-scaled storage
 SUPPORTS_SCALED_KV = True
 
+# this family's forward_paged threads block tables through its scan;
+# serving consults the attribute before enabling the paged KV arena
+SUPPORTS_PAGED_KV = True
+
 
 def new_cache(cfg: LlamaConfig, batch: int, max_seq: int,
               quantized=False) -> KVCache:
@@ -870,6 +958,14 @@ def new_cache(cfg: LlamaConfig, batch: int, max_seq: int,
     return init_cache(cfg.num_hidden_layers, batch, max_seq,
                       cfg.num_key_value_heads, cfg.hd,
                       quantized=quantized)
+
+
+def new_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int,
+                    batch: int, kv_cache_dtype=None) -> PagedKVCache:
+    """Allocate this family's page arena (`ops/paged.py` layout)."""
+    return init_paged_cache(cfg.num_hidden_layers, num_pages, page_size,
+                            cfg.num_key_value_heads, cfg.hd, batch,
+                            kv_cache_dtype=kv_cache_dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -93,6 +93,9 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
         if kind == "decode":
             from bigdl_tpu.ops.pallas.decode_attention import (
                 decode_attention_pallas as kernel)
+        elif kind == "paged_decode":
+            from bigdl_tpu.ops.pallas.paged_decode_attention import (
+                paged_decode_attention_pallas as kernel)
         else:
             from bigdl_tpu.ops.pallas.prefill_attention import (
                 prefill_attention_pallas as kernel)
@@ -105,6 +108,29 @@ def _kernel_compiles(kind: str, h: int, hkv: int, hd: int, sq: int,
         # trace — a concrete call here used to die on live TPUs with
         # "Evaluation rule for 'program_id' not implemented".
         kdt = jnp.dtype(kv_dtype_name)
+        if kind == "paged_decode":
+            # paged probe overloads the key slots: sq carries page_size,
+            # skv carries the block-table width (logical pages)
+            ps, np_ = sq, skv
+            arena = jax.ShapeDtypeStruct((np_ + 1, ps, hkv, hd), kdt)
+            bt = jax.ShapeDtypeStruct((1, np_), jnp.int32)
+            pos = jax.ShapeDtypeStruct((1,), jnp.int32)
+            qq = jax.ShapeDtypeStruct((1, 1, h, hd), jnp.bfloat16)
+            if kv_dtype_name in ("int8", "int4"):
+                sc = jax.ShapeDtypeStruct((np_ + 1, ps, hkv), jnp.float32)
+                probe_compile(
+                    lambda q_, k_, v_, b_, p_, ks, vs: kernel(
+                        q_, k_, v_, b_, p_, hd ** -0.5,
+                        k_scale=ks, v_scale=vs),
+                    qq, arena, arena, bt, pos, sc, sc)
+            else:
+                probe_compile(
+                    lambda q_, k_, v_, b_, p_: kernel(
+                        q_, k_, v_, b_, p_, hd ** -0.5),
+                    qq, arena, arena, bt, pos)
+            _probe_cache[key] = True
+            record_probe_result("paged_decode_attention", True)
+            return True
         if kv_dtype_name in ("int8", "int4"):
             # block-scaled codes probe with their f32 scale planes — the
             # scaled kernel bodies are distinct Mosaic programs
@@ -290,3 +316,81 @@ def sdp_attention(
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(jnp.bfloat16), vf,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+def sdp_attention_paged(
+    q: jax.Array,             # [B, Sq, H, D] (post-RoPE)
+    arena_k: jax.Array,       # [P, ps, Hkv, D] one layer's page arena
+    arena_v: jax.Array,
+    block_tables: jax.Array,  # [B, NP] int32 (0 = null page)
+    q_pos: jax.Array,         # [B] int32 per-slot positions
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    alibi_slopes: Optional[jax.Array] = None,
+    backend: Optional[str] = None,
+    k_scale: Optional[jax.Array] = None,   # [P, ps, Hkv] f32 arena scales
+    v_scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Causal SDP reading K/V through a block table (paged cache).
+
+    Decode (Sq=1) on TPU dispatches to the paged Pallas kernel, whose
+    BlockSpec index_maps dereference the prefetched block table — the
+    gather never materializes a dense copy. Everywhere else the fallback
+    the ISSUE names runs: an XLA ``take`` over the table reassembles the
+    dense ``[B, NP * ps, Hkv, D]`` view (shape-identical to the slab
+    read, ``NP * ps == max_seq``) and the regular `sdp_attention`
+    dispatch finishes the job — so paged decode is byte-identical to
+    slab decode wherever both take the XLA path, and the slab decode
+    kernel still serves gathered views on TPU when the paged kernel
+    cannot lower."""
+    b, sq, h, d = q.shape
+    ps, hkv = arena_k.shape[1], arena_k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    quant_name = (str(arena_k.dtype)
+                  if arena_k.dtype not in (jnp.bfloat16, jnp.float16,
+                                           jnp.float32)
+                  else None)
+
+    from bigdl_tpu.config import flags, target_is_tpu, under_spmd
+
+    be = backend or flags().attention_backend
+    if be in ("auto", "pallas") and under_spmd(q, arena_k, arena_v):
+        be = "xla" if be == "auto" else be
+    if be in ("auto", "pallas"):
+        from bigdl_tpu.ops.pallas.paged_decode_attention import (
+            paged_decode_attention_pallas, paged_decode_attention_supported)
+
+        supported = paged_decode_attention_supported(
+            q, arena_k, logits_soft_cap, sliding_window, alibi_slopes,
+            k_scale)
+        on_tpu = target_is_tpu()
+        if supported and be == "pallas":
+            if quant_name:
+                _note_dequant_path(quant_name, "fused")
+            return paged_decode_attention_pallas(
+                q, arena_k, arena_v, block_tables, q_pos, float(scale),
+                interpret=not on_tpu, k_scale=k_scale, v_scale=v_scale)
+        if supported and on_tpu and _kernel_compiles(
+                "paged_decode", h, hkv, d, ps, block_tables.shape[1],
+                str(arena_k.dtype)):
+            if quant_name:
+                _note_dequant_path(quant_name, "fused")
+            return paged_decode_attention_pallas(
+                q, arena_k, arena_v, block_tables, q_pos, float(scale),
+                k_scale=k_scale, v_scale=v_scale)
+
+    from bigdl_tpu.ops.paged import _gather_dense
+
+    kd = _gather_dense(arena_k, block_tables)
+    vd = _gather_dense(arena_v, block_tables)
+    ksd = vsd = None
+    if k_scale is not None:
+        ksd = _gather_dense(k_scale, block_tables)
+        vsd = _gather_dense(v_scale, block_tables)
+    return sdp_attention(q, kd, vd, q_pos, scale=scale,
+                         logits_soft_cap=logits_soft_cap,
+                         sliding_window=sliding_window,
+                         alibi_slopes=alibi_slopes, backend=backend,
+                         k_scale=ksd, v_scale=vsd)

@@ -1,0 +1,340 @@
+"""Paged KV cache: one page arena per layer + per-sequence block tables.
+
+The slab cache (`ops/kvcache.py`) reserves `[L, max_batch, max_seq, H, D]`
+up front — every slot pays worst-case `max_seq` whether it holds a 30-token
+chat turn or a book. This module replaces the per-slot axis with a pooled
+one: a single ``[L, num_pages, page_size, H, D]`` arena per K/V plane and an
+int32 **block table** per sequence mapping logical page -> physical page
+(the vLLM PagedAttention layout, re-done for XLA's static shapes). Memory
+now scales with *live tokens*, so concurrency is bounded by real KV
+footprint instead of ``max_batch * max_seq`` worst case, and refcounted
+pages can be shared copy-on-write across requests that start with the same
+prompt prefix (the radix tree in ``serving/pagepool.py``).
+
+Static-shape rules (everything the slab layout promised still holds):
+
+- The arena never reallocates; appends are advanced-index scatters
+  ``arena.at[layer, phys, off].set(...)`` where ``phys``/``off`` come from
+  the block table — one shape for the jit-compiled step's whole lifetime.
+- Block tables are dense ``[B, NP]`` with ``NP = max_seq // page_size``;
+  unallocated logical pages map to **page 0**, the reserved null/trash
+  page. Out-of-range or padded writes land there and out-of-range reads
+  gather it — both only ever touch positions attention masks out
+  (``k_ids > pos``), so the garbage is never observable.
+- Validity is still a per-slot ``pos``; the dense gather
+  ``arena[block_tables]`` reshapes to exactly the ``[B, max_seq, H, D]``
+  view the slab path reads, which is what makes paged decode byte-identical
+  to slab decode (tests assert it for bf16/int8/int4).
+
+int8/int4 storage carries the same per-(token, head) scale planes as the
+slab cache — quantization happens in `paged_update_layer` with the exact
+`quantize_kv` call `update_layer` uses, so codes and scales match the slab
+bit for bit and pages stay in the tile-wise low-bit layout the fused
+kernels stream (BitDecoding's packing argument, PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops.kvcache import (
+    KV_CACHE_DTYPES,
+    SCALED_KV_DTYPES,
+    _logical_nbytes,
+    kv_cache_nbytes,
+    kv_dtype_name,
+    quantize_kv,
+    resolve_kv_cache_dtype,
+)
+
+#: physical page 0 is never handed out: it is the write sink for padded /
+#: out-of-range positions and the gather source for unallocated logical
+#: pages. Its contents are garbage by design — attention masks every
+#: position that could read it.
+NULL_PAGE = 0
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PagedKVCache:
+    """Page-arena KV storage. Block tables are NOT part of the pytree —
+    they are host-owned scheduling state (numpy, mutated per admission/
+    finish) and ride into the jit as a separate ``[B, NP]`` operand, so
+    donating the cache never aliases the table."""
+
+    k: jax.Array    # [L, P, page_size, H_kv, D] storage dtype
+    v: jax.Array    # [L, P, page_size, H_kv, D]
+    pos: jax.Array  # [B] int32: per-slot number of valid positions
+    # per-(token, head) f32 dequant scales for int8/int4 storage;
+    # None for the scale-free dtypes (bf16 / fp8_e5m2)
+    k_scale: Optional[jax.Array] = None   # [L, P, page_size, H_kv] f32
+    v_scale: Optional[jax.Array] = None
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.pos, self.k_scale, self.v_scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def batch(self) -> int:
+        return self.pos.shape[0]
+
+    @property
+    def kv_dtype(self) -> str:
+        """Canonical kv_cache_dtype name of the storage."""
+        return kv_dtype_name(self.k.dtype)
+
+
+def init_paged_cache(
+    num_layers: int,
+    num_pages: int,
+    page_size: int,
+    kv_heads: int,
+    head_dim: int,
+    batch: int,
+    dtype=jnp.bfloat16,
+    kv_cache_dtype: Optional[str] = None,
+) -> PagedKVCache:
+    """Allocate an empty page arena (page 0 included — the null page is
+    a real physical page so every block-table entry stays a valid
+    index)."""
+    name = resolve_kv_cache_dtype(kv_cache_dtype)
+    dt = dtype if name == "bf16" else KV_CACHE_DTYPES[name]
+    shape = (num_layers, num_pages, page_size, kv_heads, head_dim)
+    scaled = name in SCALED_KV_DTYPES
+    sshape = (num_layers, num_pages, page_size, kv_heads)
+    return PagedKVCache(
+        k=jnp.zeros(shape, dt),
+        v=jnp.zeros(shape, dt),
+        pos=jnp.zeros((batch,), jnp.int32),
+        k_scale=jnp.zeros(sshape, jnp.float32) if scaled else None,
+        v_scale=jnp.zeros(sshape, jnp.float32) if scaled else None,
+    )
+
+
+def _page_offsets(pos: jax.Array, s_new: int, page_size: int,
+                  block_tables: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """(phys, off) write coordinates for ``s_new`` tokens appended at
+    per-slot ``pos``. Positions whose logical page is past the table
+    width redirect to the null page (their offsets stay in range, so the
+    scatter is always well-formed)."""
+    npp = block_tables.shape[1]
+    abs_pos = pos.reshape(-1, 1) + jnp.arange(s_new, dtype=jnp.int32)
+    lp = abs_pos // page_size                                 # [B, Sn]
+    off = abs_pos % page_size
+    phys = jnp.take_along_axis(
+        block_tables, jnp.clip(lp, 0, npp - 1), axis=1)
+    phys = jnp.where(lp < npp, phys, NULL_PAGE)
+    return phys, off
+
+
+def paged_update_layer(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    layer: jax.Array | int,
+    k_new: jax.Array,   # [B, S_new, H_kv, D]
+    v_new: jax.Array,
+    pos: jax.Array,     # [B] int32 per-slot append offsets
+    block_tables: jax.Array,   # [B, NP] int32
+    cache_ks: Optional[jax.Array] = None,
+    cache_vs: Optional[jax.Array] = None,
+):
+    """Append k_new/v_new through the block table (the paged analog of
+    `update_layer` with per-slot pos). Quantization is the same
+    `quantize_kv` call the slab path makes, so stored codes/scales are
+    bit-identical to a slab cache written at the same positions. Returns
+    (ck, cv) or, with scale planes, (ck, cv, cks, cvs)."""
+    scaled = cache_ks is not None
+    if scaled:
+        k_new, ks_new = quantize_kv(k_new, cache_k.dtype)
+        v_new, vs_new = quantize_kv(v_new, cache_v.dtype)
+    else:
+        k_new = k_new.astype(cache_k.dtype)
+        v_new = v_new.astype(cache_v.dtype)
+    ps = cache_k.shape[2]
+    phys, off = _page_offsets(pos, k_new.shape[1], ps, block_tables)
+
+    ck_l = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
+    cv_l = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
+    ck_l = ck_l.at[phys, off].set(k_new)
+    cv_l = cv_l.at[phys, off].set(v_new)
+    ck = jax.lax.dynamic_update_index_in_dim(cache_k, ck_l, layer, 0)
+    cv = jax.lax.dynamic_update_index_in_dim(cache_v, cv_l, layer, 0)
+    if not scaled:
+        return ck, cv
+    ks_l = jax.lax.dynamic_index_in_dim(cache_ks, layer, 0, keepdims=False)
+    vs_l = jax.lax.dynamic_index_in_dim(cache_vs, layer, 0, keepdims=False)
+    ks_l = ks_l.at[phys, off].set(ks_new)
+    vs_l = vs_l.at[phys, off].set(vs_new)
+    return (ck, cv,
+            jax.lax.dynamic_update_index_in_dim(cache_ks, ks_l, layer, 0),
+            jax.lax.dynamic_update_index_in_dim(cache_vs, vs_l, layer, 0))
+
+
+def _gather_dense(plane_l: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """``[P, ps, ...]`` layer plane -> dense ``[B, NP * ps, ...]`` via an
+    XLA `take` over the table — the fallback read the ISSUE names. With
+    ``NP * ps == max_seq`` the result is shape-identical to the slab
+    layout's per-layer read."""
+    g = jnp.take(plane_l, block_tables, axis=0)   # [B, NP, ps, ...]
+    return g.reshape((g.shape[0], g.shape[1] * g.shape[2]) + g.shape[3:])
+
+
+def paged_read_layer(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    layer: jax.Array | int,
+    block_tables: jax.Array,
+    compute_dtype=jnp.bfloat16,
+    cache_ks: Optional[jax.Array] = None,
+    cache_vs: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Dense full-length K/V for one layer, gathered through the block
+    table and upcast (dequantized when scale planes are given)."""
+    from bigdl_tpu.ops.kvcache import dequantize_kv
+
+    k = _gather_dense(jax.lax.dynamic_index_in_dim(
+        cache_k, layer, 0, keepdims=False), block_tables)
+    v = _gather_dense(jax.lax.dynamic_index_in_dim(
+        cache_v, layer, 0, keepdims=False), block_tables)
+    if cache_ks is not None:
+        ks = _gather_dense(jax.lax.dynamic_index_in_dim(
+            cache_ks, layer, 0, keepdims=False), block_tables)
+        vs = _gather_dense(jax.lax.dynamic_index_in_dim(
+            cache_vs, layer, 0, keepdims=False), block_tables)
+        return (dequantize_kv(k, ks, compute_dtype),
+                dequantize_kv(v, vs, compute_dtype))
+    return k.astype(compute_dtype), v.astype(compute_dtype)
+
+
+def paged_read_layer_quantized(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    cache_ks: jax.Array,
+    cache_vs: jax.Array,
+    layer: jax.Array | int,
+    block_tables: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One layer's raw codes + scales gathered dense (no dequant) — the
+    feed for `sdp_attention(.., k_scale=, v_scale=)` so the upcast stays
+    inside the fused kernels."""
+    k = _gather_dense(jax.lax.dynamic_index_in_dim(
+        cache_k, layer, 0, keepdims=False), block_tables)
+    v = _gather_dense(jax.lax.dynamic_index_in_dim(
+        cache_v, layer, 0, keepdims=False), block_tables)
+    ks = _gather_dense(jax.lax.dynamic_index_in_dim(
+        cache_ks, layer, 0, keepdims=False), block_tables)
+    vs = _gather_dense(jax.lax.dynamic_index_in_dim(
+        cache_vs, layer, 0, keepdims=False), block_tables)
+    return k, v, ks, vs
+
+
+def cow_copy_pages(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    srcs: jax.Array,    # [N] int32 physical source pages
+    dsts: jax.Array,    # [N] int32 physical destination pages
+    cache_ks: Optional[jax.Array] = None,
+    cache_vs: Optional[jax.Array] = None,
+):
+    """Copy whole pages src -> dst across every layer (the copy half of
+    copy-on-write). Pair lists are fixed-length per compile — the engine
+    pads with (0, 0) null-page self-copies, which are harmless no-ops on
+    never-read data. Sources are gathered BEFORE the scatter, so a pair
+    list that read and wrote the same page would still see pre-copy
+    bytes."""
+    ck = cache_k.at[:, dsts].set(jnp.take(cache_k, srcs, axis=1))
+    cv = cache_v.at[:, dsts].set(jnp.take(cache_v, srcs, axis=1))
+    if cache_ks is None:
+        return ck, cv
+    cks = cache_ks.at[:, dsts].set(jnp.take(cache_ks, srcs, axis=1))
+    cvs = cache_vs.at[:, dsts].set(jnp.take(cache_vs, srcs, axis=1))
+    return ck, cv, cks, cvs
+
+
+def gather_pages_dense(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    pages: jax.Array,   # [n] int32 physical pages (0-padded tail)
+    cache_ks: Optional[jax.Array] = None,
+    cache_vs: Optional[jax.Array] = None,
+):
+    """Materialize ``n`` pages as dense ``[L, 1, n * ps, H, D]`` planes —
+    the slab layout a private prefill cache expects, used to seed an
+    admission's cache1 from radix-shared pages. Padding pages contribute
+    garbage past the seeded length, which the prefill either overwrites
+    or masks (positions > pos are never attended)."""
+    def dense(plane):
+        g = jnp.take(plane, pages, axis=1)        # [L, n, ps, ...]
+        return g.reshape(
+            (g.shape[0], 1, g.shape[1] * g.shape[2]) + g.shape[3:])
+
+    k, v = dense(cache_k), dense(cache_v)
+    if cache_ks is None:
+        return k, v
+    return k, v, dense(cache_ks), dense(cache_vs)
+
+
+def paged_cache_nbytes(num_layers: int, num_pages: int, page_size: int,
+                       kv_heads: int, head_dim: int,
+                       kv_cache_dtype: Optional[str] = None
+                       ) -> Dict[str, int]:
+    """Storage footprint of a would-be arena without allocating it.
+    By substitution (batch -> num_pages, max_seq -> page_size) this is
+    exactly `kv_cache_nbytes`'s math, so an arena of
+    ``old_batch * (max_seq // page_size)`` pages costs byte-for-byte what
+    the old slab did — the equivalence the ledger-budget acceptance test
+    leans on."""
+    return kv_cache_nbytes(num_layers, num_pages, page_size, kv_heads,
+                           head_dim, kv_cache_dtype)
+
+
+def paged_cache_bytes(cache: PagedKVCache) -> Dict[str, int]:
+    """Storage footprint of a live arena: codes, scales, total."""
+    codes = _logical_nbytes(cache.k) + _logical_nbytes(cache.v)
+    scales = 0
+    if cache.k_scale is not None:
+        scales = (_logical_nbytes(cache.k_scale)
+                  + _logical_nbytes(cache.v_scale))
+    return {"codes": codes, "scales": scales, "total": codes + scales}
+
+
+def publish_paged_cache_bytes(cache: PagedKVCache,
+                              registry=None) -> Dict[str, int]:
+    """Set the `bigdl_tpu_kv_cache_bytes` gauge from the arena footprint
+    (same metric family as the slab cache — dashboards keep working).
+    Best-effort: metric export never gates allocation."""
+    sizes = paged_cache_bytes(cache)
+    try:
+        if registry is None:
+            from bigdl_tpu.observability import default_registry
+            registry = default_registry()
+        g = registry.gauge(
+            "bigdl_tpu_kv_cache_bytes",
+            "KV cache storage bytes by dtype and component "
+            "(codes | scales | total); int4 counted at two codes per byte",
+            labelnames=("dtype", "component"))
+        for comp, val in sizes.items():
+            g.labels(cache.kv_dtype, comp).set(float(val))
+    except Exception:
+        pass
+    return sizes

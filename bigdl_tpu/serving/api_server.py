@@ -1208,6 +1208,19 @@ def main():
                     help="fleet role (default $BIGDL_TPU_REPLICA_ROLE "
                          "or 'mixed'): prefill replicas ship KV to "
                          "decode replicas after chunked prefill")
+    ap.add_argument("--kv-page-size", type=int, default=None,
+                    help="positions per KV page (power of two; 0 = "
+                         "per-slot slab; default "
+                         "$BIGDL_TPU_KV_PAGE_SIZE or slab)")
+    ap.add_argument("--kv-pages", type=int, default=None,
+                    help="paged-KV arena size in pages (0 = auto-size "
+                         "to max_batch*max_seq; default "
+                         "$BIGDL_TPU_KV_PAGES)")
+    ap.add_argument("--prefix-sharing", default=None,
+                    choices=["auto", "on", "off"],
+                    help="radix-tree prompt-prefix page sharing for "
+                         "the paged KV cache (default "
+                         "$BIGDL_TPU_PREFIX_SHARING or auto)")
     args = ap.parse_args()
     role = resolve_replica_role(args.role)
 
@@ -1241,7 +1254,9 @@ def main():
     # prefix cache off unless opted in elsewhere
     engine = LLMEngine(model, EngineConfig(
         max_batch=args.max_batch, max_seq=args.max_seq,
-        prefix_cache_entries=32 if role == "prefill" else 0))
+        prefix_cache_entries=32 if role == "prefill" else 0,
+        kv_page_size=args.kv_page_size, kv_pages=args.kv_pages,
+        prefix_sharing=args.prefix_sharing))
     # span timelines name this process by its listen port, so the
     # router's merged /v1/trace/{id} view tells the replicas apart
     engine.spans.service = f"replica:{args.port}"

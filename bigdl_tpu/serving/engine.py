@@ -42,7 +42,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.config import decode_resident_enabled, sentinel_enabled
+from bigdl_tpu.config import (decode_resident_enabled, flags,
+                              resolve_kv_page_size, resolve_kv_pages,
+                              resolve_prefix_sharing, sentinel_enabled)
 from bigdl_tpu.observability import roofline
 from bigdl_tpu.observability.compile_watch import (annotate_costs,
                                                    compiles_in_progress,
@@ -61,12 +63,16 @@ from bigdl_tpu.ops.kvcache import (KVCache, init_cache, kv_cache_bytes,
                                    kv_cache_nbytes,
                                    publish_kv_cache_bytes,
                                    resolve_kv_cache_dtype)
+from bigdl_tpu.ops.paged import (NULL_PAGE, PagedKVCache, cow_copy_pages,
+                                 gather_pages_dense, paged_cache_bytes,
+                                 publish_paged_cache_bytes)
 from bigdl_tpu.robustness import (resolve_drain_timeout_sec,
                                   resolve_request_deadline_ms)
 from bigdl_tpu.robustness.faults import FaultInjector
 from bigdl_tpu.serving.overload import (QOS_CLASSES, SHED_REASONS,
                                         OverloadConfig, OverloadController,
                                         RequestShed)
+from bigdl_tpu.serving.pagepool import PagePool, RadixCache
 
 
 class EngineDraining(RuntimeError):
@@ -208,6 +214,32 @@ class EngineConfig:
     # only the first N prompt tokens are snapshotted — bounds the D2H
     # transfer and host memory per entry (system prompts live here)
     prefix_cache_max_tokens: int = 1024
+    # -- paged KV cache (ops/paged.py + serving/pagepool.py) ----------
+    # token positions per arena page. None defers to
+    # $BIGDL_TPU_KV_PAGE_SIZE; 0 keeps the per-slot slab. Must be a
+    # power of two dividing max_seq. With paging on, the per-slot slab
+    # becomes one [P, page_size, H, hd] arena per layer addressed
+    # through per-sequence block tables, and prompt prefixes are shared
+    # copy-on-write across requests via a radix tree.
+    kv_page_size: Optional[int] = None
+    # total physical pages in the arena. None defers to
+    # $BIGDL_TPU_KV_PAGES; 0 auto-sizes to max_batch *
+    # (max_seq / page_size) + 1 — the slab's worst case plus the pinned
+    # null page. Configure it below that to oversubscribe: admission
+    # then depends on prefix sharing actually deduplicating pages.
+    kv_pages: Optional[int] = None
+    # radix-tree prefix sharing across requests (paged mode only).
+    # None defers to $BIGDL_TPU_PREFIX_SHARING; "auto"/"on" share
+    # full-page prompt chunks copy-on-write, "off" keeps every
+    # sequence's pages private.
+    prefix_sharing: Optional[str] = None
+    # retention bound for prefix-cache entries seeded by remote KV
+    # handoffs (disaggregated prefill). -1 defers to 2 * max_batch;
+    # 0 drops staged snapshots outright. Kept SEPARATE from
+    # prefix_cache_entries so a decode-role replica that disables the
+    # local prefix cache (prefix_cache_entries == 0) still expresses
+    # an explicit bound instead of silently re-enabling caching.
+    handoff_cache_entries: int = -1
     # headroom-aware admission: an admission whose private prefill
     # cache would push bytes_in_use past this fraction of the device's
     # bytes_limit is deferred (FCFS order kept) until headroom returns.
@@ -333,6 +365,14 @@ class _Admission:
     # level change mid-admission must not change the chunk width the
     # private cache was sized for
     chunk: int
+    # paged mode (kv_page_size > 0): radix pages seeding the prompt
+    # prefix (one slot reference each, taken at admission start) and
+    # the freshly allocated private pages. The slot's block-table row
+    # is written only at COMPLETION — until then it stays all-null, so
+    # mid-admission decode steps of other slots can never write into
+    # shared data through this row.
+    shared_pages: Optional[List[int]] = None
+    new_pages: Optional[List[int]] = None
 
 
 def _device_sample_rows(lg, temps, top_ks, top_ps, seeds, poss):
@@ -423,10 +463,55 @@ class LLMEngine:
                 f"that threads scale planes through its forward; "
                 f"{getattr(self.family, 'name', '?')!r} does not "
                 "(SUPPORTS_SCALED_KV)")
-        self.cache = init_cache(
-            self.cfg.num_hidden_layers, B, ce.max_seq,
-            self.cfg.num_key_value_heads, self.cfg.hd,
-            kv_cache_dtype=self.kv_cache_dtype, per_slot_pos=True)
+        # -- paged KV mode: one [P, page_size, H, hd] arena per layer +
+        # host-owned block tables instead of the per-slot slab.
+        # Explicit EngineConfig values validate loudly here; env-driven
+        # values already passed through config.flags() (typos fall back
+        # to off/auto and utils/env_check.py reports them).
+        page_size = resolve_kv_page_size(
+            ce.kv_page_size if ce.kv_page_size is not None
+            else flags().kv_page_size)
+        n_pages_spec = resolve_kv_pages(
+            ce.kv_pages if ce.kv_pages is not None else flags().kv_pages)
+        sharing = resolve_prefix_sharing(
+            ce.prefix_sharing if ce.prefix_sharing is not None
+            else flags().prefix_sharing)
+        self._paged = page_size > 0
+        self._page_size = page_size
+        self.pool: Optional[PagePool] = None
+        self.radix: Optional[RadixCache] = None
+        if self._paged:
+            if not getattr(self.family, "SUPPORTS_PAGED_KV", False):
+                raise ValueError(
+                    f"kv_page_size={page_size} needs a family with a "
+                    f"paged forward (SUPPORTS_PAGED_KV); "
+                    f"{getattr(self.family, 'name', '?')!r} has none")
+            if ce.max_seq % page_size:
+                raise ValueError(
+                    f"max_seq {ce.max_seq} must be a multiple of "
+                    f"kv_page_size {page_size}")
+            self._pages_per_seq = ce.max_seq // page_size
+            self._num_pages = n_pages_spec or B * self._pages_per_seq + 1
+            self.cache = self.family.new_paged_cache(
+                self.cfg, self._num_pages, page_size, B,
+                kv_cache_dtype=self.kv_cache_dtype)
+            self.pool = PagePool(self._num_pages, page_size)
+            if sharing != "off":
+                self.radix = RadixCache(self.pool)
+            # host-authoritative block tables ([B, pages_per_seq] int32,
+            # 0 = null page); the device mirror refreshes lazily through
+            # _bt() only when a row changed, so the per-token step path
+            # never indexes page state on the host
+            self._bt_np = np.zeros((B, self._pages_per_seq), np.int32)
+            self._bt_dev = jnp.asarray(self._bt_np)
+            self._bt_dirty = False
+        else:
+            self._pages_per_seq = 0
+            self._num_pages = 0
+            self.cache = init_cache(
+                self.cfg.num_hidden_layers, B, ce.max_seq,
+                self.cfg.num_key_value_heads, self.cfg.hd,
+                kv_cache_dtype=self.kv_cache_dtype, per_slot_pos=True)
 
         self.slots = [_Slot() for _ in range(B)]
         # deque (admission pops the front; preemption appends the back)
@@ -649,6 +734,100 @@ class LLMEngine:
             return fwd(params, self.cfg, tokens, cache1)
 
         self._prefill = prefill_chunk
+
+        # -- paged-mode executables. Prefill stays on the slab path (a
+        # private 1-row cache1 per admission); only the splice into the
+        # batched store, the cross-request page machinery, and the
+        # decode step itself change shape.
+        if self._paged:
+            fwd_paged = self.family.forward_paged
+
+            # paged decode: same contract as engine_decode, but K/V
+            # gathers go through the block tables INSIDE the jit — the
+            # host never indexes the arena per token (graftlint's
+            # paged-host-gather rule holds the line)
+            @functools.partial(tracked_jit, "engine_decode_paged",
+                               registry=self.registry,
+                               donate_argnums=(2,))
+            def decode_paged(params, tokens, cache, block_tables):
+                logits, cache = fwd_paged(
+                    params, self.cfg, tokens[:, None], cache,
+                    block_tables, last_only=True)
+                return logits[:, -1, :], cache
+
+            self._decode_paged = decode_paged
+
+            # splice a finished admission's private cache1 into the
+            # arena: per-token (page, offset) coordinates are computed
+            # on host ONCE per admission; positions inside the shared
+            # prefix (and chunk padding) point at the null page, the
+            # arena's write sink
+            @functools.partial(tracked_jit, "engine_insert_paged",
+                               registry=self.registry,
+                               donate_argnums=(0,))
+            def insert_paged(cache, cache1, phys, off, slot, plen):
+                cap = phys.shape[0]
+                k = cache.k.at[:, phys, off].set(
+                    cache1.k[:, 0, :cap].astype(cache.k.dtype))
+                v = cache.v.at[:, phys, off].set(
+                    cache1.v[:, 0, :cap].astype(cache.v.dtype))
+                ks = vs = None
+                if cache.k_scale is not None:
+                    ks = cache.k_scale.at[:, phys, off].set(
+                        cache1.k_scale[:, 0, :cap])
+                    vs = cache.v_scale.at[:, phys, off].set(
+                        cache1.v_scale[:, 0, :cap])
+                pos = cache.pos.at[slot].set(plen)
+                return PagedKVCache(k, v, pos, ks, vs)
+
+            self._insert_paged = insert_paged
+
+            # seed a fresh cache1 from shared radix pages: one dense
+            # gather of n full pages into positions [0, n*page_size)
+            @functools.partial(tracked_jit, "engine_seed_pages",
+                               registry=self.registry,
+                               donate_argnums=(0,))
+            def seed_pages(cache1, cache, pages, consumed):
+                planes = gather_pages_dense(
+                    cache.k, cache.v, pages,
+                    cache_ks=cache.k_scale, cache_vs=cache.v_scale)
+                k = jax.lax.dynamic_update_slice(
+                    cache1.k, planes[0].astype(cache1.k.dtype),
+                    (0, 0, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(
+                    cache1.v, planes[1].astype(cache1.v.dtype),
+                    (0, 0, 0, 0, 0))
+                ks = vs = None
+                if cache1.k_scale is not None:
+                    ks = jax.lax.dynamic_update_slice(
+                        cache1.k_scale, planes[2], (0, 0, 0, 0))
+                    vs = jax.lax.dynamic_update_slice(
+                        cache1.v_scale, planes[3], (0, 0, 0, 0))
+                pos = jnp.full_like(cache1.pos, consumed)
+                return KVCache(k, v, pos, ks, vs)
+
+            self._seed_pages = seed_pages
+
+            # batched copy-on-write: gather every shared source page,
+            # scatter into the fresh destinations. Pairs are padded to
+            # max_batch with null->null self-copies so ONE executable
+            # serves every CoW step regardless of how many slots hit
+            # their shared tail page simultaneously.
+            @functools.partial(tracked_jit, "engine_cow_pages",
+                               registry=self.registry,
+                               donate_argnums=(0,))
+            def cow_pages(cache, srcs, dsts):
+                planes = cow_copy_pages(
+                    cache.k, cache.v, srcs, dsts,
+                    cache_ks=cache.k_scale, cache_vs=cache.v_scale)
+                if cache.k_scale is not None:
+                    k, v, ks, vs = planes
+                else:
+                    (k, v), ks, vs = planes, None, None
+                return PagedKVCache(k, v, cache.pos, ks, vs)
+
+            self._cow_pages = cow_pages
+
         # chunk width must divide the private cache length or the last
         # chunk's dynamic_update_slice would CLAMP its start index and
         # silently overwrite earlier positions — normalize to a power of
@@ -745,7 +924,32 @@ class LLMEngine:
             "bigdl_tpu_admission_deferred_total",
             "Admissions deferred by the headroom guard, by reason.",
             labelnames=("reason",))
-        self._m_deferred.labels("memory")   # render from scrape 1
+        for r in ("memory", "pages"):   # render from scrape 1
+            self._m_deferred.labels(r)
+        # paged-KV observability: pool pressure + radix-tree traffic.
+        # PagePool/RadixCache keep plain host ints (scheduling code
+        # stays metrics-free); _update_gauges mirrors them by delta-inc
+        # once per working step.
+        self._m_pool_exhausted = m.counter(
+            "bigdl_tpu_page_pool_exhausted_total",
+            "KV page-pool allocation failures (admissions deferred on "
+            "pages, copy-on-write eviction fallbacks). bench_diff "
+            "gates this lower-is-better.")
+        self._m_radix_lookups = m.counter(
+            "bigdl_tpu_prefix_radix_lookups_total",
+            "Radix prefix-tree lookups at admission, by outcome.",
+            labelnames=("outcome",))
+        for oc in ("hit", "miss"):       # render from scrape 1
+            self._m_radix_lookups.labels(oc)
+        self._m_radix_tokens = m.counter(
+            "bigdl_tpu_prefix_radix_tokens_total",
+            "Prompt tokens looked up vs already resident in shared "
+            "radix pages.", labelnames=("kind",))
+        for kd in ("looked_up", "hit"):  # render from scrape 1
+            self._m_radix_tokens.labels(kd)
+        self._pub_pool_exhausted = 0     # delta-inc mirror baselines
+        self._pub_radix = {"lookups": 0, "hits": 0,
+                           "lookup_tokens": 0, "hit_tokens": 0}
         self._m_quarantined = m.counter(
             "bigdl_tpu_requests_quarantined_total",
             "Requests failed by blast-radius isolation, by reason.",
@@ -783,20 +987,39 @@ class LLMEngine:
             labelnames=("tenant", "outcome"))
         # batched-cache storage footprint per component (codes vs scales);
         # shapes are static for the engine lifetime, so set once
-        publish_kv_cache_bytes(self.cache, m)
-        # static ledger entries: params (packed, QTensor/int4-aware) and
-        # the batched KV cache; per-slot bytes drive the admission cost
-        kvb = kv_cache_bytes(self.cache)
         self._weight_bytes = tree_nbytes(self.params)
         self.ledger.register(
             "weights", "engine_params", self._weight_bytes,
             family=getattr(self.family, "name",
                            type(self.family).__name__))
-        self.ledger.register(
-            "kv_cache", "engine_batched", kvb["total"],
-            dtype=self.kv_cache_dtype, codes=kvb["codes"],
-            scales=kvb["scales"], slots=B)
-        self._kv_bytes_per_slot = kvb["total"] // B
+        if self._paged:
+            # the arena is the ONE static KV allocation: admission
+            # cost stays the private cache1, and page availability —
+            # not worst-case per-slot bytes — gates concurrency, so
+            # max_batch can rise far past what the slab admitted in
+            # the same ledger budget
+            publish_paged_cache_bytes(self.cache, m)
+            kvb = paged_cache_bytes(self.cache)
+            self.ledger.register(
+                "kv_cache", "engine_paged_arena", kvb["total"],
+                dtype=self.kv_cache_dtype, codes=kvb["codes"],
+                scales=kvb["scales"], pages=self._num_pages,
+                page_size=self._page_size)
+            self._kv_bytes_per_page = kvb["total"] // self._num_pages
+            self._kv_bytes_per_slot = (
+                self._kv_bytes_per_page * self._pages_per_seq)
+        else:
+            publish_kv_cache_bytes(self.cache, m)
+            # static ledger entries: params (packed, QTensor/int4-aware)
+            # and the batched KV cache; per-slot bytes drive the
+            # admission cost
+            kvb = kv_cache_bytes(self.cache)
+            self.ledger.register(
+                "kv_cache", "engine_batched", kvb["total"],
+                dtype=self.kv_cache_dtype, codes=kvb["codes"],
+                scales=kvb["scales"], slots=B)
+            self._kv_bytes_per_slot = kvb["total"] // B
+            self._kv_bytes_per_page = 0
         self.ledger.publish(m)
 
         # -- live roofline attribution + perf-regression sentinel
@@ -851,6 +1074,8 @@ class LLMEngine:
             "engine_init", max_batch=B, max_seq=ce.max_seq,
             kv_cache_dtype=self.kv_cache_dtype,
             kv_cache_total_bytes=kvb["total"],
+            kv_page_size=self._page_size, kv_pages=self._num_pages,
+            prefix_sharing=self.radix is not None,
             prefill_chunk=self._chunk, family=getattr(
                 self.family, "name", type(self.family).__name__))
 
@@ -1187,32 +1412,50 @@ class LLMEngine:
             chunk = min(max(1, self._chunk
                             >> self.overload.chunk_shift()), bucket)
             alloc = -(-bucket // chunk) * chunk
+            shared_pages = new_pages = None
+            if self._paged:
+                # page-side reservation FIRST (before the cache1 HBM
+                # allocation): radix longest-prefix match + worst-case
+                # page grab, or a requeue-and-defer on exhaustion
+                paged_adm = self._paged_admit(req, chunk)
+                if paged_adm is None:
+                    return
+                consumed, shared_pages, new_pages = paged_adm
             cache1 = init_cache(
                 self.cfg.num_hidden_layers, 1, alloc,
                 self.cfg.num_key_value_heads, self.cfg.hd,
                 kv_cache_dtype=self.kv_cache_dtype)
-            consumed, seed_kv = self._seed_from_prefix_cache(
-                req.prompt_token_ids, chunk)
-            if consumed:
-                k_np, v_np = seed_kv[0], seed_kv[1]
-                kb = np.zeros(cache1.k.shape, k_np.dtype)
-                vb = np.zeros_like(kb)
-                kb[:, :, :consumed] = k_np[:, :, :consumed]
-                vb[:, :, :consumed] = v_np[:, :, :consumed]
-                ksb = vsb = None
-                if cache1.k_scale is not None:
-                    ks_np, vs_np = seed_kv[2], seed_kv[3]
-                    ksb = np.zeros(cache1.k_scale.shape, np.float32)
-                    vsb = np.zeros_like(ksb)
-                    ksb[:, :, :consumed] = ks_np[:, :, :consumed]
-                    vsb[:, :, :consumed] = vs_np[:, :, :consumed]
-                    ksb = jnp.asarray(ksb)
-                    vsb = jnp.asarray(vsb)
-                cache1 = KVCache(jnp.asarray(kb), jnp.asarray(vb),
-                                 jnp.asarray(consumed, jnp.int32),
-                                 ksb, vsb)
+            if self._paged:
+                if consumed:
+                    cache1 = self._seed_pages(
+                        cache1, self.cache,
+                        jnp.asarray(np.asarray(shared_pages, np.int32)),
+                        jnp.asarray(consumed, jnp.int32))
+            else:
+                consumed, seed_kv = self._seed_from_prefix_cache(
+                    req.prompt_token_ids, chunk)
+                if consumed:
+                    k_np, v_np = seed_kv[0], seed_kv[1]
+                    kb = np.zeros(cache1.k.shape, k_np.dtype)
+                    vb = np.zeros_like(kb)
+                    kb[:, :, :consumed] = k_np[:, :, :consumed]
+                    vb[:, :, :consumed] = v_np[:, :, :consumed]
+                    ksb = vsb = None
+                    if cache1.k_scale is not None:
+                        ks_np, vs_np = seed_kv[2], seed_kv[3]
+                        ksb = np.zeros(cache1.k_scale.shape, np.float32)
+                        vsb = np.zeros_like(ksb)
+                        ksb[:, :, :consumed] = ks_np[:, :, :consumed]
+                        vsb[:, :, :consumed] = vs_np[:, :, :consumed]
+                        ksb = jnp.asarray(ksb)
+                        vsb = jnp.asarray(vsb)
+                    cache1 = KVCache(jnp.asarray(kb), jnp.asarray(vb),
+                                     jnp.asarray(consumed, jnp.int32),
+                                     ksb, vsb)
             a = self._admitting = _Admission(req, free, bucket, consumed,
-                                             cache1, chunk)
+                                             cache1, chunk,
+                                             shared_pages=shared_pages,
+                                             new_pages=new_pages)
             self.tracer.admitted(req.request_id)
             self.flight.record(
                 "admit_start", step=self._step_idx,
@@ -1241,9 +1484,12 @@ class LLMEngine:
         a.consumed += chunk
 
         if a.consumed >= plen:
-            self._remember_prefix(a.req.prompt_token_ids, a.cache1)
-            self.cache = self._insert(self.cache, a.cache1,
-                                      a.slot_idx, plen)
+            if self._paged:
+                self.cache = self._paged_insert(a, plen)
+            else:
+                self._remember_prefix(a.req.prompt_token_ids, a.cache1)
+                self.cache = self._insert(self.cache, a.cache1,
+                                          a.slot_idx, plen)
             s = self.slots[a.slot_idx]
             s.req = a.req
             self._setup_slot_sampler(s)
@@ -1256,6 +1502,187 @@ class LLMEngine:
             self._emit(s, lp)
             self._check_done(a.slot_idx)
             self._admitting = None
+
+    # -- paged KV bookkeeping (kv_page_size > 0) ----------------------------
+
+    def _bt(self):
+        """Device mirror of the host block tables, refreshed only when
+        a row changed — steady-state decode reuses the resident array
+        (no per-token H2D of page indices)."""
+        if self._bt_dirty:
+            self._bt_dev = jnp.asarray(self._bt_np)
+            self._bt_dirty = False
+        return self._bt_dev
+
+    def _paged_admit(self, req: Request, chunk: int):
+        """Page-side half of admission start: radix longest-prefix
+        match, then an all-or-nothing grab of every page the sequence
+        can EVER need (prompt + max_tokens, capped at max_seq) — the
+        decode path never allocates, so a running sequence cannot
+        deadlock against an admission for pages. Returns ``(consumed,
+        shared_pages, new_pages)`` or None after requeueing the request
+        (pool exhausted even after evicting idle radix leaves)."""
+        ce = self.cfg_engine
+        prompt = req.prompt_token_ids
+        plen = len(prompt)
+        ps = self._page_size
+        consumed = 0
+        shared: List[int] = []
+        if self.radix is not None:
+            matched, pages = self.radix.match(prompt)
+            # the seeded length must stay aligned to both the prefill
+            # chunk and the page size (powers of two: lcm == max), and
+            # the final prompt token must run to produce logits
+            align = max(chunk, ps)
+            consumed = min(matched, plen - 1)
+            consumed -= consumed % align
+            shared = pages[:consumed // ps]
+        want = min(plen + req.params.max_tokens, ce.max_seq)
+        n_new = -(-want // ps) - len(shared)
+        new = self.pool.alloc(n_new)
+        if new is None and self.radix is not None:
+            # reclaim idle radix leaves (LRU-first; a page a live slot
+            # maps is never an eviction candidate) and retry once
+            self.radix.evict(n_new - self.pool.num_free)
+            new = self.pool.alloc(n_new)
+        if new is None:
+            self.waiting.appendleft(req)
+            self._deferred_admissions += 1
+            self._m_deferred.labels("pages").inc()
+            if not self._deferred_streak:
+                self._deferred_streak = True
+                self.flight.record(
+                    "admit_deferred", step=self._step_idx,
+                    request_id=req.request_id, reason="pages",
+                    needed_pages=n_new, free_pages=self.pool.num_free)
+            return None
+        for p in shared:
+            self.pool.incref(p)          # the slot's own reference
+        return consumed, shared, new
+
+    def _paged_insert(self, a: _Admission, plen: int):
+        """Completion half of a paged admission: write the slot's
+        block-table row (shared prefix pages first, then the private
+        pages), scatter the private cache1 rows into their pages, and
+        publish the prompt's pages — including the partial tail page,
+        the future copy-on-write target — to the radix tree."""
+        idx = a.slot_idx
+        ps = self._page_size
+        shared = a.shared_pages or []
+        row = list(shared) + list(a.new_pages or [])
+        self._bt_np[idx, :] = 0
+        self._bt_np[idx, :len(row)] = row
+        self._bt_dirty = True
+        # per-token scatter coordinates: positions already resident in
+        # shared pages must NOT be rewritten (a concurrent reader of
+        # those pages stays byte-identical), and chunk padding past the
+        # allocated pages has nowhere to live — both go to the null page
+        cap = min(a.cache1.k.shape[2], self.cfg_engine.max_seq)
+        write_row = np.zeros((self._pages_per_seq,), np.int64)
+        write_row[:len(row)] = row
+        write_row[:len(shared)] = NULL_PAGE
+        t = np.arange(cap)
+        phys = write_row[t // ps].astype(np.int32)
+        off = (t % ps).astype(np.int32)
+        cache = self._insert_paged(
+            self.cache, a.cache1, jnp.asarray(phys), jnp.asarray(off),
+            jnp.asarray(idx, jnp.int32), jnp.asarray(plen, jnp.int32))
+        if self.radix is not None:
+            n_prompt_pages = -(-plen // ps)
+            self.radix.insert(
+                a.req.prompt_token_ids,
+                [int(p) for p in self._bt_np[idx, :n_prompt_pages]])
+        return cache
+
+    def _cow_step(self, active: List[int]) -> None:
+        """Copy-on-write barrier before a paged decode: any active slot
+        whose write page (the page holding the position this step
+        appends to) is shared gets a private copy first. All copies
+        ride ONE fixed-shape jit call — pairs padded to max_batch with
+        null->null self-copies — so a CoW step costs one extra
+        dispatch, never one per slot."""
+        if self.pool.num_shared == 0:
+            return
+        ps = self._page_size
+        pairs: List[Tuple[int, int, int, int]] = []
+        for i in active:
+            s = self.slots[i]
+            wpos = len(s.req.prompt_token_ids) + len(s.generated) - 1
+            lp = wpos // ps
+            if lp >= self._pages_per_seq:
+                continue          # at capacity; the append masks out
+            page = int(self._bt_np[i, lp])
+            if page == NULL_PAGE or self.pool.refcount(page) <= 1:
+                continue
+            fresh = self.pool.alloc(1)
+            if fresh is None and self.radix is not None:
+                self.radix.evict(1)
+                fresh = self.pool.alloc(1)
+            if fresh is None:
+                # pool dry: surrender the prompt's radix path instead.
+                # A shared WRITE page is always the prompt's partial
+                # tail — referenced by exactly this slot and its radix
+                # node (match never returns partial pages) — so the
+                # drop makes it private and the append proceeds in
+                # place without a copy.
+                if self.radix is not None:
+                    self.radix.drop(s.req.prompt_token_ids)
+                continue
+            pairs.append((i, lp, page, fresh[0]))
+        if not pairs:
+            return
+        srcs = np.zeros((self.cfg_engine.max_batch,), np.int32)
+        dsts = np.zeros((self.cfg_engine.max_batch,), np.int32)
+        for j, (_, _, src, dst) in enumerate(pairs):
+            srcs[j] = src
+            dsts[j] = dst
+        self.cache = self._cow_pages(self.cache, jnp.asarray(srcs),
+                                     jnp.asarray(dsts))
+        for i, lp, src, dst in pairs:
+            self._bt_np[i, lp] = dst
+            self.pool.decref(src)
+        self._bt_dirty = True
+        self.flight.record("cow_pages", step=self._step_idx,
+                           n_pages=len(pairs))
+
+    def _release_slot_pages(self, idx: int) -> None:
+        """Drop the slot's block-table references (finish, preempt,
+        quarantine). Pages the radix tree still references stay
+        resident for future prefix hits; the rest free immediately."""
+        if not self._paged:
+            return
+        row = self._bt_np[idx]
+        for p in row[row != NULL_PAGE]:
+            self.pool.decref(int(p))
+        row[:] = 0
+        self._bt_dirty = True
+
+    def _release_admission_pages(self,
+                                 a: Optional[_Admission]) -> None:
+        """Failed/aborted/expired mid-admission: give back the pages
+        reserved at admission start (the block-table row was never
+        written, so the slot path cannot double-release them)."""
+        if not self._paged or a is None:
+            return
+        for p in (a.shared_pages or []) + (a.new_pages or []):
+            self.pool.decref(p)
+        a.shared_pages = None
+        a.new_pages = None
+
+    def _paged_snapshot(self) -> dict:
+        """JSON-ready paged-KV state for /v1/stats and /v1/memory."""
+        d = {
+            "page_size": self._page_size,
+            "num_pages": self._num_pages,
+            "pages_used": self.pool.num_used,
+            "pages_shared": self.pool.num_shared,
+            "pages_free": self.pool.num_free,
+            "pool_exhausted_total": self.pool.exhausted_total,
+            "kv_bytes_per_page": self._kv_bytes_per_page,
+        }
+        if self.radix is not None:
+            d["radix"] = self.radix.snapshot()
+        return d
 
     # -- KV handoff (disaggregated prefill/decode, serving/api_server) ------
 
@@ -1292,6 +1719,16 @@ class LLMEngine:
         and remote snapshots must not accumulate without bound."""
         if not self._handoff_in:
             return
+        ce = self.cfg_engine
+        cap = (ce.handoff_cache_entries if ce.handoff_cache_entries >= 0
+               else 2 * ce.max_batch)
+        if self._paged or cap == 0:
+            # paged engines share KV through device pages (host-DRAM
+            # snapshots have no splice path into the arena); cap 0
+            # disables handoff retention outright — either way the
+            # staged planes must not accumulate
+            self._handoff_in.clear()
+            return
         while True:
             try:
                 key, entry = self._handoff_in.popleft()
@@ -1308,8 +1745,10 @@ class LLMEngine:
             self.flight.record("handoff_staged", step=self._step_idx,
                                prompt_len=len(key),
                                seed_tokens=seed_shape[2])
-        cap = max(self.cfg_engine.prefix_cache_entries,
-                  2 * self.cfg_engine.max_batch)
+        # bound retention by the EXPLICIT handoff knob, never by
+        # prefix_cache_entries: prefix_cache_entries == 0 means the
+        # operator turned local prefix caching OFF, and the old
+        # max(entries, 2B) floor silently re-enabled it here
         while len(self._handoff_keys) > cap:
             old = self._handoff_keys.popleft()
             self._drop_prefix(list(old))
@@ -1431,14 +1870,21 @@ class LLMEngine:
     def reset_prefix_cache(self) -> None:
         self._prefix_cache.clear()
         self._prefix_index.clear()
+        if self.radix is not None:
+            self.radix.clear()
 
     def _drop_prefix(self, prompt: List[int]) -> None:
-        """Evict one prompt's KV snapshot (cancellation/quarantine)."""
+        """Evict one prompt's KV snapshot (cancellation/quarantine).
+        In paged mode the snapshot IS the prompt's radix path — drop
+        purges it bottom-up, stopping at nodes other prompts share."""
+        if self.radix is not None:
+            self.radix.drop(prompt)
         key = tuple(prompt)
         if self._prefix_cache.pop(key, None) is not None:
             self._prefix_index_drop(key)
 
     def _finish_admission_abort(self, a: _Admission) -> None:
+        self._release_admission_pages(a)
         self._push_output(a.req.request_id, RequestOutput(
             a.req.request_id, [], True, "abort"))
         self._obs_finish(a.req.request_id, "abort")
@@ -1709,6 +2155,31 @@ class LLMEngine:
         # hbm gauges: the ledger throttles its own device poll
         # ($BIGDL_TPU_MEMORY_POLL_SEC), so per-step publish is cheap
         self.ledger.publish(self.registry)
+        if self._paged:
+            # page gauges + host-int -> counter mirrors (delta-inc so
+            # shared registries and engine restarts never double-count)
+            self.pool.publish(self.registry)
+            d = self.pool.exhausted_total - self._pub_pool_exhausted
+            if d:
+                self._m_pool_exhausted.inc(d)
+                self._pub_pool_exhausted += d
+            if self.radix is not None:
+                r, pub = self.radix, self._pub_radix
+                hits_d = r.hits - pub["hits"]
+                miss_d = (r.lookups - pub["lookups"]) - hits_d
+                if hits_d:
+                    self._m_radix_lookups.labels("hit").inc(hits_d)
+                if miss_d:
+                    self._m_radix_lookups.labels("miss").inc(miss_d)
+                lt_d = r.lookup_tokens - pub["lookup_tokens"]
+                ht_d = r.hit_tokens - pub["hit_tokens"]
+                if lt_d:
+                    self._m_radix_tokens.labels("looked_up").inc(lt_d)
+                if ht_d:
+                    self._m_radix_tokens.labels("hit").inc(ht_d)
+                pub.update(lookups=r.lookups, hits=r.hits,
+                           lookup_tokens=r.lookup_tokens,
+                           hit_tokens=r.hit_tokens)
 
     def memory_snapshot(self) -> dict:
         """The `GET /v1/memory` dict: ledger static report + live
@@ -1725,6 +2196,8 @@ class LLMEngine:
                     len(self.waiting[0].prompt_token_ids))
                 if self.waiting else None),
         }
+        if self._paged:
+            snap["engine"]["paged"] = self._paged_snapshot()
         return snap
 
     def _overload_snapshot(self) -> dict:
@@ -1771,6 +2244,7 @@ class LLMEngine:
                     self.sentinel.snapshot()["trips"]
                     if self.sentinel is not None else 0),
             },
+            "paged": self._paged_snapshot() if self._paged else None,
             "metrics": self.registry.summary(),
             "requests": self.tracer.snapshot(),
             "compile_table": compile_table(),
@@ -1987,10 +2461,12 @@ class LLMEngine:
         s.generated = []
         s.counts = None
         s.counts_out = None
-        # reset the slot's position so the idle row stops deepening
-        self.cache = KVCache(self.cache.k, self.cache.v,
-                             self.cache.pos.at[idx].set(0),
-                             self.cache.k_scale, self.cache.v_scale)
+        # release the slot's pages (paged) and reset its position so
+        # the idle row stops deepening; KVCache and PagedKVCache are
+        # both dataclasses, so replace() covers either store
+        self._release_slot_pages(idx)
+        self.cache = dataclasses.replace(
+            self.cache, pos=self.cache.pos.at[idx].set(0))
 
     def _emit(self, s: _Slot, lp: Optional[LogprobEntry] = None) -> None:
         want_lp = s.req.params.logprobs is not None and lp is not None
@@ -2163,9 +2639,9 @@ class LLMEngine:
         s.generated = []
         s.counts = None
         s.counts_out = None
-        self.cache = KVCache(self.cache.k, self.cache.v,
-                             self.cache.pos.at[victim].set(0),
-                             self.cache.k_scale, self.cache.v_scale)
+        self._release_slot_pages(victim)
+        self.cache = dataclasses.replace(
+            self.cache, pos=self.cache.pos.at[victim].set(0))
         self.waiting.append(resumed)
         self._m_preemptions.inc()
         self.tracer.preempted(resumed.request_id)
@@ -2279,6 +2755,7 @@ class LLMEngine:
                 self._fail_request(r.request_id, "drain_timeout")
             q.clear()
         if self._admitting is not None:
+            self._release_admission_pages(self._admitting)
             self._fail_request(self._admitting.req.request_id,
                                "drain_timeout")
             self._admitting = None
@@ -2311,6 +2788,7 @@ class LLMEngine:
                 q.extend(keep)
         a = self._admitting
         if a is not None and expired(a.req):
+            self._release_admission_pages(a)
             self._fail_request(a.req.request_id, "deadline")
             self._admitting = None
         ca = self._cp_admitting
@@ -2344,6 +2822,7 @@ class LLMEngine:
             # drop the (possibly corrupt) private cache and retry it
             # from scratch at the FRONT of the queue (FCFS kept) until
             # its crash budget runs out, then quarantine it
+            self._release_admission_pages(a)
             self._admitting = None
             a.req.crashes += 1
             if a.req.crashes > ce.max_slot_crashes:
@@ -2547,6 +3026,7 @@ class LLMEngine:
         # host side), forward + health + sampling run as ONE dispatch —
         # the [B, V] logits never exist outside the executable
         resident = (decode_resident_enabled()
+                    and not self._paged
                     and not self.faults.enabled
                     and all(simple(self.slots[i]) for i in active))
         toks = None
@@ -2570,6 +3050,15 @@ class LLMEngine:
             jax.block_until_ready(toks_dev)  # graftlint: disable=step-host-sync
             toks = np.asarray(toks_dev)
             finite_host = np.asarray(finite_dev)
+        elif self._paged:
+            # CoW barrier first (shared write pages get private
+            # copies), then one block-table-driven decode dispatch
+            self._cow_step(active)
+            logits_dev, self.cache = self._decode_paged(
+                self.params, jnp.asarray(tokens), self.cache,
+                self._bt())
+            t_dispatch = time.perf_counter()
+            jax.block_until_ready(logits_dev)  # graftlint: disable=step-host-sync
         else:
             logits_dev, self.cache = self._decode(
                 self.params, jnp.asarray(tokens), self.cache)

@@ -40,6 +40,8 @@ KNOWN_ENV = (
     "BIGDL_TPU_HBM_BUDGET_FRACTION",
     "BIGDL_TPU_IQ_GRID_SOURCE",
     "BIGDL_TPU_KV_CACHE_DTYPE",
+    "BIGDL_TPU_KV_PAGES",
+    "BIGDL_TPU_KV_PAGE_SIZE",
     "BIGDL_TPU_MATMUL_BACKEND",
     "BIGDL_TPU_MATMUL_GEMV",
     "BIGDL_TPU_MATMUL_PALLAS_MAX_M",
@@ -54,6 +56,7 @@ KNOWN_ENV = (
     "BIGDL_TPU_PEAK_HBM_GBPS",
     "BIGDL_TPU_PERF_HISTORY",
     "BIGDL_TPU_POSTMORTEM_DIR",
+    "BIGDL_TPU_PREFIX_SHARING",
     "BIGDL_TPU_PREPACK",
     "BIGDL_TPU_PROFILER_DIR_CAP_BYTES",
     "BIGDL_TPU_PROFILER_MAX_SEC",
@@ -253,6 +256,14 @@ def collect() -> dict:
          "resolve_decode_resident"),
         ("prepack", "BIGDL_TPU_PREPACK", "resolve_prepack"),
         ("sentinel", "BIGDL_TPU_SENTINEL", "resolve_sentinel"),
+        ("prefix_sharing", "BIGDL_TPU_PREFIX_SHARING",
+         "resolve_prefix_sharing"),
+        # paged-KV geometry (not tristates, but the same config.py
+        # silently-fall-back contract: a typo'd page size means the
+        # engine quietly runs the per-slot slab instead)
+        ("kv_page_size", "BIGDL_TPU_KV_PAGE_SIZE",
+         "resolve_kv_page_size"),
+        ("kv_pages", "BIGDL_TPU_KV_PAGES", "resolve_kv_pages"),
     )
     for key, envname, fname in tristate_knobs:
         raw = os.environ.get(envname)
@@ -472,6 +483,9 @@ def main() -> int:
           and info.get("decode_resident", {}).get("valid", True)
           and info.get("prepack", {}).get("valid", True)
           and info.get("sentinel", {}).get("valid", True)
+          and info.get("prefix_sharing", {}).get("valid", True)
+          and info.get("kv_page_size", {}).get("valid", True)
+          and info.get("kv_pages", {}).get("valid", True)
           and info.get("sentinel_threshold", {}).get("valid", True)
           and info.get("sentinel_trip_steps", {}).get("valid", True)
           and info.get("sentinel_recover_steps", {}).get("valid", True)

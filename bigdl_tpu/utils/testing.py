@@ -120,7 +120,10 @@ class SyntheticCausalLM:
             forward = staticmethod(llama_mod.forward)
             prefill = staticmethod(llama_mod.forward_last_token)
             new_cache = staticmethod(llama_mod.new_cache)
+            forward_paged = staticmethod(llama_mod.forward_paged)
+            new_paged_cache = staticmethod(llama_mod.new_paged_cache)
             SUPPORTS_SCALED_KV = llama_mod.SUPPORTS_SCALED_KV
+            SUPPORTS_PAGED_KV = llama_mod.SUPPORTS_PAGED_KV
 
         self.family = _Family()
 

@@ -1,0 +1,24 @@
+"""Seeded bug: host-side page-table gather on the engine step path.
+
+The paged-KV contract is that the block table crosses host->device
+once per step and every per-token page index happens inside the
+tracked jit (the paged kernel's scalar prefetch). Indexing the arena
+or the block table in host Python is one gather per token outside the
+traced step. Host numpy mirrors are fine when named for it (``_np`` /
+``_host`` suffix).
+"""
+
+
+class MiniEngine:
+    def __init__(self, arena_k, block_tables):
+        self.arena_k = arena_k
+        self.block_tables = block_tables
+        self.block_tables_np = [[0]]
+
+    def step(self, toks):
+        out = []
+        for i, _ in enumerate(toks):
+            # BUG x2: arena gather through a host block-table index
+            out.append(self.arena_k[self.block_tables[i]])
+        row = self.block_tables_np[0]        # host mirror: ok
+        return out, row

@@ -172,6 +172,29 @@ def test_dispatch_loop_needs_entry():
                    for f in result.findings)
 
 
+def test_detects_paged_host_gather():
+    rel = "tests/fixtures/graftlint/fx_paged_host_gather.py"
+    result = _scan("fx_paged_host_gather.py",
+                   step_entries={rel: ("MiniEngine", "step")})
+    hits = [f for f in result.findings
+            if f.rule == "paged-host-gather"]
+    # nested subscript = two gathers: the arena read AND the host
+    # block-table index feeding it
+    assert len(hits) == 2, result.findings
+    assert {f.obj for f in hits} == {"MiniEngine.step"}
+    names = {f.message.split("'")[1] for f in hits}
+    assert names == {"arena_k", "block_tables"}
+    # the _np-suffixed host mirror stays silent
+    assert not any("block_tables_np" in f.message for f in hits)
+
+
+def test_paged_host_gather_needs_entry():
+    # outside a step-path entry a page-table subscript is not flagged
+    result = _scan("fx_paged_host_gather.py")
+    assert not any(f.rule == "paged-host-gather"
+                   for f in result.findings)
+
+
 def test_detects_unsynced_timing():
     result = _scan("fx_unsynced_timing.py")
     hits = [f for f in result.findings

@@ -1,0 +1,421 @@
+"""Paged KV cache: pool/radix invariants, CoW safety, byte-identity.
+
+The load-bearing claims of the paged path, each pinned here:
+
+- refcounts never go negative (double free raises), pages free exactly
+  at zero, allocation is all-or-nothing;
+- the radix tree matches longest prefixes (full pages only), evicts
+  only leaves the tree alone references, and drop stops at shared nodes;
+- copy-on-write never mutates the shared page — a concurrent reader's
+  bytes are untouched;
+- paged decode is byte-identical to the per-slot slab under greedy AND
+  seeded sampling, across bf16/int8/int4 KV storage;
+- with prefix sharing on, a 64-way shared-prompt burst runs inside the
+  arena budget that previously backed 8 slots (ISSUE 17 acceptance).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.ops.paged import NULL_PAGE
+from bigdl_tpu.serving.pagepool import PagePool, RadixCache
+
+
+# ---------------------------------------------------------------------------
+# PagePool invariants
+
+
+def test_pool_alloc_all_or_nothing():
+    pool = PagePool(num_pages=5, page_size=16)   # 4 allocatable
+    got = pool.alloc(3)
+    assert got is not None and len(got) == 3
+    assert NULL_PAGE not in got
+    assert pool.num_free == 1
+    assert pool.alloc(2) is None          # refused outright...
+    assert pool.num_free == 1             # ...nothing partially granted
+    assert pool.exhausted_total == 1
+    assert pool.alloc(0) == []
+
+
+def test_pool_refcount_never_negative():
+    pool = PagePool(num_pages=4, page_size=16)
+    (p,) = pool.alloc(1)
+    assert pool.refcount(p) == 1
+    assert pool.incref(p) == 2
+    assert pool.decref(p) == 1
+    assert pool.decref(p) == 0            # freed exactly at zero
+    assert p in pool._free
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.decref(p)
+    with pytest.raises(RuntimeError, match="use-after-free"):
+        pool.incref(p)
+
+
+def test_pool_null_page_pinned():
+    pool = PagePool(num_pages=3, page_size=16)
+    assert pool.refcount(NULL_PAGE) == 1
+    pool.decref(NULL_PAGE)                # no-ops, never frees
+    pool.incref(NULL_PAGE)
+    assert pool.refcount(NULL_PAGE) == 1
+    for _ in range(2):
+        got = pool.alloc(1)
+        assert got is not None and got[0] != NULL_PAGE
+    assert pool.alloc(1) is None          # null page never handed out
+
+
+def test_pool_shared_accounting():
+    pool = PagePool(num_pages=6, page_size=16)
+    a, b = pool.alloc(2)
+    pool.incref(a)
+    assert pool.num_shared == 1
+    assert pool.num_used == 2
+    pool.decref(a)
+    assert pool.num_shared == 0
+    pool.decref(a)
+    pool.decref(b)
+    assert pool.num_used == 0
+
+
+# ---------------------------------------------------------------------------
+# RadixCache
+
+
+def test_radix_longest_prefix_match():
+    pool = PagePool(num_pages=12, page_size=4)
+    radix = RadixCache(pool)
+    prompt = list(range(100, 111))                  # 11 tokens: 2 full + tail
+    pages = pool.alloc(3)
+    assert radix.insert(prompt, pages) == 3
+    # exact full-prefix reuse
+    matched, got = radix.match(prompt[:8] + [1, 2])
+    assert (matched, got) == (8, pages[:2])
+    # longest-prefix: diverges inside the second page -> one page only
+    matched, got = radix.match(prompt[:4] + [9, 9, 9, 9, 1])
+    assert (matched, got) == (4, pages[:1])
+    # the partial tail node is never returned by match
+    matched, got = radix.match(prompt)
+    assert matched == 8
+    # a second prompt sharing page one splits, no duplicate nodes
+    other = prompt[:4] + [50, 51, 52, 53]
+    pages2 = pool.alloc(2)
+    created = radix.insert(other, [pages[0], pages2[0]])
+    assert created == 1                             # first page node reused
+    assert radix.match(other)[1] == [pages[0], pages2[0]]
+
+
+def test_radix_match_too_short_for_a_page():
+    pool = PagePool(num_pages=4, page_size=8)
+    radix = RadixCache(pool)
+    radix.insert([1, 2, 3], pool.alloc(1))
+    assert radix.match([1, 2, 3]) == (0, [])
+
+
+def test_radix_evicts_only_unreferenced_leaves():
+    pool = PagePool(num_pages=8, page_size=4)
+    radix = RadixCache(pool)
+    prompt = list(range(8))
+    p = pool.alloc(2)
+    radix.insert(prompt, p)                         # tree adds 1 ref each
+    for pg in p:
+        pool.decref(pg)                 # the admitting slot released its row
+    pool.incref(p[1])                               # a live slot maps page 2
+    assert radix.evict(10) == 0                     # leaf is slot-mapped: kept
+    assert radix.num_nodes == 2
+    pool.decref(p[1])
+    # leaf now tree-only; removing it exposes the parent, which follows
+    assert radix.evict(10) == 2
+    assert radix.num_nodes == 0
+    assert pool.num_used == 0
+
+
+def test_radix_evict_is_lru():
+    pool = PagePool(num_pages=8, page_size=4)
+    radix = RadixCache(pool)
+    pa, pb = pool.alloc(1), pool.alloc(1)
+    radix.insert([1, 2, 3, 4], pa)
+    radix.insert([5, 6, 7, 8], pb)
+    pool.decref(pa[0])
+    pool.decref(pb[0])                   # rows released; tree-only refs
+    radix.match([1, 2, 3, 4])            # refresh the first path
+    assert radix.evict(1) == 1
+    assert radix.match([1, 2, 3, 4])[0] == 4        # survivor
+    assert radix.match([5, 6, 7, 8])[0] == 0        # evicted
+
+
+def test_radix_drop_stops_at_shared_nodes():
+    pool = PagePool(num_pages=8, page_size=4)
+    radix = RadixCache(pool)
+    a = [1, 2, 3, 4, 10, 11, 12, 13]
+    b = [1, 2, 3, 4, 20, 21, 22, 23]
+    pa = pool.alloc(2)
+    radix.insert(a, pa)
+    pb = pool.alloc(1)
+    radix.insert(b, [pa[0], pb[0]])
+    # dropping `a` removes its private leaf, keeps the shared first page
+    assert radix.drop(a) == 1
+    assert radix.match(b) == (8, [pa[0], pb[0]])
+    assert radix.match(a) == (4, [pa[0]])
+    assert radix.drop(b) == 2                       # now the path is private
+    assert radix.num_nodes == 0
+
+
+def test_radix_clear_releases_every_ref():
+    pool = PagePool(num_pages=8, page_size=4)
+    radix = RadixCache(pool)
+    pages = pool.alloc(3)
+    radix.insert(list(range(10)), pages)
+    for pg in pages:
+        pool.decref(pg)                  # rows released; tree-only refs
+    assert radix.clear() == 3
+    assert pool.num_used == 0
+    assert radix.num_nodes == 0
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write at the arena level
+
+
+def test_cow_copy_preserves_source_page():
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops.paged import cow_copy_pages, init_paged_cache
+
+    cache = init_paged_cache(2, 4, 8, 2, 4, batch=1)
+    k = cache.k.at[:, 1].set(1.0)
+    v = cache.v.at[:, 1].set(2.0)
+    before_k = np.asarray(k).copy()
+    nk, nv = cow_copy_pages(k, v, jnp.asarray([1], jnp.int32),
+                            jnp.asarray([2], jnp.int32))
+    hk, hv = np.asarray(nk), np.asarray(nv)
+    # the shared source page is bit-untouched; the copy is exact
+    assert (hk[:, 1] == before_k[:, 1]).all()
+    assert (hk[:, 2] == before_k[:, 1]).all()
+    assert (hv[:, 2] == 2.0).all()
+    # null->null self-copy (the padding lanes of a batched CoW step)
+    # is the identity
+    sk, _ = cow_copy_pages(nk, nv, jnp.asarray([0], jnp.int32),
+                           jnp.asarray([0], jnp.int32))
+    assert (np.asarray(sk) == hk).all()
+
+
+# ---------------------------------------------------------------------------
+# engine-level byte-identity (paged vs slab)
+
+
+def _drive(eng, prompts, params_of, max_steps=800):
+    from collections import defaultdict
+
+    outs = defaultdict(list)
+    done = set()
+    for i, (p, sp) in enumerate(zip(prompts, params_of)):
+        eng.add_request(f"r{i}", p, sp)
+    for _ in range(max_steps):
+        eng.step()
+        for i in range(len(prompts)):
+            rid = f"r{i}"
+            if rid in done:
+                continue
+            for o in eng.get_outputs(rid):
+                outs[rid] += o.new_token_ids
+                if o.finished:
+                    done.add(rid)
+        if len(done) == len(prompts):
+            break
+    assert len(done) == len(prompts), f"unfinished: {done}"
+    return dict(outs)
+
+
+def _mk_engine(kv_dtype=None, **kw):
+    from bigdl_tpu.serving import EngineConfig, LLMEngine
+    from bigdl_tpu.utils.testing import tiny_random_model
+
+    cfg = dict(max_batch=4, max_seq=64, prefill_bucket=8,
+               prefill_chunk=8, prefix_cache_entries=0)
+    if kv_dtype:
+        cfg["kv_cache_dtype"] = kv_dtype
+    cfg.update(kw)
+    return LLMEngine(tiny_random_model(seed=0), EngineConfig(**cfg))
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8", "int4"])
+def test_paged_matches_slab_greedy_and_sampled(kv_dtype):
+    from bigdl_tpu.serving import SamplingParams
+
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 250, 13).tolist() for _ in range(4)]
+    # half greedy, half seeded-sampled in ONE wave: identical logits
+    # must give identical argmax AND identical gumbel draws
+    params_of = [
+        SamplingParams(max_tokens=8) if i % 2 == 0 else
+        SamplingParams(max_tokens=8, temperature=0.8, top_k=8, seed=i)
+        for i in range(4)]
+    slab = _drive(_mk_engine(kv_dtype), prompts, params_of)
+    paged = _drive(_mk_engine(kv_dtype, kv_page_size=16,
+                              prefix_sharing="off"),
+                   prompts, params_of)
+    assert slab == paged
+
+
+def test_prefix_sharing_stays_byte_identical_and_hits():
+    from bigdl_tpu.serving import SamplingParams
+
+    pre = list(range(1, 33))                   # 2 full pages at ps=16
+    prompts = [pre + [100 + i, 200 + i] for i in range(4)]
+    params_of = [SamplingParams(max_tokens=8)] * 4
+    baseline = _drive(_mk_engine(), prompts, params_of)
+    eng = _mk_engine(kv_page_size=16, prefix_sharing="on")
+    shared = _drive(eng, prompts, params_of)
+    assert shared == baseline
+    snap = eng._paged_snapshot()
+    # requests 2..4 each reuse the 32-token prefix from the radix
+    assert snap["radix"]["hits"] == 3
+    assert snap["radix"]["hit_tokens"] == 3 * 32
+    assert snap["pool_exhausted_total"] == 0
+
+
+def test_finish_releases_pages_and_reset_clears_radix():
+    from bigdl_tpu.serving import SamplingParams
+
+    eng = _mk_engine(kv_page_size=16, prefix_sharing="on")
+    _drive(eng, [list(range(40, 60))], [SamplingParams(max_tokens=4)])
+    # the slot released its row; only radix nodes still hold pages
+    assert eng.pool.num_used == eng.radix.num_nodes > 0
+    eng.reset_prefix_cache()
+    assert eng.radix.num_nodes == 0
+    assert eng.pool.num_used == 0
+    assert eng.pool.num_free == eng.pool.num_pages - 1
+
+
+def test_64_concurrent_in_8_slot_budget():
+    """ISSUE 17 acceptance: >= 64 sequences resident at once, inside
+    the arena bytes that previously backed an 8-slot slab. 64 requests
+    share a 944-token prefix (59 full pages); each admission reserves
+    only the worst-case NEW pages (max_seq-clamped), so the whole burst
+    fits a 513-page arena == 8 slots x 1024 positions (+ null page)."""
+    import dataclasses
+
+    from bigdl_tpu.ops.kvcache import kv_cache_nbytes
+    from bigdl_tpu.ops.paged import paged_cache_bytes
+    from bigdl_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+    from bigdl_tpu.utils.testing import TINY_LLAMA, tiny_random_model
+
+    cfg = dataclasses.replace(TINY_LLAMA, max_position_embeddings=1024)
+    eng = LLMEngine(
+        tiny_random_model(seed=0, cfg=cfg),
+        EngineConfig(max_batch=64, max_seq=1024, prefill_bucket=16,
+                     prefill_chunk=16, prefix_cache_entries=0,
+                     kv_page_size=16, kv_pages=513, prefix_sharing="on",
+                     max_queue_depth=96))
+    slab8 = kv_cache_nbytes(cfg.num_hidden_layers, 8, 1024,
+                            cfg.num_key_value_heads, cfg.hd,
+                            eng.kv_cache_dtype or "bf16")["total"]
+    arena = paged_cache_bytes(eng.cache)["total"]
+    # ledger parity: the arena costs what 8 slab slots cost (+1 page)
+    assert arena <= slab8 + eng._kv_bytes_per_page
+
+    rng = np.random.default_rng(0)
+    pre = rng.integers(1, 250, 944).tolist()
+    n = 64
+    for i in range(n):
+        # unique last token; generation is max_seq-clamped at 79 tokens,
+        # which outlives the ~64-step admission ramp -> true overlap
+        eng.add_request(f"c{i}", pre + [i + 1],
+                        SamplingParams(max_tokens=200))
+    peak = 0
+    finished = set()
+    for _ in range(3000):
+        eng.step()
+        peak = max(peak, sum(s.active for s in eng.slots))
+        for i in range(n):
+            rid = f"c{i}"
+            if rid not in finished:
+                finished.update(rid for o in eng.get_outputs(rid)
+                                if o.finished)
+        if len(finished) == n:
+            break
+    snap = eng._paged_snapshot()
+    assert len(finished) == n, (len(finished), snap)
+    assert peak >= 64, (peak, snap)
+    assert snap["pool_exhausted_total"] == 0, snap
+    # the prefix really was served from shared pages, not re-prefilled
+    assert snap["radix"]["hit_tokens"] >= (n - 1) * 928, snap
+
+
+# ---------------------------------------------------------------------------
+# satellite: handoff retention decoupled from prefix_cache_entries
+
+
+def _stage_fake_handoff(eng, prompt):
+    import jax.numpy as jnp
+
+    cfg = eng.cfg
+    plen = len(prompt)
+    shape = (cfg.num_hidden_layers, 1, plen,
+             cfg.num_key_value_heads, cfg.hd)
+    planes = (jnp.zeros(shape, jnp.bfloat16), jnp.zeros(shape, jnp.bfloat16))
+    eng.stage_handoff(prompt, planes)
+
+
+def test_handoff_cap_zero_drops_snapshots():
+    eng = _mk_engine(handoff_cache_entries=0)
+    _stage_fake_handoff(eng, [1, 2, 3, 4])
+    eng._drain_handoffs()
+    assert not eng._handoff_in
+    assert not eng._prefix_cache
+
+
+def test_handoff_cap_bounds_entries_with_local_cache_off():
+    # prefix_cache_entries=0 means local caching OFF; handoff retention
+    # is bounded by ITS knob, not silently re-enabled at 2*max_batch
+    eng = _mk_engine(prefix_cache_entries=0, handoff_cache_entries=2)
+    for k in range(4):
+        _stage_fake_handoff(eng, [10 + k, 11 + k, 12 + k])
+    eng._drain_handoffs()
+    assert len(eng._prefix_cache) == 2
+    # default (-1) falls back to 2*max_batch
+    eng2 = _mk_engine()
+    for k in range(12):
+        _stage_fake_handoff(eng2, [30 + k, 31 + k, 32 + k])
+    eng2._drain_handoffs()
+    assert len(eng2._prefix_cache) == 2 * 4
+
+
+def test_paged_engine_clears_handoff_inbox():
+    eng = _mk_engine(kv_page_size=16)
+    _stage_fake_handoff(eng, [1, 2, 3, 4])
+    eng._drain_handoffs()
+    assert not eng._handoff_in
+    assert not eng._prefix_cache
+
+
+# ---------------------------------------------------------------------------
+# config resolvers
+
+
+def test_paged_knob_resolvers():
+    from bigdl_tpu.config import (resolve_kv_page_size, resolve_kv_pages,
+                                  resolve_prefix_sharing)
+
+    assert resolve_kv_page_size(0) == 0
+    assert resolve_kv_page_size("128") == 128
+    for bad in ("48", -16, "x"):
+        with pytest.raises(ValueError):
+            resolve_kv_page_size(bad)
+    assert resolve_kv_pages("0") == 0
+    assert resolve_kv_pages(129) == 129
+    for bad in ("1", -2, "y"):
+        with pytest.raises(ValueError):
+            resolve_kv_pages(bad)
+    assert resolve_prefix_sharing("1") == "on"
+    assert resolve_prefix_sharing(None) == "auto"
+    with pytest.raises(ValueError):
+        resolve_prefix_sharing("never")
+
+
+def test_engine_rejects_bad_paged_geometry():
+    with pytest.raises(ValueError):
+        _mk_engine(kv_page_size=48)          # not a power of two
+    with pytest.raises(ValueError):
+        _mk_engine(kv_page_size=32, max_seq=72)   # max_seq % ps != 0

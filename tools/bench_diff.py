@@ -50,6 +50,12 @@ METRIC_DIRECTIONS = {
     "goodput_tokens_per_s": "higher",
     "shed_total": "lower",
     "brownout_level_max": "lower",
+    # shared-prefix lane (bench_serving "prefix_share" block): the
+    # fraction of looked-up prompt tokens served from radix-shared
+    # pages must not erode, and allocation stalls against the page
+    # pool must not grow at the same offered load
+    "prefix_hit_tokens_frac": "higher",
+    "page_pool_exhausted": "lower",
     "decode_mfu": "higher",
     "prefill_mfu": "higher",
     "decode_hbm_roofline_util": "higher",
