@@ -54,10 +54,13 @@ def run_router_bench(n_replicas: int, n_requests: int = 16,
            "--max-batch", "4", "--max-seq", "64"]
     # replicas on CPU always: the router lane measures the tier, not
     # the chip, and N processes grabbing an exclusive-access TPU would
-    # starve each other
+    # starve each other. Canary on: byte-identical seeded replicas must
+    # record zero mismatches on a clean run (bench_diff zero-gates
+    # router.counters.canary_failures)
     router = Router(replica_cmd=cmd,
                     config=RouterConfig(replicas=n_replicas,
-                                        health_sec=0.25),
+                                        health_sec=0.25,
+                                        canary_sec=0.5),
                     spawn_env={"JAX_PLATFORMS": "cpu"})
     router.start()
     httpd = router.serve(port=0, background=True)
@@ -247,6 +250,7 @@ def run_prefix_share_bench(model, cfg, on_tpu: bool) -> dict:
     mean the arena is undersized for the offered load)."""
     import numpy as np
 
+    from bigdl_tpu.observability.stats import percentile
     from bigdl_tpu.serving import EngineConfig, LLMEngine, SamplingParams
 
     if on_tpu:
@@ -309,7 +313,7 @@ def run_prefix_share_bench(model, cfg, on_tpu: bool) -> dict:
         "wall_s": round(wall, 2),
         "tokens_per_s": round(generated / max(wall, 1e-9), 1),
         "prefix_hit_tokens_frac": round(hit / max(looked, 1), 4),
-        "ttft_p50_ms": (round(1000 * float(np.percentile(vals, 50)), 1)
+        "ttft_p50_ms": (round(1000 * percentile(sorted(vals), 0.5), 1)
                         if vals else None),
         "page_pool_exhausted": int(snap["pool_exhausted_total"]
                                    - base["pool_exhausted_total"]),
@@ -327,6 +331,7 @@ def run_overload_bench(model, cfg, max_seq: int, prompt_len: int,
     goodput_tokens_per_s lower-is-worse."""
     import numpy as np
 
+    from bigdl_tpu.observability.stats import percentile
     from bigdl_tpu.serving import EngineConfig, LLMEngine, SamplingParams
     from bigdl_tpu.serving.overload import RequestShed
 
@@ -419,14 +424,30 @@ def run_overload_bench(model, cfg, max_seq: int, prompt_len: int,
             "generated_tokens": int(generated),
             "wall_s": round(wall, 2),
             "ttft_p99_ms": {
-                q: (round(1000 * float(np.percentile(v, 99)), 1)
+                q: (round(1000 * percentile(sorted(v), 0.99), 1)
                     if v else None)
                 for q, v in by_qos.items()},
         }
+        # SLO lane rows: force one full burn evaluation over everything
+        # the lane observed, then report what the tracker concluded.
+        # bench_diff gates the <=1x rows (an alert below capacity is a
+        # bug); the 3x burn rate is informational — it PROVES the
+        # fast-burn alert fires under deliberate overload
+        eng.slo.evaluate()
+        slo_snap = eng.slo.snapshot()
+        comp = {k: [c for c in (eng.slo.compliance(q, k, "fast")
+                                for q in qos_cycle) if c is not None]
+                for k in ("ttft", "tpot")}
         if mult <= 1.0:
             # gated: any shed or brownout below capacity is a bug
             lane["shed_total"] = shed
             lane["brownout_level_max"] = brownout_max
+            lane["slo_burn_rate_max"] = slo_snap["burn_rate_max"]
+            lane["slo_alerts"] = slo_snap["alerts_active"]
+            lane["slo_compliance_ttft"] = (
+                round(min(comp["ttft"]), 4) if comp["ttft"] else None)
+            lane["slo_compliance_tpot"] = (
+                round(min(comp["tpot"]), 4) if comp["tpot"] else None)
         else:
             # shedding is the POINT at 3x — gate only the goodput
             # (tokens of admitted-and-served work per second)
@@ -435,6 +456,8 @@ def run_overload_bench(model, cfg, max_seq: int, prompt_len: int,
             lane["shed_count"] = shed
             lane["shed_rate"] = round(shed / n_req, 3)
             lane["brownout_level_peak"] = brownout_max
+            lane["slo_burn_rate_overload"] = slo_snap["burn_rate_max"]
+            lane["slo_alerts_overload"] = slo_snap["alerts_total"]
         out[tag] = lane
     return out
 

@@ -3,7 +3,8 @@
 The analyzer is pure stdlib (ast + json) on purpose: it inspects
 source text only and never executes or imports the code it scans — a
 module with a broken import or a TPU-only dependency still lints. Rule logic lives in :mod:`.jax_rules` (tracing /
-host-sync hazards) and :mod:`.locks` (lock discipline); this module
+host-sync hazards), :mod:`.locks` (lock discipline), and
+:mod:`.metric_rules` (label cardinality); this module
 owns what a finding IS, how an inline suppression works, and how the
 baseline may evolve (shrink-only).
 
@@ -69,6 +70,9 @@ RULES: Dict[str, str] = {
         "host-side subscript of a paged-KV table (arena / block table "
         "/ page table) on the engine step path — page indexing "
         "belongs inside the tracked jit",
+    "metric-label-cardinality":
+        "unbounded value (f-string/format/str()/concat/request-scoped "
+        "identifier) passed to a metric .labels() call",
 }
 
 _SUPPRESS_RE = re.compile(
@@ -182,7 +186,7 @@ def analyze(files: Iterable[pathlib.Path],
     vs inline-suppressed. ``rules`` restricts to a subset by name;
     ``step_entries`` overrides the engine-step-path roots (tests point
     it at fixture modules)."""
-    from bigdl_tpu.analysis import jax_rules, locks
+    from bigdl_tpu.analysis import jax_rules, locks, metric_rules
 
     modules: List[Module] = []
     failures: List[str] = []
@@ -196,6 +200,7 @@ def analyze(files: Iterable[pathlib.Path],
     raw: List[Finding] = []
     raw += jax_rules.check(modules, step_entries=step_entries)
     raw += locks.check(modules)
+    raw += metric_rules.check(modules)
     if rules is not None:
         keep = set(rules)
         raw = [f for f in raw if f.rule in keep]

@@ -1,0 +1,146 @@
+"""Metric-hygiene rules: label cardinality.
+
+Prometheus label values multiply time series: every distinct value of
+every label mints a new child series kept resident in the registry (and
+in every scraper downstream). A label fed from request-scoped data — a
+request id, a tenant string, a formatted message — grows without bound
+and eventually OOMs the registry or the TSDB. The fleet postmortem
+pattern is always the same innocent-looking line::
+
+    self._c_reqs.labels(f"replica-{r.idx}", request_id).inc()
+
+Rule
+----
+``metric-label-cardinality``
+    Flags ``.labels(...)`` arguments that are *constructed* or
+    *identity-shaped* rather than drawn from a closed set:
+
+    - f-strings and ``str.format`` / ``%`` formatting,
+    - ``str()`` / ``repr()`` / ``format()`` stringification,
+    - string concatenation (``+`` of anything inside the arg),
+    - names or attributes whose identifier looks request-scoped
+      (``tenant``, ``user``, ``request_id``, ``rid``, ``trace``,
+      ``span``, ``session``, ``uuid``, ``url``, ``addr``, ``host``,
+      or a ``*_id`` suffix).
+
+    String literals, bare bounded-looking names (``reason``, ``mode``,
+    ``phase``), and ``*args``/``**kwargs`` splats of literal tuples
+    pass. The identifier heuristic is deliberately name-based — a
+    bounded value routed through a variable called ``tenant`` still
+    reads as unbounded and needs an audited inline
+    ``# graftlint: disable=metric-label-cardinality`` stating WHY the
+    set is closed (e.g. replica index bounded by fleet size).
+
+Known limits (documented, deliberate): no dataflow — a tainted value
+laundered through an innocently-named temporary is invisible, and only
+calls spelled ``<expr>.labels(...)`` are inspected (the codebase's
+metric objects are always held in attributes/locals, so this covers
+every real site).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from bigdl_tpu.analysis.core import Finding, Module
+
+RULE = "metric-label-cardinality"
+
+#: identifier fragments that read as per-request / per-identity data.
+#: Matched against the *terminal* name of a Name/Attribute label arg.
+_TAINTED_TOKENS = (
+    "tenant", "user", "request", "rid", "trace", "span", "session",
+    "uuid", "url", "addr", "host",
+)
+
+_STRINGIFIERS = ("str", "repr", "format")
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """'tenant' for ``params.tenant`` / ``tenant``; None otherwise."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _tainted_identifier(name: str) -> bool:
+    low = name.lower().lstrip("_")
+    if low.endswith("_id") or low == "id":
+        return True
+    return any(tok in low for tok in _TAINTED_TOKENS)
+
+
+def _diagnose(arg: ast.AST) -> Optional[str]:
+    """Why this label arg is unbounded, or None if it looks closed."""
+    if isinstance(arg, ast.JoinedStr):
+        return "f-string label value (one series per distinct render)"
+    if isinstance(arg, ast.Call):
+        f = arg.func
+        if isinstance(f, ast.Attribute) and f.attr == "format":
+            return ("str.format() label value (one series per "
+                    "distinct render)")
+        if isinstance(f, ast.Name) and f.id in _STRINGIFIERS:
+            return (f"{f.id}() label value — stringified data has no "
+                    "static cardinality bound")
+    if isinstance(arg, ast.BinOp):
+        if isinstance(arg.op, ast.Mod):
+            return ("%-format label value (one series per distinct "
+                    "render)")
+        if isinstance(arg.op, ast.Add):
+            return ("concatenated label value (one series per "
+                    "distinct render)")
+    name = _terminal_name(arg)
+    if name is not None and _tainted_identifier(name):
+        return (f"label fed from {name!r} — request-scoped identity "
+                "values are unbounded")
+    return None
+
+
+class _Scan(ast.NodeVisitor):
+    def __init__(self, m: Module, out: List[Finding]):
+        self.m = m
+        self.out = out
+        self.stack: List[str] = []
+
+    @property
+    def obj(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "labels":
+            for arg in list(node.args) + [kw.value for kw in
+                                          node.keywords
+                                          if kw.arg is not None]:
+                why = _diagnose(arg)
+                if why is not None:
+                    self.out.append(Finding(
+                        rule=RULE, path=self.m.rel,
+                        line=getattr(arg, "lineno", node.lineno),
+                        obj=self.obj,
+                        message=why,
+                        snippet=self.m.snippet(
+                            getattr(arg, "lineno", node.lineno))))
+        self.generic_visit(node)
+
+
+def check(modules: Iterable[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        _Scan(m, out).visit(m.tree)
+    return out

@@ -52,6 +52,23 @@ standard library — tests/test_observability.py enforces it):
   (size-rotated like the event log); trips emit ``perf_regression``
   flight events + postmortems + a bounded profiler auto-capture, then
   recover with hysteresis.
+- ``stats``: the shared percentile / median / EWMA math (single source
+  for StepTimer summaries, sentinel baseline seeding and bench lane
+  stats; ``percentile`` is bit-compatible with ``np.percentile``'s
+  default linear method).
+- ``slo``: declarative per-QoS service-level objectives (TTFT p99,
+  TPOT p99, error rate, availability; defaults overridden by JSON in
+  ``$BIGDL_TPU_SLO_SPEC``) evaluated against multi-window sliding
+  histograms with Google-SRE fast/slow burn-rate alerting — alerts
+  emit ``slo_burn`` flight events,
+  ``bigdl_tpu_slo_burn_rate{qos,objective,window}`` gauges,
+  ``bigdl_tpu_slo_alerts_total`` and a size-rotated JSONL sink at
+  ``$BIGDL_TPU_SLO_ALERT_LOG``; ``GET /v1/slo`` serves the snapshot
+  and the router aggregates it fleet-wide.
+- ``usage``: per-tenant usage metering — one append-only JSONL record
+  per finished/shed request (``$BIGDL_TPU_USAGE_LOG``, written off the
+  engine thread) plus the live rollup behind ``GET /v1/usage``,
+  reconciled exactly against the tenant counters.
 - ``flight``: ``FlightRecorder`` ring buffer of per-step engine events
   plus postmortem dumps — on engine-step exception, stall-guard trip,
   or SIGTERM/SIGINT a single JSON (flight tail, span tail, metrics
@@ -128,8 +145,11 @@ default 8), ``BIGDL_TPU_HBM_BUDGET_FRACTION`` (admission budget as a
 fraction of ``bytes_limit``, float in (0, 1], default 0.9),
 ``BIGDL_TPU_MEMORY_POLL_SEC`` (min seconds between live
 ``memory_stats()`` reads, default 1.0), ``BIGDL_TPU_COMPILE_MEMORY``
-(set 0 to skip per-compile memory analysis). All are validated by
-``python -m bigdl_tpu.utils.env_check``.
+(set 0 to skip per-compile memory analysis),
+``BIGDL_TPU_SLO_SPEC`` (JSON SLO spec override),
+``BIGDL_TPU_SLO_ALERT_LOG`` (burn-alert JSONL sink),
+``BIGDL_TPU_USAGE_LOG`` (per-request usage ledger). All are validated
+by ``python -m bigdl_tpu.utils.env_check``.
 """
 
 from bigdl_tpu.observability.compile_watch import (
@@ -197,6 +217,27 @@ from bigdl_tpu.observability.roofline import (
     attribution as roofline_attribution,
     efficiency as roofline_efficiency,
 )
+from bigdl_tpu.observability.slo import (
+    DEFAULT_OBJECTIVES,
+    OBJECTIVES,
+    SLOTracker,
+    SlidingHistogram,
+    resolve_slo_alert_log,
+    resolve_slo_spec,
+    validate_slo_alert_log_path,
+)
+from bigdl_tpu.observability.stats import (
+    EWMA_DECAY,
+    ewma,
+    median,
+    percentile,
+    summarize,
+)
+from bigdl_tpu.observability.usage import (
+    UsageLedger,
+    resolve_usage_log,
+    validate_usage_log_path,
+)
 from bigdl_tpu.observability.sentinel import (
     PerfSentinel,
     resolve_perf_history,
@@ -256,6 +297,21 @@ __all__ = [
     "prefill_costs",
     "roofline_attribution",
     "roofline_efficiency",
+    "DEFAULT_OBJECTIVES",
+    "OBJECTIVES",
+    "SLOTracker",
+    "SlidingHistogram",
+    "resolve_slo_alert_log",
+    "resolve_slo_spec",
+    "validate_slo_alert_log_path",
+    "EWMA_DECAY",
+    "ewma",
+    "median",
+    "percentile",
+    "summarize",
+    "UsageLedger",
+    "resolve_usage_log",
+    "validate_usage_log_path",
     "PerfSentinel",
     "resolve_perf_history",
     "resolve_sentinel_recover_steps",

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from . import stats
 from .tracing import (
     resolve_event_log_keep,
     resolve_event_log_max_bytes,
@@ -49,7 +50,9 @@ METRICS = ("decode_ms", "roofline_util", "dispatch_ms")
 _HIGHER_IS_BAD = {"decode_ms": True, "roofline_util": False,
                   "dispatch_ms": True}
 
-_EWMA_DECAY = 0.8  # same 0.8/0.2 blend as the engine's tpot/dispatch EWMAs
+#: same 0.8/0.2 blend as the engine's tpot/dispatch EWMAs — single
+#: constant in observability/stats.py
+_EWMA_DECAY = stats.EWMA_DECAY
 _HISTORY_EVERY = 64        # append a baseline sample every N healthy steps
 _HISTORY_TAIL = 32         # baseline = median over the last N records
 
@@ -136,11 +139,9 @@ def validate_perf_history_path(path: str) -> dict:
 
 
 def _median(xs: List[float]) -> float:
-    s = sorted(xs)
-    n = len(s)
-    if n % 2:
-        return s[n // 2]
-    return 0.5 * (s[n // 2 - 1] + s[n // 2])
+    # single-sourced in observability/stats.py (same math as the
+    # StepTimer p50 and the bench lane percentiles)
+    return stats.median(xs)
 
 
 class PerfSentinel:
@@ -262,10 +263,8 @@ class PerfSentinel:
             for m, v in sample.items():
                 if v is None:
                     continue
-                prev = self._ewma[m]
-                self._ewma[m] = (v if prev is None
-                                 else _EWMA_DECAY * prev
-                                 + (1.0 - _EWMA_DECAY) * v)
+                self._ewma[m] = stats.ewma(self._ewma[m], v,
+                                           decay=_EWMA_DECAY)
             if not self._baseline and self._steps >= self.warmup_steps:
                 self._baseline = {m: v for m, v in self._ewma.items()
                                   if v is not None and v > 0}

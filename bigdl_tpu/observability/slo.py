@@ -1,0 +1,661 @@
+"""Service-level objectives: declarative per-QoS specs, multi-window
+sliding histograms, and Google-SRE-style burn-rate alerting.
+
+The rest of the observability stack records *mechanisms* (latency
+histograms, traces, roofline util); this module states what "healthy
+service" MEANS and pages when the error budget is burning faster than
+the service can afford.
+
+Spec grammar (``$BIGDL_TPU_SLO_SPEC``, JSON)
+--------------------------------------------
+A JSON object whose QoS-class keys override individual objectives and
+whose reserved keys tune the evaluator::
+
+    {"interactive": {"ttft_p99_ms": 500, "availability": 0.999},
+     "batch": {"tpot_p99_ms": 2000},
+     "windows": {"fast_sec": 300, "slow_sec": 3600},
+     "burn": {"fast": 14.4, "slow": 3.0},
+     "eval_sec": 5.0, "recover_evals": 3, "min_events": 12}
+
+Objectives per class (all optional; defaults below):
+
+- ``ttft_p99_ms`` — 99% of requests must see first token within this
+  (budget = 1% of requests may exceed it)
+- ``tpot_p99_ms`` — 99% of decode steps within this
+- ``error_rate`` — allowed fraction of finished requests with an
+  engine-error finish reason
+- ``availability`` — fraction of arriving requests that must be served
+  (sheds and errors both spend this budget)
+
+Burn-rate alerting (Google SRE workbook, multi-window multi-burn):
+``burn = bad_fraction / budget`` per sliding window. A *fast* alert
+(page-grade) fires when the 5m window burns >= 14.4x — at that rate a
+30-day budget is gone in ~2 days; a *slow* alert (ticket-grade) fires
+when the 1h window burns >= 3x. Alerts recover with hysteresis: the
+burn must stay below its threshold for ``recover_evals`` consecutive
+evaluations before the alert clears (the same dwell shape as the
+brownout governor and the perf sentinel).
+
+Every alert transition emits an ``slo_burn`` flight event, increments
+``bigdl_tpu_slo_alerts_total{qos,objective,severity}``, and appends a
+JSONL line to ``$BIGDL_TPU_SLO_ALERT_LOG`` (size-rotated with the
+event-log knobs). Current burn rates are exported as
+``bigdl_tpu_slo_burn_rate{qos,objective,window}`` gauges and served by
+``GET /v1/slo`` (per replica) and the router's fleet aggregation in
+``GET /v1/router/stats``.
+
+Stdlib-only by design (see observability/metrics.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .stats import percentile
+from .tracing import (
+    resolve_event_log_keep,
+    resolve_event_log_max_bytes,
+    rotate_event_log,
+    validate_event_log_path,
+)
+
+#: QoS classes (mirrors serving/overload.QOS_CLASSES; duplicated here
+#: so the observability package stays import-free of the serving tier)
+QOS_CLASSES = ("interactive", "standard", "batch")
+
+#: objective names, fixed — these are metric label values, so the set
+#: must stay bounded
+OBJECTIVES = ("ttft_p99", "tpot_p99", "error_rate", "availability")
+
+#: alert windows, fixed label values
+WINDOWS = ("fast", "slow")
+
+#: finish reasons that do NOT spend the error budget: client-visible
+#: success ("stop"/"length"), client-initiated cancels, client-set
+#: deadlines
+OK_FINISH_REASONS = ("stop", "length", "abort", "deadline")
+
+DEFAULT_OBJECTIVES: Dict[str, Dict[str, float]] = {
+    "interactive": {"ttft_p99_ms": 1000.0, "tpot_p99_ms": 200.0,
+                    "error_rate": 0.001, "availability": 0.999},
+    "standard": {"ttft_p99_ms": 2500.0, "tpot_p99_ms": 400.0,
+                 "error_rate": 0.005, "availability": 0.995},
+    "batch": {"ttft_p99_ms": 10000.0, "tpot_p99_ms": 1000.0,
+              "error_rate": 0.01, "availability": 0.99},
+}
+
+_DEFAULT_EVAL = {"fast_sec": 300.0, "slow_sec": 3600.0,
+                 "burn_fast": 14.4, "burn_slow": 3.0,
+                 "eval_sec": 5.0, "recover_evals": 3, "min_events": 12}
+
+_OBJECTIVE_KEYS = ("ttft_p99_ms", "tpot_p99_ms", "error_rate",
+                   "availability")
+
+
+def resolve_slo_spec(value: Optional[str] = None) -> dict:
+    """Parse + validate the SLO spec: explicit JSON string, else
+    ``$BIGDL_TPU_SLO_SPEC``, else pure defaults. Returns the resolved
+    spec dict ``{"qos": {...}, "windows": ..., "burn": ...,
+    "eval_sec": ..., "recover_evals": ..., "min_events": ...}``.
+    Raises ``ValueError`` on malformed JSON, unknown keys, or
+    out-of-range values (utils/env_check.py surfaces this)."""
+    if value is None:
+        value = os.environ.get("BIGDL_TPU_SLO_SPEC")
+    spec = {
+        "qos": {q: dict(DEFAULT_OBJECTIVES[q]) for q in QOS_CLASSES},
+        "windows": {"fast_sec": _DEFAULT_EVAL["fast_sec"],
+                    "slow_sec": _DEFAULT_EVAL["slow_sec"]},
+        "burn": {"fast": _DEFAULT_EVAL["burn_fast"],
+                 "slow": _DEFAULT_EVAL["burn_slow"]},
+        "eval_sec": _DEFAULT_EVAL["eval_sec"],
+        "recover_evals": _DEFAULT_EVAL["recover_evals"],
+        "min_events": _DEFAULT_EVAL["min_events"],
+    }
+    if not value:
+        return spec
+    try:
+        doc = json.loads(value)
+    except ValueError as e:
+        raise ValueError(f"BIGDL_TPU_SLO_SPEC is not valid JSON: {e}")
+    if not isinstance(doc, dict):
+        raise ValueError("BIGDL_TPU_SLO_SPEC must be a JSON object, "
+                         f"got {type(doc).__name__}")
+    for key, val in doc.items():
+        if key in QOS_CLASSES:
+            if not isinstance(val, dict):
+                raise ValueError(f"SLO spec for qos {key!r} must be an "
+                                 f"object, got {type(val).__name__}")
+            for ok, ov in val.items():
+                if ok not in _OBJECTIVE_KEYS:
+                    raise ValueError(
+                        f"unknown SLO objective {ok!r} for qos {key!r} "
+                        f"(choices: {', '.join(_OBJECTIVE_KEYS)})")
+                if not isinstance(ov, (int, float)) \
+                        or isinstance(ov, bool) or ov <= 0:
+                    raise ValueError(
+                        f"SLO objective {key}.{ok} must be a positive "
+                        f"number, got {ov!r}")
+                if ok in ("error_rate", "availability") and ov >= 1:
+                    raise ValueError(
+                        f"SLO objective {key}.{ok} must be in (0, 1), "
+                        f"got {ov!r}")
+                spec["qos"][key][ok] = float(ov)
+        elif key == "windows":
+            for wk in ("fast_sec", "slow_sec"):
+                if wk in val:
+                    wv = val[wk]
+                    if not isinstance(wv, (int, float)) \
+                            or isinstance(wv, bool) or wv <= 0:
+                        raise ValueError(
+                            f"SLO windows.{wk} must be a positive "
+                            f"number, got {wv!r}")
+                    spec["windows"][wk] = float(wv)
+            bad = set(val) - {"fast_sec", "slow_sec"}
+            if bad:
+                raise ValueError(f"unknown SLO windows key(s): "
+                                 f"{sorted(bad)}")
+        elif key == "burn":
+            for bk in ("fast", "slow"):
+                if bk in val:
+                    bv = val[bk]
+                    if not isinstance(bv, (int, float)) \
+                            or isinstance(bv, bool) or bv <= 0:
+                        raise ValueError(
+                            f"SLO burn.{bk} must be a positive number, "
+                            f"got {bv!r}")
+                    spec["burn"][bk] = float(bv)
+            bad = set(val) - {"fast", "slow"}
+            if bad:
+                raise ValueError(f"unknown SLO burn key(s): "
+                                 f"{sorted(bad)}")
+        elif key in ("eval_sec",):
+            if not isinstance(val, (int, float)) \
+                    or isinstance(val, bool) or val <= 0:
+                raise ValueError(
+                    f"SLO {key} must be a positive number, got {val!r}")
+            spec[key] = float(val)
+        elif key in ("recover_evals", "min_events"):
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or val < 1:
+                raise ValueError(
+                    f"SLO {key} must be an integer >= 1, got {val!r}")
+            spec[key] = int(val)
+        else:
+            raise ValueError(
+                f"unknown SLO spec key {key!r} (qos classes "
+                f"{', '.join(QOS_CLASSES)} or windows/burn/eval_sec/"
+                f"recover_evals/min_events)")
+    if spec["windows"]["fast_sec"] > spec["windows"]["slow_sec"]:
+        raise ValueError(
+            "SLO windows.fast_sec must be <= windows.slow_sec, got "
+            f"{spec['windows']['fast_sec']} > "
+            f"{spec['windows']['slow_sec']}")
+    return spec
+
+
+def resolve_slo_alert_log(value: Optional[str] = None) -> Optional[str]:
+    """Path for the JSONL alert sink: explicit value, else
+    ``$BIGDL_TPU_SLO_ALERT_LOG``, else None (no sink)."""
+    if value is None:
+        value = os.environ.get("BIGDL_TPU_SLO_ALERT_LOG")
+    return value or None
+
+
+def validate_slo_alert_log_path(path: str) -> dict:
+    """Writability report for the alert sink (utils/env_check.py
+    surfaces this for BIGDL_TPU_SLO_ALERT_LOG)."""
+    return validate_event_log_path(path)
+
+
+#: latency bucket upper edges in ms for the sliding histograms —
+#: log-spaced 1ms..100s; per-qos targets are counted exactly (the
+#: tracker splices each class's target into its bounds)
+_MS_BOUNDS = tuple(round(10 ** (e / 4), 3) for e in range(0, 21))
+
+
+class SlidingHistogram:
+    """Time-sliced histogram: observations land in the current slice,
+    reads aggregate the slices inside a lookback window. One ring sized
+    for the longest window serves every shorter window too. Not
+    thread-safe — the tracker serializes access under its lock."""
+
+    def __init__(self, bounds: Tuple[float, ...], max_window_s: float,
+                 slice_s: float):
+        self.bounds = tuple(sorted(set(bounds)))
+        self.slice_s = max(slice_s, 0.05)
+        self.max_window_s = max_window_s
+        # (slice_start, per-bucket counts [len(bounds)+1 for +Inf],
+        #  total, sum)
+        self._slices: "collections.deque" = collections.deque()
+
+    def _bucket(self, v: float) -> int:
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                return i
+        return len(self.bounds)
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.max_window_s - self.slice_s
+        while self._slices and self._slices[0][0] < horizon:
+            self._slices.popleft()
+
+    def observe(self, v: float, now: float) -> None:
+        self._prune(now)
+        t0 = now - (now % self.slice_s)
+        if not self._slices or self._slices[-1][0] != t0:
+            self._slices.append(
+                (t0, [0] * (len(self.bounds) + 1), [0], [0.0]))
+        _, counts, total, acc = self._slices[-1]
+        counts[self._bucket(v)] += 1
+        total[0] += 1
+        acc[0] += v
+
+    def window(self, window_s: float, now: float):
+        """Aggregated (bucket_counts, total, sum) over the trailing
+        ``window_s`` seconds."""
+        self._prune(now)
+        counts = [0] * (len(self.bounds) + 1)
+        total, acc = 0, 0.0
+        horizon = now - window_s
+        for t0, c, t, a in self._slices:
+            if t0 + self.slice_s <= horizon:
+                continue
+            for i, n in enumerate(c):
+                counts[i] += n
+            total += t[0]
+            acc += a[0]
+        return counts, total, acc
+
+    def count_above(self, threshold: float, window_s: float,
+                    now: float) -> Tuple[int, int]:
+        """(observations strictly above ``threshold``, total) in the
+        window. Exact when ``threshold`` is a bucket bound (the tracker
+        splices the per-qos targets into ``bounds``)."""
+        counts, total, _ = self.window(window_s, now)
+        above = 0
+        for i, b in enumerate(self.bounds):
+            if b > threshold:
+                above += counts[i]
+        above += counts[-1]
+        return above, total
+
+    def quantile(self, q: float, window_s: float,
+                 now: float) -> Optional[float]:
+        """Bucket-interpolated quantile estimate (same scheme as the
+        registry's summary())."""
+        counts, total, _ = self.window(window_s, now)
+        if total == 0:
+            return None
+        rank = q * total
+        run = 0
+        lo = 0.0
+        for i, b in enumerate(self.bounds):
+            nxt = run + counts[i]
+            if nxt >= rank and counts[i] > 0:
+                frac = (rank - run) / counts[i]
+                return lo + (b - lo) * frac
+            run = nxt
+            lo = b
+        return self.bounds[-1]
+
+
+class SlidingCounts:
+    """Time-sliced categorical counters (ok / error / shed) with the
+    same windowed aggregation as SlidingHistogram."""
+
+    def __init__(self, max_window_s: float, slice_s: float):
+        self.slice_s = max(slice_s, 0.05)
+        self.max_window_s = max_window_s
+        self._slices: "collections.deque" = collections.deque()
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.max_window_s - self.slice_s
+        while self._slices and self._slices[0][0] < horizon:
+            self._slices.popleft()
+
+    def add(self, key: str, now: float, n: int = 1) -> None:
+        self._prune(now)
+        t0 = now - (now % self.slice_s)
+        if not self._slices or self._slices[-1][0] != t0:
+            self._slices.append((t0, collections.Counter()))
+        self._slices[-1][1][key] += n
+
+    def window(self, window_s: float, now: float) -> Dict[str, int]:
+        self._prune(now)
+        out: collections.Counter = collections.Counter()
+        horizon = now - window_s
+        for t0, c in self._slices:
+            if t0 + self.slice_s <= horizon:
+                continue
+            out.update(c)
+        return dict(out)
+
+
+class SLOTracker:
+    """Evaluates the resolved SLO spec against live traffic.
+
+    Feeds (engine thread): ``observe_ttft`` / ``observe_tpot`` /
+    ``observe_result``. Evaluation (``maybe_evaluate``) is throttled to
+    ``eval_sec`` and runs the burn-rate math, gauge export, alert state
+    machine, flight events, and the JSONL alert sink. HTTP handler
+    threads call ``snapshot()`` — everything mutable is guarded by one
+    lock."""
+
+    def __init__(self, spec: Optional[dict] = None, registry=None,
+                 flight=None, alert_log_path: Optional[str] = None,
+                 time_fn=time.time):
+        if spec is None:
+            try:
+                spec = resolve_slo_spec()
+            except ValueError:
+                # env_check reports the bad spec; serve with defaults
+                spec = resolve_slo_spec("")
+        self.spec = spec
+        self.flight = flight
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._last_eval = 0.0
+        slow = spec["windows"]["slow_sec"]
+        fast = spec["windows"]["fast_sec"]
+        slice_s = max(fast / 30.0, 0.05)
+        self._win = {"fast": fast, "slow": slow}
+        self._ttft: Dict[str, SlidingHistogram] = {}
+        self._tpot: Dict[str, SlidingHistogram] = {}
+        self._events: Dict[str, SlidingCounts] = {}
+        for q in QOS_CLASSES:
+            ob = spec["qos"][q]
+            self._ttft[q] = SlidingHistogram(
+                _MS_BOUNDS + (ob["ttft_p99_ms"],), slow, slice_s)
+            self._tpot[q] = SlidingHistogram(
+                _MS_BOUNDS + (ob["tpot_p99_ms"],), slow, slice_s)
+            self._events[q] = SlidingCounts(slow, slice_s)
+        # alert state per (qos, objective): None | {"severity", "since",
+        # "burn", "good_evals"}
+        self._alerts: Dict[Tuple[str, str], dict] = {}
+        self._alerts_total = 0
+        self._last_burn: Dict[Tuple[str, str, str], float] = {}
+        # JSONL alert sink, size-rotated with the event-log knobs
+        if alert_log_path is None:
+            alert_log_path = resolve_slo_alert_log()
+        self._sink_path = alert_log_path or None
+        self._sink_dead = False
+        try:
+            self._sink_max_bytes = resolve_event_log_max_bytes()
+            self._sink_keep = resolve_event_log_keep()
+        except ValueError:
+            self._sink_max_bytes, self._sink_keep = None, 1
+        # metric families (registry may be None for bare trackers)
+        self._g_burn = None
+        self._c_alerts = None
+        if registry is not None:
+            self._g_burn = registry.gauge(
+                "bigdl_tpu_slo_burn_rate",
+                "Error-budget burn rate per QoS class, objective and "
+                "sliding window (1.0 = burning exactly the budget).",
+                labelnames=("qos", "objective", "window"))
+            self._c_alerts = registry.counter(
+                "bigdl_tpu_slo_alerts_total",
+                "Burn-rate alerts fired, by QoS class, objective and "
+                "severity (fast = page-grade, slow = ticket-grade).",
+                labelnames=("qos", "objective", "severity"))
+            for q in QOS_CLASSES:       # render from scrape 1
+                for o in OBJECTIVES:
+                    for w in WINDOWS:
+                        self._g_burn.labels(q, o, w).set(0.0)
+
+    # -- feeds (engine thread) ---------------------------------------------
+
+    def observe_ttft(self, qos: str, seconds: float) -> None:
+        h = self._ttft.get(qos)
+        if h is not None and seconds >= 0:
+            with self._lock:
+                h.observe(seconds * 1e3, self._time())
+
+    def observe_tpot(self, qos: str, seconds: float) -> None:
+        h = self._tpot.get(qos)
+        if h is not None and seconds >= 0:
+            with self._lock:
+                h.observe(seconds * 1e3, self._time())
+
+    def observe_result(self, qos: str, outcome: str) -> None:
+        """``outcome``: "ok" | "error" | "shed"."""
+        ev = self._events.get(qos)
+        if ev is not None:
+            with self._lock:
+                ev.add(outcome, self._time())
+
+    def observe_finish(self, qos: str, reason: str) -> None:
+        self.observe_result(
+            qos, "ok" if reason in OK_FINISH_REASONS else "error")
+
+    # -- burn math ----------------------------------------------------------
+
+    def _burn_rates(self, qos: str, objective: str,
+                    now: float) -> Dict[str, float]:
+        """{window: burn} for one (qos, objective); burn is 0.0 until
+        ``min_events`` observations fill the window (a cold start must
+        not page)."""
+        ob = self.spec["qos"][qos]
+        min_ev = self.spec["min_events"]
+        out = {}
+        for w in WINDOWS:
+            win = self._win[w]
+            if objective == "ttft_p99":
+                bad, total = self._ttft[qos].count_above(
+                    ob["ttft_p99_ms"], win, now)
+                budget = 0.01
+            elif objective == "tpot_p99":
+                bad, total = self._tpot[qos].count_above(
+                    ob["tpot_p99_ms"], win, now)
+                budget = 0.01
+            elif objective == "error_rate":
+                ev = self._events[qos].window(win, now)
+                bad = ev.get("error", 0)
+                total = bad + ev.get("ok", 0)
+                budget = ob["error_rate"]
+            else:                        # availability
+                ev = self._events[qos].window(win, now)
+                bad = ev.get("error", 0) + ev.get("shed", 0)
+                total = bad + ev.get("ok", 0)
+                budget = 1.0 - ob["availability"]
+            if total < min_ev or budget <= 0:
+                out[w] = 0.0
+            else:
+                out[w] = (bad / total) / budget
+        return out
+
+    def compliance(self, qos: str, kind: str,
+                   window: str = "slow") -> Optional[float]:
+        """Fraction of ``kind`` ("ttft"/"tpot") observations inside the
+        target over the window; None with no traffic."""
+        now = self._time()
+        ob = self.spec["qos"][qos]
+        with self._lock:
+            if kind == "ttft":
+                bad, total = self._ttft[qos].count_above(
+                    ob["ttft_p99_ms"], self._win[window], now)
+            else:
+                bad, total = self._tpot[qos].count_above(
+                    ob["tpot_p99_ms"], self._win[window], now)
+        if total == 0:
+            return None
+        return 1.0 - bad / total
+
+    # -- alert state machine ------------------------------------------------
+
+    def maybe_evaluate(self, now: Optional[float] = None) -> None:
+        """Throttled entry point — call freely from the engine step
+        loop; the burn math runs at most once per ``eval_sec``."""
+        if now is None:
+            now = self._time()
+        with self._lock:
+            if now - self._last_eval < self.spec["eval_sec"]:
+                return
+        self.evaluate(now)
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """One full evaluation pass; returns the alert transitions it
+        produced (fired / recovered), already emitted to flight,
+        metrics and the sink."""
+        if now is None:
+            now = self._time()
+        transitions: List[dict] = []
+        burn_th = {"fast": self.spec["burn"]["fast"],
+                   "slow": self.spec["burn"]["slow"]}
+        with self._lock:
+            self._last_eval = now
+            for q in QOS_CLASSES:
+                for o in OBJECTIVES:
+                    burns = self._burn_rates(q, o, now)
+                    for w in WINDOWS:
+                        self._last_burn[(q, o, w)] = burns[w]
+                        if self._g_burn is not None:
+                            self._g_burn.labels(q, o, w).set(
+                                round(burns[w], 4))
+                    # fast (page) outranks slow (ticket)
+                    severity = None
+                    if burns["fast"] >= burn_th["fast"]:
+                        severity = "fast"
+                    elif burns["slow"] >= burn_th["slow"]:
+                        severity = "slow"
+                    st = self._alerts.get((q, o))
+                    if severity is not None:
+                        if st is None:
+                            st = {"severity": severity, "since": now,
+                                  "burn": burns, "good_evals": 0}
+                            self._alerts[(q, o)] = st
+                            self._alerts_total += 1
+                            transitions.append({
+                                "event": "slo_burn", "qos": q,
+                                "objective": o, "severity": severity,
+                                "burn_fast": round(burns["fast"], 3),
+                                "burn_slow": round(burns["slow"], 3)})
+                            if self._c_alerts is not None:
+                                self._c_alerts.labels(q, o,
+                                                      severity).inc()
+                        else:
+                            st["severity"] = max(
+                                st["severity"], severity,
+                                key=lambda s: s == "fast")
+                            st["burn"] = burns
+                            st["good_evals"] = 0
+                    elif st is not None:
+                        # hysteresis: recover only after recover_evals
+                        # consecutive healthy evaluations
+                        st["good_evals"] += 1
+                        if st["good_evals"] >= self.spec["recover_evals"]:
+                            del self._alerts[(q, o)]
+                            transitions.append({
+                                "event": "slo_recover", "qos": q,
+                                "objective": o,
+                                "severity": st["severity"],
+                                "burn_fast": round(burns["fast"], 3),
+                                "burn_slow": round(burns["slow"], 3)})
+        for tr in transitions:
+            if self.flight is not None:
+                self.flight.record(tr["event"],
+                                   **{k: v for k, v in tr.items()
+                                      if k != "event"})
+            self._sink_write(dict(tr, ts=round(now, 3)))
+        return transitions
+
+    # -- JSONL alert sink ---------------------------------------------------
+
+    def _sink_write(self, doc: dict) -> None:
+        if self._sink_path is None or self._sink_dead:
+            return
+        line = json.dumps(doc, separators=(",", ":")) + "\n"
+        try:
+            if (self._sink_max_bytes is not None
+                    and os.path.exists(self._sink_path)
+                    and os.path.getsize(self._sink_path) + len(line)
+                    > self._sink_max_bytes):
+                rotate_event_log(self._sink_path, self._sink_keep)
+            with open(self._sink_path, "a", encoding="utf-8") as fh:
+                fh.write(line)
+        except OSError as e:
+            self._sink_dead = True
+            logging.getLogger(__name__).warning(
+                "SLO alert log %s unwritable (%s); sink disabled",
+                self._sink_path, e)
+
+    # -- introspection ------------------------------------------------------
+
+    def alerts_active(self) -> int:
+        with self._lock:
+            return len(self._alerts)
+
+    def burn_rate_max(self) -> float:
+        """Worst current burn across every (qos, objective, window) —
+        the bench lane headline."""
+        with self._lock:
+            return max(self._last_burn.values(), default=0.0)
+
+    def snapshot(self) -> dict:
+        """The ``GET /v1/slo`` document."""
+        now = self._time()
+        out: dict = {
+            "spec": {
+                "qos": {q: dict(self.spec["qos"][q])
+                        for q in QOS_CLASSES},
+                "windows": dict(self.spec["windows"]),
+                "burn": dict(self.spec["burn"]),
+                "eval_sec": self.spec["eval_sec"],
+                "recover_evals": self.spec["recover_evals"],
+                "min_events": self.spec["min_events"],
+            },
+            "qos": {},
+        }
+        with self._lock:
+            for q in QOS_CLASSES:
+                ob = self.spec["qos"][q]
+                qd: dict = {"objectives": {}}
+                for o in OBJECTIVES:
+                    st = self._alerts.get((q, o))
+                    qd["objectives"][o] = {
+                        "burn": {
+                            w: round(self._last_burn.get((q, o, w),
+                                                         0.0), 4)
+                            for w in WINDOWS},
+                        "alert": ({"severity": st["severity"],
+                                   "since": round(st["since"], 3)}
+                                  if st else None),
+                    }
+                for kind, hist, target in (
+                        ("ttft", self._ttft[q], ob["ttft_p99_ms"]),
+                        ("tpot", self._tpot[q], ob["tpot_p99_ms"])):
+                    p99 = hist.quantile(0.99, self._win["fast"], now)
+                    _, total, _ = hist.window(self._win["fast"], now)
+                    qd[f"{kind}_p99_ms"] = (round(p99, 3)
+                                            if p99 is not None else None)
+                    qd[f"{kind}_target_ms"] = target
+                    qd[f"{kind}_count"] = total
+                ev = self._events[q].window(self._win["slow"], now)
+                qd["events"] = ev
+                out["qos"][q] = qd
+            out["alerts_active"] = len(self._alerts)
+            out["alerts_total"] = self._alerts_total
+            out["burn_rate_max"] = round(
+                max(self._last_burn.values(), default=0.0), 4)
+        return out
+
+
+__all__ = [
+    "QOS_CLASSES",
+    "OBJECTIVES",
+    "WINDOWS",
+    "OK_FINISH_REASONS",
+    "DEFAULT_OBJECTIVES",
+    "SlidingHistogram",
+    "SlidingCounts",
+    "SLOTracker",
+    "resolve_slo_spec",
+    "resolve_slo_alert_log",
+    "validate_slo_alert_log_path",
+]

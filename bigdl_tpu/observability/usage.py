@@ -1,0 +1,257 @@
+"""Per-tenant usage metering: a durable ledger + live rollup.
+
+Answers the question the tenant counters (serving/overload.py) can't:
+"what did tenant X actually consume, and what did each request cost?"
+One append-only JSONL record per finished (or shed) request — tenant,
+QoS class, prompt/generated tokens, queue-wait, TTFT/TPOT, finish
+reason, preemption count — written OFF the engine thread (a
+SimpleQueue feeds a daemon writer, so a slow disk can never stall a
+decode step). The in-memory rollup behind ``GET /v1/usage`` carries
+per-tenant totals plus a current token-burn rate, and reconciles
+exactly against ``bigdl_tpu_tenant_requests_total`` and the overload
+governor's per-tenant generated totals (tests/test_slo.py asserts
+this).
+
+Knobs: ``$BIGDL_TPU_USAGE_LOG`` (ledger path; unset = metering stays
+in-memory only), rotation via the shared event-log size knobs.
+
+Stdlib-only by design (see observability/metrics.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import queue
+import threading
+import time
+from typing import Dict, Optional
+
+from .tracing import (
+    resolve_event_log_keep,
+    resolve_event_log_max_bytes,
+    rotate_event_log,
+    validate_event_log_path,
+)
+
+#: trailing window for the per-tenant burn rate in the rollup
+_BURN_WINDOW_S = 60.0
+
+
+def resolve_usage_log(value: Optional[str] = None) -> Optional[str]:
+    """Ledger path: explicit value, else ``$BIGDL_TPU_USAGE_LOG``, else
+    None (rollup only, no file)."""
+    if value is None:
+        value = os.environ.get("BIGDL_TPU_USAGE_LOG")
+    return value or None
+
+
+def validate_usage_log_path(path: str) -> dict:
+    """Writability report for the ledger path (utils/env_check.py
+    surfaces this for BIGDL_TPU_USAGE_LOG)."""
+    return validate_event_log_path(path)
+
+
+class _TenantUsage:
+    __slots__ = ("requests", "shed", "errors", "prompt_tokens",
+                 "generated_tokens", "queue_wait_s", "ttft_s_sum",
+                 "ttft_n", "preemptions", "burn")
+
+    def __init__(self):
+        self.requests = 0          # finished (any reason except shed)
+        self.shed = 0
+        self.errors = 0
+        self.prompt_tokens = 0
+        self.generated_tokens = 0
+        self.queue_wait_s = 0.0
+        self.ttft_s_sum = 0.0
+        self.ttft_n = 0
+        self.preemptions = 0
+        # (ts, generated_tokens) samples for the burn window
+        self.burn = collections.deque()
+
+
+class UsageLedger:
+    """Durable per-request usage records + live per-tenant rollup.
+
+    ``record_finish`` / ``record_shed`` are called from the engine
+    thread and must stay cheap: they update the rollup under a lock and
+    enqueue the JSONL doc for the writer thread. ``snapshot()`` is the
+    ``GET /v1/usage`` document; ``drain()`` blocks until every queued
+    record hit the file (tests and graceful shutdown)."""
+
+    def __init__(self, path: Optional[str] = None, time_fn=time.time):
+        if path is None:
+            path = resolve_usage_log()
+        self.path = path or None
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _TenantUsage] = {}
+        self._records_total = 0
+        self._dropped = 0
+        self._sink_dead = False
+        try:
+            self._max_bytes = resolve_event_log_max_bytes()
+            self._keep = resolve_event_log_keep()
+        except ValueError:
+            self._max_bytes, self._keep = None, 1
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._writer: Optional[threading.Thread] = None
+        if self.path is not None:
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="usage-ledger",
+                daemon=True)
+            self._writer.start()
+
+    # -- engine-thread feeds ------------------------------------------------
+
+    def record_finish(self, rid: str, tenant: str, qos: str,
+                      prompt_tokens: int, generated_tokens: int,
+                      finish_reason: str,
+                      queue_wait_s: Optional[float] = None,
+                      ttft_s: Optional[float] = None,
+                      tpot_s: Optional[float] = None,
+                      preemptions: int = 0) -> None:
+        now = self._time()
+        tenant = tenant or "default"
+        with self._lock:
+            t = self._tenants.setdefault(tenant, _TenantUsage())
+            t.requests += 1
+            if finish_reason == "error" or finish_reason not in (
+                    "stop", "length", "abort", "deadline"):
+                t.errors += 1
+            t.prompt_tokens += int(prompt_tokens)
+            t.generated_tokens += int(generated_tokens)
+            if queue_wait_s is not None:
+                t.queue_wait_s += queue_wait_s
+            if ttft_s is not None:
+                t.ttft_s_sum += ttft_s
+                t.ttft_n += 1
+            t.preemptions += int(preemptions)
+            t.burn.append((now, int(generated_tokens)))
+            self._trim_burn(t, now)
+            self._records_total += 1
+        doc = {"ts": round(now, 3), "rid": rid, "tenant": tenant,
+               "qos": qos, "outcome": "finish",
+               "finish_reason": finish_reason,
+               "prompt_tokens": int(prompt_tokens),
+               "generated_tokens": int(generated_tokens)}
+        if queue_wait_s is not None:
+            doc["queue_wait_s"] = round(queue_wait_s, 4)
+        if ttft_s is not None:
+            doc["ttft_s"] = round(ttft_s, 4)
+        if tpot_s is not None:
+            doc["tpot_s"] = round(tpot_s, 5)
+        if preemptions:
+            doc["preemptions"] = int(preemptions)
+        self._enqueue(doc)
+
+    def record_shed(self, rid: str, tenant: str, qos: str,
+                    reason: str) -> None:
+        now = self._time()
+        tenant = tenant or "default"
+        with self._lock:
+            t = self._tenants.setdefault(tenant, _TenantUsage())
+            t.shed += 1
+            self._records_total += 1
+        self._enqueue({"ts": round(now, 3), "rid": rid,
+                       "tenant": tenant, "qos": qos, "outcome": "shed",
+                       "reason": reason})
+
+    @staticmethod
+    def _trim_burn(t: _TenantUsage, now: float) -> None:
+        horizon = now - _BURN_WINDOW_S
+        while t.burn and t.burn[0][0] < horizon:
+            t.burn.popleft()
+
+    # -- writer thread ------------------------------------------------------
+
+    def _enqueue(self, doc: dict) -> None:
+        if self.path is not None and not self._sink_dead:
+            self._q.put(doc)
+
+    def _writer_loop(self) -> None:
+        while True:
+            doc = self._q.get()
+            if doc is None:            # drain barrier
+                continue
+            if isinstance(doc, threading.Event):
+                doc.set()
+                continue
+            self._write(doc)
+
+    def _write(self, doc: dict) -> None:
+        if self._sink_dead:
+            return
+        line = json.dumps(doc, separators=(",", ":")) + "\n"
+        try:
+            if (self._max_bytes is not None
+                    and os.path.exists(self.path)
+                    and os.path.getsize(self.path) + len(line)
+                    > self._max_bytes):
+                rotate_event_log(self.path, self._keep)
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(line)
+        except OSError as e:
+            self._sink_dead = True
+            with self._lock:
+                self._dropped += 1
+            logging.getLogger(__name__).warning(
+                "usage ledger %s unwritable (%s); ledger disabled "
+                "(rollup keeps running)", self.path, e)
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Block until every record enqueued so far is on disk. True on
+        success, False on timeout or when no file is configured."""
+        if self.path is None or self._writer is None:
+            return False
+        ev = threading.Event()
+        self._q.put(ev)
+        return ev.wait(timeout)
+
+    # -- rollup -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The ``GET /v1/usage`` document: per-tenant totals + current
+        burn (tokens/s over the last minute)."""
+        now = self._time()
+        tenants = {}
+        with self._lock:
+            for name, t in sorted(self._tenants.items()):
+                self._trim_burn(t, now)
+                burn_tokens = sum(n for _, n in t.burn)
+                tenants[name] = {
+                    "requests": t.requests,
+                    "shed": t.shed,
+                    "errors": t.errors,
+                    "prompt_tokens": t.prompt_tokens,
+                    "generated_tokens": t.generated_tokens,
+                    "queue_wait_s": round(t.queue_wait_s, 3),
+                    "mean_ttft_s": (round(t.ttft_s_sum / t.ttft_n, 4)
+                                    if t.ttft_n else None),
+                    "preemptions": t.preemptions,
+                    "burn_tokens_per_s": round(
+                        burn_tokens / _BURN_WINDOW_S, 3),
+                }
+            out = {"tenants": tenants,
+                   "records_total": self._records_total,
+                   "ledger_path": self.path,
+                   "ledger_dropped": self._dropped}
+        return out
+
+    def totals(self) -> Dict[str, Dict[str, int]]:
+        """Bare per-tenant counters for reconciliation tests:
+        ``{tenant: {"requests", "shed", "generated_tokens"}}``."""
+        with self._lock:
+            return {name: {"requests": t.requests, "shed": t.shed,
+                           "generated_tokens": t.generated_tokens}
+                    for name, t in self._tenants.items()}
+
+
+__all__ = [
+    "UsageLedger",
+    "resolve_usage_log",
+    "validate_usage_log_path",
+]

@@ -25,6 +25,16 @@ Kinds and the injection points they attach to:
   decode (point ``"logits"``); exercises the per-slot health check and
   quarantine. ``slot=i`` targets a fixed row (default: the lowest
   active slot).
+- ``logit_drift``     — add a FINITE constant bias (``bias=``, default
+  3.0) to one vocab column of every active logits row from the first
+  firing onward (point ``"logits"``). Unlike ``nan_logits`` this is invisible to the
+  engine's isfinite health check: the replica keeps serving at full
+  speed with every gauge green, but greedy argmax changes — silent
+  correctness drift. The detection path under test is the router's
+  golden-canary probes (serving/canary.py), which quarantine the
+  replica on byte mismatch. Once fired, drift stays on for the life of
+  the process (real corruption doesn't heal); ``times=`` caps only the
+  number of *onset* firings.
 - ``slow_step``       — sleep ``ms=`` milliseconds at the step point;
   exercises deadline enforcement without a slow model.
 - ``replica_crash``   — hard-kill THIS PROCESS (``os._exit``, default
@@ -75,6 +85,8 @@ Trigger params (every kind):
 - ``code=C``        — process exit code (``replica_crash`` only).
 - ``pressure=P``    — forced brownout pressure in [0, 1]
   (``overload_storm`` only; default 1.0).
+- ``bias=B``        — additive logit bias (``logit_drift`` only;
+  default 3.0; must be finite and non-zero).
 
 Example: ``step_exception@p=0.05,seed=7;slow_step@ms=500,every=10``.
 """
@@ -91,8 +103,8 @@ import numpy as np
 FAULT_SPEC_ENV = "BIGDL_TPU_FAULT_SPEC"
 
 KINDS = ("step_exception", "admit_exception", "prefill_exception",
-         "nan_logits", "slow_step", "replica_crash", "replica_hang",
-         "overload_storm", "handoff_drop", "scale_flap")
+         "nan_logits", "logit_drift", "slow_step", "replica_crash",
+         "replica_hang", "overload_storm", "handoff_drop", "scale_flap")
 
 #: default exit code for replica_crash — what an external ``kill -9``
 #: surfaces as through the shell (128 + SIGKILL)
@@ -107,7 +119,7 @@ _RAISE_POINTS = {
 
 _INT_PARAMS = ("after_step", "at_step", "every", "times", "seed", "slot",
                "code")
-_FLOAT_PARAMS = ("p", "ms", "pressure")
+_FLOAT_PARAMS = ("p", "ms", "pressure", "bias")
 
 
 class InjectedFault(RuntimeError):
@@ -136,6 +148,7 @@ class FaultClause:
     slot: Optional[int] = None
     code: Optional[int] = None        # replica_crash exit code
     pressure: float = 1.0             # overload_storm forced pressure
+    bias: float = 3.0                 # logit_drift additive bias
     # runtime state
     fired: int = 0
     visits: int = 0
@@ -208,6 +221,11 @@ def parse_fault_spec(spec: str) -> List[FaultClause]:
         if pr is not None and not (0.0 <= pr <= 1.0):  # type: ignore
             raise ValueError(
                 f"overload_storm pressure={pr} not in [0, 1]")
+        b = kw.get("bias")
+        if b is not None and (b != b or b in (float("inf"),
+                                              float("-inf")) or b == 0.0):
+            raise ValueError(
+                f"logit_drift bias={b} must be finite and non-zero")
         clauses.append(FaultClause(kind=kind, **kw))  # type: ignore[arg-type]
     return clauses
 
@@ -356,6 +374,28 @@ class FaultInjector:
                 # even firings go down — a deterministic flap
                 direction = "up" if c.fired % 2 == 1 else "down"
         return direction
+
+    def drift_rows(self, step: int, active_rows):
+        """``(rows, bias)`` — logits rows to shift by a finite additive
+        ``bias`` this step (``([], 0.0)`` when no ``logit_drift``
+        clause is live). Drift is STICKY: once a clause fires its bias
+        applies to every active row on every later step, modelling
+        corruption that doesn't heal. The shifted logits stay finite,
+        so the engine's isfinite health check passes and only a golden
+        canary replay can notice."""
+        if not self.clauses or not active_rows:
+            return [], 0.0
+        bias = 0.0
+        for c in self._by_kind.get("logit_drift", ()):
+            if getattr(c, "_drifting", False):
+                bias += c.bias
+            elif c.should_fire(step):
+                self._fired("logit_drift", "logits", step)
+                c._drifting = True    # type: ignore[attr-defined]
+                bias += c.bias
+        if bias == 0.0:
+            return [], 0.0
+        return list(active_rows), bias
 
     def poison_rows(self, step: int, active_rows) -> List[int]:
         """Rows of the decode logits to overwrite with NaN this step

@@ -30,6 +30,10 @@ Observability endpoints (bigdl_tpu/observability/):
   on-demand jax.profiler device trace against the live server
   (TensorBoard/Perfetto; wraps utils/profiling.start_profiler)
 - GET /v1/profiler/status — whether a capture is running, and where
+- GET /v1/slo — per-replica SLO state: resolved spec, burn rates per
+  (qos, objective, window), active alerts (observability/slo.py)
+- GET /v1/usage — per-tenant usage rollup: totals + current token
+  burn from the usage ledger (observability/usage.py)
 
 Tokenization: pass a HF tokenizer (transformers.AutoTokenizer) at
 construction; prompts may also be raw token-id lists, in which case
@@ -835,6 +839,19 @@ class OpenAIServer:
                     # this per replica
                     self._json(200, _jsonable(
                         server.engine.perf_snapshot()))
+                elif self.path == "/v1/slo":
+                    # per-replica SLO state: resolved spec, current
+                    # burn rates per (qos, objective, window), active
+                    # alerts (observability/slo.py); the router
+                    # aggregates this fleet-wide in /v1/router/stats
+                    self._json(200, _jsonable(
+                        server.engine.slo.snapshot()))
+                elif self.path == "/v1/usage":
+                    # per-tenant usage rollup (observability/usage.py):
+                    # totals + current token burn, reconciling exactly
+                    # with bigdl_tpu_tenant_requests_total
+                    self._json(200, _jsonable(
+                        server.engine.usage.snapshot()))
                 elif self.path == "/v1/profiler/status":
                     from bigdl_tpu.utils import profiling
 

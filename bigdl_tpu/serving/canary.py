@@ -1,0 +1,261 @@
+"""Golden-canary correctness probes for the replica fleet.
+
+The fault models the supervisor already catches are LOUD: crashes,
+hangs, NaN logits, drains. What nothing above caught is a replica that
+decodes *wrong-but-finite* tokens — a corrupted weight shard, a bad
+quantized kernel, an injected ``logit_drift`` — serving garbage at
+full speed with every gauge green (the motivation case in ISSUE/
+PAPERS: "When Quantization Is Free" quality drift).
+
+The prober drives a pinned set of greedy (temperature 0) probes
+through each HEALTHY replica round-robin. Because every replica loads
+the same seeded weights and greedy decoding is deterministic, every
+replica must produce byte-identical completions; the FIRST successful
+probe per (prompt, kind) records the golden answer, and any later
+byte mismatch — from any replica — is a correctness alert:
+
+- ``canary_mismatch`` flight event + ``canary_failures`` counter +
+  ``bigdl_tpu_router_canary_failures_total{replica}``,
+- the replica is quarantined through the existing supervisor path
+  (state QUARANTINED, process SIGTERMed, no restarts fed to it) —
+  exactly like a crash-looping replica, because a silently wrong
+  replica is WORSE than a dead one.
+
+Probe kinds (the paths that can each break independently):
+
+- ``plain``   — straight ``POST /v1/completions`` at the replica: the
+  decode path itself.
+- ``prefix``  — the same prompt re-probed plus a longer prompt sharing
+  its prefix: with paged KV + radix sharing enabled this is served
+  from copy-on-write shared pages, so a corrupted prefix-cache path
+  diverges here while ``plain`` stays golden.
+- ``handoff`` — only probed when the fleet has prefill-role replicas:
+  the probe carries ``X-Handoff-Targets`` (built by the router the
+  same way as a client forward), so prefill -> KV-ship -> remote
+  decode must reproduce the same bytes.
+
+Knob: ``$BIGDL_TPU_CANARY_SEC`` — probe sweep interval in seconds,
+0 disables (default). Validated by utils/env_check.py.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+CANARY_SEC_ENV = "BIGDL_TPU_CANARY_SEC"
+
+#: pinned probe prompts: raw token-id lists (the API accepts them and
+#: answers with token ids — no tokenizer needed, and ids this small
+#: exist in every vocab). The third shares the second's prefix so the
+#: radix/paged-KV path serves it from shared pages.
+DEFAULT_PROMPTS: Tuple[Tuple[int, ...], ...] = (
+    (1, 2, 3, 4, 5, 6, 7, 8),
+    (11, 12, 13, 14, 15, 16, 17, 18, 19, 20, 21, 22),
+    (11, 12, 13, 14, 15, 16, 17, 18, 19, 20, 21, 22, 23, 24),
+)
+
+DEFAULT_MAX_TOKENS = 8
+
+KINDS = ("plain", "prefix", "handoff")
+
+
+def resolve_canary_sec(value: Optional[str] = None) -> float:
+    """Canary sweep interval in seconds: explicit value, else
+    ``$BIGDL_TPU_CANARY_SEC``, else 0.0 (disabled). Raises
+    ``ValueError`` on a negative or non-numeric value (env_check
+    surfaces it)."""
+    raw = value if value is not None else os.environ.get(
+        CANARY_SEC_ENV, "")
+    if not raw:
+        return 0.0
+    sec = float(raw)                   # ValueError propagates
+    if sec < 0:
+        raise ValueError(
+            f"{CANARY_SEC_ENV} must be >= 0 (0 disables), got {raw!r}")
+    return sec
+
+
+class CanaryProber:
+    """Periodic golden-probe sweeps over a Router's replicas.
+
+    Owns a daemon thread (started by ``start()``, stopped by
+    ``stop()``) so a slow probe can never stall the supervisor's
+    health loop. All mutable state (goldens, counters) is only touched
+    from that thread; ``snapshot()`` copies under the router lock-free
+    dict-read idiom (GIL-atomic reads of append-only state)."""
+
+    def __init__(self, router: Any, interval_sec: float,
+                 prompts: Optional[List[Tuple[int, ...]]] = None,
+                 max_tokens: int = DEFAULT_MAX_TOKENS,
+                 timeout_sec: float = 30.0):
+        self.router = router
+        self.interval_sec = interval_sec
+        self.prompts = [tuple(p) for p in (prompts or DEFAULT_PROMPTS)]
+        self.max_tokens = max_tokens
+        self.timeout_sec = timeout_sec
+        # (prompt_idx, kind) -> golden choice payload (JSON-stable str)
+        self.goldens: Dict[Tuple[int, str], str] = {}
+        self.probes_total = 0
+        self.failures_total = 0
+        self.last_sweep: Optional[float] = None
+        self.last_error: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self.interval_sec <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name="canary", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_sec):
+            try:
+                self.sweep()
+            except Exception as e:       # the prober must survive
+                self.last_error = f"{type(e).__name__}: {e}"
+
+    # -- probing ------------------------------------------------------------
+
+    def _healthy(self) -> List[Any]:
+        from bigdl_tpu.serving.router import HEALTHY
+        return [r for r in self.router.replicas if r.state == HEALTHY]
+
+    def _post_completion(self, port: int, prompt: Tuple[int, ...],
+                         headers: Optional[Dict[str, str]] = None
+                         ) -> Optional[dict]:
+        body = json.dumps({
+            "model": "canary", "prompt": list(prompt),
+            "max_tokens": self.max_tokens, "temperature": 0.0,
+        }).encode()
+        h = {"Content-Type": "application/json"}
+        if headers:
+            h.update(headers)
+        conn = http.client.HTTPConnection(self.router.host, port,
+                                          timeout=self.timeout_sec)
+        try:
+            conn.request("POST", "/v1/completions", body=body,
+                         headers=h)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                return None
+            return json.loads(data)
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _canonical(doc: dict) -> Optional[str]:
+        """The byte-comparable part of a completion response: the
+        choice texts/token payloads and finish reasons, stripped of
+        ids/timestamps that legitimately differ per request."""
+        try:
+            choices = doc["choices"]
+            return json.dumps(
+                [{"text": c.get("text"),
+                  "finish_reason": c.get("finish_reason")}
+                 for c in choices],
+                sort_keys=True, separators=(",", ":"))
+        except (KeyError, TypeError):
+            return None
+
+    def _probe_specs(self, r: Any) -> List[Tuple[int, str,
+                                                 Optional[Dict[str, str]]]]:
+        """(prompt_idx, kind, extra_headers) probes for one replica."""
+        specs: List[Tuple[int, str, Optional[Dict[str, str]]]] = [
+            (0, "plain", None)]
+        if len(self.prompts) > 2:
+            # prompts 1+2 share a prefix: the radix/paged-KV path
+            specs.append((1, "prefix", None))
+            specs.append((2, "prefix", None))
+        if r.role == "prefill":
+            # the KV-handoff path: same header the router's client
+            # forwards carry, decode candidates chosen the same way
+            targets = self.router._handoff_targets(r)
+            if targets:
+                specs.append((0, "handoff",
+                              {"X-Handoff-Targets": ",".join(targets)}))
+        return specs
+
+    def sweep(self) -> dict:
+        """One probe sweep over every HEALTHY replica. Returns a
+        summary dict (probes run, mismatches found)."""
+        ran, mismatches = 0, 0
+        for r in self._healthy():
+            for prompt_idx, kind, headers in self._probe_specs(r):
+                if self._stop.is_set():
+                    break
+                # re-check per probe: an earlier mismatch in this very
+                # sweep may have quarantined the replica
+                if not self._still_healthy(r):
+                    break
+                doc = self._post_completion(
+                    r.port, self.prompts[prompt_idx], headers)
+                self.probes_total += 1
+                ran += 1
+                counted = getattr(self.router, "canary_probe", None)
+                if counted is not None:
+                    counted()
+                if doc is None:
+                    # transport/5xx: the health prober owns liveness;
+                    # the canary only judges byte correctness
+                    continue
+                got = self._canonical(doc)
+                if got is None:
+                    continue
+                key = (prompt_idx, kind)
+                golden = self.goldens.get(key)
+                if golden is None:
+                    # first successful probe defines the golden —
+                    # recorded while the fleet is healthy, so a
+                    # later-onset drift (after_step-armed fault, decayed
+                    # weights) diverges from it
+                    self.goldens[key] = got
+                elif got != golden:
+                    mismatches += 1
+                    self.failures_total += 1
+                    self.router.canary_mismatch(
+                        r, kind=kind, prompt_idx=prompt_idx,
+                        expected=golden, got=got)
+        self.last_sweep = time.time()
+        return {"probes": ran, "mismatches": mismatches}
+
+    def _still_healthy(self, r: Any) -> bool:
+        from bigdl_tpu.serving.router import HEALTHY
+        return r.state == HEALTHY
+
+    def snapshot(self) -> dict:
+        return {
+            "enabled": self.interval_sec > 0,
+            "interval_sec": self.interval_sec,
+            "prompts": len(self.prompts),
+            "goldens_recorded": len(self.goldens),
+            "probes_total": self.probes_total,
+            "failures_total": self.failures_total,
+            "last_sweep": self.last_sweep,
+            "last_error": self.last_error,
+        }
+
+
+__all__ = [
+    "CANARY_SEC_ENV",
+    "DEFAULT_PROMPTS",
+    "CanaryProber",
+    "resolve_canary_sec",
+]

@@ -58,7 +58,10 @@ from bigdl_tpu.observability.flight import write_postmortem as \
     _write_postmortem_file
 from bigdl_tpu.observability.memory import MemoryLedger, tree_nbytes
 from bigdl_tpu.observability.metrics import RATIO_BUCKETS, default_registry
+from bigdl_tpu.observability.slo import SLOTracker
+from bigdl_tpu.observability.stats import ewma as stats_ewma
 from bigdl_tpu.observability.tracing import RequestTracer
+from bigdl_tpu.observability.usage import UsageLedger
 from bigdl_tpu.ops.kvcache import (KVCache, init_cache, kv_cache_bytes,
                                    kv_cache_nbytes,
                                    publish_kv_cache_bytes,
@@ -985,6 +988,18 @@ class LLMEngine:
             "bigdl_tpu_tenant_requests_total",
             "Per-tenant admission outcomes.",
             labelnames=("tenant", "outcome"))
+        # -- service-level objectives + usage metering
+        # (observability/slo.py, usage.py): the SLO tracker gets TTFT /
+        # TPOT / result feeds from the hooks below and evaluates
+        # burn-rate alerts on a throttle inside step(); the usage
+        # ledger writes one JSONL record per finished/shed request off
+        # this thread and backs GET /v1/usage
+        self.slo = SLOTracker(registry=m, flight=self.flight)
+        self.usage = UsageLedger()
+        # request id -> (tenant, qos), set at admission (fanout
+        # children individually), popped at finish — the attribution
+        # map for both the SLO feeds and the usage ledger
+        self._usage_meta: Dict[str, Tuple[str, str]] = {}
         # batched-cache storage footprint per component (codes vs scales);
         # shapes are static for the engine lifetime, so set once
         self._weight_bytes = tree_nbytes(self.params)
@@ -1153,6 +1168,7 @@ class LLMEngine:
                     params, n=1, best_of=None,
                     seed=None if params.seed is None else params.seed + i)
                 self._children[cid] = (request_id, i)
+                self._usage_meta[cid] = (params.tenant, qos)
                 creq = Request(cid, list(ids), cparams)
                 creq.trace = trace
                 if deadline_ms is not None:
@@ -1162,6 +1178,7 @@ class LLMEngine:
                                   trace=self._child_trace(trace))
                 target.append(creq)
             return
+        self._usage_meta[request_id] = (params.tenant, qos)
         req = Request(request_id, ids, params)
         req.trace = trace
         if deadline_ms is not None:
@@ -1280,7 +1297,14 @@ class LLMEngine:
                 now=time.monotonic())
         except RequestShed as e:
             self._m_shed.labels(e.reason, e.qos).inc()
-            self._m_tenant_reqs.labels(e.tenant, "shed").inc()
+            # tenant ids are admission-controlled (PR-7 quota map),
+            # not caller-invented — audited
+            self._m_tenant_reqs.labels(e.tenant, "shed").inc()  # graftlint: disable=metric-label-cardinality
+            # a shed spends the availability budget and is a ledger
+            # line the tenant can reconcile against their 429s
+            self.slo.observe_result(e.qos, "shed")
+            self.usage.record_shed(request_id, e.tenant, e.qos,
+                                   e.reason)
             self.flight.record(
                 "shed", step=self._step_idx, request_id=request_id,
                 reason=e.reason, qos=e.qos, tenant=e.tenant,
@@ -1293,7 +1317,9 @@ class LLMEngine:
                                     reason=e.reason, qos=e.qos,
                                     tenant=e.tenant)
             raise
-        self._m_tenant_reqs.labels(params.tenant, "admitted").inc()
+        # tenant ids are admission-controlled (PR-7 quota map) —
+        # audited
+        self._m_tenant_reqs.labels(params.tenant, "admitted").inc()  # graftlint: disable=metric-label-cardinality
 
     def _overload_pressure(self) -> float:
         """Measured pressure in [0, 1]: worst of queue-depth ratio,
@@ -2106,6 +2132,9 @@ class LLMEngine:
         self.tracer.first_token(rid)
         if just_first and span.ttft_s is not None:
             self._m_ttft.observe(span.ttft_s)
+            meta = self._usage_meta.get(rid)
+            if meta is not None:
+                self.slo.observe_ttft(meta[1], span.ttft_s)
         self._m_admissions.inc()
         self.flight.record("admit_complete", step=self._step_idx,
                            request_id=rid)
@@ -2136,6 +2165,21 @@ class LLMEngine:
                     preemptions=span.n_preemptions)
         self._m_finished.labels(reason).inc()
         self._finish_times.append(time.time())   # drain-rate window
+        meta = self._usage_meta.pop(rid, None)
+        if meta is not None:
+            tenant, qos = meta
+            self.slo.observe_finish(qos, reason)
+            self.usage.record_finish(
+                rid, tenant, qos,
+                prompt_tokens=span.prompt_len if span is not None else 0,
+                generated_tokens=n_generated,
+                finish_reason=reason,
+                queue_wait_s=(span.queue_wait_s
+                              if span is not None else None),
+                ttft_s=span.ttft_s if span is not None else None,
+                tpot_s=span.tpot_s if span is not None else None,
+                preemptions=(span.n_preemptions
+                             if span is not None else 0))
         self.flight.record("finish", step=self._step_idx, request_id=rid,
                            reason=reason, n_generated=n_generated)
 
@@ -2250,6 +2294,8 @@ class LLMEngine:
             "compile_table": compile_table(),
             "memory": self.memory_snapshot(),
             "overload": self._overload_snapshot(),
+            "slo": self.slo.snapshot(),
+            "usage": self.usage.snapshot(),
             "robustness": {
                 "step_heartbeat_age_sec": round(
                     self.step_heartbeat_age(), 3),
@@ -2899,6 +2945,9 @@ class LLMEngine:
         except Exception as e:
             return self._on_step_failure(e)
         self._consec_failures = 0
+        # burn-rate evaluation: throttled to the spec's eval_sec, runs
+        # on idle steps too so alerts recover without traffic
+        self.slo.maybe_evaluate()
         if self._pending_perf is not None:
             n_active, seq_len = self._pending_perf
             self._pending_perf = None
@@ -3077,6 +3126,14 @@ class LLMEngine:
             bad = self.faults.poison_rows(self._step_idx, active)
             if bad:
                 logits_dev = logits_dev.at[jnp.asarray(bad)].set(jnp.nan)
+            # logit_drift: a finite bias on ONE vocab column of the
+            # drifted rows — argmax changes (silent wrong tokens at
+            # full speed) while the isfinite health check below stays
+            # green; only a golden-canary replay can notice
+            drows, dbias = self.faults.drift_rows(self._step_idx, active)
+            if drows:
+                logits_dev = logits_dev.at[
+                    jnp.asarray(drows), 0].add(dbias)
 
         # per-slot logits health check: a NaN/Inf row fails ONE request
         # (quarantine, structured error) while the rest of the batch
@@ -3128,12 +3185,15 @@ class LLMEngine:
         # inside it, and its final step still belongs on the timeline
         # — so capture the parent span id now, not at record time
         traced: Dict[str, Tuple[str, Optional[str]]] = {}
+        step_qos: List[str] = []    # per-slot QoS for the SLO TPOT feed
         for i in active:
             s = self.slots[i]
             tok, lp = pick(i)
             s.last_token = tok
             s.generated.append(tok)
             r = s.req
+            if r is not None:
+                step_qos.append(r.params.qos or "standard")
             if r is not None and r.trace is not None:
                 sp = self.tracer.get(r.request_id)
                 traced.setdefault(
@@ -3146,15 +3206,17 @@ class LLMEngine:
         # step wall time IS each stream's time-per-output-token
         dt = time.perf_counter() - t_decode0
         self._m_tpot.observe(dt)
+        # every active stream advanced one token this step, so the
+        # step wall time is each stream's TPOT sample for its QoS class
+        for q in step_qos:
+            self.slo.observe_tpot(q, dt)
         # EWMA + observed floor feed the queue-wait admission test and
         # the brownout latency-inflation signal
-        self._tpot_ewma = (dt if self._tpot_ewma == 0.0
-                           else 0.8 * self._tpot_ewma + 0.2 * dt)
+        self._tpot_ewma = stats_ewma(self._tpot_ewma or None, dt)
         if self._tpot_floor is None or self._tpot_ewma < self._tpot_floor:
             self._tpot_floor = self._tpot_ewma
-        self._dispatch_ewma = (
-            dispatch_s if self._dispatch_ewma == 0.0
-            else 0.8 * self._dispatch_ewma + 0.2 * dispatch_s)
+        self._dispatch_ewma = stats_ewma(
+            self._dispatch_ewma or None, dispatch_s)
         # stage the roofline/sentinel sample for step() to finalize
         # with the FULL step wall time (fault sleeps happen before this
         # method's timing bracket)

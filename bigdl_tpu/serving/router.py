@@ -146,6 +146,13 @@ def resolve_router_hedge_ms(value: Optional[str] = None) -> float:
     return ms
 
 
+def resolve_router_canary_sec(value: Optional[str] = None) -> float:
+    """Canary-probe sweep interval (default 0 = canaries off);
+    delegates to serving/canary.resolve_canary_sec."""
+    from bigdl_tpu.serving.canary import resolve_canary_sec
+    return resolve_canary_sec(value)
+
+
 def resolve_router_crash_budget(value: Optional[str] = None) -> int:
     """Deaths inside the crash window before a replica is quarantined
     (default 3, must be >= 1)."""
@@ -168,6 +175,7 @@ class RouterConfig:
     health_sec: Optional[float] = None      # $BIGDL_TPU_ROUTER_HEALTH_SEC
     hedge_ms: Optional[float] = None        # $BIGDL_TPU_ROUTER_HEDGE_MS
     crash_budget: Optional[int] = None      # $BIGDL_TPU_ROUTER_CRASH_BUDGET
+    canary_sec: Optional[float] = None      # $BIGDL_TPU_CANARY_SEC
     health_timeout_sec: float = 2.0    # per-probe HTTP timeout
     unhealthy_after: int = 3           # probe failures before hang-kill
     crash_window_sec: float = 60.0     # deaths inside count to the budget
@@ -216,6 +224,11 @@ class RouterConfig:
                 out.crash_budget = resolve_router_crash_budget()
             except ValueError:
                 out.crash_budget = 3
+        if out.canary_sec is None:
+            try:
+                out.canary_sec = resolve_router_canary_sec()
+            except ValueError:
+                out.canary_sec = 0.0      # env_check reports it
         return out
 
 
@@ -316,6 +329,9 @@ class Replica:
         # compact live-perf block (roofline util, sentinel state)
         # probed from /v1/stats; feeds the router perf aggregate
         self.perf: Optional[dict] = None
+        # compact SLO block (active alerts, worst burn rate) probed
+        # from /v1/stats; feeds the router's fleet SLO aggregate
+        self.slo: Optional[dict] = None
         # circuit breaker
         self.breaker = "closed"          # closed | open | half_open
         self.breaker_failures = 0
@@ -345,6 +361,7 @@ class Replica:
             "headroom_frac": self.headroom_frac,
             "handoff": dict(self.handoff),
             "perf": dict(self.perf) if self.perf else None,
+            "slo": dict(self.slo) if self.slo else None,
         }
 
 
@@ -459,6 +476,20 @@ class Router:
         self._h_latency = self.registry.histogram(
             "bigdl_tpu_router_request_seconds",
             "end-to-end routed request latency")
+        self._c_canary_probes = self.registry.counter(
+            "bigdl_tpu_router_canary_probes_total",
+            "golden-canary correctness probes sent to replicas")
+        self._c_canary_fail = self.registry.counter(
+            "bigdl_tpu_router_canary_failures_total",
+            "canary byte mismatches (each quarantines its replica)",
+            ["replica"])
+
+        # golden-canary prober (serving/canary.py): periodic greedy
+        # probes through each healthy replica; byte mismatch vs the
+        # recorded golden quarantines the replica via canary_mismatch.
+        # Off unless canary_sec > 0 ($BIGDL_TPU_CANARY_SEC).
+        from bigdl_tpu.serving.canary import CanaryProber
+        self.canary = CanaryProber(self, self.cfg.canary_sec or 0.0)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -468,6 +499,7 @@ class Router:
         self._supervisor = threading.Thread(target=self._supervise,
                                             daemon=True)
         self._supervisor.start()
+        self.canary.start()
         if wait_healthy:
             deadline = time.monotonic() + self.cfg.spawn_timeout_sec
             while time.monotonic() < deadline:
@@ -481,6 +513,7 @@ class Router:
 
     def shutdown(self) -> None:
         self._stop = True
+        self.canary.stop()
         self._wake.set()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -530,7 +563,8 @@ class Router:
         if not initial:
             r.restarts += 1
             self._count("restarts")
-            self._c_restarts.labels(str(r.idx)).inc()
+            # replica idx is bounded by fleet size — audited
+            self._c_restarts.labels(str(r.idx)).inc()  # graftlint: disable=metric-label-cardinality
         self.flight.record("replica_spawn", replica=r.idx, port=r.port,
                            pid=r.pid, generation=r.generation)
 
@@ -539,7 +573,9 @@ class Router:
             self.flight.record("replica_state", replica=r.idx,
                                prev=r.state, state=state)
         r.state = state
-        self._g_state.labels(str(r.idx)).set(STATE_CODES[state])
+        # replica idx is bounded by fleet size — audited
+        self._g_state.labels(str(r.idx)).set(  # graftlint: disable=metric-label-cardinality
+            STATE_CODES[state])
 
     # -- supervision --------------------------------------------------------
 
@@ -630,6 +666,38 @@ class Router:
                 r, f"hung ({r.probe_failures} probe failures"
                    f"{', ' + detail if detail else ''})")
 
+    def canary_probe(self) -> None:
+        """One canary probe was sent (counter hook for CanaryProber)."""
+        self._count("canary_probes")
+        self._c_canary_probes.inc()
+
+    def canary_mismatch(self, r: Replica, kind: str, prompt_idx: int,
+                        expected: str, got: str) -> None:
+        """A golden-canary byte mismatch on replica ``r`` — a
+        CORRECTNESS failure: the replica answers fast and healthy but
+        wrong, so it is quarantined through the same supervisor path a
+        crash loop takes (no restarts — wrong weights respawn wrong)
+        and its process is terminated so in-flight requests fail over
+        to byte-correct neighbors instead of finishing wrong."""
+        self._count("canary_failures")
+        # replica idx is bounded by fleet size — audited
+        self._c_canary_fail.labels(str(r.idx)).inc()  # graftlint: disable=metric-label-cardinality
+        self.flight.record(
+            "canary_mismatch", replica=r.idx, kind=kind,
+            prompt_idx=prompt_idx, expected=expected[:200],
+            got=got[:200])
+        if r.state == QUARANTINED:
+            return                     # already isolated this sweep
+        self._count("quarantined")
+        self._set_state(r, QUARANTINED)
+        self.flight.record("replica_quarantined", replica=r.idx,
+                           reason="canary_mismatch", kind=kind)
+        try:
+            if r.proc is not None:
+                r.proc.terminate()
+        except Exception:
+            pass
+
     def _kill_hung(self, r: Replica, reason: str) -> None:
         """A live-but-unresponsive replica (replica_hang, wedged step
         loop) is killed so its sockets break and in-flight requests can
@@ -707,6 +775,16 @@ class Router:
             r.handoff_gen = r.generation
             perf = doc.get("perf")
             r.perf = perf if isinstance(perf, dict) else None
+            slo = doc.get("slo")
+            if isinstance(slo, dict):
+                # compact fleet view; the full per-replica document
+                # stays one proxy hop away at GET /v1/slo
+                r.slo = {
+                    "alerts_active": int(slo.get("alerts_active") or 0),
+                    "alerts_total": int(slo.get("alerts_total") or 0),
+                    "burn_rate_max": float(
+                        slo.get("burn_rate_max") or 0.0),
+                }
         except (OSError, ValueError):
             pass
 
@@ -721,7 +799,8 @@ class Router:
             r.breaker_open_until = (time.monotonic()
                                     + self.cfg.breaker_cooldown_sec)
             self._count("breaker_trips")
-            self._c_trips.labels(str(r.idx)).inc()
+            # replica idx is bounded by fleet size — audited
+            self._c_trips.labels(str(r.idx)).inc()  # graftlint: disable=metric-label-cardinality
             self.flight.record("breaker_open", replica=r.idx,
                                failures=r.breaker_failures)
 
@@ -1012,8 +1091,9 @@ class Router:
                                    replica=used.idx,
                                    tenant=entry.tenant or "default")
                 self._count("requests")
-                self._c_requests.labels(str(used.idx),
-                                        str(status)).inc()
+                # idx bounded by fleet size, status by HTTP codes
+                self._c_requests.labels(
+                    str(used.idx), str(status)).inc()  # graftlint: disable=metric-label-cardinality
                 return status, data
             if status == 503:
                 # the replica is shedding (drain race or overload):
@@ -1033,7 +1113,9 @@ class Router:
             else:
                 self._breaker_success(used)
             self._count("requests")
-            self._c_requests.labels(str(used.idx), str(status)).inc()
+            # idx bounded by fleet size, status by HTTP codes
+            self._c_requests.labels(
+                str(used.idx), str(status)).inc()  # graftlint: disable=metric-label-cardinality
             self._h_latency.observe(time.monotonic() - t0)
             return status, data
 
@@ -1426,6 +1508,31 @@ class Router:
                 sum(utils) / len(utils), 4)
         return out
 
+    def _slo_aggregate(self) -> dict:
+        """Fleet SLO view from the per-replica /v1/stats slo blocks:
+        total active alerts and the worst burn rate anywhere (one
+        replica burning its budget is the fleet's page), plus the
+        canary prober's correctness state."""
+        per: Dict[str, dict] = {}
+        alerts_active = alerts_total = 0
+        burn_max = 0.0
+        for r in self.replicas:
+            if not r.slo:
+                continue
+            per[str(r.idx)] = dict(r.slo)
+            alerts_active += int(r.slo.get("alerts_active") or 0)
+            alerts_total += int(r.slo.get("alerts_total") or 0)
+            bm = r.slo.get("burn_rate_max")
+            if isinstance(bm, (int, float)):
+                burn_max = max(burn_max, float(bm))
+        return {
+            "replicas": per,
+            "alerts_active": alerts_active,
+            "alerts_total": alerts_total,
+            "burn_rate_max": round(burn_max, 4),
+            "canary": self.canary.snapshot(),
+        }
+
     def stats_snapshot(self) -> dict:
         """JSON-ready router state for ``GET /v1/router/stats`` (and
         the bench JSON's ``router`` block)."""
@@ -1437,6 +1544,7 @@ class Router:
             "counters": self.counts_snapshot(),
             "rolling_restart_in_progress": self._rolling,
             "perf": self._perf_aggregate(),
+            "slo": self._slo_aggregate(),
             "roles": {ro: sum(1 for r in self.replicas
                               if r.role == ro and r.state == HEALTHY)
                       for ro in ROLES},
@@ -1447,6 +1555,7 @@ class Router:
                 "health_sec": self.cfg.health_sec,
                 "hedge_ms": self.cfg.hedge_ms,
                 "crash_budget": self.cfg.crash_budget,
+                "canary_sec": self.cfg.canary_sec,
                 "breaker_threshold": self.cfg.breaker_threshold,
                 "max_replays": self.cfg.max_replays,
                 "affinity_tokens": self.cfg.affinity_tokens,

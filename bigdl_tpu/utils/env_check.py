@@ -26,6 +26,7 @@ KNOWN_ENV = (
     "BIGDL_TPU_AUTOSCALE_MIN",
     "BIGDL_TPU_BROWNOUT_HIGH",
     "BIGDL_TPU_BROWNOUT_LOW",
+    "BIGDL_TPU_CANARY_SEC",
     "BIGDL_TPU_COMPILE_CACHE",
     "BIGDL_TPU_COMPILE_MEMORY",
     "BIGDL_TPU_DECODE_RESIDENT",
@@ -74,10 +75,13 @@ KNOWN_ENV = (
     "BIGDL_TPU_SENTINEL_RECOVER_STEPS",
     "BIGDL_TPU_SENTINEL_THRESHOLD",
     "BIGDL_TPU_SENTINEL_TRIP_STEPS",
+    "BIGDL_TPU_SLO_ALERT_LOG",
+    "BIGDL_TPU_SLO_SPEC",
     "BIGDL_TPU_TENANT_BURST",
     "BIGDL_TPU_TENANT_RPS",
     "BIGDL_TPU_TENANT_TPS",
     "BIGDL_TPU_TRACE_SAMPLE",
+    "BIGDL_TPU_USAGE_LOG",
 )
 
 
@@ -456,6 +460,43 @@ def collect() -> dict:
         except ValueError as e:
             info[key] = {"value": raw, "valid": False, "error": str(e)}
 
+    # fleet SLO engine / usage metering / canary probes: the tracker
+    # swallows a bad spec (falls back to defaults) and the prober
+    # treats a bad interval as off, so this is where a broken override
+    # actually gets reported
+    slo_spec = os.environ.get("BIGDL_TPU_SLO_SPEC")
+    if slo_spec:
+        from bigdl_tpu.observability.slo import resolve_slo_spec
+
+        try:
+            info["slo_spec"] = {"value": resolve_slo_spec(slo_spec),
+                                "valid": True}
+        except ValueError as e:
+            info["slo_spec"] = {"value": slo_spec, "valid": False,
+                                "error": str(e)}
+    slo_log = os.environ.get("BIGDL_TPU_SLO_ALERT_LOG")
+    if slo_log:
+        from bigdl_tpu.observability.slo import \
+            validate_slo_alert_log_path
+
+        info["slo_alert_log"] = validate_slo_alert_log_path(slo_log)
+    usage_log = os.environ.get("BIGDL_TPU_USAGE_LOG")
+    if usage_log:
+        from bigdl_tpu.observability.usage import \
+            validate_usage_log_path
+
+        info["usage_log"] = validate_usage_log_path(usage_log)
+    canary_sec = os.environ.get("BIGDL_TPU_CANARY_SEC")
+    if canary_sec:
+        from bigdl_tpu.serving.canary import resolve_canary_sec
+
+        try:
+            info["canary_sec"] = {
+                "value": resolve_canary_sec(canary_sec), "valid": True}
+        except ValueError as e:
+            info["canary_sec"] = {"value": canary_sec, "valid": False,
+                                  "error": str(e)}
+
     typos = find_env_typos()
     if typos:
         info["env_typos"] = typos
@@ -513,6 +554,10 @@ def main() -> int:
           and info.get("replica_role", {}).get("valid", True)
           and info.get("handoff_timeout_ms", {}).get("valid", True)
           and info.get("handoff_retries", {}).get("valid", True)
+          and info.get("slo_spec", {}).get("valid", True)
+          and info.get("canary_sec", {}).get("valid", True)
+          and info.get("slo_alert_log", {}).get("writable", True)
+          and info.get("usage_log", {}).get("writable", True)
           and not info.get("env_typos")
           and info.get("postmortem_dir", {}).get("writable", True))
     print("status :", "OK" if ok else "PROBLEMS FOUND")

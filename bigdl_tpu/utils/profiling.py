@@ -290,34 +290,29 @@ class StepTimer:
         return out
 
     def summary(self) -> Dict[str, Dict[str, float]]:
+        from bigdl_tpu.observability.stats import summarize
+
         out = {}
         for name, ts in self.times.items():
-            s = sorted(ts)
+            s = summarize(ts, scale=1e3)
             out[name] = {
-                "count": len(ts),
-                "mean_ms": sum(ts) / len(ts) * 1e3,
-                "min_ms": s[0] * 1e3,
-                "max_ms": s[-1] * 1e3,
-                "p50_ms": _percentile(s, 0.50) * 1e3,
-                "p90_ms": _percentile(s, 0.90) * 1e3,
-                "p99_ms": _percentile(s, 0.99) * 1e3,
-                "total_s": sum(ts),
+                "count": s["count"],
+                "mean_ms": s["mean"],
+                "min_ms": s["min"],
+                "max_ms": s["max"],
+                "p50_ms": s["p50"],
+                "p90_ms": s["p90"],
+                "p99_ms": s["p99"],
+                "total_s": s["total"],
             }
         return out
 
 
 def _percentile(sorted_samples, q: float) -> float:
-    """Linear-interpolation percentile over pre-sorted samples (numpy's
-    default method, without numpy). The old `s[len(s) // 2]` median
-    picked the UPPER of the two middle samples on even-length inputs,
-    biasing p50 high; interpolation returns their midpoint."""
-    s = sorted_samples
-    if not s:
-        return float("nan")
-    if len(s) == 1:
-        return s[0]
-    pos = q * (len(s) - 1)
-    lo = int(pos)
-    hi = min(lo + 1, len(s) - 1)
-    frac = pos - lo
-    return s[lo] * (1.0 - frac) + s[hi] * frac
+    """Linear-interpolation percentile over pre-sorted samples; the
+    shared implementation lives in observability/stats.py (single
+    source for StepTimer, the sentinel baseline, and bench lane
+    stats). Kept as a name here for existing callers."""
+    from bigdl_tpu.observability.stats import percentile
+
+    return percentile(sorted_samples, q)

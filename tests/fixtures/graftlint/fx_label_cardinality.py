@@ -1,0 +1,44 @@
+"""Seeded bug: unbounded metric label values. Every constructed or
+request-scoped ``.labels()`` argument mints one Prometheus series per
+distinct value — the registry-OOM shape ``metric-label-cardinality``
+must catch. The ``ok_*`` sites (literals, bounded-looking names, an
+audited inline disable) must stay silent."""
+
+
+class Meter:
+    def __init__(self, counter):
+        self.c = counter
+
+    def bad_fstring(self, r):
+        self.c.labels(f"replica-{r.idx}").inc()
+
+    def bad_format(self, r):
+        self.c.labels("replica-{}".format(r.idx)).inc()
+
+    def bad_percent(self, r):
+        self.c.labels("replica-%d" % r.idx).inc()
+
+    def bad_str(self, r):
+        self.c.labels(str(r.idx)).inc()
+
+    def bad_concat(self, prefix, name):
+        self.c.labels(prefix + name).inc()
+
+    def bad_tenant_attr(self, params):
+        self.c.labels(params.tenant).inc()
+
+    def bad_request_id_name(self, request_id):
+        self.c.labels(request_id).inc()
+
+    def bad_kwarg(self, user):
+        self.c.labels(who=user).inc()
+
+    def ok_literal(self):
+        self.c.labels("decode", "hit").inc()
+
+    def ok_bounded_name(self, reason, mode):
+        self.c.labels(reason, mode).inc()
+
+    def ok_audited(self, r):
+        # bounded by fleet size — audited
+        self.c.labels(str(r.idx)).inc()  # graftlint: disable=metric-label-cardinality

@@ -270,6 +270,40 @@ def test_clean_locks_zero_findings():
     assert result.findings == [], result.findings
 
 
+def test_detects_label_cardinality():
+    """Every constructed/request-scoped label shape in the fixture is
+    caught; literals, bounded names, and the audited inline disable
+    stay silent."""
+    result = _scan("fx_label_cardinality.py")
+    hits = [f for f in result.findings
+            if f.rule == "metric-label-cardinality"]
+    assert {f.obj.split(".")[-1] for f in hits} == {
+        "bad_fstring", "bad_format", "bad_percent", "bad_str",
+        "bad_concat", "bad_tenant_attr", "bad_request_id_name",
+        "bad_kwarg",
+    }, result.findings
+    assert len(hits) == 8, result.findings
+    # exclusions: nothing anchored to the ok_* sites
+    assert not any(f.obj.split(".")[-1].startswith("ok_")
+                   for f in result.findings)
+    # the audited disable is counted as suppressed, not live
+    assert any(f.rule == "metric-label-cardinality"
+               and f.obj.endswith("ok_audited")
+               for f in result.suppressed)
+
+
+def test_label_cardinality_repo_sites_are_audited():
+    """The repo's own identity-shaped label sites (tenant labels in
+    the engine, str(idx) labels in the router) carry audited inline
+    disables — the rule sees them, the gate stays clean."""
+    result = analyze(iter_package_files(PKG), repo_root=REPO,
+                     rules=["metric-label-cardinality"])
+    assert result.findings == [], [f.render() for f in result.findings]
+    supp_paths = {f.path for f in result.suppressed}
+    assert "bigdl_tpu/serving/engine.py" in supp_paths
+    assert "bigdl_tpu/serving/router.py" in supp_paths
+
+
 # ---------------------------------------------------------------------------
 # suppressions + fingerprints
 

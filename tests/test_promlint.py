@@ -1,0 +1,195 @@
+"""Tier-1 gate + unit coverage for tools/promlint.py.
+
+Two jobs, mirroring test_graftlint.py:
+
+1. **The gate** — a live render of the engine registry (every family
+   the serving stack registers, SLO/usage/canary included) and of a
+   router registry must produce ZERO violations: a metric that
+   promtool would reject never ships.
+2. **Detection coverage** — each convention the linter enforces is
+   exercised by a seeded-bad scrape and caught.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from promlint import lint_text, main  # noqa: E402
+
+
+GOOD = """\
+# HELP app_requests_total requests served
+# TYPE app_requests_total counter
+app_requests_total{code="200"} 7
+app_requests_total{code="503"} 1
+# HELP app_queue_depth requests waiting
+# TYPE app_queue_depth gauge
+app_queue_depth 3
+# HELP app_latency_seconds request latency
+# TYPE app_latency_seconds histogram
+app_latency_seconds_bucket{le="0.1"} 5
+app_latency_seconds_bucket{le="+Inf"} 8
+app_latency_seconds_sum 1.25
+app_latency_seconds_count 8
+"""
+
+
+def test_clean_scrape_passes():
+    assert lint_text(GOOD) == []
+
+
+# ---------------------------------------------------------------------------
+# detection coverage — one seeded violation each
+
+
+def _violations(text):
+    return "\n".join(lint_text(text))
+
+
+def test_counter_must_end_total():
+    v = _violations("# HELP app_hits hits\n# TYPE app_hits counter\n"
+                    "app_hits 1\n")
+    assert "must end in _total" in v
+
+
+def test_total_reserved_for_counters():
+    v = _violations("# HELP app_up_total up\n# TYPE app_up_total gauge\n"
+                    "app_up_total 1\n")
+    assert "reserved for counters" in v
+
+
+def test_reserved_expansion_suffixes():
+    v = _violations("# HELP app_x_bucket x\n# TYPE app_x_bucket gauge\n"
+                    "app_x_bucket 1\n")
+    assert "reserved for histogram/summary expansion" in v
+
+
+def test_missing_help():
+    v = _violations("# TYPE app_total counter\napp_total 1\n")
+    assert "missing HELP" in v
+
+
+def test_empty_help():
+    v = _violations("# HELP app_total \n# TYPE app_total counter\n"
+                    "app_total 1\n")
+    assert "empty HELP" in v
+
+
+def test_duplicate_type():
+    v = _violations("# HELP a_total a\n# TYPE a_total counter\n"
+                    "a_total 1\n# TYPE a_total counter\n")
+    assert "duplicate TYPE" in v
+
+
+def test_help_must_precede_type():
+    v = _violations("# TYPE a_total counter\n# HELP a_total a\n"
+                    "a_total 1\n")
+    assert "must precede its TYPE" in v
+
+
+def test_unknown_kind():
+    v = _violations("# HELP a a\n# TYPE a widget\na 1\n")
+    assert "unknown metric type" in v
+
+
+def test_series_without_type():
+    v = _violations("orphan_series 1\n")
+    assert "no preceding TYPE" in v
+
+
+def test_family_blocks_contiguous():
+    v = _violations(
+        "# HELP a_total a\n# TYPE a_total counter\na_total 1\n"
+        "# HELP b b\n# TYPE b gauge\nb 2\n"
+        "a_total{x=\"y\"} 3\n")
+    assert "outside its contiguous family block" in v
+
+
+def test_reserved_label_prefix():
+    v = _violations("# HELP a a\n# TYPE a gauge\n"
+                    "a{__name__=\"x\"} 1\n")
+    assert "reserved __ prefix" in v
+
+
+def test_le_reserved_for_buckets():
+    v = _violations("# HELP a a\n# TYPE a gauge\na{le=\"0.5\"} 1\n")
+    assert "'le'" in v and "reserved" in v
+
+
+def test_duplicate_series():
+    v = _violations("# HELP a a\n# TYPE a gauge\n"
+                    "a{k=\"v\"} 1\na{k=\"v\"} 2\n")
+    assert "duplicate series" in v
+
+
+def test_unparseable_value():
+    v = _violations("# HELP a a\n# TYPE a gauge\na pancake\n")
+    assert "unparseable sample value" in v
+
+
+def test_inf_nan_values_ok():
+    assert lint_text("# HELP a a\n# TYPE a gauge\n"
+                     "a{k=\"v\"} +Inf\na{k=\"w\"} NaN\n") == []
+
+
+def test_escaped_label_values_ok():
+    assert lint_text('# HELP a a\n# TYPE a gauge\n'
+                     'a{msg="hi \\"there\\"\\n"} 1\n') == []
+
+
+# ---------------------------------------------------------------------------
+# the gate: live registries must lint clean
+
+
+def test_live_engine_registry_lints_clean():
+    from bigdl_tpu.serving.engine import EngineConfig, LLMEngine
+    from bigdl_tpu.utils.testing import tiny_random_model
+
+    eng = LLMEngine(tiny_random_model(seed=0),
+                    EngineConfig(max_batch=2, max_seq=64))
+    text = eng.registry.render()
+    assert "# TYPE" in text
+    assert "bigdl_tpu_slo_burn_rate" in text
+    assert lint_text(text) == [], "\n".join(lint_text(text))
+
+
+def test_router_registry_lints_clean():
+    from bigdl_tpu.observability.metrics import MetricsRegistry
+    from bigdl_tpu.serving.router import Router, RouterConfig
+
+    reg = MetricsRegistry()
+    r = Router(spawn=lambda idx, port: None,
+               config=RouterConfig(replicas=0), registry=reg)
+    # touch the labeled families so children render
+    r._c_requests.labels("0", "200").inc()
+    r._c_canary_fail.labels("0").inc()
+    text = reg.render()
+    assert "bigdl_tpu_router_canary_probes_total" in text
+    assert lint_text(text) == [], "\n".join(lint_text(text))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_exit_codes(tmp_path):
+    good = tmp_path / "good.txt"
+    good.write_text(GOOD)
+    assert main([str(good)]) == 0
+    bad = tmp_path / "bad.txt"
+    bad.write_text("# TYPE app_hits counter\napp_hits 1\n")
+    assert main([str(bad)]) == 1
+
+
+def test_cli_stdin():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "promlint.py"), "-"],
+        input=GOOD, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr

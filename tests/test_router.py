@@ -766,3 +766,73 @@ def test_e2e_fleet_profiler_capture(cluster, tmp_path):
     assert 0 <= perf["decode_util_min"] <= perf["decode_util_mean"]
     for rep in perf["replicas"].values():
         assert rep["decode_ideal_ms"] is not None
+
+
+def test_e2e_canary_quarantines_drifting_replica():
+    """The ISSUE-18 acceptance chaos run: replica 1 carries a sticky
+    ``logit_drift`` fault — finite additive logit bias, so it stays
+    fast, healthy and isfinite, and ONLY a golden byte comparison can
+    tell it is answering wrong. The canary prober must quarantine
+    exactly that replica, the healthy neighbor must keep reproducing
+    its answers byte-identically, and no request may be lost across
+    the quarantine transition."""
+    _FAULT_SPECS.clear()
+    _FAULT_SPECS[1] = "logit_drift@after_step=1,bias=8"
+    router = Router(spawn=_spawn_replica, config=RouterConfig(
+        replicas=2, health_sec=0.2, backoff_base_sec=0.2,
+        crash_budget=20, crash_window_sec=5.0, unhealthy_after=4,
+        spawn_timeout_sec=240.0, drain_exit_timeout_sec=90.0,
+        canary_sec=0.3))
+    router.start(wait_healthy=True)
+    httpd = router.serve(port=0, background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        _wait_all_healthy(router)
+        # client burst racing the canary sweep: a request in flight on
+        # the drifting replica when it is terminated must fail over
+        prompts = [[i + 1, i + 4, 2, 3] for i in range(8)]
+        results = _completion_burst(base, prompts)
+        assert [s for s, _ in results] == [200] * 8
+
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            if router.replicas[1].state == QUARANTINED:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("canary never quarantined the drifting "
+                        f"replica: {router.canary.snapshot()} "
+                        f"{router.stats_snapshot()['counters']}")
+        # exactly the drifting replica is isolated; goldens came from
+        # the byte-correct neighbor, which stays in rotation
+        assert router.replicas[0].state == HEALTHY
+        assert router.counts["canary_failures"] >= 1
+        assert router.counts["quarantined"] >= 1
+        events = router.flight.snapshot()
+        mism = [e for e in events if e["event"] == "canary_mismatch"]
+        assert mism and all(e["replica"] == 1 for e in mism)
+        assert all(e["expected"] != e["got"] for e in mism)
+        quar = [e for e in events
+                if e["event"] == "replica_quarantined"]
+        assert [e["replica"] for e in quar] == [1]
+        assert quar[0]["reason"] == "canary_mismatch"
+
+        # the healthy tier keeps serving: zero lost requests, and the
+        # greedy answers are byte-stable run over run
+        first = _completion_burst(base, prompts)
+        assert [s for s, _ in first] == [200] * 8
+        again = _completion_burst(base, prompts)
+        assert [s for s, _ in again] == [200] * 8
+        assert ([d["choices"][0]["text"] for _, d in first]
+                == [d["choices"][0]["text"] for _, d in again])
+        # quarantine is terminal — no respawn feeds wrong weights back
+        assert router.replicas[1].state == QUARANTINED
+        # fleet stats surface the canary verdict
+        snap = router.stats_snapshot()
+        assert snap["slo"]["canary"]["failures_total"] >= 1
+        assert snap["slo"]["canary"]["goldens_recorded"] >= 1
+        assert snap["counters"]["canary_failures"] >= 1
+    finally:
+        _FAULT_SPECS.clear()
+        httpd.shutdown()
+        router.shutdown()

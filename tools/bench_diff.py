@@ -56,6 +56,20 @@ METRIC_DIRECTIONS = {
     # pool must not grow at the same offered load
     "prefix_hit_tokens_frac": "higher",
     "page_pool_exhausted": "lower",
+    # SLO lane (bench_serving overload block, <=1x lanes): burn rate
+    # and active alerts must stay zero below capacity (slo_alerts is
+    # additionally zero-gated), and the fraction of TTFT/TPOT
+    # observations inside their QoS targets must not erode. The 3x
+    # lane's slo_burn_rate_overload is deliberately NOT here — alerts
+    # firing under deliberate overload is the feature working
+    "slo_burn_rate_max": "lower",
+    "slo_alerts": "lower",
+    "slo_compliance_ttft": "higher",
+    "slo_compliance_tpot": "higher",
+    # golden-canary byte mismatches (router lane): also zero-gated — a
+    # single mismatch between byte-identical seeded replicas means a
+    # replica decoded garbage
+    "canary_failures": "lower",
     "decode_mfu": "higher",
     "prefill_mfu": "higher",
     "decode_hbm_roofline_util": "higher",
@@ -100,12 +114,17 @@ ROBUSTNESS_COUNTERS = (
     # additionally zero-gated below: a gated lane must never ship a
     # run whose own sentinel fired
     "bigdl_tpu_perf_regression_total",
+    # golden-canary byte mismatches (serving/canary.py) — also
+    # zero-gated: byte-identical seeded replicas must agree
+    "bigdl_tpu_router_canary_failures_total",
 )
 
 # counters that must be exactly 0 in the candidate run, baseline or
 # not: a sentinel trip means the run itself detected a decode
-# regression while it was happening
-ZERO_COUNTERS = ("bigdl_tpu_perf_regression_total",)
+# regression while it was happening; an SLO alert or a canary byte
+# mismatch in a gated lane means the run violated its own objectives
+ZERO_COUNTERS = ("bigdl_tpu_perf_regression_total", "slo_alerts",
+                 "canary_failures")
 
 # the router's flat counters block (bench_serving --replicas embeds
 # GET /v1/router/stats as `router_bench.router`): every one of these
@@ -127,6 +146,8 @@ ROUTER_COUNTERS = {
     "handoff_retries": "lower",
     "handoff_fallbacks": "lower",
     "autoscale_refused": "lower",
+    # golden-canary byte mismatches: zero-gated via ZERO_COUNTERS too
+    "canary_failures": "lower",
 }
 
 # host dispatch overhead of the decode step (bench_serving

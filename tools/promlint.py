@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""promlint — lint a Prometheus text-exposition scrape.
+
+The in-tree registry (bigdl_tpu.observability.metrics) renders format
+0.0.4; this tool holds every scrape — live ``/metrics`` output or a
+saved file — to the conventions Prometheus itself and promtool
+enforce, so a metric that would be rejected or silently mangled
+downstream fails tier-1 here first:
+
+- metric names match ``[a-zA-Z_:][a-zA-Z0-9_:]*`` and label names
+  ``[a-zA-Z_][a-zA-Z0-9_]*`` (no ``__`` reserved prefix),
+- every family has exactly one ``# TYPE`` and exactly one non-empty
+  ``# HELP`` (HELP first), with a known kind,
+- counters end in ``_total``; non-counters must NOT, and the
+  ``_bucket``/``_sum``/``_count`` suffixes are reserved for histogram
+  /summary expansion,
+- the ``le`` label is reserved for histogram buckets (``quantile``
+  for summaries),
+- every series belongs to a declared family, family blocks are
+  contiguous, and no (name, labelset) repeats,
+- sample values parse as floats (``+Inf``/``-Inf``/``NaN`` allowed).
+
+Usage::
+
+    python tools/promlint.py metrics.txt
+    curl -s localhost:8000/metrics | python tools/promlint.py -
+
+Exit status 1 if any violation is found. Importable: ``lint_text()``
+returns the violation list (the tier-1 test runs it over a live
+engine registry render).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+KNOWN_KINDS = ("counter", "gauge", "histogram", "summary", "untyped")
+
+#: suffixes minted by histogram/summary expansion — plain families may
+#: not claim them (Prometheus would alias the series)
+RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+_SERIES_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>\S+)(?:\s+(?P<ts>-?\d+))?$")
+
+_LABEL_PAIR_RE = re.compile(
+    r'\s*(?P<name>[a-zA-Z_][a-zA-Z0-9_]*|[^=,{}]+)\s*=\s*'
+    r'"(?P<value>(?:[^"\\]|\\.)*)"\s*(?:,|$)')
+
+
+def _base_family(name: str, types: Dict[str, str]) -> Optional[str]:
+    """The declared family a series line belongs to: exact match, or
+    the histogram/summary base when the name carries an expansion
+    suffix."""
+    if name in types:
+        return name
+    for suf in RESERVED_SUFFIXES:
+        if name.endswith(suf):
+            base = name[: -len(suf)]
+            if types.get(base) in ("histogram", "summary"):
+                return base
+    return None
+
+
+def _parse_float(raw: str) -> bool:
+    try:
+        float(raw)
+        return True
+    except ValueError:
+        return False
+
+
+def lint_text(text: str) -> List[str]:
+    """All violations in one scrape, as ``line N: message`` strings."""
+    out: List[str] = []
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    type_lines: Dict[str, int] = {}
+    help_lines: Dict[str, int] = {}
+    series_seen: Set[Tuple[str, Tuple[Tuple[str, str], ...]]] = set()
+    families_with_series: Set[str] = set()
+    closed_families: Set[str] = set()
+    current_family: Optional[str] = None
+
+    def err(lineno: int, msg: str) -> None:
+        out.append(f"line {lineno}: {msg}")
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP "):].split(None, 1)
+            name = parts[0] if parts else ""
+            body = parts[1] if len(parts) > 1 else ""
+            if not METRIC_NAME_RE.match(name):
+                err(lineno, f"HELP for invalid metric name {name!r}")
+                continue
+            if name in help_lines:
+                err(lineno, f"duplicate HELP for {name} (first at line "
+                            f"{help_lines[name]})")
+            else:
+                help_lines[name] = lineno
+                helps[name] = body
+            if not body.strip():
+                err(lineno, f"empty HELP text for {name}")
+            if name in type_lines:
+                err(lineno, f"HELP for {name} must precede its TYPE "
+                            f"(TYPE at line {type_lines[name]})")
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split()
+            if len(parts) != 2:
+                err(lineno, f"malformed TYPE line: {line!r}")
+                continue
+            name, kind = parts
+            if not METRIC_NAME_RE.match(name):
+                err(lineno, f"TYPE for invalid metric name {name!r}")
+                continue
+            if kind not in KNOWN_KINDS:
+                err(lineno, f"unknown metric type {kind!r} for {name}")
+            if name in type_lines:
+                err(lineno, f"duplicate TYPE for {name} (first at line "
+                            f"{type_lines[name]})")
+                continue
+            type_lines[name] = lineno
+            types[name] = kind
+            if kind == "counter" and not name.endswith("_total"):
+                err(lineno, f"counter {name} must end in _total")
+            if kind != "counter" and name.endswith("_total"):
+                err(lineno, f"{kind} {name} ends in _total (reserved "
+                            "for counters)")
+            if kind not in ("histogram", "summary"):
+                for suf in RESERVED_SUFFIXES:
+                    if name.endswith(suf):
+                        err(lineno, f"{kind} {name} ends in {suf} "
+                                    "(reserved for histogram/summary "
+                                    "expansion)")
+            if current_family is not None:
+                closed_families.add(current_family)
+            current_family = name
+            continue
+        if line.startswith("#"):
+            continue    # free-form comment
+        m = _SERIES_RE.match(line)
+        if m is None:
+            err(lineno, f"unparseable series line: {line!r}")
+            continue
+        name = m.group("name")
+        fam = _base_family(name, types)
+        if fam is None:
+            err(lineno, f"series {name} has no preceding TYPE")
+        else:
+            families_with_series.add(fam)
+            if fam in closed_families:
+                err(lineno, f"series {name} outside its contiguous "
+                            f"family block (TYPE at line "
+                            f"{type_lines[fam]})")
+            kind = types[fam]
+            is_bucket = kind in ("histogram", "summary") \
+                and name.endswith("_bucket")
+        labels: List[Tuple[str, str]] = []
+        raw_labels = m.group("labels")
+        if raw_labels:
+            consumed = 0
+            for pm in _LABEL_PAIR_RE.finditer(raw_labels):
+                consumed = pm.end()
+                ln = pm.group("name")
+                if not LABEL_NAME_RE.match(ln):
+                    err(lineno, f"invalid label name {ln!r} on {name}")
+                elif ln.startswith("__"):
+                    err(lineno, f"label {ln!r} on {name} uses the "
+                                "reserved __ prefix")
+                elif fam is not None:
+                    if ln == "le" and not is_bucket:
+                        err(lineno, f"label 'le' on {name} is reserved "
+                                    "for histogram buckets")
+                    if ln == "quantile" and types[fam] != "summary":
+                        err(lineno, f"label 'quantile' on {name} is "
+                                    "reserved for summaries")
+                labels.append((ln, pm.group("value")))
+            if consumed != len(raw_labels):
+                err(lineno, f"unparseable label block on {name}: "
+                            f"{raw_labels[consumed:]!r}")
+        key = (name, tuple(sorted(labels)))
+        if key in series_seen:
+            err(lineno, f"duplicate series {name}{dict(labels)}")
+        series_seen.add(key)
+        if not _parse_float(m.group("value")):
+            err(lineno, f"unparseable sample value "
+                        f"{m.group('value')!r} on {name}")
+
+    for name in sorted(types):
+        if name not in helps:
+            out.append(f"family {name}: missing HELP")
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    if argv[0] == "-":
+        text = sys.stdin.read()
+    else:
+        with open(argv[0], encoding="utf-8") as f:
+            text = f.read()
+    violations = lint_text(text)
+    for v in violations:
+        print(v)
+    n_fams = len(re.findall(r"(?m)^# TYPE ", text))
+    print(f"promlint: {len(violations)} violation(s), "
+          f"{n_fams} famil{'y' if n_fams == 1 else 'ies'} checked",
+          file=sys.stderr)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
