@@ -330,7 +330,36 @@ def bench_config(qtype: str = "sym_int4", kv_quantized: bool = False,
     ledger.register("kv_cache", "bench_cache", kv_bytes["total"],
                     dtype=kv_dtype)
 
+    # quality block (quantization-error observability): the per-format
+    # golden NLL budget from ACCURACY.md (shrink-only ratcheted by
+    # tools/bench_diff.py as nll_delta_vs_bf16) plus one measured
+    # weight-error sample — a fixed-seed matrix quantized at the bench
+    # qtype and scored by the same weight_error_stats the load-time
+    # attribution uses, so a kernel-level encode regression moves a
+    # bench number even without a checkpoint to convert
+    from bigdl_tpu.observability.quality import (golden_nll_allowance,
+                                                 weight_error_stats)
+    from bigdl_tpu.ops.quant import (FLOAT_QTYPES, dequantize_linear,
+                                     quantize_linear)
+
+    q_sample = None
+    if qtype not in FLOAT_QTYPES:
+        try:
+            w_ref = np.random.default_rng(0).standard_normal(
+                (256, 256)).astype(np.float32)
+            qt = quantize_linear(jnp.asarray(w_ref), qtype)
+            q_sample = weight_error_stats(
+                w_ref, np.asarray(dequantize_linear(qt, jnp.float32)))
+        except Exception:
+            q_sample = None     # telemetry, never fails the bench
+    quality_block = {
+        "qtype": qtype,
+        "nll_delta_vs_bf16": round(golden_nll_allowance(qtype), 6),
+        "weight_error_sample": q_sample,
+    }
+
     return {
+        "quality": quality_block,
         "observability": obs_summary,
         # static ledger totals + live device stats (TPU runs) + peak
         # jit scratch — tools/bench_diff.py compares the headline

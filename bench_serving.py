@@ -669,6 +669,19 @@ def main() -> None:
     cp["dispatch_overhead_ms"] = (
         wave_eng.stats_snapshot()["dispatch_overhead_ms"])
     out["critical_path"] = cp
+    # quality block (ISSUE 19): the engine's compact live-quality
+    # snapshot (token NLL / entropy / margin from the measured wave)
+    # plus the per-format golden NLL budget bench_diff ratchets as
+    # nll_delta_vs_bf16
+    from bigdl_tpu.observability.quality import golden_nll_allowance
+
+    eng_q = wave_eng.stats_snapshot().get("quality")
+    out["quality"] = {
+        "qtype": wave_eng.qtype,
+        "nll_delta_vs_bf16": round(
+            golden_nll_allowance(wave_eng.qtype), 6),
+        "live": eng_q,
+    }
     # open-loop overload lane: capacity probe then Poisson arrivals at
     # 0.5x/1x/3x — bench_diff gates its shed/brownout (<=1x must stay
     # zero) and 3x goodput rows

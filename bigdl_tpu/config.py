@@ -69,6 +69,11 @@ class RuntimeFlags:
     # watch the decode EWMAs against the rolling baseline, "off" skip
     # sentinel construction entirely (zero per-step overhead)
     sentinel: str = "auto"
+    # quality observability (observability/quality.py): "auto"/"on"
+    # record load-time quantization-error attribution and feed the
+    # decode-path quality telemetry + QualitySentinel; "off" skips the
+    # dequant round-trip at load and all per-step quality work
+    quality: str = "auto"
     # host-side C++ kernels (bigdl_tpu.native); disable to force pure JAX
     disable_native: bool = False
     native_cache_dir: Optional[str] = None
@@ -122,6 +127,8 @@ class RuntimeFlags:
                 lambda s: resolve_decode_resident(s)),
             sentinel=_tristate_env("BIGDL_TPU_SENTINEL",
                                    lambda s: resolve_sentinel(s)),
+            quality=_tristate_env("BIGDL_TPU_QUALITY",
+                                  lambda s: resolve_quality(s)),
             disable_native=_env_bool("BIGDL_TPU_DISABLE_NATIVE"),
             native_cache_dir=os.environ.get("BIGDL_TPU_NATIVE_CACHE"),
             kv_cache_dtype=os.environ.get(
@@ -241,6 +248,24 @@ def sentinel_enabled() -> bool:
     """Effective perf-sentinel switch: "off" disables, "on"/"auto"
     enable (the sentinel's own warmup/baseline logic handles the rest)."""
     return flags().sentinel != "off"
+
+
+def resolve_quality(spec) -> str:
+    """Normalize a BIGDL_TPU_QUALITY spec to "auto" | "on" | "off"."""
+    s = str(spec).strip().lower() if spec is not None else "auto"
+    s = {"1": "on", "true": "on", "0": "off", "false": "off",
+         "": "auto"}.get(s, s)
+    if s not in _TRISTATE:
+        raise ValueError(
+            f"unknown quality mode {spec!r}; choose from {_TRISTATE}")
+    return s
+
+
+def quality_enabled() -> bool:
+    """Effective quality-observability switch: "off" disables both the
+    load-time attribution and the decode-path telemetry/sentinel;
+    "on"/"auto" enable."""
+    return flags().quality != "off"
 
 
 def decode_resident_enabled() -> bool:

@@ -96,15 +96,44 @@ class Acc:
                 native = quantize_native(wt, qtype)
                 if native is not None:
                     data, scale = native
-                    return QTensor(jnp.asarray(data),
-                                   jnp.asarray(scale).astype(jnp.bfloat16),
-                                   None, qtype, wt.shape)
+                    qt = QTensor(jnp.asarray(data),
+                                 jnp.asarray(scale).astype(jnp.bfloat16),
+                                 None, qtype, wt.shape)
+                    self._attribute(name, w, qt)
+                    return qt
             out = self._quantize_linear(jnp.asarray(np.asarray(w)),
                                         qtype, qw=qw)
             if mixed_key is not None and mixed_key not in self._mixed_picks:
                 self._mixed_picks[mixed_key] = out.qtype
+            self._attribute(name, w, out)
             return out
         return jnp.asarray(np.asarray(w)).T.astype(self.compute_dtype)
+
+    def _attribute(self, name: str, w, qt) -> None:
+        """Quantization-error attribution (observability/quality.py):
+        when a collector is installed (model.from_pretrained under
+        config.quality_enabled()), record this tensor's SNR /
+        max-abs-err / clip-saturation vs the pre-quant floats via a
+        dequantize round-trip. No collector -> no round-trip, zero
+        load-time cost. Telemetry only: never load-bearing."""
+        from bigdl_tpu.observability.quality import current_attribution
+
+        report = current_attribution()
+        if report is None:
+            return
+        try:
+            from bigdl_tpu.observability.quality import weight_error_stats
+            from bigdl_tpu.ops.quant import dequantize_linear
+
+            # dequantize_linear returns HF layout [out, in] — the same
+            # orientation the pre-quant weight arrived in
+            deq = np.asarray(dequantize_linear(qt, jnp.float32))
+            ref = np.asarray(w, np.float32)
+            if deq.shape != ref.shape:
+                return
+            report.add(name, qt.qtype, weight_error_stats(ref, deq))
+        except Exception:
+            pass
 
     def dense(self, w) -> jax.Array:
         return jnp.asarray(np.asarray(w)).astype(self.compute_dtype)

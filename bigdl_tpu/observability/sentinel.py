@@ -25,7 +25,7 @@ import json
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from . import stats
 from .tracing import (
@@ -160,7 +160,17 @@ class PerfSentinel:
                  history_path: Optional[str] = None,
                  warmup_steps: int = 16,
                  on_trip: Optional[Callable[[dict], None]] = None,
-                 on_recover: Optional[Callable[[dict], None]] = None):
+                 on_recover: Optional[Callable[[dict], None]] = None,
+                 metrics: Optional[Tuple[str, ...]] = None,
+                 higher_is_bad: Optional[Dict[str, bool]] = None):
+        # the machinery is metric-agnostic: a subclass (the quality
+        # sentinel in observability/quality.py) supplies its own watched
+        # signals + directions and everything else — EWMAs, warmup
+        # baseline, JSONL history seeding, dwell hysteresis — is shared
+        self.metrics: Tuple[str, ...] = (tuple(metrics) if metrics
+                                         else METRICS)
+        self.higher_is_bad: Dict[str, bool] = (
+            dict(higher_is_bad) if higher_is_bad else dict(_HIGHER_IS_BAD))
         self.threshold = resolve_sentinel_threshold(threshold)
         self.trip_steps = resolve_sentinel_trip_steps(trip_steps)
         self.recover_steps = resolve_sentinel_recover_steps(recover_steps)
@@ -170,7 +180,8 @@ class PerfSentinel:
         self.on_trip = on_trip
         self.on_recover = on_recover
         self._lock = threading.Lock()
-        self._ewma: Dict[str, Optional[float]] = {m: None for m in METRICS}
+        self._ewma: Dict[str, Optional[float]] = {m: None
+                                                  for m in self.metrics}
         self._baseline: Dict[str, float] = {}
         self._steps = 0
         self._bad_streak = 0
@@ -212,7 +223,7 @@ class PerfSentinel:
             return {}
         records = records[-_HISTORY_TAIL:]
         base: Dict[str, float] = {}
-        for m in METRICS:
+        for m in self.metrics:
             vals = [float(r[m]) for r in records
                     if isinstance(r.get(m), (int, float))
                     and float(r[m]) > 0]
@@ -227,7 +238,7 @@ class PerfSentinel:
         if not path:
             return
         doc = {"ts": time.time()}
-        for m in METRICS:
+        for m in self.metrics:
             # called with _lock held (observe's locked section)
             if self._ewma[m] is not None:  # graftlint: disable=lock-guarded-unlocked
                 doc[m] = round(self._ewma[m], 6)  # graftlint: disable=lock-guarded-unlocked
@@ -254,14 +265,21 @@ class PerfSentinel:
                 dispatch_ms: Optional[float] = None) -> Optional[str]:
         """Fold one step's numbers in; returns ``"trip"`` /
         ``"recover"`` on a state transition, else None."""
-        sample = {"decode_ms": decode_ms, "roofline_util": roofline_util,
-                  "dispatch_ms": dispatch_ms}
+        return self.observe_sample(
+            {"decode_ms": decode_ms, "roofline_util": roofline_util,
+             "dispatch_ms": dispatch_ms})
+
+    def observe_sample(self, sample: Dict[str, Optional[float]]
+                       ) -> Optional[str]:
+        """Metric-agnostic observe: fold ``{metric: value-or-None}``
+        in; unknown keys are ignored, None values skip that metric this
+        step. Subclasses wrap this with their own named signature."""
         transition = None
         info = None
         with self._lock:
             self._steps += 1
             for m, v in sample.items():
-                if v is None:
+                if v is None or m not in self._ewma:
                     continue
                 self._ewma[m] = stats.ewma(self._ewma[m], v,
                                            decay=_EWMA_DECAY)
@@ -305,11 +323,11 @@ class PerfSentinel:
     def _bad_metrics(self) -> List[str]:
         # called with _lock held (observe's locked section)
         bad = []
-        for m in METRICS:
+        for m in self.metrics:
             cur, base = self._ewma[m], self._baseline.get(m)  # graftlint: disable=lock-guarded-unlocked
             if cur is None or base is None or base <= 0:
                 continue
-            if _HIGHER_IS_BAD[m]:
+            if self.higher_is_bad.get(m, True):
                 if cur > base * (1.0 + self.threshold):
                     bad.append(m)
             elif cur < base * (1.0 - self.threshold):

@@ -839,6 +839,13 @@ class OpenAIServer:
                     # this per replica
                     self._json(200, _jsonable(
                         server.engine.perf_snapshot()))
+                elif self.path == "/v1/quality":
+                    # quantization-error attribution + live decode
+                    # quality + golden-probe NLL + QualitySentinel
+                    # state (engine.quality_snapshot); the router's
+                    # /v1/router/stats aggregates the compact subset
+                    self._json(200, _jsonable(
+                        server.engine.quality_snapshot()))
                 elif self.path == "/v1/slo":
                     # per-replica SLO state: resolved spec, current
                     # burn rates per (qos, objective, window), active

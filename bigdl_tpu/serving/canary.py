@@ -34,8 +34,21 @@ Probe kinds (the paths that can each break independently):
   same way as a client forward), so prefill -> KV-ship -> remote
   decode must reproduce the same bytes.
 
-Knob: ``$BIGDL_TPU_CANARY_SEC`` — probe sweep interval in seconds,
-0 disables (default). Validated by utils/env_check.py.
+Byte equality has a blind spot: a drift too small (or too aligned)
+to flip any argmax serves byte-identical completions while the
+distribution underneath degrades. The **NLL-tolerance mode**
+(``$BIGDL_TPU_CANARY_NLL_TOL`` > 0) closes it: every probe also
+requests per-token logprobs, the first successful probe per
+(prompt, kind) records the golden mean NLL, and a later probe whose
+mean NLL drifts more than the tolerance (nats/token, either
+direction) quarantines the replica with ``kind="nll"`` — even when
+its bytes still match. Pick the tolerance from
+``observability.quality.golden_nll_allowance(qtype)`` plus margin.
+
+Knobs: ``$BIGDL_TPU_CANARY_SEC`` — probe sweep interval in seconds,
+0 disables (default); ``$BIGDL_TPU_CANARY_NLL_TOL`` — NLL drift
+tolerance in nats/token, 0 disables the NLL mode (default). Both
+validated by utils/env_check.py.
 """
 
 from __future__ import annotations
@@ -48,6 +61,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 CANARY_SEC_ENV = "BIGDL_TPU_CANARY_SEC"
+CANARY_NLL_TOL_ENV = "BIGDL_TPU_CANARY_NLL_TOL"
 
 #: pinned probe prompts: raw token-id lists (the API accepts them and
 #: answers with token ids — no tokenizer needed, and ids this small
@@ -80,6 +94,24 @@ def resolve_canary_sec(value: Optional[str] = None) -> float:
     return sec
 
 
+def resolve_canary_nll_tol(value: Optional[str] = None) -> float:
+    """NLL drift tolerance in nats/token for the canary's
+    NLL-tolerance mode: explicit value, else
+    ``$BIGDL_TPU_CANARY_NLL_TOL``, else 0.0 (byte-equality only).
+    Raises ``ValueError`` on a negative or non-numeric value
+    (env_check surfaces it)."""
+    raw = value if value is not None else os.environ.get(
+        CANARY_NLL_TOL_ENV, "")
+    if not raw:
+        return 0.0
+    tol = float(raw)                   # ValueError propagates
+    if tol < 0:
+        raise ValueError(
+            f"{CANARY_NLL_TOL_ENV} must be >= 0 (0 disables), "
+            f"got {raw!r}")
+    return tol
+
+
 class CanaryProber:
     """Periodic golden-probe sweeps over a Router's replicas.
 
@@ -92,16 +124,25 @@ class CanaryProber:
     def __init__(self, router: Any, interval_sec: float,
                  prompts: Optional[List[Tuple[int, ...]]] = None,
                  max_tokens: int = DEFAULT_MAX_TOKENS,
-                 timeout_sec: float = 30.0):
+                 timeout_sec: float = 30.0,
+                 nll_tol: Optional[float] = None):
         self.router = router
         self.interval_sec = interval_sec
         self.prompts = [tuple(p) for p in (prompts or DEFAULT_PROMPTS)]
         self.max_tokens = max_tokens
         self.timeout_sec = timeout_sec
+        try:
+            self.nll_tol = (nll_tol if nll_tol is not None
+                            else resolve_canary_nll_tol())
+        except ValueError:
+            self.nll_tol = 0.0         # env_check reports the bad knob
         # (prompt_idx, kind) -> golden choice payload (JSON-stable str)
         self.goldens: Dict[Tuple[int, str], str] = {}
+        # (prompt_idx, kind) -> golden mean NLL (nats/token)
+        self.goldens_nll: Dict[Tuple[int, str], float] = {}
         self.probes_total = 0
         self.failures_total = 0
+        self.nll_failures_total = 0
         self.last_sweep: Optional[float] = None
         self.last_error: Optional[str] = None
         self._stop = threading.Event()
@@ -138,10 +179,15 @@ class CanaryProber:
     def _post_completion(self, port: int, prompt: Tuple[int, ...],
                          headers: Optional[Dict[str, str]] = None
                          ) -> Optional[dict]:
-        body = json.dumps({
+        payload: Dict[str, Any] = {
             "model": "canary", "prompt": list(prompt),
             "max_tokens": self.max_tokens, "temperature": 0.0,
-        }).encode()
+        }
+        if self.nll_tol > 0:
+            # NLL mode rides the same probe: top-0 logprobs returns
+            # just the chosen-token logprob per position
+            payload["logprobs"] = 0
+        body = json.dumps(payload).encode()
         h = {"Content-Type": "application/json"}
         if headers:
             h.update(headers)
@@ -173,6 +219,19 @@ class CanaryProber:
                  for c in choices],
                 sort_keys=True, separators=(",", ":"))
         except (KeyError, TypeError):
+            return None
+
+    @staticmethod
+    def _mean_nll(doc: dict) -> Optional[float]:
+        """Mean NLL (nats/token) over the completion's chosen tokens,
+        or None when the response carries no usable logprobs."""
+        try:
+            lps = doc["choices"][0]["logprobs"]["token_logprobs"]
+            vals = [float(v) for v in lps if v is not None]
+            if not vals:
+                return None
+            return -sum(vals) / len(vals)
+        except (KeyError, IndexError, TypeError, ValueError):
             return None
 
     def _probe_specs(self, r: Any) -> List[Tuple[int, str,
@@ -233,6 +292,26 @@ class CanaryProber:
                     self.router.canary_mismatch(
                         r, kind=kind, prompt_idx=prompt_idx,
                         expected=golden, got=got)
+                    continue           # quarantined; skip the NLL test
+                if self.nll_tol > 0:
+                    # NLL-tolerance mode: catches drift that never
+                    # flips an argmax — the bytes above stay golden
+                    # while the distribution underneath degrades
+                    nll = self._mean_nll(doc)
+                    if nll is None:
+                        continue
+                    g_nll = self.goldens_nll.get(key)
+                    if g_nll is None:
+                        self.goldens_nll[key] = nll
+                    elif abs(nll - g_nll) > self.nll_tol:
+                        mismatches += 1
+                        self.failures_total += 1
+                        self.nll_failures_total += 1
+                        self.router.canary_mismatch(
+                            r, kind="nll", prompt_idx=prompt_idx,
+                            expected=f"nll={g_nll:.4f}"
+                                     f"±{self.nll_tol:.4f}",
+                            got=f"nll={nll:.4f} ({kind})")
         self.last_sweep = time.time()
         return {"probes": ran, "mismatches": mismatches}
 
@@ -248,14 +327,19 @@ class CanaryProber:
             "goldens_recorded": len(self.goldens),
             "probes_total": self.probes_total,
             "failures_total": self.failures_total,
+            "nll_tol": self.nll_tol,
+            "nll_goldens_recorded": len(self.goldens_nll),
+            "nll_failures_total": self.nll_failures_total,
             "last_sweep": self.last_sweep,
             "last_error": self.last_error,
         }
 
 
 __all__ = [
+    "CANARY_NLL_TOL_ENV",
     "CANARY_SEC_ENV",
     "DEFAULT_PROMPTS",
     "CanaryProber",
+    "resolve_canary_nll_tol",
     "resolve_canary_sec",
 ]

@@ -43,13 +43,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.config import (decode_resident_enabled, flags,
-                              resolve_kv_page_size, resolve_kv_pages,
-                              resolve_prefix_sharing, sentinel_enabled)
+                              quality_enabled, resolve_kv_page_size,
+                              resolve_kv_pages, resolve_prefix_sharing,
+                              sentinel_enabled)
 from bigdl_tpu.observability import roofline
 from bigdl_tpu.observability.compile_watch import (annotate_costs,
                                                    compiles_in_progress,
                                                    top_offenders,
                                                    tracked_jit)
+from bigdl_tpu.observability.quality import (GOLDEN_PROBE_PROMPTS,
+                                             QUALITY_METRICS,
+                                             QualitySentinel,
+                                             golden_nll_allowance,
+                                             resolve_quality_probe_steps)
 from bigdl_tpu.observability.sentinel import PerfSentinel
 from bigdl_tpu.observability.disttrace import SpanRecorder, new_span_id
 from bigdl_tpu.observability.flight import (FlightRecorder, build_postmortem,
@@ -292,6 +298,17 @@ class EngineConfig:
     # perf-history JSONL path the sentinel baselines against; None
     # defers to $BIGDL_TPU_PERF_HISTORY (unset = in-memory baseline)
     perf_history: Optional[str] = None
+    # live quality telemetry + QualitySentinel (observability/
+    # quality.py): None defers to config.quality_enabled()
+    # ($BIGDL_TPU_QUALITY tristate); True/False force it per engine
+    quality: Optional[bool] = None
+    # quality-history JSONL the QualitySentinel baselines against; None
+    # defers to $BIGDL_TPU_QUALITY_HISTORY (unset = in-memory baseline)
+    quality_history: Optional[str] = None
+    # teacher-forced NLL probe period in DECODE STEPS (not seconds, so
+    # tests are deterministic); None defers to
+    # $BIGDL_TPU_QUALITY_PROBE_STEPS (default 0 = probe off)
+    quality_probe_steps: Optional[int] = None
 
 
 class _Slot:
@@ -437,6 +454,11 @@ class LLMEngine:
         self.params = model.params
         self.cfg = model.config
         self.family = model.family
+        # quality-observability inputs: the serving qtype labels every
+        # bigdl_tpu_quality_* sample; the load-time attribution report
+        # (transformers/model.py) backs GET /v1/quality
+        self.qtype = getattr(model, "qtype", None) or "bf16"
+        self.quality_report = getattr(model, "quality_report", None)
         if getattr(self.family, "is_recurrent", False):
             raise ValueError(
                 f"continuous batching is KV-cache based; the "
@@ -684,9 +706,10 @@ class LLMEngine:
         # the host side of the dispatch).
         @functools.partial(tracked_jit, "engine_decode_resident",
                            registry=self.registry, donate_argnums=(2,),
-                           static_argnames=("all_greedy",))
+                           static_argnames=("all_greedy", "with_quality"))
         def decode_resident(params, tokens, cache, temps, top_ks,
-                            top_ps, seeds, poss, *, all_greedy):
+                            top_ps, seeds, poss, *, all_greedy,
+                            with_quality=False):
             logits, cache = fwd(params, self.cfg, tokens[:, None], cache)
             lg = logits[:, -1, :]
             finite = jnp.isfinite(lg).all(axis=-1)
@@ -695,7 +718,21 @@ class LLMEngine:
             else:
                 toks = _device_sample_rows(lg, temps, top_ks, top_ps,
                                            seeds, poss)
-            return toks, finite, cache
+            qrows = None
+            if with_quality:
+                # live decode-quality telemetry, fused into the SAME
+                # executable so the single-dispatch invariant survives:
+                # per-slot chosen-token logprob, full-softmax entropy,
+                # and top-1 margin, returned as one [B, 3] f32 block
+                # the host pulls alongside toks/finite
+                lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+                chosen = jnp.take_along_axis(
+                    lp, toks[:, None].astype(jnp.int32), axis=-1)[:, 0]
+                entropy = -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+                top2, _ = jax.lax.top_k(lg.astype(jnp.float32), 2)
+                margin = top2[:, 0] - top2[:, 1]
+                qrows = jnp.stack([chosen, entropy, margin], axis=-1)
+            return toks, finite, cache, qrows
 
         self._decode_resident = decode_resident
 
@@ -1074,6 +1111,76 @@ class LLMEngine:
                 history_path=ce.perf_history,
                 on_trip=self._on_perf_trip,
                 on_recover=self._on_perf_recover)
+
+        # -- live quality telemetry + QualitySentinel (observability/
+        # quality.py). All histogram samples carry (qtype,
+        # kv_cache_dtype, qos) so a fleet scrape can slice quality by
+        # quantization format. Families exist from scrape 1 for the
+        # standard QoS classes (render-before-traffic idiom above).
+        self._use_quality = (ce.quality if ce.quality is not None
+                             else quality_enabled())
+        _qlabels = ("qtype", "kv_cache_dtype", "qos")
+        self._m_q_logprob = m.histogram(
+            "bigdl_tpu_quality_token_logprob",
+            "Chosen-token logprob per decode step (resident path "
+            "computes it inside the fused dispatch).",
+            labelnames=_qlabels,
+            buckets=(-16.0, -8.0, -4.0, -2.0, -1.0, -0.5, -0.25,
+                     -0.1, -0.01, 0.0))
+        self._m_q_entropy = m.histogram(
+            "bigdl_tpu_quality_entropy",
+            "Full-softmax entropy (nats) of the decode distribution.",
+            labelnames=_qlabels,
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0))
+        self._m_q_margin = m.histogram(
+            "bigdl_tpu_quality_top1_margin",
+            "Top-1 minus top-2 logit margin of the decode "
+            "distribution.",
+            labelnames=_qlabels,
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0))
+        self._m_q_eos = m.counter(
+            "bigdl_tpu_quality_eos_total",
+            "EOS tokens emitted, by qtype/kv dtype/QoS.",
+            labelnames=_qlabels)
+        self._m_q_repeat = m.counter(
+            "bigdl_tpu_quality_repeat_total",
+            "Immediate token repetitions (tok == previous tok) "
+            "emitted, by qtype/kv dtype/QoS.",
+            labelnames=_qlabels)
+        for q in QOS_CLASSES:              # render from scrape 1
+            lbl = (self.qtype, self.kv_cache_dtype, q)
+            self._m_q_logprob.labels(*lbl)
+            self._m_q_entropy.labels(*lbl)
+            self._m_q_margin.labels(*lbl)
+            self._m_q_eos.labels(*lbl)
+            self._m_q_repeat.labels(*lbl)
+        self._m_q_probe_nll = m.gauge(
+            "bigdl_tpu_quality_probe_nll",
+            "Latest teacher-forced NLL over the golden probe prompts "
+            "(nats/token).")
+        self._m_q_regress = m.counter(
+            "bigdl_tpu_quality_regression_total",
+            "QualitySentinel trips by regressed metric "
+            "(tools/bench_diff.py gates this at 0).",
+            labelnames=("metric",))
+        for mt in QUALITY_METRICS:         # render from scrape 1
+            self._m_q_regress.labels(mt)
+        self._last_quality: Optional[dict] = None   # last observed step
+        self._last_probe: Optional[dict] = None     # last probe result
+        self._quality_probe_fn = None               # lazily compiled
+        try:
+            self._quality_probe_steps = (
+                ce.quality_probe_steps
+                if ce.quality_probe_steps is not None
+                else resolve_quality_probe_steps())
+        except ValueError:
+            self._quality_probe_steps = 0   # env_check reports it
+        self.qsentinel: Optional[QualitySentinel] = None
+        if self._use_quality:
+            self.qsentinel = QualitySentinel(
+                history_path=ce.quality_history,
+                on_trip=self._on_quality_trip,
+                on_recover=self._on_quality_recover)
         # annotate the compile table with analytical per-jit costs so
         # compile_table()/top_offenders() rank jits by bytes moved
         try:
@@ -2288,6 +2395,26 @@ class LLMEngine:
                     self.sentinel.snapshot()["trips"]
                     if self.sentinel is not None else 0),
             },
+            # compact live-quality subset for the router's poll loop;
+            # the full view (attribution table, probe history) lives at
+            # GET /v1/quality
+            "quality": {
+                "qtype": self.qtype,
+                "token_nll": (self._last_quality["token_nll"]
+                              if self._last_quality else None),
+                "entropy": (self._last_quality["entropy"]
+                            if self._last_quality else None),
+                "top1_margin": (self._last_quality["top1_margin"]
+                                if self._last_quality else None),
+                "probe_nll": (self._last_probe["nll"]
+                              if self._last_probe else None),
+                "sentinel_tripped": (
+                    self.qsentinel.tripped
+                    if self.qsentinel is not None else None),
+                "sentinel_trips": (
+                    self.qsentinel.snapshot()["trips"]
+                    if self.qsentinel is not None else 0),
+            } if self._use_quality else None,
             "paged": self._paged_snapshot() if self._paged else None,
             "metrics": self.registry.summary(),
             "requests": self.tracer.snapshot(),
@@ -2444,6 +2571,185 @@ class LLMEngine:
             "sentinel": (self.sentinel.snapshot()
                          if self.sentinel is not None else None),
             "top_offenders": top_offenders(8),
+        }
+
+    # -- live quality telemetry + QualitySentinel ---------------------------
+
+    def _host_quality_rows(self, logits: np.ndarray,
+                           q_meta) -> np.ndarray:
+        """Host-side twin of the fused quality block: chosen-token
+        logprob / entropy / top-1 margin per q_meta row, computed from
+        the [B, V] logits array the complex rows already pulled. Only
+        runs when that pull happened anyway — never adds a transfer."""
+        out = np.zeros((logits.shape[0], 3), np.float32)
+        for i, tok, _, _ in q_meta:
+            row = logits[i].astype(np.float64)
+            mx = float(row.max())
+            ex = np.exp(row - mx)
+            z = float(ex.sum())
+            lp = row - mx - np.log(z)
+            p = ex / z
+            top2 = np.partition(row, -2)[-2:]
+            out[i, 0] = lp[tok]
+            out[i, 1] = -float((p * lp).sum())
+            out[i, 2] = float(top2[-1] - top2[-2])
+        return out
+
+    def _quality_observe(self, qrows_np: np.ndarray, q_meta) -> None:
+        """Fold one decode step's quality rows into the histograms,
+        the compact snapshot, and the QualitySentinel. Pure host float
+        work — the fastpath dispatch-count test asserts it adds no
+        device dispatches. The ``_np`` suffix declares the host-mirror
+        contract: callers pass an already-pulled numpy array, never a
+        device buffer (graftlint's step-host-sync rule audits this)."""
+        lps: List[float] = []
+        ents: List[float] = []
+        margins: List[float] = []
+        for i, tok, repeat, qos in q_meta:
+            lp = float(qrows_np[i, 0])
+            ent = float(qrows_np[i, 1])
+            margin = float(qrows_np[i, 2])
+            lbl = (self.qtype, self.kv_cache_dtype, qos)
+            self._m_q_logprob.labels(*lbl).observe(lp)
+            self._m_q_entropy.labels(*lbl).observe(ent)
+            self._m_q_margin.labels(*lbl).observe(margin)
+            if (self.eos_token_id is not None
+                    and tok == self.eos_token_id):
+                self._m_q_eos.labels(*lbl).inc()
+            if repeat:
+                self._m_q_repeat.labels(*lbl).inc()
+            lps.append(lp)
+            ents.append(ent)
+            margins.append(margin)
+        n = len(lps)
+        if not n:
+            return
+        mean_lp = sum(lps) / n
+        self._last_quality = {
+            # NLL (= -logprob) keeps every sentinel metric positive,
+            # which the multiplicative threshold machinery requires
+            "token_nll": round(-mean_lp, 4),
+            "entropy": round(sum(ents) / n, 4),
+            "top1_margin": round(sum(margins) / n, 4),
+            "batch": n,
+            "step": self._step_idx,
+        }
+        if self.qsentinel is not None:
+            self.qsentinel.observe(
+                token_nll=-mean_lp, entropy=sum(ents) / n,
+                top1_margin=sum(margins) / n)
+
+    def _on_quality_trip(self, info: dict) -> None:
+        """QualitySentinel tripped: counter + flight event +
+        postmortem + bounded profiler auto-capture, all best-effort
+        (a quality regression must never become an outage)."""
+        try:
+            for mt in info.get("metrics", ()):
+                self._m_q_regress.labels(mt).inc()
+            self.flight.record(
+                "quality_regression", step=self._step_idx,
+                metrics=list(info.get("metrics", ())),
+                ewma=info.get("ewma"), baseline=info.get("baseline"),
+                threshold=info.get("threshold"))
+            self.write_postmortem("quality_regression")
+            self._start_auto_capture(info)
+        except Exception:
+            pass
+
+    def _on_quality_recover(self, info: dict) -> None:
+        try:
+            self.flight.record(
+                "quality_recovered", step=self._step_idx,
+                metrics=list(info.get("metrics", ())),
+                ewma=info.get("ewma"), baseline=info.get("baseline"))
+            self._auto_capture_dir = None
+        except Exception:
+            pass
+
+    def _maybe_quality_probe(self) -> None:
+        """Run the teacher-forced NLL probe every
+        ``quality_probe_steps`` decode steps (0 = off, the default, so
+        the pure-decode dispatch-count invariant holds untouched)."""
+        p = self._quality_probe_steps
+        if not self._use_quality or not p or self._step_idx % p:
+            return
+        try:
+            self._quality_probe()
+        except Exception:
+            pass        # the probe is telemetry, never load-bearing
+
+    def _quality_probe(self) -> None:
+        """Teacher-forced NLL over the golden probe prompts: one extra
+        dispatch on its own fresh 4-row cache, scored against the
+        SERVING weights — so silent numeric corruption (logit_drift)
+        moves this number even when byte-level canaries cannot see it.
+        When fault clauses are live the probe applies the same
+        column-0 drift bias the decode path applies (mask + bias enter
+        as traced values, so fault state never forces a recompile)."""
+        v = self.cfg.vocab_size
+        prompts = np.asarray(
+            [[t % v for t in p] for p in GOLDEN_PROBE_PROMPTS],
+            np.int32)
+        n, w = prompts.shape
+        if self._quality_probe_fn is None:
+            fwd = self.family.forward
+
+            @functools.partial(tracked_jit, "engine_quality_probe",
+                               registry=self.registry)
+            def probe(params, toks, cache, drift_mask, drift_bias):
+                logits, _ = fwd(params, self.cfg, toks, cache)
+                lg = logits.astype(jnp.float32)
+                lg = lg.at[:, :, 0].add(
+                    jnp.where(drift_mask, drift_bias, 0.0)[:, None])
+                lp = jax.nn.log_softmax(lg, axis=-1)
+                chosen = jnp.take_along_axis(
+                    lp[:, :-1, :],
+                    toks[:, 1:, None].astype(jnp.int32), axis=-1)[..., 0]
+                return -jnp.mean(chosen)
+
+            self._quality_probe_fn = probe
+        mask = np.zeros((n,), bool)
+        bias = 0.0
+        if self.faults.enabled:
+            rows, b = self.faults.drift_rows(self._step_idx,
+                                             list(range(n)))
+            if rows:
+                mask[rows] = True
+                bias = float(b)
+        cache = self.family.new_cache(self.cfg, n, w, False)
+        nll_dev = self._quality_probe_fn(
+            self.params, jnp.asarray(prompts), cache,
+            jnp.asarray(mask), jnp.asarray(bias, jnp.float32))
+        nll = float(np.asarray(nll_dev))
+        self._m_q_probe_nll.set(round(nll, 4))
+        self._last_probe = {
+            "nll": round(nll, 4),
+            "step": self._step_idx,
+            "prompts": int(n),
+            "tokens_per_prompt": int(w),
+        }
+        if self.qsentinel is not None:
+            self.qsentinel.observe(probe_nll=nll)
+
+    def quality_snapshot(self) -> dict:
+        """JSON-ready quality view for ``GET /v1/quality``: the
+        load-time quantization-error attribution table, the live
+        decode telemetry, the latest golden probe, and the
+        QualitySentinel state."""
+        return {
+            "enabled": self._use_quality,
+            "qtype": self.qtype,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "attribution": (self.quality_report.to_doc()
+                            if self.quality_report is not None
+                            else None),
+            "live": (dict(self._last_quality)
+                     if self._last_quality else None),
+            "probe": dict(self._last_probe) if self._last_probe else None,
+            "probe_period_steps": self._quality_probe_steps,
+            "golden_nll_allowance": golden_nll_allowance(self.qtype),
+            "sentinel": (self.qsentinel.snapshot()
+                         if self.qsentinel is not None else None),
         }
 
     def _config_fingerprint(self) -> dict:
@@ -2953,6 +3259,10 @@ class LLMEngine:
             self._pending_perf = None
             self._perf_observe(time.perf_counter() - t_step0,
                                n_active, seq_len)
+        # periodic teacher-forced NLL probe (off by default: probe
+        # period 0 keeps the pure-decode dispatch count untouched)
+        if did:
+            self._maybe_quality_probe()
         return did
 
     def _step_inner(self) -> bool:
@@ -3081,16 +3391,19 @@ class LLMEngine:
         toks = None
         finite_host = None
         logits_dev = None
+        qrows = None        # [B, 3] chosen_lp/entropy/top1_margin (f32)
         if resident:
             temps, top_ks, top_ps, seeds, poss = gather_params(active)
             all_greedy = all(
                 self.slots[i].req.params.temperature <= 0.0
                 for i in active)
-            toks_dev, finite_dev, self.cache = self._decode_resident(
-                self.params, jnp.asarray(tokens), self.cache,
-                jnp.asarray(temps), jnp.asarray(top_ks),
-                jnp.asarray(top_ps), jnp.asarray(seeds),
-                jnp.asarray(poss), all_greedy=all_greedy)
+            toks_dev, finite_dev, self.cache, qrows_dev = \
+                self._decode_resident(
+                    self.params, jnp.asarray(tokens), self.cache,
+                    jnp.asarray(temps), jnp.asarray(top_ks),
+                    jnp.asarray(top_ps), jnp.asarray(seeds),
+                    jnp.asarray(poss), all_greedy=all_greedy,
+                    with_quality=self._use_quality)
             # dispatch vs device split: dispatch-return time is pure
             # host work (trace + transfer enqueue); the blocked wait on
             # the step result is device compute — the same two-sided
@@ -3099,6 +3412,8 @@ class LLMEngine:
             jax.block_until_ready(toks_dev)  # graftlint: disable=step-host-sync
             toks = np.asarray(toks_dev)
             finite_host = np.asarray(finite_dev)
+            if qrows_dev is not None:
+                qrows = np.asarray(qrows_dev)
         elif self._paged:
             # CoW barrier first (shared write pages get private
             # copies), then one block-table-driven decode dispatch
@@ -3186,14 +3501,21 @@ class LLMEngine:
         # — so capture the parent span id now, not at record time
         traced: Dict[str, Tuple[str, Optional[str]]] = {}
         step_qos: List[str] = []    # per-slot QoS for the SLO TPOT feed
+        # (slot, tok, is_repeat, qos) captured BEFORE _check_done can
+        # free the slot — the quality-telemetry feed for this step
+        q_meta: List[Tuple[int, int, bool, str]] = []
         for i in active:
             s = self.slots[i]
             tok, lp = pick(i)
+            repeat = bool(s.generated) and s.generated[-1] == tok
             s.last_token = tok
             s.generated.append(tok)
             r = s.req
             if r is not None:
                 step_qos.append(r.params.qos or "standard")
+                if self._use_quality:
+                    q_meta.append((i, tok, repeat,
+                                   r.params.qos or "standard"))
             if r is not None and r.trace is not None:
                 sp = self.tracer.get(r.request_id)
                 traced.setdefault(
@@ -3202,6 +3524,16 @@ class LLMEngine:
                      sp.trace_span if sp is not None else None))
             self._emit(s, lp)
             self._check_done(i)
+        # live quality telemetry: resident steps hand over the fused
+        # [B, 3] block (zero extra dispatches); host-sampled steps
+        # reuse the logits array that the complex rows already pulled.
+        # Simple-row non-resident batches keep their logits on-device
+        # — telemetry never adds a transfer the step didn't make.
+        if q_meta:
+            if qrows is None and logits is not None:
+                qrows = self._host_quality_rows(logits, q_meta)
+            if qrows is not None:
+                self._quality_observe(qrows, q_meta)
         # one batched step advances EVERY active stream one token, so
         # step wall time IS each stream's time-per-output-token
         dt = time.perf_counter() - t_decode0

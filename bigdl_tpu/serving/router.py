@@ -329,6 +329,10 @@ class Replica:
         # compact live-perf block (roofline util, sentinel state)
         # probed from /v1/stats; feeds the router perf aggregate
         self.perf: Optional[dict] = None
+        # compact live-quality block (token NLL, probe NLL,
+        # QualitySentinel state) probed from /v1/stats; feeds the
+        # router's fleet quality aggregate
+        self.quality: Optional[dict] = None
         # compact SLO block (active alerts, worst burn rate) probed
         # from /v1/stats; feeds the router's fleet SLO aggregate
         self.slo: Optional[dict] = None
@@ -362,6 +366,7 @@ class Replica:
             "handoff": dict(self.handoff),
             "perf": dict(self.perf) if self.perf else None,
             "slo": dict(self.slo) if self.slo else None,
+            "quality": dict(self.quality) if self.quality else None,
         }
 
 
@@ -775,6 +780,8 @@ class Router:
             r.handoff_gen = r.generation
             perf = doc.get("perf")
             r.perf = perf if isinstance(perf, dict) else None
+            quality = doc.get("quality")
+            r.quality = quality if isinstance(quality, dict) else None
             slo = doc.get("slo")
             if isinstance(slo, dict):
                 # compact fleet view; the full per-replica document
@@ -1508,6 +1515,31 @@ class Router:
                 sum(utils) / len(utils), 4)
         return out
 
+    def _quality_aggregate(self) -> dict:
+        """Fleet quality view from the per-replica /v1/stats quality
+        blocks: per-replica NLL/probe numbers plus the fleet's worst
+        probe NLL (one silently-degraded replica is the alarm — it
+        serves wrong-but-plausible tokens at full speed) and the count
+        of tripped quality sentinels."""
+        per: Dict[str, dict] = {}
+        probe_nlls: List[float] = []
+        tripped = 0
+        for r in self.replicas:
+            if not r.quality:
+                continue
+            per[str(r.idx)] = dict(r.quality)
+            pn = r.quality.get("probe_nll")
+            if isinstance(pn, (int, float)):
+                probe_nlls.append(float(pn))
+            if r.quality.get("sentinel_tripped"):
+                tripped += 1
+        out: dict = {"replicas": per, "sentinels_tripped": tripped}
+        if probe_nlls:
+            out["probe_nll_max"] = round(max(probe_nlls), 4)
+            out["probe_nll_mean"] = round(
+                sum(probe_nlls) / len(probe_nlls), 4)
+        return out
+
     def _slo_aggregate(self) -> dict:
         """Fleet SLO view from the per-replica /v1/stats slo blocks:
         total active alerts and the worst burn rate anywhere (one
@@ -1544,6 +1576,7 @@ class Router:
             "counters": self.counts_snapshot(),
             "rolling_restart_in_progress": self._rolling,
             "perf": self._perf_aggregate(),
+            "quality": self._quality_aggregate(),
             "slo": self._slo_aggregate(),
             "roles": {ro: sum(1 for r in self.replicas
                               if r.role == ro and r.state == HEALTHY)

@@ -116,6 +116,12 @@ class TpuCausalLM:
             kv_cache_dtype if kv_cache_dtype is not None else kv_quantized)
         self.kv_quantized = self.kv_cache_dtype != "bf16"
         self.draft_params: Any = None   # set when loaded with speculative=True
+        # load-time quantization-error attribution
+        # (observability/quality.py AttributionReport): populated by
+        # from_pretrained when conversion ran under an attribution
+        # collector; None for float loads, load_low_bit (no pre-quant
+        # reference weights exist), and GGUF passthrough
+        self.quality_report: Any = None
         self._generator: Optional[Generator] = None
         # packed weight bytes into the process memory ledger at build
         # time (postmortems / GET /v1/memory / bench reports read it);
@@ -547,10 +553,26 @@ class _BaseAutoModelClass:
                     else:
                         yield name, w
             tensor_stream = _tee(tensor_stream, visual_tensors)
-        params = family.convert_params(
-            tensor_stream, cfg, qtype=cvt_qtype,
-            modules_to_not_convert=tuple(modules_to_not_convert),
-            imatrix=imatrix)
+        # quantization-error attribution: run the conversion under a
+        # collector so every Acc.linear records SNR/max-abs-err/clip
+        # saturation vs the pre-quant floats (observability/quality.py).
+        # config.quality_enabled() == False skips the collector and the
+        # per-tensor dequant round-trip entirely.
+        from bigdl_tpu.config import quality_enabled
+        from bigdl_tpu.observability.quality import collect_attribution
+
+        quality_report = None
+        if quality_enabled() and cvt_qtype is not None:
+            with collect_attribution() as quality_report:
+                params = family.convert_params(
+                    tensor_stream, cfg, qtype=cvt_qtype,
+                    modules_to_not_convert=tuple(modules_to_not_convert),
+                    imatrix=imatrix)
+        else:
+            params = family.convert_params(
+                tensor_stream, cfg, qtype=cvt_qtype,
+                modules_to_not_convert=tuple(modules_to_not_convert),
+                imatrix=imatrix)
         if embedding_qtype is not None:
             # LowBitEmbedding equivalent (reference embedding.py:77-114,
             # embedding_qtype kwarg at model.py:104)
@@ -571,6 +593,8 @@ class _BaseAutoModelClass:
         model = TpuCausalLM(params, cfg, family, hf_config, qtype,
                             model_path=path, max_seq=max_seq,
                             kv_cache_dtype=kv_cache_dtype)
+        if quality_report is not None and len(quality_report):
+            model.quality_report = quality_report
         model = _attach_qwen_vl(model)
         if speculative:
             # self-speculation: same checkpoint as a sym_int4 draft

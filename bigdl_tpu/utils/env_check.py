@@ -26,6 +26,7 @@ KNOWN_ENV = (
     "BIGDL_TPU_AUTOSCALE_MIN",
     "BIGDL_TPU_BROWNOUT_HIGH",
     "BIGDL_TPU_BROWNOUT_LOW",
+    "BIGDL_TPU_CANARY_NLL_TOL",
     "BIGDL_TPU_CANARY_SEC",
     "BIGDL_TPU_COMPILE_CACHE",
     "BIGDL_TPU_COMPILE_MEMORY",
@@ -63,6 +64,12 @@ KNOWN_ENV = (
     "BIGDL_TPU_PROFILER_MAX_SEC",
     "BIGDL_TPU_QOS_AGING_SEC",
     "BIGDL_TPU_QOS_DEFAULT",
+    "BIGDL_TPU_QUALITY",
+    "BIGDL_TPU_QUALITY_HISTORY",
+    "BIGDL_TPU_QUALITY_PROBE_STEPS",
+    "BIGDL_TPU_QUALITY_RECOVER_STEPS",
+    "BIGDL_TPU_QUALITY_THRESHOLD",
+    "BIGDL_TPU_QUALITY_TRIP_STEPS",
     "BIGDL_TPU_QUANTIZE_KV_CACHE",
     "BIGDL_TPU_RECOMPILE_WARN",
     "BIGDL_TPU_REPLICA_ROLE",
@@ -260,6 +267,7 @@ def collect() -> dict:
          "resolve_decode_resident"),
         ("prepack", "BIGDL_TPU_PREPACK", "resolve_prepack"),
         ("sentinel", "BIGDL_TPU_SENTINEL", "resolve_sentinel"),
+        ("quality", "BIGDL_TPU_QUALITY", "resolve_quality"),
         ("prefix_sharing", "BIGDL_TPU_PREFIX_SHARING",
          "resolve_prefix_sharing"),
         # paged-KV geometry (not tristates, but the same config.py
@@ -309,6 +317,39 @@ def collect() -> dict:
 
         try:
             info[key] = {"value": getattr(_sentinel, fname)(raw),
+                         "valid": True}
+        except ValueError as e:
+            info[key] = {"value": raw, "valid": False, "error": str(e)}
+
+    # quality-history baseline sink (same degrade-to-live contract as
+    # the perf history)
+    qh = os.environ.get("BIGDL_TPU_QUALITY_HISTORY")
+    if qh:
+        from bigdl_tpu.observability.quality import \
+            validate_quality_history_path
+
+        info["quality_history"] = validate_quality_history_path(qh)
+
+    # QualitySentinel tuning + the golden-probe period (the sentinel
+    # falls back to defaults on bad values; surface range errors here)
+    quality_knobs = (
+        ("quality_threshold", "BIGDL_TPU_QUALITY_THRESHOLD",
+         "resolve_quality_threshold"),
+        ("quality_trip_steps", "BIGDL_TPU_QUALITY_TRIP_STEPS",
+         "resolve_quality_trip_steps"),
+        ("quality_recover_steps", "BIGDL_TPU_QUALITY_RECOVER_STEPS",
+         "resolve_quality_recover_steps"),
+        ("quality_probe_steps", "BIGDL_TPU_QUALITY_PROBE_STEPS",
+         "resolve_quality_probe_steps"),
+    )
+    for key, envname, fname in quality_knobs:
+        raw = os.environ.get(envname)
+        if not raw:
+            continue
+        from bigdl_tpu.observability import quality as _quality
+
+        try:
+            info[key] = {"value": getattr(_quality, fname)(raw),
                          "valid": True}
         except ValueError as e:
             info[key] = {"value": raw, "valid": False, "error": str(e)}
@@ -497,6 +538,19 @@ def collect() -> dict:
             info["canary_sec"] = {"value": canary_sec, "valid": False,
                                   "error": str(e)}
 
+    # canary NLL-tolerance mode (the prober falls back to byte-equality
+    # only on a bad value; surface it here instead)
+    nll_tol = os.environ.get("BIGDL_TPU_CANARY_NLL_TOL")
+    if nll_tol:
+        from bigdl_tpu.serving.canary import resolve_canary_nll_tol
+
+        try:
+            info["canary_nll_tol"] = {
+                "value": resolve_canary_nll_tol(nll_tol), "valid": True}
+        except ValueError as e:
+            info["canary_nll_tol"] = {"value": nll_tol, "valid": False,
+                                      "error": str(e)}
+
     typos = find_env_typos()
     if typos:
         info["env_typos"] = typos
@@ -556,6 +610,13 @@ def main() -> int:
           and info.get("handoff_retries", {}).get("valid", True)
           and info.get("slo_spec", {}).get("valid", True)
           and info.get("canary_sec", {}).get("valid", True)
+          and info.get("canary_nll_tol", {}).get("valid", True)
+          and info.get("quality", {}).get("valid", True)
+          and info.get("quality_threshold", {}).get("valid", True)
+          and info.get("quality_trip_steps", {}).get("valid", True)
+          and info.get("quality_recover_steps", {}).get("valid", True)
+          and info.get("quality_probe_steps", {}).get("valid", True)
+          and info.get("quality_history", {}).get("writable", True)
           and info.get("slo_alert_log", {}).get("writable", True)
           and info.get("usage_log", {}).get("writable", True)
           and not info.get("env_typos")

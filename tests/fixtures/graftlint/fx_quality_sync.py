@@ -1,0 +1,37 @@
+"""Seeded bug: quality telemetry pulling device scalars per token.
+
+Mirrors the hazard the real engine's quality block must avoid: the
+fused decode step returns a [B, 3] device array of per-row
+(logprob, entropy, margin) stats, and the tempting-but-wrong way to
+fold it into histograms is a float() per row per field — 3*B D2H
+syncs on every decode step. The sanctioned idiom is ONE np.asarray
+pull, then host indexing (``_observe_ok`` below).
+"""
+
+import numpy as np
+
+
+class MiniEngine:
+    def step(self):
+        qrows_dev = self._decode()
+        self._observe(qrows_dev)
+        return self._observe_ok(qrows_dev)
+
+    def _decode(self):
+        return object()
+
+    def _observe(self, qrows_dev):
+        for i in range(8):
+            lp = float(qrows_dev[i, 0])     # D2H sync per token
+            ent = float(qrows_dev[i, 1])    # and again
+            self._record(lp, ent)
+
+    def _observe_ok(self, qrows_dev):
+        qrows_np = np.asarray(qrows_dev)    # ONE pull per step...
+        total = 0.0
+        for i in range(8):
+            total += float(qrows_np[i, 0])  # ...then host indexing: ok
+        return total
+
+    def _record(self, lp, ent):
+        pass

@@ -151,6 +151,24 @@ def test_step_path_needs_entry():
     assert not any(f.rule == "step-host-sync" for f in result.findings)
 
 
+def test_detects_quality_telemetry_step_sync():
+    # quality rows pulled per-token from the step path: the hazard the
+    # engine's _quality_observe avoids by taking a host-mirror arg
+    rel = "tests/fixtures/graftlint/fx_quality_sync.py"
+    result = _scan("fx_quality_sync.py",
+                   step_entries={rel: ("MiniEngine", "step")})
+    hits = [f for f in result.findings if f.rule == "step-host-sync"]
+    assert len(hits) >= 2, result.findings
+    assert {f.obj for f in hits} == {"MiniEngine._observe"}
+    # the pull-once-then-index twin must stay silent
+    assert not any(f.obj.endswith("_observe_ok") for f in hits)
+
+
+def test_quality_telemetry_sync_needs_entry():
+    result = _scan("fx_quality_sync.py")
+    assert not any(f.rule == "step-host-sync" for f in result.findings)
+
+
 def test_detects_dispatch_in_decode_loop():
     rel = "tests/fixtures/graftlint/fx_dispatch_loop.py"
     result = _scan("fx_dispatch_loop.py",

@@ -836,3 +836,59 @@ def test_e2e_canary_quarantines_drifting_replica():
         _FAULT_SPECS.clear()
         httpd.shutdown()
         router.shutdown()
+
+
+def test_e2e_nll_canary_quarantines_byte_identical_drift(monkeypatch):
+    """The quality-observability acceptance chaos run: replica 1
+    carries a NEGATIVE logit_drift bias on vocab column 0 — it never
+    flips an argmax, so every byte of its greedy answers stays golden
+    and the byte-equality canary is provably blind to it. Only the
+    distribution drifts (~4e-3 nats/token on the tiny-random model).
+    With BIGDL_TPU_CANARY_NLL_TOL set below that, the NLL-tolerance
+    mode must quarantine exactly the drifting replica, with
+    kind='nll' mismatches and zero byte mismatches."""
+    _FAULT_SPECS.clear()
+    _FAULT_SPECS[1] = "logit_drift@after_step=1,bias=-8"
+    # healthy replicas are bit-deterministic twins (same seed, greedy)
+    # so their NLLs agree exactly; 1e-3 sits well under the ~4e-3
+    # drift and well over float noise
+    monkeypatch.setenv("BIGDL_TPU_CANARY_NLL_TOL", "0.001")
+    router = Router(spawn=_spawn_replica, config=RouterConfig(
+        replicas=2, health_sec=0.2, backoff_base_sec=0.2,
+        crash_budget=20, crash_window_sec=5.0, unhealthy_after=4,
+        spawn_timeout_sec=240.0, drain_exit_timeout_sec=90.0,
+        canary_sec=0.3))
+    assert router.canary.nll_tol == 0.001
+    router.start(wait_healthy=True)
+    try:
+        _wait_all_healthy(router)
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            if router.replicas[1].state == QUARANTINED:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("NLL canary never quarantined the drifting "
+                        f"replica: {router.canary.snapshot()} "
+                        f"{router.stats_snapshot()['counters']}")
+        assert router.replicas[0].state == HEALTHY
+        # every mismatch was an NLL verdict — the bytes never differed
+        events = router.flight.snapshot()
+        mism = [e for e in events if e["event"] == "canary_mismatch"]
+        assert mism and all(e["replica"] == 1 for e in mism)
+        assert all(e["kind"] == "nll" for e in mism)
+        assert all(e["expected"].startswith("nll=") for e in mism)
+        snap = router.canary.snapshot()
+        assert snap["nll_failures_total"] >= 1
+        assert snap["nll_failures_total"] == snap["failures_total"]
+        assert snap["nll_goldens_recorded"] >= 1
+        # quarantine is terminal, and the fleet stats carry the
+        # per-replica quality aggregation from the live engines
+        assert router.replicas[1].state == QUARANTINED
+        stats = router.stats_snapshot()
+        assert stats["counters"]["canary_failures"] >= 1
+        quality = stats.get("quality")
+        assert quality is not None and quality.get("replicas")
+    finally:
+        _FAULT_SPECS.clear()
+        router.shutdown()

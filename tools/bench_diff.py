@@ -114,17 +114,22 @@ ROBUSTNESS_COUNTERS = (
     # additionally zero-gated below: a gated lane must never ship a
     # run whose own sentinel fired
     "bigdl_tpu_perf_regression_total",
+    # quality-regression sentinel trips (observability/quality.py) —
+    # also zero-gated: the run itself watched its decode quality drift
+    "bigdl_tpu_quality_regression_total",
     # golden-canary byte mismatches (serving/canary.py) — also
     # zero-gated: byte-identical seeded replicas must agree
     "bigdl_tpu_router_canary_failures_total",
 )
 
 # counters that must be exactly 0 in the candidate run, baseline or
-# not: a sentinel trip means the run itself detected a decode
-# regression while it was happening; an SLO alert or a canary byte
-# mismatch in a gated lane means the run violated its own objectives
-ZERO_COUNTERS = ("bigdl_tpu_perf_regression_total", "slo_alerts",
-                 "canary_failures")
+# not: a sentinel trip means the run itself detected a decode (or
+# decode-quality) regression while it was happening; an SLO alert or
+# a canary byte mismatch in a gated lane means the run violated its
+# own objectives
+ZERO_COUNTERS = ("bigdl_tpu_perf_regression_total",
+                 "bigdl_tpu_quality_regression_total",
+                 "slo_alerts", "canary_failures")
 
 # the router's flat counters block (bench_serving --replicas embeds
 # GET /v1/router/stats as `router_bench.router`): every one of these
@@ -170,6 +175,16 @@ ROOFLINE_METRICS = {
     "decode_mfu": "higher",
 }
 
+# the per-format golden NLL budget (quality block, nats/token —
+# observability/quality.golden_nll_allowance from the refreshed
+# ACCURACY.md deltas): a SHRINK-ONLY ratchet with its own (tight)
+# --max-nll-regress-pct, lower-is-better — quantization quality may
+# improve freely but a budget that grows means the format got worse
+# (or someone quietly loosened the table)
+NLL_METRICS = {
+    "nll_delta_vs_bf16": "lower",
+}
+
 
 def load_record(path: str) -> dict:
     """Read a BENCH json; unwrap the driver's {"parsed": ...} wrapper
@@ -212,6 +227,9 @@ def flatten_metrics(rec: dict, prefix: str = "",
         elif key in DISPATCH_METRICS and isinstance(val, (int, float)) \
                 and not isinstance(val, bool):
             out[name] = (float(val), DISPATCH_METRICS[key])
+        elif key in NLL_METRICS and isinstance(val, (int, float)) \
+                and not isinstance(val, bool):
+            out[name] = (float(val), NLL_METRICS[key])
         elif key == "value" and isinstance(val, (int, float)) \
                 and not isinstance(val, bool) and rec.get("unit") == "ms":
             # the headline {"metric": ..., "value": ..., "unit": "ms"}
@@ -259,20 +277,25 @@ def diff(old: Dict[str, Tuple[float, str]],
          threshold_pct: float,
          hbm_threshold_pct: Optional[float] = None,
          roofline_threshold_pct: Optional[float] = None,
-         dispatch_threshold_pct: Optional[float] = None):
+         dispatch_threshold_pct: Optional[float] = None,
+         nll_threshold_pct: Optional[float] = None):
     """Returns (rows, regressions): rows are (name, old, new, pct,
     direction, regressed) for every metric present in both files.
     Memory-report scalars (HBM_METRICS keys) regress past
     ``hbm_threshold_pct`` (default: ``threshold_pct``); the decode
     roofline ratchet (ROOFLINE_METRICS) past ``roofline_threshold_pct``
     (default 2); the host dispatch-overhead ratchet (DISPATCH_METRICS)
-    past ``dispatch_threshold_pct`` (default 2)."""
+    past ``dispatch_threshold_pct`` (default 2); the golden NLL budget
+    (NLL_METRICS) past ``nll_threshold_pct`` (default 2,
+    shrink-only)."""
     if hbm_threshold_pct is None:
         hbm_threshold_pct = threshold_pct
     if roofline_threshold_pct is None:
         roofline_threshold_pct = 2.0
     if dispatch_threshold_pct is None:
         dispatch_threshold_pct = 2.0
+    if nll_threshold_pct is None:
+        nll_threshold_pct = 2.0
     rows = []
     regressions = []
     for name in sorted(set(old) & set(new)):
@@ -289,6 +312,8 @@ def diff(old: Dict[str, Tuple[float, str]],
             limit = roofline_threshold_pct
         elif leaf in DISPATCH_METRICS:
             limit = dispatch_threshold_pct
+        elif leaf in NLL_METRICS:
+            limit = nll_threshold_pct
         else:
             limit = threshold_pct
         bad = pct > limit if direction == "lower" else pct < -limit
@@ -325,6 +350,10 @@ def main(argv=None) -> int:
                     default=2.0,
                     help="ratchet threshold for dispatch_overhead_ms "
                          "(default 2; lower-is-better)")
+    ap.add_argument("--max-nll-regress-pct", type=float, default=2.0,
+                    help="shrink-only ratchet threshold for the "
+                         "quality block's nll_delta_vs_bf16 golden "
+                         "budget (default 2; lower-is-better)")
     args = ap.parse_args(argv)
 
     try:
@@ -337,7 +366,8 @@ def main(argv=None) -> int:
     rows, regressions = diff(old, new, args.threshold,
                              args.max_hbm_regress_pct,
                              args.max_roofline_regress_pct,
-                             args.max_dispatch_regress_pct)
+                             args.max_dispatch_regress_pct,
+                             args.max_nll_regress_pct)
     if not rows:
         print("bench_diff: no comparable metrics between "
               f"{args.old} and {args.new}", file=sys.stderr)
