@@ -119,6 +119,105 @@ def run_router_bench(n_replicas: int, n_requests: int = 16,
     }
 
 
+def run_restart_bench(n_replicas: int = 2, new_tokens: int = 16,
+                      prompt_len: int = 12, workers: int = 4) -> dict:
+    """Rolling-restart-under-load lane (ISSUE 20 acceptance): worker
+    threads hammer the fleet with buffered completions while
+    ``rolling_restart`` drains + respawns every replica. Live
+    migration means the drain ships each in-flight sequence's KV to a
+    peer instead of replaying it, so the gated rows are
+    ``http_5xx == 0`` (zero-loss) and ``recomputed_tokens_total == 0``
+    (zero *recompute* — journal replays would burn decode steps the
+    fleet already paid for); ``migrated_tokens_total`` reports how
+    many tokens the handoffs actually saved."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from bigdl_tpu.serving.router import Router, RouterConfig
+
+    cmd = [sys.executable, "-m", "bigdl_tpu.serving.api_server",
+           "--tiny-random", "--tiny-seed", "7",
+           "--host", "127.0.0.1", "--port", "{port}",
+           "--max-batch", "4", "--max-seq", "64"]
+    router = Router(replica_cmd=cmd,
+                    config=RouterConfig(replicas=n_replicas,
+                                        health_sec=0.25),
+                    spawn_env={"JAX_PLATFORMS": "cpu"})
+    router.start()
+    httpd = router.serve(port=0, background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 250, prompt_len).tolist()
+               for _ in range(workers)]
+    stop = threading.Event()
+    lock = threading.Lock()
+    statuses: list = []
+
+    def pound(i: int) -> None:
+        body = json.dumps({"prompt": prompts[i],
+                           "max_tokens": new_tokens}).encode()
+        while not stop.is_set():
+            req = urllib.request.Request(
+                base + "/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=300) as resp:
+                    json.loads(resp.read())
+                st = 200
+            except urllib.error.HTTPError as e:
+                st = e.code
+            except Exception as e:
+                st = f"{type(e).__name__}"
+            with lock:
+                statuses.append(st)
+
+    out: dict = {"replicas": n_replicas}
+    try:
+        threads = [threading.Thread(target=pound, args=(i,))
+                   for i in range(workers)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)        # load established before the restart
+        t0 = time.perf_counter()
+        with router._admin_lock:
+            summary = router.rolling_restart()
+        out["restart_wall_s"] = round(time.perf_counter() - t0, 2)
+        out["restart_ok"] = bool(summary.get("ok"))
+        time.sleep(3 * 0.25 + 0.5)   # final stats polls land
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        snap = router.stats_snapshot()
+        httpd.shutdown()
+        router.shutdown()
+    cnt = snap["counters"]
+    out.update({
+        "requests_total": len(statuses),
+        "completed": statuses.count(200),
+        # the zero-loss gate: ANY 5xx during a planned restart is a
+        # regression (bench_diff flags growth from zero as inf%)
+        "http_5xx": sum(1 for s in statuses
+                        if isinstance(s, int) and s >= 500),
+        "transport_errors": sum(1 for s in statuses
+                                if not isinstance(s, int)),
+        "sequences_migrated": int(cnt.get("sequences_migrated", 0)),
+        "migrated_tokens_total": int(cnt.get("migrated_tokens_total", 0)),
+        # the zero-recompute gate: journal replays re-decode tokens the
+        # fleet already generated; live migration must keep this at 0
+        "recomputed_tokens_total": int(
+            cnt.get("recomputed_tokens_total", 0)),
+        "migrations_failed": int(cnt.get("migration_failed", 0)
+                                 + cnt.get("sequences_migrate_failed", 0)),
+        "migration": snap.get("migration"),
+        "journal": snap.get("journal"),
+    })
+    return out
+
+
 def run_autoscale_bench(n_replicas: int = 2, n_requests: int = 12,
                         new_tokens: int = 8, prompt_len: int = 12) -> dict:
     """Forced-scale-down recovery lane: burst at <=1x on the full
@@ -489,6 +588,16 @@ def main() -> None:
             except Exception as e:
                 failed_lanes.append("autoscale")
                 out["router_bench"]["autoscale"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+            # rolling-restart-under-load: bench_diff gates its
+            # http_5xx / recomputed_tokens_total / migrations_failed
+            # rows lower-is-better (zero-loss, zero-recompute restarts)
+            try:
+                out["router_bench"]["restart"] = run_restart_bench(
+                    max(2, min(replicas, 3)))
+            except Exception as e:
+                failed_lanes.append("restart")
+                out["router_bench"]["restart"] = {
                     "error": f"{type(e).__name__}: {e}"}
         print(json.dumps(out))
         if failed_lanes:

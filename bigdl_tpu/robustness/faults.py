@@ -67,6 +67,29 @@ Kinds and the injection points they attach to:
   invariants under test are the hard guards: never retire the last
   healthy replica, never fight a rolling restart
   (serving/autoscaler.py).
+- ``migration_drop``  — make a live-migration transfer attempt fail at
+  one of its three gates (``gate=send|recv|commit``; unset = every
+  gate): ``send`` fails the POST before any bytes leave the source,
+  ``recv`` makes the target reject before staging, ``commit`` stages
+  the state on the target but loses the ACK (the crash-after-commit
+  matrix row). Consulted via ``drop_point("migrate_<gate>")``. The
+  recovery path under test is the source's local-resume fallback and
+  the router's journal replay — the request must never be lost.
+- ``migration_corrupt`` — flip one bit in a framed internal wire
+  payload after checksumming (``corrupt_point``; ``point=`` scopes to
+  ``migrate`` or ``handoff``, unset = both). The receiver's CRC32
+  check must reject it with a structured 400 counted in
+  ``bigdl_tpu_handoff_rejects_total{reason="crc"}``.
+- ``net_latency``     — add ``ms=`` milliseconds of latency to
+  fleet-internal HTTP client calls (router→replica stats/canary
+  probes and admin fan-outs, replica→replica handoff/migrate posts).
+  ``point=`` scopes to one path (``handoff``, ``migrate``, ``stats``,
+  ``canary``, ``admin``); unset applies to all internal calls.
+- ``net_drop``        — fail fleet-internal HTTP client calls as if
+  the connection reset (``p=`` per-call probability, or the usual
+  every/times triggers). Same ``point=`` scoping as ``net_latency``.
+  Together they make migration/handoff timeout+retry paths
+  chaos-testable deterministically.
 
 Trigger params (every kind):
 
@@ -87,6 +110,11 @@ Trigger params (every kind):
   (``overload_storm`` only; default 1.0).
 - ``bias=B``        — additive logit bias (``logit_drift`` only;
   default 3.0; must be finite and non-zero).
+- ``gate=G``        — migration gate to fail (``migration_drop``
+  only): ``send``, ``recv``, or ``commit``; unset fires at every gate.
+- ``point=P``       — internal-HTTP path scope (``net_latency`` /
+  ``net_drop`` / ``migration_corrupt``); unset applies everywhere the
+  hook is consulted.
 
 Example: ``step_exception@p=0.05,seed=7;slow_step@ms=500,every=10``.
 """
@@ -104,7 +132,12 @@ FAULT_SPEC_ENV = "BIGDL_TPU_FAULT_SPEC"
 
 KINDS = ("step_exception", "admit_exception", "prefill_exception",
          "nan_logits", "logit_drift", "slow_step", "replica_crash",
-         "replica_hang", "overload_storm", "handoff_drop", "scale_flap")
+         "replica_hang", "overload_storm", "handoff_drop", "scale_flap",
+         "migration_drop", "migration_corrupt", "net_latency",
+         "net_drop")
+
+#: live-migration transfer gates migration_drop can target
+MIGRATION_GATES = ("send", "recv", "commit")
 
 #: default exit code for replica_crash — what an external ``kill -9``
 #: surfaces as through the shell (128 + SIGKILL)
@@ -120,6 +153,7 @@ _RAISE_POINTS = {
 _INT_PARAMS = ("after_step", "at_step", "every", "times", "seed", "slot",
                "code")
 _FLOAT_PARAMS = ("p", "ms", "pressure", "bias")
+_STR_PARAMS = ("gate", "point")
 
 
 class InjectedFault(RuntimeError):
@@ -149,6 +183,8 @@ class FaultClause:
     code: Optional[int] = None        # replica_crash exit code
     pressure: float = 1.0             # overload_storm forced pressure
     bias: float = 3.0                 # logit_drift additive bias
+    gate: Optional[str] = None        # migration_drop target gate
+    point: Optional[str] = None       # net_* / migration_corrupt scope
     # runtime state
     fired: int = 0
     visits: int = 0
@@ -207,6 +243,8 @@ def parse_fault_spec(spec: str) -> List[FaultClause]:
                     kw[key] = int(val)
                 elif key in _FLOAT_PARAMS:
                     kw[key] = float(val)
+                elif key in _STR_PARAMS:
+                    kw[key] = val.strip()
                 else:
                     raise ValueError(
                         f"unknown fault param {key!r} for {kind!r}")
@@ -226,6 +264,11 @@ def parse_fault_spec(spec: str) -> List[FaultClause]:
                                               float("-inf")) or b == 0.0):
             raise ValueError(
                 f"logit_drift bias={b} must be finite and non-zero")
+        g = kw.get("gate")
+        if g is not None and g not in MIGRATION_GATES:
+            raise ValueError(
+                f"migration gate {g!r} not one of "
+                f"{', '.join(MIGRATION_GATES)}")
         clauses.append(FaultClause(kind=kind, **kw))  # type: ignore[arg-type]
     return clauses
 
@@ -342,17 +385,76 @@ class FaultInjector:
         return forced
 
     def drop_point(self, point: str, step: int) -> bool:
-        """True when a ``handoff_drop`` clause fires at this point —
-        the caller must treat the in-flight transfer attempt as lost
-        (no bytes delivered) and run its retry/fallback ladder. Only
-        the ``"handoff"`` point consults this today; each attempt is
-        one visit."""
-        if not self.clauses or point != "handoff":
+        """True when a drop clause fires at this point — the caller
+        must treat the in-flight transfer attempt as lost (no bytes
+        delivered) and run its retry/fallback ladder. Each attempt is
+        one visit. ``"handoff"`` consults ``handoff_drop``;
+        ``"migrate_send"`` / ``"migrate_recv"`` / ``"migrate_commit"``
+        consult ``migration_drop`` clauses whose ``gate`` matches the
+        suffix (a gate-less clause fires at every migration gate)."""
+        if not self.clauses:
             return False
         dropped = False
-        for c in self._by_kind.get("handoff_drop", ()):
+        if point == "handoff":
+            for c in self._by_kind.get("handoff_drop", ()):
+                if c.should_fire(step):
+                    self._fired("handoff_drop", point, step)
+                    dropped = True
+        elif point.startswith("migrate_"):
+            gate = point[len("migrate_"):]
+            for c in self._by_kind.get("migration_drop", ()):
+                if c.gate is not None and c.gate != gate:
+                    continue
+                if c.should_fire(step):
+                    self._fired("migration_drop", point, step)
+                    dropped = True
+        return dropped
+
+    def corrupt_point(self, point: str, step: int) -> bool:
+        """True when a ``migration_corrupt`` clause fires: the sender
+        must flip a bit in its already-checksummed frame
+        (serving/wire.corrupt_frame) before the POST, so the receiver's
+        CRC32 rejection path is what gets exercised. ``point`` is
+        ``"migrate"`` or ``"handoff"``; a clause's ``point=`` scopes
+        it, unset fires at both."""
+        if not self.clauses:
+            return False
+        corrupted = False
+        for c in self._by_kind.get("migration_corrupt", ()):
+            if c.point is not None and c.point != point:
+                continue
             if c.should_fire(step):
-                self._fired("handoff_drop", point, step)
+                self._fired("migration_corrupt", point, step)
+                corrupted = True
+        return corrupted
+
+    def net_delay_ms(self, point: str, step: int = 0) -> float:
+        """Milliseconds of injected latency for one fleet-internal
+        HTTP client call at ``point`` (0 when no scoped ``net_latency``
+        clause fires). The caller sleeps before issuing the call."""
+        if not self.clauses:
+            return 0.0
+        total = 0.0
+        for c in self._by_kind.get("net_latency", ()):
+            if c.point is not None and c.point != point:
+                continue
+            if c.should_fire(step):
+                self._fired("net_latency", point, step)
+                total += c.ms
+        return total
+
+    def net_dropped(self, point: str, step: int = 0) -> bool:
+        """True when a scoped ``net_drop`` clause fires: the caller
+        must fail this fleet-internal HTTP call as if the connection
+        reset (raise ``OSError`` before any bytes move)."""
+        if not self.clauses:
+            return False
+        dropped = False
+        for c in self._by_kind.get("net_drop", ()):
+            if c.point is not None and c.point != point:
+                continue
+            if c.should_fire(step):
+                self._fired("net_drop", point, step)
                 dropped = True
         return dropped
 

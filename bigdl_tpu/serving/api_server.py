@@ -64,6 +64,9 @@ from bigdl_tpu.observability.disttrace import (make_traceparent,
 from bigdl_tpu.serving.engine import (EngineDraining, LLMEngine,
                                       SamplingParams)
 from bigdl_tpu.serving.overload import RequestShed
+from bigdl_tpu.serving.wire import (REJECT_REASONS, WireError,
+                                    corrupt_frame, frame_payload,
+                                    is_framed, unframe_payload)
 
 #: engine finish reasons that map to HTTP 504 (the request ran out of
 #: time: its own deadline, or the server's drain window closed on it)
@@ -109,6 +112,53 @@ def resolve_handoff_retries(value: Optional[int] = None) -> int:
         v = int(os.environ.get("BIGDL_TPU_HANDOFF_RETRIES", "2"))
     if v < 0:
         raise ValueError(f"handoff retries {v} must be >= 0")
+    return v
+
+
+#: tristate values for $BIGDL_TPU_LIVE_MIGRATION ("auto" == enabled:
+#: the knob exists so operators can hard-disable migration fleetwide,
+#: and so a future build can gate "auto" on measured link bandwidth
+#: without breaking explicit opt-ins)
+LIVE_MIGRATION_MODES = ("auto", "on", "off")
+
+
+def resolve_live_migration(value: Optional[str] = None) -> str:
+    """$BIGDL_TPU_LIVE_MIGRATION (default "auto"): whether this replica
+    accepts /v1/internal/migrate_in intakes and runs migrate-out on
+    planned disruptions. Raises ValueError on an unknown mode."""
+    v = value if value is not None else os.environ.get(
+        "BIGDL_TPU_LIVE_MIGRATION", "auto")
+    v = (v or "auto").strip().lower()
+    if v not in LIVE_MIGRATION_MODES:
+        raise ValueError(f"live migration mode {v!r} not one of "
+                         f"{', '.join(LIVE_MIGRATION_MODES)}")
+    return v
+
+
+def resolve_migrate_timeout_ms(value: Optional[float] = None) -> float:
+    """$BIGDL_TPU_MIGRATE_TIMEOUT_MS (default 5000): wall budget for
+    one sequence export AND for each migrate_in POST attempt."""
+    if value is not None:
+        v = float(value)
+    else:
+        v = float(os.environ.get("BIGDL_TPU_MIGRATE_TIMEOUT_MS", "5000"))
+    if v <= 0:
+        raise ValueError(f"migrate timeout {v} ms must be > 0")
+    return v
+
+
+def resolve_migrate_max_bytes(value: Optional[int] = None) -> int:
+    """$BIGDL_TPU_MIGRATE_MAX_BYTES (default 64 MiB): largest framed
+    migration payload either side will move — a sender whose export
+    exceeds it resumes locally, a receiver rejects oversized intakes
+    with reason "too_large" before reading the body."""
+    if value is not None:
+        v = int(value)
+    else:
+        v = int(os.environ.get("BIGDL_TPU_MIGRATE_MAX_BYTES",
+                               str(64 << 20)))
+    if v <= 0:
+        raise ValueError(f"migrate max bytes {v} must be > 0")
     return v
 
 
@@ -278,7 +328,10 @@ class OpenAIServer:
                  wedge_sec: float = 10.0,
                  role: Optional[str] = None,
                  handoff_timeout_ms: Optional[float] = None,
-                 handoff_retries: Optional[int] = None):
+                 handoff_retries: Optional[int] = None,
+                 migrate_timeout_ms: Optional[float] = None,
+                 migrate_max_bytes: Optional[int] = None,
+                 live_migration: Optional[str] = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
@@ -298,6 +351,29 @@ class OpenAIServer:
         self._handoff_counts = {"sends": 0, "accepted": 0, "retries": 0,
                                 "fallbacks": 0, "dropped": 0}
         self._handoff_attempts = 0
+        # live-migration knobs (serving/wire.py framing + engine
+        # export/import): "off" disables both the migrate_in intake and
+        # every migrate-out path — callers then fall back to
+        # drain-and-replay, exactly the pre-migration behavior
+        self.live_migration = resolve_live_migration(live_migration)
+        self._migrate_timeout_ms = resolve_migrate_timeout_ms(
+            migrate_timeout_ms)
+        self._migrate_max_bytes = resolve_migrate_max_bytes(
+            migrate_max_bytes)
+        # rid -> {"resume_id", "target"} set by the migrate-out sender
+        # at commit, popped by the HTTP handler when it emits the
+        # client-facing resume marker (lock: _handoff_lock)
+        self._migrated_info: dict = {}
+        # wire-frame rejects at receive (magic/version/length/crc/json/
+        # too_large), mirrored into /v1/stats for the router's deltas
+        self._reject_counts = {r: 0 for r in REJECT_REASONS}
+        self._m_rejects = engine.registry.counter(
+            "bigdl_tpu_handoff_rejects_total",
+            "internal wire payloads rejected at receive, by "
+            "frame-validation reason",
+            ["reason"])
+        for r in REJECT_REASONS:
+            self._m_rejects.labels(r)
         self._m_handoff = {
             key: engine.registry.counter(
                 f"bigdl_tpu_handoff_{key}_total", desc)
@@ -401,7 +477,8 @@ class OpenAIServer:
 
     def _run_request(self, token_ids, params, stream_cb=None,
                      stop_strs=(), disconnect_check=None,
-                     cancel_cb=None, rid=None, trace=None):
+                     cancel_cb=None, rid=None, trace=None,
+                     seed_ids=None):
         """Returns (rid, {index: ids}, {index: logprob entries},
         {index: finish_reason}, {index: final text}, {index: error}).
 
@@ -441,6 +518,25 @@ class OpenAIServer:
         # requests decode once at the end
         live_decode = bool(stop_strs) or stream_cb is not None
         cancelled = [False]          # cancel_cb fired (at most once)
+        if seed_ids:
+            # a resumed (migrated-in) request: the engine only emits
+            # tokens generated since the claim, but the client is owed
+            # the WHOLE completion and decode(a + b) is not
+            # decode(a) + decode(b) for real tokenizers — seed the
+            # accumulated state with the pre-migration ids and mark
+            # their text already emitted and already stop-scanned (the
+            # source replica streamed it before handing off), so the
+            # continuation's first delta carries the boundary
+            # separator and the buffered response detokenizes pre +
+            # post together
+            out_ids[0] = list(seed_ids)
+            pre_text = self._decode_text(list(seed_ids))
+            emitted[0] = len(pre_text)
+            scanned[0] = len(pre_text)
+            if live_decode:
+                det = detoks[0] = _IncrementalDetok(self._decode_text)
+                det.push(list(seed_ids))
+                texts[0] = det.text
 
         def cancel_once():
             if not cancelled[0]:
@@ -593,11 +689,23 @@ class OpenAIServer:
             self._handoff_attempts += 1
             return self._handoff_attempts
 
+    def _count_reject(self, reason: str) -> None:
+        with self._handoff_lock:
+            self._reject_counts[reason] = \
+                self._reject_counts.get(reason, 0) + 1
+        self._m_rejects.labels(reason).inc()
+
     def handoff_snapshot(self) -> dict:
         """The /v1/stats "handoff" block: flat counters the router's
         stats poll turns into per-replica deltas."""
         with self._handoff_lock:
             return dict(self._handoff_counts)
+
+    def rejects_snapshot(self) -> dict:
+        """The /v1/stats "wire_rejects" block: framed-payload
+        rejections at receive, by reason."""
+        with self._handoff_lock:
+            return dict(self._reject_counts)
 
     def _handoff_eligible(self, body: dict, params) -> List[str]:
         """Decode targets for this request, empty when the request must
@@ -652,16 +760,19 @@ class OpenAIServer:
         # re-reads it from the staged request)
         handoff_span = new_span_id() if trace is not None else None
         t_handoff0 = time.time()
-        hdrs = {"Content-Type": "application/json",
+        hdrs = {"Content-Type": "application/octet-stream",
                 "X-Tenant-Id": params.tenant or "default"}
         if trace is not None:
             req["_traceparent"] = make_traceparent(trace[0], trace[1])
             hdrs["traceparent"] = req["_traceparent"]
-        payload = json.dumps({
+        # checksummed frame (serving/wire.py): a bit-flipped base64
+        # body now dies at the receiver's CRC check as a structured
+        # 400 instead of deserializing into garbage KV
+        payload = frame_payload({
             "prompt": [int(t) for t in ids],
             "planes": planes_to_wire(entry),
             "request": req,
-        }).encode()
+        })
         import urllib.request
 
         attempts = self._handoff_retries + 1
@@ -672,10 +783,19 @@ class OpenAIServer:
             if self.engine.faults.drop_point("handoff", step):
                 self._count_handoff("dropped")
             else:
+                data = payload
+                if self.engine.faults.corrupt_point("handoff", step):
+                    data = corrupt_frame(payload)
                 try:
+                    d = self.engine.faults.net_delay_ms("handoff", step)
+                    if d:
+                        time.sleep(d / 1000.0)
+                    if self.engine.faults.net_dropped("handoff", step):
+                        raise OSError(
+                            "injected connection reset (net_drop)")
                     r = urllib.request.Request(
                         f"http://{target}/v1/internal/kv_handoff",
-                        data=payload, method="POST", headers=hdrs)
+                        data=data, method="POST", headers=hdrs)
                     with urllib.request.urlopen(
                             r, timeout=self._handoff_timeout_ms
                             / 1000.0) as resp:
@@ -723,6 +843,144 @@ class OpenAIServer:
                 trace[0], "handoff_fallback", parent_id=handoff_span,
                 targets=list(targets), attempts=attempts)
         return None
+
+    # -- live migration (source side) ---------------------------------------
+
+    def _take_migrated_info(self, rid: str) -> dict:
+        with self._handoff_lock:
+            return self._migrated_info.pop(rid, {})
+
+    def migrate_out(self, targets: List[str], rids=None,
+                    max_sequences=None, qos=None) -> dict:
+        """Migrate in-flight mid-decode sequences to healthy peers and
+        report per-sequence outcomes. The planned-disruption entry
+        point: the router calls it (POST /v1/admin/migrate_out) before
+        a rolling-restart SIGTERM or an autoscale retirement,
+        begin_drain calls it when handed migrate targets, and the
+        brownout ladder's level-3 option calls it with qos="batch".
+        With live migration off (or no targets) every sequence is
+        skipped and callers fall back to drain-and-replay — the
+        pre-migration behavior, zero-5xx but not zero-loss."""
+        results: List[dict] = []
+        summary = {"migrated": 0, "failed": 0, "skipped": 0,
+                   "results": results}
+        targets = [str(t).strip() for t in (targets or [])
+                   if str(t).strip()]
+        if self.live_migration == "off" or not targets:
+            return summary
+        todo = (list(rids) if rids
+                else self.engine.active_request_ids(qos=qos))
+        if max_sequences is not None:
+            todo = todo[:int(max_sequences)]
+        for rid in todo:
+            res = self._migrate_one(rid, targets)
+            results.append(res)
+            o = res["outcome"]
+            if o == "migrated":
+                summary["migrated"] += 1
+            elif o == "unexportable":
+                summary["skipped"] += 1
+            else:
+                summary["failed"] += 1
+        return summary
+
+    def _migrate_one(self, rid: str, targets: List[str]) -> dict:
+        """Export one mid-decode sequence and ship it to the first
+        target that acks. Commit (engine.finish_migrated) happens ONLY
+        on a 200 carrying the resume_id; every other ending resumes
+        the sequence locally from its own exported planes
+        (engine.resume_local) — the request is never lost, at worst it
+        keeps decoding where it already was. The migration_drop /
+        migration_corrupt and net_latency / net_drop chaos kinds
+        (robustness/faults.py) hook every attempt."""
+        state = self.engine.export_sequence(
+            rid, timeout_sec=self._migrate_timeout_ms / 1000.0)
+        if state is None:
+            # finished, already migrating, or not mid-decode here —
+            # nothing was suspended, nothing to undo
+            return {"request_id": rid, "outcome": "unexportable"}
+        planes = state.pop("planes")
+        doc = dict(state, planes=planes_to_wire(planes))
+        tr = state.get("trace")
+        payload = frame_payload(doc)
+        if len(payload) > self._migrate_max_bytes:
+            self.engine.resume_local(rid)
+            self.loop.notify()
+            self.engine.flight.record(
+                "migration_too_large", request_id=rid,
+                bytes=len(payload), cap=self._migrate_max_bytes)
+            return {"request_id": rid, "outcome": "too_large",
+                    "bytes": len(payload)}
+        import urllib.request
+
+        t0 = time.time()
+        span_id = new_span_id() if tr else None
+        attempts = self._handoff_retries + 1
+        delay = 0.05
+        for i in range(attempts):
+            target = targets[i % len(targets)]
+            step = self._next_handoff_attempt()
+            if self.engine.faults.drop_point("migrate_send", step):
+                pass             # injected wire loss: no bytes moved
+            else:
+                data = payload
+                if self.engine.faults.corrupt_point("migrate", step):
+                    data = corrupt_frame(payload)
+                try:
+                    d = self.engine.faults.net_delay_ms("migrate", step)
+                    if d:
+                        time.sleep(d / 1000.0)
+                    if self.engine.faults.net_dropped("migrate", step):
+                        raise OSError(
+                            "injected connection reset (net_drop)")
+                    r = urllib.request.Request(
+                        f"http://{target}/v1/internal/migrate_in",
+                        data=data, method="POST",
+                        headers={"Content-Type":
+                                 "application/octet-stream"})
+                    with urllib.request.urlopen(
+                            r, timeout=self._migrate_timeout_ms
+                            / 1000.0) as resp:
+                        if resp.status == 200:
+                            ack = json.loads(resp.read())
+                            resume_id = str(ack.get("resume_id")
+                                            or state["resume_id"])
+                            with self._handoff_lock:
+                                self._migrated_info[rid] = {
+                                    "resume_id": resume_id,
+                                    "target": target}
+                            self.engine.finish_migrated(
+                                rid, target, resume_id)
+                            self.loop.notify()
+                            if tr:
+                                self.engine.spans.record(
+                                    "migrate.out", tr[0],
+                                    span_id=span_id, parent_id=tr[1],
+                                    t_start=t0, t_end=time.time(),
+                                    target=target, attempt=i + 1,
+                                    bytes=len(payload))
+                            return {"request_id": rid,
+                                    "outcome": "migrated",
+                                    "target": target,
+                                    "resume_id": resume_id,
+                                    "attempts": i + 1}
+                except Exception:
+                    pass         # timeout, refused, 4xx/5xx, dead target
+            if i + 1 < attempts:
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+        # every attempt failed: the sequence resumes HERE from its own
+        # exported planes — zero tokens lost, zero recompute when the
+        # local reseed lands
+        self.engine.resume_local(rid)
+        self.loop.notify()
+        if tr:
+            self.engine.spans.record(
+                "migrate.out", tr[0], span_id=span_id,
+                parent_id=tr[1], t_start=t0, t_end=time.time(),
+                failed=True, attempts=attempts)
+        return {"request_id": rid, "outcome": "failed",
+                "attempts": attempts}
 
     # -- http ---------------------------------------------------------------
 
@@ -821,6 +1079,8 @@ class OpenAIServer:
                     snap = server.engine.stats_snapshot()
                     snap["role"] = server.role
                     snap["handoff"] = server.handoff_snapshot()
+                    snap["wire_rejects"] = server.rejects_snapshot()
+                    snap["live_migration"] = server.live_migration
                     self._json(200, snap)
                 elif self.path == "/v1/memory":
                     # ledger static report + live device stats +
@@ -886,10 +1146,45 @@ class OpenAIServer:
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
-                try:
-                    body = json.loads(self.rfile.read(n) or b"{}")
-                except json.JSONDecodeError:
-                    return self._json(400, {"error": "bad json"})
+                internal = self.path.startswith("/v1/internal/")
+                if self.path == "/v1/internal/migrate_in" \
+                        and n > server._migrate_max_bytes:
+                    # refuse BEFORE reading the body: an oversized
+                    # export must not stall the intake thread
+                    server._count_reject("too_large")
+                    return self._json(413, {"error": {
+                        "message": f"migration payload {n} bytes "
+                                   f"exceeds BIGDL_TPU_MIGRATE_MAX_"
+                                   f"BYTES={server._migrate_max_bytes}",
+                        "type": "bad_wire_frame",
+                        "reason": "too_large", "code": 413}})
+                raw = self.rfile.read(n) if n else b"{}"
+                if internal and is_framed(raw):
+                    # checksummed frame (serving/wire.py): a corrupt or
+                    # version-skewed payload dies here as a structured
+                    # 400 the sender's retry ladder understands
+                    try:
+                        body = unframe_payload(raw)
+                    except WireError as e:
+                        server._count_reject(e.reason)
+                        return self._json(400, {"error": {
+                            "message": str(e),
+                            "type": "bad_wire_frame",
+                            "reason": e.reason, "code": 400}})
+                    if not isinstance(body, dict):
+                        server._count_reject("json")
+                        return self._json(400, {"error": {
+                            "message": "frame body must be a JSON "
+                                       "object",
+                            "type": "bad_wire_frame",
+                            "reason": "json", "code": 400}})
+                else:
+                    # legacy bare-JSON internal payloads stay accepted
+                    # for one version of mixed-fleet compatibility
+                    try:
+                        body = json.loads(raw or b"{}")
+                    except json.JSONDecodeError:
+                        return self._json(400, {"error": "bad json"})
                 try:
                     if self.path == "/v1/completions":
                         return self._completions(body, chat=False)
@@ -899,6 +1194,10 @@ class OpenAIServer:
                         return self._embeddings(body)
                     if self.path == "/v1/internal/kv_handoff":
                         return self._kv_handoff(body)
+                    if self.path == "/v1/internal/migrate_in":
+                        return self._migrate_in(body)
+                    if self.path == "/v1/admin/migrate_out":
+                        return self._admin_migrate_out(body)
                     if self.path == "/v1/profiler/start":
                         return self._profiler(body, start=True)
                     if self.path == "/v1/profiler/stop":
@@ -980,6 +1279,79 @@ class OpenAIServer:
                             t_start=t_accept0, t_end=time.time(),
                             prompt_len=len(prompt))
 
+            def _migrate_in(self, body: dict):
+                """Target side of live migration: accept one
+                mid-decode sequence's exported state (framed and
+                CRC-checked in do_POST), stage it for the resumed
+                request to claim (engine.stage_migration — the engine
+                loop imports the KV pages before the next admission),
+                and ack with the resume_id the client must present
+                (X-Resume-Id). The source treats any non-200 as a
+                failed attempt and falls back (retry / local resume) —
+                including the injected recv/commit drops below, which
+                emulate a request lost before intake and a commit ack
+                lost on the wire (state staged, source never told; the
+                staging TTL reclaims it unclaimed, so no tokens ever
+                reach a client twice)."""
+                if server.live_migration == "off":
+                    return self._json(503, {"error": {
+                        "message": "live migration disabled "
+                                   "(BIGDL_TPU_LIVE_MIGRATION=off)",
+                        "type": "unavailable", "code": 503}})
+                if server.engine.draining:
+                    return self._draining_503()
+                step = server._next_handoff_attempt()
+                if server.engine.faults.drop_point("migrate_recv",
+                                                   step):
+                    return self._json(503, {"error": {
+                        "message": "injected migrate_recv drop",
+                        "type": "unavailable", "code": 503}})
+                t0 = time.time()
+                planes = planes_from_wire(body.get("planes"))
+                state = dict(body)
+                state["planes"] = planes
+                resume_id = server.engine.stage_migration(state)
+                server.loop.notify()
+                tr = state.get("trace")
+                if tr:
+                    server.engine.spans.record(
+                        "migrate.in", tr[0], span_id=new_span_id(),
+                        parent_id=tr[1], t_start=t0, t_end=time.time(),
+                        resume_id=resume_id,
+                        kv_len=state.get("kv_len"))
+                if server.engine.faults.drop_point("migrate_commit",
+                                                   step):
+                    # the state IS staged — only the ack dies. The
+                    # source resumes locally; the staged copy expires
+                    # unclaimed (engine._migration_ttl)
+                    return self._json(503, {"error": {
+                        "message": "injected migrate_commit drop",
+                        "type": "unavailable", "code": 503}})
+                return self._json(200, {"resume_id": resume_id,
+                                        "staged": True})
+
+            def _admin_migrate_out(self, body: dict):
+                """Operator/router entry point for planned disruption:
+                migrate in-flight sequences to the named healthy peers
+                and report per-sequence outcomes. The router calls
+                this before the SIGTERM of a rolling restart or an
+                autoscale retirement, so the drain that follows has
+                nothing left to recompute."""
+                targets = body.get("targets") or []
+                if isinstance(targets, str):
+                    targets = targets.split(",")
+                targets = [str(t).strip() for t in targets
+                           if str(t).strip()]
+                if not targets:
+                    return self._json(
+                        400, {"error": "'targets' must name at least "
+                                       "one host:port peer"})
+                out = server.migrate_out(
+                    targets, rids=body.get("request_ids"),
+                    max_sequences=body.get("max_sequences"),
+                    qos=body.get("qos"))
+                self._json(200, out)
+
             def _embeddings(self, body: dict):
                 if server.embedder is None or \
                         server.embedder_tokenizer is None:
@@ -1043,13 +1415,36 @@ class OpenAIServer:
                 # path below, which reuses the snapshot as its own
                 # prefix seed (the handoff ladder's terminal fallback:
                 # the request is never lost to a dead decode target).
+                # a migrated sequence arriving at its new home: the
+                # router re-forwards the original request with
+                # X-Resume-Id, and claiming the staged state resumes
+                # generation mid-decode (zero recompute). A claim miss
+                # — staging TTL expired, wrong replica — falls through
+                # to a fresh replay: slower, never wrong.
+                resume_state = None
+                rh = self.headers.get("X-Resume-Id")
+                if rh:
+                    resume_state = server.engine.claim_migration(rh)
+                pre_ids: List[int] = []
+                if resume_state is not None:
+                    # tokens generated before this replica took over
+                    # (any earlier hop's output rode into the exported
+                    # prompt; generated_offset marks where the true
+                    # prompt ends) — seeded into _run_request so the
+                    # response covers the full completion
+                    off = int(resume_state.get("generated_offset")
+                              or 0)
+                    pids = list(
+                        resume_state.get("prompt_token_ids") or [])
+                    pre_ids = (pids[len(pids) - off:] if off else []) \
+                        + list(resume_state.get("generated") or [])
                 hdr = self.headers.get("X-Handoff-Targets")
                 if hdr and "_handoff_targets" not in body:
                     body = dict(body)
                     body["_handoff_targets"] = hdr
                 # (chat keeps local decode: the relayed JSON is in
                 # text_completion shape)
-                targets = (() if chat
+                targets = (() if chat or resume_state is not None
                            else server._handoff_eligible(body, params))
                 if targets:
                     out = server._prefill_and_handoff(
@@ -1061,8 +1456,12 @@ class OpenAIServer:
                 # Retry-After, handled in do_POST) must reject doomed
                 # work as a clean status line, not a broken SSE body
                 rid = f"cmpl-{uuid.uuid4().hex[:16]}"
-                server.engine.add_request(rid, ids, params,
-                                          trace=trace)
+                if resume_state is not None:
+                    server.engine.resume_migrated_request(
+                        rid, resume_state, trace=trace)
+                else:
+                    server.engine.add_request(rid, ids, params,
+                                              trace=trace)
                 server.loop.notify()
 
                 if body.get("stream"):
@@ -1096,8 +1495,25 @@ class OpenAIServer:
                                 _socket_disconnected(self.connection),
                             cancel_cb=lambda: server._cancelled.labels(
                                 "stream").inc(),
-                            rid=rid)
+                            rid=rid, seed_ids=pre_ids or None)
                     try:
+                        if any(r == "migrated"
+                               for r in reasons.values()):
+                            # the sequence moved mid-stream: emit the
+                            # resume marker and STOP — no [DONE], the
+                            # router re-forwards to the target and the
+                            # continuation rides the same client
+                            # stream (serving/router.py _relay)
+                            info = server._take_migrated_info(rid)
+                            self.wfile.write(
+                                b"data: " + json.dumps({"migrated": {
+                                    "id": rid,
+                                    "resume_id":
+                                        info.get("resume_id"),
+                                    "target": info.get("target"),
+                                }}).encode() + b"\n\n")
+                            self.wfile.flush()
+                            return
                         self.wfile.write(b"data: [DONE]\n\n")
                         self.wfile.flush()
                     except OSError:
@@ -1111,11 +1527,28 @@ class OpenAIServer:
                             self.connection),
                         cancel_cb=lambda: server._cancelled.labels(
                             "nonstream").inc(),
-                        rid=rid)
+                        rid=rid, seed_ids=pre_ids or None)
                 # robustness status mapping: a request that ran out of
                 # time (its own deadline, or the drain window closing on
                 # it) is a gateway timeout; a quarantined request is a
                 # server error with the engine's structured diagnosis
+                mig = [i for i, r in reasons.items()
+                       if r == "migrated"]
+                if mig:
+                    # the sequence moved to another replica: hand the
+                    # router what it needs to finish the request there
+                    # (re-forward with X-Resume-Id) and stitch the
+                    # partial output in front of the continuation
+                    info = server._take_migrated_info(rid)
+                    return self._json(200, {
+                        "id": rid, "object": "migration",
+                        "migrated": True,
+                        "resume_id": info.get("resume_id"),
+                        "target": info.get("target"),
+                        "partial_text": texts.get(mig[0], ""),
+                        "partial_tokens":
+                            len(out_ids.get(mig[0], [])),
+                    })
                 timed_out = [r for r in reasons.values()
                              if r in _TIMEOUT_REASONS]
                 if timed_out:
@@ -1181,14 +1614,25 @@ class OpenAIServer:
             self._httpd.serve_forever()
         return self._httpd
 
-    def begin_drain(self, timeout_sec: Optional[float] = None) -> None:
+    def begin_drain(self, timeout_sec: Optional[float] = None,
+                    migrate_targets: Optional[List[str]] = None) -> None:
         """Graceful-drain entry point (the CLI's SIGTERM handler):
         admission stops (new requests get 503 + Retry-After), in-flight
         requests run to completion, and whatever outlives the drain
         window fails with 504. Poll `engine.drained` (or `wait_drained`)
-        to know when it is safe to exit."""
+        to know when it is safe to exit.
+
+        When `migrate_targets` names healthy peers (the router's
+        rolling restart and retirement pass them; the CLI SIGTERM
+        handler reads $BIGDL_TPU_MIGRATE_TARGETS), in-flight mid-decode
+        sequences are live-migrated there in a background thread while
+        the drain settles — zero-loss, not merely zero-5xx."""
         self.engine.begin_drain(timeout_sec)
         self.loop.notify()       # wake the step loop to run the drain
+        if migrate_targets and self.live_migration != "off":
+            threading.Thread(
+                target=self.migrate_out,
+                args=(list(migrate_targets),), daemon=True).start()
 
     def wait_drained(self, poll_sec: float = 0.05) -> None:
         """Block until every in-flight request has finished (or the
@@ -1303,7 +1747,13 @@ def main():
     import signal as _signal
 
     def _drain_and_exit(signum, frame):
-        server.begin_drain()
+        # $BIGDL_TPU_MIGRATE_TARGETS (comma-separated host:port peers,
+        # normally injected by the router/autoscaler at spawn): when
+        # set, a SIGTERM drain live-migrates in-flight sequences there
+        # instead of finishing them locally
+        peers = [t.strip() for t in os.environ.get(
+            "BIGDL_TPU_MIGRATE_TARGETS", "").split(",") if t.strip()]
+        server.begin_drain(migrate_targets=peers or None)
 
         def _watch():
             server.wait_drained()

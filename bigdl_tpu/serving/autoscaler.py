@@ -13,7 +13,10 @@ reshapes the fleet instead of only shedding:
 - **Scale down** — drain + retire the least-loaded ``mixed`` replica
   when the fleet has been idle for ``down_streak`` consecutive ticks.
   NEVER the last healthy replica (``Router.retire_replica`` refuses),
-  never below ``$BIGDL_TPU_AUTOSCALE_MIN``.
+  never below ``$BIGDL_TPU_AUTOSCALE_MIN``. ``retire_replica`` first
+  live-migrates the victim's in-flight sequences to surviving peers
+  (``/v1/admin/migrate_out``), so a scale-down loses zero tokens even
+  mid-decode.
 - **Role reassignment** — when pressure persists at the max replica
   bound, flip a ``mixed`` replica to ``prefill`` when TTFT pressure
   dominates (deep queues, calm tpot: admission work is the bottleneck)

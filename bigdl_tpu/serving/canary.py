@@ -191,6 +191,15 @@ class CanaryProber:
         h = {"Content-Type": "application/json"}
         if headers:
             h.update(headers)
+        # router-owned network chaos (net_latency@point=canary /
+        # net_drop@point=canary): a dropped probe is simply a probe
+        # that found nothing, never a mismatch
+        nf = getattr(self.router, "_net_fault", None)
+        if nf is not None:
+            try:
+                nf("/v1/completions")
+            except OSError:
+                return None
         conn = http.client.HTTPConnection(self.router.host, port,
                                           timeout=self.timeout_sec)
         try:

@@ -90,6 +90,17 @@ class EngineDraining(RuntimeError):
     The API server maps it to 503 + ``Retry-After``."""
 
 
+#: ``bigdl_tpu_migrations_total{outcome}`` label values (live sequence
+#: migration, export_sequence/import_sequence). Source side: exported ->
+#: committed (the target owns the sequence) or failed + local_resume
+#: (the sender gave up; the sequence re-admits here); unexportable means
+#: the request was not mid-decode when asked. Target side: imported (KV
+#: staged into the arena / prefix cache) -> claimed (the resumed
+#: request's admission picked the staged pages up).
+MIGRATION_OUTCOMES = ("exported", "committed", "failed", "local_resume",
+                      "unexportable", "imported", "claimed")
+
+
 @dataclasses.dataclass
 class SamplingParams:
     """Per-request sampling (reference vllm/sampling_params.py surface:
@@ -157,6 +168,15 @@ class Request:
     # (trace_id, parent_span_id) propagated from the traceparent header;
     # None for untraced requests
     trace: Optional[Tuple[str, str]] = None
+    # live-migration resume (export_sequence/import_sequence): the
+    # source slot's device-sampler stream carried over verbatim — an
+    # unseeded request otherwise draws a fresh nonce at admission and
+    # its continuation diverges from the unmigrated run
+    resume_dev_seed: Optional[int] = None
+    # staging key a migrated-in sequence presents at admission:
+    # _paged_admit claims the imported arena pages stashed under it
+    # (one-shot; None after the claim, or for ordinary requests)
+    resume_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -898,6 +918,28 @@ class LLMEngine:
         # bounds how many remote snapshots can pin host DRAM when the
         # local prefix cache is disabled (prefix_cache_entries == 0)
         self._handoff_keys: "collections.deque" = collections.deque()
+        # -- live sequence migration (export_sequence/import_sequence).
+        # HTTP sender threads only touch the thread-safe set/deques and
+        # the _lock-guarded dicts; every slot/page/cache mutation stays
+        # on the engine thread (_migration_step / _drain_migrations).
+        self._migrate_req: set = set()      # rids to suspend + export
+        self._migration_out: Dict[str, dict] = {}   # rid -> wire state
+        self._migration_meta: Dict[str, dict] = {}  # rid -> local resume
+        self._migration_done: "collections.deque" = collections.deque()
+        self._migration_fail: "collections.deque" = collections.deque()
+        self._migration_in: "collections.deque" = collections.deque()
+        # target-side staging: resume_id -> (state, staged_at). A lost
+        # commit-ack means the source resumed locally — the stale copy
+        # here must expire UNCLAIMED or the sequence would run twice.
+        self._migration_staged: Dict[str, Tuple[dict, float]] = {}
+        # resume_id -> (imported pages, kv_len, staged_at); claimed by
+        # _paged_admit, expired (pages decref'd) with the stage above
+        self._migration_pages: Dict[str, Tuple[List[int], int,
+                                               float]] = {}
+        self._migration_ttl = 30.0
+        self._mig: Dict[str, int] = {oc: 0 for oc in MIGRATION_OUTCOMES}
+        self._mig["migrated_tokens_total"] = 0
+        self._mig["recomputed_tokens_total"] = 0
 
         # -- metric families (registry/tracer/flight created above,
         # before the jit definitions)
@@ -949,6 +991,31 @@ class LLMEngine:
         self._m_handoff_staged = m.counter(
             "bigdl_tpu_handoff_staged_total",
             "Remote KV-handoff snapshots staged into the prefix cache.")
+        # live-migration observability: outcomes, source-side wall
+        # time, and the migrated-vs-recomputed token ledger the bench
+        # rolling-restart lane and tools/bench_diff.py gate on
+        self._m_migrations = m.counter(
+            "bigdl_tpu_migrations_total",
+            "Live sequence migrations by outcome (bench_diff gates "
+            "outcome=\"failed\" lower-is-better).",
+            labelnames=("outcome",))
+        for oc in MIGRATION_OUTCOMES:    # render from scrape 1
+            self._m_migrations.labels(oc)
+        self._m_migration_ms = m.histogram(
+            "bigdl_tpu_migration_ms",
+            "Source-side migration wall milliseconds, slot export to "
+            "commit-ack.",
+            buckets=(1.0, 5.0, 25.0, 100.0, 500.0, 2500.0, 10000.0))
+        self._m_migrated_tokens = m.counter(
+            "bigdl_tpu_migrated_tokens_total",
+            "Generated-so-far tokens preserved across committed "
+            "migrations (decode work NOT thrown away by a drain, "
+            "rolling restart, or scale-down).")
+        self._m_recomputed_tokens = m.counter(
+            "bigdl_tpu_recomputed_tokens_total",
+            "Generated-so-far tokens whose KV must be recomputed "
+            "because a failed migration had no staged copy to fall "
+            "back on (bench_diff gates this lower-is-better).")
         # pre-register the families fed by ops/probing.py and
         # speculative.py so /metrics exposes them before the first
         # probe or speculative round runs in this process
@@ -1204,7 +1271,7 @@ class LLMEngine:
     # -- public api ---------------------------------------------------------
 
     def add_request(self, request_id: str, prompt_token_ids, params=None,
-                    trace=None):
+                    trace=None, resume=None):
         if self._draining:
             raise EngineDraining(
                 "engine is draining (admission stopped); retry against "
@@ -1239,6 +1306,10 @@ class LLMEngine:
         best_of = params.best_of or params.n
         if best_of < params.n:
             raise ValueError(f"best_of ({best_of}) < n ({params.n})")
+        if resume is not None and best_of > 1:
+            # migration exports only simple (non-fanout) slots; a
+            # resume of a fan-out parent has no single sampler stream
+            raise ValueError("migration resume requires n=1/best_of=1")
         if params.max_time_ms is not None and params.max_time_ms <= 0:
             raise ValueError("max_time_ms must be positive")
         deadline_ms = (params.max_time_ms
@@ -1256,11 +1327,18 @@ class LLMEngine:
                 f"qos must be one of {QOS_CLASSES}, got {qos!r}")
         params = dataclasses.replace(
             params, qos=qos, tenant=params.tenant or "default")
-        self._overload_admit(request_id, ids, params, deadline_ms,
-                             best_of, trace)
-        cap = self.overload.max_tokens_cap()
-        if cap is not None and params.max_tokens > cap:
-            params = dataclasses.replace(params, max_tokens=cap)
+        if resume is None:
+            self._overload_admit(request_id, ids, params, deadline_ms,
+                                 best_of, trace)
+            cap = self.overload.max_tokens_cap()
+            if cap is not None and params.max_tokens > cap:
+                params = dataclasses.replace(params, max_tokens=cap)
+        # a migration resume bypasses early shedding and the brownout
+        # max_tokens cap: the sequence passed admission control when it
+        # first entered the fleet, its staged state is already claimed
+        # (a shed here would strand it mid-stream), and a cap would
+        # silently truncate tokens the client was promised. The intake
+        # membrane for an overloaded target is /v1/internal/migrate_in.
         with self._lock:
             self._outputs[request_id] = []
         target = self._cp_waiting if long else self.waiting
@@ -1290,6 +1368,22 @@ class LLMEngine:
         req.trace = trace
         if deadline_ms is not None:
             req.deadline = req.arrival + deadline_ms / 1000.0
+        if resume is not None:
+            # live-migration resume: generation continues mid-stream.
+            # The generated-so-far tail already rides in the prompt;
+            # the sampler stream and logprob accumulator carry over,
+            # and the source's absolute deadline (if any) keeps ticking
+            # — the clock does not restart on the new replica.
+            req.generated_offset = int(resume.get("generated_offset", 0))
+            req.resumed_cum_logprob = float(
+                resume.get("cum_logprob", 0.0))
+            if resume.get("dev_seed") is not None:
+                req.resume_dev_seed = int(resume["dev_seed"])
+            if resume.get("resume_id"):
+                req.resume_id = str(resume["resume_id"])
+            if resume.get("deadline") is not None:
+                req.deadline = float(resume["deadline"])
+                self._any_deadline = True
         self.tracer.start(request_id, prompt_len=len(ids),
                           t_arrival=req.arrival,
                           trace=self._child_trace(trace))
@@ -1324,10 +1418,16 @@ class LLMEngine:
         return time.monotonic() - self._last_step_ts
 
     def has_unfinished(self) -> bool:
+        # a suspended migration-out sequence is still this replica's
+        # responsibility until its sender commits or resumes it —
+        # draining must not declare victory while one is in flight
         return (len(self.waiting) > 0 or self._admitting is not None
                 or any(s.active for s in self.slots)
                 or len(self._cp_waiting) > 0 or self._cp_active is not None
-                or self._cp_admitting is not None)
+                or self._cp_admitting is not None
+                or bool(self._migration_meta) or bool(self._migrate_req)
+                or bool(self._migration_done)
+                or bool(self._migration_fail))
 
     def get_outputs(self, request_id: str) -> List[RequestOutput]:
         with self._lock:
@@ -1482,7 +1582,8 @@ class LLMEngine:
         """Advance chunked admission by AT MOST one chunk (bounds the
         decode gap a long prompt can cause). Starts a new admission when
         a slot is free and the queue is non-empty."""
-        self._drain_handoffs()
+        self._drain_migrations()    # before handoffs: slab-mode imports
+        self._drain_handoffs()      # ride the handoff staging inbox
         a = self._admitting
         if a is None:
             free = next((i for i, s in enumerate(self.slots)
@@ -1661,7 +1762,32 @@ class LLMEngine:
         ps = self._page_size
         consumed = 0
         shared: List[int] = []
-        if self.radix is not None:
+        owned = False
+        mig = (self._migration_pages.pop(req.resume_id, None)
+               if req.resume_id is not None else None)
+        if mig is not None:
+            # migrated-in sequence: the imported pages arrive at
+            # refcount 1 (owned by the staging stash) and that
+            # reference BECOMES the slot's — no incref below. Only the
+            # aligned prefix is consumable (the same chunk/page
+            # alignment as a radix hit); tail pages holding the
+            # re-prefilled remainder give their reference back.
+            req.resume_id = None         # claim is one-shot
+            pages_m, kv_imported, _ = mig
+            align = max(chunk, ps)
+            consumed = min(kv_imported, plen - 1)
+            consumed -= consumed % align
+            keep = consumed // ps
+            shared = pages_m[:keep]
+            for p in pages_m[keep:]:
+                self.pool.decref(p)
+            owned = True
+            self._mig_inc("claimed")
+            self.flight.record(
+                "migration_claim", step=self._step_idx,
+                request_id=req.request_id, consumed=consumed,
+                n_pages=keep)
+        elif self.radix is not None:
             matched, pages = self.radix.match(prompt)
             # the seeded length must stay aligned to both the prefill
             # chunk and the page size (powers of two: lcm == max), and
@@ -1679,6 +1805,11 @@ class LLMEngine:
             self.radix.evict(n_new - self.pool.num_free)
             new = self.pool.alloc(n_new)
         if new is None:
+            if owned:
+                # give the claimed pages back; the deferred re-admission
+                # re-prefills from tokens (the claim was one-shot)
+                for p in shared:
+                    self.pool.decref(p)
             self.waiting.appendleft(req)
             self._deferred_admissions += 1
             self._m_deferred.labels("pages").inc()
@@ -1689,8 +1820,9 @@ class LLMEngine:
                     request_id=req.request_id, reason="pages",
                     needed_pages=n_new, free_pages=self.pool.num_free)
             return None
-        for p in shared:
-            self.pool.incref(p)          # the slot's own reference
+        if not owned:
+            for p in shared:
+                self.pool.incref(p)      # the slot's own reference
         return consumed, shared, new
 
     def _paged_insert(self, a: _Admission, plen: int):
@@ -1886,6 +2018,464 @@ class LLMEngine:
             old = self._handoff_keys.popleft()
             self._drop_prefix(list(old))
 
+    # -- live sequence migration (zero-loss drains/restarts/scale-downs) ----
+    #
+    # Source side (this replica is being drained/retired): an HTTP
+    # sender thread calls request_migration(rid); the engine loop
+    # suspends the slot mid-decode and exports its complete resumable
+    # state (KV planes, tokens, sampler stream, cum-logprob, QoS/
+    # deadline/trace) into take_export(rid). After the target's
+    # /v1/internal/migrate_in returns 200 the sender calls
+    # finish_migrated() (the request finishes here with reason
+    # "migrated"); after the retry ladder fails it calls resume_local()
+    # and the sequence re-admits HERE, re-seeded from the exported
+    # planes, so a dead target costs a requeue — never the tokens.
+    #
+    # Target side: stage_migration(state) parks the state under its
+    # resume_id; the engine loop imports the KV (paged: fresh pages +
+    # arena scatter, slab: prefix-cache staging) and the resumed
+    # request's admission claims it — the bounded tail re-prefill is
+    # the same byte-identical invariant preempt-resume relies on.
+    # Unclaimed state expires after _migration_ttl (a lost commit-ack
+    # means the source resumed locally; the stale copy must die
+    # unclaimed or the sequence would run twice).
+
+    def request_migration(self, request_id: str) -> None:
+        """Ask the engine loop to suspend + export one mid-decode
+        request. Thread-safe; poll take_export for the state."""
+        self._migrate_req.add(request_id)
+
+    def take_export(self, request_id: str) -> Optional[dict]:
+        """The exported state (planes are host numpy — the API layer
+        wire-encodes them), ``{"unexportable": True}`` when the request
+        was not mid-decode, or None while the export is pending."""
+        with self._lock:
+            return self._migration_out.pop(request_id, None)
+
+    def export_sequence(self, request_id: str,
+                        timeout_sec: float = 5.0) -> Optional[dict]:
+        """Blocking convenience over request_migration/take_export for
+        senders that can wait: returns the resumable state, or None
+        when the request is not mid-decode here (or the engine loop
+        never got to it) — the caller leaves the request alone then.
+        On timeout the export is cancelled (resume_local), so a late
+        export can never leave the sequence suspended forever."""
+        self.request_migration(request_id)
+        deadline = time.monotonic() + timeout_sec
+        while time.monotonic() < deadline:
+            st = self.take_export(request_id)
+            if st is not None:
+                return None if st.get("unexportable") else st
+            time.sleep(0.002)
+        self.resume_local(request_id)
+        return None
+
+    def finish_migrated(self, request_id: str, target: str,
+                        resume_id: str) -> None:
+        """Commit ack from the sender thread: the target replica owns
+        the sequence now. The engine loop delivers the "migrated"
+        finish (so the HTTP handler can emit the resume marker) —
+        nothing is re-emitted, nothing is recomputed."""
+        self._migration_done.append((request_id, target, resume_id))
+
+    def resume_local(self, request_id: str) -> None:
+        """Every transfer attempt failed (or the export timed out):
+        cancel the export and re-admit the sequence locally, re-seeded
+        from its own exported planes. Safe to call at any point of the
+        export lifecycle, from any thread, more than once."""
+        self._migrate_req.discard(request_id)
+        self._migration_fail.append(request_id)
+
+    def stage_migration(self, state: dict) -> str:
+        """Target-side intake (HTTP handler threads): park a migrated
+        sequence's state for the resumed request to claim, and queue
+        its KV planes for the engine loop to import. Returns the
+        resume_id the source's client must present (X-Resume-Id)."""
+        resume_id = state.get("resume_id")
+        if not resume_id:
+            raise ValueError("migration state carries no resume_id")
+        with self._lock:
+            self._migration_staged[str(resume_id)] = (state,
+                                                      time.monotonic())
+        self._migration_in.append(state)
+        return str(resume_id)
+
+    # ISSUE-facing aliases: the tentpole API names
+    import_sequence = stage_migration
+
+    def claim_migration(self, resume_id: str) -> Optional[dict]:
+        """One-shot claim of staged state by the resumed request's
+        HTTP handler; None when nothing is staged under resume_id (the
+        request then proceeds as a fresh replay — full recompute, but
+        correct)."""
+        with self._lock:
+            ent = self._migration_staged.pop(str(resume_id), None)
+        return None if ent is None else ent[0]
+
+    def resume_migrated_request(self, request_id: str, state: dict,
+                                trace=None) -> None:
+        """Admit a claimed migrated sequence as a resumable request:
+        prompt = source prompt + generated-so-far, generation resumes
+        at the source's offset with the source's sampler stream,
+        cum-logprob, QoS/tenant, and deadline. Raises like add_request
+        (EngineDraining / RequestShed / ValueError)."""
+        fields = {f.name for f in dataclasses.fields(SamplingParams)}
+        params = SamplingParams(**{
+            k: v for k, v in (state.get("params") or {}).items()
+            if k in fields})
+        params = dataclasses.replace(
+            params,
+            stop_token_ids=tuple(params.stop_token_ids or ()))
+        gen = list(state.get("generated") or [])
+        full = list(state.get("prompt_token_ids") or []) + gen
+        self.add_request(
+            request_id, full, params, trace=trace,
+            resume={
+                "generated_offset":
+                    int(state.get("generated_offset") or 0) + len(gen),
+                "cum_logprob": float(state.get("cum_logprob") or 0.0),
+                "dev_seed": state.get("dev_seed"),
+                "resume_id": state.get("resume_id"),
+                "deadline": state.get("deadline"),
+            })
+
+    def active_request_ids(self,
+                           qos: Optional[str] = None) -> List[str]:
+        """Request ids currently resident in decode slots — the
+        migratable set, optionally filtered to one QoS class (the
+        brownout ladder migrates only batch-QoS sequences off an
+        overloaded replica). A snapshot; safe from any thread."""
+        out = []
+        for s in self.slots:
+            r = s.req
+            if s.active and r is not None and (
+                    qos is None or (r.params.qos or None) == qos):
+                out.append(r.request_id)
+        return out
+
+    def migration_snapshot(self) -> dict:
+        """The /v1/stats "migration" block: flat counters the router's
+        stats poll turns into per-replica deltas, plus live staging
+        depth."""
+        with self._lock:
+            staged = len(self._migration_staged)
+        d = dict(self._mig)
+        d["staged"] = staged
+        d["pending_out"] = len(self._migration_meta)
+        d["wants_migration"] = bool(
+            getattr(self.overload, "wants_migration", False))
+        if self._paged:
+            d["pool"] = {
+                "exported_pages_total": self.pool.exported_pages_total,
+                "imported_pages_total": self.pool.imported_pages_total,
+                "import_exhausted_total":
+                    self.pool.import_exhausted_total,
+            }
+        return d
+
+    def _mig_inc(self, outcome: str) -> None:
+        self._mig[outcome] += 1
+        self._m_migrations.labels(outcome).inc()
+
+    def _export_slot(self, idx: int) -> None:
+        """Engine-loop half of request_migration: gather the slot's KV
+        off the device, capture every resumable field, then tear the
+        slot down preempt-style (pages released, pos reset) WITHOUT
+        requeueing — the sequence is in limbo until the sender commits
+        (finish_migrated) or gives up (resume_local)."""
+        s = self.slots[idx]
+        req = s.req
+        rid = req.request_id
+        t0 = time.perf_counter()
+        plen = len(req.prompt_token_ids)
+        gen = list(s.generated)
+        # the last sampled token has not been fed back yet — the cache
+        # holds plen + len(gen) - 1 positions (the same invariant
+        # preempt-resume's bounded tail re-prefill relies on)
+        kv_len = plen + len(gen) - 1
+        resume_id = f"{rid}-m{self._step_idx}"
+        state = {
+            "version": 1,
+            "resume_id": resume_id,
+            "request_id": rid,
+            "prompt_token_ids": [int(t) for t in req.prompt_token_ids],
+            "generated": [int(t) for t in gen],
+            "generated_offset": int(req.generated_offset),
+            "kv_len": int(kv_len),
+            "dev_seed": int(s.dev_seed),
+            "cum_logprob": float(s.cum_logprob),
+            "deadline": req.deadline,
+            "params": dataclasses.asdict(req.params),
+            "trace": list(req.trace) if req.trace is not None else None,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "paged": self._paged,
+        }
+        try:
+            if self._paged:
+                ps = self._page_size
+                n_pages = -(-kv_len // ps)
+                pages = [int(p) for p in self._bt_np[idx, :n_pages]]
+                state["page_manifest"] = self.pool.export_pages(pages)
+                dev = gather_pages_dense(
+                    self.cache.k, self.cache.v,
+                    jnp.asarray(np.asarray(pages, np.int32)),
+                    cache_ks=self.cache.k_scale,
+                    cache_vs=self.cache.v_scale)
+                # audited: a rare-path migration pulls this sequence's
+                # 2-4 planes once — not a per-token sync
+                planes = tuple(
+                    np.ascontiguousarray(np.asarray(p)[:, :, :kv_len])  # graftlint: disable=step-host-sync
+                    for p in dev)
+            else:
+                c = self.cache
+                srcs = (c.k, c.v) + ((c.k_scale, c.v_scale)
+                                     if c.k_scale is not None else ())
+                planes = tuple(
+                    np.ascontiguousarray(  # graftlint: disable=step-host-sync
+                        np.asarray(p[:, idx:idx + 1, :kv_len]))  # graftlint: disable=step-host-sync
+                    for p in srcs)
+        except Exception as e:
+            # export must never kill the step loop: leave the sequence
+            # running (the sender times out; the request finishes here)
+            self.flight.record("migration_export_failed",
+                               step=self._step_idx, request_id=rid,
+                               **exception_fields(e))
+            self._mig_inc("failed")
+            with self._lock:
+                self._migration_out[rid] = {"unexportable": True}
+            return
+        state["planes"] = planes
+        resumed = dataclasses.replace(
+            req,
+            prompt_token_ids=list(req.prompt_token_ids) + gen,
+            generated_offset=req.generated_offset + len(gen),
+            resumed_cum_logprob=s.cum_logprob,
+            resume_dev_seed=int(s.dev_seed))
+        s.req = None
+        s.active = False
+        s.generated = []
+        s.counts = None
+        s.counts_out = None
+        self._release_slot_pages(idx)
+        self.cache = dataclasses.replace(
+            self.cache, pos=self.cache.pos.at[idx].set(0))
+        self._migration_meta[rid] = {
+            "resumed": resumed, "planes": planes, "kv_len": kv_len,
+            "t0": t0, "n_generated": resumed.generated_offset}
+        with self._lock:
+            self._migration_out[rid] = state
+        self._mig_inc("exported")
+        self.flight.record(
+            "migration_export", step=self._step_idx, request_id=rid,
+            resume_id=resume_id, slot=idx, kv_len=kv_len,
+            n_generated=resumed.generated_offset)
+
+    def _migration_step(self) -> bool:
+        """Engine-loop migration work: sweep export requests, deliver
+        commit finishes, re-admit failed sends. Returns True when any
+        migration work happened (counts as a working step)."""
+        did = False
+        if self._migrate_req:
+            for rid in list(self._migrate_req):
+                self._migrate_req.discard(rid)
+                idx = next(
+                    (i for i, s in enumerate(self.slots)
+                     if s.active and s.req is not None
+                     and s.req.request_id == rid), None)
+                if idx is None:
+                    # not mid-decode here (queued, admitting, CP lane,
+                    # already finished, unknown): nothing to move —
+                    # tell the sender so it leaves the request alone
+                    self._mig_inc("unexportable")
+                    with self._lock:
+                        self._migration_out[rid] = {
+                            "unexportable": True}
+                    continue
+                self._export_slot(idx)
+                did = True
+        while self._migration_done:
+            try:
+                rid, target, resume_id = self._migration_done.popleft()
+            except IndexError:
+                break
+            meta = self._migration_meta.pop(rid, None)
+            with self._lock:
+                self._migration_out.pop(rid, None)
+            self._abort.discard(rid)
+            if meta is None:
+                continue             # raced with resume_local: resolved
+            self._mig_inc("committed")
+            self._mig["migrated_tokens_total"] += meta["n_generated"]
+            self._m_migrated_tokens.inc(meta["n_generated"])
+            self._m_migration_ms.observe(
+                (time.perf_counter() - meta["t0"]) * 1000.0)
+            self._push_output(
+                rid, RequestOutput(rid, [], True, "migrated"),
+                score=meta["resumed"].resumed_cum_logprob,
+                length=meta["n_generated"])
+            self._obs_finish(rid, "migrated",
+                             n_generated=meta["n_generated"])
+            self.flight.record(
+                "migration_commit", step=self._step_idx,
+                request_id=rid, target=target, resume_id=resume_id,
+                n_generated=meta["n_generated"])
+            did = True
+        while self._migration_fail:
+            try:
+                rid = self._migration_fail.popleft()
+            except IndexError:
+                break
+            meta = self._migration_meta.pop(rid, None)
+            with self._lock:
+                self._migration_out.pop(rid, None)
+            if meta is None:
+                continue             # never exported / already resolved
+            self._mig_inc("failed")
+            resumed = meta["resumed"]
+            if rid in self._abort:
+                # client hung up while the transfer was failing
+                self._abort.discard(rid)
+                self._push_output(rid, RequestOutput(rid, [], True,
+                                                     "abort"))
+                self._obs_finish(rid, "abort",
+                                 n_generated=meta["n_generated"])
+                did = True
+                continue
+            if not self._reseed_local(resumed, meta):
+                # no staged copy: the resume's prefill recomputes the
+                # generated-so-far tail from tokens
+                self._mig["recomputed_tokens_total"] += \
+                    meta["n_generated"]
+                self._m_recomputed_tokens.inc(meta["n_generated"])
+            self.waiting.append(resumed)
+            self._mig_inc("local_resume")
+            self.tracer.preempted(rid)
+            self.flight.record(
+                "migration_local_resume", step=self._step_idx,
+                request_id=rid, n_generated=meta["n_generated"])
+            did = True
+        return did
+
+    def _reseed_local(self, resumed: Request, meta: dict) -> bool:
+        """Failed migration: put the exported KV back (paged:
+        self-import into fresh pages; slab: prefix-cache staging) so
+        the local resume is a cache splice, not a recompute. False when
+        nothing could be staged."""
+        planes = meta.get("planes")
+        kv_len = int(meta.get("kv_len") or 0)
+        if planes is None or kv_len <= 0:
+            return False
+        if self._paged:
+            resume_id = (f"{resumed.request_id}"
+                         f"-local{self._step_idx}")
+            if not self._import_planes(resume_id, planes, kv_len):
+                return False
+            resumed.resume_id = resume_id
+            return True
+        key = tuple(resumed.prompt_token_ids[:kv_len])
+        self._handoff_in.append((key, tuple(planes)))
+        return True
+
+    def _import_planes(self, resume_id: str, planes,
+                       kv_len: int) -> bool:
+        """Scatter host KV planes into freshly imported arena pages and
+        stash them under resume_id for _paged_admit to claim. Engine
+        thread only. False when the pool cannot hold the sequence —
+        the resume then re-prefills from tokens (correct, just slower)."""
+        ps = self._page_size
+        n = -(-kv_len // ps)
+        pages = self.pool.import_pages(n)
+        if pages is None and self.radix is not None:
+            self.radix.evict(n - self.pool.num_free)
+            pages = self.pool.import_pages(n)
+        if pages is None:
+            self.flight.record(
+                "migration_import_exhausted", step=self._step_idx,
+                resume_id=resume_id, needed_pages=n,
+                free_pages=self.pool.num_free)
+            return False
+        cap = n * ps
+        t = np.arange(cap)
+        row = np.asarray(pages, np.int64)
+        phys = jnp.asarray(row[t // ps].astype(np.int32))
+        off = jnp.asarray((t % ps).astype(np.int32))
+        c = self.cache
+        names = ("k", "v", "k_scale", "v_scale")
+        upd = {}
+        for name, plane in zip(names, planes):
+            arena = getattr(c, name)
+            if arena is None:
+                continue
+            # audited: plane arrived as host bytes off the wire — this
+            # asarray is dtype/view normalization, not a device pull
+            plane = np.asarray(plane)  # graftlint: disable=step-host-sync
+            buf = np.zeros((plane.shape[0], cap) + plane.shape[3:],
+                           plane.dtype)
+            buf[:, :kv_len] = plane[:, 0, :kv_len]
+            upd[name] = arena.at[:, phys, off].set(
+                jnp.asarray(buf).astype(arena.dtype))
+        self.cache = dataclasses.replace(c, **upd)
+        self._migration_pages[resume_id] = (pages, kv_len,
+                                            time.monotonic())
+        return True
+
+    def _drain_migrations(self) -> None:
+        """Engine-loop half of stage_migration: import staged KV, and
+        expire unclaimed staging (state AND pages) past the TTL."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [r for r, (_, ts) in
+                    self._migration_staged.items()
+                    if now - ts > self._migration_ttl]
+            for r in dead:
+                self._migration_staged.pop(r, None)
+        for r in dead:
+            self.flight.record("migration_stage_expired",
+                               step=self._step_idx, resume_id=r)
+        if self._migration_pages:
+            for r in [r for r, (_, _, ts) in
+                      self._migration_pages.items()
+                      if now - ts > self._migration_ttl]:
+                pages, _, _ = self._migration_pages.pop(r)
+                for p in pages:
+                    self.pool.decref(p)
+        while self._migration_in:
+            try:
+                state = self._migration_in.popleft()
+            except IndexError:
+                break
+            planes = state.pop("planes", None)
+            resume_id = state.get("resume_id")
+            kv_len = int(state.get("kv_len") or 0)
+            if planes is None or kv_len <= 0:
+                continue
+            if state.get("kv_cache_dtype") not in (None,
+                                                   self.kv_cache_dtype):
+                # mixed-dtype fleet: the quantized codes don't splice —
+                # the resume re-prefills from tokens instead
+                self.flight.record(
+                    "migration_dtype_skew", step=self._step_idx,
+                    resume_id=resume_id,
+                    theirs=state.get("kv_cache_dtype"),
+                    ours=self.kv_cache_dtype)
+                continue
+            ok = False
+            if self._paged:
+                ok = self._import_planes(str(resume_id), planes, kv_len)
+            else:
+                full = (list(state.get("prompt_token_ids") or [])
+                        + list(state.get("generated") or []))
+                if len(full) > kv_len:
+                    self._handoff_in.append(
+                        (tuple(full[:kv_len]), tuple(planes)))
+                    ok = True
+            if ok:
+                self._mig_inc("imported")
+                self.flight.record(
+                    "migration_import", step=self._step_idx,
+                    resume_id=resume_id, kv_len=kv_len,
+                    request_id=state.get("request_id"))
+
     @staticmethod
     def _materialize(entry):
         """Pending device slices -> host numpy (cheap if the async copy
@@ -2032,10 +2622,17 @@ class LLMEngine:
         # PER TOKEN from (seed, absolute position) in _sample_host, so a
         # preempt-resume replays identically to an uninterrupted run.
         s.rng = np.random.default_rng() if p.seed is None else None
-        # device-sampler stream: user seed folded to 31 bits, or a fresh
-        # nonce per admission (unseeded requests promise no replay)
-        s.dev_seed = (int(p.seed) & 0x7FFFFFFF if p.seed is not None
-                      else int(np.random.default_rng().integers(1 << 31)))
+        # device-sampler stream: user seed folded to 31 bits, the
+        # migrated-in stream carried over verbatim (an unseeded resume
+        # must continue the SOURCE's stream or its continuation
+        # diverges from the unmigrated run), or a fresh nonce per
+        # admission (unseeded non-resumed requests promise no replay)
+        if p.seed is not None:
+            s.dev_seed = int(p.seed) & 0x7FFFFFFF
+        elif s.req.resume_dev_seed is not None:
+            s.dev_seed = int(s.req.resume_dev_seed) & 0x7FFFFFFF
+        else:
+            s.dev_seed = int(np.random.default_rng().integers(1 << 31))
         s.cum_logprob = s.req.resumed_cum_logprob
         # rank scores are only consumed when best_of oversamples (> n);
         # don't pay the per-token host log-softmax otherwise
@@ -2416,6 +3013,7 @@ class LLMEngine:
                     if self.qsentinel is not None else 0),
             } if self._use_quality else None,
             "paged": self._paged_snapshot() if self._paged else None,
+            "migration": self.migration_snapshot(),
             "metrics": self.registry.summary(),
             "requests": self.tracer.snapshot(),
             "compile_table": compile_table(),
@@ -3120,6 +3718,20 @@ class LLMEngine:
                 self._finish(i, "drain_timeout")
         if self._cp_active is not None:
             self._cp_finish("drain_timeout")
+        # suspended migrations whose sender never resolved them: the
+        # drain window is closed — fail them too (the sender's late
+        # commit/resume finds no meta and no-ops)
+        self._migrate_req.clear()
+        self._migration_done.clear()
+        self._migration_fail.clear()
+        for rid, meta in list(self._migration_meta.items()):
+            self._migration_meta.pop(rid, None)
+            with self._lock:
+                self._migration_out.pop(rid, None)
+            self._push_output(rid, RequestOutput(
+                rid, [], True, "drain_timeout"))
+            self._obs_finish(rid, "drain_timeout",
+                             n_generated=meta["n_generated"])
 
     def _expire_deadlines(self) -> None:
         """Per-step deadline enforcement across every lane a request
@@ -3293,6 +3905,11 @@ class LLMEngine:
                 q.clear()
                 q.extend(keep)
 
+        # live migration: suspend + export requested sequences, finish
+        # committed ones, re-admit failed ones (serving/api_server
+        # drives the other half from its sender threads)
+        mig_did = self._migration_step()
+
         # per-request deadlines (skip the scan entirely until the first
         # deadline-carrying request arrives)
         if self._any_deadline:
@@ -3337,7 +3954,7 @@ class LLMEngine:
 
         active = [i for i, s in enumerate(self.slots) if s.active]
         if not active:
-            did = cp_did or self._admitting is not None
+            did = cp_did or mig_did or self._admitting is not None
             if did:
                 self._m_steps.inc()
                 self._flight_step("admit" if self._admitting is not None

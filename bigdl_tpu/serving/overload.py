@@ -578,6 +578,17 @@ class OverloadController:
         with self._lock:
             return self.level == 0
 
+    @property
+    def wants_migration(self) -> bool:
+        """Level 3's fleet-relief option: a fully browned-out replica
+        is shedding new batch admissions anyway, so the batch
+        sequences it is ALREADY running are better finished on a
+        cooler peer. Surfaced through the /v1/stats migration block;
+        the router reads it and drives POST /v1/admin/migrate_out
+        with qos="batch"."""
+        with self._lock:
+            return self.level >= BROWNOUT_LEVELS
+
     def max_tokens_cap(self) -> Optional[int]:
         with self._lock:
             return BROWNOUT_MAX_TOKENS[min(self.level,

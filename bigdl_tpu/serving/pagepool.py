@@ -59,6 +59,10 @@ class PagePool:
         self._ref = [0] * num_pages
         self._ref[NULL_PAGE] = 1     # pinned
         self.exhausted_total = 0     # alloc failures (observability)
+        # live-migration accounting (serving/engine.py export/import)
+        self.exported_pages_total = 0
+        self.imported_pages_total = 0
+        self.import_exhausted_total = 0
 
     # -- allocation ---------------------------------------------------------
 
@@ -101,6 +105,44 @@ class PagePool:
 
     def refcount(self, page: int) -> int:
         return self._ref[page]
+
+    # -- live migration -----------------------------------------------------
+
+    def export_pages(self, pages: Sequence[int]) -> dict:
+        """Validate that every page in a departing sequence's block
+        table is LIVE and return the wire manifest for its KV transfer
+        (serving/engine.export_sequence gathers the arena bytes; the
+        pool only vouches for the mapping). Raises ``RuntimeError`` on
+        a free or null page — exporting a page nobody maps would ship
+        stale KV and resume the sequence on garbage."""
+        for p in pages:
+            if p == NULL_PAGE:
+                raise RuntimeError(
+                    "export of the pinned null page (block table holds "
+                    "an unwritten entry)")
+            if self._ref[p] <= 0:
+                raise RuntimeError(
+                    f"export of free page {p} (use-after-free)")
+        self.exported_pages_total += len(pages)
+        return {"pages": [int(p) for p in pages],
+                "page_size": self.page_size,
+                "num_pages": len(pages)}
+
+    def import_pages(self, n: int) -> Optional[List[int]]:
+        """All-or-nothing allocation of ``n`` fresh pages (refcount 1)
+        for an arriving migrated sequence, or None when the arena
+        cannot hold it — the sender keeps ownership and falls back
+        (local resume / journal replay). Counted separately from
+        admission exhaustion so capacity planning can tell organic
+        pressure from migration pressure."""
+        pages = self.alloc(n)
+        if pages is None:
+            # alloc() bumped exhausted_total; reattribute the failure
+            self.exhausted_total -= 1
+            self.import_exhausted_total += 1
+            return None
+        self.imported_pages_total += len(pages)
+        return pages
 
     # -- accounting ---------------------------------------------------------
 

@@ -58,10 +58,12 @@ Run: ``python -m bigdl_tpu.serving.router --model PATH --replicas 2``
 
 from __future__ import annotations
 
+import base64
 import collections
 import dataclasses
 import hashlib
 import http.client
+import itertools
 import json
 import os
 import queue
@@ -82,11 +84,13 @@ from bigdl_tpu.observability.disttrace import (SpanRecorder,
                                                trace_sampled)
 from bigdl_tpu.observability.flight import FlightRecorder
 from bigdl_tpu.observability.metrics import MetricsRegistry
+from bigdl_tpu.robustness.faults import FaultInjector
 
 ROUTER_HEALTH_ENV = "BIGDL_TPU_ROUTER_HEALTH_SEC"
 ROUTER_REPLICAS_ENV = "BIGDL_TPU_ROUTER_REPLICAS"
 ROUTER_HEDGE_ENV = "BIGDL_TPU_ROUTER_HEDGE_MS"
 ROUTER_CRASH_BUDGET_ENV = "BIGDL_TPU_ROUTER_CRASH_BUDGET"
+ROUTER_JOURNAL_ENV = "BIGDL_TPU_ROUTER_JOURNAL"
 
 # replica lifecycle states -> bigdl_tpu_router_replica_state gauge codes
 STARTING = "starting"
@@ -153,6 +157,22 @@ def resolve_router_canary_sec(value: Optional[str] = None) -> float:
     return resolve_canary_sec(value)
 
 
+def resolve_router_journal(value: Optional[str] = None) -> Optional[str]:
+    """Durable request-journal path (default None = in-memory only).
+    Must be absolute: a relative path silently journals into whatever
+    cwd the supervisor happened to start from, which is exactly where
+    a crash-recovery replay would then fail to find it."""
+    raw = value if value is not None else os.environ.get(
+        ROUTER_JOURNAL_ENV, "")
+    if not raw:
+        return None
+    if not os.path.isabs(raw):
+        raise ValueError(
+            f"{ROUTER_JOURNAL_ENV} must be an absolute path, "
+            f"got {raw!r}")
+    return raw
+
+
 def resolve_router_crash_budget(value: Optional[str] = None) -> int:
     """Deaths inside the crash window before a replica is quarantined
     (default 3, must be >= 1)."""
@@ -201,6 +221,18 @@ class RouterConfig:
     roles: Optional[List[str]] = None
     # decode targets named per handoff (ordered least-loaded)
     handoff_fanout: int = 3
+    # durable JSONL journal path (None defers to
+    # $BIGDL_TPU_ROUTER_JOURNAL; unset env = in-memory only)
+    journal_path: Optional[str] = None
+    # POST /v1/admin/migrate_out budget per drained replica: covers
+    # exporting + shipping every in-flight sequence, so it scales with
+    # max_batch, not one request
+    migrate_admin_timeout_sec: float = 30.0
+    # brownout level-3 relief: how often one overloaded replica may be
+    # asked to push batch-QoS sequences to an idle peer, and how many
+    # sequences per nudge
+    brownout_migrate_interval_sec: float = 5.0
+    brownout_migrate_batch: int = 2
 
     def resolve(self) -> "RouterConfig":
         out = dataclasses.replace(self)
@@ -229,6 +261,11 @@ class RouterConfig:
                 out.canary_sec = resolve_router_canary_sec()
             except ValueError:
                 out.canary_sec = 0.0      # env_check reports it
+        if out.journal_path is None:
+            try:
+                out.journal_path = resolve_router_journal()
+            except ValueError:
+                out.journal_path = None   # env_check reports it
         return out
 
 
@@ -261,20 +298,115 @@ class JournalEntry:
     # (trace_id, client_parent_span_id or None, router_span_id) — None
     # when the trace was tail-sampled out, so no header is forwarded
     trace: Optional[Tuple[str, Optional[str], str]] = None
+    # last observed live-migration hop ({"resume_id", "target"}) — a
+    # recovered journal uses it to tell "crashed mid-migration" (fall
+    # back to byte-identical replay of the original body) from a plain
+    # in-flight request
+    migrated: Optional[dict] = None
 
 
 class RequestJournal:
-    """In-memory write-ahead journal of in-flight requests. `admit`
-    happens BEFORE the first forward; `complete` removes the entry once
-    the client has its answer (or its structured error)."""
+    """Write-ahead journal of in-flight requests. `admit` happens
+    BEFORE the first forward; `complete` removes the entry once the
+    client has its answer (or its structured error).
 
-    def __init__(self):
+    With ``path`` set every mutation is also appended to a durable
+    JSONL file (one fsync-free ``write+flush`` per record — the
+    trailing record of a kill -9 may be TORN, which recovery detects
+    and skips). Startup recovery replays the complete records:
+    admitted-but-never-completed entries come back as
+    :attr:`recovered` (their raw bodies replayable byte-identically
+    for greedy/seeded sampling), torn or garbled lines are counted in
+    :attr:`torn_records`, never trusted. A record only counts as
+    committed once its terminating newline hit the file."""
+
+    def __init__(self, path: Optional[str] = None):
         self._entries: Dict[str, JournalEntry] = {}
         self._lock = threading.Lock()
+        self.path = path
+        self._fh = None
+        self.torn_records = 0
+        self.recovered: List[JournalEntry] = []
+        if path:
+            self.recovered, self.torn_records = self._recover(path)
+            # truncate after recovery: the recovered entries are the
+            # router's to replay; carrying dead records forward would
+            # re-recover them after every restart
+            self._fh = open(path, "wb")
+            for e in self.recovered:
+                self._append({
+                    "op": "admit", "rid": e.rid, "path": e.path,
+                    "body": base64.b64encode(e.body).decode("ascii"),
+                    "stream": e.stream, "key": e.key,
+                    "tenant": e.tenant, "recovered": True})
+
+    @staticmethod
+    def _recover(path: str) -> Tuple[List[JournalEntry], int]:
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except FileNotFoundError:
+            return [], 0
+        if not data:
+            return [], 0
+        lines = data.split(b"\n")
+        tail = lines.pop()              # b"" when the file ends clean
+        torn = 1 if tail.strip() else 0  # kill -9 mid-append
+        live: Dict[str, JournalEntry] = {}
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                op = rec["op"]
+                rid = str(rec["rid"])
+            except (ValueError, KeyError, TypeError):
+                torn += 1               # mid-file garbage: skip, count
+                continue
+            if op == "admit":
+                try:
+                    body = base64.b64decode(rec.get("body") or "")
+                except (ValueError, TypeError):
+                    torn += 1
+                    continue
+                live[rid] = JournalEntry(
+                    rid=rid, path=str(rec.get("path") or
+                                      "/v1/completions"),
+                    body=body, stream=bool(rec.get("stream")),
+                    key=int(rec.get("key") or 0),
+                    tenant=rec.get("tenant"))
+            elif op == "complete":
+                live.pop(rid, None)
+            elif op == "migrate":
+                e = live.get(rid)
+                if e is not None:
+                    e.migrated = {"resume_id": rec.get("resume_id"),
+                                  "target": rec.get("target")}
+        return list(live.values()), torn
+
+    def _append(self, rec: dict) -> None:
+        """One JSONL record; caller holds (or IS inside) _lock. The
+        newline is the commit marker — a torn write is detected by its
+        absence (or the half-written JSON in front of it)."""
+        # audited: every caller holds _lock (see docstring), so this
+        # read cannot race the locked writers the checker found
+        fh = self._fh  # graftlint: disable=lock-guarded-unlocked
+        if fh is None:
+            return
+        try:
+            fh.write(json.dumps(rec).encode() + b"\n")
+            fh.flush()
+        except (OSError, ValueError):
+            pass                         # journal loss never 500s traffic
 
     def admit(self, entry: JournalEntry) -> None:
         with self._lock:
             self._entries[entry.rid] = entry
+            self._append({
+                "op": "admit", "rid": entry.rid, "path": entry.path,
+                "body": base64.b64encode(entry.body).decode("ascii"),
+                "stream": entry.stream, "key": entry.key,
+                "tenant": entry.tenant})
 
     def assign(self, rid: str, replica: int, generation: int) -> None:
         with self._lock:
@@ -283,9 +415,23 @@ class RequestJournal:
                 e.replica = replica
                 e.generation = generation
 
+    def record_migration(self, rid: str, resume_id: Optional[str],
+                         target: Optional[str]) -> None:
+        """The request's sequence moved mid-decode: journal the hop
+        BEFORE the continuation forward, so a router crash between
+        commit and continuation recovers to 'replay the original
+        body' (slower, byte-identical) instead of a lost request."""
+        with self._lock:
+            e = self._entries.get(rid)
+            if e is not None:
+                e.migrated = {"resume_id": resume_id, "target": target}
+            self._append({"op": "migrate", "rid": rid,
+                          "resume_id": resume_id, "target": target})
+
     def complete(self, rid: str) -> None:
         with self._lock:
             self._entries.pop(rid, None)
+            self._append({"op": "complete", "rid": rid})
 
     def depth(self) -> int:
         with self._lock:
@@ -295,6 +441,20 @@ class RequestJournal:
         with self._lock:
             return [e for e in self._entries.values()
                     if e.replica == replica]
+
+    def snapshot(self) -> dict:
+        return {"path": self.path, "depth": self.depth(),
+                "torn_records": self.torn_records,
+                "recovered": len(self.recovered)}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
 
 
 class Replica:
@@ -326,6 +486,14 @@ class Replica:
         # belongs to (a respawn resets the replica's counters to zero)
         self.handoff: dict = {}
         self.handoff_gen = -1
+        # live-migration counter block probed from /v1/stats
+        # ("migration" + summed "wire_rejects"), same per-generation
+        # delta discipline as handoff
+        self.migration: Optional[dict] = None
+        self.migration_counts: dict = {}
+        self.migration_gen = -1
+        # last brownout level-3 migrate nudge (rate limit)
+        self.last_brownout_migrate = 0.0
         # compact live-perf block (roofline util, sentinel state)
         # probed from /v1/stats; feeds the router perf aggregate
         self.perf: Optional[dict] = None
@@ -364,6 +532,8 @@ class Replica:
             "tpot_ewma_ms": self.tpot_ewma_ms,
             "headroom_frac": self.headroom_frac,
             "handoff": dict(self.handoff),
+            "migration": (dict(self.migration)
+                          if self.migration else None),
             "perf": dict(self.perf) if self.perf else None,
             "slo": dict(self.slo) if self.slo else None,
             "quality": dict(self.quality) if self.quality else None,
@@ -428,7 +598,12 @@ class Router:
         self.replicas = [
             Replica(i, p, role=(roles[i] if i < len(roles) else "mixed"))
             for i, p in enumerate(ports)]
-        self.journal = RequestJournal()
+        self.journal = RequestJournal(self.cfg.journal_path)
+        # chaos for the router's OWN fleet-internal HTTP clients
+        # (net_latency@point= / net_drop@point=, robustness/faults.py);
+        # off unless $BIGDL_TPU_FAULTS carries a scoped clause
+        self.faults = FaultInjector.from_env()
+        self._fault_step = itertools.count(1)
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.flight = flight if flight is not None else FlightRecorder()
@@ -496,6 +671,26 @@ class Router:
         from bigdl_tpu.serving.canary import CanaryProber
         self.canary = CanaryProber(self, self.cfg.canary_sec or 0.0)
 
+        # journal recovery surfaces its findings once, at boot: torn
+        # trailing records (kill -9 mid-append) are counted and
+        # skipped, complete-but-unfinished admits come back for replay
+        if self.journal.torn_records:
+            self._count("journal_torn_records",
+                        self.journal.torn_records)
+            self.flight.record("journal_torn",
+                               records=self.journal.torn_records,
+                               path=self.journal.path)
+        if self.journal.recovered:
+            self._count("journal_recovered",
+                        len(self.journal.recovered))
+            self.flight.record(
+                "journal_recovered",
+                entries=len(self.journal.recovered),
+                migrated_inflight=sum(
+                    1 for e in self.journal.recovered
+                    if e.migrated is not None),
+                path=self.journal.path)
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self, wait_healthy: bool = True) -> None:
@@ -542,6 +737,7 @@ class Router:
                     r.proc.kill()
                 except Exception:
                     pass
+        self.journal.close()
 
     def _spawn(self, idx: int, port: int, role: str = "mixed"):
         if self._spawn_fn is not None:
@@ -610,8 +806,41 @@ class Router:
                 continue
             self._probe(r, now)
 
+    @staticmethod
+    def _fault_point(path: str) -> str:
+        """Chaos scope for one fleet-internal HTTP call: the point=
+        label net_latency / net_drop clauses select on."""
+        if "/migrate" in path:
+            return "migrate"
+        if "/kv_handoff" in path:
+            return "handoff"
+        if path.startswith("/v1/admin"):
+            return "admin"
+        if path.startswith("/v1/completions") \
+                or path.startswith("/v1/chat/"):
+            return "canary"              # only the prober posts these
+        return "stats"                   # /health, /v1/stats, spans
+
+    def _net_fault(self, path: str) -> None:
+        """Apply injected network chaos to one internal client call:
+        sleep the scoped latency, then fail as a connection reset when
+        a scoped drop fires (the caller's OSError handling — probe
+        failure accounting, stats-poll skip, migrate fallback — is
+        exactly the machinery under test)."""
+        if not self.faults.enabled:
+            return
+        point = self._fault_point(path)
+        step = next(self._fault_step)
+        d = self.faults.net_delay_ms(point, step)
+        if d > 0:
+            time.sleep(d / 1000.0)
+        if self.faults.net_dropped(point, step):
+            raise OSError(
+                f"injected connection reset (net_drop@{point})")
+
     def _http_get(self, port: int, path: str,
                   timeout: float) -> Tuple[int, bytes]:
+        self._net_fault(path)
         conn = http.client.HTTPConnection(self.host, port,
                                           timeout=timeout)
         try:
@@ -623,6 +852,7 @@ class Router:
 
     def _http_post(self, port: int, path: str, doc: dict,
                    timeout: float) -> Tuple[int, bytes]:
+        self._net_fault(path)
         body = json.dumps(doc).encode()
         conn = http.client.HTTPConnection(self.host, port,
                                           timeout=timeout)
@@ -778,6 +1008,36 @@ class Router:
                     self._count(f"handoff_{key}", d)
             r.handoff = ho
             r.handoff_gen = r.generation
+            mig = doc.get("migration")
+            r.migration = mig if isinstance(mig, dict) else None
+            mg = r.migration or {}
+            wr = doc.get("wire_rejects") or {}
+            cur = {
+                "migration_committed":
+                    int(mg.get("committed", 0) or 0),
+                "migration_failed": int(mg.get("failed", 0) or 0),
+                "migration_local_resume":
+                    int(mg.get("local_resume", 0) or 0),
+                "migration_imported":
+                    int(mg.get("imported", 0) or 0),
+                "migration_claimed": int(mg.get("claimed", 0) or 0),
+                "migrated_tokens_total":
+                    int(mg.get("migrated_tokens_total", 0) or 0),
+                "recomputed_tokens_total":
+                    int(mg.get("recomputed_tokens_total", 0) or 0),
+                "wire_rejects": sum(
+                    int(v) for v in wr.values()
+                    if isinstance(v, (int, float))),
+            }
+            prevm = (r.migration_counts
+                     if r.migration_gen == r.generation else {})
+            for key, v in cur.items():
+                d = v - prevm.get(key, 0)
+                if d > 0:
+                    self._count(key, d)
+            r.migration_counts = cur
+            r.migration_gen = r.generation
+            self._maybe_brownout_migrate(r)
             perf = doc.get("perf")
             r.perf = perf if isinstance(perf, dict) else None
             quality = doc.get("quality")
@@ -930,6 +1190,174 @@ class Router:
                                  r.queue_depth, len(r.inflight), r.idx))
         return [f"{self.host}:{r.port}"
                 for r in pool[:max(1, self.cfg.handoff_fanout)]]
+
+    # -- live migration -----------------------------------------------------
+
+    def _migrate_peers(self, r: Replica) -> List[str]:
+        """host:port targets for replica ``r``'s in-flight sequences:
+        every OTHER routable replica, least-loaded first."""
+        peers = [x for x in self.replicas
+                 if x is not r and self._routable(x)]
+        peers.sort(key=lambda x: (x.brownout, x.occupancy,
+                                  x.queue_depth, len(x.inflight),
+                                  x.idx))
+        return [f"{self.host}:{x.port}" for x in peers]
+
+    def _migrate_off(self, r: Replica, reason: str,
+                     qos: Optional[str] = None,
+                     max_sequences: Optional[int] = None) -> dict:
+        """Ask replica ``r`` to export its mid-decode sequences to
+        healthy peers (POST /v1/admin/migrate_out) ahead of a planned
+        disruption. Best-effort by design: a refused or failed call
+        falls back to the plain SIGTERM drain — in-flight work
+        finishes locally, zero 5xx, just not zero recompute if the
+        process then dies."""
+        targets = self._migrate_peers(r)
+        out: dict = {"requested": False, "migrated": 0, "failed": 0}
+        if not targets or not r.alive():
+            return out
+        doc: dict = {"targets": targets}
+        if qos:
+            doc["qos"] = qos
+        if max_sequences:
+            doc["max_sequences"] = int(max_sequences)
+        try:
+            status, body = self._http_post(
+                r.port, "/v1/admin/migrate_out", doc,
+                self.cfg.migrate_admin_timeout_sec)
+            out["requested"] = True
+            out["status"] = status
+            try:
+                res = json.loads(body)
+            except ValueError:
+                res = {}
+            out["migrated"] = int(res.get("migrated", 0) or 0)
+            out["failed"] = int(res.get("failed", 0) or 0)
+            out["skipped"] = int(res.get("skipped", 0) or 0)
+            self._count("migrations_requested")
+            if out["migrated"]:
+                self._count("sequences_migrated", out["migrated"])
+            if out["failed"]:
+                self._count("sequences_migrate_failed", out["failed"])
+        except OSError as e:
+            out["error"] = str(e)[:200]
+        self.flight.record("migrate_off", replica=r.idx,
+                           reason=reason, qos=qos, **out)
+        return out
+
+    def _maybe_brownout_migrate(self, r: Replica) -> None:
+        """Brownout ladder, fleet rung: a replica that reached level 3
+        is already degrading everyone it serves — when an idle peer
+        exists, push a few batch-QoS sequences over instead of letting
+        them starve behind the interactive tier. Rate-limited per
+        replica; interactive traffic never moves this way (its KV is
+        hot here; migration is for work that tolerates the hop)."""
+        wants = bool((r.migration or {}).get("wants_migration")) \
+            or r.brownout >= 3
+        if not wants:
+            return
+        now = time.monotonic()
+        if now - r.last_brownout_migrate \
+                < self.cfg.brownout_migrate_interval_sec:
+            return
+        if not any(x is not r and self._routable(x)
+                   and x.brownout == 0 for x in self.replicas):
+            return                       # nowhere cooler to go
+        r.last_brownout_migrate = now
+        self._count("brownout_migrations")
+        self._migrate_off(r, "brownout", qos="batch",
+                          max_sequences=self.cfg.brownout_migrate_batch)
+
+    def _replica_at(self, target: str) -> Optional[Replica]:
+        """The replica serving ``host:port``, or None. State is NOT
+        checked: a migration target just acked a stage, which beats a
+        probe-delayed lifecycle label; a dead process fails the
+        forward and the caller falls back."""
+        try:
+            port = int(str(target).rsplit(":", 1)[-1])
+        except ValueError:
+            return None
+        for r in self.replicas:
+            if r.port == port and r.alive():
+                return r
+        return None
+
+    @staticmethod
+    def _migrated_of(data: bytes) -> Optional[dict]:
+        """Parse a replica's mid-decode migration handoff body
+        ({"object": "migration", "migrated": true, "resume_id",
+        "target", ...}); None for a normal completion."""
+        if b'"migrated"' not in data[:256]:
+            return None
+        try:
+            doc = json.loads(data)
+        except ValueError:
+            return None
+        if isinstance(doc, dict) and doc.get("migrated") is True:
+            return doc
+        return None
+
+    def _continue_migrated(self, entry: JournalEntry,
+                           mig: dict) -> Tuple[int, bytes]:
+        """A replica exported ``entry``'s sequence mid-decode: finish
+        the request by re-POSTing the journaled ORIGINAL body to the
+        migration target with ``X-Resume-Id``. The target claims the
+        staged KV state, resumes at the exact sampler position, and
+        returns the FULL completion (it detokenizes pre + post tokens
+        together), so the client response is byte-identical to an
+        unmigrated run. Chained hops (the target itself drains) loop,
+        bounded by fleet size. Raises ``ReplicaLost`` when the staged
+        state's home is gone — the caller replays from the journal."""
+        hops = 0
+        while True:
+            resume_id = mig.get("resume_id")
+            target = str(mig.get("target") or "")
+            self._count("migration_continuations")
+            self.journal.record_migration(entry.rid, resume_id,
+                                          target)
+            self.flight.record(
+                "migration_continue", rid=entry.rid,
+                resume_id=resume_id, target=target,
+                **({"trace_id": entry.trace[0]}
+                   if entry.trace is not None else {}))
+            if entry.trace is not None:
+                self.spans.annotate(
+                    entry.trace[0], "migration_continue",
+                    parent_id=entry.trace[2], target=target,
+                    resume_id=resume_id, request_id=entry.rid)
+            rep = self._replica_at(target)
+            if rep is None or not resume_id:
+                raise ReplicaLost(
+                    f"migration target {target!r} not reachable")
+            hdrs = self._fwd_headers(entry)
+            hdrs["X-Resume-Id"] = str(resume_id)
+            rep.inflight.add(entry.rid)
+            self.journal.assign(entry.rid, rep.idx, rep.generation)
+            conn = http.client.HTTPConnection(
+                self.host, rep.port,
+                timeout=self.cfg.connect_timeout_sec)
+            try:
+                conn.request("POST", entry.path, body=entry.body,
+                             headers=hdrs)
+                conn.sock.settimeout(self.cfg.forward_timeout_sec)
+                resp = conn.getresponse()
+                status, data = resp.status, resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                self._breaker_failure(rep)
+                raise ReplicaLost(
+                    f"migration target {target}: "
+                    f"{type(e).__name__}: {e}") from e
+            finally:
+                rep.inflight.discard(entry.rid)
+                conn.close()
+            nxt = self._migrated_of(data) if status == 200 else None
+            if nxt is None:
+                self._breaker_success(rep)
+                return status, data
+            mig = nxt
+            hops += 1
+            if hops > len(self.replicas) + 1:
+                raise ReplicaLost("migration continuation loop")
 
     def _fwd_headers(self, entry: JournalEntry,
                      r: Optional[Replica] = None) -> Dict[str, str]:
@@ -1115,6 +1543,44 @@ class Router:
                 if reroutes <= len(self.replicas):
                     continue
                 return 503, data
+            if status == 200:
+                mig = self._migrated_of(data)
+                if mig is not None:
+                    # the sequence moved mid-decode (drain, restart,
+                    # scale-down, brownout): finish it on its new home
+                    self._breaker_success(used)
+                    try:
+                        status, data = self._continue_migrated(
+                            entry, mig)
+                        # continuation served by the TARGET: count and
+                        # return here so a rare target-side 5xx does
+                        # not land on the source's breaker
+                        self._count("requests")
+                        # idx bounded by fleet size, status by HTTP
+                        self._c_requests.labels(
+                            str(used.idx), str(status)).inc()  # graftlint: disable=metric-label-cardinality
+                        self._h_latency.observe(
+                            time.monotonic() - t0)
+                        return status, data
+                    except ReplicaLost as e:
+                        # the staged state died with its target: fall
+                        # back to a full journal replay — recomputes
+                        # the prefix, never wrong
+                        self._count("migration_fallback_replays")
+                        self.flight.record(
+                            "migration_fallback", rid=entry.rid,
+                            error=str(e)[:200])
+                        if entry.replays < self.cfg.max_replays:
+                            entry.replays += 1
+                            self._count("replays")
+                            self._c_replays.inc()
+                            continue
+                        return 502, json.dumps({"error": {
+                            "message": "migration continuation failed "
+                                       "and replay budget is spent",
+                            "type": "replica_lost", "code": 502,
+                            "retry_after":
+                                self.retry_after_hint()}}).encode()
             if status >= 500:
                 self._breaker_failure(used)
             else:
@@ -1151,6 +1617,14 @@ class Router:
                 r.planned_restart = True   # the supervisor hands over
                 self._set_state(r, DRAINING)
                 step = {"replica": r.idx, "pid": r.pid}
+                # live migration BEFORE the SIGTERM: mid-decode
+                # sequences move to healthy peers (the in-flight
+                # relays see the migrated marker and re-forward), so
+                # the drain has nothing left to wait out and the
+                # restart costs zero recomputed tokens — a refused or
+                # failed migrate falls back to the plain drain
+                step["migrate"] = self._migrate_off(
+                    r, "rolling_restart")
                 try:
                     if r.proc is not None and r.proc.poll() is None:
                         r.proc.terminate()     # SIGTERM -> drain
@@ -1344,6 +1818,10 @@ class Router:
             return False
         r.planned_restart = True         # supervisor hands the proc over
         self._set_state(r, DRAINING)
+        # scale-down is a planned disruption: move the mid-decode
+        # sequences to surviving replicas first, then drain whatever
+        # (if anything) refused to export
+        mig = self._migrate_off(r, reason)
         try:
             if r.proc is not None and r.proc.poll() is None:
                 r.proc.terminate()       # SIGTERM -> graceful drain
@@ -1360,7 +1838,8 @@ class Router:
             r.planned_restart = False
         self._count("autoscale_retired")
         self.flight.record("replica_retired", replica=r.idx,
-                           reason=reason)
+                           reason=reason,
+                           migrated=mig.get("migrated", 0))
         return True
 
     def reassign_role(self, r: Replica, role: str) -> bool:
@@ -1565,12 +2044,29 @@ class Router:
             "canary": self.canary.snapshot(),
         }
 
+    def _migration_aggregate(self) -> dict:
+        """Fleet live-migration view: the sum of every replica's
+        probed counters (per-generation deltas keep respawn resets
+        from double-counting) plus live staging depth."""
+        agg = collections.Counter()
+        staged = pending = 0
+        for r in self.replicas:
+            for k, v in r.migration_counts.items():
+                agg[k] += v
+            mg = r.migration or {}
+            staged += int(mg.get("staged", 0) or 0)
+            pending += int(mg.get("pending_out", 0) or 0)
+        return {**{k: int(v) for k, v in sorted(agg.items())},
+                "staged": staged, "pending_out": pending}
+
     def stats_snapshot(self) -> dict:
         """JSON-ready router state for ``GET /v1/router/stats`` (and
         the bench JSON's ``router`` block)."""
         return {
             "replicas": [r.snapshot() for r in self.replicas],
             "journal_depth": self.journal.depth(),
+            "journal": self.journal.snapshot(),
+            "migration": self._migration_aggregate(),
             "spans": self.spans.snapshot(),
             "tenants": self._tenant_aggregate(),
             "counters": self.counts_snapshot(),
@@ -1593,6 +2089,7 @@ class Router:
                 "max_replays": self.cfg.max_replays,
                 "affinity_tokens": self.cfg.affinity_tokens,
                 "handoff_fanout": self.cfg.handoff_fanout,
+                "journal_path": self.cfg.journal_path,
             },
         }
 
@@ -1876,51 +2373,142 @@ class Router:
                         r.inflight.discard(entry.rid)
                         conn.close()
 
-            def _relay(self, entry: JournalEntry, r: Replica, resp):
+            def _pump(self, entry: JournalEntry, resp):
+                """Relay one replica's SSE to the client until EOF.
+                Returns (saw_done, migrated_info_or_None,
+                client_gone). The mid-decode migration marker (a
+                ``data: {"migrated": ...}`` event the replica emits
+                INSTEAD of [DONE]) is consumed here — it is
+                router-internal routing state, never client bytes."""
                 saw_done = False
+                mig = None
                 try:
                     while True:
                         line = resp.fp.readline()
                         if not line:
                             break
-                        if line.strip() == b"data: [DONE]":
+                        s = line.strip()
+                        if s == b"data: [DONE]":
                             saw_done = True
+                        elif s.startswith(b'data: {"migrated"'):
+                            try:
+                                doc = json.loads(s[len(b"data: "):])
+                            except ValueError:
+                                doc = {}
+                            got = doc.get("migrated")
+                            if isinstance(got, dict):
+                                mig = got
+                                continue
                         try:
                             self.wfile.write(line)
                             if line == b"\n":
                                 self.wfile.flush()
                         except OSError:
                             # CLIENT left: closing the replica conn
-                            # (finally below) trips the engine's SSE
-                            # write failure -> abort + slot free
+                            # (caller's finally) trips the engine's
+                            # SSE write failure -> abort + slot free
                             router.flight.record(
                                 "stream_client_gone", rid=entry.rid)
-                            return
+                            return saw_done, None, True
                 except (OSError, http.client.HTTPException):
                     pass                 # replica died mid-read
-                if saw_done:
-                    return
-                # REPLICA lost mid-stream: structured error, not a
-                # dropped socket
-                router._count("failovers")
-                router._count("stream_errors")
-                router._c_failovers.inc()
-                router._breaker_failure(r)
-                retry = router.retry_after_hint()
-                router.flight.record("stream_replica_lost",
-                                     rid=entry.rid, replica=r.idx)
-                event = {"error": {
-                    "message": "replica failed mid-stream; resubmit "
-                               "the request",
-                    "type": "replica_failover", "code": 503,
-                    "retry_after": retry}}
+                return saw_done, mig, False
+
+            def _relay(self, entry: JournalEntry, r: Replica, resp):
+                """Relay the stream; when the replica hands the
+                sequence off mid-decode, re-POST the journaled body to
+                the migration target with X-Resume-Id and ride the
+                continuation SSE on the SAME client socket — the
+                client sees one uninterrupted stream whose bytes match
+                an unmigrated run (the target's first delta carries
+                the boundary separator; serving/api_server.py seeds
+                the resumed decode state)."""
+                hops = 0
+                conn2 = None
                 try:
-                    self.wfile.write(
-                        b"data: " + json.dumps(event).encode()
-                        + b"\n\ndata: [DONE]\n\n")
-                    self.wfile.flush()
-                except OSError:
-                    pass
+                    while True:
+                        saw_done, mig, gone = self._pump(entry, resp)
+                        if saw_done or gone:
+                            return
+                        if mig is None:
+                            break        # replica lost mid-stream
+                        hops += 1
+                        if hops > len(router.replicas) + 1:
+                            break
+                        resume_id = mig.get("resume_id")
+                        target = str(mig.get("target") or "")
+                        router._count("migration_continuations")
+                        router.journal.record_migration(
+                            entry.rid, resume_id, target)
+                        router.flight.record(
+                            "migration_continue", rid=entry.rid,
+                            resume_id=resume_id, target=target,
+                            stream=True)
+                        if entry.trace is not None:
+                            router.spans.annotate(
+                                entry.trace[0], "migration_continue",
+                                parent_id=entry.trace[2],
+                                target=target, resume_id=resume_id,
+                                request_id=entry.rid)
+                        rep = router._replica_at(target)
+                        if rep is None or not resume_id:
+                            break
+                        hdrs = router._fwd_headers(entry)
+                        hdrs["X-Resume-Id"] = str(resume_id)
+                        if conn2 is not None:
+                            conn2.close()
+                        conn2 = http.client.HTTPConnection(
+                            router.host, rep.port,
+                            timeout=router.cfg.connect_timeout_sec)
+                        router.journal.assign(entry.rid, rep.idx,
+                                              rep.generation)
+                        try:
+                            conn2.request("POST", entry.path,
+                                          body=entry.body,
+                                          headers=hdrs)
+                            conn2.sock.settimeout(
+                                router.cfg.forward_timeout_sec)
+                            resp2 = conn2.getresponse()
+                        except (OSError,
+                                http.client.HTTPException):
+                            break
+                        if resp2.status != 200:
+                            try:
+                                resp2.read()
+                            except (OSError,
+                                    http.client.HTTPException):
+                                pass
+                            break
+                        router._breaker_success(rep)
+                        r = rep
+                        resp = resp2
+                    # REPLICA (or its migration continuation) lost
+                    # mid-stream: structured error, not a dropped
+                    # socket — generated bytes already with the client
+                    # cannot be resumed transparently
+                    router._count("failovers")
+                    router._count("stream_errors")
+                    router._c_failovers.inc()
+                    router._breaker_failure(r)
+                    retry = router.retry_after_hint()
+                    router.flight.record("stream_replica_lost",
+                                         rid=entry.rid,
+                                         replica=r.idx)
+                    event = {"error": {
+                        "message": "replica failed mid-stream; "
+                                   "resubmit the request",
+                        "type": "replica_failover", "code": 503,
+                        "retry_after": retry}}
+                    try:
+                        self.wfile.write(
+                            b"data: " + json.dumps(event).encode()
+                            + b"\n\ndata: [DONE]\n\n")
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+                finally:
+                    if conn2 is not None:
+                        conn2.close()
 
         return Handler
 
@@ -1963,6 +2551,10 @@ def main():
     ap.add_argument("--roles", default=None,
                     help="comma-separated per-index fleet roles, e.g. "
                          "'prefill,decode' (rest default to mixed)")
+    ap.add_argument("--journal", default=None,
+                    help="durable JSONL request-journal path (default "
+                         "$BIGDL_TPU_ROUTER_JOURNAL; unset = "
+                         "in-memory only)")
     ap.add_argument("--autoscale", action="store_true",
                     help="run the load-signal autoscaler "
                          "(serving/autoscaler.py; bounds from "
@@ -1990,7 +2582,8 @@ def main():
                             health_sec=args.health_sec,
                             hedge_ms=args.hedge_ms,
                             crash_budget=args.crash_budget,
-                            roles=roles),
+                            roles=roles,
+                            journal_path=args.journal),
         host=args.host)
     print(f"router: spawning {router.cfg.replicas} replicas on ports "
           f"{[r.port for r in router.replicas]}", file=sys.stderr)

@@ -1,0 +1,104 @@
+"""Checksummed, versioned framing for fleet-internal wire payloads.
+
+PR 9's KV-handoff payload was a bare JSON document: a bit-flipped
+base64 body (a bad NIC, a proxy truncation, a version-skewed peer)
+deserializes into *garbage KV* silently and the decode replica serves
+wrong-but-plausible tokens at full speed. Every internal transfer —
+``/v1/internal/kv_handoff`` and the live-migration
+``/v1/internal/migrate_in`` — now travels inside one self-describing
+frame::
+
+    offset  size  field
+    0       4     magic  b"BTW1"
+    4       2     version (big-endian u16; this writer emits 1)
+    6       4     CRC32 of the body (big-endian u32, zlib.crc32)
+    10      8     body length (big-endian u64)
+    18      n     body: UTF-8 JSON document
+
+The receiver rejects a frame whose magic, version, length, or CRC
+does not check out with a typed :class:`WireError` — the HTTP layer
+turns that into a structured 400 counted in
+``bigdl_tpu_handoff_rejects_total{reason}`` and the sender falls back
+(local decode for handoff, local resume / journal replay for
+migration). A legacy *unframed* JSON body is still accepted by the
+servers for one version of mixed-fleet compatibility: frames start
+with ``BTW``, JSON starts with ``{``, so the two are unambiguous.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Any
+
+MAGIC = b"BTW1"
+WIRE_VERSION = 1
+_HEADER = struct.Struct(">4sHIQ")      # magic, version, crc32, body len
+
+#: reject reasons the metrics pre-label (render-before-first-reject)
+REJECT_REASONS = ("magic", "version", "length", "crc", "json",
+                  "too_large")
+
+
+class WireError(ValueError):
+    """A frame failed validation. ``reason`` is one of
+    :data:`REJECT_REASONS` — it becomes the structured-400 body and
+    the ``reason`` label on ``bigdl_tpu_handoff_rejects_total``."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"bad wire frame ({reason})"
+                         + (f": {detail}" if detail else ""))
+        self.reason = reason
+
+
+def frame_payload(obj: Any) -> bytes:
+    """Serialize ``obj`` (a JSON-able document) into one checksummed
+    frame."""
+    body = json.dumps(obj).encode("utf-8")
+    return _HEADER.pack(MAGIC, WIRE_VERSION,
+                        zlib.crc32(body) & 0xFFFFFFFF,
+                        len(body)) + body
+
+
+def is_framed(data: bytes) -> bool:
+    """True when ``data`` starts like a frame (vs a legacy bare-JSON
+    payload, which starts with ``{``)."""
+    return data[:len(MAGIC)] == MAGIC
+
+
+def unframe_payload(data: bytes) -> Any:
+    """Validate one frame and return the decoded JSON document.
+    Raises :class:`WireError` on any mismatch."""
+    if len(data) < _HEADER.size:
+        raise WireError("length",
+                        f"{len(data)} bytes < {_HEADER.size}-byte header")
+    magic, version, crc, blen = _HEADER.unpack_from(data)
+    if magic != MAGIC:
+        raise WireError("magic", repr(magic))
+    if version != WIRE_VERSION:
+        raise WireError("version",
+                        f"got v{version}, this build speaks "
+                        f"v{WIRE_VERSION}")
+    body = data[_HEADER.size:]
+    if len(body) != blen:
+        raise WireError("length",
+                        f"header says {blen} body bytes, got "
+                        f"{len(body)}")
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise WireError("crc", "checksum mismatch")
+    try:
+        return json.loads(body)
+    except ValueError as e:
+        raise WireError("json", str(e)[:120]) from e
+
+
+def corrupt_frame(data: bytes) -> bytes:
+    """Deterministically flip one bit in the frame BODY (fault
+    injection: ``migration_corrupt``). The receiver's CRC check must
+    catch it; flipping a body bit rather than a header bit exercises
+    the checksum, not the cheap structural validation."""
+    if len(data) <= _HEADER.size:
+        return data[:-1] + bytes([data[-1] ^ 0x01]) if data else data
+    i = _HEADER.size + (len(data) - _HEADER.size) // 2
+    return data[:i] + bytes([data[i] ^ 0x01]) + data[i + 1:]

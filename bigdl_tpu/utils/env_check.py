@@ -44,6 +44,7 @@ KNOWN_ENV = (
     "BIGDL_TPU_KV_CACHE_DTYPE",
     "BIGDL_TPU_KV_PAGES",
     "BIGDL_TPU_KV_PAGE_SIZE",
+    "BIGDL_TPU_LIVE_MIGRATION",
     "BIGDL_TPU_MATMUL_BACKEND",
     "BIGDL_TPU_MATMUL_GEMV",
     "BIGDL_TPU_MATMUL_PALLAS_MAX_M",
@@ -51,6 +52,9 @@ KNOWN_ENV = (
     "BIGDL_TPU_MAX_QUEUE_DEPTH",
     "BIGDL_TPU_MAX_SEQ",
     "BIGDL_TPU_MEMORY_POLL_SEC",
+    "BIGDL_TPU_MIGRATE_MAX_BYTES",
+    "BIGDL_TPU_MIGRATE_TARGETS",
+    "BIGDL_TPU_MIGRATE_TIMEOUT_MS",
     "BIGDL_TPU_MOE_DISPATCH",
     "BIGDL_TPU_MXU_LAYOUT",
     "BIGDL_TPU_NATIVE_CACHE",
@@ -77,6 +81,7 @@ KNOWN_ENV = (
     "BIGDL_TPU_ROUTER_CRASH_BUDGET",
     "BIGDL_TPU_ROUTER_HEALTH_SEC",
     "BIGDL_TPU_ROUTER_HEDGE_MS",
+    "BIGDL_TPU_ROUTER_JOURNAL",
     "BIGDL_TPU_ROUTER_REPLICAS",
     "BIGDL_TPU_SENTINEL",
     "BIGDL_TPU_SENTINEL_RECOVER_STEPS",
@@ -501,6 +506,70 @@ def collect() -> dict:
         except ValueError as e:
             info[key] = {"value": raw, "valid": False, "error": str(e)}
 
+    # live-migration knobs (the api server falls back to defaults on a
+    # bad timeout/size and refuses to start on a bad mode; the router
+    # treats an unusable journal path as journal-off — all four classes
+    # of typo get reported here instead of surfacing mid-drain)
+    migrate_knobs = (
+        ("live_migration", "BIGDL_TPU_LIVE_MIGRATION",
+         "resolve_live_migration"),
+        ("migrate_timeout_ms", "BIGDL_TPU_MIGRATE_TIMEOUT_MS",
+         "resolve_migrate_timeout_ms"),
+        ("migrate_max_bytes", "BIGDL_TPU_MIGRATE_MAX_BYTES",
+         "resolve_migrate_max_bytes"),
+    )
+    for key, envname, fname in migrate_knobs:
+        raw = os.environ.get(envname)
+        if not raw:
+            continue
+        from bigdl_tpu.serving import api_server as _api_server
+
+        try:
+            info[key] = {"value": getattr(_api_server, fname)(raw),
+                         "valid": True}
+        except ValueError as e:
+            info[key] = {"value": raw, "valid": False, "error": str(e)}
+
+    # migrate-out peer list: free-form host:port entries, so just check
+    # the shape — a malformed entry silently skips that peer at drain
+    # time, which is the worst moment to learn about a typo
+    mt = os.environ.get("BIGDL_TPU_MIGRATE_TARGETS")
+    if mt:
+        bad = []
+        for t in (x.strip() for x in mt.split(",")):
+            if not t:
+                continue
+            host, _, port = t.rpartition(":")
+            if not host or not port.isdigit():
+                bad.append(t)
+        info["migrate_targets"] = (
+            {"value": mt, "valid": True} if not bad else
+            {"value": mt, "valid": False,
+             "error": f"malformed host:port entries: {bad}"})
+
+    # durable router journal path (the router degrades to in-memory on
+    # a relative path or an unwritable file)
+    rj = os.environ.get("BIGDL_TPU_ROUTER_JOURNAL")
+    if rj:
+        from bigdl_tpu.serving.router import resolve_router_journal
+
+        try:
+            resolved = resolve_router_journal(rj)
+            writable = True
+            err = None
+            d = os.path.dirname(resolved) or "/"
+            if not os.path.isdir(d):
+                writable, err = False, f"directory does not exist: {d}"
+            elif not os.access(d, os.W_OK):
+                writable, err = False, f"directory not writable: {d}"
+            info["router_journal"] = {"value": resolved,
+                                      "valid": True, "writable": writable}
+            if err:
+                info["router_journal"]["error"] = err
+        except ValueError as e:
+            info["router_journal"] = {"value": rj, "valid": False,
+                                      "error": str(e)}
+
     # fleet SLO engine / usage metering / canary probes: the tracker
     # swallows a bad spec (falls back to defaults) and the prober
     # treats a bad interval as off, so this is where a broken override
@@ -608,6 +677,12 @@ def main() -> int:
           and info.get("replica_role", {}).get("valid", True)
           and info.get("handoff_timeout_ms", {}).get("valid", True)
           and info.get("handoff_retries", {}).get("valid", True)
+          and info.get("live_migration", {}).get("valid", True)
+          and info.get("migrate_timeout_ms", {}).get("valid", True)
+          and info.get("migrate_max_bytes", {}).get("valid", True)
+          and info.get("migrate_targets", {}).get("valid", True)
+          and info.get("router_journal", {}).get("valid", True)
+          and info.get("router_journal", {}).get("writable", True)
           and info.get("slo_spec", {}).get("valid", True)
           and info.get("canary_sec", {}).get("valid", True)
           and info.get("canary_nll_tol", {}).get("valid", True)

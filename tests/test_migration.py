@@ -1,0 +1,806 @@
+"""Live sequence migration (ISSUE 20): zero-loss drains, restarts and
+scale-downs via mid-decode KV state handoff.
+
+- **In-thread unit tests**: the checksummed wire framing
+  (serving/wire.py), the PagePool export/import accounting, the new
+  fault kinds (``migration_drop`` / ``migration_corrupt`` /
+  ``net_latency`` / ``net_drop``), the migration env resolvers, and
+  the router journal's torn-write recovery.
+- **In-process engine pairs** (two tiny engines, byte-identical
+  weights): a request exported mid-decode from engine A and resumed on
+  engine B produces output byte-identical to an unmigrated run — under
+  greedy AND seeded sampling, paged KV with a radix-CoW-shared prefix
+  included.
+- **Subprocess chaos e2e** (two ``api_server --tiny-random`` replicas,
+  same seed): ``/v1/admin/migrate_out`` -> framed
+  ``/v1/internal/migrate_in`` -> ``X-Resume-Id`` continuation returns
+  the FULL completion byte-identical to an unmigrated reference; an
+  armed ``migration_drop`` falls back to local resume with zero lost
+  requests; SIGKILL of the source after commit leaves no duplicate
+  tokens.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Tuple
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+from bigdl_tpu.robustness.faults import (FaultInjector,  # noqa: E402
+                                         validate_fault_spec)
+from bigdl_tpu.serving.api_server import (resolve_live_migration,  # noqa: E402
+                                          resolve_migrate_max_bytes,
+                                          resolve_migrate_timeout_ms)
+from bigdl_tpu.serving.pagepool import PagePool  # noqa: E402
+from bigdl_tpu.serving.router import (RequestJournal,  # noqa: E402
+                                      resolve_router_journal)
+from bigdl_tpu.serving.wire import (WireError, corrupt_frame,  # noqa: E402
+                                    frame_payload, is_framed,
+                                    unframe_payload)
+
+
+# -- wire framing (no model) ------------------------------------------------
+
+
+def test_wire_frame_roundtrip():
+    doc = {"resume_id": "m-1", "generated": [5, 7, 11],
+           "planes": ["x" * 500]}
+    data = frame_payload(doc)
+    assert is_framed(data)
+    assert not is_framed(json.dumps(doc).encode())
+    assert unframe_payload(data) == doc
+
+
+def test_wire_frame_rejects_corruption():
+    data = frame_payload({"generated": list(range(64))})
+    flipped = corrupt_frame(data)
+    assert flipped != data
+    with pytest.raises(WireError) as ei:
+        unframe_payload(flipped)
+    assert ei.value.reason == "crc"
+
+
+def test_wire_frame_rejects_structural():
+    data = frame_payload({"a": 1})
+    # truncated body -> length
+    with pytest.raises(WireError) as ei:
+        unframe_payload(data[:-2])
+    assert ei.value.reason == "length"
+    # wrong magic
+    with pytest.raises(WireError) as ei:
+        unframe_payload(b"NOPE" + data[4:])
+    assert ei.value.reason == "magic"
+    # version skew: bump the u16 version field in place
+    skew = data[:4] + b"\x00\x63" + data[6:]
+    with pytest.raises(WireError) as ei:
+        unframe_payload(skew)
+    assert ei.value.reason == "version"
+    # too short for even a header
+    with pytest.raises(WireError) as ei:
+        unframe_payload(b"BTW1")
+    assert ei.value.reason == "length"
+
+
+# -- PagePool export/import accounting --------------------------------------
+
+
+def test_pagepool_export_import():
+    pool = PagePool(num_pages=8, page_size=16)
+    pages = pool.alloc(3)
+    assert pages is not None
+    man = pool.export_pages(pages)
+    assert man["pages"] == list(pages)
+    assert man["page_size"] == 16
+    assert pool.exported_pages_total == 3
+    # exporting a free page would ship stale KV — must raise
+    free_page = next(p for p in range(1, 8) if p not in pages)
+    with pytest.raises(RuntimeError):
+        pool.export_pages([pages[0], free_page])
+    with pytest.raises(RuntimeError):
+        pool.export_pages([0])          # the pinned null page
+    # all-or-nothing import: 4 free pages left, 5 must fail cleanly
+    before = pool.num_free
+    assert pool.import_pages(5) is None
+    assert pool.num_free == before      # nothing leaked
+    assert pool.import_exhausted_total == 1
+    got = pool.import_pages(4)
+    assert got is not None and len(got) == 4
+    assert all(pool.refcount(p) == 1 for p in got)
+    assert pool.imported_pages_total == 4
+    assert pool.num_free == 0
+
+
+# -- fault kinds ------------------------------------------------------------
+
+
+def test_fault_spec_validates_new_kinds():
+    spec = ("migration_drop@gate=send,every=1,times=1;"
+            "migration_corrupt@point=migrate,every=2;"
+            "net_latency@ms=5,every=1,point=canary;"
+            "net_drop@p=1.0,point=stats")
+    info = validate_fault_spec(spec)
+    assert info["valid"], info
+    assert set(info["clauses"]) == {"migration_drop",
+                                    "migration_corrupt",
+                                    "net_latency", "net_drop"}
+    assert not validate_fault_spec("migration_drop@gate=nope")["valid"]
+    assert not validate_fault_spec("net_latency@msx=5")["valid"]
+    assert not validate_fault_spec("wormhole@p=1.0")["valid"]
+
+
+def test_migration_drop_gate_matching():
+    fi = FaultInjector.from_env("migration_drop@gate=commit,every=1")
+    assert fi.enabled
+    assert not fi.drop_point("migrate_send", 1)
+    assert not fi.drop_point("migrate_recv", 2)
+    assert fi.drop_point("migrate_commit", 3)
+    # unset gate fires at every migration gate
+    fi = FaultInjector.from_env("migration_drop@every=1,times=2")
+    assert fi.drop_point("migrate_send", 1)
+    assert fi.drop_point("migrate_recv", 2)
+    assert not fi.drop_point("migrate_commit", 3)   # times exhausted
+
+
+def test_net_fault_kinds():
+    fi = FaultInjector.from_env(
+        "net_latency@ms=7,every=1,point=canary;"
+        "net_drop@p=1.0,point=migrate")
+    assert fi.net_delay_ms("canary", 1) == 7.0
+    assert fi.net_delay_ms("stats", 2) == 0.0
+    assert fi.net_dropped("migrate", 1)
+    assert not fi.net_dropped("handoff", 2)
+    # corrupt: unset point fires for both migrate and handoff payloads
+    fi = FaultInjector.from_env("migration_corrupt@every=1")
+    assert fi.corrupt_point("migrate", 1)
+    assert fi.corrupt_point("handoff", 2)
+
+
+# -- env resolvers ----------------------------------------------------------
+
+
+def test_migration_env_resolvers():
+    assert resolve_live_migration("") == "auto"
+    assert resolve_live_migration("ON") == "on"
+    assert resolve_migrate_timeout_ms(250) == 250.0
+    assert resolve_migrate_max_bytes(1 << 20) == 1 << 20
+    for bad in ("maybe", "1"):
+        with pytest.raises(ValueError):
+            resolve_live_migration(bad)
+    with pytest.raises(ValueError):
+        resolve_migrate_timeout_ms(0)
+    with pytest.raises(ValueError):
+        resolve_migrate_max_bytes(-1)
+    assert resolve_router_journal(None) is None
+    assert resolve_router_journal("/tmp/x.jsonl") == "/tmp/x.jsonl"
+    with pytest.raises(ValueError):
+        resolve_router_journal("relative/path.jsonl")
+
+
+# -- router journal torn-write recovery -------------------------------------
+
+
+def _journal_line(op: str, rid: str, **kw) -> bytes:
+    return (json.dumps({"op": op, "rid": rid, **kw}) + "\n").encode()
+
+
+def _admit_body(raw: bytes) -> str:
+    import base64
+
+    return base64.b64encode(raw).decode("ascii")
+
+
+def test_journal_torn_tail_recovery(tmp_path):
+    """A kill -9 mid-append leaves an unterminated trailing record:
+    recovery must skip exactly that record, replay the complete ones,
+    and count it."""
+    path = str(tmp_path / "journal.jsonl")
+    body = _admit_body(b'{"prompt": [1, 2], "max_tokens": 4}')
+    with open(path, "wb") as fh:
+        fh.write(_journal_line("admit", "r1", path="/v1/completions",
+                               body=body, stream=False, key=3))
+        fh.write(_journal_line("admit", "r2", path="/v1/completions",
+                               body=body, stream=False, key=4))
+        fh.write(_journal_line("complete", "r1"))
+        # torn tail: no newline commit marker
+        fh.write(b'{"op": "admit", "rid": "r3", "body": "eyJh')
+
+    j = RequestJournal(path)
+    try:
+        assert j.torn_records == 1
+        assert [e.rid for e in j.recovered] == ["r2"]
+        assert j.recovered[0].body == \
+            b'{"prompt": [1, 2], "max_tokens": 4}'
+        # the rewritten file is fully parseable and marks the replay
+        with open(path, "rb") as fh:
+            recs = [json.loads(x) for x in fh.read().splitlines()]
+        assert all(r.get("op") == "admit" for r in recs)
+        assert recs[0]["rid"] == "r2" and recs[0]["recovered"] is True
+    finally:
+        j.close()
+
+
+def test_journal_garbage_line_recovery(tmp_path):
+    """A corrupt mid-file line (garbage JSON) is skipped and counted
+    without losing the records around it — including the migrate hop
+    that tells recovery to replay rather than re-forward."""
+    path = str(tmp_path / "journal.jsonl")
+    body = _admit_body(b'{"prompt": [3], "max_tokens": 2}')
+    with open(path, "wb") as fh:
+        fh.write(_journal_line("admit", "r1", path="/v1/completions",
+                               body=body, stream=False, key=1))
+        fh.write(b"{telemetry got spliced in here}\n")
+        fh.write(_journal_line("migrate", "r1", resume_id="m-1",
+                               target="127.0.0.1:9"))
+    j = RequestJournal(path)
+    try:
+        assert j.torn_records == 1
+        assert [e.rid for e in j.recovered] == ["r1"]
+        assert j.recovered[0].migrated["resume_id"] == "m-1"
+        snap = j.snapshot()
+        assert snap["torn_records"] == 1 and snap["recovered"] == 1
+    finally:
+        j.close()
+
+
+def test_journal_records_migrations(tmp_path):
+    from bigdl_tpu.serving.router import JournalEntry
+
+    path = str(tmp_path / "journal.jsonl")
+    j = RequestJournal(path)
+    try:
+        e = JournalEntry(rid="r1", path="/v1/completions",
+                         body=b'{"prompt": [1]}', stream=False, key=0)
+        j.admit(e)
+        j.record_migration("r1", "m-9", "127.0.0.1:9001")
+        with open(path, "rb") as fh:
+            ops = [json.loads(x)["op"] for x in fh.read().splitlines()]
+        assert ops == ["admit", "migrate"]
+        assert e.migrated == {"resume_id": "m-9",
+                              "target": "127.0.0.1:9001"}
+        j.complete("r1")
+        assert j.depth() == 0
+    finally:
+        j.close()
+
+
+# -- in-process engine pairs: byte-identical resume -------------------------
+
+_ENGINE_CFG = dict(max_batch=2, max_seq=128, kv_page_size=16,
+                   kv_pages=64)
+
+
+def _drain(eng, rid):
+    """Step until rid finishes; returns (token_ids, finish_reason)."""
+    toks, reason = [], None
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        eng.step()
+        done = False
+        for o in eng.get_outputs(rid):
+            toks.extend(o.new_token_ids)
+            if o.finished:
+                reason = o.finish_reason
+                done = True
+        if done:
+            return toks, reason
+    raise AssertionError(f"{rid} never finished")
+
+
+def _migrate_between(src, dst, rid, prompt, params, pre_tokens=2):
+    """Run ``rid`` on ``src`` until ``pre_tokens`` tokens are out,
+    export mid-decode, stage + claim + resume on ``dst``; returns
+    (tokens_seen_before_migration, continuation_tokens, finish_reason).
+    """
+    src.add_request(rid, prompt, params)
+    got = []
+    deadline = time.monotonic() + 300
+    while len(got) < pre_tokens and time.monotonic() < deadline:
+        src.step()
+        for o in src.get_outputs(rid):
+            got.extend(o.new_token_ids)
+    assert len(got) >= pre_tokens
+    src.request_migration(rid)
+    st = None
+    while st is None and time.monotonic() < deadline:
+        src.step()
+        for o in src.get_outputs(rid):
+            got.extend(o.new_token_ids)      # tokens racing the export
+        st = src.take_export(rid)
+    assert st is not None and not st.get("unexportable")
+    assert st["generated"] == got            # nothing lost in transit
+    src.finish_migrated(rid, "peer", st["resume_id"])
+    _, reason = _drain(src, rid)
+    assert reason == "migrated"
+
+    resume_id = dst.stage_migration(st)
+    claimed = dst.claim_migration(resume_id)
+    assert claimed is not None
+    assert dst.claim_migration(resume_id) is None    # one-shot
+    dst.resume_migrated_request(rid + "-resumed", claimed)
+    cont, reason = _drain(dst, rid + "-resumed")
+    return got, cont, reason
+
+
+@pytest.fixture(scope="module")
+def engine_pair():
+    """Two engines over byte-identical tiny weights (same seed), paged
+    KV with radix prefix sharing on — the CoW path is the default one
+    migrations must survive."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from bigdl_tpu.serving import EngineConfig, LLMEngine
+    from bigdl_tpu.utils.testing import tiny_random_model
+
+    a = LLMEngine(tiny_random_model(seed=7),
+                  EngineConfig(prefix_sharing="on", **_ENGINE_CFG))
+    b = LLMEngine(tiny_random_model(seed=7),
+                  EngineConfig(prefix_sharing="on", **_ENGINE_CFG))
+    return a, b
+
+
+def test_migration_byte_identity_greedy(engine_pair):
+    from bigdl_tpu.serving import SamplingParams
+
+    a, b = engine_pair
+    prompt = list(range(1, 9))
+    p = SamplingParams(max_tokens=24, ignore_eos=True)
+    b.add_request("g-ref", prompt, p)
+    ref, _ = _drain(b, "g-ref")
+    pre, cont, reason = _migrate_between(a, b, "g-mig", prompt, p)
+    assert reason in ("length", "stop", "eos")
+    assert pre + cont == ref
+    snap = a.migration_snapshot()
+    assert snap["committed"] >= 1
+    assert snap["migrated_tokens_total"] >= len(pre)
+    assert snap["recomputed_tokens_total"] == 0
+    tsnap = b.migration_snapshot()
+    assert tsnap["imported"] >= 1 and tsnap["claimed"] >= 1
+
+
+def test_migration_byte_identity_seeded(engine_pair):
+    """Seeded sampling: the PRNG stream must survive the hop — the
+    continuation samples the SAME tokens the source would have."""
+    from bigdl_tpu.serving import SamplingParams
+
+    a, b = engine_pair
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    p = SamplingParams(max_tokens=24, temperature=0.9, seed=123,
+                       ignore_eos=True)
+    b.add_request("s-ref", prompt, p)
+    ref, _ = _drain(b, "s-ref")
+    pre, cont, _ = _migrate_between(a, b, "s-mig", prompt, p,
+                                    pre_tokens=3)
+    assert pre + cont == ref
+    assert a.migration_snapshot()["recomputed_tokens_total"] == 0
+
+
+def test_migration_radix_shared_prefix(engine_pair):
+    """A sequence whose prompt rides radix-CoW-shared pages exports and
+    resumes byte-identically: shared pages are exported like any other
+    page and the target owns fresh copies."""
+    from bigdl_tpu.serving import SamplingParams
+
+    a, b = engine_pair
+    shared = list(range(40, 72))             # two full 16-token pages
+    prompt = shared + [7, 8, 9]
+    warm = SamplingParams(max_tokens=2, ignore_eos=True)
+    p = SamplingParams(max_tokens=20, ignore_eos=True)
+    # seed A's radix so the migrated request's prefix pages are SHARED
+    a.add_request("warm", shared, warm)
+    _drain(a, "warm")
+    b.add_request("rx-ref", prompt, p)
+    ref, _ = _drain(b, "rx-ref")
+    pre, cont, _ = _migrate_between(a, b, "rx-mig", prompt, p)
+    assert pre + cont == ref
+
+
+def test_export_unexportable_and_local_resume(engine_pair):
+    """Exporting an unknown rid reports unexportable (sender leaves it
+    alone); resume_local after an export finishes the request HERE with
+    its full output — the failed-transfer path loses nothing."""
+    from bigdl_tpu.serving import SamplingParams
+
+    a, b = engine_pair
+    a.request_migration("no-such-request")
+    a.step()
+    assert a.take_export("no-such-request") == {"unexportable": True}
+    snap0 = a.migration_snapshot()
+    assert snap0["unexportable"] >= 1
+
+    prompt = [11, 12, 13, 14]
+    p = SamplingParams(max_tokens=16, ignore_eos=True)
+    b.add_request("lr-ref", prompt, p)
+    ref, _ = _drain(b, "lr-ref")
+    a.add_request("lr", prompt, p)
+    got = []
+    while len(got) < 2:
+        a.step()
+        for o in a.get_outputs("lr"):
+            got.extend(o.new_token_ids)
+    a.request_migration("lr")
+    st = None
+    while st is None:
+        a.step()
+        for o in a.get_outputs("lr"):
+            got.extend(o.new_token_ids)
+        st = a.take_export("lr")
+    assert not st.get("unexportable")
+    a.resume_local("lr")                     # every transfer failed
+    rest, reason = _drain(a, "lr")
+    assert reason in ("length", "stop", "eos")
+    assert got + rest == ref
+    snap = a.migration_snapshot()
+    assert snap["failed"] >= 1
+    # the local reseed path re-decodes nothing when the staged planes
+    # are still around; either way the client lost zero tokens
+    assert snap["local_resume"] >= 0
+
+
+def test_stage_migration_requires_resume_id(engine_pair):
+    a, _ = engine_pair
+    with pytest.raises(ValueError):
+        a.stage_migration({"generated": [1, 2]})
+
+
+# -- subprocess chaos e2e ---------------------------------------------------
+
+_REQ = {"prompt": list(range(1, 9)), "max_tokens": 200,
+        "temperature": 0.9, "seed": 123, "ignore_eos": True}
+
+
+def _spawn_api(port: int, fault_spec: str = "") -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("BIGDL_TPU_FAULT_SPEC", None)
+    if fault_spec:
+        env["BIGDL_TPU_FAULT_SPEC"] = fault_spec
+    cmd = [sys.executable, "-m", "bigdl_tpu.serving.api_server",
+           "--tiny-random", "--tiny-seed", "7",
+           "--host", "127.0.0.1", "--port", str(port),
+           "--max-batch", "2", "--max-seq", "256",
+           "--kv-page-size", "16", "--kv-pages", "64"]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT)
+
+
+def _wait_healthy(port: int, timeout: float = 240.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        time.sleep(0.25)
+    raise AssertionError(f"replica :{port} never became healthy")
+
+
+def _post(port: int, path: str, doc: dict, headers=None, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _get(port: int, path: str, timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_active(port: int, timeout: float = 40.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if _get(port, "/v1/stats")["slots"]["active"]:
+                return
+        except Exception:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"no request ever active on :{port}")
+
+
+@pytest.fixture(scope="module")
+def migrate_fleet():
+    """Source (A) + target (B) replicas, same seed, with one full
+    retry ladder of chaos armed at EVERY migration gate (three
+    attempts per migrate_out: resolve_handoff_retries default 2 + 1).
+    The clauses exhaust in test order — send drops, then corrupt
+    frames, then recv drops, then commit drops — and every later
+    migrate_out transfers cleanly."""
+    pa = _spawn_api(
+        18621,
+        fault_spec="migration_drop@gate=send,every=1,times=3;"
+                   "migration_corrupt@point=migrate,every=1,times=3")
+    pb = _spawn_api(
+        18622,
+        fault_spec="migration_drop@gate=recv,every=1,times=3;"
+                   "migration_drop@gate=commit,every=1,times=3")
+    try:
+        _wait_healthy(18621)
+        _wait_healthy(18622)
+        st, ref = _post(18622, "/v1/completions", dict(_REQ))
+        assert st == 200, ref
+        yield 18621, 18622, pa, pb, ref["choices"][0]["text"]
+    finally:
+        for p in (pa, pb):
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+def _migrate_inflight(src_port: int, dst_port: int):
+    """POST _REQ to src in a thread, migrate it mid-decode to dst;
+    returns (source status, source response doc, join fn)."""
+    out = {}
+
+    def run():
+        out["resp"] = _post(src_port, "/v1/completions", dict(_REQ),
+                            timeout=300)
+
+    t = threading.Thread(target=run)
+    t.start()
+    _wait_active(src_port)
+    time.sleep(0.15)                # let a few tokens decode first
+    st, summary = _post(src_port, "/v1/admin/migrate_out",
+                        {"targets": [f"127.0.0.1:{dst_port}"]})
+    assert st == 200, summary
+    t.join(timeout=300)
+    assert "resp" in out
+    return summary, out["resp"]
+
+
+def test_e2e_migration_drop_falls_back_local(migrate_fleet):
+    """First migrate_out hits the armed migration_drop at the send
+    gate: the transfer fails, the sequence resumes locally, the client
+    still gets the full byte-identical completion — zero lost
+    requests."""
+    a, b, _, _, ref_text = migrate_fleet
+    summary, (status, doc) = _migrate_inflight(a, b)
+    assert summary["migrated"] == 0, summary
+    assert summary["failed"] >= 1, summary
+    assert status == 200
+    assert doc.get("migrated") is None       # finished HERE, no marker
+    assert doc["choices"][0]["text"] == ref_text
+    mig = _get(a, "/v1/stats")["migration"]
+    assert mig["local_resume"] >= 1 or mig["failed"] >= 1, mig
+
+
+def test_e2e_corrupt_frame_rejected_then_local(migrate_fleet):
+    """Armed migration_corrupt bit-flips the sender's checksummed
+    frame on every attempt: the target's CRC check rejects each with a
+    structured 400 (counted per reason), the sender falls back to
+    local resume, the client sees the full byte-identical
+    completion."""
+    a, b, _, _, ref_text = migrate_fleet
+    summary, (status, doc) = _migrate_inflight(a, b)
+    assert summary["migrated"] == 0, summary
+    assert summary["failed"] >= 1, summary
+    assert status == 200
+    assert doc["choices"][0]["text"] == ref_text
+    rejects = _get(b, "/v1/stats")["wire_rejects"]
+    assert rejects.get("crc", 0) >= 1, rejects
+
+
+def test_e2e_recv_and_commit_gate_drops(migrate_fleet):
+    """The target-side gates: migrate_recv drops the intake BEFORE
+    staging, migrate_commit drops the ack AFTER staging (the staged
+    copy expires unclaimed). Both resolve to a clean local resume with
+    the full byte-identical completion — and the commit-gate orphans
+    never reach any client twice."""
+    a, b, _, _, ref_text = migrate_fleet
+    for gate in ("recv", "commit"):
+        summary, (status, doc) = _migrate_inflight(a, b)
+        assert summary["migrated"] == 0, (gate, summary)
+        assert summary["failed"] >= 1, (gate, summary)
+        assert status == 200
+        assert doc["choices"][0]["text"] == ref_text, gate
+    # the commit-gate drops staged state the source never committed:
+    # it must sit unclaimed (until TTL) rather than decode anywhere
+    tstats = _get(b, "/v1/stats")["migration"]
+    assert tstats["claimed"] == 0, tstats
+    assert tstats["staged"] >= 1, tstats
+
+
+def test_e2e_migrate_byte_identical_full_text(migrate_fleet):
+    """Clean migration: the source answers with the resume marker, the
+    continuation on the target returns the FULL completion (prompt
+    boundary detok included) byte-identical to the unmigrated
+    reference, and nobody recomputed anything."""
+    a, b, _, _, ref_text = migrate_fleet
+    summary, (status, doc) = _migrate_inflight(a, b)
+    assert summary["migrated"] == 1, summary
+    assert status == 200 and doc.get("migrated") is True, doc
+    st, cont = _post(b, "/v1/completions", dict(_REQ),
+                     headers={"X-Resume-Id": doc["resume_id"]})
+    assert st == 200, cont
+    assert cont["choices"][0]["text"] == ref_text
+    assert cont["usage"]["completion_tokens"] == _REQ["max_tokens"]
+
+    src = _get(a, "/v1/stats")["migration"]
+    dst = _get(b, "/v1/stats")["migration"]
+    assert src["committed"] >= 1, src
+    assert src["recomputed_tokens_total"] == 0, src
+    assert src["migrated_tokens_total"] >= 1, src
+    assert dst["imported"] >= 1 and dst["claimed"] >= 1, dst
+    # the wire really framed the transfer (no silent bare-JSON path)
+    assert dst.get("pool", {}).get("imported_pages_total", 1) >= 1
+
+
+def test_e2e_sigkill_source_after_commit(migrate_fleet):
+    """SIGKILL the source AFTER migrate_in commits: the target already
+    owns the sequence, the continuation yields the full completion with
+    no duplicate tokens — the crash costs nothing."""
+    a, b, pa, _, ref_text = migrate_fleet
+    summary, (status, doc) = _migrate_inflight(a, b)
+    assert summary["migrated"] == 1, summary
+    assert status == 200 and doc.get("migrated") is True, doc
+    pa.send_signal(signal.SIGKILL)
+    pa.wait(timeout=30)
+    st, cont = _post(b, "/v1/completions", dict(_REQ),
+                     headers={"X-Resume-Id": doc["resume_id"]})
+    assert st == 200, cont
+    assert cont["choices"][0]["text"] == ref_text     # no dup, no gap
+    assert cont["usage"]["completion_tokens"] == _REQ["max_tokens"]
+
+
+def test_e2e_unknown_resume_id_replays_fresh(migrate_fleet):
+    """A continuation whose staged state is gone (expired / never
+    arrived) degrades to a fresh replay: full recompute, correct
+    bytes."""
+    _, b, _, _, ref_text = migrate_fleet
+    st, doc = _post(b, "/v1/completions", dict(_REQ),
+                    headers={"X-Resume-Id": "m-never-staged"})
+    assert st == 200, doc
+    assert doc["choices"][0]["text"] == ref_text
+
+
+# -- router rolling restart under live load ---------------------------------
+
+
+def _spawn_restart_replica(idx: int, port: int):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("BIGDL_TPU_FAULT_SPEC", None)
+    env["BIGDL_TPU_DRAIN_TIMEOUT_SEC"] = "30"
+    cmd = [sys.executable, "-m", "bigdl_tpu.serving.api_server",
+           "--tiny-random", "--tiny-seed", "7",
+           "--host", "127.0.0.1", "--port", str(port),
+           "--max-batch", "4", "--max-seq", "256",
+           "--kv-page-size", "16", "--kv-pages", "128"]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT)
+
+
+def _stream_text(base: str, doc: dict) -> Tuple[int, str]:
+    """One streaming completion through the router: concatenated delta
+    text (the router splices a migrated sequence's continuation into
+    the same SSE socket, so the client never sees the seam)."""
+    req = urllib.request.Request(
+        f"{base}/v1/completions",
+        data=json.dumps(dict(doc, stream=True)).encode(),
+        method="POST", headers={"Content-Type": "application/json"})
+    pieces = []
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    break
+                chunk = json.loads(payload)
+                for c in chunk.get("choices") or []:
+                    pieces.append(c.get("text") or "")
+            return resp.status, "".join(pieces)
+    except urllib.error.HTTPError as e:
+        return e.code, ""
+
+
+def test_router_rolling_restart_zero_loss(tmp_path_factory):
+    """ISSUE acceptance: rolling restart of a 2-replica fleet under
+    continuous streaming + buffered load finishes with ZERO 5xx and
+    ZERO recomputed tokens — every mid-decode sequence on a draining
+    replica live-migrates to the healthy peer and every client gets
+    the byte-identical full completion."""
+    from bigdl_tpu.serving.router import Router, RouterConfig
+
+    journal = str(tmp_path_factory.mktemp("rrj") / "journal.jsonl")
+    router = Router(spawn=_spawn_restart_replica, config=RouterConfig(
+        replicas=2, health_sec=0.25, backoff_base_sec=0.2,
+        crash_budget=20, crash_window_sec=5.0, unhealthy_after=4,
+        spawn_timeout_sec=240.0, drain_exit_timeout_sec=90.0,
+        no_replica_wait_sec=120.0, journal_path=journal))
+    router.start(wait_healthy=True)
+    httpd = router.serve(port=0, background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    stop = threading.Event()
+    results = []                      # (kind, status, text)
+    errors = []
+
+    def worker(kind: str):
+        while not stop.is_set():
+            try:
+                if kind == "stream":
+                    st, text = _stream_text(base, _REQ)
+                else:
+                    st, doc = _post(httpd.server_address[1],
+                                    "/v1/completions", dict(_REQ),
+                                    timeout=300)
+                    text = (doc["choices"][0]["text"]
+                            if st == 200 else "")
+                results.append((kind, st, text))
+            except Exception as e:     # transport-level failure = loss
+                errors.append(f"{kind}: {type(e).__name__}: {e}")
+
+    try:
+        # reference completion through the router (also jit-warms the
+        # replica the affinity hash picks)
+        st, ref = _post(httpd.server_address[1], "/v1/completions",
+                        dict(_REQ), timeout=300)
+        assert st == 200, ref
+        ref_text = ref["choices"][0]["text"]
+        assert ref["usage"]["completion_tokens"] == _REQ["max_tokens"]
+
+        workers = [threading.Thread(target=worker, args=(k,))
+                   for k in ("stream", "stream", "buffered",
+                             "buffered")]
+        for t in workers:
+            t.start()
+        time.sleep(1.0)               # load established, decodes live
+        summary = router.rolling_restart()
+        time.sleep(3 * 0.25 + 0.5)    # final stats polls land
+    finally:
+        stop.set()
+        for t in workers:
+            t.join(timeout=300)
+        # the journal's complete record lands after the response write
+        # — give the handler threads a beat before snapshotting
+        for _ in range(40):
+            if router.journal.depth() == 0:
+                break
+            time.sleep(0.05)
+        stats = router.stats_snapshot()
+        httpd.shutdown()
+        router.shutdown()
+
+    assert summary["ok"], summary
+    assert not errors, errors
+    assert results, "no load survived the restart window"
+    fivexx = [(k, s) for k, s, _ in results if s >= 500]
+    assert not fivexx, fivexx          # zero 5xx through the restart
+    bad = [(k, s, t[:60]) for k, s, t in results if t != ref_text]
+    assert not bad, bad                # byte-identical, stream + buffered
+
+    mig = stats["migration"]
+    counters = stats["counters"]
+    assert counters.get("sequences_migrated", 0) >= 1, counters
+    # the source's committed delta can die with the drained process
+    # before the next stats poll; the TARGET's claim always survives
+    # the restart, as does anything it would have had to recompute
+    assert mig.get("migration_claimed", 0) >= 1, mig
+    assert mig.get("recomputed_tokens_total", 0) == 0, mig
+    assert counters.get("migration_fallback_replays", 0) == 0, counters
+    # every migrated hop hit the durable journal before its forward
+    with open(journal, "rb") as fh:
+        ops = [json.loads(x)["op"] for x in fh.read().splitlines()]
+    assert "migrate" in ops, ops[:20]
+    assert stats["journal"]["depth"] == 0

@@ -70,6 +70,15 @@ METRIC_DIRECTIONS = {
     # single mismatch between byte-identical seeded replicas means a
     # replica decoded garbage
     "canary_failures": "lower",
+    # rolling-restart lane (bench_serving router_bench.restart block):
+    # a planned restart must lose no requests (http_5xx), re-decode no
+    # tokens the fleet already generated (recomputed_tokens_total —
+    # live migration ships them instead), and land every attempted
+    # sequence handoff (migrations_failed). All three sit at zero on a
+    # healthy baseline, so any growth flags as inf%.
+    "http_5xx": "lower",
+    "recomputed_tokens_total": "lower",
+    "migrations_failed": "lower",
     "decode_mfu": "higher",
     "prefill_mfu": "higher",
     "decode_hbm_roofline_util": "higher",
@@ -120,6 +129,12 @@ ROBUSTNESS_COUNTERS = (
     # golden-canary byte mismatches (serving/canary.py) — also
     # zero-gated: byte-identical seeded replicas must agree
     "bigdl_tpu_router_canary_failures_total",
+    # live-migration health: a failed sequence migration means a
+    # planned drain fell back to journal replay (recompute), and a
+    # rejected wire frame means a corrupt/skewed internal payload
+    # reached a replica
+    'bigdl_tpu_migrations_total{outcome="failed',
+    "bigdl_tpu_handoff_rejects_total",
 )
 
 # counters that must be exactly 0 in the candidate run, baseline or
@@ -153,6 +168,14 @@ ROUTER_COUNTERS = {
     "autoscale_refused": "lower",
     # golden-canary byte mismatches: zero-gated via ZERO_COUNTERS too
     "canary_failures": "lower",
+    # live-migration recovery actions (flat router counters): failed
+    # handoffs, continuation fallbacks to journal replay, recomputed
+    # tokens, torn journal records — all zero on a clean fleet
+    "migration_failed": "lower",
+    "sequences_migrate_failed": "lower",
+    "migration_fallback_replays": "lower",
+    "recomputed_tokens_total": "lower",
+    "journal_torn_records": "lower",
 }
 
 # host dispatch overhead of the decode step (bench_serving
